@@ -1,0 +1,148 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Capability parity with PaddlePaddle's public API surface
+(``python/paddle/__init__.py``), built from scratch on jax/XLA/Pallas:
+eager ops dispatch to XLA (debug path), training loops compile through
+``jax.jit``/pjit (perf path), parallelism maps onto ``jax.sharding.Mesh``.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# float64 capability parity with the reference (x64 must be on before tracing)
+_jax.config.update("jax_enable_x64", True)
+# keep python-float default at float32 (paddle semantics) via weak types.
+
+from . import dtypes as _dtype_module
+from .dtypes import (  # noqa: F401
+    DType,
+    bool_,
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    float8_e4m3fn,
+    float8_e5m2,
+    complex64,
+    complex128,
+    iinfo,
+    finfo,
+    promote_types,
+)
+
+dtype = DType  # paddle.dtype is the dtype class
+
+from .device import (  # noqa: F401
+    Place,
+    TPUPlace,
+    CPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+    XPUPlace,
+    CustomPlace,
+    set_device,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_rocm,
+    is_compiled_with_xpu,
+    is_compiled_with_tpu,
+)
+
+from .framework import (  # noqa: F401
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    is_grad_enabled,
+    set_default_dtype,
+    get_default_dtype,
+    seed,
+    get_rng_state,
+    set_rng_state,
+    in_dynamic_mode,
+    in_dynamic_or_pir_mode,
+    Generator,
+)
+
+from .core.tensor import Tensor, Parameter  # noqa: F401
+
+# ops: importing patches Tensor methods
+from .ops import *  # noqa: F401,F403
+from . import ops as _ops
+
+from .autograd import grad, PyLayer  # noqa: F401
+from . import autograd  # noqa: F401
+
+# subpackages (populated progressively; import lazily where heavy)
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import amp  # noqa: F401
+from . import vision  # noqa: F401
+from . import jit  # noqa: F401
+from . import distributed  # noqa: F401
+from . import device  # noqa: F401
+from . import utils  # noqa: F401
+from . import incubate  # noqa: F401
+from . import profiler  # noqa: F401
+from . import static  # noqa: F401
+from . import sparse  # noqa: F401
+from . import distribution  # noqa: F401
+from . import linalg_ns as linalg  # noqa: F401
+from . import fft  # noqa: F401
+from .hapi.model import Model, summary  # noqa: F401
+from .framework_io import save, load  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for "
+        "compiled execution."
+    )
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.summary import flops as _flops
+
+    return _flops(net, input_size, custom_ops, print_detail)
+
+
+def get_flags(flags):
+    from .utils import flags as _flags
+
+    return _flags.get_flags(flags)
+
+
+def set_flags(flags):
+    from .utils import flags as _flags
+
+    return _flags.set_flags(flags)
+
+
+def synchronize():
+    """Block until all enqueued device work completes."""
+    try:
+        _jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class CUDAGraph:  # capability slot: jit already gives whole-step graphs on TPU
+    def __init__(self, *a, **k):
+        raise NotImplementedError("Use paddle_tpu.jit — XLA compiles whole-step graphs.")

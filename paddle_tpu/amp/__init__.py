@@ -1,0 +1,222 @@
+"""paddle.amp — autocast + GradScaler (parity: python/paddle/amp/).
+
+TPU-native stance: bf16 is the native mixed-precision dtype (MXU computes in
+bf16 natively), so O1 autocast casts matmul/conv inputs to bf16 and loss
+scaling is a no-op by default (bf16 has fp32's exponent range). The GradScaler
+API is kept for source compatibility — with ``use_fp16=float16`` semantics it
+performs real scaling.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from .. import dtypes as _dt
+from ..core.tensor import Tensor
+
+# per-op lists (parity: amp/amp_lists.py:33-113)
+WHITE_LIST = {  # run in low precision
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "sdpa",
+}
+BLACK_LIST = {  # must stay fp32
+    "exp", "log", "log2", "log10", "mean", "sum", "softmax", "log_softmax",
+    "cross_entropy", "softmax_with_cross_entropy", "layer_norm", "rms_norm",
+    "norm", "cumsum", "logsumexp", "erf", "erfinv", "pow",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = _dt.bfloat16
+        self.level = "O1"
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def is_auto_cast_enabled():
+    return _state.enabled
+
+
+def get_amp_dtype():
+    return _state.dtype
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast (amp/auto_cast.py:1006)."""
+    prev = (_state.enabled, _state.dtype, _state.level)
+    _state.enabled = enable
+    _state.dtype = _dt.convert_dtype(dtype)
+    _state.level = level
+    added_w = set(custom_white_list or ())
+    added_b = set(custom_black_list or ())
+    WHITE_LIST.update(added_w)
+    BLACK_LIST.update(added_b)
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+        WHITE_LIST.difference_update(added_w - BLACK_LIST)
+        BLACK_LIST.difference_update(added_b)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None, save_dtype=None, master_grad=False, excluded_layers=None):
+    """paddle.amp.decorate (amp/auto_cast.py:1091) — O2 casts parameters."""
+    from ..nn import Layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        npd = _dt.to_np(dtype)
+        for m in model_list:
+            excluded = set()
+            if excluded_layers:
+                ex = excluded_layers if isinstance(excluded_layers, (list, tuple)) else [excluded_layers]
+                for l in m.sublayers(include_self=True):
+                    for e in ex:
+                        if isinstance(e, type) and isinstance(l, e):
+                            excluded.update(id(p) for p in l.parameters(include_sublayers=False))
+            from ..nn.layer.norm import _BatchNormBase, LayerNorm
+
+            for l in m.sublayers(include_self=True):
+                is_norm = isinstance(l, (_BatchNormBase, LayerNorm))
+                for p in l.parameters(include_sublayers=False):
+                    if id(p) in excluded or is_norm:
+                        continue
+                    if p.dtype.is_floating_point:
+                        p._data = p._data.astype(npd)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """parity: amp/grad_scaler.py:657.
+
+    On TPU with bf16 the scaler defaults to pass-through (enable_loss_scaling
+    honored when the user opts into float16).
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**16, incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000, decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import numpy as np
+
+        inv = 1.0 / self._scale
+        self._found_inf = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is None:
+                continue
+            g = p.grad._data * inv
+            if not bool(jnp.all(jnp.isfinite(g))):
+                self._found_inf = True
+            p.grad._data = g
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+
+
+# -- debugging (parity: amp/debugging.py) ----------------------------------
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    import numpy as np
+
+    arr = tensor.numpy()
+    n_nan = int(np.isnan(arr).sum())
+    n_inf = int(np.isinf(arr).sum())
+    if n_nan or n_inf:
+        raise RuntimeError(
+            f"check_numerics failed for {op_type}:{var_name}: "
+            f"{n_nan} nan, {n_inf} inf values"
+        )
+    return n_nan, n_inf
+
+
+class debugging:
+    check_numerics = staticmethod(check_numerics)
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        pass
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        pass

@@ -1,0 +1,202 @@
+"""User-facing autograd API (parity: python/paddle/autograd + paddle.grad).
+
+``backward``/``grad`` drive the tape engine (core/autograd_engine.py);
+``PyLayer`` lets users define custom forward/backward pairs recorded on the
+same tape (reference: ``paddle/fluid/eager/pylayer/``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import GradNode, apply_op
+from ..core import autograd_engine
+from ..framework import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward"""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    autograd_engine.run_backward(
+        list(tensors), grad_tensors, retain_graph=retain_graph
+    )
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+    name=None,
+):
+    """paddle.grad — gradients of outputs w.r.t. inputs (GeneralGrad analogue)."""
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    return autograd_engine.run_backward(
+        list(outputs),
+        grad_outputs,
+        retain_graph=retain_graph,
+        create_graph=create_graph,
+        inputs=list(inputs),
+        allow_unused=allow_unused,
+    )
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensor_list(self):
+        return list(self._saved)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom op with user-defined forward and backward.
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.exp(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor
+            return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .. import framework
+
+        ctx = PyLayerContext()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        if not framework.is_grad_enabled():
+            return outputs
+
+        single = isinstance(outputs, Tensor)
+        out_list = [outputs] if single else [o for o in outputs if isinstance(o, Tensor)]
+
+        in_tensors = [
+            a for a in list(args) + list(kwargs.values())
+            if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+        if not in_tensors:
+            return outputs
+
+        # Build a GradNode whose backward runs the user's python backward.
+        import numpy as np
+
+        edges = []
+        for t in in_tensors:
+            if t._grad_node is not None:
+                edges.append(("node", t._grad_node, t._out_index))
+            else:
+                edges.append(("leaf", t))
+        out_avals = [(tuple(o._data.shape), np.dtype(o._data.dtype)) for o in out_list]
+        from jax import tree_util
+
+        _, out_treedef = tree_util.tree_flatten([0] * len(out_list))
+
+        node = _PyLayerGradNode(
+            cls, ctx, [t._data for t in in_tensors], in_tensors, edges, out_avals, out_treedef
+        )
+        for idx, o in enumerate(out_list):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_index = idx
+        return outputs
+
+
+class _PyLayerGradNode(GradNode):
+    __slots__ = ("cls", "ctx")
+
+    def __init__(self, cls, ctx, in_arrays, in_tensors, edges, out_avals, out_treedef):
+        def pure_fn(diff_arrays):  # only used for shape metadata; never vjp'd
+            raise RuntimeError("PyLayer backward is user-defined")
+
+        super().__init__(
+            f"PyLayer_{cls.__name__}", pure_fn, in_arrays, in_tensors, edges,
+            out_avals, out_treedef,
+        )
+        self.cls = cls
+        self.ctx = ctx
+
+
+def _pylayer_backward(node, cts, create_graph):
+    """Engine hook: run the user's backward for PyLayer nodes."""
+    ct_tensors = [c if isinstance(c, Tensor) else Tensor(jnp.asarray(c)) for c in cts]
+    with set_grad_enabled(create_graph):
+        grads = node.cls.backward(node.ctx, *ct_tensors)
+    if isinstance(grads, Tensor) or grads is None:
+        grads = (grads,)
+    out = []
+    for g in grads:
+        if g is None:
+            out.append(None)
+        elif create_graph:
+            out.append(g)
+        else:
+            out.append(g._data)
+    if len(out) != len(node.edges):
+        raise RuntimeError(
+            f"PyLayer.backward returned {len(out)} grads for {len(node.edges)} inputs"
+        )
+    return out
+
+
+autograd_engine.PYLAYER_BACKWARD = _pylayer_backward
+
+
+def is_pylayer_node(node):
+    return isinstance(node, _PyLayerGradNode)
+
+
+class saved_tensors_hooks:
+    """API-compat stub: registers pack/unpack hooks for saved tensors.
+
+    On TPU the eager tape stores device arrays; offloading hooks are a no-op
+    unless the user supplies host-offload functions.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,3 @@
+from .tensor import Tensor  # noqa: F401
+from .dispatch import apply_op  # noqa: F401
+from . import autograd_engine  # noqa: F401

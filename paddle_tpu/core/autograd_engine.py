@@ -1,0 +1,244 @@
+"""Queue-based reverse-mode autograd engine over the GradNode tape.
+
+TPU-native re-design of the reference's ``egr::RunBackward``
+(``paddle/fluid/eager/backward.cc:106``): build dependency counts over the
+reachable node graph, seed output cotangents, then pop-run nodes whose
+consumers have all contributed, accumulating into ``GradTensorHolder``-style
+buffers.  ``paddle.grad``-style subgraph capture (the reference's
+``GeneralGrad``) is implemented via capture keys on (node, out_index) / leaf.
+
+When ``create_graph=True`` the per-node backward computation is re-recorded
+through :func:`apply_op`, so higher-order derivatives compose naturally.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+from jax import tree_util
+
+from .dispatch import GradNode, apply_op, run_vjp, zero_cotangent
+
+
+def _raw(x):
+    from .tensor import Tensor
+
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _accum(a, b):
+    if a is None:
+        return b
+    from .tensor import Tensor
+
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        return apply_op(jnp.add, a, b, _op_name="grad_accumulate")
+    return jnp.add(a, b)
+
+
+def _capture_key(t):
+    if t._grad_node is not None:
+        return ("node", id(t._grad_node), t._out_index)
+    return ("leaf", id(t))
+
+
+def run_backward(
+    tensors,
+    grad_tensors=None,
+    retain_graph=False,
+    create_graph=False,
+    inputs=None,
+    allow_unused=False,
+    accumulate_grad=True,
+):
+    """Core engine. If `inputs` is given, returns their grads (capture mode)."""
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    grad_tensors = list(grad_tensors)
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors must match outputs in length")
+
+    capture = None
+    if inputs is not None:
+        capture = {}
+        for t in inputs:
+            capture.setdefault(_capture_key(t), None)
+
+    retain = retain_graph or create_graph
+
+    # ---- seed cotangents --------------------------------------------------
+    holders = {}  # id(node) -> list per out_idx of accumulated ct
+    node_by_id = {}
+
+    def _seed_value(t, g):
+        if g is None:
+            ones = jnp.ones(t._data.shape, t._data.dtype)
+            return Tensor(ones) if create_graph else ones
+        if not create_graph:
+            g = _raw(g)
+        elif not isinstance(g, Tensor):
+            g = Tensor(jnp.asarray(g))
+        return g
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            raise RuntimeError(
+                "Tensor passed to backward() has stop_gradient=True and no "
+                "grad graph; nothing to differentiate."
+            )
+        g = _seed_value(t, g)
+        node = t._grad_node
+        if node is None:
+            _deliver_leaf(t, g, capture, accumulate_grad, create_graph)
+            continue
+        node_by_id[id(node)] = node
+        h = holders.setdefault(id(node), [None] * len(node.out_avals))
+        h[t._out_index] = _accum(h[t._out_index], g)
+        if capture is not None:
+            k = ("node", id(node), t._out_index)
+            if k in capture:
+                capture[k] = _accum(capture[k], g)
+
+    # ---- discover reachable graph + dependency counts ---------------------
+    reachable = {}
+    stack = list(node_by_id.values())
+    while stack:
+        n = stack.pop()
+        if id(n) in reachable:
+            continue
+        reachable[id(n)] = n
+        for e in n.edges:
+            if e[0] == "node":
+                stack.append(e[1])
+    dep = collections.Counter()
+    for n in reachable.values():
+        for e in n.edges:
+            if e[0] == "node" and id(e[1]) in reachable:
+                dep[id(e[1])] += 1
+
+    queue = collections.deque(
+        n for nid, n in reachable.items() if dep[nid] == 0 and nid in holders
+    )
+    # nodes with dep 0 but no seed can exist only if unreachable from outputs;
+    # they are simply never processed.
+
+    processed = set()
+    while queue:
+        node = queue.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        h = holders.get(id(node), [None] * len(node.out_avals))
+        cts = []
+        for idx, aval in enumerate(node.out_avals):
+            ct = h[idx]
+            if ct is None:
+                z = zero_cotangent(aval)
+                ct = Tensor(z) if (create_graph and np.issubdtype(aval[1], np.inexact)) else z
+            for hook in node.hooks.get(idx, ()):
+                ct = hook(ct if isinstance(ct, Tensor) else Tensor(ct))
+                if not create_graph:
+                    ct = _raw(ct)
+            cts.append(ct)
+
+        gin = _node_backward(node, cts, create_graph)
+
+        for g, edge in zip(gin, node.edges):
+            if g is None:
+                continue
+            if edge[0] == "leaf":
+                _deliver_leaf(edge[1], g, capture, accumulate_grad, create_graph)
+            else:
+                _, target, idx = edge
+                if id(target) in reachable:
+                    th = holders.setdefault(id(target), [None] * len(target.out_avals))
+                    th[idx] = _accum(th[idx], g)
+                    if capture is not None:
+                        k = ("node", id(target), idx)
+                        if k in capture:
+                            capture[k] = _accum(capture[k], g)
+                    dep[id(target)] -= 1
+                    if dep[id(target)] == 0:
+                        queue.append(target)
+        holders.pop(id(node), None)
+        if not retain:
+            node.release()
+
+    # ---- collect captured input grads ------------------------------------
+    if capture is None:
+        return None
+    results = []
+    for t in inputs:
+        g = capture[_capture_key(t)]
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph; set allow_unused=True to return "
+                    "None for it."
+                )
+            results.append(None)
+        else:
+            if not isinstance(g, Tensor):
+                g = Tensor(g, stop_gradient=True)
+            results.append(g)
+    return results
+
+
+def _deliver_leaf(leaf, g, capture, accumulate_grad, create_graph):
+    from .tensor import Tensor
+
+    if capture is not None:
+        k = ("leaf", id(leaf))
+        if k in capture:
+            capture[k] = _accum(capture[k], g)
+        return  # only_inputs=True semantics: don't touch other leaves' .grad
+    if leaf.stop_gradient or not accumulate_grad:
+        return
+    for hook in leaf._hooks:
+        out = hook(g if isinstance(g, Tensor) else Tensor(g))
+        if out is not None:
+            g = out if create_graph else _raw(out)
+    if not isinstance(g, Tensor):
+        g = Tensor(g, stop_gradient=True)
+    if leaf._grad is None:
+        leaf._grad = g
+    else:
+        new = apply_op(jnp.add, leaf._grad, g, _op_name="grad_accumulate")
+        if not create_graph:
+            new.stop_gradient = True
+        leaf._grad = new
+
+
+PYLAYER_BACKWARD = None  # wired by paddle_tpu.autograd (PyLayer support)
+
+
+def _node_backward(node: GradNode, cts, create_graph):
+    from .tensor import Tensor
+
+    if PYLAYER_BACKWARD is not None and type(node).__name__ == "_PyLayerGradNode":
+        return PYLAYER_BACKWARD(node, cts, create_graph)
+
+    if not create_graph:
+        return run_vjp(node, cts)
+
+    import jax
+
+    def bw(cts_leaves, ins):
+        c = tree_util.tree_unflatten(node.out_treedef, cts_leaves)
+        _, pull = jax.vjp(node.pure_fn, list(ins))
+        return pull(c)[0]
+
+    if node.released:
+        raise RuntimeError(
+            f"GradNode {node.name} has been freed; use retain_graph=True."
+        )
+    return apply_op(bw, cts, node.in_tensors, _op_name=f"{node.name}_grad")

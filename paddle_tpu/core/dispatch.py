@@ -1,0 +1,192 @@
+"""Eager op dispatch with tape-based autograd recording.
+
+This is the TPU-native analogue of the reference's generated ``*_ad_func``
+eager wrappers (``paddle/fluid/eager/auto_code_generator/generator/eager_gen.py``)
+plus ``GradNodeBase`` recording (``paddle/fluid/eager/grad_node_info.h:197``):
+every framework op is a *pure jax function*; :func:`apply_op` executes it on the
+unwrapped ``jax.Array`` payloads and, when gradients are required, records a
+:class:`GradNode` holding the pure function and its differentiable inputs.
+
+Backward (see ``autograd_engine.py``) recomputes the op under ``jax.vjp`` —
+i.e. eager mode rematerializes forward activations during backward (cheap on
+accelerators, memory-friendly, and makes higher-order autograd fall out
+naturally because the backward computation can itself be re-recorded).
+
+The jit/to_static path does NOT use this tape: whole training steps are traced
+functionally and differentiated with ``jax.grad`` (see paddle_tpu/jit).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+from .. import framework
+
+
+def _is_tensor(x):
+    from .tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``pure_fn`` maps a list of differentiable input arrays to the op's output
+    pytree; non-differentiable inputs are captured in its closure (the
+    analogue of the reference's ``TensorWrapper`` input capture).
+    """
+
+    __slots__ = (
+        "name",
+        "pure_fn",
+        "in_arrays",
+        "in_tensors",
+        "edges",
+        "out_avals",
+        "out_treedef",
+        "hooks",
+        "released",
+        "__weakref__",
+    )
+
+    def __init__(
+        self, name, pure_fn, in_arrays, in_tensors, edges, out_avals, out_treedef
+    ):
+        self.name = name
+        self.pure_fn = pure_fn
+        self.in_arrays = in_arrays
+        self.in_tensors = in_tensors  # differentiable input Tensors (captured)
+        self.edges = edges  # list of ("node", node, out_idx) | ("leaf", tensor)
+        self.out_avals = out_avals  # [(shape, np_dtype)] per output leaf
+        self.out_treedef = out_treedef
+        self.hooks = {}  # out_idx -> [fn]
+        self.released = False
+
+    def release(self):
+        self.pure_fn = None
+        self.in_arrays = None
+        self.in_tensors = None
+        self.released = True
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_out={len(self.out_avals)}>"
+
+
+def _maybe_autocast(op_name, arrays):
+    from .. import amp as _amp
+
+    state = _amp.amp_state()
+    if not state.enabled:
+        return arrays
+    low = state.dtype.np_dtype
+    if op_name in _amp.WHITE_LIST:
+        target = low
+    elif op_name in _amp.BLACK_LIST:
+        target = np.float32
+    else:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and hasattr(a, "astype") and jnp.issubdtype(
+            getattr(a, "dtype", None), jnp.floating
+        ) and a.dtype != target and a.dtype != np.float64:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+def _differentiable(leaf):
+    if not _is_tensor(leaf) or leaf.stop_gradient:
+        return False
+    return jnp.issubdtype(leaf._data.dtype, jnp.inexact)
+
+
+def apply_op(fn, *args, _op_name=None, **kwargs):
+    """Run pure jax function `fn` over (args, kwargs) that may contain Tensors.
+
+    Returns outputs wrapped as Tensors, recording a GradNode if needed.
+    """
+    from .tensor import Tensor
+
+    leaves, treedef = tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: _is_tensor(x)
+    )
+    arrays = [l._data if _is_tensor(l) else l for l in leaves]
+
+    # AMP autocast: per-op white/black list casting (reference analogue:
+    # AMP logic injected per-op by eager codegen, eager_gen.py:1996-2055).
+    name_for_amp = _op_name or getattr(fn, "__name__", "op")
+    arrays = _maybe_autocast(name_for_amp, arrays)
+
+    record = framework.is_grad_enabled()
+    diff_pos = [i for i, l in enumerate(leaves) if _differentiable(l)] if record else []
+
+    if not diff_pos:
+        a2, k2 = tree_util.tree_unflatten(treedef, arrays)
+        out = fn(*a2, **k2)
+        return _wrap_outputs(out, node=None)
+
+    def pure(diff_arrays):
+        buf = list(arrays)
+        for pos, arr in zip(diff_pos, diff_arrays):
+            buf[pos] = arr
+        a2, k2 = tree_util.tree_unflatten(treedef, buf)
+        return fn(*a2, **k2)
+
+    in_arrays = [arrays[i] for i in diff_pos]
+    out = pure(in_arrays)
+
+    edges = []
+    for i in diff_pos:
+        t = leaves[i]
+        if t._grad_node is not None:
+            edges.append(("node", t._grad_node, t._out_index))
+        else:
+            edges.append(("leaf", t))
+
+    out_leaves, out_treedef = tree_util.tree_flatten(out)
+    out_avals = [(tuple(o.shape), np.dtype(o.dtype)) for o in out_leaves]
+    name = _op_name or getattr(fn, "__name__", "op")
+    in_tensors = [leaves[i] for i in diff_pos]
+    node = GradNode(name, pure, in_arrays, in_tensors, edges, out_avals, out_treedef)
+
+    wrapped = []
+    for idx, o in enumerate(out_leaves):
+        t = Tensor(o, stop_gradient=not jnp.issubdtype(o.dtype, jnp.inexact))
+        if not t.stop_gradient:
+            t._grad_node = node
+            t._out_index = idx
+        wrapped.append(t)
+    return tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def _wrap_outputs(out, node):
+    from .tensor import Tensor
+
+    out_leaves, out_treedef = tree_util.tree_flatten(out)
+    wrapped = [Tensor(o, stop_gradient=True) for o in out_leaves]
+    return tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def run_vjp(node: GradNode, cotangents):
+    """Compute input gradients for `node` given per-output cotangent arrays."""
+    if node.released:
+        raise RuntimeError(
+            f"GradNode {node.name} has been freed; pass retain_graph=True "
+            "if you need to backward through the graph a second time."
+        )
+    cts = tree_util.tree_unflatten(node.out_treedef, cotangents)
+    _, pull = jax.vjp(node.pure_fn, node.in_arrays)
+    (gin,) = pull(cts)
+    return gin
+
+
+def zero_cotangent(aval):
+    shape, dt = aval
+    if np.issubdtype(dt, np.inexact):
+        return jnp.zeros(shape, dt)
+    return np.zeros(shape, jax.dtypes.float0)

@@ -1,0 +1,323 @@
+"""The eager Tensor: a python handle over a ``jax.Array`` payload.
+
+TPU-native analogue of the reference's eager tensor
+(``paddle/phi/api/include/tensor.h:82`` + ``paddle/fluid/pybind/eager.cc``):
+holds the device buffer, the autograd meta (grad node + output index,
+cf. ``AutogradMeta``), the ``stop_gradient`` flag (default True like the
+reference — Parameters flip it to False), and the accumulated ``.grad``.
+
+Most operator methods (``matmul``, ``__add__``, ``reshape``...) are patched on
+by ``paddle_tpu.ops`` at import time, mirroring how the reference monkey-patches
+``eager_math_op_patch.cc`` methods onto the pybind tensor type.
+
+In-place ops (``add_``, ``__setitem__``) follow functional-rebind semantics:
+the new value is computed out-of-place (XLA is functional) and this handle is
+re-pointed at it, keeping autograd exact.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import dtypes as _dtype_mod
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_out_index",
+        "_hooks",
+        "name",
+        "persistable",
+        "trainable",
+        "_dist_attr",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, data, stop_gradient=True, name=None):
+        # `data` must already be a jax array (or tracer); user-facing creation
+        # goes through paddle_tpu.to_tensor.
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._hooks = []
+        if name is None:
+            _tensor_counter[0] += 1
+            name = f"generated_tensor_{_tensor_counter[0]}"
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._dist_attr = None
+
+    # ------------------------------------------------------------------
+    # structure / metadata
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return _dtype_mod.dtype_from_array(self._data)
+
+    @property
+    def place(self):
+        from .. import device as _device
+
+        try:
+            dev = self._data.devices()
+            plat = next(iter(dev)).platform
+        except Exception:
+            plat = "cpu"
+        if plat == "cpu":
+            return _device.CPUPlace(0)
+        return _device.TPUPlace(0)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return self.dtype.itemsize
+
+    # ------------------------------------------------------------------
+    # host interop
+    # ------------------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        a = self.numpy()
+        if args:
+            return a.item(*args)
+        return a.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is "
+                "ambiguous."
+            )
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_info = f", stop_gradient={self.stop_gradient}"
+        try:
+            vals = np.array2string(
+                self.numpy(), precision=6, separator=", ", threshold=64
+            )
+        except Exception:
+            vals = f"<traced {self._data}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+            f"{grad_info},\n       {vals})"
+        )
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = value
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from . import autograd_engine
+
+        autograd_engine.run_backward(
+            [self], [grad_tensor], retain_graph=retain_graph
+        )
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._data))
+        else:
+            self._grad = None
+
+    def zero_grad(self):
+        self.clear_grad()
+
+    def register_hook(self, hook):
+        """Hook on this tensor's gradient. Returns a removable handle."""
+        if self._grad_node is not None:
+            hooks = self._grad_node.hooks.setdefault(self._out_index, [])
+            hooks.append(hook)
+            container = hooks
+        else:
+            self._hooks.append(hook)
+            container = self._hooks
+
+        class RemovableHandle:
+            def remove(self_inner):
+                try:
+                    container.remove(hook)
+                except ValueError:
+                    pass
+
+        return RemovableHandle()
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self._out_index = 0
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .dispatch import apply_op
+
+        return apply_op(lambda x: x + 0, self, _op_name="clone")
+
+    # ------------------------------------------------------------------
+    # in-place rebind machinery
+    # ------------------------------------------------------------------
+    def _assign_result_(self, result: "Tensor"):
+        """Re-point this handle at `result` (functional in-place)."""
+        self._data = result._data
+        self._grad_node = result._grad_node
+        self._out_index = result._out_index
+        self.stop_gradient = result.stop_gradient
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            arr = value._data
+        else:
+            arr = jnp.asarray(np.asarray(value), dtype=self._data.dtype)
+        arr = jnp.asarray(arr, dtype=self._data.dtype)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            arr = arr.reshape(self._data.shape)
+        # preserve device/sharding of the existing payload where possible
+        try:
+            arr = jax.device_put(arr, self._data.sharding)
+        except Exception:
+            pass
+        self._data = arr
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    # value/device helpers
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]))
+
+    def to(self, *args, **kwargs):
+        # supports .to(dtype), .to(device), .to(device, dtype)
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            try:
+                d = _dtype_mod.convert_dtype(a)
+            except (TypeError, ValueError, KeyError):
+                continue
+            t = t.astype(d)
+        return t
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):  # compat: "cuda" = the accelerator
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    @property
+    def T(self):
+        from .dispatch import apply_op
+
+        return apply_op(
+            lambda x: jnp.transpose(x), self, _op_name="transpose"
+        )
+
+    # `astype` is defined here (needed before ops patching) -----------------
+    def astype(self, dtype):
+        from .dispatch import apply_op
+
+        npd = _dtype_mod.to_np(dtype)
+        return apply_op(
+            lambda x: x.astype(npd), self, _op_name="cast"
+        )
+
+    cast = astype
+
+    def _md5sum(self):
+        import hashlib
+
+        return hashlib.md5(np.ascontiguousarray(self.numpy()).tobytes()).hexdigest()
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (stop_gradient=False by default)."""
+
+    def __init__(self, data, trainable=True, name=None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

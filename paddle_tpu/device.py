@@ -1,0 +1,170 @@
+"""Device / Place abstraction.
+
+Parity target: the reference's ``phi::Place`` (``paddle/phi/common/place.h:31``)
+and ``paddle.device`` python API.  On TPU there is a single accelerator type;
+``TPUPlace`` is first-class (the reference survey calls for a new enum value),
+``CPUPlace`` maps to the XLA CPU client, and CUDA aliases are accepted for
+source compatibility but resolve to the default accelerator.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+
+
+class Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self._device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self._device_id == other._device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self._device_id))
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_gpu_place(self):
+        return False
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class CUDAPlace(TPUPlace):
+    """Source-compat alias: code written for GPU runs on the accelerator."""
+
+    device_type = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    device_type = "cpu"
+
+
+class XPUPlace(TPUPlace):
+    device_type = "tpu"
+
+
+class CustomPlace(TPUPlace):
+    device_type = "tpu"
+
+    def __init__(self, dev_type="tpu", device_id=0):
+        super().__init__(device_id)
+
+
+_state = threading.local()
+_platform_cache = [None]
+
+
+def _accelerator_platform():
+    """The current jax platform name — WITHOUT initializing device backends.
+
+    Querying jax.default_backend() creates the PJRT client (on real TPU pods
+    that can block on the fabric); we answer from JAX_PLATFORMS when set and
+    only fall back to a real (cached) backend query on explicit demand.
+    """
+    env = os.environ.get("JAX_PLATFORMS", "")
+    if env:
+        return env.split(",")[0].strip() or "cpu"
+    if _platform_cache[0] is None:
+        try:
+            _platform_cache[0] = jax.default_backend()
+        except RuntimeError:  # pragma: no cover
+            _platform_cache[0] = "cpu"
+    return _platform_cache[0]
+
+
+def set_device(device: str):
+    """paddle.device.set_device — accepts 'tpu', 'tpu:0', 'cpu', 'gpu:0'...
+
+    GPU/XPU/custom names are treated as the accelerator for compatibility.
+    """
+    device = str(device)
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    if name in ("cpu",):
+        _state.place = CPUPlace(idx)
+    else:
+        _state.place = TPUPlace(idx)
+    return get_device()
+
+
+def get_device() -> str:
+    p = _current_place()
+    return f"{p.device_type}:{p.get_device_id()}"
+
+
+def _current_place() -> Place:
+    p = getattr(_state, "place", None)
+    if p is None:
+        plat = _accelerator_platform()
+        p = CPUPlace(0) if plat == "cpu" else TPUPlace(0)
+        _state.place = p
+    return p
+
+
+def jax_device_for(place: Place | None = None):
+    """Map a Place to a concrete jax.Device, or None for "default device".
+
+    Returning None lets callers skip jax.device_put entirely — arrays land on
+    the default device lazily without forcing backend initialization.
+    """
+    if place is None:
+        return None
+    devs = jax.devices("cpu") if place.is_cpu_place() else jax.devices()
+    return devs[place.get_device_id() % len(devs)]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def cuda_device_count() -> int:  # compat
+    return 0
+
+
+def get_all_device_type():
+    return ["cpu", "tpu"]
+
+
+def get_available_device():
+    return [f"tpu:{i}" for i in range(device_count())]

@@ -1,0 +1,56 @@
+"""paddle.distributed — minimal bootstrap surface (full stack in progress).
+
+The TPU-native distributed design (SURVEY.md §5): no NCCL — the device mesh
+is the communicator. Collectives compile to XLA ops over ICI/DCN. This module
+currently provides the process/env surface; the collective API, fleet hybrid
+parallel, and auto_parallel land in paddle_tpu.distributed.* modules.
+"""
+from __future__ import annotations
+
+import os
+
+
+def get_rank(group=None):
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+
+
+def get_world_size(group=None):
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
+
+
+def is_initialized():
+    return _initialized[0]
+
+
+_initialized = [False]
+
+
+def init_parallel_env():
+    """Multi-controller bootstrap over jax.distributed (single-proc no-op)."""
+    if _initialized[0]:
+        return
+    world = get_world_size()
+    if world > 1 and "PADDLE_MASTER" in os.environ:
+        import jax
+
+        coord = os.environ["PADDLE_MASTER"]
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=world,
+            process_id=get_rank(),
+        )
+    _initialized[0] = True
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", 0))

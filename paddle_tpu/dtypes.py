@@ -1,0 +1,175 @@
+"""Data types for paddle_tpu.
+
+Capability parity with the reference's ``phi::DataType``
+(``paddle/phi/common/data_type.h``), re-expressed over numpy/ml_dtypes scalar
+types so every dtype maps 1:1 onto an XLA element type.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+    _FP8_E4M3 = np.dtype(ml_dtypes.float8_e4m3fn)
+    _FP8_E5M2 = np.dtype(ml_dtypes.float8_e5m2)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    _BFLOAT16 = np.dtype(np.float32)
+    _FP8_E4M3 = np.dtype(np.float32)
+    _FP8_E5M2 = np.dtype(np.float32)
+
+
+class DType:
+    """A framework dtype: a named wrapper over a numpy dtype.
+
+    Behaves like the reference's ``paddle.float32`` objects: reprs as
+    ``paddle_tpu.float32``, compares equal to strings ("float32"), numpy
+    dtypes, and other DType instances.
+    """
+
+    __slots__ = ("name", "np_dtype")
+    _by_name: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        DType._by_name[name] = self
+
+    # -- conversions -------------------------------------------------------
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    __str__ = __repr__
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        try:
+            return convert_dtype(other) is self
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        if eq is NotImplemented:
+            return eq
+        return not eq
+
+    # numpy interop: np.dtype(paddle_tpu.float32) works
+    @property
+    def __array_interface__(self):  # pragma: no cover
+        raise AttributeError
+
+    # -- classification ----------------------------------------------------
+    @property
+    def is_floating_point(self) -> bool:
+        return np.issubdtype(self.np_dtype, np.floating)
+
+    @property
+    def is_complex(self) -> bool:
+        return np.issubdtype(self.np_dtype, np.complexfloating)
+
+    @property
+    def is_integer(self) -> bool:
+        return np.issubdtype(self.np_dtype, np.integer)
+
+    @property
+    def is_inexact(self) -> bool:
+        return self.is_floating_point or self.is_complex
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+uint16 = DType("uint16", np.uint16)
+uint32 = DType("uint32", np.uint32)
+uint64 = DType("uint64", np.uint64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _BFLOAT16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+float8_e4m3fn = DType("float8_e4m3fn", _FP8_E4M3)
+float8_e5m2 = DType("float8_e5m2", _FP8_E5M2)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALIASES = {
+    "bool": bool_,
+    "float": float32,
+    "double": float64,
+    "half": float16,
+    "int": int32,
+    "long": int64,
+}
+
+_BY_NP = {d.np_dtype: d for d in DType._by_name.values()}
+
+
+def convert_dtype(d) -> DType:
+    """Normalize str / numpy dtype / python type / DType into a DType."""
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        if d in DType._by_name:
+            return DType._by_name[d]
+        if d in _ALIASES:
+            return _ALIASES[d]
+        # fall through to numpy name parsing ("float32" already handled)
+        return _BY_NP[np.dtype(d)]
+    if d is bool:
+        return bool_
+    if d is int:
+        return int64
+    if d is float:
+        return float32
+    if d is complex:
+        return complex64
+    npd = np.dtype(d)
+    if npd in _BY_NP:
+        return _BY_NP[npd]
+    raise TypeError(f"unsupported dtype: {d!r}")
+
+
+def to_np(d) -> np.dtype:
+    return convert_dtype(d).np_dtype
+
+
+def dtype_from_array(arr) -> DType:
+    return _BY_NP[np.dtype(arr.dtype)]
+
+
+# Type-promotion table follows numpy/jax semantics; the reference implements
+# promotion in eager codegen (eager_gen.py type promotion) — on TPU we simply
+# delegate to jax's promotion which XLA understands natively.
+def promote_types(a, b) -> DType:
+    import jax.numpy as jnp
+
+    return _BY_NP[np.dtype(jnp.promote_types(to_np(a), to_np(b)))]
+
+
+def iinfo(d):
+    return np.iinfo(to_np(d))
+
+
+class _FInfo:
+    def __init__(self, d):
+        import ml_dtypes
+
+        self._f = ml_dtypes.finfo(to_np(d))
+        self.dtype = convert_dtype(d)
+
+    def __getattr__(self, k):
+        return getattr(self._f, k)
+
+
+def finfo(d):
+    return _FInfo(d)

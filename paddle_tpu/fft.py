@@ -1,0 +1,65 @@
+"""paddle.fft (parity: python/paddle/fft.py) — thin lowering onto jnp.fft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+
+
+def _mk1(name):
+    jfn = getattr(jnp.fft, name)
+
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply_op(lambda a: jfn(a, n=n, axis=axis, norm=norm), x, _op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _mkn(name):
+    jfn = getattr(jnp.fft, name)
+
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        kw = {}
+        if axes is not None:
+            kw["axes"] = tuple(axes)
+        return apply_op(lambda a: jfn(a, s=s, norm=norm, **kw), x, _op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+fft = _mk1("fft")
+ifft = _mk1("ifft")
+rfft = _mk1("rfft")
+irfft = _mk1("irfft")
+hfft = _mk1("hfft")
+ihfft = _mk1("ihfft")
+fft2 = _mkn("fft2")
+ifft2 = _mkn("ifft2")
+rfft2 = _mkn("rfft2")
+irfft2 = _mkn("irfft2")
+fftn = _mkn("fftn")
+ifftn = _mkn("ifftn")
+rfftn = _mkn("rfftn")
+irfftn = _mkn("irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), x, _op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), x, _op_name="ifftshift")

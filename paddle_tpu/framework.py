@@ -1,0 +1,214 @@
+"""Global framework state: grad mode, default dtype, RNG generators.
+
+Parity targets in the reference:
+- grad mode: eager ``tracer._has_grad`` toggled by ``paddle.no_grad``
+- default dtype: ``paddle.get_default_dtype`` (python/paddle/framework/dtype)
+- RNG: ``phi::Generator`` (paddle/phi/core/generator.h:32) per-device Philox
+  state — here a jax PRNG key chain with the same seed/state API.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+from . import dtypes as _dtype_mod
+
+
+class _GlobalState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.default_dtype = _dtype_mod.float32
+        self.in_to_static = False
+
+
+_state = _GlobalState()
+
+
+# ---------------------------------------------------------------------------
+# grad mode
+# ---------------------------------------------------------------------------
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    """Context manager AND direct setter (paddle.set_grad_enabled)."""
+
+    @contextlib.contextmanager
+    def _ctx(prev):
+        try:
+            yield
+        finally:
+            _state.grad_enabled = prev
+
+    prev = _state.grad_enabled
+    _state.grad_enabled = bool(mode)
+    return _ctx(prev)
+
+
+class no_grad:
+    """paddle.no_grad — usable as context manager or decorator."""
+
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = True
+        return self
+
+
+# ---------------------------------------------------------------------------
+# default dtype
+# ---------------------------------------------------------------------------
+def set_default_dtype(d):
+    d = _dtype_mod.convert_dtype(d)
+    if not d.is_floating_point:
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _state.default_dtype = d
+
+
+def get_default_dtype():
+    return _state.default_dtype
+
+
+# ---------------------------------------------------------------------------
+# RNG: Generator with Philox-like seed/offset semantics over jax PRNG keys.
+# ---------------------------------------------------------------------------
+class Generator:
+    """A stateful RNG generator.
+
+    Mirrors ``phi::Generator``: holds (seed, offset); each random op consumes
+    one key. ``manual_seed`` resets the chain. Under jit tracing, the key may
+    be supplied externally via :func:`rng_key_scope` so traced programs get
+    fresh per-step randomness from a key argument instead of a baked constant.
+    """
+
+    def __init__(self, seed: int | None = None):
+        if seed is None:
+            seed = int(np.random.randint(0, 2**31 - 1))
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._offset = 0
+        self._key = jax.random.PRNGKey(self._seed)
+        return self
+
+    def seed(self):
+        return self.manual_seed(int(np.random.randint(0, 2**31 - 1)))
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+    def get_state(self):
+        return (self._seed, self._offset)
+
+    def set_state(self, state):
+        seed, offset = state
+        self.manual_seed(seed)
+        for _ in range(offset):
+            self.next_key()
+
+    def next_key(self):
+        override = _rng_scope_key()
+        if override is not None:
+            return override
+        self._key, sub = jax.random.split(self._key)
+        self._offset += 1
+        return sub
+
+
+_default_generator = None
+_cpu_generator = None
+
+
+def default_generator() -> Generator:
+    global _default_generator
+    if _default_generator is None:
+        _default_generator = Generator(0)
+    return _default_generator
+
+
+def seed(s: int):
+    """paddle.seed"""
+    default_generator().manual_seed(int(s))
+    return default_generator()
+
+
+def get_rng_state():
+    return [default_generator().get_state()]
+
+
+def set_rng_state(state):
+    default_generator().set_state(state[0])
+
+
+def next_rng_key():
+    return default_generator().next_key()
+
+
+# -- traced-RNG scope -------------------------------------------------------
+class _RngScope(threading.local):
+    def __init__(self):
+        self.keys = []
+
+
+_rng_scope = _RngScope()
+
+
+def _rng_scope_key():
+    if not _rng_scope.keys:
+        return None
+    # fold a fresh subkey off the scope's chain
+    key = _rng_scope.keys[-1]
+    key, sub = jax.random.split(key)
+    _rng_scope.keys[-1] = key
+    return sub
+
+
+@contextlib.contextmanager
+def rng_key_scope(key):
+    """All random ops inside draw subkeys from `key` (traced-safe)."""
+    _rng_scope.keys.append(key)
+    try:
+        yield
+    finally:
+        _rng_scope.keys.pop()
+
+
+# ---------------------------------------------------------------------------
+# mode flags (source compat with reference dygraph/static split)
+# ---------------------------------------------------------------------------
+def in_dynamic_mode() -> bool:
+    return not _state.in_to_static
+
+
+def in_dynamic_or_pir_mode() -> bool:
+    return True
+
+
+def in_pir_mode() -> bool:
+    return False

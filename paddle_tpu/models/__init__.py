@@ -1,0 +1,2 @@
+"""Flagship model implementations (GPT / LLaMA / BERT) used by benchmarks
+and the driver entrypoints. Vision models live in paddle_tpu.vision.models."""

@@ -1,0 +1,183 @@
+"""Flagship decoder-only transformer family (GPT / LLaMA style).
+
+Capability slot: the reference trains these through PaddleNLP on Fleet hybrid
+parallel (BASELINE.md configs 4-5). Here the model is built from paddle_tpu
+layers so the whole training step jit-compiles to one XLA program; parallel
+training shards it over a Mesh via paddle_tpu.distributed.
+
+Layout conventions are TPU-first: [batch, seq, heads, head_dim] attention
+tensors feed the Pallas flash kernel; weights stay [in, out] so every matmul
+is a single MXU dot_general.
+"""
+from __future__ import annotations
+
+import math
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate.nn import functional as FF
+from paddle_tpu.nn import functional as F
+
+
+class GPTConfig:
+    def __init__(
+        self,
+        vocab_size=50304,
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        num_kv_heads=None,
+        intermediate_size=None,
+        max_seq_len=2048,
+        norm_type="rmsnorm",
+        act="swiglu",
+        rope=True,
+        dropout=0.0,
+        tie_embeddings=True,
+        dtype="float32",
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.intermediate_size = intermediate_size or (
+            int(8 * hidden_size / 3 / 128 + 1) * 128 if act == "swiglu" else 4 * hidden_size
+        )
+        self.max_seq_len = max_seq_len
+        self.norm_type = norm_type
+        self.act = act
+        self.rope = rope
+        self.dropout = dropout
+        self.tie_embeddings = tie_embeddings
+        self.dtype = dtype
+
+
+def llama_config(size="7b", **overrides):
+    presets = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=4, vocab_size=1024, max_seq_len=512),
+        "125m": dict(hidden_size=768, num_layers=12, num_heads=12, vocab_size=50304),
+        "350m": dict(hidden_size=1024, num_layers=24, num_heads=16, vocab_size=50304),
+        "1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16, vocab_size=50304),
+        "7b": dict(hidden_size=4096, num_layers=32, num_heads=32, vocab_size=32000),
+    }
+    cfg = presets[size]
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+class Attention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_heads
+        self.num_kv_heads = config.num_kv_heads
+        self.head_dim = h // config.num_heads
+        self.q_proj = nn.Linear(h, self.num_heads * self.head_dim, bias_attr=False)
+        self.k_proj = nn.Linear(h, self.num_kv_heads * self.head_dim, bias_attr=False)
+        self.v_proj = nn.Linear(h, self.num_kv_heads * self.head_dim, bias_attr=False)
+        self.o_proj = nn.Linear(self.num_heads * self.head_dim, h, bias_attr=False)
+        self.rope = config.rope
+        self.dropout = config.dropout
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if self.rope:
+            q, k, _ = FF.fused_rotary_position_embedding(q, k, None)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = k.repeat_interleave(rep, axis=2)
+            v = v.repeat_interleave(rep, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+            is_causal=True, training=self.training,
+        )
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class MLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.act = config.act
+        if config.act == "swiglu":
+            self.gate_proj = nn.Linear(h, m, bias_attr=False)
+            self.up_proj = nn.Linear(h, m, bias_attr=False)
+            self.down_proj = nn.Linear(m, h, bias_attr=False)
+        else:
+            self.fc1 = nn.Linear(h, m)
+            self.fc2 = nn.Linear(m, h)
+
+    def forward(self, x):
+        if self.act == "swiglu":
+            return self.down_proj(FF.swiglu(self.gate_proj(x), self.up_proj(x)))
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+class DecoderLayer(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        norm_cls = nn.RMSNorm if config.norm_type == "rmsnorm" else nn.LayerNorm
+        self.input_norm = norm_cls(config.hidden_size)
+        self.attn = Attention(config)
+        self.post_attn_norm = norm_cls(config.hidden_size)
+        self.mlp = MLP(config)
+        self.dropout = config.dropout
+
+    def forward(self, x, attn_mask=None):
+        h = x + self.attn(self.input_norm(x), attn_mask)
+        return h + self.mlp(self.post_attn_norm(h))
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        if not config.rope:
+            self.embed_pos = nn.Embedding(config.max_seq_len, config.hidden_size)
+        self.layers = nn.LayerList([DecoderLayer(config) for _ in range(config.num_layers)])
+        norm_cls = nn.RMSNorm if config.norm_type == "rmsnorm" else nn.LayerNorm
+        self.final_norm = norm_cls(config.hidden_size)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        if not self.config.rope:
+            pos = paddle.arange(input_ids.shape[1])
+            x = x + self.embed_pos(pos)
+        for layer in self.layers:
+            x = layer(x, attn_mask)
+        return self.final_norm(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.model = GPTModel(config)
+        if config.tie_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        hidden = self.model(input_ids, attn_mask)
+        if self.lm_head is None:
+            return paddle.matmul(hidden, self.model.embed_tokens.weight, transpose_y=True)
+        return self.lm_head(hidden)
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]),
+        )
+
+
+def causal_lm_loss(model, batch):
+    input_ids, labels = batch
+    return model.loss(input_ids, labels)

@@ -1,0 +1,50 @@
+"""paddle.nn namespace (parity: python/paddle/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer  # noqa: F401
+from .layer.common import (  # noqa: F401
+    Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding,
+    Flatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Bilinear,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D, PixelShuffle, PixelUnshuffle,
+    ChannelShuffle, CosineSimilarity, Unfold, Fold,
+)
+from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LocalResponseNorm,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, Tanh, Silu, Mish, LogSigmoid, Tanhshrink, Softsign,
+    Hardswish, GELU, LeakyReLU, PReLU, ELU, CELU, SELU, Hardshrink,
+    Softshrink, Hardsigmoid, Hardtanh, Softmax, LogSoftmax, Softplus,
+    ThresholdedReLU, Maxout, Swish, RReLU, GLU,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, LPPool2D,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, CTCLoss, CosineEmbeddingLoss,
+    TripletMarginLoss, PoissonNLLLoss, GaussianNLLLoss,
+    MultiLabelSoftMarginLoss, SoftMarginLoss, HingeEmbeddingLoss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+    SimpleRNN, LSTM, GRU,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+    clip_grad_norm_, clip_grad_value_,
+)
+
+from ..param_attr import ParamAttr  # noqa: F401

@@ -1,0 +1,107 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu as _p
+
+
+class ClipGradBase:
+    def _dygraph_clip(self, params_grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, g.clip(self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = g.norm()
+            scale = _p.clip(
+                _p.full([], self.clip_norm, g.dtype) / _p.maximum(norm, _p.full([], self.clip_norm, g.dtype)),
+                max=1.0,
+            )
+            out.append((p, g * scale))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip; under hybrid parallel the norm reduction spans all
+    model-parallel shards (see distributed.fleet HybridParallelOptimizer)."""
+
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        sq = None
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            s = (g.astype("float32") ** 2).sum()
+            sq = s if sq is None else sq + s
+        if sq is None:
+            return params_grads
+        global_norm = sq.sqrt()
+        clip_t = _p.full([], self.clip_norm, "float32")
+        scale = clip_t / _p.maximum(global_norm, clip_t)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, (g.astype("float32") * scale).astype(g.dtype)))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    from .layer.layers import Layer
+
+    if hasattr(parameters, "parameters"):
+        parameters = parameters.parameters()
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return _p.zeros([])
+    if norm_type == float("inf"):
+        total = _p.maximum(*[p.grad.abs().max() for p in params]) if len(params) > 1 else params[0].grad.abs().max()
+    else:
+        sq = None
+        for p in params:
+            s = (p.grad.astype("float32").abs() ** norm_type).sum()
+            sq = s if sq is None else sq + s
+        total = sq ** (1.0 / norm_type)
+    clip_coef = float(max_norm) / (float(total.item()) + 1e-6)
+    if clip_coef < 1.0:
+        for p in params:
+            p.grad._data = (p.grad._data * clip_coef).astype(p.grad._data.dtype)
+    return total
+
+
+def clip_grad_value_(parameters, clip_value):
+    if hasattr(parameters, "parameters"):
+        parameters = parameters.parameters()
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)

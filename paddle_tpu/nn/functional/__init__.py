@@ -1,0 +1,22 @@
+"""paddle.nn.functional namespace."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import (  # noqa: F401
+    conv1d,
+    conv2d,
+    conv3d,
+    conv1d_transpose,
+    conv2d_transpose,
+    conv3d_transpose,
+)
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    flashmask_attention,
+    scaled_dot_product_attention,
+    sdp_kernel,
+)
+
+from ...ops.manipulation import pad as _ops_pad  # noqa: F401

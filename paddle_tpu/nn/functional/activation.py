@@ -1,0 +1,230 @@
+"""Activation functionals (parity: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def _unary(jfn, name):
+    def op(x, name=None):
+        return apply_op(jfn, x, _op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary(jax.nn.relu, "relu")
+relu6 = _unary(jax.nn.relu6, "relu6")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(jnp.tanh, "tanh")
+silu = _unary(jax.nn.silu, "silu")
+mish = _unary(lambda x: x * jnp.tanh(jax.nn.softplus(x)), "mish")
+log_sigmoid = _unary(jax.nn.log_sigmoid, "log_sigmoid")
+tanhshrink = _unary(lambda x: x - jnp.tanh(x), "tanhshrink")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+
+
+def relu_(x, name=None):
+    return x._assign_result_(relu(x))
+
+
+def tanh_(x, name=None):
+    return x._assign_result_(tanh(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(
+        lambda a: jax.nn.gelu(a, approximate=bool(approximate)), x, _op_name="gelu"
+    )
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def hardswish(x, name=None):
+    return apply_op(jax.nn.hard_swish, x, _op_name="hardswish")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x, _op_name="hardsigmoid"
+    )
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda a: jnp.clip(a, min, max), x, _op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype),
+        x,
+        _op_name="hardshrink",
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(
+            a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)
+        ).astype(a.dtype),
+        x,
+        _op_name="softshrink",
+    )
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.elu(a, alpha), x, _op_name="elu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._assign_result_(elu(x, alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.celu(a, alpha), x, _op_name="celu")
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return apply_op(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)).astype(a.dtype),
+        x,
+        _op_name="selu",
+    )
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(
+        lambda a: jax.nn.leaky_relu(a, negative_slope), x, _op_name="leaky_relu"
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _prelu(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a > 0, a, wb * a).astype(a.dtype)
+
+    return apply_op(_prelu, x, weight, _op_name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ... import framework
+
+    if training:
+        key = framework.next_rng_key()
+
+        def _rrelu(a):
+            slope = jax.random.uniform(key, a.shape, jnp.float32, lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+
+        return apply_op(_rrelu, x, _op_name="rrelu")
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ... import dtypes as _dt
+
+    def _softmax(a):
+        if dtype is not None:
+            a = a.astype(_dt.to_np(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply_op(_softmax, x, _op_name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._assign_result_(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ... import dtypes as _dt
+
+    def _lsm(a):
+        if dtype is not None:
+            a = a.astype(_dt.to_np(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply_op(_lsm, x, _op_name="log_softmax")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        lambda a: jnp.where(
+            beta * a > threshold, a, (1.0 / beta) * jnp.log1p(jnp.exp(beta * a))
+        ).astype(a.dtype),
+        x,
+        _op_name="softplus",
+    )
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(
+        lambda a: jnp.where(a > threshold, a, value).astype(a.dtype),
+        x,
+        _op_name="thresholded_relu",
+    )
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _maxout(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        shape = list(a.shape)
+        shape[ax : ax + 1] = [groups, c // groups]
+        return jnp.max(a.reshape(shape), axis=ax + 1)
+
+    return apply_op(_maxout, x, _op_name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    def _glu(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return apply_op(_glu, x, _op_name="glu")
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        def _swiglu(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return apply_op(_swiglu, x, _op_name="swiglu")
+    return apply_op(lambda a, b: jax.nn.silu(a) * b, x, y, _op_name="swiglu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ... import framework
+
+    key = framework.next_rng_key()
+
+    def _gs(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            # straight-through estimator
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply_op(_gs, x, _op_name="gumbel_softmax")

@@ -1,0 +1,373 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+
+Parity: python/paddle/nn/functional/common.py + input.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ... import framework
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight layout [in, out] like the reference."""
+
+    def _linear(a, w, b):
+        out = jnp.matmul(a, w)
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(_linear, x, weight, bias, _op_name="linear")
+
+
+def dropout(
+    x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None
+):
+    if not training or (isinstance(p, (int, float)) and p == 0):
+        return x if isinstance(x, Tensor) else x
+    key = framework.next_rng_key()
+
+    def _dropout(a):
+        keep = 1.0 - p
+        if axis is None:
+            mask_shape = a.shape
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            mask_shape = tuple(
+                a.shape[i] if i in [ax % a.ndim for ax in axes] else 1
+                for i in range(a.ndim)
+            )
+        mask = jax.random.bernoulli(key, keep, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(mask, a / keep, 0.0).astype(a.dtype)
+        return jnp.where(mask, a, 0.0).astype(a.dtype)
+
+    return apply_op(_dropout, x, _op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    key = framework.next_rng_key()
+
+    def _ad(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, a.shape)
+        a_coef = (keep + p * alpha_p**2 * keep) ** -0.5
+        b_coef = -a_coef * p * alpha_p
+        return (a_coef * jnp.where(mask, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return apply_op(_ad, x, _op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def _embedding(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out).astype(w.dtype)
+        return out
+
+    return apply_op(_embedding, x, weight, _op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.manipulation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _ls(l, pd):
+        k = l.shape[-1]
+        if pd is None:
+            return (1 - epsilon) * l + epsilon / k
+        return (1 - epsilon) * l + epsilon * pd
+
+    return apply_op(_ls, label, prior_dist, _op_name="label_smooth")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _cs(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply_op(_cs, x1, x2, _op_name="cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bilinear(a, b, w, bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi is not None:
+            out = out + bi
+        return out
+
+    return apply_op(_bilinear, x1, x2, weight, bias, _op_name="bilinear")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _ps(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oc = c // (r * r)
+            a = a.reshape(n, oc, r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, oc, h * r, w * r)
+        n, h, w, c = a.shape
+        oc = c // (r * r)
+        a = a.reshape(n, h, w, r, r, oc)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, oc)
+
+    return apply_op(_ps, x, _op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _pu(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 2, 4, 5, 1, 3)
+        return a.reshape(n, c * r * r, h // r, w // r).transpose(0, 2, 3, 1)
+
+    return apply_op(_pu, x, _op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _cs(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            a = a.transpose(0, 2, 1, 3, 4)
+            return a.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        a = a.transpose(0, 1, 2, 4, 3)
+        return a.reshape(n, h, w, c)
+
+    return apply_op(_cs, x, _op_name="channel_shuffle")
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    def _interp(a):
+        # operate in NHWC for jax.image
+        chan_last = data_format in ("NHWC", "NDHWC", "NWC")
+        spatial_nd = a.ndim - 2
+        if not chan_last:
+            perm = (0,) + tuple(range(2, a.ndim)) + (1,)
+            a_cl = jnp.transpose(a, perm)
+        else:
+            a_cl = a
+        in_spatial = a_cl.shape[1:-1]
+        if size is not None:
+            out_spatial = [
+                int(s.item()) if isinstance(s, Tensor) else int(s) for s in (
+                    size if isinstance(size, (list, tuple)) else [size]
+                )
+            ]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial_nd
+            out_spatial = [int(d * f) for d, f in zip(in_spatial, sf)]
+        method = {
+            "nearest": "nearest",
+            "bilinear": "bilinear",
+            "trilinear": "trilinear",
+            "bicubic": "bicubic",
+            "linear": "linear",
+            "area": "linear",
+        }[mode]
+        out_shape = (a_cl.shape[0],) + tuple(out_spatial) + (a_cl.shape[-1],)
+        out = jax.image.resize(a_cl, out_shape, method=method)
+        if not chan_last:
+            inv = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
+            out = jnp.transpose(out, inv)
+        return out.astype(a.dtype)
+
+    return apply_op(_interp, x, _op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: phi/kernels/funcs/im2col)."""
+
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def _unfold(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+        oh = (h + p[0] + p[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (w + p[1] + p[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sl = a_p[
+                    :,
+                    :,
+                    i * d[0] : i * d[0] + oh * s[0] : s[0],
+                    j * d[1] : j * d[1] + ow * s[1] : s[1],
+                ]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return apply_op(_unfold, x, _op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    osz = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def _fold(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        h_p = osz[0] + p[0] + p[2]
+        w_p = osz[1] + p[1] + p[3]
+        oh = (h_p - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (w_p - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a_r = a.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, h_p, w_p), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[
+                    :,
+                    :,
+                    i * d[0] : i * d[0] + oh * s[0] : s[0],
+                    j * d[1] : j * d[1] + ow * s[1] : s[1],
+                ].add(a_r[:, :, i, j])
+        return out[:, :, p[0] : h_p - p[2], p[1] : w_p - p[3]]
+
+    return apply_op(_fold, x, _op_name="fold")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None, pad_from_left_axis=True):
+    from ...ops.manipulation import pad as _pad
+
+    return _pad(x, pad, mode, value, data_format, pad_from_left_axis)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    from ...ops.manipulation import flatten as _flatten
+
+    return _flatten(x, start_axis, stop_axis)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def _ag(th):
+        n, c, h, w = [int(v) for v in (out_shape if not isinstance(out_shape, Tensor) else out_shape.numpy())]
+        ys = jnp.linspace(-1, 1, h) if align_corners else jnp.linspace(-1 + 1 / h, 1 - 1 / h, h)
+        xs = jnp.linspace(-1, 1, w) if align_corners else jnp.linspace(-1 + 1 / w, 1 - 1 / w, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        grid = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # h,w,3
+        out = jnp.einsum("hwk,nik->nhwi", grid.astype(th.dtype), th)
+        return out
+
+    return apply_op(_ag, theta, _op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    def _gs(a, g):
+        n, c, h, w = a.shape
+        gx = (g[..., 0] + 1) * (w - 1) / 2 if align_corners else ((g[..., 0] + 1) * w - 1) / 2
+        gy = (g[..., 1] + 1) * (h - 1) / 2 if align_corners else ((g[..., 1] + 1) * h - 1) / 2
+
+        def sample_channel(img):  # h,w
+            def bilinear_one(yy, xx):
+                x0 = jnp.floor(xx)
+                y0 = jnp.floor(yy)
+                x1, y1 = x0 + 1, y0 + 1
+                wx1 = xx - x0
+                wy1 = yy - y0
+
+                def at(yi, xi):
+                    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                    yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                    xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                    v = img[yi_c, xi_c]
+                    if padding_mode == "zeros":
+                        v = jnp.where(valid, v, 0.0)
+                    return v
+
+                return (
+                    at(y0, x0) * (1 - wy1) * (1 - wx1)
+                    + at(y0, x1) * (1 - wy1) * wx1
+                    + at(y1, x0) * wy1 * (1 - wx1)
+                    + at(y1, x1) * wy1 * wx1
+                )
+
+            return bilinear_one
+
+        out = []
+        for ni in range(n):
+            chans = []
+            for ci in range(c):
+                f = sample_channel(a[ni, ci])
+                if mode == "bilinear":
+                    chans.append(f(gy[ni], gx[ni]))
+                else:
+                    yi = jnp.clip(jnp.round(gy[ni]), 0, h - 1).astype(jnp.int32)
+                    xi = jnp.clip(jnp.round(gx[ni]), 0, w - 1).astype(jnp.int32)
+                    chans.append(a[ni, ci][yi, xi])
+            out.append(jnp.stack(chans))
+        return jnp.stack(out).astype(a.dtype)
+
+    return apply_op(_gs, x, grid, _op_name="grid_sample")

@@ -1,0 +1,178 @@
+"""Convolutions over jax.lax.conv_general_dilated.
+
+The reference dispatches conv to cuDNN (``phi/kernels/gpudnn``); on TPU a
+single ``conv_general_dilated`` HLO maps the whole conv onto the MXU, with
+layout chosen by XLA — no manual algorithm search needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+
+
+def _tuple_n(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(i) for i in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(i) for i in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding_n(padding, n):
+    """Normalize paddle padding spec → lax [(lo, hi)] per spatial dim."""
+    if isinstance(padding, str):
+        return padding.upper()  # "SAME" / "VALID"
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # full-rank form [[0,0],[0,0],[lo,hi],...]
+        sp = [p for p in padding if list(p) != [0, 0]]
+        if len(sp) == n:
+            return [tuple(p) for p in sp]
+        return [tuple(p) for p in padding[-n:]]
+    return [(int(p), int(p)) for p in padding]
+
+
+def _conv_nd(
+    x, weight, bias, stride, padding, dilation, groups, n, channel_last, op_name
+):
+    strides = _tuple_n(stride, n)
+    dilations = _tuple_n(dilation, n)
+    pad = _padding_n(padding, n)
+
+    spatial = "DHW"[-n:] if n <= 3 else None
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        (1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, out_spec)
+    )
+
+    def _conv(a, w, b):
+        out = jax.lax.conv_general_dilated(
+            a,
+            w.astype(a.dtype),
+            window_strides=strides,
+            padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b is not None:
+            shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channel_last else 1
+            shape[ch_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    return apply_op(_conv, x, weight, bias, _op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv_nd(
+        x, weight, bias, stride, padding, dilation, groups, 1,
+        data_format in ("NLC",), "conv1d",
+    )
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv_nd(
+        x, weight, bias, stride, padding, dilation, groups, 2,
+        data_format == "NHWC", "conv2d",
+    )
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv_nd(
+        x, weight, bias, stride, padding, dilation, groups, 3,
+        data_format == "NDHWC", "conv3d",
+    )
+
+
+def _conv_transpose_nd(
+    x, weight, bias, stride, padding, output_padding, dilation, groups, n,
+    channel_last, output_size, op_name,
+):
+    strides = _tuple_n(stride, n)
+    dilations = _tuple_n(dilation, n)
+    pad = _padding_n(padding, n)
+    out_pad = _tuple_n(output_padding, n) if output_padding is not None else (0,) * n
+
+    spatial = "DHW"[-n:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle transpose-conv weight layout: [in, out//groups, *k]
+    rhs_spec = "IO" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        (1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, lhs_spec)
+    )
+
+    def _convt(a, w, b):
+        if isinstance(pad, str):
+            lax_pad = pad
+        else:
+            # grad-of-conv padding transformation
+            k = [
+                (w.shape[2 + i] - 1) * dilations[i] + 1 for i in range(n)
+            ]
+            lax_pad = [
+                (k[i] - 1 - pad[i][0], k[i] - 1 - pad[i][1] + out_pad[i])
+                for i in range(n)
+            ]
+        if groups > 1:
+            # lax transpose conv with groups: split manually
+            a_groups = jnp.split(a, groups, axis=-1 if channel_last else 1)
+            w_groups = jnp.split(w, groups, axis=0)
+            outs = [
+                jax.lax.conv_transpose(
+                    ag, wg.astype(a.dtype), strides=strides, padding=lax_pad,
+                    rhs_dilation=dilations, dimension_numbers=dn,
+                )
+                for ag, wg in zip(a_groups, w_groups)
+            ]
+            out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+        else:
+            out = jax.lax.conv_transpose(
+                a, w.astype(a.dtype), strides=strides, padding=lax_pad,
+                rhs_dilation=dilations, dimension_numbers=dn,
+            )
+        if b is not None:
+            shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channel_last else 1
+            shape[ch_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    return apply_op(_convt, x, weight, bias, _op_name=op_name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(
+        x, weight, bias, stride, padding, output_padding, dilation, groups, 1,
+        data_format == "NLC", output_size, "conv1d_transpose",
+    )
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(
+        x, weight, bias, stride, padding, output_padding, dilation, groups, 2,
+        data_format == "NHWC", output_size, "conv2d_transpose",
+    )
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(
+        x, weight, bias, stride, padding, output_padding, dilation, groups, 3,
+        data_format == "NDHWC", output_size, "conv3d_transpose",
+    )

@@ -1,0 +1,157 @@
+"""Attention functionals (parity: python/paddle/nn/functional/flash_attention.py:358).
+
+On TPU the flash-attention capability slot (reference: CUDA flashattn lib at
+``phi/kernels/gpu/flash_attn_kernel.cu``) is filled by a Pallas splash/flash
+kernel when running on real TPU hardware, with a pure-XLA fallback that still
+fuses well (used on CPU test meshes and for odd shapes).
+
+Layout note: paddle attention tensors are [batch, seq, heads, head_dim].
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+
+
+def _xla_sdpa(q, k, v, mask=None, causal=False, dropout=0.0, scale=None, key=None):
+    """Reference attention in pure XLA: [B, S, H, D] layout."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B,H,S,D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhsd,bhtd->bhst", qh * scale, kh)
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((s, t), bool), t - s)
+        logits = jnp.where(cmask, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _use_pallas(q_shape):
+    import jax
+
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    b, s, h, d = q_shape
+    return s % 128 == 0 and d % 128 == 0
+
+
+def flash_attention(
+    query,
+    key,
+    value,
+    dropout=0.0,
+    causal=False,
+    return_softmax=False,
+    fixed_seed_offset=None,
+    rng_name="",
+    training=True,
+    name=None,
+):
+    from ... import framework
+
+    drop_key = framework.next_rng_key() if (dropout > 0.0 and training) else None
+
+    def _fa(q, k, v):
+        if _use_pallas(q.shape) and dropout == 0.0:
+            try:
+                from ...ops.pallas.flash_attention import flash_attention_fwd
+
+                return flash_attention_fwd(q, k, v, causal=causal)
+            except Exception:
+                pass
+        return _xla_sdpa(q, k, v, causal=causal, dropout=dropout if training else 0.0, key=drop_key)
+
+    out = apply_op(_fa, query, key, value, _op_name="flash_attention")
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p=0.0,
+    is_causal=False,
+    training=True,
+    name=None,
+):
+    """parity: nn/functional/flash_attention.py:1139 — [B,S,H,D] layout."""
+    from ... import framework
+
+    drop_key = framework.next_rng_key() if (dropout_p > 0.0 and training) else None
+
+    def _sdpa(q, k, v, m):
+        if m is None and _use_pallas(q.shape) and dropout_p == 0.0:
+            try:
+                from ...ops.pallas.flash_attention import flash_attention_fwd
+
+                return flash_attention_fwd(q, k, v, causal=is_causal)
+            except Exception:
+                pass
+        return _xla_sdpa(
+            q, k, v, mask=m, causal=is_causal,
+            dropout=dropout_p if training else 0.0, key=drop_key,
+        )
+
+    return apply_op(_sdpa, query, key, value, attn_mask, _op_name="sdpa")
+
+
+def flashmask_attention(
+    query, key, value, startend_row_indices=None, dropout=0.0, causal=False,
+    window_size=None, return_softmax_lse=False, return_seed_offset=False,
+    fixed_seed_offset=None, rng_name="", training=True, name=None,
+):
+    """Sparse-mask attention (parity: flash_attention.py:1299 flashmask).
+
+    startend_row_indices: [B, H, S, 1] (causal) — LT masking: key j is masked
+    for query rows >= start index. Fallback builds the dense mask.
+    """
+    if startend_row_indices is None:
+        return flash_attention(query, key, value, dropout, causal, training=training)[0]
+
+    def _fm(q, k, v, sri):
+        b, s, h, d = q.shape
+        rows = jnp.arange(s)[:, None, None]  # query index
+        start = jnp.swapaxes(sri, 1, 2)  # [B, S, H, n]
+        # mask[b, h, i, j]: allowed if i < start[b, j, h, 0]
+        st = sri[..., 0]  # [B, H, S_k]
+        i_idx = jnp.arange(s)[None, None, :, None]
+        allowed = i_idx < st[:, :, None, :]
+        if causal:
+            j_idx = jnp.arange(s)[None, None, None, :]
+            allowed = allowed & (j_idx <= i_idx)
+        logits_mask = jnp.where(allowed, 0.0, -jnp.inf)
+        return _xla_sdpa(q, k, v, mask=logits_mask, causal=False)
+
+    out = apply_op(_fm, query, key, value, startend_row_indices, _op_name="flashmask_attention")
+    if return_softmax_lse or return_seed_offset:
+        return (out, None, None)[: 1 + int(return_softmax_lse) + int(return_seed_offset)]
+    return out
+
+
+def sdp_kernel(*a, **k):  # compat context manager
+    import contextlib
+
+    return contextlib.nullcontext()

@@ -1,0 +1,311 @@
+"""Loss functionals (parity: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    def _ce(logits, lab, w):
+        lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30)
+        )
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab
+        else:
+            lab_int = lab
+            if lab_int.ndim == lp.ndim:  # [..., 1] form
+                lab_int = jnp.squeeze(lab_int, axis)
+            soft = jax.nn.one_hot(lab_int, n_classes, dtype=lp.dtype, axis=axis)
+        if label_smoothing > 0.0:
+            soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(soft * lp, axis=axis)
+        if w is not None and not soft_label:
+            lab_int = lab if lab.ndim < lp.ndim else jnp.squeeze(lab, axis)
+            loss = loss * jnp.take(w, jnp.clip(lab_int, 0, n_classes - 1))
+        if not soft_label and ignore_index >= 0:
+            lab_int = lab if lab.ndim < lp.ndim else jnp.squeeze(lab, axis)
+            mask = lab_int != ignore_index
+            loss = jnp.where(mask, loss, 0.0)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return apply_op(_ce, input, label, weight, _op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True,
+    return_softmax=False, axis=-1,
+):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis) if loss.ndim < logits.ndim else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+        _op_name="mse_loss",
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+        _op_name="l1_loss",
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _sl1(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+        # paddle huber: 0.5*d^2 if d<delta else delta*(d-0.5*delta)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply_op(_sl1, input, label, _op_name="smooth_l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def _nll(lp, lab, w):
+        n_classes = lp.shape[1]
+        lab_c = jnp.clip(lab, 0, n_classes - 1)
+        picked = -jnp.take_along_axis(lp, lab_c[:, None] if lp.ndim == 2 else jnp.expand_dims(lab_c, 1), axis=1)
+        picked = jnp.squeeze(picked, 1)
+        wt = jnp.ones_like(picked) if w is None else jnp.take(w, lab_c)
+        mask = (lab != ignore_index).astype(picked.dtype)
+        picked = picked * wt * mask
+        if reduction == "mean":
+            return jnp.sum(picked) / jnp.maximum(jnp.sum(wt * mask), 1e-12)
+        return _reduce(picked, reduction)
+
+    return apply_op(_nll, input, label, weight, _op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def _bce(p, y, w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op(_bce, input, label, weight, _op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def _bcel(z, y, w, pw):
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op(_bcel, logit, label, weight, pos_weight, _op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def _kl(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-30)) - lp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_op(_kl, input, label, _op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        input, other, label, _op_name="margin_ranking_loss",
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, y: _reduce(
+            jnp.where(y == 1.0, a, jnp.maximum(0.0, margin - a)), reduction
+        ),
+        input, label, _op_name="hinge_embedding_loss",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def _cel(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply_op(_cel, input1, input2, label, _op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def _tml(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply_op(_tml, input, positive, negative, _op_name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def _focal(z, y, nz):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if nz is not None:
+            loss = loss / nz
+        return _reduce(loss, reduction)
+
+    return apply_op(_focal, logit, label, normalizer, _op_name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), input, label, _op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        input, label, _op_name="log_loss",
+    )
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via dynamic-program in log space (lax.scan over time)."""
+
+    def _ctc(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] log-probs (paddle feeds logits; apply log_softmax)
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        NEG = -1e30
+        # extended labels with blanks: [B, S]
+        ext = jnp.full((B, S), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        # init alpha at t=0
+        alpha0 = jnp.full((B, S), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        )
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+            a_shift2 = jnp.where(same_as_prev2, NEG, a_shift2)
+            combined = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            new_alpha = combined + emit
+            return new_alpha, None
+
+        alpha_T, _ = jax.lax.scan(step, alpha0, lp[1:])
+        # pick final positions: S-1 and S-2 depend on label_length
+        last = 2 * lab_len  # index of final blank
+        idx1 = jnp.clip(last, 0, S - 1)[:, None]
+        idx2 = jnp.clip(last - 1, 0, S - 1)[:, None]
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alpha_T, idx1, 1)[:, 0],
+            jnp.take_along_axis(alpha_T, idx2, 1)[:, 0],
+        )
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply_op(_ctc, log_probs, labels, input_lengths, label_lengths, _op_name="ctc_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean", name=None):
+    def _pnll(a, y):
+        if log_input:
+            loss = jnp.exp(a) - y * a
+        else:
+            loss = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op(_pnll, input, label, _op_name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction="mean", name=None):
+    def _gnll(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+
+    return apply_op(_gnll, input, label, variance, _op_name="gaussian_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def _ml(z, y, w):
+        loss = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        if w is not None:
+            loss = loss * w
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+    return apply_op(_ml, input, label, weight, _op_name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction),
+        input, label, _op_name="soft_margin_loss",
+    )

@@ -1,0 +1,183 @@
+"""Normalization functionals (parity: python/paddle/nn/functional/norm.py).
+
+These stay as straight-line jnp so XLA fuses them into neighbouring matmuls;
+the Pallas fused variants live in paddle_tpu.incubate.nn.functional.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+
+    def _ln(a, w, b):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out.astype(a.dtype)
+
+    return apply_op(_ln, x, weight, bias, _op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1, name=None):
+    def _rms(a, w, b):
+        ax = begin_norm_axis % a.ndim
+        axes = tuple(range(ax, a.ndim))
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axes, keepdims=True)
+        out = a * jax.lax.rsqrt(var + epsilon).astype(a.dtype)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out.astype(a.dtype)
+
+    return apply_op(_rms, x, weight, bias, _op_name="rms_norm")
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    """Returns output; updates running stats in-place when training."""
+    use_batch_stats = training and not use_global_stats
+
+    ch_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def _stats_axes(a):
+        if ch_last:
+            return tuple(range(a.ndim - 1))
+        return (0,) + tuple(range(2, a.ndim))
+
+    def _shape_for(a, v):
+        shape = [1] * a.ndim
+        shape[a.ndim - 1 if ch_last else (1 if a.ndim > 1 else 0)] = v.shape[0]
+        return v.reshape(shape)
+
+    if use_batch_stats:
+        # compute batch stats eagerly so we can fold them into running stats
+        def _bn_train(a, rm, rv, w, b):
+            axes = _stats_axes(a)
+            m = jnp.mean(a, axis=axes)
+            v = jnp.var(a, axis=axes)
+            out = (a - _shape_for(a, m)) * jax.lax.rsqrt(_shape_for(a, v) + epsilon)
+            if w is not None:
+                out = out * _shape_for(a, w)
+            if b is not None:
+                out = out + _shape_for(a, b)
+            new_rm = momentum * rm + (1 - momentum) * m
+            new_rv = momentum * rv + (1 - momentum) * v
+            return out.astype(a.dtype), new_rm, new_rv
+
+        out, new_rm, new_rv = apply_op(
+            _bn_train, x, running_mean, running_var, weight, bias,
+            _op_name="batch_norm",
+        )
+        # running stats are buffers: update payloads in place (no grad flow)
+        running_mean._data = new_rm._data if isinstance(new_rm, Tensor) else new_rm
+        running_var._data = new_rv._data if isinstance(new_rv, Tensor) else new_rv
+        return out
+
+    def _bn_eval(a, rm, rv, w, b):
+        out = (a - _shape_for(a, rm)) * jax.lax.rsqrt(_shape_for(a, rv) + epsilon)
+        if w is not None:
+            out = out * _shape_for(a, w)
+        if b is not None:
+            out = out + _shape_for(a, b)
+        return out.astype(a.dtype)
+
+    return apply_op(_bn_eval, x, running_mean, running_var, weight, bias, _op_name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    def _in(a, w, b):
+        axes = tuple(range(2, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        if w is not None:
+            shape = [1, w.shape[0]] + [1] * (a.ndim - 2)
+            out = out * w.reshape(shape)
+        if b is not None:
+            shape = [1, b.shape[0]] + [1] * (a.ndim - 2)
+            out = out + b.reshape(shape)
+        return out.astype(a.dtype)
+
+    return apply_op(_in, x, weight, bias, _op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    ch_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def _gn(a, w, b):
+        if ch_last:
+            a_cf = jnp.moveaxis(a, -1, 1)
+        else:
+            a_cf = a
+        n, c = a_cf.shape[0], a_cf.shape[1]
+        g = num_groups
+        grouped = a_cf.reshape((n, g, c // g) + a_cf.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        m = jnp.mean(grouped, axis=axes, keepdims=True)
+        v = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - m) * jax.lax.rsqrt(v + epsilon)).reshape(a_cf.shape)
+        if w is not None:
+            out = out * w.reshape([1, c] + [1] * (a_cf.ndim - 2))
+        if b is not None:
+            out = out + b.reshape([1, c] + [1] * (a_cf.ndim - 2))
+        if ch_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+
+    return apply_op(_gn, x, weight, bias, _op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def _lrn(a):
+        sq = jnp.square(a)
+        c_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[c_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        window = [1] * a.ndim
+        window[c_axis] = size
+        summed = jax.lax.reduce_window(
+            padded, jnp.zeros((), a.dtype), jax.lax.add, tuple(window),
+            (1,) * a.ndim, [(0, 0)] * a.ndim,
+        )
+        div = (k + alpha * summed) ** beta
+        return a / div
+
+    return apply_op(_lrn, x, _op_name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _normalize(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return apply_op(_normalize, x, _op_name="normalize")

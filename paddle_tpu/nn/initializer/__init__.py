@@ -1,0 +1,228 @@
+"""Weight initializers (parity: python/paddle/nn/initializer/).
+
+Each initializer produces a host-side numpy array (deterministic under
+``paddle_tpu.seed``) that is then placed on device — matching the reference's
+fill-at-creation semantics rather than jax's lazy init style.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ... import dtypes as _dt, framework
+
+
+def calculate_gain(nonlinearity, param=None):
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle fc weights are [in, out]
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def _init_array(self, shape, dtype):
+        raise NotImplementedError
+
+    def __call__(self, param, block=None):
+        arr = self._init_array(list(param.shape), param.dtype)
+        param._data = arr
+        return param
+
+    def _key(self):
+        return framework.next_rng_key()
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _init_array(self, shape, dtype):
+        return jnp.full(shape, self.value, _dt.to_np(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def _init_array(self, shape, dtype):
+        d = _dt.to_np(dtype)
+        return self.mean + self.std * jax.random.normal(self._key(), shape, jnp.float32).astype(d)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def _init_array(self, shape, dtype):
+        d = _dt.to_np(dtype)
+        lo = (self.a - self.mean) / self.std
+        hi = (self.b - self.mean) / self.std
+        z = jax.random.truncated_normal(self._key(), lo, hi, shape, jnp.float32)
+        return (self.mean + self.std * z).astype(d)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def _init_array(self, shape, dtype):
+        d = _dt.to_np(dtype)
+        return jax.random.uniform(
+            self._key(), shape, jnp.float32, self.low, self.high
+        ).astype(d)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _init_array(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        d = _dt.to_np(dtype)
+        return (std * jax.random.normal(self._key(), shape, jnp.float32)).astype(d)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _init_array(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        d = _dt.to_np(dtype)
+        return jax.random.uniform(
+            self._key(), shape, jnp.float32, -limit, limit
+        ).astype(d)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _init_array(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        d = _dt.to_np(dtype)
+        return (std * jax.random.normal(self._key(), shape, jnp.float32)).astype(d)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _init_array(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        d = _dt.to_np(dtype)
+        return jax.random.uniform(
+            self._key(), shape, jnp.float32, -limit, limit
+        ).astype(d)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def _init_array(self, shape, dtype):
+        from ...core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtype=_dt.to_np(dtype))
+        return arr.reshape(shape)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def _init_array(self, shape, dtype):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = jax.random.normal(self._key(), (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(_dt.to_np(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def _init_array(self, shape, dtype):
+        arr = np.zeros(shape, _dt.to_np(dtype))
+        out_c, in_c = shape[0], shape[1]
+        mins = min(out_c // self.groups, in_c)
+        center = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for i in range(mins):
+                arr[(g * (out_c // self.groups) + i, i) + center] = 1.0
+        return jnp.asarray(arr)
+
+
+# lowercase function-style aliases (paddle.nn.initializer module level)
+normal = Normal
+uniform = Uniform
+constant = Constant
+xavier_normal = XavierNormal
+xavier_uniform = XavierUniform
+kaiming_normal = KaimingNormal
+kaiming_uniform = KaimingUniform
+truncated_normal = TruncatedNormal
+assign = Assign
+orthogonal = Orthogonal
+dirac = Dirac
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    # stored for Layer.create_parameter defaults (minimal parity)
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None

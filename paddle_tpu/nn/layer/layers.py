@@ -1,0 +1,401 @@
+"""nn.Layer — module base class (parity: python/paddle/nn/layer/layers.py).
+
+Holds parameters (trainable Tensors), buffers (non-trainable state like
+BatchNorm running stats), and sublayers; supports hooks, train/eval mode,
+state_dict round-trips, and functional parameter swapping (the seam the jit
+path uses to trace a Layer as a pure function of its parameters).
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ... import framework
+from ...core.tensor import Tensor, Parameter
+
+_layer_name_counters = collections.defaultdict(int)
+
+
+def _unique_layer_name(prefix):
+    _layer_name_counters[prefix] += 1
+    return f"{prefix}_{_layer_name_counters[prefix] - 1}"
+
+
+class HookRemoveHelper:
+    def __init__(self, container, key):
+        self._container = container
+        self._key = key
+
+    def remove(self):
+        self._container.pop(self._key, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._sub_layers = collections.OrderedDict()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self._full_name = _unique_layer_name(
+            name_scope or self.__class__.__name__.lower()
+        )
+        self._init_in_dynamic_mode = True
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        from ...param_attr import ParamAttr
+        from ..initializer import Constant, XavierUniform, Normal
+
+        dtype = dtype or self._dtype or framework.get_default_dtype()
+        attr = ParamAttr._to_attr(attr)
+        init = None
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        elif default_initializer is not None:
+            init = default_initializer
+        elif is_bias:
+            init = Constant(0.0)
+        else:
+            init = XavierUniform()
+        data = init._init_array([int(s) for s in shape], dtype)
+        name = attr.name if attr is not None and attr.name else None
+        p = Parameter(data, trainable=True, name=name)
+        if attr is not None:
+            if attr.learning_rate != 1.0:
+                p.optimize_attr["learning_rate"] = attr.learning_rate
+            p.regularizer = attr.regularizer
+            if not attr.trainable:
+                p.trainable = False
+            p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        return tensor
+
+    # ------------------------------------------------------------------
+    # attribute magic
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            params[name] = value
+            buffers.pop(name, None) if buffers else None
+            layers.pop(name, None) if layers else None
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning layers")
+            layers[name] = value
+            params.pop(name, None) if params else None
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            else:
+                raise TypeError(f"cannot assign non-Parameter to parameter {name}")
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = (
+            list(self._parameters) + list(self._buffers) + list(self._sub_layers)
+        )
+        return sorted(set(super().__dir__() + extra))
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, l in self.named_children():
+            if l is None or id(l) in layers_set:
+                continue
+            layers_set.add(id(l))
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, l
+            yield from l.named_sublayers(
+                prefix=sub_prefix, include_self=False, layers_set=layers_set
+            )
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = (
+            [(prefix, self)]
+            + [
+                (f"{prefix}.{n}" if prefix else n, l)
+                for n, l in self.named_sublayers()
+            ]
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for lp, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{lp}.{name}" if lp else name), p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = (
+            [(prefix, self)]
+            + [
+                (f"{prefix}.{n}" if prefix else n, l)
+                for n, l in self.named_sublayers()
+            ]
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{lp}.{name}" if lp else name), b
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # ------------------------------------------------------------------
+    # modes
+    # ------------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # ------------------------------------------------------------------
+    # forward plumbing
+    # ------------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            o = hook(self, inputs, outputs)
+            if o is not None:
+                outputs = o
+        return outputs
+
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(
+        self, destination=None, include_sublayers=True, structured_name_prefix="",
+        use_hook=True,
+    ):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(
+            prefix=structured_name_prefix.rstrip("."),
+            include_sublayers=include_sublayers,
+        ):
+            dest[name] = p
+        for name, b in self.named_buffers(
+            prefix=structured_name_prefix.rstrip("."),
+            include_sublayers=include_sublayers,
+        ):
+            if name.split(".")[-1] not in self._non_persistable_buffer_names_set:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        missing, unexpected = [], []
+        own = self.state_dict()
+        matched = set()
+        for name, t in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                arr = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+                t.set_value(arr)
+                matched.add(name)
+            else:
+                missing.append(name)
+        for k in state_dict:
+            if k not in matched and k not in own:
+                unexpected.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # dtype / device movement
+    # ------------------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        return self._to_impl(device=device, dtype=dtype)
+
+    def _to_impl(self, device=None, dtype=None):
+        from ... import dtypes as _dt
+
+        if dtype is not None:
+            npd = _dt.to_np(dtype)
+            for p in self.parameters():
+                if p.dtype.is_floating_point:
+                    p._data = p._data.astype(npd)
+            for b in self.buffers():
+                if b is not None and b.dtype.is_floating_point:
+                    b._data = b._data.astype(npd)
+            self._dtype = _dt.convert_dtype(dtype).name
+        return self
+
+    def astype(self, dtype):
+        return self._to_impl(dtype=dtype)
+
+    def float(self):
+        return self._to_impl(dtype="float32")
+
+    def half(self):
+        return self._to_impl(dtype="float16")
+
+    def bfloat16(self):
+        return self._to_impl(dtype="bfloat16")
+
+    # ------------------------------------------------------------------
+    # functional parameter swap (jit seam)
+    # ------------------------------------------------------------------
+    @contextlib.contextmanager
+    def _swap_state(self, flat_state: dict):
+        """Temporarily replace named params/buffers' payloads with `flat_state`
+        values (jax arrays/tracers). Restores on exit. Yields a dict that will
+        be filled with the post-forward buffer payloads (mutated state)."""
+        saved = {}
+        entries = dict(self.state_dict())
+        for name, arr in flat_state.items():
+            t = entries[name]
+            saved[name] = t._data
+            t._data = arr
+        mutated = {}
+        try:
+            yield mutated
+        finally:
+            for name, old in saved.items():
+                t = entries[name]
+                mutated[name] = t._data
+                t._data = old
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self.named_children():
+            mod_str = repr(l)
+            mod_str = "\n".join(
+                ["  " + line for line in mod_str.split("\n")]
+            )
+            lines.append(f"  ({name}): {mod_str.strip()}")
+        main = self.__class__.__name__ + "("
+        if extra and not lines:
+            return main + extra + ")"
+        if lines:
+            return main + (extra + "\n" if extra else "\n") + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def extra_repr(self):
+        return ""

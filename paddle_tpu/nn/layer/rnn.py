@@ -1,0 +1,255 @@
+"""RNN layers over lax.scan (parity: python/paddle/nn/layer/rnn.py).
+
+The reference's cuDNN RNN kernels (``phi/kernels/gpudnn/rnn_kernel``) map on
+TPU to a ``lax.scan`` over fused per-step matmuls — XLA pipelines the scan so
+the MXU stays busy across time steps.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ..initializer import Uniform
+from .. import functional as F
+from .layers import Layer
+from .container import LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        import paddle_tpu as paddle
+
+        b = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape[0], (list, tuple)):
+            return tuple(
+                paddle.full([b] + list(s), init_value, dtype or "float32") for s in shape
+            )
+        return paddle.full([b] + list(shape), init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _cell(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply_op(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, _op_name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h, c = states
+
+        def _cell(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = apply_op(
+            _cell, inputs, h, c, self.weight_ih, self.weight_hh,
+            self.bias_ih, self.bias_hh, _op_name="lstm_cell",
+        )
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1 - z) * n + z * h
+
+        new_h = apply_op(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                         self.bias_ih, self.bias_hh, _op_name="gru_cell")
+        return new_h, new_h
+
+
+class RNN(Layer):
+    """Runs a cell over time (parity: paddle.nn.RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        xs = paddle.unbind(inputs, axis=time_axis)
+        if self.is_reverse:
+            xs = xs[::-1]
+        states = initial_states
+        outs = []
+        for x in xs:
+            out, states = self.cell(x, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = paddle.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+
+        s_fw, s_bw = initial_states if initial_states is not None else (None, None)
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return paddle.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent net driven by lax.scan."""
+
+    CELL = None
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell, "RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell}[mode]
+        layers = []
+        for l in range(num_layers):
+            in_sz = input_size if l == 0 else hidden_size * num_dir
+            kwargs = {}
+            if mode == "RNN_RELU":
+                kwargs["activation"] = "relu"
+            fw = cell_cls(in_sz, hidden_size, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr, **kwargs)
+            if self.bidirect:
+                bw = cell_cls(in_sz, hidden_size, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr, **kwargs)
+                layers.append(BiRNN(fw, bw, time_major))
+            else:
+                layers.append(RNN(fw, False, time_major))
+        self.layer_list = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+
+        out = inputs
+        final_states = []
+        for i, rnn_l in enumerate(self.layer_list):
+            st = None if initial_states is None else initial_states
+            out, st_out = rnn_l(out, None)
+            final_states.append(st_out)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        # stack final states across layers(+directions)
+        if self.mode == "LSTM":
+            if self.bidirect:
+                hs, cs = [], []
+                for st_fw, st_bw in final_states:
+                    hs += [st_fw[0], st_bw[0]]
+                    cs += [st_fw[1], st_bw[1]]
+            else:
+                hs = [s[0] for s in final_states]
+                cs = [s[1] for s in final_states]
+            state = (paddle.stack(hs, axis=0), paddle.stack(cs, axis=0))
+        else:
+            if self.bidirect:
+                hs = []
+                for st_fw, st_bw in final_states:
+                    hs += [st_fw, st_bw]
+            else:
+                hs = list(final_states)
+            state = paddle.stack(hs, axis=0)
+        return out, state
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction, time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout, **kw)

@@ -1,0 +1,257 @@
+"""Op surface assembly + Tensor method patching.
+
+Re-exports the functional op library and monkey-patches operator methods onto
+:class:`~paddle_tpu.core.tensor.Tensor`, mirroring how the reference attaches
+math methods to its pybind eager tensor
+(``paddle/fluid/pybind/eager_math_op_patch.cc``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, logic, linalg, random  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+def _convert_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_convert_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx)) if idx and not isinstance(idx[0], (slice, type(None))) else [
+            _convert_index(i) for i in idx
+        ]
+    return idx
+
+
+def _tensor_getitem(self, idx):
+    jidx = _convert_index(idx)
+    return apply_op(lambda a: a[jidx], self, _op_name="getitem")
+
+
+def _tensor_setitem(self, idx, value):
+    jidx = _convert_index(idx)
+    if isinstance(value, Tensor):
+        out = apply_op(
+            lambda a, v: a.at[jidx].set(v.astype(a.dtype)),
+            self,
+            value,
+            _op_name="setitem",
+        )
+    else:
+        out = apply_op(
+            lambda a: a.at[jidx].set(jnp.asarray(value, a.dtype)),
+            self,
+            _op_name="setitem",
+        )
+    self._assign_result_(out)
+
+
+def _tensor_iter(self):
+    for i in range(self.shape[0]):
+        yield self[i]
+
+
+# ---------------------------------------------------------------------------
+# method patching
+# ---------------------------------------------------------------------------
+_BINARY_DUNDERS = {
+    "__add__": math.add,
+    "__radd__": lambda x, y: math.add(y, x) if isinstance(y, Tensor) else apply_op(lambda a: jnp.add(y, a), x),
+    "__sub__": math.subtract,
+    "__rsub__": lambda x, y: apply_op(lambda a: jnp.subtract(y, a), x) if not isinstance(y, Tensor) else math.subtract(y, x),
+    "__mul__": math.multiply,
+    "__rmul__": lambda x, y: apply_op(lambda a: jnp.multiply(y, a), x) if not isinstance(y, Tensor) else math.multiply(y, x),
+    "__truediv__": math.divide,
+    "__rtruediv__": lambda x, y: apply_op(lambda a: jnp.true_divide(y, a), x) if not isinstance(y, Tensor) else math.divide(y, x),
+    "__floordiv__": math.floor_divide,
+    "__rfloordiv__": lambda x, y: apply_op(lambda a: jnp.floor_divide(y, a), x),
+    "__mod__": math.mod,
+    "__rmod__": lambda x, y: apply_op(lambda a: jnp.mod(y, a), x),
+    "__pow__": math.pow,
+    "__rpow__": lambda x, y: apply_op(lambda a: jnp.power(y, a), x),
+    "__matmul__": linalg.matmul,
+    "__rmatmul__": lambda x, y: linalg.matmul(y, x) if isinstance(y, Tensor) else apply_op(lambda a: jnp.matmul(y, a), x),
+    "__eq__": logic.equal,
+    "__ne__": logic.not_equal,
+    "__lt__": logic.less_than,
+    "__le__": logic.less_equal,
+    "__gt__": logic.greater_than,
+    "__ge__": logic.greater_equal,
+    "__and__": logic.bitwise_and,
+    "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+    "__lshift__": logic.bitwise_left_shift,
+    "__rshift__": logic.bitwise_right_shift,
+}
+
+_UNARY_DUNDERS = {
+    "__neg__": math.neg,
+    "__abs__": math.abs,
+    "__invert__": logic.bitwise_not,
+}
+
+_METHODS = dict(
+    # math
+    add=math.add, subtract=math.subtract, multiply=math.multiply,
+    divide=math.divide, floor_divide=math.floor_divide, mod=math.mod,
+    remainder=math.remainder, pow=math.pow, maximum=math.maximum,
+    minimum=math.minimum, fmax=math.fmax, fmin=math.fmin,
+    exp=math.exp, expm1=math.expm1, log=math.log, log2=math.log2,
+    log10=math.log10, log1p=math.log1p, sqrt=math.sqrt, rsqrt=math.rsqrt,
+    abs=math.abs, neg=math.neg, sign=math.sign, sin=math.sin, cos=math.cos,
+    tan=math.tan, asin=math.asin, acos=math.acos, atan=math.atan,
+    sinh=math.sinh, cosh=math.cosh, tanh=math.tanh, asinh=math.asinh,
+    acosh=math.acosh, atanh=math.atanh, floor=math.floor, ceil=math.ceil,
+    round=math.round, trunc=math.trunc, frac=math.frac,
+    reciprocal=math.reciprocal, square=math.square, erf=math.erf,
+    erfinv=math.erfinv, sigmoid=math.sigmoid, digamma=math.digamma,
+    lgamma=math.lgamma, logit=math.logit, scale=math.scale, clip=math.clip,
+    lerp=math.lerp, nan_to_num=math.nan_to_num, atan2=math.atan2,
+    angle=math.angle, conj=math.conj, real=math.real, imag=math.imag,
+    # reductions
+    sum=math.sum, mean=math.mean, prod=math.prod, max=math.max, min=math.min,
+    amax=math.amax, amin=math.amin, logsumexp=math.logsumexp, all=math.all,
+    any=math.any, std=math.std, var=math.var, median=math.median,
+    nanmean=math.nanmean, nansum=math.nansum, quantile=math.quantile,
+    count_nonzero=math.count_nonzero,
+    argmax=math.argmax, argmin=math.argmin, cumsum=math.cumsum,
+    cumprod=math.cumprod, cummax=math.cummax, cummin=math.cummin,
+    logcumsumexp=math.logcumsumexp, trace=math.trace, diff=math.diff,
+    isnan=math.isnan, isinf=math.isinf, isfinite=math.isfinite, isin=math.isin,
+    inner=math.inner, outer=math.outer, kron=math.kron,
+    heaviside=math.heaviside, hypot=math.hypot,
+    # manipulation
+    reshape=manipulation.reshape, reshape_=manipulation.reshape_,
+    transpose=manipulation.transpose, flatten=manipulation.flatten,
+    flatten_=manipulation.flatten_, squeeze=manipulation.squeeze,
+    squeeze_=manipulation.squeeze_, unsqueeze=manipulation.unsqueeze,
+    unsqueeze_=manipulation.unsqueeze_, tile=manipulation.tile,
+    expand=manipulation.expand, expand_as=manipulation.expand_as,
+    broadcast_to=manipulation.broadcast_to, flip=manipulation.flip,
+    roll=manipulation.roll, rot90=manipulation.rot90, split=manipulation.split,
+    chunk=manipulation.chunk, unbind=manipulation.unbind,
+    gather=manipulation.gather, gather_nd=manipulation.gather_nd,
+    scatter=manipulation.scatter, scatter_=manipulation.scatter_,
+    scatter_nd_add=manipulation.scatter_nd_add,
+    index_select=manipulation.index_select, index_sample=manipulation.index_sample,
+    index_add=manipulation.index_add, index_put=manipulation.index_put,
+    take_along_axis=manipulation.take_along_axis,
+    put_along_axis=manipulation.put_along_axis, take=manipulation.take,
+    masked_select=manipulation.masked_select, masked_fill=manipulation.masked_fill,
+    masked_fill_=manipulation.masked_fill_, where=manipulation.where,
+    nonzero=manipulation.nonzero, repeat_interleave=manipulation.repeat_interleave,
+    pad=manipulation.pad, topk=manipulation.topk, sort=manipulation.sort,
+    argsort=manipulation.argsort, unique=manipulation.unique,
+    unique_consecutive=manipulation.unique_consecutive,
+    moveaxis=manipulation.moveaxis, swapaxes=manipulation.swapaxes,
+    kthvalue=manipulation.kthvalue, mode=manipulation.mode,
+    as_strided=manipulation.as_strided, unfold=manipulation.unfold,
+    tensor_split=manipulation.tensor_split, bucketize=manipulation.bucketize,
+    # logic
+    equal=logic.equal, not_equal=logic.not_equal, less_than=logic.less_than,
+    less_equal=logic.less_equal, greater_than=logic.greater_than,
+    greater_equal=logic.greater_equal, logical_and=logic.logical_and,
+    logical_or=logic.logical_or, logical_xor=logic.logical_xor,
+    logical_not=logic.logical_not, bitwise_and=logic.bitwise_and,
+    bitwise_or=logic.bitwise_or, bitwise_xor=logic.bitwise_xor,
+    bitwise_not=logic.bitwise_not, isclose=logic.isclose,
+    allclose=logic.allclose, equal_all=logic.equal_all,
+    # linalg
+    matmul=linalg.matmul, mm=linalg.mm, bmm=linalg.bmm, dot=linalg.dot,
+    mv=linalg.mv, norm=linalg.norm, dist=linalg.dist, cross=linalg.cross,
+    cholesky=linalg.cholesky, inverse=linalg.inverse, t=manipulation.t,
+    cast=manipulation.cast, cast_=manipulation.cast_,
+    # creation-ish
+    tril=creation.tril, triu=creation.triu, diag=creation.diag,
+    diag_embed=creation.diag_embed,
+    # random in-place
+    uniform_=random.uniform_, normal_=random.normal_,
+    exponential_=random.exponential_, bernoulli_=random.bernoulli_,
+    multinomial=random.multinomial, bernoulli=random.bernoulli,
+)
+
+# autogenerated in-place arithmetic variants (functional rebind)
+_INPLACE_FROM = dict(
+    add_=math.add, subtract_=math.subtract, multiply_=math.multiply,
+    divide_=math.divide, scale_=math.scale, clip_=math.clip, pow_=math.pow,
+    exp_=math.exp, sqrt_=math.sqrt, rsqrt_=math.rsqrt, abs_=math.abs,
+    floor_=math.floor, ceil_=math.ceil, round_=math.round, neg_=math.neg,
+    reciprocal_=math.reciprocal, tanh_=math.tanh, sigmoid_=math.sigmoid,
+    erfinv_=math.erfinv, remainder_=math.remainder, mod_=math.mod,
+    lerp_=math.lerp, where_=manipulation.where,
+)
+
+
+def _make_inplace(fn):
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        return self._assign_result_(out)
+
+    return method
+
+
+def _make_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    return method
+
+
+def _fill_(self, value):
+    out = apply_op(
+        lambda a: jnp.full_like(a, value), self, _op_name="fill_"
+    )
+    return self._assign_result_(out)
+
+
+def _zero_(self):
+    return _fill_(self, 0)
+
+
+def _fill_diagonal_(self, value, offset=0, wrap=False):
+    def _fd(a):
+        n = min(a.shape[-2], a.shape[-1])
+        idx = jnp.arange(n - (offset if offset > 0 else 0))
+        rows = idx + max(-offset, 0)
+        cols = idx + max(offset, 0)
+        return a.at[..., rows, cols].set(value)
+
+    return self._assign_result_(apply_op(_fd, self, _op_name="fill_diagonal_"))
+
+
+def patch_tensor_methods():
+    for dunder, fn in _BINARY_DUNDERS.items():
+        setattr(Tensor, dunder, _make_method(fn))
+    for dunder, fn in _UNARY_DUNDERS.items():
+        setattr(Tensor, dunder, _make_method(fn))
+    for name, fn in _METHODS.items():
+        setattr(Tensor, name, _make_method(fn))
+    for name, fn in _INPLACE_FROM.items():
+        setattr(Tensor, name, _make_inplace(fn))
+    Tensor.__getitem__ = _tensor_getitem
+    Tensor.__setitem__ = _tensor_setitem
+    Tensor.__iter__ = _tensor_iter
+    Tensor.__hash__ = object.__hash__
+    Tensor.fill_ = _fill_
+    Tensor.zero_ = _zero_
+    Tensor.fill_diagonal_ = _fill_diagonal_
+    # numpy priority so np_scalar * Tensor defers to Tensor.__rmul__
+    Tensor.__array_priority__ = 1000
+
+
+patch_tensor_methods()

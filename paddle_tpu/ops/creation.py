@@ -1,0 +1,242 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import dtypes as _dt
+from .. import framework, device as _device
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _resolve_dtype(dtype, default=None):
+    if dtype is None:
+        return default
+    return _dt.to_np(dtype)
+
+
+def _put(arr):
+    """Host array → default device (lazy placement; no backend query)."""
+    return arr
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor"""
+    if isinstance(data, Tensor):
+        out = data.astype(dtype) if dtype is not None else data.clone()
+        out.stop_gradient = stop_gradient
+        return out
+    if isinstance(data, (jnp.ndarray, jax.Array)) and not isinstance(data, np.ndarray):
+        arr = data
+        if dtype is not None:
+            arr = arr.astype(_dt.to_np(dtype))
+        t = Tensor(arr, stop_gradient=stop_gradient)
+        return t
+    a = np.asarray(data)
+    if dtype is not None:
+        a = a.astype(_dt.to_np(dtype))
+    elif a.dtype == np.float64:
+        # python floats / float lists default to the framework default dtype
+        a = a.astype(framework.get_default_dtype().np_dtype)
+    elif a.dtype == np.int32 and isinstance(data, (int, list, tuple)):
+        a = a.astype(np.int64)
+    if place is not None:
+        dev = _device.jax_device_for(place)
+        t = Tensor(jax.device_put(a, dev), stop_gradient=stop_gradient)
+    else:
+        t = Tensor(jnp.asarray(a), stop_gradient=stop_gradient)
+    return t
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            out.append(int(s))
+    return out
+
+
+def zeros(shape, dtype=None, name=None):
+    d = _resolve_dtype(dtype, framework.get_default_dtype().np_dtype)
+    return Tensor(_put(jnp.zeros(_shape_list(shape), d)))
+
+
+def ones(shape, dtype=None, name=None):
+    d = _resolve_dtype(dtype, framework.get_default_dtype().np_dtype)
+    return Tensor(_put(jnp.ones(_shape_list(shape), d)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            d = np.bool_
+        elif isinstance(fill_value, int):
+            d = np.int64
+        else:
+            d = framework.get_default_dtype().np_dtype
+    else:
+        d = _dt.to_np(dtype)
+    return Tensor(_put(jnp.full(_shape_list(shape), fill_value, d)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    d = _resolve_dtype(dtype, None)
+    return Tensor(jnp.zeros(x._data.shape, d or x._data.dtype))
+
+
+def ones_like(x, dtype=None, name=None):
+    d = _resolve_dtype(dtype, None)
+    return Tensor(jnp.ones(x._data.shape, d or x._data.dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = _resolve_dtype(dtype, None)
+    return Tensor(jnp.full(x._data.shape, fill_value, d or x._data.dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            d = np.int64
+        else:
+            d = framework.get_default_dtype().np_dtype
+    else:
+        d = _dt.to_np(dtype)
+    return Tensor(_put(jnp.arange(start, end, step, dtype=d)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    d = _resolve_dtype(dtype, framework.get_default_dtype().np_dtype)
+    return Tensor(_put(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=d)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    d = _resolve_dtype(dtype, framework.get_default_dtype().np_dtype)
+    return Tensor(_put(jnp.logspace(start, stop, int(num), base=base, dtype=d)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    d = _resolve_dtype(dtype, framework.get_default_dtype().np_dtype)
+    return Tensor(_put(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=d)))
+
+
+def assign(x, output=None):
+    src = to_tensor(x) if not isinstance(x, Tensor) else x
+    out = apply_op(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact) else jnp.asarray(a), src, _op_name="assign")
+    if output is not None:
+        output._assign_result_(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.tril(a, diagonal), x, _op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.triu(a, diagonal), x, _op_name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _diag(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], dtype=bool)
+                mask = jnp.roll(mask, offset, axis=1) if offset else mask
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diag(a, offset)
+
+    return apply_op(_diag, x, _op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda a: jnp.diagflat(a, offset), x, _op_name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def _de(a):
+        n = a.shape[-1]
+        m = n + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (m, m), a.dtype)
+        idx = jnp.arange(n)
+        rows = idx + max(-offset, 0)
+        cols = idx + max(offset, 0)
+        out = out.at[..., rows, cols].set(a)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+
+    return apply_op(_de, x, _op_name="diag_embed")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return apply_op(lambda *xs: list(jnp.meshgrid(*xs, indexing="ij")), *args, _op_name="meshgrid")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(_put(jnp.asarray(np.stack([r, c]), dtype=_dt.to_np(dtype))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(_put(jnp.asarray(np.stack([r, c]), dtype=_dt.to_np(dtype))))
+
+
+def complex(real, imag, name=None):
+    return apply_op(lambda r, i: jax.lax.complex(r, i), real, imag, _op_name="complex")
+
+
+def as_tensor(data, dtype=None):
+    return to_tensor(data, dtype=dtype)
+
+
+def clone_detached(x):
+    return x.detach()
+
+
+def polar(abs_t, angle, name=None):
+    return apply_op(
+        lambda a, th: jax.lax.complex(a * jnp.cos(th), a * jnp.sin(th)),
+        abs_t,
+        angle,
+        _op_name="polar",
+    )

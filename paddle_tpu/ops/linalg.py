@@ -1,0 +1,348 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py).
+
+The hot path — ``matmul`` — lowers directly to ``jnp.matmul`` so XLA maps it
+onto the MXU (reference analogue: ``phi/kernels/gpu/matmul_kernel.cu`` over
+cuBLAS; here the systolic array via a single HLO dot_general).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _matmul(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -2, -1) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -2, -1) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply_op(_matmul, x, y, _op_name="matmul")
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y, _op_name="bmm")
+
+
+def dot(x, y, name=None):
+    def _dot(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return apply_op(_dot, x, y, _op_name="dot")
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, x, vec, _op_name="mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+        input,
+        x,
+        y,
+        _op_name="addmm",
+    )
+
+
+def einsum(equation, *operands):
+    return apply_op(
+        lambda ops: jnp.einsum(equation, *ops), list(operands), _op_name="einsum"
+    )
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_op(
+        lambda a, b: jnp.tensordot(a, b, axes=axes), x, y, _op_name="tensordot"
+    )
+
+
+def cross(x, y, axis=9, name=None):
+    def _cross(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_op(_cross, x, y, _op_name="cross")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def _norm(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(a))))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            ordv = jnp.inf
+        elif p == float("-inf"):
+            ordv = -jnp.inf
+        else:
+            ordv = p
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=ordv, keepdims=False)
+        return jnp.linalg.norm(a, ord=ordv, axis=_ax(axis), keepdims=keepdim)
+
+    def _ax(axis):
+        if isinstance(axis, (list, tuple)):
+            return tuple(axis)
+        return axis
+
+    return apply_op(_norm, x, _op_name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.linalg.vector_norm(
+            a, ord=p, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis, keepdims=keepdim
+        ),
+        x,
+        _op_name="vector_norm",
+    )
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim),
+        x,
+        _op_name="matrix_norm",
+    )
+
+
+def dist(x, y, p=2, name=None):
+    return apply_op(
+        lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), x, y, _op_name="dist"
+    )
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def _cdist(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return apply_op(_cdist, x, y, _op_name="cdist")
+
+
+def t(input, name=None):
+    from .manipulation import t as _t
+
+    return _t(input)
+
+
+def transpose(x, perm, name=None):
+    from .manipulation import transpose as _transpose
+
+    return _transpose(x, perm)
+
+
+def cholesky(x, upper=False, name=None):
+    def _chol(a):
+        lower = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(lower, -2, -1) if upper else lower
+
+    return apply_op(_chol, x, _op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return apply_op(
+        lambda b, l: jax.scipy.linalg.cho_solve((l, not upper), b),
+        x,
+        y,
+        _op_name="cholesky_solve",
+    )
+
+
+def inverse(x, name=None):
+    return apply_op(jnp.linalg.inv, x, _op_name="inverse")
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(
+        lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+        x,
+        _op_name="pinv",
+    )
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y, _op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply_op(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, trans=1 if transpose else 0, lower=not upper, unit_diagonal=unitriangular
+        ),
+        x,
+        y,
+        _op_name="triangular_solve",
+    )
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _lu(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        if get_infos:
+            return lu_mat, piv.astype(np.int32) + 1, jnp.zeros((), np.int32)
+        return lu_mat, piv.astype(np.int32) + 1
+
+    return apply_op(_lu, x, _op_name="lu")
+
+
+def svd(x, full_matrices=False, name=None):
+    def _svd(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -2, -1)  # paddle returns V not V^H
+
+    return apply_op(_svd, x, _op_name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    def _qr(a):
+        if mode == "r":
+            return jnp.linalg.qr(a, mode="r")
+        q, r = jnp.linalg.qr(a, mode=mode)
+        return q, r
+
+    return apply_op(_qr, x, _op_name="qr")
+
+
+def eig(x, name=None):
+    # XLA lacks general eig on TPU; compute on CPU host like the reference's
+    # CPU-only kernels for eig.
+    arr = np.asarray(x._data)
+    w, v = np.linalg.eig(arr)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(
+        lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x, _op_name="eigh"
+    )
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(x._data)
+    return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(
+        lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x, _op_name="eigvalsh"
+    )
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x, _op_name="det")
+
+
+def slogdet(x, name=None):
+    def _slogdet(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return apply_op(_slogdet, x, _op_name="slogdet")
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(
+        lambda a: jnp.linalg.matrix_power(a, n), x, _op_name="matrix_power"
+    )
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol).astype(np.int64),
+        x,
+        _op_name="matrix_rank",
+    )
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _lstsq(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(np.int64), sv
+
+    return apply_op(_lstsq, x, y, _op_name="lstsq")
+
+
+def multi_dot(x, name=None):
+    return apply_op(lambda xs: jnp.linalg.multi_dot(xs), list(x), _op_name="multi_dot")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(
+        lambda a: jnp.corrcoef(a, rowvar=rowvar), x, _op_name="corrcoef"
+    )
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(
+        lambda a, fw, aw: jnp.cov(
+            a, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw
+        ),
+        x,
+        fweights,
+        aweights,
+        _op_name="cov",
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def _hist(a, w):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (None, None)
+        rng = (lo, hi) if lo is not None else None
+        h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=rng, weights=w, density=density)
+        return h if density or w is not None else h.astype(np.int64)
+
+    return apply_op(_hist, input, weight, _op_name="histogram")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    def _histdd(a, w):
+        h, edges = jnp.histogramdd(a, bins=bins, range=ranges, weights=w, density=density)
+        return (h, list(edges))
+
+    return apply_op(_histdd, x, weights, _op_name="histogramdd")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    def _bincount(a, w):
+        length = int(np.maximum(np.asarray(a).max(initial=-1) + 1, minlength))
+        return jnp.bincount(a, weights=w, length=length)
+
+    return apply_op(_bincount, x, weights, _op_name="bincount")
+
+
+def householder_product(x, tau, name=None):
+    def _hp(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[..., i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
+            return q @ h
+
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+
+    return apply_op(_hp, x, tau, _op_name="householder_product")

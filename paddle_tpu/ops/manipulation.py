@@ -1,0 +1,709 @@
+"""Shape / layout manipulation ops (parity: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import dtypes as _dt
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _static_ints(v):
+    """Resolve a shape-like argument (may contain Tensors) to python ints."""
+    if isinstance(v, Tensor):
+        out = v.numpy().tolist()
+        return [int(i) for i in out] if isinstance(out, list) else int(out)
+    if isinstance(v, (list, tuple)):
+        return [int(i.item()) if isinstance(i, Tensor) else int(i) for i in v]
+    return int(v)
+
+
+def reshape(x, shape, name=None):
+    shape = _static_ints(shape)
+    return apply_op(lambda a: jnp.reshape(a, shape), x, _op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._assign_result_(reshape(x, shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def transpose(x, perm=None, name=None):
+    if perm is not None:
+        perm = _static_ints(perm)
+    return apply_op(lambda a: jnp.transpose(a, perm), x, _op_name="transpose")
+
+
+def t(x, name=None):
+    def _t(a):
+        if a.ndim < 2:
+            return a
+        return jnp.swapaxes(a, -2, -1)
+
+    return apply_op(_t, x, _op_name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(
+        lambda a: jnp.moveaxis(a, source, destination), x, _op_name="moveaxis"
+    )
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(
+        lambda a: jnp.swapaxes(a, axis0, axis1), x, _op_name="swapaxes"
+    )
+
+
+transpose_ = lambda x, perm, name=None: x._assign_result_(transpose(x, perm))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _flatten(a):
+        nd = a.ndim
+        if nd == 0:
+            return a.reshape([1])
+        s = start_axis % nd
+        e = stop_axis % nd
+        new_shape = list(a.shape[:s]) + [-1] + list(a.shape[e + 1 :])
+        return a.reshape(new_shape)
+
+    return apply_op(_flatten, x, _op_name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    def _squeeze(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply_op(_squeeze, x, _op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._assign_result_(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _static_ints(axis)
+
+    def _unsqueeze(a):
+        axes = ax if isinstance(ax, list) else [ax]
+        out = a
+        for i in axes:
+            out = jnp.expand_dims(out, i)
+        return out
+
+    return apply_op(_unsqueeze, x, _op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._assign_result_(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(
+        lambda xs: jnp.concatenate(xs, axis=axis), list(x), _op_name="concat"
+    )
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(lambda xs: jnp.stack(xs, axis=axis), list(x), _op_name="stack")
+
+
+def hstack(x, name=None):
+    return apply_op(lambda xs: jnp.hstack(xs), list(x), _op_name="hstack")
+
+
+def vstack(x, name=None):
+    return apply_op(lambda xs: jnp.vstack(xs), list(x), _op_name="vstack")
+
+
+def dstack(x, name=None):
+    return apply_op(lambda xs: jnp.dstack(xs), list(x), _op_name="dstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def _split(a):
+        n = num_or_sections
+        if isinstance(n, int):
+            return list(jnp.split(a, n, axis=axis))
+        sections = _static_ints(n)
+        total = a.shape[axis]
+        if -1 in sections:
+            known = builtins_sum(s for s in sections if s != -1)
+            sections = [total - known if s == -1 else s for s in sections]
+        offsets = np.cumsum(sections)[:-1].tolist()
+        return list(jnp.split(a, offsets, axis=axis))
+
+    return apply_op(_split, x, _op_name="split")
+
+
+def builtins_sum(it):
+    import builtins
+
+    return builtins.sum(it)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return apply_op(
+        lambda a: list(jnp.array_split(a, num_or_indices, axis=axis)),
+        x,
+        _op_name="tensor_split",
+    )
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return apply_op(
+        lambda a: list(jnp.array_split(a, chunks, axis=axis)), x, _op_name="chunk"
+    )
+
+
+def unbind(input, axis=0, name=None):
+    def _unbind(a):
+        n = a.shape[axis]
+        return [jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis)]
+
+    return apply_op(_unbind, input, _op_name="unbind")
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_ints(repeat_times)
+    if isinstance(reps, int):
+        reps = [reps]
+    return apply_op(lambda a: jnp.tile(a, reps), x, _op_name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = _static_ints(shape)
+
+    def _expand(a):
+        tgt = list(shape)
+        # -1 means keep the original dim
+        nd_off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - nd_off]
+        return jnp.broadcast_to(a, tgt)
+
+    return apply_op(_expand, x, _op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return apply_op(
+        lambda a, b: jnp.broadcast_to(a, b.shape), x, y, _op_name="expand_as"
+    )
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    return apply_op(
+        lambda xs: list(jnp.broadcast_arrays(*xs)), list(inputs), _op_name="broadcast_tensors"
+    )
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda a: jnp.flip(a, axis=tuple(axes)), x, _op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k, axes), x, _op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda a: jnp.roll(a, shifts, axis), x, _op_name="roll")
+
+
+# -- gather / scatter family ------------------------------------------------
+def gather(x, index, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(
+        lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=axis),
+        x,
+        index,
+        _op_name="gather",
+    )
+
+
+def gather_nd(x, index, name=None):
+    def _gather_nd(a, idx):
+        tup = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[tup]
+
+    return apply_op(_gather_nd, x, index, _op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _scatter(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # paddle semantics: when not overwrite, zero target rows then add
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply_op(_scatter, x, index, updates, _op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._assign_result_(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _snd(a, i, u):
+        tup = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[tup].add(u)
+
+    return apply_op(_snd, x, index, updates, _op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shape = _static_ints(shape)
+
+    def _snd(i, u):
+        zeros = jnp.zeros(shape, u.dtype)
+        tup = tuple(jnp.moveaxis(i, -1, 0))
+        return zeros.at[tup].add(u)
+
+    return apply_op(_snd, index, updates, _op_name="scatter_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(
+        lambda a, i: jnp.take(a, i, axis=axis), x, index, _op_name="index_select"
+    )
+
+
+def index_sample(x, index, name=None):
+    return apply_op(
+        lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index, _op_name="index_sample"
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    def _index_add(a, i, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].add(v)
+
+    return apply_op(_index_add, x, index, value, _op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def _index_put(a, idxs, v):
+        tup = tuple(idxs)
+        if accumulate:
+            return a.at[tup].add(v)
+        return a.at[tup].set(v)
+
+    return apply_op(_index_put, x, list(indices), value, _op_name="index_put")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op(
+        lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+        arr,
+        indices,
+        _op_name="take_along_axis",
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def _put(a, i, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+                "amax": "max", "amin": "min"}[reduce]
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij")
+        full_idx = list(grids)
+        full_idx[axis % a.ndim] = i
+        return getattr(a.at[tuple(full_idx)], mode)(v)
+
+    return apply_op(_put, arr, indices, values, _op_name="put_along_axis")
+
+
+def take(x, index, mode="raise", name=None):
+    return apply_op(
+        lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1) if i.ndim == 0 else i, mode="clip" if mode == "clip" else "wrap" if mode == "wrap" else None),
+        x,
+        index,
+        _op_name="take",
+    )
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (like the reference's masked_select)
+    return apply_op(lambda a, m: a[m], x, mask, _op_name="masked_select")
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply_op(
+        lambda a, m, v: jnp.where(m, jnp.asarray(v, a.dtype), a),
+        x,
+        mask,
+        value,
+        _op_name="masked_fill",
+    )
+
+
+def masked_fill_(x, mask, value, name=None):
+    return x._assign_result_(masked_fill(x, mask, value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    def _ms(a, m, v):
+        flat_m = m.reshape(-1)
+        nsel = int(np.asarray(flat_m).sum())
+        src = v.reshape(-1)[:nsel]
+        out = a.reshape(-1).at[jnp.where(flat_m)[0]].set(src)
+        return out.reshape(a.shape)
+
+    return apply_op(_ms, x, mask, value, _op_name="masked_scatter")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op(
+        lambda c, a, b: jnp.where(c, a, b), condition, x, y, _op_name="where"
+    )
+
+
+def where_(condition, x, y, name=None):
+    return x._assign_result_(where(condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    arr = x._data
+    res = jnp.nonzero(arr)  # eager-only (dynamic shape)
+    if as_tuple:
+        return tuple(Tensor(r) for r in res)
+    return Tensor(jnp.stack(res, axis=1).astype(np.int64))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def _ri(a, r):
+        return jnp.repeat(a, r, axis=axis)
+
+    return apply_op(_ri, x, repeats, _op_name="repeat_interleave")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):
+    pad_list = _static_ints(pad)
+
+    def _pad(a):
+        nd = a.ndim
+        if len(pad_list) == 2 * nd:
+            # full-rank paddle format: [d0_l, d0_r, d1_l, d1_r, ...]
+            width = [(pad_list[2 * i], pad_list[2 * i + 1]) for i in range(nd)]
+        else:
+            # torch-style trailing-dims format applied to last len(pad)//2 dims
+            k = len(pad_list) // 2
+            width = [(0, 0)] * (nd - k)
+            # NCHW conv-style: pad applies to spatial dims (last k), reversed order
+            for i in range(k):
+                width.append((pad_list[2 * (k - 1 - i)], pad_list[2 * (k - 1 - i) + 1]))
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return apply_op(_pad, x, _op_name="pad")
+
+
+def slice(input, axes, starts, ends, name=None):
+    import builtins
+
+    axes = _static_ints(axes)
+    starts = _static_ints(starts)
+    ends = _static_ints(ends)
+
+    def _slice(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+
+    return apply_op(_slice, input, _op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+
+    axes = _static_ints(axes)
+    starts = _static_ints(starts)
+    ends = _static_ints(ends)
+    strides = _static_ints(strides)
+
+    def _ss(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply_op(_ss, x, _op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+
+    shape = _static_ints(shape)
+    offsets = _static_ints(offsets) if offsets is not None else [0] * len(shape)
+
+    def _crop(a):
+        idx = tuple(
+            builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
+            for i, (o, s) in enumerate(zip(offsets, shape))
+        )
+        return a[idx]
+
+    return apply_op(_crop, x, _op_name="crop")
+
+
+# -- search / sort ----------------------------------------------------------
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def _topk(a):
+        ax = axis % a.ndim
+        arr = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, inds = jax.lax.top_k(arr, k)
+        else:
+            vals, inds = jax.lax.top_k(-arr, k)
+            vals = -vals
+        return (
+            jnp.moveaxis(vals, -1, ax),
+            jnp.moveaxis(inds.astype(np.int64), -1, ax),
+        )
+
+    return apply_op(_topk, x, _op_name="topk")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def _sort(a):
+        out = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return apply_op(_sort, x, _op_name="sort")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def _argsort(a):
+        out = jnp.argsort(a, axis=axis, stable=stable, descending=descending).astype(np.int64)
+        return out
+
+    return apply_op(_argsort, x, _op_name="argsort")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def _ss(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(np.int32 if out_int32 else np.int64)
+
+    return apply_op(_ss, sorted_sequence, values, _op_name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(a):
+        vals = jnp.sort(a, axis=axis)
+        inds = jnp.argsort(a, axis=axis).astype(np.int64)
+        taken_v = jnp.take(vals, k - 1, axis=axis)
+        taken_i = jnp.take(inds, k - 1, axis=axis)
+        if keepdim:
+            taken_v = jnp.expand_dims(taken_v, axis)
+            taken_i = jnp.expand_dims(taken_i, axis)
+        return taken_v, taken_i
+
+    return apply_op(_kth, x, _op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _mode(a):
+        sorted_a = jnp.sort(a, axis=axis)
+        n = a.shape[axis]
+        # count runs via comparisons
+        vals, counts = jax.vmap(
+            lambda row: _mode_1d(row)
+        )(jnp.moveaxis(sorted_a, axis, -1).reshape(-1, n))
+        shp = list(a.shape)
+        del shp[axis % a.ndim]
+        vals = vals.reshape(shp)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+        idx = jnp.argmax(
+            (a == (jnp.expand_dims(vals, axis) if not keepdim else vals)).astype(np.int32), axis=axis
+        ).astype(np.int64)
+        if keepdim:
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    def _mode_1d(row):
+        uniq_mask = jnp.concatenate([jnp.array([True]), row[1:] != row[:-1]])
+        run_id = jnp.cumsum(uniq_mask) - 1
+        counts = jnp.zeros(row.shape[0], np.int32).at[run_id].add(1)
+        best = jnp.argmax(counts)
+        val = row[jnp.argmax(run_id == best)]
+        return val, counts
+
+    return apply_op(_mode, x, _op_name="mode")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    # dynamic shape: eager-only
+    arr = np.asarray(x._data)
+    res = np.unique(
+        arr,
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r if i == 0 else r.astype(_dt.to_np(dtype)))) for i, r in enumerate(res)]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+    mask = np.concatenate([[True], arr[1:] != arr[:-1]]) if arr.ndim == 1 else None
+    vals = arr[mask] if mask is not None else arr
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(mask) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(_dt.to_np(dtype)))))
+    if return_counts:
+        idx = np.where(mask)[0]
+        counts = np.diff(np.append(idx, arr.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts.astype(_dt.to_np(dtype)))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=np.float32),
+        x,
+        _op_name="one_hot",
+    )
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=np.int64))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, dtype=np.int32))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, dtype=np.int64))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    return x.dtype.is_floating_point
+
+
+def is_integer(x):
+    return x.dtype.is_integer
+
+
+def is_complex(x):
+    return x.dtype.is_complex
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast_(x, dtype):
+    return x._assign_result_(x.astype(dtype))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def _as_strided(a):
+        flat = a.reshape(-1)
+        idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+        for dim, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            sh = [1] * len(shape)
+            sh[dim] = s
+            idx = idx + r.reshape(sh)
+        return flat[jnp.asarray(idx)]
+
+    return apply_op(_as_strided, x, _op_name="as_strided")
+
+
+def unfold(x, axis, size, step, name=None):
+    def _unfold(a):
+        n = a.shape[axis]
+        starts = np.arange(0, n - size + 1, step)
+        slices = [jax.lax.slice_in_dim(a, int(s), int(s) + size, axis=axis) for s in starts]
+        return jnp.stack(slices, axis=axis if axis >= 0 else a.ndim + axis)
+
+    return apply_op(_unfold, x, _op_name="unfold")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_1d, x, _op_name="atleast_1d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_2d, x, _op_name="atleast_2d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_3d, x, _op_name="atleast_3d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._assign_result_(flatten(x, start_axis, stop_axis))
+
+
+def tolist(x):
+    return x.tolist()

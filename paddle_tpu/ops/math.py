@@ -1,0 +1,470 @@
+"""Elementwise math + reductions (parity: python/paddle/tensor/math.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import dtypes as _dt, framework
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _unary(jfn, name):
+    def op(x, name=None):
+        return apply_op(jfn, x, _op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _binary(jfn, name):
+    def op(x, y, name=None):
+        return apply_op(jfn, x, y, _op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+# -- elementwise unary ------------------------------------------------------
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+abs = _unary(jnp.abs, "abs")
+absolute = abs
+neg = _unary(jnp.negative, "neg")
+negative = neg
+sign = _unary(jnp.sign, "sign")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+arcsin, arccos, arctan = asin, acos, atan
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda x: x - jnp.trunc(x), "frac")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+square = _unary(jnp.square, "square")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+gamma = _unary(lambda x: jnp.exp(jax.scipy.special.gammaln(x)) * jnp.sign(x) ** 0, "gamma")
+i0 = _unary(jax.scipy.special.i0, "i0")
+i1 = _unary(jax.scipy.special.i1, "i1")
+angle = _unary(jnp.angle, "angle")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+exponent_bias = None  # placeholder
+
+
+def logit(x, eps=None, name=None):
+    def _logit(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+
+    return apply_op(_logit, x, _op_name="logit")
+
+
+# -- elementwise binary -----------------------------------------------------
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+mul = multiply
+
+
+def divide(x, y, name=None):
+    def _div(a, b):
+        out = jnp.true_divide(a, b)
+        if not (
+            jnp.issubdtype(jnp.result_type(a), jnp.inexact)
+            or jnp.issubdtype(jnp.result_type(b), jnp.inexact)
+        ):
+            out = out.astype(framework.get_default_dtype().np_dtype)
+        return out
+
+    return apply_op(_div, x, y, _op_name="divide")
+
+
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+floor_mod = _binary(jnp.mod, "floor_mod")
+mod = _binary(jnp.mod, "mod")
+remainder = mod
+pow = _binary(jnp.power, "pow")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+heaviside = _binary(jnp.heaviside, "heaviside")
+hypot = _binary(jnp.hypot, "hypot")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+nextafter = _binary(jnp.nextafter, "nextafter")
+copysign = _binary(jnp.copysign, "copysign")
+gcd = _binary(jnp.gcd, "gcd")
+lcm = _binary(jnp.lcm, "lcm")
+kron = _binary(jnp.kron, "kron")
+ldexp = _binary(lambda a, b: a * (2.0**b), "ldexp")
+inner_alias = None
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def _scale(a, s, b):
+        s = jnp.asarray(s, a.dtype) if not np.isscalar(s) else s
+        if bias_after_scale:
+            return a * s + b
+        return (a + b) * s
+
+    return apply_op(_scale, x, scale, bias, _op_name="scale")
+
+
+def clip(x, min=None, max=None, name=None):
+    def _clip(a, lo, hi):
+        return jnp.clip(a, lo, hi)
+
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply_op(_clip, x, lo, hi, _op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight, _op_name="lerp")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply_op(
+        lambda xs: sum(xs[1:], start=xs[0]) if len(xs) > 1 else xs[0],
+        list(inputs),
+        _op_name="add_n",
+    )
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        x,
+        _op_name="nan_to_num",
+    )
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), x, _op_name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    def _mpx(xs, idx):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0
+        )[0]
+
+    return apply_op(_mpx, list(inputs), index, _op_name="multiplex")
+
+
+# -- reductions -------------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        ax = axis.numpy().tolist()
+        return tuple(ax) if isinstance(ax, list) else int(ax)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    d = _dt.to_np(dtype) if dtype is not None else None
+
+    def _sum(a):
+        out_dtype = d
+        if out_dtype is None and jnp.issubdtype(a.dtype, jnp.bool_):
+            out_dtype = np.int64
+        return jnp.sum(a, axis=axis, keepdims=keepdim, dtype=out_dtype)
+
+    return apply_op(_sum, x, _op_name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.mean(a, axis=axis, keepdims=keepdim), x, _op_name="mean"
+    )
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    axis = _norm_axis(axis)
+    d = _dt.to_np(dtype) if dtype is not None else None
+    return apply_op(
+        lambda a: jnp.prod(a, axis=axis, keepdims=keepdim, dtype=d),
+        x,
+        _op_name="prod",
+    )
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.max(a, axis=axis, keepdims=keepdim), x, _op_name="max"
+    )
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.min(a, axis=axis, keepdims=keepdim), x, _op_name="min"
+    )
+
+
+amax = max
+amin = min
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim),
+        x,
+        _op_name="logsumexp",
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.all(a, axis=axis, keepdims=keepdim), x, _op_name="all"
+    )
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.any(a, axis=axis, keepdims=keepdim), x, _op_name="any"
+    )
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim).astype(np.int64),
+        x,
+        _op_name="count_nonzero",
+    )
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.nanmean(a, axis=axis, keepdims=keepdim), x, _op_name="nanmean"
+    )
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    d = _dt.to_np(dtype) if dtype is not None else None
+    return apply_op(
+        lambda a: jnp.nansum(a, axis=axis, keepdims=keepdim, dtype=d),
+        x,
+        _op_name="nansum",
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x, _op_name="median"
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a, qq: jnp.quantile(a, jnp.asarray(qq), axis=axis, keepdims=keepdim),
+        x,
+        q,
+        _op_name="quantile",
+    )
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.std(a, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        _op_name="std",
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.var(a, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        _op_name="var",
+    )
+
+
+# -- arg / index reductions -------------------------------------------------
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    axis_n = _norm_axis(axis)
+    d = _dt.to_np(dtype)
+
+    def _argmax(a):
+        out = jnp.argmax(a, axis=axis_n)
+        if keepdim and axis_n is not None:
+            out = jnp.expand_dims(out, axis_n)
+        return out.astype(d)
+
+    return apply_op(_argmax, x, _op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    axis_n = _norm_axis(axis)
+    d = _dt.to_np(dtype)
+
+    def _argmin(a):
+        out = jnp.argmin(a, axis=axis_n)
+        if keepdim and axis_n is not None:
+            out = jnp.expand_dims(out, axis_n)
+        return out.astype(d)
+
+    return apply_op(_argmin, x, _op_name="argmin")
+
+
+# -- cumulative -------------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = _dt.to_np(dtype) if dtype is not None else None
+
+    def _cumsum(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+
+    return apply_op(_cumsum, x, _op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = _dt.to_np(dtype) if dtype is not None else None
+
+    def _cumprod(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=d)
+        return jnp.cumprod(a, axis=int(dim), dtype=d)
+
+    return apply_op(_cumprod, x, _op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def _cummax(a):
+        ax = 0 if axis is None else int(axis)
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, arr, axis=ax)
+        inds = _cummax_indices(arr, ax)
+        return vals, inds.astype(_dt.to_np(dtype))
+
+    return apply_op(_cummax, x, _op_name="cummax")
+
+
+def _cummax_indices(arr, ax):
+    n = arr.shape[ax]
+    idx = jnp.arange(n)
+    shape = [1] * arr.ndim
+    shape[ax] = n
+    idx = idx.reshape(shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv >= av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    _, inds = jax.lax.associative_scan(
+        combine, (arr, jnp.broadcast_to(idx, arr.shape)), axis=ax
+    )
+    return inds
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def _cummin(a):
+        ax = 0 if axis is None else int(axis)
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.minimum, arr, axis=ax)
+        neg_inds = _cummax_indices(-arr, ax)
+        return vals, neg_inds.astype(_dt.to_np(dtype))
+
+    return apply_op(_cummin, x, _op_name="cummin")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def _lcse(a):
+        ax = 0 if axis is None else int(axis)
+        arr = a.reshape(-1) if axis is None else a
+        return jax.lax.associative_scan(jnp.logaddexp, arr, axis=ax)
+
+    return apply_op(_lcse, x, _op_name="logcumsumexp")
+
+
+# -- tests ------------------------------------------------------------------
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+isneginf = _unary(jnp.isneginf, "isneginf")
+isposinf = _unary(jnp.isposinf, "isposinf")
+isreal = _unary(jnp.isreal, "isreal")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply_op(
+        lambda a, t: jnp.isin(a, t, invert=invert), x, test_x, _op_name="isin"
+    )
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply_op(
+        lambda a, p, ap: jnp.diff(a, n=n, axis=axis, prepend=p, append=ap),
+        x,
+        prepend,
+        append,
+        _op_name="diff",
+    )
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        x,
+        _op_name="trace",
+    )
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, x, y, _op_name="inner")
+
+
+def outer(x, y, name=None):
+    return apply_op(
+        lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)), x, y, _op_name="outer"
+    )

@@ -1,0 +1,189 @@
+"""Random ops over the global Generator key chain.
+
+Parity: python/paddle/tensor/random.py over ``phi::Generator`` Philox states.
+Each op consumes one subkey from the default generator; under
+``framework.rng_key_scope`` (used by the jit path) keys come from the scoped
+chain so traced programs receive per-step randomness as an argument.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import dtypes as _dt, framework, device as _device
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from .creation import _shape_list
+
+
+def _key():
+    return framework.next_rng_key()
+
+
+def _default_float():
+    return framework.get_default_dtype().np_dtype
+
+
+def rand(shape, dtype=None, name=None):
+    d = _dt.to_np(dtype) if dtype is not None else _default_float()
+    return Tensor(jax.random.uniform(_key(), _shape_list(shape), dtype=d))
+
+
+def randn(shape, dtype=None, name=None):
+    d = _dt.to_np(dtype) if dtype is not None else _default_float()
+    return Tensor(jax.random.normal(_key(), _shape_list(shape), dtype=d))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = _dt.to_np(dtype) if dtype is not None else _default_float()
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(
+        jax.random.uniform(key, _shape_list(shape), dtype=d, minval=min, maxval=max)
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        key = _key()
+
+        def _normal(m, s):
+            shp = jnp.broadcast_shapes(
+                jnp.shape(m) if not np.isscalar(m) else (),
+                jnp.shape(s) if not np.isscalar(s) else (),
+            )
+            return m + s * jax.random.normal(key, shp, dtype=_default_float())
+
+        return apply_op(_normal, mean, std, _op_name="normal")
+    shp = _shape_list(shape) if shape is not None else []
+    return Tensor(
+        mean + std * jax.random.normal(_key(), shp, dtype=_default_float())
+    )
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    d = _dt.to_np(dtype) if dtype is not None else _default_float()
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(mean + std * jax.random.normal(key, _shape_list(shape), dtype=d))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt.to_np(dtype)
+    return Tensor(
+        jax.random.randint(_key(), _shape_list(shape), low, high, dtype=d)
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt.to_np(dtype) if dtype is not None else _dt.to_np(x.dtype)
+    return Tensor(
+        jax.random.randint(_key(), tuple(x.shape), low, high).astype(d)
+    )
+
+
+def randperm(n, dtype="int64", name=None):
+    d = _dt.to_np(dtype)
+    return Tensor(jax.random.permutation(_key(), n).astype(d))
+
+
+def bernoulli(x, name=None):
+    key = _key()
+    return apply_op(
+        lambda p: jax.random.bernoulli(key, p).astype(p.dtype),
+        x,
+        _op_name="bernoulli",
+    )
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = _key()
+    out = Tensor(jax.random.bernoulli(key, p, tuple(x.shape)).astype(x._data.dtype))
+    return x._assign_result_(out)
+
+
+def binomial(count, prob, name=None):
+    key = _key()
+    return apply_op(
+        lambda n, p: jax.random.binomial(key, n.astype(np.float32), p).astype(np.int64),
+        count,
+        prob,
+        _op_name="binomial",
+    )
+
+
+def poisson(x, name=None):
+    key = _key()
+    return apply_op(
+        lambda lam: jax.random.poisson(key, lam).astype(lam.dtype),
+        x,
+        _op_name="poisson",
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _key()
+
+    def _multinomial(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1, shape=(num_samples,) + p.shape[:-1]
+            ).T.astype(np.int64) if p.ndim > 1 else jax.random.categorical(
+                key, logits, shape=(num_samples,)
+            ).astype(np.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, p.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(np.int64)
+
+    return apply_op(_multinomial, x, _op_name="multinomial")
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = _key()
+    out = Tensor(
+        (jax.random.exponential(key, tuple(x.shape)) / lam).astype(x._data.dtype)
+    )
+    return x._assign_result_(out)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    out = Tensor(
+        jax.random.uniform(
+            key, tuple(x.shape), dtype=x._data.dtype, minval=min, maxval=max
+        )
+    )
+    return x._assign_result_(out)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = Tensor(
+        (mean + std * jax.random.normal(_key(), tuple(x.shape))).astype(x._data.dtype)
+    )
+    return x._assign_result_(out)
+
+
+def rand_like(x, dtype=None, name=None):
+    d = _dt.to_np(dtype) if dtype is not None else x._data.dtype
+    return Tensor(jax.random.uniform(_key(), tuple(x.shape), dtype=d))
+
+
+def randn_like(x, dtype=None, name=None):
+    d = _dt.to_np(dtype) if dtype is not None else x._data.dtype
+    return Tensor(jax.random.normal(_key(), tuple(x.shape), dtype=d))
+
+
+def shuffle(x, axis=0, name=None):
+    key = _key()
+    return apply_op(
+        lambda a: jax.random.permutation(key, a, axis=axis), x, _op_name="shuffle"
+    )

@@ -1,0 +1,411 @@
+"""paddle.optimizer — 17 optimizers over the functional update core.
+
+Parity: python/paddle/optimizer/. Each _update is pure jnp: eager step() and
+the jit'd TrainStep share it.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import lr  # noqa: F401
+from .optimizer import Optimizer
+from .regularizer import L1Decay, L2Decay  # noqa: F401
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, p, g, slots, lr):
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def _update(self, p, g, slots, lr):
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_slots(self, p):
+        return {"moment": jnp.full_like(p, self._init_val)}
+
+    def _update(self, p, g, slots, lr):
+        m = slots["moment"] + g * g
+        new_p = p - lr * g / (jnp.sqrt(m) + self._epsilon)
+        return new_p, {"moment": m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+        self._multi_precision = multi_precision
+
+    def _init_slots(self, p):
+        f32 = jnp.float32
+        slots = {
+            "moment1": jnp.zeros(p.shape, f32),
+            "moment2": jnp.zeros(p.shape, f32),
+            "beta1_pow": jnp.ones((), f32),
+            "beta2_pow": jnp.ones((), f32),
+        }
+        if self._amsgrad:
+            slots["moment2_max"] = jnp.zeros(p.shape, f32)
+        if self._multi_precision and p.dtype != jnp.float32:
+            slots["master_weight"] = p.astype(f32)
+        return slots
+
+    def _update(self, p, g, slots, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        gf = g.astype(jnp.float32)
+        m1 = b1 * slots["moment1"] + (1 - b1) * gf
+        m2 = b2 * slots["moment2"] + (1 - b2) * gf * gf
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        m1_hat = m1 / (1 - b1p)
+        denom_m2 = m2
+        new_slots = {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+        if self._amsgrad:
+            m2max = jnp.maximum(slots["moment2_max"], m2)
+            denom_m2 = m2max
+            new_slots["moment2_max"] = m2max
+        m2_hat = denom_m2 / (1 - b2p)
+        update = m1_hat / (jnp.sqrt(m2_hat) + eps)
+        master = slots.get("master_weight")
+        if master is not None:
+            new_master = master - lr * update
+            new_slots["master_weight"] = new_master
+            new_p = new_master.astype(p.dtype)
+        else:
+            new_p = (p.astype(jnp.float32) - lr * update).astype(p.dtype)
+        return new_p, new_slots
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (parity: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, amsgrad=amsgrad, name=name)
+        self._wd = float(weight_decay) if not callable(weight_decay) else weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._current_param_name = None
+
+    @property
+    def _coeff(self):
+        return self._wd
+
+    def step(self):
+        # decay applies per-param (apply_decay_param_fun filter) before update
+        self._decay_names = None
+        super().step()
+
+    def _regularized_grad_arr(self, p, g_arr):
+        # mark current param so _update can decide decay
+        self._current_param_name = getattr(p, "name", None)
+        return g_arr
+
+    def _update(self, p, g, slots, lr):
+        decay = True
+        if self._apply_decay_param_fun is not None and self._current_param_name is not None:
+            decay = self._apply_decay_param_fun(self._current_param_name)
+        if decay and self._wd:
+            master = slots.get("master_weight")
+            base = master if master is not None else p.astype(jnp.float32)
+            base = base * (1.0 - lr * self._wd)
+            if master is not None:
+                slots = dict(slots)
+                slots["master_weight"] = base
+                p = base.astype(p.dtype)
+            else:
+                p = base.astype(p.dtype)
+        return super()._update(p, g, slots, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {
+            "moment": jnp.zeros_like(p, jnp.float32),
+            "inf_norm": jnp.zeros_like(p, jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, slots, lr):
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * gf
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(gf) + self._epsilon)
+        b1p = slots["beta1_pow"] * self._beta1
+        new_p = (p.astype(jnp.float32) - (lr / (1 - b1p)) * m / u).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slots(self, p):
+        slots = {
+            "mean_square": jnp.zeros_like(p, jnp.float32),
+            "momentum": jnp.zeros_like(p, jnp.float32),
+        }
+        if self._centered:
+            slots["mean_grad"] = jnp.zeros_like(p, jnp.float32)
+        return slots
+
+    def _update(self, p, g, slots, lr):
+        gf = g.astype(jnp.float32)
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * gf * gf
+        new_slots = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * gf
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            new_slots["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * gf / denom
+        new_slots["momentum"] = mom
+        new_p = (p.astype(jnp.float32) - mom).astype(p.dtype)
+        return new_p, new_slots
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_slots(self, p):
+        return {
+            "avg_squared_grad": jnp.zeros_like(p, jnp.float32),
+            "avg_squared_update": jnp.zeros_like(p, jnp.float32),
+        }
+
+    def _update(self, p, g, slots, lr):
+        gf = g.astype(jnp.float32)
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * gf * gf
+        update = (
+            jnp.sqrt(slots["avg_squared_update"] + self._epsilon)
+            / jnp.sqrt(asg + self._epsilon)
+            * gf
+        )
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * update * update
+        new_p = (p.astype(jnp.float32) - lr * update).astype(p.dtype)
+        return new_p, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._current_param = None
+
+    def _init_slots(self, p):
+        return {
+            "moment1": jnp.zeros_like(p, jnp.float32),
+            "moment2": jnp.zeros_like(p, jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _regularized_grad_arr(self, p, g_arr):
+        self._current_param = p
+        return g_arr
+
+    def _update(self, p, g, slots, lr):
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m1 = self._beta1 * slots["moment1"] + (1 - self._beta1) * gf
+        m2 = self._beta2 * slots["moment2"] + (1 - self._beta2) * gf * gf
+        b1p = slots["beta1_pow"] * self._beta1
+        b2p = slots["beta2_pow"] * self._beta2
+        m1h = m1 / (1 - b1p)
+        m2h = m2 / (1 - b2p)
+        r = m1h / (jnp.sqrt(m2h) + self._epsilon)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._current_param is not None and self._exclude_fn(self._current_param):
+            wd = 0.0
+        update = r + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        u_norm = jnp.linalg.norm(update)
+        ratio = jnp.where(
+            (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0
+        )
+        new_p = (pf - lr * ratio * update).astype(p.dtype)
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8, momentum_decay=0.004, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_slots(self, p):
+        return {
+            "moment1": jnp.zeros_like(p, jnp.float32),
+            "moment2": jnp.zeros_like(p, jnp.float32),
+            "mu_prod": jnp.ones((), jnp.float32),
+            "step": jnp.zeros((), jnp.float32),
+        }
+
+    def _update(self, p, g, slots, lr):
+        gf = g.astype(jnp.float32)
+        t = slots["step"] + 1
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = slots["mu_prod"] * mu_t
+        m1 = self._beta1 * slots["moment1"] + (1 - self._beta1) * gf
+        m2 = self._beta2 * slots["moment2"] + (1 - self._beta2) * gf * gf
+        m1h = mu_t1 * m1 / (1 - mu_prod * mu_t1) + (1 - mu_t) * gf / (1 - mu_prod)
+        m2h = m2 / (1 - self._beta2**t)
+        new_p = (p.astype(jnp.float32) - lr * m1h / (jnp.sqrt(m2h) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment1": m1, "moment2": m2, "mu_prod": mu_prod, "step": t}
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {
+            "moment1": jnp.zeros_like(p, jnp.float32),
+            "moment2": jnp.zeros_like(p, jnp.float32),
+            "step": jnp.zeros((), jnp.float32),
+        }
+
+    def _update(self, p, g, slots, lr):
+        gf = g.astype(jnp.float32)
+        t = slots["step"] + 1
+        m1 = self._beta1 * slots["moment1"] + (1 - self._beta1) * gf
+        m2 = self._beta2 * slots["moment2"] + (1 - self._beta2) * gf * gf
+        m1h = m1 / (1 - self._beta1**t)
+        rho_inf = 2 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * t * self._beta2**t / (1 - self._beta2**t)
+        def _rect():
+            m2h = jnp.sqrt(m2 / (1 - self._beta2**t))
+            r = jnp.sqrt(
+                ((rho_t - 4) * (rho_t - 2) * rho_inf)
+                / ((rho_inf - 4) * (rho_inf - 2) * rho_t)
+            )
+            return r * m1h / (m2h + self._epsilon)
+
+        update = jnp.where(rho_t > 5.0, _rect(), m1h)
+        new_p = (p.astype(jnp.float32) - lr * update).astype(p.dtype)
+        return new_p, {"moment1": m1, "moment2": m2, "step": t}
+
+
+class ASGD(SGD):
+    pass
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50), parameters=None, etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_slots(self, p):
+        return {
+            "prev_grad": jnp.zeros_like(p, jnp.float32),
+            "lr": jnp.full(p.shape, float(self._learning_rate), jnp.float32),
+        }
+
+    def _update(self, p, g, slots, lr):
+        gf = g.astype(jnp.float32)
+        sign = jnp.sign(gf * slots["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_pos, jnp.where(sign < 0, self._eta_neg, 1.0))
+        new_lr = jnp.clip(slots["lr"] * factor, self._lr_min, self._lr_max)
+        new_p = (p.astype(jnp.float32) - new_lr * jnp.sign(gf)).astype(p.dtype)
+        return new_p, {"prev_grad": gf, "lr": new_lr}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure API (simplified two-loop recursion)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None, tolerance_grad=1e-07, tolerance_change=1e-09, history_size=100, line_search_fn=None, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._history_size = history_size
+        self._s_list = []
+        self._y_list = []
+        self._prev_flat_grad = None
+        self._prev_flat_param = None
+
+    def _flat(self, arrays):
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32) for a in arrays])
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the loss")
+        loss = closure()
+        params = [p for p in self._parameter_list if p.trainable and p.grad is not None]
+        flat_g = self._flat([p.grad._data for p in params])
+        flat_p = self._flat([p._data for p in params])
+        if self._prev_flat_grad is not None:
+            s = flat_p - self._prev_flat_param
+            y = flat_g - self._prev_flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_list.append(s)
+                self._y_list.append(y)
+                if len(self._s_list) > self._history_size:
+                    self._s_list.pop(0)
+                    self._y_list.pop(0)
+        q = flat_g
+        alphas = []
+        for s, y in zip(reversed(self._s_list), reversed(self._y_list)):
+            rho = 1.0 / jnp.dot(y, s)
+            alpha = rho * jnp.dot(s, q)
+            q = q - alpha * y
+            alphas.append((alpha, rho))
+        if self._y_list:
+            y_last, s_last = self._y_list[-1], self._s_list[-1]
+            q = q * (jnp.dot(s_last, y_last) / jnp.dot(y_last, y_last))
+        for (alpha, rho), s, y in zip(reversed(alphas), self._s_list, self._y_list):
+            beta = rho * jnp.dot(y, q)
+            q = q + (alpha - beta) * s
+        direction = -q
+        lr = self.get_lr()
+        self._prev_flat_grad = flat_g
+        self._prev_flat_param = flat_p
+        offset = 0
+        for p in params:
+            n = p.size
+            upd = direction[offset : offset + n].reshape(p._data.shape)
+            p._data = (p._data.astype(jnp.float32) + lr * upd).astype(p._data.dtype)
+            offset += n
+        return loss
+
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
+    "RMSProp", "Adadelta", "Lamb", "NAdam", "RAdam", "ASGD", "Rprop",
+    "LBFGS", "lr", "L1Decay", "L2Decay",
+]

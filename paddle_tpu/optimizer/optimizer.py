@@ -1,0 +1,192 @@
+"""Optimizer base (parity: python/paddle/optimizer/optimizer.py:128).
+
+Each optimizer is defined by two *pure* functions — ``_init_slots`` and
+``_update`` — used both by the eager ``step()`` loop and, unchanged, inside
+jit-compiled functional train steps (paddle_tpu.jit.TrainStep).  That single
+source of truth is the TPU-native replacement for the reference's per-device
+optimizer kernels (``phi/kernels/gpu/adam_kernel.cu`` etc.): XLA fuses the
+whole update into one kernel per parameter, or one fused loop when the step
+is jitted.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import framework
+from ..core.tensor import Tensor, Parameter
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        name=None,
+    ):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                # param groups
+                self._param_groups = parameters
+                flat = []
+                for g in parameters:
+                    flat.extend(g["params"])
+                parameters = flat
+            else:
+                self._param_groups = None
+        else:
+            self._param_groups = None
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        from .regularizer import L2Decay
+
+        if isinstance(weight_decay, float):
+            self.regularization = L2Decay(weight_decay)
+        else:
+            self.regularization = weight_decay
+        self._slots = {}  # id(param) -> {slot_name: jax array}
+        self._step_count = 0
+        self._name = name
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) else None
+
+    # -- functional core (override) ----------------------------------------
+    def _init_slots(self, param_array):
+        """Pure: initial slot dict for one parameter array."""
+        return {}
+
+    def _update(self, p, g, slots, lr):
+        """Pure: returns (new_p, new_slots)."""
+        raise NotImplementedError
+
+    # -- regularization ----------------------------------------------------
+    def _regularized_grad(self, p, g):
+        reg = getattr(p, "regularizer", None) or self.regularization
+        if reg is None:
+            return g
+        return reg._apply(p, g)
+
+    # -- eager step --------------------------------------------------------
+    @framework.no_grad()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise RuntimeError("Optimizer created without parameters")
+        params_grads = [
+            (p, p.grad) for p in params if p.trainable and p.grad is not None
+        ]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            g_arr = g._data if isinstance(g, Tensor) else g
+            g_arr = self._regularized_grad_arr(p, g_arr)
+            slots = self._slots.get(id(p))
+            if slots is None:
+                slots = self._init_slots(p._data)
+                self._slots[id(p)] = slots
+            p_lr = lr * p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else lr
+            new_p, new_slots = self._update(p._data, g_arr.astype(p._data.dtype), slots, p_lr)
+            p._data = new_p
+            self._slots[id(p)] = new_slots
+        self._step_count += 1
+
+    def _regularized_grad_arr(self, p, g_arr):
+        reg = getattr(p, "regularizer", None)
+        if reg is None:
+            reg = self.regularization
+        if reg is None:
+            return g_arr
+        return reg._apply_arr(p._data, g_arr)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    @framework.no_grad()
+    def clear_grad(self, set_to_zero=False):
+        if self._parameter_list is None:
+            return
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self):
+        state = {}
+        if self._parameter_list is not None:
+            for p in self._parameter_list:
+                slots = self._slots.get(id(p))
+                if not slots:
+                    continue
+                for slot_name, arr in slots.items():
+                    state[f"{p.name}_{slot_name}"] = Tensor(jnp.asarray(arr))
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        state["@step"] = self._step_count
+        return state
+
+    def set_state_dict(self, state_dict):
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        self._step_count = int(state_dict.get("@step", 0))
+        if self._parameter_list is None:
+            return
+        for p in self._parameter_list:
+            slots = self._slots.get(id(p))
+            if slots is None:
+                slots = self._init_slots(p._data)
+            for slot_name in list(slots.keys()):
+                key = f"{p.name}_{slot_name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    slots[slot_name] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            self._slots[id(p)] = slots
+
+    # -- functional step (jit path) ----------------------------------------
+    def functional_state(self, named_params):
+        """Initial slot pytree for a dict of name->array."""
+        return {name: self._init_slots(arr) for name, arr in named_params.items()}
+
+    def functional_update(self, params, grads, state, lr):
+        """Pure pytree update usable inside jax.jit. Returns (params, state)."""
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state[name]
+                continue
+            np_, ns_ = self._update(p, g.astype(p.dtype), state[name], lr)
+            new_params[name] = np_
+            new_state[name] = ns_
+        return new_params, new_state
+
+    def _sync_from_functional(self, named_params, state):
+        """Write back functional-step results into eager slots."""
+        for name, p in named_params.items():
+            self._slots[id(p)] = state[name]

@@ -1,0 +1,36 @@
+"""Regularizers (parity: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def _apply_arr(self, p_arr, g_arr):
+        raise NotImplementedError
+
+    def _apply(self, p, g):
+        from ..core.dispatch import apply_op
+
+        return apply_op(lambda pa, ga: self._apply_arr(pa, ga), p, g)
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def _apply_arr(self, p_arr, g_arr):
+        return g_arr + self.coeff * p_arr
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def _apply_arr(self, p_arr, g_arr):
+        return g_arr + self.coeff * jnp.sign(p_arr)
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"

@@ -1,0 +1,5 @@
+"""Mesh/topology/sharding machinery backing paddle_tpu.distributed.
+
+The user-facing API lives in paddle_tpu.distributed; this package holds the
+TPU-native internals (global mesh management, axis topology, sharding specs).
+"""

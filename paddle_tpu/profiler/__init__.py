@@ -1,0 +1,183 @@
+"""paddle.profiler over jax.profiler/XPlane (parity: python/paddle/profiler).
+
+The reference's CUPTI tracer + chrome export (SURVEY §5 tracing) maps to
+jax.profiler traces viewable in TensorBoard/Perfetto; RecordEvent maps to
+TraceAnnotation so host-side ranges appear in the device timeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        period = closed + ready + record
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+
+    return handler
+
+
+class Profiler:
+    """parity: profiler/profiler.py:89-341."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None, timer_only=False, record_shapes=False, profile_memory=False, with_flops=False):
+        self._targets = targets
+        self._scheduler = scheduler if callable(scheduler) else None
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo, repeat=1)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._export_dir = None
+        self._jax_active = False
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        self._last_step_t = time.perf_counter()
+        self._transition()
+        return self
+
+    def stop(self):
+        self._stop_jax()
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+        self._transition()
+
+    def _transition(self):
+        if self._scheduler is None:
+            self._start_jax()
+            return
+        state = self._scheduler(self._step)
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._start_jax()
+        else:
+            self._stop_jax()
+        self._state = state
+
+    def _start_jax(self):
+        if self._jax_active or self._timer_only:
+            return
+        try:
+            import jax
+
+            logdir = self._export_dir or os.path.join(os.getcwd(), "profiler_log")
+            os.makedirs(logdir, exist_ok=True)
+            jax.profiler.start_trace(logdir)
+            self._jax_active = True
+        except Exception:
+            pass
+
+    def _stop_jax(self):
+        if not self._jax_active:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._jax_active = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        if not self._step_times:
+            print("no steps recorded")
+            return
+        import numpy as np
+
+        times = np.asarray(self._step_times)
+        print(
+            f"steps: {len(times)}  mean: {times.mean()*1e3:.3f} ms  "
+            f"p50: {np.percentile(times, 50)*1e3:.3f} ms  "
+            f"p99: {np.percentile(times, 99)*1e3:.3f} ms"
+        )
+
+    def export(self, path, format="json"):
+        self._export_dir = path
+
+
+class RecordEvent:
+    """parity: paddle.profiler.RecordEvent → jax TraceAnnotation."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        try:
+            import jax
+
+            self._ctx = jax.profiler.TraceAnnotation(self.name)
+            self._ctx.__enter__()
+        except Exception:
+            self._ctx = None
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def load_profiler_result(filename):
+    raise NotImplementedError("use TensorBoard / Perfetto on the XPlane trace dir")

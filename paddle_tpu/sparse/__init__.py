@@ -1,0 +1,75 @@
+"""paddle.sparse — COO/CSR tensors over jax.experimental.sparse (BCOO).
+
+Parity target: python/paddle/sparse. XLA on TPU has no native sparse kernels;
+BCOO lowers to gather/scatter + dense matmul segments, matching the
+capability (not the kernel strategy) of phi/kernels/sparse.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+
+
+class SparseCooTensor(Tensor):
+    def __init__(self, indices, values, shape, coalesced=False):
+        from jax.experimental import sparse as jsparse
+
+        ind = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+        val = values._data if isinstance(values, Tensor) else jnp.asarray(values)
+        self._bcoo = jsparse.BCOO((val, ind.T), shape=tuple(shape))
+        super().__init__(self._bcoo.todense(), stop_gradient=True)
+        self._indices = Tensor(ind)
+        self._values = Tensor(val)
+
+    def indices(self):
+        return self._indices
+
+    def values(self):
+        return self._values
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def is_sparse_coo(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    if shape is None:
+        ind = np.asarray(indices.numpy() if isinstance(indices, Tensor) else indices)
+        shape = tuple(int(ind[i].max()) + 1 for i in range(ind.shape[0]))
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = np.stack([rows, cols_np])
+    return SparseCooTensor(Tensor(jnp.asarray(indices)), values, shape)
+
+
+def matmul(x, y, name=None):
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    from ..ops.linalg import matmul as _mm
+
+    return _mm(xd, yd)
+
+
+def add(x, y, name=None):
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return xd + yd
+
+
+def relu(x, name=None):
+    from ..nn.functional.activation import relu as _relu
+
+    if isinstance(x, SparseCooTensor):
+        return sparse_coo_tensor(x.indices(), _relu(x.values()), tuple(x.shape))
+    return _relu(x)

@@ -1,0 +1,47 @@
+"""paddle.static — compatibility surface.
+
+The reference's Program/Executor machinery (SURVEY §3.5) is replaced by
+jax.jit whole-graph compilation; this module keeps the commonly-used symbols
+(InputSpec, name scopes, io helpers) so static-style code imports cleanly.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(list(ndarray.shape), str(ndarray.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def save(layer, path, **kwargs):
+    from .. import jit
+
+    jit.save(layer, path, **kwargs)
+
+
+def load(path, **kwargs):
+    from .. import jit
+
+    return jit.load(path, **kwargs)

@@ -1,0 +1,32 @@
+"""paddle.utils (parity subset: flags, unique_name, deprecated helpers)."""
+from . import flags  # noqa: F401
+from . import unique_name  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is required but not installed")
+
+
+def run_check():
+    import jax
+
+    import paddle_tpu as paddle
+
+    x = paddle.ones([2, 2])
+    y = paddle.matmul(x, x)
+    assert float(y.sum().item()) == 8.0
+    devs = jax.devices()
+    print(f"paddle_tpu is installed successfully! devices: {devs}")
+
+
+class deprecated:
+    def __init__(self, update_to="", since="", reason="", level=0):
+        pass
+
+    def __call__(self, fn):
+        return fn

@@ -1,0 +1,79 @@
+"""Global FLAGS registry (parity: paddle/common/flags.h PD_DEFINE_* + python
+get_flags/set_flags).
+
+Flags are registered with type + default + help, can be set from env
+(``FLAGS_name``) or programmatically. A future native (C++) registry can slot
+in behind the same API (reference keeps flags in C++ for zero-overhead reads;
+here reads are python-side config lookups, not in the hot path because XLA
+compiles the hot path).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_registry = {}
+
+
+class Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name, default, help=""):
+        self.name = name
+        self.default = default
+        self.value = self._from_env(name, default)
+        self.type = type(default)
+        self.help = help
+
+    @staticmethod
+    def _from_env(name, default):
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is None:
+            return default
+        if isinstance(default, bool):
+            return env.lower() in ("1", "true", "yes")
+        if isinstance(default, int):
+            return int(env)
+        if isinstance(default, float):
+            return float(env)
+        return env
+
+
+def define_flag(name, default, help=""):
+    with _lock:
+        if name not in _registry:
+            _registry[name] = Flag(name, default, help)
+    return _registry[name]
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f[6:] if f.startswith("FLAGS_") else f
+        if key in _registry:
+            out[f] = _registry[key].value
+        else:
+            env = os.environ.get(f if f.startswith("FLAGS_") else f"FLAGS_{f}")
+            out[f] = env
+    return out
+
+
+def set_flags(flags_dict):
+    for k, v in flags_dict.items():
+        key = k[6:] if k.startswith("FLAGS_") else k
+        with _lock:
+            if key not in _registry:
+                _registry[key] = Flag(key, v)
+            else:
+                _registry[key].value = v
+
+
+# core flags mirrored from paddle/common/flags.cc (subset relevant on TPU)
+define_flag("check_nan_inf", False, "check nan/inf after every op (debug)")
+define_flag("benchmark", False, "synchronous timing mode")
+define_flag("use_pallas_kernels", True, "use Pallas kernels for fused ops on TPU")
+define_flag("allocator_strategy", "xla", "memory allocator strategy (XLA-managed)")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision")

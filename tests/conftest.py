@@ -1,0 +1,29 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's fake-backend strategy (SURVEY §4: custom_cpu plugin
+runs the distributed suite on CPU-only hosts) — XLA-CPU with
+xla_force_host_platform_device_count=8 is our fake multi-chip TPU.
+"""
+import os
+
+# Force CPU: the ambient env pins JAX_PLATFORMS=axon (the real-TPU tunnel),
+# which must not be touched from unit tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,247 @@
+"""Tape autograd engine tests (reference model: test/legacy_test
+imperative/autograd suites + OpTest.check_grad finite differences)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x_np, eps=1e-3):
+    """Central finite differences of scalar fn wrt x (float64)."""
+    x_np = x_np.astype(np.float64)
+    g = np.zeros_like(x_np)
+    it = np.nditer(x_np, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x_np.copy()
+        xp[idx] += eps
+        xm = x_np.copy()
+        xm[idx] -= eps
+        g[idx] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestBackwardBasics:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6], rtol=1e-6)
+
+    def test_two_uses_accumulate(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x + x * 3
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0], rtol=1e-6)
+
+    def test_broadcast_grad(self):
+        x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+        ((x + b) ** 2).sum().backward()
+        assert list(b.grad.shape) == [4]
+        np.testing.assert_allclose(b.grad.numpy(), [12.0] * 4, rtol=1e-5)
+
+    def test_matmul_grad_vs_numeric(self):
+        a_np = np.random.rand(3, 4).astype(np.float32)
+        b_np = np.random.rand(4, 2).astype(np.float32)
+        a = paddle.to_tensor(a_np, stop_gradient=False)
+        b = paddle.to_tensor(b_np, stop_gradient=False)
+        loss = paddle.matmul(a, b).sum()
+        loss.backward()
+        ng = numeric_grad(lambda ap: (ap @ b_np.astype(np.float64)).sum(), a_np)
+        np.testing.assert_allclose(a.grad.numpy(), ng, rtol=1e-2, atol=1e-3)
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x.detach() * 2
+        assert y.stop_gradient
+        z = x * 2 + y
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_backward_twice_raises_without_retain(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_non_scalar_backward_uses_ones(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+    def test_explicit_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+    def test_multi_output_op_grad(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+        parts = paddle.split(x, 2)
+        (parts[0].sum() * 2 + parts[1].sum()).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 1, 1, 1])
+
+    def test_int_output_no_grad_graph(self):
+        x = paddle.to_tensor([3.0, 1.0], stop_gradient=False)
+        idx = paddle.argmax(x)
+        assert idx.stop_gradient
+
+    def test_inplace_add_tracks_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.add_(paddle.to_tensor([5.0]))
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_clear_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        x.clear_grad()
+        assert x.grad is None
+
+
+class TestPaddleGrad:
+    def test_grad_api(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert x.grad is None  # only_inputs semantics
+
+    def test_grad_intermediate(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        h = x * 3
+        y = h * h
+        (gh,) = paddle.grad(y, h)
+        np.testing.assert_allclose(gh.numpy(), [12.0])
+
+    def test_grad_unused_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, z)
+        y2 = x * 2
+        (gz,) = paddle.grad(y2, [z], allow_unused=True)
+        assert gz is None
+
+    def test_create_graph_double_backward(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x * x  # y = x^3, y' = 3x^2, y'' = 6x
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [27.0], rtol=1e-5)
+        (ggx,) = paddle.grad(gx, x)
+        np.testing.assert_allclose(ggx.numpy(), [18.0], rtol=1e-5)
+
+    def test_grad_of_grad_sin(self):
+        x = paddle.to_tensor([0.5], stop_gradient=False)
+        (g1,) = paddle.grad(paddle.sin(x), x, create_graph=True)
+        (g2,) = paddle.grad(g1, x)
+        np.testing.assert_allclose(g2.numpy(), [-np.sin(0.5)], rtol=1e-5)
+
+
+class TestHooks:
+    def test_leaf_hook_scales_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        x.register_hook(lambda g: g * 10)
+        (x * 2).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+    def test_nonleaf_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        h = x * 2
+        seen = []
+        h.register_hook(lambda g: seen.append(g.numpy()) or g)
+        (h * 3).backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [3.0])
+
+    def test_hook_remove(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        handle = x.register_hook(lambda g: g * 10)
+        handle.remove()
+        (x * 2).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestPyLayer:
+    def test_custom_exp(self):
+        class Exp(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.exp(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                (y,) = ctx.saved_tensor
+                return dy * y
+
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = Exp.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [np.e], rtol=1e-5)
+
+    def test_pylayer_two_inputs(self):
+        class MulAdd(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b + a
+
+            @staticmethod
+            def backward(ctx, dy):
+                a, b = ctx.saved_tensor
+                return dy * (b + 1), dy * a
+
+        a = paddle.to_tensor([2.0], stop_gradient=False)
+        b = paddle.to_tensor([3.0], stop_gradient=False)
+        MulAdd.apply(a, b).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [4.0])
+        np.testing.assert_allclose(b.grad.numpy(), [2.0])
+
+
+class TestOpGradsNumeric:
+    @pytest.mark.parametrize(
+        "op,np_fn",
+        [
+            (lambda t: paddle.exp(t).sum(), lambda a: np.exp(a).sum()),
+            (lambda t: paddle.tanh(t).sum(), lambda a: np.tanh(a).sum()),
+            (lambda t: paddle.sqrt(t + 2).sum(), lambda a: np.sqrt(a + 2).sum()),
+            (lambda t: (t ** 3).sum(), lambda a: (a ** 3).sum()),
+            (lambda t: paddle.nn.functional.softmax(t).sum(axis=None), lambda a: _softmax_np(a).sum()),
+            (lambda t: paddle.mean(t * t), lambda a: (a * a).mean()),
+            (lambda t: paddle.concat([t, t * 2], axis=0).sum(), lambda a: np.concatenate([a, a * 2]).sum()),
+            (lambda t: t.reshape([6]).cumsum().sum(), lambda a: a.reshape(6).cumsum().sum()),
+        ],
+    )
+    def test_grad_matches_numeric(self, op, np_fn):
+        x_np = (np.random.rand(2, 3).astype(np.float32) + 0.1)
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        loss = op(x)
+        loss.backward()
+        ng = numeric_grad(np_fn, x_np)
+        np.testing.assert_allclose(x.grad.numpy(), ng, rtol=2e-2, atol=2e-3)
+
+
+def _softmax_np(a):
+    e = np.exp(a - a.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)

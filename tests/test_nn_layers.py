@@ -1,0 +1,224 @@
+"""nn.Layer infrastructure + layer forward tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestLayerInfra:
+    def test_parameters_registration(self):
+        l = nn.Linear(4, 3)
+        names = dict(l.named_parameters())
+        assert set(names) == {"weight", "bias"}
+        assert l.weight.shape == [4, 3]
+        assert not l.weight.stop_gradient
+
+    def test_sublayers_and_state_dict(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = net.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        assert len(net.parameters()) == 4
+
+    def test_set_state_dict_roundtrip(self):
+        l1 = nn.Linear(4, 3)
+        l2 = nn.Linear(4, 3)
+        l2.set_state_dict(l1.state_dict())
+        np.testing.assert_allclose(l1.weight.numpy(), l2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_buffers(self):
+        bn = nn.BatchNorm2D(3)
+        assert "_mean" in dict(bn.named_buffers())
+        assert "_mean" in bn.state_dict()
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        l(paddle.ones([1, 2]))
+        assert calls
+        h.remove()
+
+    def test_apply_and_children(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+        seen = []
+        net.apply(lambda m: seen.append(type(m).__name__))
+        assert seen.count("Linear") == 2
+
+    def test_layerlist_ops(self):
+        ll = nn.LayerList([nn.Linear(2, 2)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 2
+        assert isinstance(ll[1], nn.Linear)
+
+
+class TestLayerForward:
+    def test_linear(self):
+        l = nn.Linear(4, 3)
+        x = paddle.ones([2, 4])
+        y = l(x)
+        assert y.shape == [2, 3]
+        expect = np.ones((2, 4), np.float32) @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(y.numpy(), expect, rtol=1e-5)
+
+    def test_conv2d_shape(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        y = conv(paddle.ones([2, 3, 16, 16]))
+        assert y.shape == [2, 8, 8, 8]
+
+    def test_conv2d_vs_manual(self):
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        x = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3))
+        w = conv.weight.numpy()[0, 0]
+        y = conv(x).numpy()[0, 0]
+        xm = x.numpy()[0, 0]
+        expect = np.array(
+            [[(xm[i : i + 2, j : j + 2] * w).sum() for j in range(2)] for i in range(2)]
+        )
+        np.testing.assert_allclose(y, expect, rtol=1e-4)
+
+    def test_batchnorm_train_updates_stats(self):
+        bn = nn.BatchNorm2D(2, momentum=0.5)
+        x = paddle.to_tensor(np.random.rand(4, 2, 3, 3).astype(np.float32) * 5)
+        bn.train()
+        y = bn(x)
+        # output approx zero-mean unit-var per channel
+        yn = y.numpy()
+        assert abs(yn.mean()) < 1e-4
+        assert bn._mean.numpy().sum() != 0  # running stats updated
+
+    def test_batchnorm_eval_uses_running(self):
+        bn = nn.BatchNorm2D(2)
+        bn.eval()
+        x = paddle.ones([1, 2, 2, 2])
+        y = bn(x)
+        np.testing.assert_allclose(y.numpy(), x.numpy(), rtol=1e-3)
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+        y = ln(x).numpy()
+        np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        out = emb(paddle.to_tensor([[1, 2], [3, 4]]))
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_dropout_train_vs_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100])
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+        d.train()
+        y = d(x).numpy()
+        assert (y == 0).sum() > 10
+
+    def test_maxpool_avgpool(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2, 2)(x)
+        ap = nn.AvgPool2D(2, 2)(x)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_adaptive_pool(self):
+        x = paddle.ones([1, 3, 8, 8])
+        y = nn.AdaptiveAvgPool2D((2, 2))(x)
+        assert y.shape == [1, 3, 2, 2]
+
+    def test_activations(self):
+        x = paddle.to_tensor([-1.0, 0.0, 1.0])
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 1])
+        assert nn.GELU()(x).shape == [3]
+        s = nn.Softmax()(x).numpy()
+        np.testing.assert_allclose(s.sum(), 1.0, rtol=1e-6)
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.ones([2, 5, 16])
+        y = mha(x)
+        assert y.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        y = enc(paddle.ones([2, 5, 16]))
+        assert y.shape == [2, 5, 16]
+
+    def test_lstm(self):
+        lstm = nn.LSTM(4, 8)
+        out, (h, c) = lstm(paddle.ones([2, 3, 4]))
+        assert out.shape == [2, 3, 8]
+        assert h.shape == [1, 2, 8]
+
+    def test_grad_flows_through_layers(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        x = paddle.ones([2, 4])
+        loss = net(x).sum()
+        loss.backward()
+        for p in net.parameters():
+            assert p.grad is not None
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = paddle.to_tensor([[2.0, 1.0, 0.1]], stop_gradient=False)
+        label = paddle.to_tensor([0])
+        loss = nn.functional.cross_entropy(logits, label)
+        e = np.exp([2.0, 1.0, 0.1])
+        expect = -np.log(e[0] / e.sum())
+        np.testing.assert_allclose(loss.item(), expect, rtol=1e-5)
+        loss.backward()
+        assert logits.grad is not None
+
+    def test_cross_entropy_soft_label(self):
+        logits = paddle.to_tensor([[2.0, 1.0]])
+        soft = paddle.to_tensor([[0.7, 0.3]])
+        loss = nn.functional.cross_entropy(logits, soft, soft_label=True)
+        assert loss.item() > 0
+
+    def test_mse(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 2.0])
+        np.testing.assert_allclose(nn.functional.mse_loss(a, b).item(), 2.0)
+
+    def test_bce_with_logits(self):
+        z = paddle.to_tensor([0.0])
+        y = paddle.to_tensor([1.0])
+        np.testing.assert_allclose(
+            nn.functional.binary_cross_entropy_with_logits(z, y).item(),
+            np.log(2),
+            rtol=1e-6,
+        )
+
+    def test_kl_div(self):
+        lp = paddle.to_tensor(np.log([[0.5, 0.5]]).astype(np.float32))
+        t = paddle.to_tensor([[0.5, 0.5]])
+        np.testing.assert_allclose(nn.functional.kl_div(lp, t).item(), 0.0, atol=1e-7)
+
+
+class TestInitializers:
+    def test_constant(self):
+        l = nn.Linear(3, 3, weight_attr=paddle.ParamAttr(initializer=nn.initializer.Constant(0.5)))
+        assert (l.weight.numpy() == 0.5).all()
+
+    def test_normal_stats(self):
+        init = nn.initializer.Normal(0.0, 0.02)
+        arr = init._init_array([1000], "float32")
+        assert abs(float(np.asarray(arr).std()) - 0.02) < 0.005
+
+    def test_xavier_uniform_bound(self):
+        init = nn.initializer.XavierUniform()
+        arr = np.asarray(init._init_array([100, 100], "float32"))
+        bound = np.sqrt(6 / 200)
+        assert arr.max() <= bound + 1e-6
+        assert arr.min() >= -bound - 1e-6

@@ -1,0 +1,168 @@
+"""Optimizer + LR scheduler tests (reference model: test/legacy_test adam/sgd
+op tests + scheduler unit tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quadratic_param():
+    p = paddle.core.Parameter if False else None
+    w = paddle.to_tensor([5.0], stop_gradient=False)
+    return w
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize(
+        "make_opt",
+        [
+            lambda params: optimizer.SGD(0.1, parameters=params),
+            lambda params: optimizer.Momentum(0.1, parameters=params),
+            lambda params: optimizer.Adam(0.1, parameters=params),
+            lambda params: optimizer.AdamW(0.1, parameters=params),
+            lambda params: optimizer.RMSProp(0.1, parameters=params),
+            lambda params: optimizer.Adagrad(0.5, parameters=params),
+            lambda params: optimizer.Adamax(0.1, parameters=params),
+            lambda params: optimizer.Adadelta(1.0, parameters=params),
+            lambda params: optimizer.Lamb(0.01, parameters=params),
+            lambda params: optimizer.NAdam(0.1, parameters=params),
+            lambda params: optimizer.RAdam(0.1, parameters=params),
+        ],
+    )
+    def test_minimizes_quadratic(self, make_opt):
+        lin = nn.Linear(1, 1)
+        opt = make_opt(lin.parameters())
+        x = paddle.ones([8, 1])
+        target = paddle.zeros([8, 1])
+        first_loss = None
+        for _ in range(30):
+            loss = nn.functional.mse_loss(lin(x), target)
+            if first_loss is None:
+                first_loss = float(loss.item())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.item()) < first_loss
+
+    def test_sgd_exact_update(self):
+        w = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        from paddle_tpu.core.tensor import Parameter
+
+        p = Parameter(w._data)
+        opt = optimizer.SGD(0.5, parameters=[p])
+        (p * 3).backward()
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.5], rtol=1e-6)
+
+    def test_adam_first_step_matches_formula(self):
+        from paddle_tpu.core.tensor import Parameter
+        import jax.numpy as jnp
+
+        p = Parameter(jnp.asarray([1.0], jnp.float32))
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[p])
+        (p * 1.0).backward()  # grad = 1
+        opt.step()
+        # first adam step with g=1: update = lr * mhat / (sqrt(vhat) + eps) ≈ lr
+        np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-4)
+
+    def test_weight_decay_l2(self):
+        from paddle_tpu.core.tensor import Parameter
+        import jax.numpy as jnp
+
+        p = Parameter(jnp.asarray([1.0], jnp.float32))
+        opt = optimizer.SGD(0.1, parameters=[p], weight_decay=0.5)
+        (p * 0.0).backward()
+        opt.step()
+        # grad = 0 + wd*p = 0.5 → p = 1 - 0.1*0.5
+        np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-6)
+
+    def test_grad_clip_global_norm(self):
+        from paddle_tpu.core.tensor import Parameter
+        import jax.numpy as jnp
+
+        p = Parameter(jnp.asarray([1.0, 1.0], jnp.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = optimizer.SGD(1.0, parameters=[p], grad_clip=clip)
+        (p * 10.0).sum().backward()  # grad=[10,10], norm≈14.14
+        opt.step()
+        # clipped grad = 10/14.14... = 0.7071
+        np.testing.assert_allclose(p.numpy(), [1 - 0.70710678] * 2, rtol=1e-4)
+
+    def test_state_dict_roundtrip(self):
+        lin = nn.Linear(2, 2)
+        opt = optimizer.Adam(0.1, parameters=lin.parameters())
+        loss = lin(paddle.ones([1, 2])).sum()
+        loss.backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(0.1, parameters=lin.parameters())
+        opt2.set_state_dict(sd)
+        for p in lin.parameters():
+            np.testing.assert_allclose(
+                np.asarray(opt._slots[id(p)]["moment1"]),
+                np.asarray(opt2._slots[id(p)]["moment1"]),
+            )
+
+    def test_lbfgs_closure(self):
+        lin = nn.Linear(1, 1)
+        opt = optimizer.LBFGS(learning_rate=0.5, parameters=lin.parameters())
+        x = paddle.ones([4, 1])
+
+        losses = []
+        for _ in range(5):
+            def closure():
+                opt.clear_grad()
+                loss = nn.functional.mse_loss(lin(x), paddle.zeros([4, 1]))
+                loss.backward()
+                losses.append(float(loss.item()))
+                return loss
+
+            opt.step(closure)
+        assert losses[-1] <= losses[0]
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(round(s(), 6))
+            s.step()
+        assert vals[:2] == [0.1, 0.1]
+        assert vals[2] == pytest.approx(0.05)
+
+    def test_cosine(self):
+        s = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        s.step(10)
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_warmup(self):
+        s = optimizer.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        s.step(5)
+        assert s() == pytest.approx(0.05)
+        s.step(20)
+        assert s() == pytest.approx(0.1)
+
+    def test_noam(self):
+        s = optimizer.lr.NoamDecay(d_model=512, warmup_steps=100, learning_rate=1.0)
+        s.step(50)
+        v50 = s()
+        s.step(100)
+        v100 = s()
+        assert v100 > v50
+
+    def test_optimizer_uses_scheduler(self):
+        lin = nn.Linear(1, 1)
+        sched = optimizer.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+        opt = optimizer.SGD(sched, parameters=lin.parameters())
+        assert opt.get_lr() == pytest.approx(0.5)
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.05)
+
+    def test_reduce_on_plateau(self):
+        s = optimizer.lr.ReduceOnPlateau(1.0, patience=1, factor=0.1)
+        s.step(metrics=1.0)
+        s.step(metrics=1.0)
+        s.step(metrics=1.0)
+        assert s() == pytest.approx(0.1)

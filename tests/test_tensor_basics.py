@@ -1,0 +1,131 @@
+"""Tensor creation / metadata / host-interop tests (reference model:
+test/legacy_test tensor tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestCreation:
+    def test_to_tensor_from_list(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == paddle.float32
+        np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_to_tensor_int_defaults_int64(self):
+        t = paddle.to_tensor([1, 2, 3])
+        assert t.dtype == paddle.int64
+
+    def test_to_tensor_dtype(self):
+        t = paddle.to_tensor([1, 2], dtype="float16")
+        assert t.dtype == paddle.float16
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        f = paddle.full([2, 2], 7, dtype="int32")
+        assert f.dtype == paddle.int32
+        assert f.numpy().sum() == 28
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        assert paddle.arange(5).dtype == paddle.int64
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+        )
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+
+    def test_like_variants(self):
+        x = paddle.ones([2, 3], dtype="float32")
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.full_like(x, 5).numpy()[0, 0] == 5
+
+    def test_scalar_item(self):
+        t = paddle.to_tensor(3.5)
+        assert t.item() == pytest.approx(3.5)
+        assert float(t) == pytest.approx(3.5)
+
+    def test_repr(self):
+        t = paddle.ones([2])
+        assert "Tensor" in repr(t)
+
+
+class TestMeta:
+    def test_shape_ndim_size(self):
+        t = paddle.ones([2, 3, 4])
+        assert t.shape == [2, 3, 4]
+        assert t.ndim == 3
+        assert t.size == 24
+        assert t.numel() == 24
+
+    def test_astype(self):
+        t = paddle.ones([2]).astype("int64")
+        assert t.dtype == paddle.int64
+
+    def test_dtype_eq_string(self):
+        assert paddle.float32 == "float32"
+        assert paddle.float32 == np.float32
+        assert paddle.float32 != "int32"
+
+    def test_stop_gradient_default_true(self):
+        assert paddle.ones([1]).stop_gradient is True
+
+
+class TestIndexing:
+    def test_basic_slice(self):
+        x = paddle.arange(12).reshape([3, 4])
+        np.testing.assert_array_equal(x[1].numpy(), [4, 5, 6, 7])
+        np.testing.assert_array_equal(x[:, 1].numpy(), [1, 5, 9])
+        np.testing.assert_array_equal(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+
+    def test_tensor_index(self):
+        x = paddle.arange(10)
+        idx = paddle.to_tensor([1, 3, 5])
+        np.testing.assert_array_equal(x[idx].numpy(), [1, 3, 5])
+
+    def test_bool_mask(self):
+        x = paddle.arange(6)
+        mask = x > 3
+        np.testing.assert_array_equal(x[mask].numpy(), [4, 5])
+
+    def test_setitem(self):
+        x = paddle.zeros([3, 3])
+        x[1] = 5.0
+        assert x.numpy()[1].sum() == 15
+        x[0, 0] = paddle.to_tensor(2.0)
+        assert x.numpy()[0, 0] == 2
+
+    def test_iter(self):
+        rows = list(paddle.arange(6).reshape([2, 3]))
+        assert len(rows) == 2
+        np.testing.assert_array_equal(rows[1].numpy(), [3, 4, 5])
+
+
+class TestInplace:
+    def test_add_(self):
+        x = paddle.ones([2])
+        x.add_(paddle.ones([2]))
+        np.testing.assert_array_equal(x.numpy(), [2, 2])
+
+    def test_fill_zero_(self):
+        x = paddle.ones([2, 2])
+        x.fill_(3.0)
+        assert x.numpy().sum() == 12
+        x.zero_()
+        assert x.numpy().sum() == 0
+
+    def test_set_value(self):
+        x = paddle.ones([2, 2])
+        x.set_value(np.full((2, 2), 9, np.float32))
+        assert x.numpy().sum() == 36
+
+
+class TestSaveLoad:
+    def test_save_load_state(self, tmp_path):
+        obj = {"w": paddle.ones([2, 2]), "step": 3, "nested": [paddle.zeros([1])]}
+        p = str(tmp_path / "ckpt.pdparams")
+        paddle.save(obj, p)
+        loaded = paddle.load(p)
+        assert loaded["step"] == 3
+        np.testing.assert_array_equal(loaded["w"].numpy(), np.ones((2, 2)))
