@@ -6,15 +6,24 @@ xla_force_host_platform_device_count=8 is our fake multi-chip TPU.
 """
 import os
 
-# Force CPU: the ambient env pins JAX_PLATFORMS=axon (the real-TPU tunnel),
-# which must not be touched from unit tests.
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Force CPU: the ambient sitecustomize imports jax and pins platform=axon
+# (the real-TPU tunnel) before this conftest runs, so env vars alone are
+# too late — update jax.config directly (backends are created lazily, so
+# this is safe as long as nothing called jax.devices() yet).
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# Fail fast (and eagerly pin the CPU backend) rather than silently running
+# the suite over the real-TPU tunnel if a backend was already instantiated.
+assert jax.default_backend() == "cpu", jax.default_backend()
 
 import numpy as np
 import pytest
