@@ -1,45 +1,98 @@
-"""paddle.distributed — minimal bootstrap surface (full stack in progress).
+"""paddle.distributed — the TPU-native distributed stack.
 
-The TPU-native distributed design (SURVEY.md §5): no NCCL — the device mesh
-is the communicator. Collectives compile to XLA ops over ICI/DCN. This module
-currently provides the process/env surface; the collective API, fleet hybrid
-parallel, and auto_parallel land in paddle_tpu.distributed.* modules.
+Design (SURVEY §5 "Distributed communication backend"): no NCCL — the
+device mesh is the communicator. Collectives compile to XLA ops over
+ICI/DCN; process bootstrap is multi-controller jax.distributed; hybrid
+parallelism is a ProcessMesh with axes (pp, dp, sharding, sep, mp); the
+reference's ProcessGroup/comm-context/watchdog machinery
+(`process_group.h:48`, `comm_task_manager.h:37`) has no equivalent because
+compiled collectives cannot desynchronize — XLA sequences them.
+
+Submodules: `communication` (collective API), `auto_parallel` (DistTensor/
+ProcessMesh/shard_tensor/reshard), `fleet` (hybrid parallel),
+`parallel_step` (the compiled hybrid train step).
 """
 from __future__ import annotations
 
 import os
 
+from .communication import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    gather,
+    get_group,
+    get_rank,
+    get_world_size,
+    is_available,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream,
+    wait,
+)
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    TensorDistAttr,
+    dtensor_from_fn,
+    dtensor_from_local,
+    get_mesh,
+    reshard,
+    set_mesh,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    shard_activation,
+)
+from .parallel_step import (  # noqa: F401
+    ShardedTrainStep,
+    group_sharded_parallel,
+    shard_model_parameters,
+)
+from . import fleet  # noqa: F401
 
-def get_rank(group=None):
-    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+# fleet.mpu split op lives at paddle.distributed.split in the reference
+from .fleet.mpu import split  # noqa: F401
 
 
-def get_world_size(group=None):
-    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
+_initialized = [False]
 
 
 def is_initialized():
     return _initialized[0]
 
 
-_initialized = [False]
-
-
 def init_parallel_env():
-    """Multi-controller bootstrap over jax.distributed (single-proc no-op)."""
+    """Multi-controller bootstrap over jax.distributed (parallel.py:978).
+
+    Env contract matches the reference launcher: PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM, PADDLE_MASTER. Single process: no-op."""
     if _initialized[0]:
         return
     world = get_world_size()
     if world > 1 and "PADDLE_MASTER" in os.environ:
         import jax
 
-        coord = os.environ["PADDLE_MASTER"]
         jax.distributed.initialize(
-            coordinator_address=coord,
+            coordinator_address=os.environ["PADDLE_MASTER"],
             num_processes=world,
             process_id=get_rank(),
         )
     _initialized[0] = True
+    return ParallelEnv()
 
 
 class ParallelEnv:
@@ -54,3 +107,22 @@ class ParallelEnv:
     @property
     def device_id(self):
         return int(os.environ.get("FLAGS_selected_tpus", 0))
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+def spawn(func, args=(), nprocs=-1, join=True, **options):
+    """paddle.distributed.spawn parity (spawn.py:456).
+
+    TPU note: one jax process drives all local chips, so in-process "spawn"
+    over devices is the mesh itself; nprocs>1 real processes are only
+    meaningful multi-host, where the launcher (paddle_tpu.distributed.launch)
+    starts them. Here: run func once (the SPMD program covers all devices).
+    """
+    func(*args)
+
+
+def get_backend():
+    return "xla"

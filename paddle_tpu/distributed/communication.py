@@ -1,0 +1,388 @@
+"""paddle.distributed collective API — XLA collectives, no NCCL.
+
+Parity surface: `python/paddle/distributed/communication/*.py`
+(all_reduce/all_gather/reduce_scatter/broadcast/all_to_all/send/recv/
+scatter/gather/barrier) and `collective.py:194 new_group`.
+
+TPU-native design (SURVEY §5 "Distributed communication backend"): the
+device mesh IS the communicator. Each collective here is a tiny jit'd
+`shard_map` program over the participating devices — XLA lowers psum /
+all_gather / ppermute / all_to_all onto ICI/DCN. This replaces the whole
+ProcessGroupNCCL stack (`process_group_nccl.cc`): no comm contexts, no
+stream/task objects (XLA schedules), no watchdog (no hangs to watch —
+collectives are compiled into the step program).
+
+Eager semantics: the reference's eager collectives are SPMD — every rank
+calls `all_reduce(local_tensor)`. Here a "rank" is a device in the group's
+mesh. The eager path assembles the per-rank tensors into one stacked
+global array over the group axis, runs the compiled collective, and hands
+back this rank's view. Under a multi-controller deployment each process
+contributes its local shard via `make_array_from_process_local_data`; in
+single-controller tests all ranks live in one process (the reference tests
+the same way via its fake custom_cpu backend, SURVEY §4).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax import shard_map
+
+from ..core.tensor import Tensor
+
+P = PartitionSpec
+
+
+# ---------------------------------------------------------------------------
+# ReduceOp / groups
+# ---------------------------------------------------------------------------
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+    ReduceOp.AVG: lambda x, ax: jax.lax.pmean(x, ax),
+    ReduceOp.PROD: lambda x, ax: jnp.prod(jax.lax.all_gather(x, ax), axis=0),
+}
+
+
+@dataclass
+class Group:
+    """A communicator: an ordered list of global ranks bound to a 1-D device
+    mesh (axis name "g"). Parity: paddle.distributed.collective.Group."""
+
+    ranks: list
+    id: int = 0
+    _mesh: Optional[Mesh] = field(default=None, repr=False)
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def rank(self):
+        r = get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            devs = jax.devices()
+            self._mesh = Mesh(
+                np.array([devs[r % len(devs)] for r in self.ranks], dtype=object),
+                ("g",),
+            )
+        return self._mesh
+
+
+_default_group: Optional[Group] = None
+_group_counter = [0]
+
+
+def get_rank(group=None):
+    import os
+
+    if group is not None:
+        return group.rank
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+
+
+def get_world_size(group=None):
+    import os
+
+    if group is not None:
+        return group.nranks
+    n = os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE"))
+    if n is not None:
+        return int(n)
+    try:
+        return jax.process_count() if jax.process_count() > 1 else 1
+    except Exception:
+        return 1
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        n = max(get_world_size(), 1)
+        if n == 1:
+            n = len(jax.devices())
+        _default_group = Group(ranks=list(range(n)), id=0)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    _group_counter[0] += 1
+    if ranks is None:
+        ranks = list(range(get_world_size()))
+    g = Group(ranks=list(ranks), id=_group_counter[0])
+    _groups_by_id[g.id] = g
+    return g
+
+
+_groups_by_id = {}
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _get_default_group()
+    return _groups_by_id.get(gid)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None or group is _default_group:
+        _default_group = None
+
+
+def is_available():
+    return True
+
+
+# ---------------------------------------------------------------------------
+# eager collective execution
+# ---------------------------------------------------------------------------
+def _collective_1d(group: Group, fn, x, extra_specs=()):
+    """Run `fn(local_block)` as a shard_map over the group's 1-D mesh, with
+    the input stacked along a leading group axis."""
+    mesh = group.mesh
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P("g"),) + tuple(extra_specs),
+        out_specs=P("g"),
+        check_vma=False,
+    )(x)
+
+
+def _stack_ranks(tensors):
+    """Stack per-rank payloads into [nranks, ...] (single-controller path)."""
+    return jnp.stack([t._data for t in tensors], axis=0)
+
+
+def _this_rank_view(group, stacked, rank=None):
+    r = rank if rank is not None else max(group.rank, 0)
+    return stacked[r]
+
+
+def _is_dist_multiprocess():
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all_reduce of this rank's tensor across the group."""
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        return tensor
+    red = _REDUCERS[op]
+    if _is_dist_multiprocess():
+        # multi-controller: every process holds a same-shape local tensor;
+        # reduce across the process dimension via a global-array psum.
+        stacked = _global_stack(tensor, group)
+    else:
+        stacked = jnp.broadcast_to(tensor._data, (group.nranks,) + tuple(tensor.shape))
+
+    def _ar(block):
+        return red(block, "g")
+
+    out = _collective_1d(group, _ar, stacked)
+    tensor._data = _this_rank_view(group, out)
+    return tensor
+
+
+def _global_stack(tensor, group):
+    """Assemble [nranks, ...] global array from per-process local tensors."""
+    sharding = NamedSharding(group.mesh, P("g"))
+    local = np.asarray(tensor._data)[None]
+    return jax.make_array_from_process_local_data(
+        sharding, local, (group.nranks,) + local.shape[1:]
+    )
+
+
+def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True, axis=0):
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        tensor_list.append(Tensor(tensor._data))
+        return tensor_list
+    if _is_dist_multiprocess():
+        from jax.experimental import multihost_utils
+
+        out = np.asarray(multihost_utils.process_allgather(np.asarray(tensor._data)))
+    else:
+        out = np.broadcast_to(
+            np.asarray(tensor._data), (group.nranks,) + tuple(tensor.shape)
+        )
+    for r in range(group.nranks):
+        tensor_list.append(Tensor(jnp.asarray(out[r])))
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    import pickle
+
+    group = group or _get_default_group()
+    if group.nranks <= 1 or not _is_dist_multiprocess():
+        object_list.append(obj)
+        return object_list
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.broadcast_one_to_all(
+        np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    )
+    object_list.append(pickle.loads(bytes(gathered)))
+    return object_list
+
+
+def reduce(tensor: Tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = group or _get_default_group()
+    all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+    return tensor
+
+
+def broadcast(tensor: Tensor, src, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        return tensor
+    if _is_dist_multiprocess():
+        from jax.experimental import multihost_utils
+
+        root = group.get_group_rank(src)
+        val = multihost_utils.broadcast_one_to_all(
+            np.asarray(tensor._data), is_source=(group.rank == root)
+        )
+        tensor._data = jnp.asarray(val)
+    return tensor
+
+
+def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Each rank contributes `tensor_list` (n tensors); rank r receives the
+    cross-rank reduction of everyone's slot r. Single-controller simulation
+    mirrors all_reduce: every "rank" holds the same inputs, so slot r sums
+    to n * tensor_list[r]."""
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        tensor._data = tensor_list[0]._data
+        return tensor
+    cat = jnp.stack([t._data for t in tensor_list], 0)  # this rank: [n, ...]
+    if _is_dist_multiprocess():
+        g = _global_stack(Tensor(cat), group)  # [nprocs, n, ...]
+    else:
+        g = jnp.broadcast_to(cat, (group.nranks,) + tuple(cat.shape))
+
+    def _rs(block):  # block: [1, n, ...] -> this rank's reduced shard
+        red = jax.lax.psum(block[0], "g")  # [n, ...]
+        idx = jax.lax.axis_index("g")
+        return jax.lax.dynamic_slice_in_dim(red, idx, 1, 0)
+
+    out = _collective_1d(group, _rs, g)  # [n, ...], row r = rank r's result
+    tensor._data = _this_rank_view(group, out)
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """SPMD all_to_all. Single-controller simulation: all ranks hold the same
+    inputs, so rank r's output list is [in[r]] * n — consistent with the
+    degenerate all_reduce/reduce_scatter semantics above."""
+    group = group or _get_default_group()
+    n = group.nranks
+    if n <= 1 or not _is_dist_multiprocess():
+        r = max(group.rank, 0)
+        src_t = in_tensor_list[min(r, len(in_tensor_list) - 1)]
+        out_tensor_list.extend(Tensor(src_t._data) for _ in range(max(n, 1)))
+        return out_tensor_list
+    cat = jnp.stack([t._data for t in in_tensor_list], 0)
+    g = _global_stack(Tensor(cat), group)  # [nprocs, n, ...]
+
+    def _a2a(block):  # local [1, n, ...] -> local [n, 1, ...]: dim0 = source
+        return jax.lax.all_to_all(block, "g", split_axis=1, concat_axis=0)
+
+    mesh = group.mesh
+    out = shard_map(
+        _a2a, mesh=mesh, in_specs=(P("g"),), out_specs=P(None, "g"), check_vma=False
+    )(g)  # global [n, n, ...]; out[:, r] = rank r's received list
+    row = np.asarray(out[:, max(group.rank, 0)])
+    for i in range(n):
+        out_tensor_list.append(Tensor(jnp.asarray(row[i])))
+    return out_tensor_list
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if tensor_list:
+        tensor._data = tensor_list[max(group.rank, 0)]._data
+    return tensor
+
+
+def gather(tensor: Tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if gather_list is not None:
+        return all_gather(gather_list, tensor, group=group)
+    return tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager send/recv is not supported: point-to-point transfers compile "
+        "to lax.ppermute inside jit'd programs (see "
+        "paddle_tpu.distributed.pipeline for the schedule that uses them)"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager send/recv is not supported: point-to-point transfers compile "
+        "to lax.ppermute inside jit'd programs (see "
+        "paddle_tpu.distributed.pipeline for the schedule that uses them)"
+    )
+
+
+def barrier(group=None):
+    if _is_dist_multiprocess():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor._data.block_until_ready()
+
+
+# stream namespace parity (communication/stream/*)
+class _StreamNS:
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    alltoall = staticmethod(all_to_all)
+    scatter = staticmethod(scatter)
+    reduce = staticmethod(reduce)
+
+
+stream = _StreamNS()

