@@ -1,0 +1,90 @@
+"""Headline benchmark: decoder-only (GPT/LLaMA-style) pretrain throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no absolute numbers (BASELINE.md), so vs_baseline
+reports achieved model FLOPs utilisation (MFU) against the chip peak —
+a hardware-normalised stand-in the driver can track across rounds.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    import paddle_tpu.nn.functional as F
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_seq_len=2048, dropout=0.0,
+                        dtype="bfloat16", recompute=True)
+        batch, seq, steps = 4, 2048, 10
+    else:  # smoke path for CPU dev runs
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=256, dropout=0.0)
+        batch, seq, steps = 2, 128, 3
+
+    with paddle.amp.auto_cast(enable=on_tpu, dtype="bfloat16", level="O2"):
+        model = GPTForCausalLM(cfg)
+    if on_tpu:
+        for _, p in model.named_parameters():
+            p._data = p._data.astype(jax.numpy.bfloat16)
+
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4, parameters=model.parameters())
+
+    def train_fn(ids, labels):
+        logits = model(ids)
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]).astype("float32"),
+            labels.reshape([-1]),
+        )
+
+    step = TrainStep(model, train_fn, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+
+    loss = step(ids, labels)  # compile + warmup
+    _ = float(loss.numpy())
+    loss = step(ids, labels)
+    _ = float(loss.numpy())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    _ = float(loss.numpy())  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+
+    # MFU: 6 * params * tokens/sec / peak_flops
+    n_params = sum(int(np.prod(p.shape)) for _, p in model.named_parameters())
+    model_flops = 6.0 * n_params * tokens_per_sec
+    kind = jax.devices()[0].device_kind.lower()
+    peak = (459e12 if "v5p" in kind or "v5" == kind else
+            197e12 if "v5e" in kind or "v5 lite" in kind else
+            275e12 if "v4" in kind else
+            918e12 if "v6" in kind or "trillium" in kind else
+            197e12) if on_tpu else 1e12  # bf16 peak per chip
+    mfu = model_flops / peak
+
+    print(json.dumps({
+        "metric": "gpt_pretrain_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

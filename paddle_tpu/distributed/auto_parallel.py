@@ -302,12 +302,20 @@ def reshard(x: Tensor, mesh: ProcessMesh, placements):
     _check_placements(x, mesh, placements)
     has_partial = any(isinstance(p, Partial) for p in (
         x._dist_attr.placements if x._dist_attr else []))
-    arr = x._data
-    if has_partial:
-        # eager partial -> materialise the pending sum across the partial axes
-        arr = _resolve_partial(arr, x._dist_attr)
-    arr = jax.device_put(arr, _named_sharding(mesh, placements))
-    out = Tensor(arr, stop_gradient=x.stop_gradient)
+    src_attr = x._dist_attr
+    sharding = _named_sharding(mesh, placements)
+
+    def _move(arr):
+        if has_partial:
+            # eager partial -> materialise the pending sum across partial axes
+            arr = _resolve_partial(arr, src_attr)
+        return jax.device_put(arr, sharding)
+
+    # dispatch through the tape so resharding an activation keeps gradients
+    from ..core.dispatch import apply_op
+
+    out = apply_op(_move, x, _op_name="reshard")
+    out.stop_gradient = x.stop_gradient
     out._dist_attr = TensorDistAttr(mesh, placements)
     return out
 

@@ -233,28 +233,38 @@ def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True, axis=0):
         from jax.experimental import multihost_utils
 
         out = np.asarray(multihost_utils.process_allgather(np.asarray(tensor._data)))
+        ranks = group.ranks  # select the group's members from the world gather
     else:
         out = np.broadcast_to(
             np.asarray(tensor._data), (group.nranks,) + tuple(tensor.shape)
         )
-    for r in range(group.nranks):
+        ranks = range(group.nranks)
+    for r in ranks:
         tensor_list.append(Tensor(jnp.asarray(out[r])))
     return tensor_list
 
 
 def all_gather_object(object_list, obj, group=None):
+    """Gather one picklable object per rank into `object_list` (len == nranks)."""
     import pickle
 
     group = group or _get_default_group()
-    if group.nranks <= 1 or not _is_dist_multiprocess():
-        object_list.append(obj)
+    if not _is_dist_multiprocess():
+        # single-controller SPMD: every "rank" holds the same object
+        object_list.extend(obj for _ in range(group.nranks))
         return object_list
     from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.broadcast_one_to_all(
-        np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    )
-    object_list.append(pickle.loads(bytes(gathered)))
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # pad to a common length so process_allgather sees uniform shapes
+    size = np.asarray([payload.size])
+    sizes = np.asarray(multihost_utils.process_allgather(size)).reshape(-1)
+    buf = np.zeros(int(sizes.max()), np.uint8)
+    buf[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(buf[None]))
+    gathered = gathered.reshape(-1, buf.size)
+    for r in group.ranks:
+        object_list.append(pickle.loads(bytes(gathered[r][: int(sizes[r])])))
     return object_list
 
 

@@ -131,6 +131,8 @@ def distributed_optimizer(optimizer, strategy=None):
     return optimizer
 
 
+from .utils import recompute  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
 from .mpu import (  # noqa: E402,F401
     ColumnParallelLinear,
     ParallelCrossEntropy,

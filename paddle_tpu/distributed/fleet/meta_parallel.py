@@ -43,8 +43,10 @@ class SharedLayerDesc(LayerDesc):
 
 class PipelineLayer(Layer):
     """Parity: pp_layers.py:258. Builds all LayerDescs and partitions them
-    into `num_stages` segments; under SPMD every segment's params carry a
-    "pp"-axis placement (stage s's params live on pp coordinate s)."""
+    into `num_stages` segments (`_stage_bounds`). Execution currently runs
+    the straight-line correctness path (all params replicated over "pp");
+    compiled stage placement + microbatch scheduling is provided by
+    `paddle_tpu.distributed.pipeline` for models that opt in."""
 
     def __init__(
         self,

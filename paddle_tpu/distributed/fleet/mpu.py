@@ -43,11 +43,11 @@ def _annotate(param, tensor_dim):
     return param
 
 
-def _seq_spec(mesh, batch_dims=1):
-    """PartitionSpec sharding the sequence dim (after batch dims) over mp."""
-    from jax.sharding import PartitionSpec
+def _replicate_spec(mesh):
+    """Spec for gather_output: batch stays on data axes, rest replicated."""
+    from .utils.sequence_parallel_utils import _spec
 
-    return PartitionSpec(*([None] * batch_dims + ["mp"]))
+    return _spec(mesh, None)
 
 
 class VocabParallelEmbedding(Layer):
@@ -78,10 +78,12 @@ class ColumnParallelLinear(Layer):
         gather_output=True,
         fuse_matmul_bias=False,
         mp_group=None,
+        sequence_parallel=False,
         name=None,
     ):
         super().__init__()
         self.gather_output = gather_output
+        self.sequence_parallel = sequence_parallel
         self.weight = _annotate(
             self.create_parameter([in_features, out_features], attr=weight_attr),
             tensor_dim=1,
@@ -93,13 +95,17 @@ class ColumnParallelLinear(Layer):
         )
 
     def forward(self, x):
+        if self.sequence_parallel:
+            # incoming activation is seq-sharded over mp; constraining the
+            # matmul input to seq-replicated makes XLA emit the SP all_gather
+            from .utils import sequence_parallel_utils as spu
+
+            x = spu.all_gather(x)
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             mesh = get_fleet_mesh()
             if mesh is not None:
-                out = shard_activation(
-                    out, [Replicate() for _ in mesh.dim_names], mesh=mesh
-                )
+                out = shard_activation(out, mesh=mesh, spec=_replicate_spec(mesh))
         return out
 
 
@@ -116,10 +122,12 @@ class RowParallelLinear(Layer):
         input_is_parallel=False,
         fuse_matmul_bias=False,
         mp_group=None,
+        sequence_parallel=False,
         name=None,
     ):
         super().__init__()
         self.input_is_parallel = input_is_parallel
+        self.sequence_parallel = sequence_parallel
         self.weight = _annotate(
             self.create_parameter([in_features, out_features], attr=weight_attr),
             tensor_dim=0,
@@ -127,7 +135,15 @@ class RowParallelLinear(Layer):
         self.bias = self.create_parameter([out_features], is_bias=True) if has_bias else None
 
     def forward(self, x):
-        return F.linear(x, self.weight, self.bias)
+        out = F.linear(x, self.weight, self.bias)
+        if self.sequence_parallel:
+            # constrain the mp-partial output to seq-sharded: XLA lowers the
+            # pending sum + seq split to one reduce_scatter (Megatron-SP bwd
+            # of the gather, sequence_parallel_utils.py:564)
+            from .utils import sequence_parallel_utils as spu
+
+            out = spu.reduce_scatter(out)
+        return out
 
 
 class ParallelCrossEntropy(Layer):
@@ -182,9 +198,13 @@ class RNGStatesTracker:
     @contextlib.contextmanager
     def rng_state(self, name="model-parallel-rng"):
         if name not in self.states_:
+            import zlib
+
             import jax
 
-            self.states_[name] = jax.random.key(hash(name) & 0x7FFFFFFF)
+            # stable digest: hash() is salted per-process and would give
+            # multi-controller processes divergent dropout streams
+            self.states_[name] = jax.random.key(zlib.crc32(name.encode()))
         import jax
 
         key = self.states_[name]

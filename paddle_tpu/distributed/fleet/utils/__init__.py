@@ -1,0 +1,91 @@
+"""fleet.utils — recompute (activation checkpointing) and helpers.
+
+Parity: `python/paddle/distributed/fleet/utils/__init__.py` (recompute),
+`python/paddle/distributed/fleet/recompute/recompute.py`.
+
+TPU-native: the reference saves/restores RNG state and re-runs forward in
+backward by hand; here recompute is `jax.checkpoint` — XLA rematerialises
+the segment during the backward pass, trading FLOPs for HBM. Works on both
+execution paths: under `jit`/`TrainStep` the remat annotation rides the
+whole-graph trace; in eager mode the checkpointed segment is recorded as a
+single tape op whose VJP rematerialises.
+"""
+from __future__ import annotations
+
+from contextlib import nullcontext
+
+import jax
+from jax import tree_util
+
+from .... import framework
+from ....core.dispatch import apply_op, _is_tensor
+from ....core.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    """Checkpoint `function(*args, **kwargs)`: don't store its activations.
+
+    `function` should be a Layer (or a bound method of one) so its parameters
+    are threaded through explicitly and receive gradients on the eager tape.
+    Plain closures still work under the jit path (jax remat differentiates
+    through closed-over tracers) but lose eager-tape param grads.
+    """
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+
+    from ....nn.layer.layers import Layer
+
+    if isinstance(function, Layer):
+        layer, call = function, function
+    else:
+        layer = getattr(function, "__self__", None)
+        layer = layer if isinstance(layer, Layer) else None
+        call = function
+
+    entries = layer.state_dict() if layer is not None else {}
+    names = list(entries)
+
+    leaves, treedef = tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    tpos = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+
+    def run(state_arrays, tensor_arrays):
+        buf = list(leaves)
+        for p, a in zip(tpos, tensor_arrays):
+            buf[p] = Tensor(a)
+        a2, k2 = tree_util.tree_unflatten(treedef, buf)
+        ctx = (
+            layer._swap_state(dict(zip(names, state_arrays)))
+            if layer is not None
+            else nullcontext()
+        )
+        with ctx, framework.no_grad():
+            out = call(*a2, **k2)
+        return tree_util.tree_map(
+            lambda t: t._data if _is_tensor(t) else t,
+            out,
+            is_leaf=_is_tensor,
+        )
+
+    ckpt = jax.checkpoint(run)
+    state_tensors = [entries[n] for n in names]
+    tensor_args = [leaves[i] for i in tpos]
+    return apply_op(ckpt, state_tensors, tensor_args, _op_name="recompute")
+
+
+class LocalFS:
+    """Parity stub: fleet.utils.LocalFS (file-system helper)."""
+
+    def ls_dir(self, path):
+        import os
+
+        return [], os.listdir(path) if os.path.isdir(path) else []
+
+    def is_exist(self, path):
+        import os
+
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        import os
+
+        os.makedirs(path, exist_ok=True)

@@ -1,0 +1,129 @@
+"""Megatron-style sequence parallelism (parity:
+`fleet/utils/sequence_parallel_utils.py:85-137,429,564`).
+
+The reference implements SP with explicit PyLayers (ScatterOp/GatherOp/
+AllGatherOp/ReduceScatterOp) around Column/RowParallelLinear. TPU-native
+redesign: SP is a *sharding pattern*, not hand-written collectives — the
+non-matmul region keeps activations sharded over the sequence dim on the
+"mp" axis; constraining the matmul input to seq-replicated makes XLA emit
+the all_gather, and constraining the row-output to seq-sharded turns the
+partial-sum into a reduce_scatter. Same comm volume as Megatron-SP, but
+scheduled/fused by XLA and overlapped over ICI.
+
+Layout convention: activations are [batch, seq, hidden] (the reference's SP
+utils assume [s, b, h]; batch-major is the TPU/GSPMD-friendly layout, and
+paddle_tpu TP layers are batch-major throughout).
+"""
+from __future__ import annotations
+
+from ...auto_parallel import shard_activation
+from .. import get_fleet_mesh
+
+
+def _data_axes(mesh):
+    return tuple(
+        a for a in ("dp", "sharding", "sep")
+        if a in mesh.dim_names and mesh.get_dim_size(a) > 1
+    )
+
+
+def _spec(mesh, seq):
+    """PartitionSpec for [batch, seq, ...]: batch over data axes, seq per arg."""
+    from jax.sharding import PartitionSpec
+
+    d = _data_axes(mesh)
+    return PartitionSpec(d if d else None, seq)
+
+
+def _mp_active(mesh):
+    return mesh is not None and "mp" in mesh.dim_names and mesh.get_dim_size("mp") > 1
+
+
+def scatter(x, axis=1):
+    """ScatterOp: split the sequence dim over mp (identity bwd = gather)."""
+    mesh = get_fleet_mesh()
+    if not _mp_active(mesh):
+        return x
+    return shard_activation(x, mesh=mesh, spec=_spec(mesh, "mp"))
+
+
+def all_gather(x, axis=1):
+    """GatherOp/AllGatherOp: materialise the full sequence dim."""
+    mesh = get_fleet_mesh()
+    if not _mp_active(mesh):
+        return x
+    return shard_activation(x, mesh=mesh, spec=_spec(mesh, None))
+
+
+def reduce_scatter(x, axis=1):
+    """ReduceScatterOp: resolve an mp-partial sum directly into seq shards."""
+    mesh = get_fleet_mesh()
+    if not _mp_active(mesh):
+        return x
+    return shard_activation(x, mesh=mesh, spec=_spec(mesh, "mp"))
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x, axis=1):
+        return scatter(x, axis)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, axis=1):
+        return all_gather(x, axis)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x):
+        return all_gather(x)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x):
+        return reduce_scatter(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Parity: sequence_parallel_utils.py:148 — marks params whose grads the
+    reference must all-reduce over mp by hand (LayerNorm params in the SP
+    region). Under GSPMD those params are replicated and their grads are
+    reduced by the compiler, so this is metadata only."""
+    parameter.sequence_parallel = True
+    return parameter
+
+
+def create_fused_allreduce_gradient_hooks(model, accumulation_steps=1):
+    """No-op under GSPMD: gradient reduction is compiled into the step."""
+    return []
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """No-op under GSPMD (see mark_as_sequence_parallel_parameter)."""
+    return None
+
+
+def is_fused_matmul_bias_supported():
+    return True
+
+
+class ColumnSequenceParallelLinear:
+    """Constructed via fleet.mpu.ColumnParallelLinear(sequence_parallel=True)."""
+
+    def __new__(cls, in_features, out_features, **kwargs):
+        from ..mpu import ColumnParallelLinear
+
+        kwargs["sequence_parallel"] = True
+        return ColumnParallelLinear(in_features, out_features, **kwargs)
+
+
+class RowSequenceParallelLinear:
+    def __new__(cls, in_features, out_features, **kwargs):
+        from ..mpu import RowParallelLinear
+
+        kwargs["sequence_parallel"] = True
+        return RowParallelLinear(in_features, out_features, **kwargs)

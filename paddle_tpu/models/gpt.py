@@ -35,6 +35,7 @@ class GPTConfig:
         dropout=0.0,
         tie_embeddings=True,
         dtype="float32",
+        recompute=False,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -51,6 +52,7 @@ class GPTConfig:
         self.dropout = dropout
         self.tie_embeddings = tie_embeddings
         self.dtype = dtype
+        self.recompute = recompute
 
 
 def llama_config(size="7b", **overrides):
@@ -149,8 +151,14 @@ class GPTModel(nn.Layer):
         if not self.config.rope:
             pos = paddle.arange(input_ids.shape[1])
             x = x + self.embed_pos(pos)
-        for layer in self.layers:
-            x = layer(x, attn_mask)
+        if self.config.recompute:
+            from paddle_tpu.distributed.fleet.utils import recompute
+
+            for layer in self.layers:
+                x = recompute(layer, x, attn_mask)
+        else:
+            for layer in self.layers:
+                x = layer(x, attn_mask)
         return self.final_norm(x)
 
 

@@ -1,0 +1,108 @@
+"""Recompute (activation checkpointing) + sequence parallel tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestRecompute:
+    def _grads(self, recompute):
+        paddle.seed(11)
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=2,
+                        num_heads=2, max_seq_len=16, recompute=recompute)
+        m = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(3)
+        ids = paddle.to_tensor(rng.integers(0, 32, (2, 8)).astype(np.int32))
+        labels = paddle.to_tensor(rng.integers(0, 32, (2, 8)).astype(np.int64))
+        loss = m.loss(ids, labels)
+        loss.backward()
+        return float(loss.numpy()), {
+            n: np.asarray(p.grad.numpy())
+            for n, p in m.named_parameters() if p.grad is not None
+        }
+
+    def test_eager_grad_parity(self):
+        l0, g0 = self._grads(False)
+        l1, g1 = self._grads(True)
+        assert abs(l0 - l1) < 1e-5
+        assert set(g0) == set(g1) and len(g0) > 0
+        for n in g0:
+            np.testing.assert_allclose(g0[n], g1[n], rtol=1e-4, atol=1e-5, err_msg=n)
+
+    def test_jit_trainstep_with_recompute(self):
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=2,
+                        num_heads=2, max_seq_len=16, recompute=True)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        step = TrainStep(m, lambda i, l: m.loss(i, l), opt)
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(0, 32, (2, 8)).astype(np.int32))
+        labels = paddle.to_tensor(rng.integers(0, 32, (2, 8)).astype(np.int64))
+        losses = [float(step(ids, labels).numpy()) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_recompute_plain_layer(self):
+        from paddle_tpu.distributed.fleet.utils import recompute
+
+        paddle.seed(5)
+        lin = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        x.stop_gradient = False
+        y = recompute(lin, x)
+        y2 = lin(x)
+        np.testing.assert_allclose(y.numpy(), y2.numpy(), rtol=1e-6)
+        y.sum().backward()
+        assert lin.weight.grad is not None
+        assert x.grad is not None
+
+
+class TestSequenceParallel:
+    def test_sp_matches_dense(self):
+        from paddle_tpu.distributed import fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+
+        paddle.seed(1)
+        col = fleet.ColumnParallelLinear(16, 32, gather_output=False,
+                                         has_bias=False, sequence_parallel=True)
+        row = fleet.RowParallelLinear(32, 16, input_is_parallel=True,
+                                      has_bias=False, sequence_parallel=True)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 8, 16)).astype(np.float32))
+        from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as spu
+
+        xs = spu.scatter(x)
+        out = row(F.relu(col(xs)))
+        out_full = spu.all_gather(out)
+        # dense reference
+        ref = np.maximum(x.numpy() @ col.weight.numpy(), 0) @ row.weight.numpy()
+        np.testing.assert_allclose(out_full.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestDistFixes:
+    def test_all_gather_object_length(self):
+        g = dist.new_group(list(range(4)))
+        objs = []
+        dist.all_gather_object(objs, {"rank": "meta"}, group=g)
+        assert len(objs) == 4
+
+    def test_reshard_keeps_grad(self):
+        mesh = dist.ProcessMesh(shape=(8,), dim_names=["x"])
+        x = paddle.to_tensor(np.ones((8, 4), np.float32))
+        x.stop_gradient = False
+        y = x * 2.0
+        r = dist.reshard(y, mesh, [dist.Shard(0)])
+        assert r._grad_node is not None
+        r.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((8, 4), 2.0))
