@@ -250,8 +250,11 @@ def all_gather_object(object_list, obj, group=None):
 
     group = group or _get_default_group()
     if not _is_dist_multiprocess():
-        # single-controller SPMD: every "rank" holds the same object
-        object_list.extend(obj for _ in range(group.nranks))
+        # single-controller SPMD: every "rank" holds an equal but independent
+        # copy (matching the pickle round-trip aliasing of the multihost path)
+        import copy
+
+        object_list.extend(copy.deepcopy(obj) for _ in range(group.nranks))
         return object_list
     from jax.experimental import multihost_utils
 

@@ -56,11 +56,12 @@ def all_gather(x, axis=1):
 
 
 def reduce_scatter(x, axis=1):
-    """ReduceScatterOp: resolve an mp-partial sum directly into seq shards."""
-    mesh = get_fleet_mesh()
-    if not _mp_active(mesh):
-        return x
-    return shard_activation(x, mesh=mesh, spec=_spec(mesh, "mp"))
+    """ReduceScatterOp: resolve an mp-partial sum directly into seq shards.
+
+    Under GSPMD this is the same sharding constraint as :func:`scatter` —
+    XLA lowers the partial-sum + seq-shard combination to a reduce-scatter.
+    """
+    return scatter(x, axis)
 
 
 class ScatterOp:

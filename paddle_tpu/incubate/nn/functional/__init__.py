@@ -20,6 +20,14 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis
         if res is not None:
             a = a + res
         ax = begin_norm_axis % a.ndim
+        rows = 1
+        for s in a.shape[:-1]:
+            rows *= s
+        if (ax == a.ndim - 1 and b is None and rows % 8 == 0
+                and jax.default_backend() == "tpu"):
+            from ....ops.pallas import rms_norm as _pallas_rms
+
+            return _pallas_rms(a, w, epsilon)
         axes = tuple(range(ax, a.ndim))
         var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axes, keepdims=True)
         out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)

@@ -51,8 +51,12 @@ def _use_pallas(q_shape):
             return False
     except Exception:
         return False
+    from ...ops.pallas.flash_attention import supported_seq
+
     b, s, h, d = q_shape
-    return s % 128 == 0 and d % 128 == 0
+    # the kernel needs Mosaic-tileable seq blocks and the whole head_dim in
+    # VMEM; other shapes fall back to the XLA path
+    return supported_seq(s) and d <= 256
 
 
 def flash_attention(

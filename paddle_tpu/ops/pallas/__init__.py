@@ -1,0 +1,30 @@
+"""Pallas TPU kernels — the capability slot the reference fills with
+hand-written CUDA fusions (``phi/kernels/fusion/gpu``, ``phi/kernels/gpu/
+flash_attn_kernel.cu``).
+
+Design stance (TPU-first): only ops that XLA cannot already fuse optimally
+get a Pallas kernel. Flash attention (tiled online-softmax over VMEM blocks)
+and row-normalisation (rms/layer norm over long rows) qualify; elementwise
+chains like rope/swiglu/bias-act do NOT — XLA fuses those into the
+surrounding matmuls, and a Pallas kernel would break that fusion.
+
+All kernels run in interpret mode on CPU (tests) and compiled on TPU.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def use_interpret() -> bool:
+    """Interpret-mode on non-TPU backends so the same kernel code is tested
+    on the CPU mesh (SURVEY §4: fake-backend strategy)."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+from .flash_attention import flash_attention, flash_attention_fwd  # noqa: E402
+from .rms_norm import rms_norm  # noqa: E402
+
+__all__ = ["flash_attention", "flash_attention_fwd", "rms_norm", "use_interpret"]

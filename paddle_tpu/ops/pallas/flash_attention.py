@@ -1,0 +1,370 @@
+"""Flash attention as a Pallas TPU kernel (fwd + bwd), with custom_vjp.
+
+Capability parity: the reference binds the external CUDA flashattn library
+(``phi/kernels/gpu/flash_attn_kernel.cu``); on TPU the same slot is a tiled
+online-softmax kernel that keeps q/k/v blocks in VMEM and accumulates in
+float32 — O(S) memory instead of the O(S^2) score matrix.
+
+Layout: public entry takes paddle's [B, S, H, D]; kernels run on [BH, S, D].
+GQA is handled in the BlockSpec index maps (q-head blocks read their shared
+kv head directly) — kv is never materialised at q-head width.
+
+Causal semantics match the XLA fallback (`_xla_sdpa`): when sq != sk the
+queries align to the END of the key sequence (kv-cache decode convention),
+i.e. query row i sees key cols <= i + (sk - sq).
+
+Grid convention (TPU grids execute the LAST dimension innermost &
+sequentially, so scratch accumulators carry across it):
+  forward:  (B*Hq, Sq/bq, Sk/bk)   — k-blocks stream through a fixed q-block
+  backward: dq   (B*Hq, Sq/bq, Sk/bk)
+            dkdv (B*Hkv, Sk/bk, rep*Sq/bq) — the q sweep covers all rep
+            q-heads sharing the kv head, keeping accumulation sequential.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() exact zero
+                 # without nan from (-inf) - (-inf) in the rescale path
+
+
+def _block_for(s: int):
+    """Pick a seq block size whose lse/delta blocks satisfy Mosaic's
+    last-dim tiling (multiple of 128, or the full dimension)."""
+    if s <= 512:
+        return s
+    for b in (512, 256, 128):
+        if s % b == 0:
+            return b
+    return None
+
+
+def supported_seq(s: int) -> bool:
+    return _block_for(s) is not None
+
+
+def _causal_mask(qi, ki, bq, bk, offset):
+    """[bq, bk] bool: True where key col <= query row + offset."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+    return cols <= rows + offset
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                *, scale, causal, bq, bk, nk, offset):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    def compute():
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            s = jnp.where(_causal_mask(qi, ki, bq, bk, offset), s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]                       # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)   # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)              # [bq, 1]
+        l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0:1] = m_new
+        l_scr[:, 0:1] = l_new
+
+    if causal:
+        # k-blocks entirely above the (offset) diagonal are fully masked
+        @pl.when(ki * bk <= qi * bq + (bq - 1) + offset)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0])))
+
+
+def _kv_index(b_idx, hq, hk):
+    """Map a flat (batch*q_head) grid index to its (batch*kv_head) block."""
+    rep = hq // hk
+    bi = b_idx // hq
+    hi = b_idx % hq
+    return bi * hk + hi // rep
+
+
+def _fwd(q, k, v, scale, causal, interpret, hq, hk):
+    bhq, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _block_for(sq), _block_for(sk)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"flash_attention: seq lens ({sq}, {sk}) not tileable — pad to a "
+            "multiple of 128 (or <= 512) or use the XLA fallback"
+        )
+    nq, nk = sq // bq, sk // bk
+    offset = sk - sq
+
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        offset=offset,
+    )
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bhq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bhq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bhq, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bhq * sq * sk * d,
+            bytes_accessed=(2 * bhq * sq * d + 2 * (bhq // (hq // hk)) * sk * d)
+            * q.dtype.itemsize,
+            transcendentals=bhq * sq * sk,
+        ),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------- backward
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, bq, bk, nk, offset):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = jnp.where(_causal_mask(qi, ki, bq, bk, offset), s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])            # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                               # [bq, bk]
+        ds = p * (dp - delta_ref[0][:, None])           # [bq, bk]
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(ki * bk <= qi * bq + (bq - 1) + offset)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, bq, bk, nq, nq_total, offset):
+    ki = pl.program_id(1)
+    ji = pl.program_id(2)          # sweeps rep * nq q-blocks, sequential
+    qi = ji % nq                   # q-block index within one q-head
+
+    @pl.when(ji == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = jnp.where(_causal_mask(qi, ki, bq, bk, offset), s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])            # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                               # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0][:, None])           # [bq, bk]
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                               # [bk, d]
+
+    if causal:
+        @pl.when(qi * bq + (bq - 1) + offset >= ki * bk)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ji == nq_total - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
+    bhq, sq, d = q.shape
+    bhk, sk, _ = k.shape
+    bq, bk = _block_for(sq), _block_for(sk)
+    nq, nk = sq // bq, sk // bk
+    rep = hq // hk
+    offset = sk - sq
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, offset=offset),
+        grid=(bhq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bhq, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # flat (batch*kv_head, j) -> the q-head block owning sweep step j
+    def _q_index(b, j):
+        bi = b // hk
+        hi = b % hk
+        return bi * hq + hi * rep + j // nq
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq, nq_total=rep * nq,
+                          offset=offset),
+        grid=(bhk, nk, rep * nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, jk, j: (_q_index(b, j), j % nq, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, jk, j: (_q_index(b, j), j % nq, 0)),
+            pl.BlockSpec((1, bq), lambda b, jk, j: (_q_index(b, j), j % nq)),
+            pl.BlockSpec((1, bq), lambda b, jk, j: (_q_index(b, j), j % nq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bhk, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bhk, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public api
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, interpret, hq, hk):
+    o, _ = _fwd(q, k, v, scale, causal, interpret, hq, hk)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, interpret, hq, hk):
+    o, lse = _fwd(q, k, v, scale, causal, interpret, hq, hk)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, interpret, hq, hk, res, do):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, do, scale, causal, interpret, hq, hk)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
+    """[B, S, H, D] flash attention. Differentiable (custom flash backward).
+
+    GQA (fewer kv heads than q heads) reads shared kv heads via the kernel
+    index maps — no materialised head repeat.
+    """
+    from . import use_interpret
+
+    if interpret is None:
+        interpret = use_interpret()
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if hq % hk != 0:
+        raise ValueError(f"q heads ({hq}) must be a multiple of kv heads ({hk})")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    def to_bh(x, h):  # [B,S,H,D] -> [B*H,S,D]
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+
+    o = _flash(to_bh(q, hq), to_bh(k, hk), to_bh(v, hk), float(scale),
+               bool(causal), bool(interpret), hq, hk)
+    return jnp.transpose(o.reshape(b, hq, sq, d), (0, 2, 1, 3))
+
+
+# Back-compat name used by nn.functional.flash_attention
+flash_attention_fwd = flash_attention
