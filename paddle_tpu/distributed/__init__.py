@@ -63,6 +63,11 @@ from .parallel_step import (  # noqa: F401
     shard_model_parameters,
 )
 from . import fleet  # noqa: F401
+from .context_parallel import (  # noqa: F401
+    context_parallel_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 # fleet.mpu split op lives at paddle.distributed.split in the reference
 from .fleet.mpu import split  # noqa: F401
