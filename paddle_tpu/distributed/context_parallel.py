@@ -149,18 +149,29 @@ def context_parallel_attention(query, key, value, mesh=None, causal=True,
     jmesh = mesh.jax_mesh if hasattr(mesh, "jax_mesh") else mesh
     if axis_name not in jmesh.axis_names:
         raise ValueError(f"mesh has no '{axis_name}' axis: {jmesh.axis_names}")
+    if strategy not in ("ring", "ulysses"):
+        raise ValueError(f"unknown cp strategy {strategy!r} "
+                         "(expected 'ring' or 'ulysses')")
 
-    fn = ring_attention if strategy == "ring" else ulysses_attention
+    mapped = _mapped_cp(jmesh, strategy, bool(causal), axis_name)
     spec = PartitionSpec(None, axis_name, None, None)
 
     def _cp(q, k, v):
-        mapped = jax.shard_map(
-            functools.partial(fn, axis_name=axis_name, causal=causal),
-            mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
-        )
         q = jax.device_put(q, NamedSharding(jmesh, spec))
         k = jax.device_put(k, NamedSharding(jmesh, spec))
         v = jax.device_put(v, NamedSharding(jmesh, spec))
         return mapped(q, k, v)
 
     return apply_op(_cp, query, key, value, _op_name="context_parallel_attention")
+
+
+@functools.lru_cache(maxsize=64)
+def _mapped_cp(jmesh, strategy, causal, axis_name):
+    """Memoised shard_map wrapper so repeated eager calls hit jax's
+    compilation cache instead of retracing."""
+    fn = ring_attention if strategy == "ring" else ulysses_attention
+    spec = PartitionSpec(None, axis_name, None, None)
+    return jax.shard_map(
+        functools.partial(fn, axis_name=axis_name, causal=causal),
+        mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )

@@ -92,6 +92,14 @@ def is_initialized():
     return _fleet_state["initialized"]
 
 
+def _reset_for_tests():
+    """Drop fleet/global mesh state so a test can re-init a new topology."""
+    from ..auto_parallel import set_mesh
+
+    _fleet_state.update(initialized=False, strategy=None, hcg=None, mesh=None)
+    set_mesh(None)
+
+
 def get_hybrid_communicate_group():
     return _fleet_state["hcg"]
 

@@ -189,3 +189,190 @@ class GPTForCausalLM(nn.Layer):
 def causal_lm_loss(model, batch):
     input_ids, labels = batch
     return model.loss(input_ids, labels)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined variant: stacked decoder parameters + compiled SPMD pipeline
+# (parity: PaddleNLP GPTForCausalLMPipe over fleet PipelineLayer/1F1B;
+#  reference runtime: fleet/meta_parallel/pipeline_parallel.py:242)
+# ---------------------------------------------------------------------------
+def _rope_pure(x, base=10000.0):
+    """Neox-style rope on [B, S, H, D] arrays."""
+    import jax.numpy as jnp
+
+    d = x.shape[-1]
+    pos = jnp.arange(x.shape[1], dtype=jnp.float32)
+    inv = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = jnp.outer(pos, inv)
+    sin = jnp.sin(freqs)[None, :, None, :]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    x1, x2 = x[..., : d // 2], x[..., d // 2 :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _rms_pure(x, w, eps=1e-6):
+    import jax
+    import jax.numpy as jnp
+
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return ((x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(x.dtype)) * w
+
+
+def _sdpa_pure(q, k, v, causal=True):
+    from paddle_tpu.nn.functional.flash_attention import sdpa_arrays
+
+    return sdpa_arrays(q, k, v, causal=causal)
+
+
+def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True):
+    """One decoder block on arrays. p = (ln1, wq, wk, wv, wo, ln2, wg, wu, wd)."""
+    import jax
+    import jax.numpy as jnp
+
+    ln1, wq, wk, wv, wo, ln2, wg, wu, wd = p
+    b, s, hdim = x.shape
+    hd = hdim // num_heads
+    h = _rms_pure(x, ln1)
+    q = (h @ wq).reshape(b, s, num_heads, hd)
+    k = (h @ wk).reshape(b, s, num_kv_heads, hd)
+    v = (h @ wv).reshape(b, s, num_kv_heads, hd)
+    if use_rope:
+        q, k = _rope_pure(q), _rope_pure(k)
+    o = _sdpa_pure(q, k, v, causal=True).reshape(b, s, num_heads * hd)
+    x = x + o @ wo
+    h2 = _rms_pure(x, ln2)
+    return x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+
+
+class StackedDecoder(nn.Layer):
+    """All decoder blocks as leading-axis-stacked parameters [L, ...].
+
+    TPU-first: a single lax.scan over layers (constant compile time at any
+    depth) when pp is off; when the active mesh has a "pp" axis > 1, the
+    leading axis is stage-sharded and the compiled SPMD pipeline schedule
+    (distributed/pipeline.py) runs microbatches through ppermute rotation.
+    """
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        if config.norm_type != "rmsnorm" or config.act != "swiglu":
+            raise ValueError("StackedDecoder supports the rmsnorm+swiglu family")
+        if not config.rope:
+            raise ValueError("StackedDecoder requires rope positions "
+                             "(learned embed_pos is not supported)")
+        if config.dropout:
+            raise ValueError("StackedDecoder does not support dropout")
+        from paddle_tpu.nn.initializer import Constant, Normal
+
+        L, h = config.num_layers, config.hidden_size
+        hd = h // config.num_heads
+        kv = config.num_kv_heads * hd
+        m = config.intermediate_size
+        self.config = config
+        w = lambda *shape: self.create_parameter(
+            list(shape), default_initializer=Normal(std=0.02)
+        )
+        one = Constant(1.0)
+        self.ln1 = self.create_parameter([L, h], default_initializer=one)
+        self.wq = w(L, h, h)
+        self.wk = w(L, h, kv)
+        self.wv = w(L, h, kv)
+        self.wo = w(L, h, h)
+        self.ln2 = self.create_parameter([L, h], default_initializer=one)
+        self.wg = w(L, h, m)
+        self.wu = w(L, h, m)
+        self.wd = w(L, m, h)
+
+    def _mesh_pp(self):
+        from paddle_tpu.distributed.auto_parallel import get_mesh
+        from paddle_tpu.distributed.fleet import get_fleet_mesh
+
+        mesh = get_fleet_mesh() or get_mesh()
+        if mesh is None or "pp" not in mesh.dim_names:
+            return None, 1
+        return mesh, mesh.get_dim_size("pp")
+
+    def apply_pipeline_placements(self, mesh=None):
+        """Mark every stacked param Shard(0) over the 'pp' mesh axis."""
+        from paddle_tpu.distributed.auto_parallel import (
+            Replicate, Shard, TensorDistAttr)
+
+        if mesh is None:
+            mesh, pp = self._mesh_pp()
+            if mesh is None:
+                return self
+        ax = mesh.dim_names.index("pp")
+        for _, p in self.named_parameters():
+            placements = [Replicate() for _ in mesh.dim_names]
+            placements[ax] = Shard(0)
+            p._dist_attr = TensorDistAttr(mesh, placements)
+        return self
+
+    def forward(self, x):
+        import jax
+        from paddle_tpu.core.dispatch import apply_op
+
+        cfg = self.config
+        mesh, pp = self._mesh_pp()
+
+        def _run(x, *params):
+            def step(x, p):
+                return _block_pure(
+                    p, x, cfg.num_heads, cfg.num_kv_heads, cfg.rope
+                ), None
+
+            if pp <= 1:
+                out, _ = jax.lax.scan(step, x, tuple(params))
+                return out
+
+            from paddle_tpu.distributed.pipeline import (
+                microbatch, spmd_pipeline, unmicrobatch)
+
+            n_micro = getattr(cfg, "pp_microbatches", None) or pp
+
+            def stage_fn(stage_params, x):
+                out, _ = jax.lax.scan(step, x, stage_params)
+                return out
+
+            from jax.sharding import PartitionSpec as P
+
+            pipe = spmd_pipeline(
+                stage_fn, mesh.jax_mesh, pp,
+                params_spec=P("pp"), remat=cfg.recompute,
+            )
+            return unmicrobatch(pipe(tuple(params), microbatch(x, n_micro)))
+
+        return apply_op(
+            _run, x, self.ln1, self.wq, self.wk, self.wv, self.wo,
+            self.ln2, self.wg, self.wu, self.wd, _op_name="stacked_decoder",
+        )
+
+
+class GPTForCausalLMPipe(nn.Layer):
+    """Decoder-only LM with the stacked/pipelined decoder core."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        if not config.tie_embeddings:
+            raise ValueError("GPTForCausalLMPipe ties the lm head to the "
+                             "token embedding (tie_embeddings=False is not "
+                             "supported)")
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.decoder = StackedDecoder(config)
+        self.final_norm = nn.RMSNorm(config.hidden_size)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        x = self.decoder(x)
+        x = self.final_norm(x)
+        return paddle.matmul(x, self.embed_tokens.weight, transpose_y=True)
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]),
+        )

@@ -59,6 +59,25 @@ def _use_pallas(q_shape):
     return supported_seq(s) and d <= 256
 
 
+def sdpa_arrays(q, k, v, causal=True, scale=None):
+    """Array-level attention: pallas flash kernel when eligible, XLA fallback.
+
+    The single dispatch point shared by the functional API and the pure
+    model paths (models/gpt.py stacked decoder)."""
+    if _use_pallas(q.shape):
+        try:
+            from ...ops.pallas import flash_attention as _fa_kernel
+
+            return _fa_kernel(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return _xla_sdpa(q, k, v, causal=causal, scale=scale)
+
+
 def flash_attention(
     query,
     key,
@@ -76,14 +95,9 @@ def flash_attention(
     drop_key = framework.next_rng_key() if (dropout > 0.0 and training) else None
 
     def _fa(q, k, v):
-        if _use_pallas(q.shape) and dropout == 0.0:
-            try:
-                from ...ops.pallas.flash_attention import flash_attention_fwd
-
-                return flash_attention_fwd(q, k, v, causal=causal)
-            except Exception:
-                pass
-        return _xla_sdpa(q, k, v, causal=causal, dropout=dropout if training else 0.0, key=drop_key)
+        if dropout == 0.0 or not training:
+            return sdpa_arrays(q, k, v, causal=causal)
+        return _xla_sdpa(q, k, v, causal=causal, dropout=dropout, key=drop_key)
 
     out = apply_op(_fa, query, key, value, _op_name="flash_attention")
     if return_softmax:
@@ -107,13 +121,8 @@ def scaled_dot_product_attention(
     drop_key = framework.next_rng_key() if (dropout_p > 0.0 and training) else None
 
     def _sdpa(q, k, v, m):
-        if m is None and _use_pallas(q.shape) and dropout_p == 0.0:
-            try:
-                from ...ops.pallas.flash_attention import flash_attention_fwd
-
-                return flash_attention_fwd(q, k, v, causal=is_causal)
-            except Exception:
-                pass
+        if m is None and (dropout_p == 0.0 or not training):
+            return sdpa_arrays(q, k, v, causal=is_causal)
         return _xla_sdpa(
             q, k, v, mask=m, causal=is_causal,
             dropout=dropout_p if training else 0.0, key=drop_key,
