@@ -1,0 +1,2 @@
+"""Gate submodule alias (parity: incubate/distributed/models/moe/gate/)."""
+from . import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
