@@ -1,0 +1,134 @@
+"""Native (C++) runtime components, built on first import.
+
+The reference implements its runtime substrate in C++ (store: N12
+`tcp_store.h:121`; host tracer: N34 `host_tracer.cc`). These are the
+TPU-native equivalents, compiled from the sources in this directory with
+g++ into one shared library and bound via ctypes (the environment has no
+pybind11 — ctypes is the sanctioned binding path).
+
+Falls back cleanly (``LIB is None``) if no toolchain is available;
+pure-Python equivalents in distributed/store.py and profiler keep the
+API working.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_NAME = "libpaddle_tpu_native.so"
+
+LIB = None
+
+
+def _sources():
+    return [os.path.join(_DIR, f) for f in sorted(os.listdir(_DIR))
+            if f.endswith(".cc")]
+
+
+def _build(lib_path):
+    srcs = _sources()
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           "-o", lib_path] + srcs
+    subprocess.run(cmd, check=True, capture_output=True, timeout=240)
+
+
+def _load():
+    global LIB
+    lib_path = os.path.join(_DIR, _LIB_NAME)
+    srcs = _sources()
+    if not srcs:
+        return None
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < newest_src:
+        try:
+            # build into a temp file then atomically rename, so concurrent
+            # importers never load a half-written library
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+            os.close(fd)
+            _build(tmp)
+            os.replace(tmp, lib_path)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+
+    lib.pt_store_server_start.restype = ctypes.c_void_p
+    lib.pt_store_server_start.argtypes = [ctypes.c_int,
+                                          ctypes.POINTER(ctypes.c_int)]
+    lib.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+
+    lib.pt_tracer_enable.argtypes = [ctypes.c_int]
+    lib.pt_tracer_enabled.restype = ctypes.c_int
+    lib.pt_tracer_now_ns.restype = ctypes.c_int64
+    lib.pt_tracer_record.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.pt_tracer_count.restype = ctypes.c_size_t
+    lib.pt_tracer_drain.restype = ctypes.c_size_t
+    lib.pt_tracer_drain.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_size_t,
+    ]
+    return lib
+
+
+LIB = _load()
+
+
+def available() -> bool:
+    return LIB is not None
+
+
+# ----------------------------------------------------------------- tracer
+
+def tracer_enable(on=True):
+    if LIB is not None:
+        LIB.pt_tracer_enable(1 if on else 0)
+
+
+def tracer_record(name: str, start_ns: int, end_ns: int, tid: int = 0,
+                  kind: int = 0):
+    if LIB is not None:
+        LIB.pt_tracer_record(name.encode()[:63], start_ns, end_ns, tid, kind)
+
+
+def tracer_now_ns() -> int:
+    if LIB is not None:
+        return LIB.pt_tracer_now_ns()
+    import time
+
+    return time.monotonic_ns()
+
+
+def tracer_drain(cap=1 << 20):
+    """Drain recorded events -> list of (name, start_ns, end_ns, tid, kind)."""
+    if LIB is None:
+        return []
+    n = LIB.pt_tracer_count()
+    if n == 0:
+        return []
+    cap = min(int(n), cap)
+    names = ctypes.create_string_buffer(cap * 64)
+    starts = (ctypes.c_int64 * cap)()
+    ends = (ctypes.c_int64 * cap)()
+    tids = (ctypes.c_int32 * cap)()
+    kinds = (ctypes.c_int32 * cap)()
+    got = LIB.pt_tracer_drain(names, starts, ends, tids, kinds, cap)
+    out = []
+    for i in range(got):
+        raw = names.raw[i * 64:(i + 1) * 64]
+        nm = raw.split(b"\0", 1)[0].decode(errors="replace")
+        out.append((nm, starts[i], ends[i], tids[i], kinds[i]))
+    return out

@@ -1,0 +1,91 @@
+"""Native C++ runtime: TCPStore rendezvous + host tracer."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def test_native_lib_builds():
+    from paddle_tpu.core import native
+
+    # The image ships g++ (task environment contract); the native path must
+    # actually build here, not silently fall back.
+    assert native.available()
+
+
+def test_tcp_store_basic():
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True, world_size=2)
+    assert master.ping()
+    client = TCPStore(host="127.0.0.1", port=master.port, world_size=2)
+
+    master.set("k", b"v1")
+    assert client.get("k") == b"v1"
+    assert client.get("missing") is None
+    assert client.add("ctr", 3) == 3
+    assert master.add("ctr", 4) == 7
+    client.delete_key("k")
+    assert master.get("k") is None
+    client.close()
+    master.close()
+
+
+def test_tcp_store_wait_blocks_until_set():
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True)
+    client = TCPStore(host="127.0.0.1", port=master.port)
+    got = {}
+
+    def waiter():
+        got["v"] = client.wait("barrier")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    assert "v" not in got
+    master.set("barrier", b"go")
+    t.join(timeout=5)
+    assert got.get("v") == b"go"
+    client.close()
+    master.close()
+
+
+def test_tcp_store_native_server_used():
+    from paddle_tpu.core import native
+    from paddle_tpu.distributed.store import TCPStore
+
+    if not native.available():
+        pytest.skip("no toolchain")
+    master = TCPStore(is_master=True)
+    assert master.is_native
+    master.close()
+
+
+def test_tracer_records_and_drains():
+    from paddle_tpu.core import native
+
+    if not native.available():
+        pytest.skip("no toolchain")
+    native.tracer_enable(True)
+    t0 = native.tracer_now_ns()
+    native.tracer_record("op:matmul", t0, t0 + 1000, tid=1)
+    native.tracer_record("op:softmax", t0 + 1000, t0 + 1500, tid=1)
+    evts = native.tracer_drain()
+    native.tracer_enable(False)
+    names = [e[0] for e in evts]
+    assert "op:matmul" in names and "op:softmax" in names
+    m = evts[names.index("op:matmul")]
+    assert m[2] - m[1] == 1000
+
+
+def test_tracer_disabled_is_noop():
+    from paddle_tpu.core import native
+
+    if not native.available():
+        pytest.skip("no toolchain")
+    native.tracer_enable(False)
+    native.tracer_record("ignored", 0, 1)
+    assert native.tracer_drain() == []
