@@ -71,14 +71,22 @@ class Profiler:
         self._jax_active = False
         self._step_times = []
         self._last_step_t = None
+        self._host_events = []
 
     def start(self):
+        from ..core import native
+
+        native.tracer_enable(True)
         self._last_step_t = time.perf_counter()
         self._transition()
         return self
 
     def stop(self):
+        from ..core import native
+
         self._stop_jax()
+        self._drain_host_events()
+        native.tracer_enable(False)
         if self._on_trace_ready:
             self._on_trace_ready(self)
 
@@ -133,30 +141,96 @@ class Profiler:
         return False
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
-        if not self._step_times:
-            print("no steps recorded")
-            return
+        """Print the statistic tables (parity: profiler_statistic.py)."""
         import numpy as np
 
-        times = np.asarray(self._step_times)
-        print(
-            f"steps: {len(times)}  mean: {times.mean()*1e3:.3f} ms  "
-            f"p50: {np.percentile(times, 50)*1e3:.3f} ms  "
-            f"p99: {np.percentile(times, 99)*1e3:.3f} ms"
-        )
+        lines = []
+        if self._step_times:
+            times = np.asarray(self._step_times)
+            lines.append(
+                f"steps: {len(times)}  mean: {times.mean()*1e3:.3f} ms  "
+                f"p50: {np.percentile(times, 50)*1e3:.3f} ms  "
+                f"p99: {np.percentile(times, 99)*1e3:.3f} ms"
+            )
+        stats = host_event_statistics(self._host_events)
+        if stats:
+            lines.append(
+                f"{'Name':<32}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+                f"{'Max(ms)':>10}{'Min(ms)':>10}"
+            )
+            order = sorted(stats.items(), key=lambda kv: -kv[1]["total"])
+            for name, s in order:
+                lines.append(
+                    f"{name[:31]:<32}{s['calls']:>8}"
+                    f"{s['total']*1e3:>12.3f}{s['avg']*1e3:>10.3f}"
+                    f"{s['max']*1e3:>10.3f}{s['min']*1e3:>10.3f}"
+                )
+        out = "\n".join(lines) if lines else "no events recorded"
+        print(out)
+        return out
+
+    def _drain_host_events(self):
+        from ..core import native
+
+        self._host_events.extend(native.tracer_drain())
 
     def export(self, path, format="json"):
-        self._export_dir = path
+        """Write host events as a chrome trace (chrometracing_logger.cc
+        parity). Device-side XPlane traces live in the jax trace dir."""
+        import json
+        import os as _os
+
+        self._drain_host_events()
+        events = []
+        for name, start, end, tid, kind in self._host_events:
+            events.append({
+                "name": name, "ph": "X", "pid": 0, "tid": tid,
+                "ts": start / 1e3, "dur": (end - start) / 1e3,
+                "cat": "host",
+            })
+        d = _os.path.dirname(path)
+        if d:
+            _os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+
+def host_event_statistics(events):
+    """Aggregate (name, start, end, tid, kind) host events per name."""
+    stats = {}
+    for name, start, end, tid, kind in events:
+        dur = max(0, end - start) / 1e9
+        s = stats.setdefault(
+            name, {"calls": 0, "total": 0.0, "max": 0.0, "min": float("inf")}
+        )
+        s["calls"] += 1
+        s["total"] += dur
+        s["max"] = max(s["max"], dur)
+        s["min"] = min(s["min"], dur)
+    for s in stats.values():
+        s["avg"] = s["total"] / s["calls"]
+        if s["min"] == float("inf"):
+            s["min"] = 0.0
+    return stats
 
 
 class RecordEvent:
-    """parity: paddle.profiler.RecordEvent → jax TraceAnnotation."""
+    """parity: paddle.profiler.RecordEvent.
+
+    Dual sink: the native C++ tracer buffer (host timeline, chrome export)
+    and jax TraceAnnotation (shows up inside the device XPlane trace)."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ctx = None
+        self._t0 = None
 
     def begin(self):
+        from ..core import native
+
+        self._t0 = native.tracer_now_ns()
         try:
             import jax
 
@@ -166,6 +240,16 @@ class RecordEvent:
             self._ctx = None
 
     def end(self):
+        from ..core import native
+
+        if self._t0 is not None:
+            import threading
+
+            native.tracer_record(
+                self.name, self._t0, native.tracer_now_ns(),
+                tid=threading.get_ident() % (1 << 31),
+            )
+            self._t0 = None
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
@@ -180,4 +264,7 @@ class RecordEvent:
 
 
 def load_profiler_result(filename):
-    raise NotImplementedError("use TensorBoard / Perfetto on the XPlane trace dir")
+    import json
+
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,55 @@
+"""Profiler: RecordEvent host timeline, chrome export, summary stats."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def test_record_event_and_chrome_export(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler as prof_mod
+    from paddle_tpu.profiler import Profiler, RecordEvent
+
+    p = Profiler(timer_only=True)
+    p.start()
+    for i in range(3):
+        with RecordEvent("train_step"):
+            with RecordEvent("forward"):
+                x = paddle.randn([8, 8])
+                (x @ x).numpy()
+        p.step()
+    p.stop()
+
+    out = str(tmp_path / "trace.json")
+    p.export(out)
+    data = json.load(open(out))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "train_step" in names and "forward" in names
+    for e in data["traceEvents"]:
+        assert e["dur"] >= 0
+
+    text = p.summary()
+    assert "train_step" in text
+
+
+def test_scheduler_windows():
+    from paddle_tpu.profiler import ProfilerState, make_scheduler
+
+    sch = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sch(i) for i in range(4)]
+    assert states[0] == ProfilerState.CLOSED
+    assert states[1] == ProfilerState.READY
+    assert states[2] == ProfilerState.RECORD
+    assert states[3] == ProfilerState.RECORD_AND_RETURN
+
+
+def test_host_event_statistics():
+    from paddle_tpu.profiler import host_event_statistics
+
+    evts = [("op", 0, 2_000_000, 0, 0), ("op", 0, 4_000_000, 0, 0),
+            ("other", 0, 1_000_000, 0, 0)]
+    stats = host_event_statistics(evts)
+    assert stats["op"]["calls"] == 2
+    np.testing.assert_allclose(stats["op"]["avg"], 0.003)
+    np.testing.assert_allclose(stats["op"]["max"], 0.004)
