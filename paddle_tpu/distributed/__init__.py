@@ -120,15 +120,41 @@ class ParallelEnv:
         return self.device_id
 
 
+def _spawn_target(func, args, rank, nprocs, env):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)
+
+
 def spawn(func, args=(), nprocs=-1, join=True, **options):
     """paddle.distributed.spawn parity (spawn.py:456).
 
-    TPU note: one jax process drives all local chips, so in-process "spawn"
-    over devices is the mesh itself; nprocs>1 real processes are only
-    meaningful multi-host, where the launcher (paddle_tpu.distributed.launch)
-    starts them. Here: run func once (the SPMD program covers all devices).
+    TPU note: one jax process drives all local chips, so the SPMD program
+    already covers every device — nprocs<=1 runs func inline. nprocs>1
+    starts real OS processes with the PADDLE_* env contract (multi-host
+    style; mainly the CPU fake-backend test path).
     """
-    func(*args)
+    if nprocs is None or nprocs <= 1:
+        func(*args)
+        return None
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    env = {k: v for k, v in os.environ.items() if k.startswith(("PADDLE_", "FLAGS_"))}
+    procs = [
+        ctx.Process(target=_spawn_target, args=(func, args, r, nprocs, env))
+        for r in range(nprocs)
+    ]
+    for p in procs:
+        p.start()
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode != 0]
+        if bad:
+            raise RuntimeError(f"spawn: child exit codes {bad}")
+    return procs
 
 
 def get_backend():

@@ -1,0 +1,2 @@
+"""Launcher package (parity: python/paddle/distributed/launch)."""
+from .main import launch  # noqa: F401

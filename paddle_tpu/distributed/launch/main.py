@@ -1,0 +1,146 @@
+"""``python -m paddle_tpu.distributed.launch`` — multi-host job launcher.
+
+Capability parity: `python/paddle/distributed/launch/main.py:23` +
+`controllers/collective.py` (pod/process model, env contract, restart).
+
+TPU-native process model: ONE controller process per HOST drives all local
+chips (multi-controller jax), so ``--nproc_per_node`` defaults to 1 on TPU
+— unlike the reference's process-per-GPU. Values > 1 are used by the
+CPU fake-backend test path (each process becomes one "rank").
+
+Env contract written for each process (consumed by init_parallel_env):
+  PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_MASTER,
+  PADDLE_LOCAL_RANK, PADDLE_NNODES, PADDLE_JOB_ID
+
+Rendezvous: ``--master host:port`` backed by the native TCPStore
+(core/native/store.cc); with ``--rank -1`` node ranks are auto-assigned
+by an atomic ADD on the store. ``--max_restart`` relaunches failed
+processes (elastic restart-from-checkpoint model, SURVEY §5 failure
+detection).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+
+def _parse():
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="TPU-native distributed launcher",
+    )
+    p.add_argument("--master", default=None,
+                   help="rendezvous server host:port (TCPStore)")
+    p.add_argument("--rank", type=int, default=-1,
+                   help="node rank; -1 = auto-assign via master")
+    p.add_argument("--nnodes", default="1",
+                   help="number of nodes (elastic range 'lo:hi' takes lo)")
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None,
+                   help="accepted for API parity; the TPU runtime binds all "
+                        "local chips to the one controller process")
+    p.add_argument("--max_restart", type=int, default=0)
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def _nnodes(spec: str) -> int:
+    return int(str(spec).split(":")[0])
+
+
+def _rendezvous(master: str, rank: int, nnodes: int, job_id: str):
+    """Return (node_rank, store_or_none). Starts the store on the master
+    node (the one whose --rank is 0 or that can bind the port)."""
+    from ..store import TCPStore
+
+    host, port = master.split(":")
+    port = int(port)
+    store = None
+    if rank == 0 or rank == -1:
+        try:
+            store = TCPStore(host="127.0.0.1", port=port, is_master=True,
+                             world_size=nnodes)
+        except Exception:
+            store = None  # another node owns the master port
+    if store is None:
+        store = TCPStore(host=host, port=port, is_master=False,
+                         world_size=nnodes)
+    if rank == -1:
+        rank = store.add(f"{job_id}/node_count", 1) - 1
+    store.set(f"{job_id}/node/{rank}", str(os.getpid()))
+    return rank, store
+
+
+def launch() -> None:
+    args = _parse()
+    nnodes = _nnodes(args.nnodes)
+    nproc = args.nproc_per_node or 1
+    node_rank = max(args.rank, 0)
+    store = None
+    if args.master and nnodes > 1:
+        node_rank, store = _rendezvous(args.master, args.rank, nnodes,
+                                       args.job_id)
+
+    world = nnodes * nproc
+    os.makedirs(args.log_dir, exist_ok=True)
+    script_args = [a for a in args.training_script_args if a != "--"]
+
+    for attempt in range(args.max_restart + 1):
+        procs = []
+        logs = []
+        for i in range(nproc):
+            rank = node_rank * nproc + i
+            env = dict(os.environ)
+            env.update(
+                PADDLE_TRAINER_ID=str(rank),
+                PADDLE_TRAINERS_NUM=str(world),
+                PADDLE_LOCAL_RANK=str(i),
+                PADDLE_NNODES=str(nnodes),
+                PADDLE_JOB_ID=args.job_id,
+                FLAGS_selected_tpus=str(i),
+            )
+            if args.master:
+                env["PADDLE_MASTER"] = args.master
+            log_path = os.path.join(
+                args.log_dir, f"{args.job_id}.{rank}.log")
+            lf = open(log_path, "ab")
+            logs.append(lf)
+            procs.append(subprocess.Popen(
+                [sys.executable, args.training_script] + script_args,
+                env=env, stdout=lf, stderr=subprocess.STDOUT,
+            ))
+
+        codes = [p.wait() for p in procs]
+        for lf in logs:
+            lf.close()
+        if all(c == 0 for c in codes):
+            break
+        if attempt == args.max_restart:
+            for rank, c in enumerate(codes):
+                if c != 0:
+                    log_path = os.path.join(
+                        args.log_dir, f"{args.job_id}.{node_rank * nproc + rank}.log")
+                    sys.stderr.write(
+                        f"rank {rank} exited {c}; last log lines "
+                        f"({log_path}):\n")
+                    try:
+                        with open(log_path, "rb") as f:
+                            sys.stderr.write(
+                                f.read()[-2000:].decode(errors="replace"))
+                    except OSError:
+                        pass
+            sys.exit(max(codes))
+        time.sleep(1.0)
+
+    if store is not None:
+        store.close()

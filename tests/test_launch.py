@@ -1,0 +1,82 @@
+"""Launcher CLI: env contract, multi-process, restart, rank assignment."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(tmp_path, extra_args, script_body, env=None):
+    script = tmp_path / "train.py"
+    script.write_text(script_body)
+    e = dict(os.environ)
+    e["PYTHONPATH"] = REPO
+    e.pop("PADDLE_TRAINER_ID", None)
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "log")] + extra_args + [str(script)],
+        capture_output=True, text=True, env=e, cwd=str(tmp_path), timeout=120,
+    )
+
+
+ENV_SCRIPT = """
+import os, pathlib
+rank = os.environ["PADDLE_TRAINER_ID"]
+world = os.environ["PADDLE_TRAINERS_NUM"]
+pathlib.Path(f"out_{rank}.txt").write_text(f"{rank}/{world}")
+"""
+
+
+def test_launch_two_procs_env(tmp_path):
+    r = _run(tmp_path, ["--nproc_per_node", "2"], ENV_SCRIPT)
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "out_0.txt").read_text() == "0/2"
+    assert (tmp_path / "out_1.txt").read_text() == "1/2"
+    assert (tmp_path / "log" / "default.0.log").exists()
+
+
+def test_launch_restart_on_failure(tmp_path):
+    body = """
+import os, pathlib
+marker = pathlib.Path("attempt.txt")
+n = int(marker.read_text()) if marker.exists() else 0
+marker.write_text(str(n + 1))
+raise SystemExit(1 if n == 0 else 0)
+"""
+    r = _run(tmp_path, ["--max_restart", "1"], body)
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "attempt.txt").read_text() == "2"
+
+
+def test_launch_failure_reports_log(tmp_path):
+    body = "print('boom-marker'); raise SystemExit(3)\n"
+    r = _run(tmp_path, [], body)
+    assert r.returncode == 3
+    assert "boom-marker" in r.stderr
+
+
+def test_launch_master_rank_autoassign(tmp_path):
+    # nnodes=2 simulated locally: two launchers share one master store
+    import threading
+
+    body = ENV_SCRIPT
+    results = {}
+
+    def node(i):
+        results[i] = _run(
+            tmp_path, ["--master", "127.0.0.1:29471", "--nnodes", "2",
+                       "--job_id", "j2"],
+            body,
+        )
+
+    t0 = threading.Thread(target=lambda: node(0))
+    t1 = threading.Thread(target=lambda: node(1))
+    t0.start(); t1.start(); t0.join(); t1.join()
+    assert results[0].returncode == 0, results[0].stderr
+    assert results[1].returncode == 0, results[1].stderr
+    outs = sorted(p.name for p in tmp_path.glob("out_*.txt"))
+    assert outs == ["out_0.txt", "out_1.txt"]
