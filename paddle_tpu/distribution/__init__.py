@@ -328,3 +328,422 @@ def _kl_unif_unif(p, q):
         ),
         p.low, p.high, q.low, q.high,
     )
+
+
+# ---------------------------------------------------------------------------
+# Long-tail distributions (parity: python/paddle/distribution/* modules)
+# ---------------------------------------------------------------------------
+class ExponentialFamily(Distribution):
+    """Base for exponential-family dists (paddle ExponentialFamily)."""
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = Tensor(_arr(loc))
+        self.scale = Tensor(_arr(scale))
+        super().__init__(tuple(self.loc.shape))
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        shp = tuple(shape) + tuple(self.loc._data.shape)
+        return Tensor(self.loc._data + self.scale._data * jax.random.cauchy(key, shp))
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda m, s, v: -jnp.log(math.pi * s * (1 + ((v - m) / s) ** 2)),
+            self.loc, self.scale, value,
+        )
+
+    def entropy(self):
+        return apply_op(lambda s: jnp.log(4 * math.pi * s), self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda m, s, v: jnp.arctan((v - m) / s) / math.pi + 0.5,
+            self.loc, self.scale, value,
+        )
+
+
+class Chi2(Distribution):
+    def __init__(self, df, name=None):
+        self.df = Tensor(_arr(df))
+        super().__init__(tuple(self.df.shape))
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        shp = tuple(shape) + tuple(self.df._data.shape)
+        return Tensor(2.0 * jax.random.gamma(key, self.df._data / 2.0, shp))
+
+    def log_prob(self, value):
+        def _lp(k, v):
+            h = k / 2.0
+            return (h - 1) * jnp.log(v) - v / 2.0 - jax.scipy.special.gammaln(h) - h * math.log(2.0)
+
+        return apply_op(_lp, self.df, value)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = Tensor(_arr(concentration))
+        shp = tuple(self.concentration.shape)
+        super().__init__(shp[:-1], shp[-1:])
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        out = jax.random.dirichlet(
+            key, self.concentration._data,
+            tuple(shape) + tuple(self.concentration._data.shape[:-1]))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def _lp(a, v):
+            return (
+                jnp.sum((a - 1) * jnp.log(v), -1)
+                + jax.scipy.special.gammaln(jnp.sum(a, -1))
+                - jnp.sum(jax.scipy.special.gammaln(a), -1)
+            )
+
+        return apply_op(_lp, self.concentration, value)
+
+    def entropy(self):
+        def _ent(a):
+            a0 = jnp.sum(a, -1)
+            k = a.shape[-1]
+            return (
+                jnp.sum(jax.scipy.special.gammaln(a), -1)
+                - jax.scipy.special.gammaln(a0)
+                + (a0 - k) * jax.scipy.special.digamma(a0)
+                - jnp.sum((a - 1) * jax.scipy.special.digamma(a), -1)
+            )
+
+        return apply_op(_ent, self.concentration)
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = Tensor(_arr(probs))
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _log_norm(self, lam):
+        # log C(lambda); near 0.5 use the taylor-stable limit log(2)
+        safe = jnp.where(jnp.abs(lam - 0.5) < (self._lims[1] - 0.5), 0.4, lam)
+        c = jnp.log(jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))) - jnp.log(
+            jnp.abs(1.0 - 2.0 * safe))
+        return jnp.where(jnp.abs(lam - 0.5) < (self._lims[1] - 0.5),
+                         jnp.log(2.0), c)
+
+    def log_prob(self, value):
+        def _lp(p, v):
+            return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                    + self._log_norm(p))
+
+        return apply_op(_lp, self.probs, value)
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        shp = tuple(shape) + tuple(self.probs._data.shape)
+        u = jax.random.uniform(key, shp)
+        lam = self.probs._data
+        # inverse cdf; the lambda == 0.5 limit is u itself
+        safe = jnp.where(jnp.abs(lam - 0.5) < 1e-3, 0.4, lam)
+        x = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor(jnp.where(jnp.abs(lam - 0.5) < 1e-3, u, x))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (paddle geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = Tensor(_arr(probs))
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        shp = tuple(shape) + tuple(self.probs._data.shape)
+        u = jax.random.uniform(key, shp, minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs._data)))
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda p, v: v * jnp.log1p(-p) + jnp.log(p), self.probs, value
+        )
+
+    def entropy(self):
+        return apply_op(
+            lambda p: (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p,
+            self.probs,
+        )
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: (1 - p) / p, self.probs)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = Tensor(jnp.asarray(_arr(total_count)))
+        self.probs = Tensor(_arr(probs))
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        n = jnp.broadcast_to(self.total_count._data, self.probs._data.shape)
+        shp = tuple(shape) + tuple(self.probs._data.shape)
+        out = jax.random.binomial(key, n.astype(jnp.float32),
+                                  self.probs._data, shape=shp)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def _lp(n, p, v):
+            n = n.astype(jnp.float32)
+            comb = (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n - v + 1))
+            return comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+
+        return apply_op(_lp, self.total_count, self.probs, value)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = Tensor(_arr(rate))
+        super().__init__(tuple(self.rate.shape))
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        shp = tuple(shape) + tuple(self.rate._data.shape)
+        return Tensor(jax.random.poisson(key, self.rate._data, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda r, v: v * jnp.log(r) - r - jax.scipy.special.gammaln(v + 1),
+            self.rate, value,
+        )
+
+    @property
+    def mean(self):
+        return self.rate
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale, name=None):
+        self.df = Tensor(_arr(df))
+        self.loc = Tensor(_arr(loc))
+        self.scale = Tensor(_arr(scale))
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.df._data.shape, self.loc._data.shape, self.scale._data.shape)))
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        shp = tuple(shape) + tuple(self._batch_shape)
+        t = jax.random.t(key, self.df._data, shp)
+        return Tensor(self.loc._data + self.scale._data * t)
+
+    def log_prob(self, value):
+        def _lp(df, m, s, v):
+            z = (v - m) / s
+            return (jax.scipy.special.gammaln((df + 1) / 2)
+                    - jax.scipy.special.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+        return apply_op(_lp, self.df, self.loc, self.scale, value)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = Tensor(_arr(loc))
+        self.scale = Tensor(_arr(scale))
+        self._base = Normal(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    def sample(self, shape=()):
+        return self._base.sample(shape).exp()
+
+    def log_prob(self, value):
+        def _lp(m, s, v):
+            lv = jnp.log(v)
+            return (-((lv - m) ** 2) / (2 * s**2) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi) - lv)
+
+        return apply_op(_lp, self.loc, self.scale, value)
+
+    def entropy(self):
+        return apply_op(
+            lambda m, s: m + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+            self.loc, self.scale,
+        )
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = Tensor(_arr(loc))
+        if covariance_matrix is not None:
+            cov = _arr(covariance_matrix)
+        elif scale_tril is not None:
+            st = _arr(scale_tril)
+            cov = st @ jnp.swapaxes(st, -1, -2)
+        elif precision_matrix is not None:
+            cov = jnp.linalg.inv(_arr(precision_matrix))
+        else:
+            raise ValueError("need covariance_matrix/precision_matrix/scale_tril")
+        self.covariance_matrix = Tensor(cov)
+        self._chol = jnp.linalg.cholesky(cov)
+        super().__init__(tuple(self.loc.shape[:-1]), tuple(self.loc.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        shp = tuple(shape) + tuple(self.loc._data.shape)
+        z = jax.random.normal(key, shp)
+        return Tensor(self.loc._data + jnp.einsum("...ij,...j->...i", self._chol, z))
+
+    def log_prob(self, value):
+        chol = self._chol
+
+        def _lp(m, v):
+            d = m.shape[-1]
+            diff = v - m
+            sol = jax.scipy.linalg.solve_triangular(chol, diff[..., None],
+                                                    lower=True)[..., 0]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(chol, axis1=-2, axis2=-1)), -1)
+            return (-0.5 * jnp.sum(sol * sol, -1) - logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+
+        return apply_op(_lp, self.loc, value)
+
+    def entropy(self):
+        chol = self._chol
+
+        def _ent(m):
+            d = m.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(chol, axis1=-2, axis2=-1)), -1)
+            return 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+
+        return apply_op(_ent, self.loc)
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (paddle Independent)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = reinterpreted_batch_rank
+        bs = tuple(base.batch_shape)
+        super().__init__(bs[:len(bs) - reinterpreted_batch_rank],
+                         bs[len(bs) - reinterpreted_batch_rank:])
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return lp.sum(axis=tuple(range(-self._rank, 0)))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return ent.sum(axis=tuple(range(-self._rank, 0)))
+
+
+class TransformedDistribution(Distribution):
+    """base pushed through a chain of transforms (paddle
+    TransformedDistribution)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(tuple(base.batch_shape), tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    rsample = sample
+
+    def log_prob(self, value):
+        lp = 0.0
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - t.forward_log_det_jacobian(x)
+            y = x
+        return self.base.log_prob(y) + lp
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    return apply_op(
+        lambda a, b: a * (jnp.log(a) - jnp.log(b))
+        + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)),
+        p.probs_t, q.probs_t,
+    )
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    return apply_op(
+        lambda rp, rq: jnp.log(rp) - jnp.log(rq) + rq / rp - 1.0,
+        p.rate, q.rate,
+    )
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    return apply_op(
+        lambda mp_, sp, mq, sq: (
+            jnp.log(sq / sp)
+            + jnp.abs(mp_ - mq) / sq
+            + sp / sq * jnp.exp(-jnp.abs(mp_ - mq) / sp)
+            - 1
+        ),
+        p.loc, p.scale, q.loc, q.scale,
+    )
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def _kl(a1, b1, a2, b2):
+        g = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        t = g(a2) + g(b2) - g(a2 + b2) - (g(a1) + g(b1) - g(a1 + b1))
+        return (t + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+
+    return apply_op(_kl, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def _kl(c1, r1, c2, r2):
+        g = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        return ((c1 - c2) * dg(c1) - g(c1) + g(c2)
+                + c2 * (jnp.log(r1) - jnp.log(r2)) + c1 * (r2 / r1 - 1.0))
+
+    return apply_op(_kl, p.concentration, p.rate, q.concentration, q.rate)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dir_dir(p, q):
+    def _kl(a, b):
+        g = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        a0 = jnp.sum(a, -1)
+        return (g(a0) - jnp.sum(g(a), -1) - g(jnp.sum(b, -1))
+                + jnp.sum(g(b), -1)
+                + jnp.sum((a - b) * (dg(a) - dg(a0)[..., None]), -1))
+
+    return apply_op(_kl, p.concentration, q.concentration)
+
+
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform,
+)

@@ -1,0 +1,246 @@
+"""paddle.distribution.transform (parity: python/paddle/distribution/
+transform.py): bijectors with forward/inverse/log-det-jacobian."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.OTHER
+
+    def forward(self, x):
+        return apply_op(self._forward, x, _op_name=type(self).__name__)
+
+    def inverse(self, y):
+        return apply_op(self._inverse, y, _op_name=type(self).__name__ + "_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(self._fldj, x, _op_name=type(self).__name__ + "_fldj")
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        from ..core.tensor import Tensor
+
+        self.loc = loc._data if isinstance(loc, Tensor) else jnp.asarray(loc)
+        self.scale = scale._data if isinstance(scale, Tensor) else jnp.asarray(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        from ..core.tensor import Tensor
+
+        self.power = power._data if isinstance(power, Tensor) else jnp.asarray(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("softmax is not a bijection")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> K-simplex via stick breaking."""
+
+    _type = Type.BIJECTION
+
+    @staticmethod
+    def _offsets(k, dtype):
+        return jnp.log(jnp.arange(k, 0, -1).astype(dtype))
+
+    def _forward(self, x):
+        z = jax.nn.sigmoid(x - self._offsets(x.shape[-1], x.dtype))
+        one = jnp.ones_like(z[..., :1])
+        return jnp.concatenate([z, one], -1) * jnp.concatenate(
+            [one, jnp.cumprod(1 - z, -1)], -1
+        )
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        sum_prev = jnp.cumsum(y_crop, -1) - y_crop
+        z = y_crop / (1 - sum_prev)
+        return (jnp.log(z) - jnp.log1p(-z)
+                + self._offsets(y_crop.shape[-1], y.dtype))
+
+    def _fldj(self, x):
+        z = jax.nn.sigmoid(x - self._offsets(x.shape[-1], x.dtype))
+        one = jnp.ones_like(z[..., :1])
+        rem_prev = jnp.concatenate(
+            [one, jnp.cumprod(1 - z, -1)[..., :-1]], -1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(rem_prev), -1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = reinterpreted_batch_rank
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        j = self.base.forward_log_det_jacobian(x)
+        return j.sum(axis=tuple(range(-self._rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(tuple(batch) + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(tuple(batch) + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        parts = paddle.unstack(x, axis=self.axis)
+        outs = [t.forward(p) for t, p in zip(self.transforms, parts)]
+        return paddle.stack(outs, axis=self.axis)
+
+    def inverse(self, y):
+        import paddle_tpu as paddle
+
+        parts = paddle.unstack(y, axis=self.axis)
+        outs = [t.inverse(p) for t, p in zip(self.transforms, parts)]
+        return paddle.stack(outs, axis=self.axis)

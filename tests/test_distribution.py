@@ -1,0 +1,172 @@
+"""Distribution family numerics vs torch.distributions references."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+td = torch.distributions
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x))
+
+
+def _chk(ours, theirs, atol=1e-4):
+    np.testing.assert_allclose(
+        np.asarray(ours.numpy() if hasattr(ours, "numpy") else ours),
+        theirs.numpy(), atol=atol, rtol=1e-4,
+    )
+
+
+def test_log_probs_match_torch():
+    import paddle_tpu.distribution as D
+
+    v = np.array([0.3, 1.2, 2.5], np.float32)
+    pos = np.array([0.1, 0.5, 0.9], np.float32)
+    cases = [
+        (D.Normal(0.5, 1.3), td.Normal(0.5, 1.3), v),
+        (D.Laplace(0.5, 1.3), td.Laplace(0.5, 1.3), v),
+        (D.Cauchy(0.5, 1.3), td.Cauchy(0.5, 1.3), v),
+        (D.Gumbel(0.5, 1.3), td.Gumbel(0.5, 1.3), v),
+        (D.Exponential(0.7), td.Exponential(0.7), v),
+        (D.Gamma(2.0, 3.0), td.Gamma(2.0, 3.0), v),
+        (D.Chi2(3.0), td.Chi2(3.0), v),
+        (D.Beta(2.0, 3.0), td.Beta(2.0, 3.0), pos),
+        (D.LogNormal(0.2, 0.9), td.LogNormal(0.2, 0.9), v),
+        (D.StudentT(4.0, 0.5, 1.3), td.StudentT(4.0, 0.5, 1.3), v),
+        (D.Poisson(2.5), td.Poisson(2.5), np.array([0., 1., 4.], np.float32)),
+        (D.Geometric(0.3), td.Geometric(0.3), np.array([0., 2., 5.], np.float32)),
+        (D.Bernoulli(0.3), td.Bernoulli(0.3), np.array([0., 1., 1.], np.float32)),
+    ]
+    import paddle_tpu as paddle
+
+    for ours, theirs, val in cases:
+        _chk(ours.log_prob(paddle.to_tensor(val)), theirs.log_prob(_t(val)))
+
+
+def test_binomial_and_dirichlet_log_prob():
+    import paddle_tpu as paddle
+    import paddle_tpu.distribution as D
+
+    b = D.Binomial(10, 0.3)
+    tb = td.Binomial(10, torch.tensor(0.3))
+    val = np.array([2.0, 5.0], np.float32)
+    _chk(b.log_prob(paddle.to_tensor(val)), tb.log_prob(_t(val)))
+
+    conc = np.array([1.5, 2.0, 3.0], np.float32)
+    dd = D.Dirichlet(conc)
+    tdd = td.Dirichlet(_t(conc))
+    val = np.array([0.2, 0.3, 0.5], np.float32)
+    _chk(dd.log_prob(paddle.to_tensor(val)), tdd.log_prob(_t(val)))
+    _chk(dd.entropy(), tdd.entropy())
+
+
+def test_mvn_log_prob_and_entropy():
+    import paddle_tpu as paddle
+    import paddle_tpu.distribution as D
+
+    loc = np.array([0.5, -0.3], np.float32)
+    cov = np.array([[1.2, 0.3], [0.3, 0.8]], np.float32)
+    ours = D.MultivariateNormal(loc, covariance_matrix=cov)
+    theirs = td.MultivariateNormal(_t(loc), covariance_matrix=_t(cov))
+    val = np.array([0.1, 0.2], np.float32)
+    _chk(ours.log_prob(paddle.to_tensor(val)), theirs.log_prob(_t(val)))
+    _chk(ours.entropy(), theirs.entropy())
+    s = ours.sample([4])
+    assert tuple(s.shape) == (4, 2)
+
+
+def test_kl_pairs_match_torch():
+    import paddle_tpu.distribution as D
+
+    pairs = [
+        (D.Normal(0.0, 1.0), D.Normal(0.5, 2.0),
+         td.Normal(0.0, 1.0), td.Normal(0.5, 2.0)),
+        (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0),
+         td.Laplace(0.0, 1.0), td.Laplace(0.5, 2.0)),
+        (D.Exponential(0.7), D.Exponential(1.3),
+         td.Exponential(0.7), td.Exponential(1.3)),
+        (D.Beta(2.0, 3.0), D.Beta(1.5, 2.5),
+         td.Beta(2.0, 3.0), td.Beta(1.5, 2.5)),
+        (D.Gamma(2.0, 3.0), D.Gamma(1.5, 2.5),
+         td.Gamma(2.0, 3.0), td.Gamma(1.5, 2.5)),
+        (D.Bernoulli(0.3), D.Bernoulli(0.6),
+         td.Bernoulli(0.3), td.Bernoulli(0.6)),
+    ]
+    for p, q, tp, tq in pairs:
+        _chk(D.kl_divergence(p, q), td.kl_divergence(tp, tq))
+
+    conc1 = np.array([1.5, 2.0, 3.0], np.float32)
+    conc2 = np.array([2.5, 1.0, 2.0], np.float32)
+    _chk(D.kl_divergence(D.Dirichlet(conc1), D.Dirichlet(conc2)),
+         td.kl_divergence(td.Dirichlet(_t(conc1)), td.Dirichlet(_t(conc2))))
+
+
+def test_independent_and_transformed():
+    import paddle_tpu as paddle
+    import paddle_tpu.distribution as D
+
+    base = D.Normal(np.zeros((3, 2), np.float32), np.ones((3, 2), np.float32))
+    ind = D.Independent(base, 1)
+    val = np.random.RandomState(0).randn(3, 2).astype(np.float32)
+    lp = ind.log_prob(paddle.to_tensor(val))
+    tlp = td.Independent(td.Normal(torch.zeros(3, 2), torch.ones(3, 2)), 1
+                         ).log_prob(_t(val))
+    _chk(lp, tlp)
+
+    # LogNormal == Normal pushed through exp
+    tdist = D.TransformedDistribution(D.Normal(0.2, 0.9), [D.ExpTransform()])
+    v = np.array([0.5, 1.5], np.float32)
+    _chk(tdist.log_prob(paddle.to_tensor(v)),
+         td.LogNormal(0.2, 0.9).log_prob(_t(v)))
+
+
+def test_transforms_roundtrip_and_jacobians():
+    import paddle_tpu as paddle
+    import paddle_tpu.distribution as D
+
+    x = np.random.RandomState(1).randn(5).astype(np.float32) * 0.5
+    cases = [
+        (D.AffineTransform(1.0, 2.0), td.AffineTransform(1.0, 2.0)),
+        (D.ExpTransform(), td.ExpTransform()),
+        (D.SigmoidTransform(), td.SigmoidTransform()),
+        (D.TanhTransform(), td.TanhTransform()),
+    ]
+    for ours, theirs in cases:
+        xt = paddle.to_tensor(x)
+        y = ours.forward(xt)
+        _chk(y, theirs(_t(x)))
+        back = ours.inverse(y)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x, atol=1e-5)
+        _chk(ours.forward_log_det_jacobian(xt),
+             theirs.log_abs_det_jacobian(_t(x), theirs(_t(x))))
+
+
+def test_stickbreaking_transform():
+    import paddle_tpu as paddle
+    import paddle_tpu.distribution as D
+
+    x = np.random.RandomState(2).randn(4).astype(np.float32)
+    t = D.StickBreakingTransform()
+    tt = td.StickBreakingTransform()
+    xt = paddle.to_tensor(x)
+    y = t.forward(xt)
+    _chk(y, tt(_t(x)))
+    np.testing.assert_allclose(np.asarray(y.numpy()).sum(), 1.0, atol=1e-6)
+    back = t.inverse(y)
+    np.testing.assert_allclose(np.asarray(back.numpy()), x, atol=1e-4)
+    _chk(t.forward_log_det_jacobian(xt),
+         tt.log_abs_det_jacobian(_t(x), tt(_t(x))))
+
+
+def test_sampling_statistics():
+    import paddle_tpu.distribution as D
+
+    for dist, mean, tol in [
+        (D.Poisson(3.0), 3.0, 0.1),
+        (D.Geometric(0.4), 1.5, 0.1),
+        (D.Chi2(4.0), 4.0, 0.2),
+        (D.StudentT(10.0, 1.0, 1.0), 1.0, 0.1),
+        (D.Binomial(10, 0.3), 3.0, 0.1),
+    ]:
+        s = np.asarray(dist.sample([20000]).numpy())
+        np.testing.assert_allclose(s.mean(), mean, atol=3 * tol)
