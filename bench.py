@@ -21,7 +21,7 @@ def main():
 
     import paddle_tpu as paddle
     from paddle_tpu.jit import TrainStep
-    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
     import paddle_tpu.nn.functional as F
 
     if on_tpu:
@@ -34,8 +34,10 @@ def main():
                         num_heads=4, max_seq_len=256, dropout=0.0)
         batch, seq, steps = 2, 128, 3
 
+    # stacked-decoder flagship: lax.scan over layers keeps compile time
+    # constant in depth; recompute = jax.checkpoint per block
     with paddle.amp.auto_cast(enable=on_tpu, dtype="bfloat16", level="O2"):
-        model = GPTForCausalLM(cfg)
+        model = GPTForCausalLMPipe(cfg)
     if on_tpu:
         for _, p in model.named_parameters():
             p._data = p._data.astype(jax.numpy.bfloat16)

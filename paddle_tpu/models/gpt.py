@@ -318,10 +318,15 @@ class StackedDecoder(nn.Layer):
         mesh, pp = self._mesh_pp()
 
         def _run(x, *params):
+            def block(x, p):
+                return _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
+                                   cfg.rope)
+
+            if cfg.recompute:
+                block = jax.checkpoint(block)
+
             def step(x, p):
-                return _block_pure(
-                    p, x, cfg.num_heads, cfg.num_kv_heads, cfg.rope
-                ), None
+                return block(x, p), None
 
             if pp <= 1:
                 out, _ = jax.lax.scan(step, x, tuple(params))

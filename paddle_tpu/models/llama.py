@@ -1,0 +1,216 @@
+"""LLaMA model family + kv-cache generation.
+
+Capability slot: the reference trains/serves LLaMA through PaddleNLP on
+Fleet hybrid parallel (BASELINE.md config 5: LLaMA-7B sharding_stage3 +
+recompute). The architecture here IS the GPT family core (rmsnorm + swiglu
++ rope + GQA, models/gpt.py) with LLaMA naming, presets, and a greedy/
+sampling ``generate`` loop over a kv cache.
+
+TPU-first decode: the cache is a fixed-shape [B, max_len, H, D] buffer
+updated with dynamic_update_slice, so every decode step reuses ONE
+compiled program (no shape churn); attention masks the unwritten tail.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.dispatch import apply_op
+
+from .gpt import (GPTConfig, GPTForCausalLM, GPTForCausalLMPipe, GPTModel,
+                  _rms_pure, _rope_pure)
+
+
+class LlamaConfig(GPTConfig):
+    def __init__(self, **kw):
+        kw.setdefault("norm_type", "rmsnorm")
+        kw.setdefault("act", "swiglu")
+        kw.setdefault("rope", True)
+        kw.setdefault("tie_embeddings", False)
+        super().__init__(**kw)
+
+
+def llama_preset(size="7b", **overrides):
+    presets = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=4,
+                     vocab_size=1024, max_seq_len=512),
+        "7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                   vocab_size=32000, max_seq_len=4096),
+        "13b": dict(hidden_size=5120, num_layers=40, num_heads=40,
+                    vocab_size=32000, max_seq_len=4096),
+        "70b": dict(hidden_size=8192, num_layers=80, num_heads=64,
+                    num_kv_heads=8, vocab_size=32000, max_seq_len=4096,
+                    intermediate_size=28672),
+    }
+    cfg = dict(presets[size])
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+class LlamaModel(GPTModel):
+    pass
+
+
+class LlamaForCausalLM(GPTForCausalLM):
+    """LLaMA decoder LM with generation."""
+
+    def __init__(self, config=None, **kw):
+        if config is None:
+            config = LlamaConfig(**kw)
+        super().__init__(config)
+
+    # -- decode path -------------------------------------------------------
+    def _decode_params(self):
+        """Collect per-layer weights once (name -> stacked python list)."""
+        layers = self.model.layers
+        return [
+            dict(
+                ln1=l.input_norm.weight, wq=l.attn.q_proj.weight,
+                wk=l.attn.k_proj.weight, wv=l.attn.v_proj.weight,
+                wo=l.attn.o_proj.weight, ln2=l.post_attn_norm.weight,
+                wg=l.mlp.gate_proj.weight, wu=l.mlp.up_proj.weight,
+                wd=l.mlp.down_proj.weight,
+            )
+            for l in layers
+        ]
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, seed=0):
+        """Greedy (temperature=0) or sampled decode with a kv cache.
+
+        input_ids: [B, S] Tensor/array. Returns [B, S + max_new_tokens].
+        """
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        ids = input_ids._data if hasattr(input_ids, "_data") else jnp.asarray(
+            input_ids)
+        b, s0 = ids.shape
+        max_len = s0 + max_new_tokens
+        hd = cfg.hidden_size // cfg.num_heads
+        n_layers = cfg.num_layers
+
+        params = self._decode_params()
+        flat_params = []
+        for lp in params:
+            flat_params.extend(
+                lp[k]._data for k in
+                ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd"))
+        embed = self.model.embed_tokens.weight._data
+        fnorm = self.model.final_norm.weight._data
+        head = (self.lm_head.weight._data if self.lm_head is not None
+                else None)
+
+        def rope_at(x, pos):
+            # x: [B, T, H, D] starting at absolute position `pos`
+            d = x.shape[-1]
+            t = x.shape[1]
+            p = (pos + jnp.arange(t))[:, None].astype(jnp.float32)
+            inv = 10000.0 ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+            freqs = p * inv[None, :]
+            sin = jnp.sin(freqs)[None, :, None, :]
+            cos = jnp.cos(freqs)[None, :, None, :]
+            x1, x2 = x[..., : d // 2], x[..., d // 2:]
+            return jnp.concatenate(
+                [x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1
+            ).astype(x.dtype)
+
+        def block_step(x, lp, kcache, vcache, pos, t_new):
+            """One decoder block over t_new tokens at absolute `pos`,
+            updating [B, max_len, Hkv, D] caches in place."""
+            ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
+            bsz, t, hdim = x.shape
+            h = _rms_pure(x, ln1)
+            q = (h @ wq).reshape(bsz, t, cfg.num_heads, hd)
+            k = (h @ wk).reshape(bsz, t, cfg.num_kv_heads, hd)
+            v = (h @ wv).reshape(bsz, t, cfg.num_kv_heads, hd)
+            q, k = rope_at(q, pos), rope_at(k, pos)
+            zero = jnp.int32(0)
+            kcache = jax.lax.dynamic_update_slice(
+                kcache, k.astype(kcache.dtype),
+                (zero, jnp.int32(pos), zero, zero))
+            vcache = jax.lax.dynamic_update_slice(
+                vcache, v.astype(vcache.dtype),
+                (zero, jnp.int32(pos), zero, zero))
+            if cfg.num_kv_heads != cfg.num_heads:
+                rep = cfg.num_heads // cfg.num_kv_heads
+                ck = jnp.repeat(kcache, rep, axis=2)
+                cv = jnp.repeat(vcache, rep, axis=2)
+            else:
+                ck, cv = kcache, vcache
+            # attention over the cache with validity + causal mask
+            scale = 1.0 / math.sqrt(hd)
+            logits = jnp.einsum("bthd,bshd->bhts",
+                                (q * scale).astype(jnp.float32),
+                                ck.astype(jnp.float32))
+            key_pos = jnp.arange(max_len)[None, :]
+            qry_pos = pos + jnp.arange(t)[:, None]
+            mask = key_pos <= qry_pos  # causal + only written slots
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhts,bshd->bthd", probs,
+                           cv.astype(jnp.float32)).astype(x.dtype)
+            o = o.reshape(bsz, t, cfg.num_heads * hd)
+            x = x + o @ wo
+            h2 = _rms_pure(x, ln2)
+            x = x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+            return x, kcache, vcache
+
+        def forward_step(token_ids, caches, pos):
+            """token_ids [B, T] -> (next-token logits [B, V], new caches)."""
+            x = embed[token_ids]
+            new_caches = []
+            for li in range(n_layers):
+                lp = tuple(flat_params[li * 9:(li + 1) * 9])
+                kc, vc = caches[li]
+                x, kc, vc = block_step(x, lp, kc, vc, pos, token_ids.shape[1])
+                new_caches.append((kc, vc))
+            x = _rms_pure(x, fnorm)
+            last = x[:, -1]
+            logits = (last @ head if head is not None
+                      else last @ embed.T)
+            return logits.astype(jnp.float32), new_caches
+
+        @jax.jit
+        def prefill(ids, caches):
+            return forward_step(ids, caches, 0)
+
+        @jax.jit
+        def decode_one(tok, caches, pos, key):
+            logits, caches = forward_step(tok, caches, pos)
+            if temperature > 0.0:
+                lg = logits / temperature
+                if top_k > 0:
+                    kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                    lg = jnp.where(lg < kth, -jnp.inf, lg)
+                nxt = jax.random.categorical(key, lg, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return nxt.astype(ids.dtype), caches
+
+        caches = [
+            (jnp.zeros((b, max_len, cfg.num_kv_heads, hd), embed.dtype),
+             jnp.zeros((b, max_len, cfg.num_kv_heads, hd), embed.dtype))
+            for _ in range(n_layers)
+        ]
+        logits, caches = prefill(ids, caches)
+        nxt = jnp.argmax(logits, axis=-1).astype(ids.dtype)
+        out = [ids, nxt[:, None]]
+        key = jax.random.PRNGKey(seed)
+        pos = s0
+        for i in range(max_new_tokens - 1):
+            key, sub = jax.random.split(key)
+            # pos as a traced scalar: every decode step reuses one program
+            nxt, caches = decode_one(nxt[:, None], caches,
+                                     jnp.int32(pos), sub)
+            out.append(nxt[:, None])
+            pos += 1
+        return paddle.to_tensor(jnp.concatenate(out, axis=1))
+
+
+class LlamaForCausalLMPipe(GPTForCausalLMPipe):
+    pass

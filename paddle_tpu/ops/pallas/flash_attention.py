@@ -105,15 +105,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
         l = l_scr[:, 0:1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0])))
+        lse_row = m_scr[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0]))
+        # [8, bq] sublane-padded block: Mosaic needs >=8 sublanes per block
+        lse_ref[0] = jnp.broadcast_to(lse_row[None, :], (8, lse_row.shape[0]))
 
 
 def _kv_index(b_idx, hq, hk):
-    """Map a flat (batch*q_head) grid index to its (batch*kv_head) block."""
+    """Map a flat (batch*q_head) grid index to its (batch*kv_head) block.
+
+    Uses lax primitives directly: jnp operator dispatch on the int32 grid
+    tracer recurses inside Mosaic's index-map tracing."""
+    if hq == hk:
+        return b_idx
     rep = hq // hk
-    bi = b_idx // hq
-    hi = b_idx % hq
-    return bi * hk + hi // rep
+    hq_c = jnp.int32(hq)
+    bi = jax.lax.div(b_idx, hq_c)
+    hi = jax.lax.rem(b_idx, hq_c)
+    return jax.lax.add(
+        jax.lax.mul(bi, jnp.int32(hk)),
+        jax.lax.div(hi, jnp.int32(rep)),
+    )
 
 
 def _fwd(q, k, v, scale, causal, interpret, hq, hk):
@@ -132,7 +143,18 @@ def _fwd(q, k, v, scale, causal, interpret, hq, hk):
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
         offset=offset,
     )
-    o, lse = pl.pallas_call(
+    # x64 mode (enabled globally for float64 API parity) must not leak into
+    # kernel tracing: Mosaic has no 64-bit types and its lowering crashes on
+    # the int64 literals x64 promotion produces.
+    with jax.enable_x64(False):
+        o, lse = _fwd_call(kern, q, k, v, bhq, sq, sk, d, bq, bk, nq, nk,
+                           hq, hk, interpret)
+    return o, lse[:, 0, :]
+
+
+def _fwd_call(kern, q, k, v, bhq, sq, sk, d, bq, bk, nq, nk, hq, hk,
+              interpret):
+    return pl.pallas_call(
         kern,
         grid=(bhq, nq, nk),
         in_specs=[
@@ -142,11 +164,11 @@ def _fwd(q, k, v, scale, causal, interpret, hq, hk):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bhq, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bhq, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bhq, 8, sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -161,7 +183,6 @@ def _fwd(q, k, v, scale, causal, interpret, hq, hk):
             transcendentals=bhq * sq * sk,
         ),
     )(q, k, v)
-    return o, lse
 
 
 # ---------------------------------------------------------------- backward
@@ -185,11 +206,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         ) * scale
         if causal:
             s = jnp.where(_causal_mask(qi, ki, bq, bk, offset), s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])            # [bq, bk]
+        p = jnp.exp(s - lse_ref[0, 0][:, None])         # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )                                               # [bq, bk]
-        ds = p * (dp - delta_ref[0][:, None])           # [bq, bk]
+        ds = p * (dp - delta_ref[0, 0][:, None])        # [bq, bk]
         dq_acc[:] += scale * jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -229,7 +250,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale
         if causal:
             s = jnp.where(_causal_mask(qi, ki, bq, bk, offset), s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])            # [bq, bk]
+        p = jnp.exp(s - lse_ref[0, 0][:, None])         # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -237,7 +258,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0][:, None])           # [bq, bk]
+        ds = p * (dp - delta_ref[0, 0][:, None])        # [bq, bk]
         dk_acc[:] += scale * jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -264,7 +285,24 @@ def _bwd(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
     rep = hq // hk
     offset = sk - sq
 
+    with jax.enable_x64(False):
+        return _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret,
+                         hq, hk)
+
+
+def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
+    bhq, sq, d = q.shape
+    bhk, sk, _ = k.shape
+    bq, bk = _block_for(sq), _block_for(sk)
+    nq, nk = sq // bq, sk // bk
+    rep = hq // hk
+    offset = sk - sq
+
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # [bh, 8, sq] sublane-padded control tensors (Mosaic block tiling)
+    lse8 = jnp.broadcast_to(lse[:, None, :], (lse.shape[0], 8, lse.shape[1]))
+    delta8 = jnp.broadcast_to(delta[:, None, :],
+                              (delta.shape[0], 8, delta.shape[1]))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -275,14 +313,14 @@ def _bwd(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
             pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bhq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse8, delta8)
 
     # flat (batch*kv_head, j) -> the q-head block owning sweep step j
     def _q_index(b, j):
@@ -300,8 +338,8 @@ def _bwd(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
             pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
             pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
             pl.BlockSpec((1, bq, d), lambda b, jk, j: (_q_index(b, j), j % nq, 0)),
-            pl.BlockSpec((1, bq), lambda b, jk, j: (_q_index(b, j), j % nq)),
-            pl.BlockSpec((1, bq), lambda b, jk, j: (_q_index(b, j), j % nq)),
+            pl.BlockSpec((1, 8, bq), lambda b, jk, j: (_q_index(b, j), 0, j % nq)),
+            pl.BlockSpec((1, 8, bq), lambda b, jk, j: (_q_index(b, j), 0, j % nq)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
@@ -316,7 +354,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse8, delta8)
     return dq, dk, dv
 
 

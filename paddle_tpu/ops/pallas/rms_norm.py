@@ -33,7 +33,14 @@ def _fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps):
 def _fwd(x2, w, eps, interpret):
     n, h = x2.shape
     br = _rows_block(n)
-    o, rstd = pl.pallas_call(
+    # keep Mosaic tracing in 32-bit mode (global x64 is on for API parity)
+    with jax.enable_x64(False):
+        o, rstd = _fwd_call(n, h, br, eps, interpret, x2, w)
+    return o, rstd[:, 0]
+
+
+def _fwd_call(n, h, br, eps, interpret, x2, w):
+    return pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=(n // br,),
         in_specs=[
@@ -50,7 +57,6 @@ def _fwd(x2, w, eps, interpret):
         ],
         interpret=interpret,
     )(x2, w)
-    return o, rstd[:, 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
