@@ -200,3 +200,6 @@ class saved_tensors_hooks:
 
     def __exit__(self, *exc):
         return False
+
+from .functional import (  # noqa: E402,F401
+    Hessian, Jacobian, hessian, jacobian, jvp, vjp)

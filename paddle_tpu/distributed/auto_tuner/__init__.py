@@ -1,0 +1,124 @@
+"""Auto-tuner: black-box search over hybrid-parallel configs.
+
+Parity: `python/paddle/distributed/auto_tuner/` (tuner.py:21 AutoTuner,
+search.py grid search, prune.py constraint pruning). Searches
+(dp, mp, pp, sharding, micro_batch) combinations for a world size, prunes
+infeasible ones with a memory model, and ranks candidates by a
+user-supplied run function (throughput) — the same measure-and-pick loop
+the reference drives with real training trials.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TunerCfg:
+    dp: int
+    mp: int
+    pp: int
+    sharding: int
+    micro_batch: int
+
+    def degree(self):
+        return self.dp * self.mp * self.pp * self.sharding
+
+    def to_dict(self):
+        return dict(dp_degree=self.dp, mp_degree=self.mp, pp_degree=self.pp,
+                    sharding_degree=self.sharding,
+                    micro_batch_size=self.micro_batch)
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def generate_candidates(world_size, global_batch=None, max_mp=None,
+                        max_pp=None):
+    """All (dp, mp, pp, sharding, mbs) filling exactly `world_size`."""
+    out = []
+    for mp in _divisors(world_size):
+        if max_mp and mp > max_mp:
+            continue
+        for pp in _divisors(world_size // mp):
+            if max_pp and pp > max_pp:
+                continue
+            rest = world_size // (mp * pp)
+            for sharding in _divisors(rest):
+                dp = rest // sharding
+                mbs_opts = [1, 2, 4, 8]
+                if global_batch:
+                    per = global_batch // max(dp * sharding, 1)
+                    mbs_opts = [m for m in mbs_opts if per and per % m == 0]
+                for mbs in (mbs_opts or [1]):
+                    out.append(TunerCfg(dp, mp, pp, sharding, mbs))
+    return out
+
+
+def estimate_memory_gb(cfg: TunerCfg, model_params_b, hidden=4096,
+                       layers=32, seq=2048, bytes_per_param=2):
+    """Coarse per-chip memory model (prune.py analogue): params + grads +
+    optimizer states (sharded) + activations (mp/pp/microbatch split)."""
+    shard_factor = cfg.mp * cfg.pp * cfg.sharding
+    param_gb = model_params_b * bytes_per_param / shard_factor / 1e9
+    grad_gb = param_gb
+    # adam moments in fp32
+    opt_gb = model_params_b * 8 / (cfg.mp * cfg.pp * cfg.sharding) / 1e9
+    act_gb = (cfg.micro_batch * seq * hidden * layers * 2 * 12
+              / (cfg.mp * cfg.pp)) / 1e9
+    return param_gb + grad_gb + opt_gb + act_gb
+
+
+def prune_by_memory(candidates, model_params_b, hbm_gb=95, **model_kw):
+    return [c for c in candidates
+            if estimate_memory_gb(c, model_params_b, **model_kw) < hbm_gb]
+
+
+class AutoTuner:
+    """parity: auto_tuner/tuner.py:21."""
+
+    def __init__(self, tuner_cfg: dict):
+        self.cfg = tuner_cfg
+        world = tuner_cfg.get("world_size", 8)
+        cands = generate_candidates(
+            world,
+            global_batch=tuner_cfg.get("global_batch_size"),
+            max_mp=tuner_cfg.get("max_mp_degree"),
+            max_pp=tuner_cfg.get("max_pp_degree"),
+        )
+        params_b = tuner_cfg.get("model_params_b")
+        if params_b:
+            cands = prune_by_memory(
+                cands, params_b, hbm_gb=tuner_cfg.get("hbm_gb", 95))
+        self.candidates = cands
+        self.history = []
+        self._it = iter(self.candidates)
+
+    def search_once(self):
+        """Next untried candidate or None when exhausted."""
+        try:
+            return next(self._it)
+        except StopIteration:
+            return None
+
+    def add_cfg(self, cfg: TunerCfg, metric: float):
+        self.history.append((cfg, metric))
+
+    def get_best_cfg(self):
+        if not self.history:
+            return None
+        return max(self.history, key=lambda kv: kv[1])[0]
+
+    def tune(self, run_fn, max_trials=None):
+        """Measure each candidate with run_fn(cfg) -> throughput; returns
+        the best config."""
+        for i, cfg in enumerate(self.candidates):
+            if max_trials is not None and i >= max_trials:
+                break
+            try:
+                metric = run_fn(cfg)
+            except Exception:
+                metric = float("-inf")
+            self.add_cfg(cfg, metric)
+        return self.get_best_cfg()

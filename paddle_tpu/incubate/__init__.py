@@ -1,5 +1,7 @@
 """paddle.incubate — fused-op APIs (Pallas-backed on TPU) + extras."""
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401
+from . import distributed  # noqa: F401
 
 
 def autotune(config=None):

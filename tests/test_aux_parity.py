@@ -1,0 +1,130 @@
+"""Aux subsystems: functional autograd, quantization, ASP, auto_tuner."""
+import numpy as np
+import pytest
+
+
+def test_jvp_vjp():
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd.functional import jvp, vjp
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+
+    def f(x):
+        return (x ** 2).sum()
+
+    out, tangent = jvp(f, x, paddle.ones_like(x))
+    assert float(out) == 14.0
+    assert float(tangent) == 12.0  # sum(2x)
+
+    out, g = vjp(f, x)
+    np.testing.assert_allclose(np.asarray(g.numpy()), [2.0, 4.0, 6.0])
+
+
+def test_jacobian_hessian():
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd.functional import Hessian, Jacobian
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+
+    def f(x):
+        return x ** 3
+
+    jac = Jacobian(f, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([3.0, 12.0]), atol=1e-5)
+
+    def g(x):
+        return (x ** 3).sum()
+
+    h = Hessian(g, x)
+    np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]), atol=1e-5)
+
+
+def test_qat_trains_and_quantizes():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.quantization import QAT, QuantConfig, QuantedLinear
+
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    q = QAT(QuantConfig())
+    model = q.quantize(model)
+    assert isinstance(model[0], QuantedLinear)
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    xs = paddle.randn([16, 8])
+    ys = paddle.randn([16, 1])
+    losses = []
+    for _ in range(10):
+        loss = ((model(xs) - ys) ** 2).mean()
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_ptq_observe_convert():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.quantization import PTQ, QuantConfig
+
+    model = nn.Sequential(nn.Linear(8, 4))
+    p = PTQ(QuantConfig())
+    model = p.quantize(model)
+    for _ in range(3):
+        model(paddle.randn([4, 8]))
+    model = p.convert(model)
+    assert model[0].static_scales is not None and model[0].static_scales > 0
+    out = model(paddle.randn([4, 8]))
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_asp_2to4_masks():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.incubate import asp
+
+    model = nn.Sequential(nn.Linear(16, 8))
+    asp.prune_model(model)
+    w = np.asarray(model[0].weight.numpy())
+    assert abs(asp.calculate_density(model[0].weight) - 0.5) < 1e-6
+    # every group of 4 has exactly 2 nonzeros
+    groups = w.reshape(-1, 4)
+    assert ((groups != 0).sum(axis=1) == 2).all()
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    x = paddle.randn([4, 16])
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    w2 = np.asarray(model[0].weight.numpy())
+    # mask preserved after the optimizer step
+    assert ((w2.reshape(-1, 4) != 0).sum(axis=1) <= 2).all()
+
+
+def test_auto_tuner_search():
+    from paddle_tpu.distributed.auto_tuner import (
+        AutoTuner, estimate_memory_gb, generate_candidates)
+
+    cands = generate_candidates(8)
+    assert all(c.degree() == 8 for c in cands)
+    assert any(c.mp == 2 and c.pp == 2 for c in cands)
+
+    tuner = AutoTuner({"world_size": 8, "model_params_b": 7e9,
+                       "hbm_gb": 95})
+    assert tuner.candidates  # pruning leaves feasible configs
+
+    # fake measurement: prefer mp=2, mbs=4
+    def run(cfg):
+        return (10 if cfg.mp == 2 else 0) + cfg.micro_batch
+
+    best = tuner.tune(run)
+    assert best.mp == 2 and best.micro_batch == 8
+
+
+def test_memory_model_monotonic():
+    from paddle_tpu.distributed.auto_tuner import TunerCfg, estimate_memory_gb
+
+    small = estimate_memory_gb(TunerCfg(1, 8, 1, 1, 1), 7e9)
+    big = estimate_memory_gb(TunerCfg(8, 1, 1, 1, 1), 7e9)
+    assert small < big
