@@ -105,6 +105,29 @@ def _differentiable(leaf):
     return jnp.issubdtype(leaf._data.dtype, jnp.inexact)
 
 
+def _record_static(fn, leaves, arrays, treedef, out_tree):
+    """Append a replayable closure to the active static Program (the
+    analogue of op-desc insertion, see paddle_tpu/static)."""
+    from ..static import _active_program
+
+    prog = _active_program()
+    if prog is None:
+        return
+    tensor_pos = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+
+    def replay(tensor_arrays, _arrays=list(arrays), _pos=tuple(tensor_pos),
+               _treedef=treedef):
+        buf = list(_arrays)
+        for p, a in zip(_pos, tensor_arrays):
+            buf[p] = a
+        a2, k2 = tree_util.tree_unflatten(_treedef, buf)
+        return fn(*a2, **k2)
+
+    out_leaves = [t for t in tree_util.tree_flatten(
+        out_tree, is_leaf=_is_tensor)[0] if _is_tensor(t)]
+    prog._record(replay, [leaves[i] for i in tensor_pos], out_leaves)
+
+
 def apply_op(fn, *args, _op_name=None, **kwargs):
     """Run pure jax function `fn` over (args, kwargs) that may contain Tensors.
 
@@ -128,7 +151,9 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
     if not diff_pos:
         a2, k2 = tree_util.tree_unflatten(treedef, arrays)
         out = fn(*a2, **k2)
-        return _wrap_outputs(out, node=None)
+        wrapped = _wrap_outputs(out, node=None)
+        _record_static(fn, leaves, arrays, treedef, wrapped)
+        return wrapped
 
     def pure(diff_arrays):
         buf = list(arrays)
@@ -161,7 +186,9 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
             t._grad_node = node
             t._out_index = idx
         wrapped.append(t)
-    return tree_util.tree_unflatten(out_treedef, wrapped)
+    out_tree = tree_util.tree_unflatten(out_treedef, wrapped)
+    _record_static(fn, leaves, arrays, treedef, out_tree)
+    return out_tree
 
 
 def _wrap_outputs(out, node):

@@ -121,6 +121,13 @@ class Optimizer:
         return reg._apply_arr(p._data, g_arr)
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static import _active_program
+
+        prog = _active_program()
+        if prog is not None:
+            # static capture: Executor.run performs the jitted train step
+            prog._minimize = (self, loss)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

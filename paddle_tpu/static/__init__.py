@@ -1,14 +1,198 @@
-"""paddle.static — compatibility surface.
+"""paddle.static — Program/Executor over tape capture.
 
-The reference's Program/Executor machinery (SURVEY §3.5) is replaced by
-jax.jit whole-graph compilation; this module keeps the commonly-used symbols
-(InputSpec, name scopes, io helpers) so static-style code imports cleanly.
+Capability parity: the reference's static graph stack (SURVEY §3.5:
+`Executor.run` base/executor.py:1693 -> StandaloneExecutor ->
+PirInterpreter). TPU-native redesign: a `Program` is a recording of the
+ops executed under ``program_guard`` (every framework op flows through
+``core.dispatch.apply_op``, which appends replayable closures here — the
+analogue of op-desc insertion into a Block). `Executor.run` replays the
+recording with feeds substituted; when an optimizer registered via
+``minimize`` the replay becomes a jitted train step (value_and_grad +
+functional optimizer update), i.e. the whole Program compiles to one XLA
+program exactly like the dygraph TrainStep path.
 """
 from __future__ import annotations
 
 import contextlib
 
 import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+_PROGRAM_STACK = []
+
+
+def _active_program():
+    return _PROGRAM_STACK[-1] if _PROGRAM_STACK else None
+
+
+class Program:
+    """parity: base/framework.py Program (op recording + feeds)."""
+
+    def __init__(self):
+        self.feeds = {}        # name -> placeholder Tensor
+        self.records = []      # (replay_fn, in_tensors, out_tensors)
+        self._minimize = None  # (optimizer, loss Tensor)
+        self.random_seed = None
+
+    # -- recording hooks (called from core.dispatch.apply_op) -------------
+    def _record(self, replay_fn, in_tensors, out_tensors):
+        self.records.append((replay_fn, list(in_tensors), list(out_tensors)))
+
+    def trainable_params(self):
+        seen, out = set(), []
+        opt = self._minimize[0] if self._minimize else None
+        allow = (None if opt is None or opt._parameter_list is None
+                 else {id(p) for p in opt._parameter_list})
+        for _, ins, _ in self.records:
+            for t in ins:
+                if (isinstance(t, Parameter) and t.trainable
+                        and id(t) not in seen
+                        and (allow is None or id(t) in allow)):
+                    seen.add(id(t))
+                    out.append(t)
+        return out
+
+    # -- Program surface ---------------------------------------------------
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.feeds = dict(self.feeds)
+        p.records = list(self.records)
+        if not for_test:
+            p._minimize = self._minimize
+        return p
+
+    def list_vars(self):
+        return list(self.feeds.values())
+
+    @property
+    def num_blocks(self):
+        return 1
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _PROGRAM_STACK.append(main_program)
+    try:
+        yield
+    finally:
+        _PROGRAM_STACK.pop()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder (parity: paddle.static.data)."""
+    import jax.numpy as jnp
+
+    from .. import dtypes as _dt
+
+    concrete = [1 if (s is None or int(s) < 0) else int(s) for s in shape]
+    t = Tensor(jnp.zeros(concrete, _dt.convert_dtype(dtype).np_dtype),
+               stop_gradient=True, name=name)
+    prog = _active_program() or _default_main
+    prog.feeds[name] = t
+    return t
+
+
+class Executor:
+    """parity: base/executor.py:1237 Executor."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        import jax
+        from jax import tree_util
+
+        program = program if isinstance(program, Program) else (
+            program or _default_main)
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not program.records:  # startup program: params already live
+            return [None for _ in fetch_list]
+
+        feed_names = sorted(program.feeds.keys() & feed.keys())
+        feed_tensors = [program.feeds[n] for n in feed_names]
+        params = program.trainable_params()
+
+        def forward(param_arrays, feed_arrays):
+            env = {}
+            for t, a in zip(feed_tensors, feed_arrays):
+                env[id(t)] = a
+            for t, a in zip(params, param_arrays):
+                env[id(t)] = a
+            for replay_fn, ins, outs in program.records:
+                ins_a = [env.get(id(t), t._data) for t in ins]
+                out = replay_fn(ins_a)
+                out_leaves = tree_util.tree_flatten(out)[0]
+                for t, a in zip(outs, out_leaves):
+                    env[id(t)] = a
+            return env
+
+        feed_arrays = [Tensor(np.asarray(feed[n]))._data for n in feed_names]
+        param_arrays = [p._data for p in params]
+
+        if program._minimize is not None:
+            opt, loss_t = program._minimize
+
+            def train_step(param_arrays, feed_arrays, lr, opt_state):
+                def loss_of(pa):
+                    env = forward(pa, feed_arrays)
+                    return env[id(loss_t)], env
+
+                (loss, env), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(param_arrays)
+                named = {str(i): a for i, a in enumerate(param_arrays)}
+                gnamed = {str(i): g for i, g in enumerate(grads)}
+                new_named, new_state = opt.functional_update(
+                    named, gnamed, opt_state, lr)
+                new_params = [new_named[str(i)]
+                              for i in range(len(param_arrays))]
+                fetches = [env.get(id(f), getattr(f, "_data", None))
+                           for f in fetch_list]
+                return new_params, new_state, fetches
+
+            if not hasattr(program, "_opt_state"):
+                named = {str(i): a for i, a in enumerate(param_arrays)}
+                program._opt_state = opt.functional_state(named)
+                program._compiled = jax.jit(train_step)
+            new_params, program._opt_state, fetches = program._compiled(
+                param_arrays, feed_arrays, opt.get_lr(), program._opt_state)
+            for p, a in zip(params, new_params):
+                p._data = a
+            opt._step_count += 1
+        else:
+            def eval_step(param_arrays, feed_arrays):
+                env = forward(param_arrays, feed_arrays)
+                return [env.get(id(f), getattr(f, "_data", None))
+                        for f in fetch_list]
+
+            if not hasattr(program, "_compiled_eval"):
+                program._compiled_eval = jax.jit(eval_step)
+            fetches = program._compiled_eval(param_arrays, feed_arrays)
+
+        if return_numpy:
+            return [np.asarray(f) if f is not None else None
+                    for f in fetches]
+        return [Tensor(f) if f is not None else None for f in fetches]
+
+    def close(self):
+        pass
 
 
 class InputSpec:
@@ -45,3 +229,19 @@ def load(path, **kwargs):
     from .. import jit
 
     return jit.load(path, **kwargs)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """parity: paddle.static.gradients — eager fallback via autograd."""
+    from .. import autograd
+
+    return autograd.grad(targets, inputs, grad_outputs=target_gradients,
+                         retain_graph=True)
+
+
+def cpu_places(device_count=None):
+    return ["cpu"]
+
+
+def device_guard(device=None):
+    return contextlib.nullcontext()

@@ -1,0 +1,84 @@
+"""paddle.static Program/Executor: capture, replay, minimize."""
+import numpy as np
+import pytest
+
+
+def test_static_forward_program():
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4])
+        y = paddle.tanh(x) * 2.0 + 1.0
+
+    exe = static.Executor()
+    arr = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    (out,) = exe.run(main, feed={"x": arr}, fetch_list=[y])
+    np.testing.assert_allclose(out, np.tanh(arr) * 2.0 + 1.0, atol=1e-6)
+
+    # different feed replays the same compiled program
+    arr2 = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    (out2,) = exe.run(main, feed={"x": arr2}, fetch_list=[y])
+    np.testing.assert_allclose(out2, np.tanh(arr2) * 2.0 + 1.0, atol=1e-6)
+
+
+def test_static_layer_and_minimize():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    paddle.seed(0)
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 4])
+        label = static.data("label", [8, 1])
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+        pred = net(x)
+        loss = ((pred - label) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 4).astype(np.float32)
+    ys = (xs.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    losses = []
+    for _ in range(15):
+        (lv,) = exe.run(main, feed={"x": xs, "label": ys},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_static_clone_for_test_drops_optimizer():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 4])
+        lin = nn.Linear(4, 2)
+        out = lin(x)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize((out ** 2).mean())
+
+    test_prog = main.clone(for_test=True)
+    assert test_prog._minimize is None
+    exe = static.Executor()
+    (o,) = exe.run(test_prog, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[out])
+    assert o.shape == (2, 2)
+
+
+def test_default_program_guard():
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    # ops outside program_guard are NOT recorded
+    before = len(static.default_main_program().records)
+    _ = paddle.tanh(paddle.ones([2]))
+    assert len(static.default_main_program().records) == before
