@@ -1,0 +1,10 @@
+"""paddle.audio — spectral features (parity: python/paddle/audio)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from . import functional  # noqa: F401

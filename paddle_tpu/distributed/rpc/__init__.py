@@ -1,0 +1,152 @@
+"""paddle.distributed.rpc — process RPC (parity: distributed/rpc/rpc.py:85
+init_rpc / rpc_sync / rpc_async / shutdown over the C++ brpc agent).
+
+TPU-native transport: the native TCPStore carries pickled call requests
+and results (control-plane RPC only; tensor traffic rides XLA
+collectives). Each worker runs a poller thread that executes requests
+addressed to it. Single-process mode executes calls inline.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import uuid
+
+from ..store import TCPStore
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip=None, port=None):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+_state = {
+    "store": None, "name": None, "rank": 0, "world": 1,
+    "workers": {}, "poller": None, "stop": False,
+}
+
+
+def init_rpc(name, rank=0, world_size=1, master_endpoint=None):
+    if master_endpoint:
+        host, port = master_endpoint.split(":")
+        store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
+                         world_size=world_size)
+    else:
+        store = TCPStore(is_master=True, world_size=1)
+    _state.update(store=store, name=name, rank=rank, world=world_size,
+                  stop=False)
+    store.set(f"rpc/worker/{rank}", name)
+    _state["workers"][name] = WorkerInfo(name, rank)
+
+    def poll():
+        seq = 0
+        while not _state["stop"]:
+            req = store.get(f"rpc/call/{name}/{seq}")
+            if req is None:
+                time.sleep(0.01)
+                continue
+            call_id, fn, args, kwargs = pickle.loads(req)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # deliver the exception to the caller
+                result = (False, e)
+            store.set(f"rpc/result/{call_id}", pickle.dumps(result))
+            seq += 1
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    _state["poller"] = t
+
+
+def get_worker_info(name=None):
+    if name is None:
+        name = _state["name"]
+    if name in _state["workers"]:
+        return _state["workers"][name]
+    # discover via store
+    store = _state["store"]
+    for r in range(_state["world"]):
+        n = store.get(f"rpc/worker/{r}")
+        if n is not None and n.decode() == name:
+            info = WorkerInfo(name, r)
+            _state["workers"][name] = info
+            return info
+    raise ValueError(f"unknown rpc worker {name!r}")
+
+
+def get_all_worker_infos():
+    store = _state["store"]
+    infos = []
+    for r in range(_state["world"]):
+        n = store.get(f"rpc/worker/{r}")
+        if n is not None:
+            infos.append(WorkerInfo(n.decode(), r))
+    return infos
+
+
+class _Future:
+    def __init__(self, call_id, inline_result=None, done=False):
+        self._call_id = call_id
+        self._result = inline_result
+        self._done = done
+
+    def wait(self, timeout=60.0):
+        if self._done:
+            ok, val = self._result
+            if not ok:
+                raise val
+            return val
+        store = _state["store"]
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            raw = store.get(f"rpc/result/{self._call_id}")
+            if raw is not None:
+                ok, val = pickle.loads(raw)
+                self._done = True
+                self._result = (ok, val)
+                if not ok:
+                    raise val
+                return val
+            time.sleep(0.01)
+        raise TimeoutError(f"rpc call {self._call_id} timed out")
+
+
+_seq_counters = {}
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=60.0):
+    args = args or ()
+    kwargs = kwargs or {}
+    if to == _state["name"]:
+        try:
+            return _Future(None, (True, fn(*args, **kwargs)), done=True)
+        except Exception as e:
+            return _Future(None, (False, e), done=True)
+    call_id = uuid.uuid4().hex
+    seq = _seq_counters.get(to, 0)
+    _seq_counters[to] = seq + 1
+    _state["store"].set(
+        f"rpc/call/{to}/{seq}",
+        pickle.dumps((call_id, fn, args, kwargs)),
+    )
+    return _Future(call_id)
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=60.0):
+    return rpc_async(to, fn, args, kwargs, timeout).wait(timeout)
+
+
+def shutdown(graceful=True):
+    _state["stop"] = True
+    if _state["poller"] is not None:
+        _state["poller"].join(timeout=2)
+    if _state["store"] is not None:
+        _state["store"].close()
+    _state.update(store=None, poller=None)

@@ -1,0 +1,107 @@
+"""paddle.geometric — graph ops (parity: python/paddle/geometric):
+message passing over segment ops (XLA scatter — the TPU-native form)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], reduce into dst (message_passing/send_recv parity)."""
+    def _sur(x, src, dst):
+        n = out_size or x.shape[0]
+        msgs = x[src]
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=n)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(dst, jnp.float32), dst,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1.0)[:, None]
+        if reduce_op == "max":
+            return jax.ops.segment_max(msgs, dst, num_segments=n)
+        if reduce_op == "min":
+            return jax.ops.segment_min(msgs, dst, num_segments=n)
+        raise ValueError(reduce_op)
+
+    return apply_op(_sur, x, src_index, dst_index, _op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    def _suer(x, y, src, dst):
+        n = out_size or x.shape[0]
+        msgs = x[src]
+        if message_op == "add":
+            msgs = msgs + y
+        elif message_op == "mul":
+            msgs = msgs * y
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=n)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(dst, jnp.float32), dst,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1.0)[:, None]
+        if reduce_op == "max":
+            return jax.ops.segment_max(msgs, dst, num_segments=n)
+        raise ValueError(reduce_op)
+
+    return apply_op(_suer, x, y, src_index, dst_index, _op_name="send_ue_recv")
+
+
+def segment_sum(data, segment_ids, name=None):
+    def _ss(d, ids):
+        return jax.ops.segment_sum(d, ids, num_segments=int(ids.max()) + 1)
+
+    import numpy as np
+
+    ids = segment_ids.numpy() if hasattr(segment_ids, "numpy") else segment_ids
+    n = int(np.asarray(ids).max()) + 1
+
+    def _ss2(d, ids):
+        return jax.ops.segment_sum(d, ids, num_segments=n)
+
+    return apply_op(_ss2, data, segment_ids, _op_name="segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    import numpy as np
+
+    ids = segment_ids.numpy() if hasattr(segment_ids, "numpy") else segment_ids
+    n = int(np.asarray(ids).max()) + 1
+
+    def _sm(d, ids):
+        s = jax.ops.segment_sum(d, ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.float32), ids,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1.0)[:, None]
+
+    return apply_op(_sm, data, segment_ids, _op_name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    import numpy as np
+
+    ids = segment_ids.numpy() if hasattr(segment_ids, "numpy") else segment_ids
+    n = int(np.asarray(ids).max()) + 1
+
+    def _sx(d, ids):
+        return jax.ops.segment_max(d, ids, num_segments=n)
+
+    return apply_op(_sx, data, segment_ids, _op_name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None):
+    import numpy as np
+
+    ids = segment_ids.numpy() if hasattr(segment_ids, "numpy") else segment_ids
+    n = int(np.asarray(ids).max()) + 1
+
+    def _sn(d, ids):
+        return jax.ops.segment_min(d, ids, num_segments=n)
+
+    return apply_op(_sn, data, segment_ids, _op_name="segment_min")

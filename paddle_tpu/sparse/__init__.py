@@ -23,7 +23,8 @@ class SparseCooTensor(Tensor):
         self._bcoo = jsparse.BCOO((val, ind.T), shape=tuple(shape))
         super().__init__(self._bcoo.todense(), stop_gradient=True)
         self._indices = Tensor(ind)
-        self._values = Tensor(val)
+        # keep the caller's Tensor so the autograd graph reaches the values
+        self._values = values if isinstance(values, Tensor) else Tensor(val)
 
     def indices(self):
         return self._indices
@@ -54,11 +55,73 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_g
 
 
 def matmul(x, y, name=None):
+    """Sparse x dense matmul via BCOO dot_general — stays sparse on the
+    lhs (no densify), lowering to gather+segment-sum on TPU."""
+    if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
+        def _smm(values, dense):
+            from jax.experimental import sparse as jsparse
+
+            m = jsparse.BCOO((values, x._bcoo.indices), shape=x._bcoo.shape)
+            return jsparse.bcoo_dot_general(
+                m, dense, dimension_numbers=(((m.ndim - 1,), (0,)), ((), ())))
+
+        return apply_op(_smm, x.values(), y, _op_name="sparse_matmul")
     xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
     yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
     from ..ops.linalg import matmul as _mm
 
     return _mm(xd, yd)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense x dense -> sparse, computing only `mask`'s nonzero positions."""
+    out = matmul(x, y)
+    if isinstance(mask, SparseCooTensor):
+        ind = mask.indices()
+        def _take(o, idx):
+            return o[tuple(idx)]
+        vals = apply_op(_take, out, ind, _op_name="masked_take")
+        return sparse_coo_tensor(ind, vals, tuple(out.shape))
+    return out * mask
+
+
+def _valuewise(name, jfn):
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            vals = apply_op(jfn, x.values(), _op_name=f"sparse_{name}")
+            return sparse_coo_tensor(x.indices(), vals, tuple(x.shape))
+        return apply_op(jfn, x, _op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+sin = _valuewise("sin", jnp.sin)
+tan = _valuewise("tan", jnp.tan)
+asin = _valuewise("asin", jnp.arcsin)
+atan = _valuewise("atan", jnp.arctan)
+sinh = _valuewise("sinh", jnp.sinh)
+tanh = _valuewise("tanh", jnp.tanh)
+asinh = _valuewise("asinh", jnp.arcsinh)
+atanh = _valuewise("atanh", jnp.arctanh)
+sqrt = _valuewise("sqrt", jnp.sqrt)
+square = _valuewise("square", jnp.square)
+abs = _valuewise("abs", jnp.abs)
+expm1 = _valuewise("expm1", jnp.expm1)
+log1p = _valuewise("log1p", jnp.log1p)
+neg = _valuewise("neg", lambda a: -a)
+
+
+def pow(x, factor, name=None):
+    if isinstance(x, SparseCooTensor):
+        vals = apply_op(lambda v: jnp.power(v, factor), x.values(),
+                        _op_name="sparse_pow")
+        return sparse_coo_tensor(x.indices(), vals, tuple(x.shape))
+    return apply_op(lambda v: jnp.power(v, factor), x, _op_name="pow")
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
 
 
 def add(x, y, name=None):

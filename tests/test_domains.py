@@ -1,0 +1,115 @@
+"""Domain libraries: sparse, text, audio, geometric, rpc."""
+import numpy as np
+import pytest
+
+
+def test_sparse_matmul_stays_sparse_and_grads():
+    import paddle_tpu as paddle
+    from paddle_tpu import sparse
+
+    ind = np.array([[0, 1, 2], [1, 0, 2]])
+    vals = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    vals.stop_gradient = False
+    sp = sparse.sparse_coo_tensor(ind, vals, [3, 3])
+    dense = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
+    out = sparse.matmul(sp, dense)
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 1], ref[1, 0], ref[2, 2] = 2.0, 4.0, 6.0
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref)
+
+    loss = (out ** 2).sum()
+    loss.backward()
+    assert vals.grad is not None
+
+
+def test_sparse_valuewise_ops():
+    import paddle_tpu as paddle
+    from paddle_tpu import sparse
+
+    ind = np.array([[0, 1], [1, 0]])
+    sp = sparse.sparse_coo_tensor(ind, [1.0, 4.0], [2, 2])
+    sq = sparse.sqrt(sp)
+    np.testing.assert_allclose(np.asarray(sq.values().numpy()), [1.0, 2.0])
+    assert sq.is_sparse_coo()
+
+
+def test_geometric_send_recv():
+    import paddle_tpu as paddle
+    from paddle_tpu import geometric
+
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 3], np.int32))
+    out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    ref = np.zeros((4, 2), np.float32)
+    ref[1] = x.numpy()[0] + x.numpy()[2]
+    ref[2] = x.numpy()[1]
+    ref[3] = x.numpy()[0]
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref)
+
+    sm = geometric.segment_mean(
+        x, paddle.to_tensor(np.array([0, 0, 1, 1], np.int32)))
+    np.testing.assert_allclose(
+        np.asarray(sm.numpy()),
+        np.stack([x.numpy()[:2].mean(0), x.numpy()[2:].mean(0)]))
+
+
+def test_audio_features():
+    from paddle_tpu.audio import functional as AF
+
+    w = AF.get_window("hann", 16)
+    assert tuple(w.shape) == (16,)
+    fb = AF.compute_fbank_matrix(16000, 512, n_mels=40)
+    assert tuple(fb.shape) == (40, 257)
+    assert float(fb.numpy().min()) >= 0
+
+    import paddle_tpu as paddle
+
+    s = paddle.to_tensor(np.abs(np.random.RandomState(0).randn(10, 10)).astype(np.float32))
+    db = AF.power_to_db(s)
+    assert np.isfinite(np.asarray(db.numpy())).all()
+
+
+def test_text_datasets_and_viterbi():
+    import paddle_tpu as paddle
+    from paddle_tpu import text
+
+    ds = text.UCIHousing(mode="train")
+    assert len(ds) == 404
+
+    pot = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 5, 3).astype(np.float32))
+    trans = paddle.to_tensor(
+        np.random.RandomState(1).randn(3, 3).astype(np.float32))
+    scores, path = text.viterbi_decode(pot, trans)
+    assert tuple(path.shape) == (2, 5)
+
+    # brute-force check batch 0
+    import itertools
+
+    p0 = np.asarray(pot.numpy())[0]
+    t0 = np.asarray(trans.numpy())
+    best, best_path = -1e9, None
+    for tags in itertools.product(range(3), repeat=5):
+        s = p0[0, tags[0]] + sum(
+            t0[tags[i - 1], tags[i]] + p0[i, tags[i]] for i in range(1, 5))
+        if s > best:
+            best, best_path = s, tags
+    np.testing.assert_allclose(float(scores.numpy()[0]), best, atol=1e-5)
+    assert tuple(np.asarray(path.numpy())[0]) == best_path
+
+
+def test_rpc_sync_async():
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc("worker0", rank=0, world_size=1)
+    try:
+        assert rpc.rpc_sync("worker0", max, args=([3, 1, 2],)) == 3
+        fut = rpc.rpc_async("worker0", sum, args=([1, 2, 3],))
+        assert fut.wait() == 6
+        info = rpc.get_worker_info()
+        assert info.name == "worker0" and info.rank == 0
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("worker0", lambda: 1 / 0)
+    finally:
+        rpc.shutdown()
