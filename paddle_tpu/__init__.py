@@ -95,6 +95,12 @@ from . import sparse  # noqa: F401
 from . import distribution  # noqa: F401
 from . import linalg_ns as linalg  # noqa: F401
 from . import fft  # noqa: F401
+from . import onnx  # noqa: F401
+from . import text  # noqa: F401
+from . import audio  # noqa: F401
+from . import geometric  # noqa: F401
+from . import quantization  # noqa: F401
+from . import autograd  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 from .framework_io import save, load  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401

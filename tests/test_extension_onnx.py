@@ -1,0 +1,65 @@
+"""cpp_extension custom ops, StableHLO export, elastic manager."""
+import os
+
+import numpy as np
+import pytest
+
+
+def test_cpp_extension_load_and_run(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.utils import cpp_extension
+
+    src = tmp_path / "my_relu.cc"
+    src.write_text("""
+#include <cstdint>
+extern "C" void custom_relu(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0 ? x[i] : 0;
+}
+extern "C" void custom_double(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = 2.0f * x[i];
+}
+""")
+    mod = cpp_extension.load("my_ops", [str(src)],
+                             build_directory=str(tmp_path))
+    relu = mod.get_op("custom_relu")
+    x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], np.float32))
+    out = relu(x)
+    np.testing.assert_allclose(np.asarray(out.numpy()), [0, 2, 0, 4])
+
+    # works inside jit via pure_callback
+    import jax
+
+    dbl = mod.get_op("custom_double")
+    y = jax.jit(lambda a: dbl(paddle.Tensor(a))._data)(x._data)
+    np.testing.assert_allclose(np.asarray(y), [-2, 4, -6, 8])
+
+
+def test_stablehlo_export(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.static import InputSpec
+
+    model = nn.Sequential(nn.Linear(4, 2))
+    model.eval()
+    out = paddle.onnx.export(
+        model, str(tmp_path / "m"),
+        input_spec=[InputSpec([1, 4], "float32")])
+    text = open(out).read()
+    assert "stablehlo" in text or "dot" in text or "func" in text
+
+
+def test_elastic_manager_heartbeat():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore(is_master=True)
+    m = ElasticManager(store=store)
+    m.np = 1
+    m.enabled = True
+    m.start_heartbeat(interval=0.1)
+    import time
+
+    time.sleep(0.4)
+    assert m.alive_ranks() == [0]
+    assert not m.should_restart()
+    m.exit()
