@@ -75,6 +75,93 @@ from .ops import *  # noqa: F401,F403
 from . import ops as _ops
 
 from .autograd import grad, PyLayer  # noqa: F401
+
+# numeric constants (parity: paddle.pi / e / inf / nan / newaxis)
+import math as _math
+
+import numpy as _np_mod
+
+bool = _np_mod.bool_  # paddle.bool dtype alias (shadows builtins.bool here only)
+pstring = "pstring"   # string-tensor dtype tag (reference: phi StringTensor)
+raw = "raw"           # raw dtype tag (reference: DataType::UNDEFINED carrier)
+
+pi = _math.pi
+e = _math.e
+inf = float("inf")
+nan = float("nan")
+newaxis = None
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    pass
+
+
+def check_shape(tensor):
+    return list(tensor.shape)
+
+
+def get_cuda_rng_state():
+    from . import framework as _fw
+
+    return _fw.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from . import framework as _fw
+
+    _fw.set_rng_state(state)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from .nn.layer.layers import Layer
+
+    holder = Layer.__new__(Layer)
+    Layer.__init__(holder)
+    return holder.create_parameter(shape, attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+class LazyGuard:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
 from . import autograd  # noqa: F401
 
 # subpackages (populated progressively; import lazily where heavy)
@@ -103,6 +190,8 @@ from . import quantization  # noqa: F401
 from . import autograd  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 from .framework_io import save, load  # noqa: F401
+from .ops.compat import to_dlpack, from_dlpack  # noqa: F401
+from .distributed.data_parallel import DataParallel  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,53 @@
+"""paddle.DataParallel (parity: python/paddle/distributed/parallel.py:219).
+
+TPU-native: no EagerReducer/bucketed NCCL allreduce — wrapping marks the
+intent; gradient reduction happens inside the compiled step where GSPMD
+emits a single fused psum over the dp axis (the XLA equivalent of the
+reference's bucket-fused allreduce, reducer.h:88). Eager fallback when a
+multi-device dp mesh is active: average grads across the dp axis after
+backward via the collective API.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        """Average grads over the dp world (fused_allreduce_gradients
+        parity). No-op in single-process SPMD where psum is compiled in."""
+        from . import get_world_size
+
+        if get_world_size() <= 1:
+            return
+        from . import all_reduce
+        from .communication import ReduceOp
+
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

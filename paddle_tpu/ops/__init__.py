@@ -255,3 +255,81 @@ def patch_tensor_methods():
 
 
 patch_tensor_methods()
+from .compat import *  # noqa: F401,F403
+
+
+# -- inplace `_` variants (parity: paddle's trailing-underscore API) --------
+# Functional-core emulation: compute out-of-place, then rebind the payload
+# (the reference mutates the buffer; with XLA's immutable arrays, rebinding
+# is observationally equivalent for the python surface).
+def _make_inplace(base_name):
+    def inplace(x, *args, **kwargs):
+        base = getattr(x, base_name, None)
+        if base is None:
+            import paddle_tpu as _p
+
+            fn = getattr(_p, base_name)
+            out = fn(x, *args, **kwargs)
+        else:
+            out = base(*args, **kwargs)
+        x._data = out._data.astype(x._data.dtype) if out._data.dtype != x._data.dtype else out._data
+        x._grad_node = out._grad_node
+        x._out_index = getattr(out, "_out_index", 0)
+        return x
+
+    inplace.__name__ = base_name + "_"
+    return inplace
+
+
+_INPLACE_BASES = [
+    "abs", "acos", "asin", "atan", "cos", "sin", "tan", "sinh", "cosh",
+    "tanh", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+    "rsqrt", "square", "floor", "ceil", "round", "trunc", "sigmoid",
+    "reciprocal", "neg", "erf", "erfinv", "digamma", "lgamma", "frac",
+    "cumsum", "cumprod", "clip", "scale", "pow", "remainder", "mod",
+    "floor_divide", "floor_mod", "divide", "multiply", "subtract", "add",
+    "equal", "greater_equal", "greater_than", "less_equal", "less_than",
+    "not_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "gcd", "lcm", "hypot", "copysign", "nan_to_num", "logit", "i0",
+    "index_add", "index_put", "index_fill", "tril", "triu", "gammaln",
+    "gammainc", "gammaincc", "multigammaln", "polygamma", "sinc", "ldexp",
+    "renorm", "lerp", "fill_diagonal", "masked_scatter", "t", "less",
+    "addmm",
+    "bitwise_invert", "bitwise_left_shift", "bitwise_right_shift",
+]
+
+import sys as _sys
+
+_mod = _sys.modules[__name__]
+for _b in _INPLACE_BASES:
+    if not hasattr(Tensor, _b) and not hasattr(_mod, _b):
+        continue
+    _ip = _make_inplace(_b)
+    setattr(_mod, _b + "_", _ip)
+    setattr(Tensor, _b + "_", _ip)
+
+
+def _random_inplace(name, sampler):
+    def fn(x, *args, **kwargs):
+        from .. import framework
+
+        x._data = sampler(framework.next_rng_key(), x._data, *args)
+        return x
+
+    fn.__name__ = name
+    setattr(_mod, name, fn)
+    setattr(Tensor, name, fn)
+
+
+import jax as _jax
+
+_random_inplace("cauchy_", lambda k, a, loc=0.0, scale=1.0:
+                (loc + scale * _jax.random.cauchy(k, a.shape)).astype(a.dtype))
+_random_inplace("geometric_", lambda k, a, probs=0.5:
+                jnp.floor(jnp.log(_jax.random.uniform(k, a.shape, minval=1e-7))
+                          / jnp.log1p(-probs)).astype(a.dtype))
+_random_inplace("log_normal_", lambda k, a, mean=1.0, std=2.0:
+                jnp.exp(mean + std * _jax.random.normal(k, a.shape)).astype(a.dtype))
+_random_inplace("exponential_", lambda k, a, lam=1.0:
+                (_jax.random.exponential(k, a.shape) / lam).astype(a.dtype))

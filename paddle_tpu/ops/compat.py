@@ -1,0 +1,350 @@
+"""Long-tail tensor API surface (parity: the remaining python/paddle
+top-level exports — special functions, split/stack helpers, scatter
+variants, reductions). Each op is a pure jnp function through apply_op.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _op(name, jfn):
+    def f(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        return apply_op(lambda a, *r: jfn(a, *r), x, *args, _op_name=name)
+
+    f.__name__ = name
+    return f
+
+
+# -- special functions ------------------------------------------------------
+gammaln = _op("gammaln", lambda a: jax.scipy.special.gammaln(a))
+digamma_fn = lambda a: jax.scipy.special.digamma(a)
+gammainc = _op("gammainc", lambda a, x: jax.scipy.special.gammainc(a, x))
+gammaincc = _op("gammaincc", lambda a, x: jax.scipy.special.gammaincc(a, x))
+i0e = _op("i0e", lambda a: jax.scipy.special.i0e(a))
+i1e = _op("i1e", lambda a: jax.scipy.special.i1e(a))
+sinc = _op("sinc", lambda a: jnp.sinc(a))
+signbit = _op("signbit", lambda a: jnp.signbit(a))
+sgn = _op("sgn", lambda a: jnp.sign(a))
+positive = _op("positive", lambda a: +a)
+bitwise_invert = _op("bitwise_invert", lambda a: jnp.invert(a))
+
+
+def polygamma(x, n, name=None):
+    return apply_op(
+        lambda a: jax.scipy.special.polygamma(int(n), a), x,
+        _op_name="polygamma")
+
+
+def multigammaln(x, p, name=None):
+    def _mg(a):
+        out = 0.25 * p * (p - 1) * math.log(math.pi)
+        for i in range(p):
+            out = out + jax.scipy.special.gammaln(a - i / 2.0)
+        return out
+
+    return apply_op(_mg, x, _op_name="multigammaln")
+
+
+def frexp(x, name=None):
+    return apply_op(lambda a: jnp.frexp(a), x, _op_name="frexp")
+
+
+def ldexp(x, y, name=None):
+    return apply_op(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y,
+                    _op_name="ldexp")
+
+
+# -- reductions -------------------------------------------------------------
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply_op(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
+                    x, _op_name="nanmedian")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.nanquantile(a, q, axis=axis, keepdims=keepdim), x,
+        _op_name="nanquantile")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def _tz(ya, xa):
+        if xa is not None:
+            return jax.scipy.integrate.trapezoid(ya, xa, axis=axis)
+        return jax.scipy.integrate.trapezoid(ya, dx=dx or 1.0, axis=axis)
+
+    return apply_op(_tz, y, x, _op_name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def _ctz(ya, xa):
+        ya = jnp.moveaxis(ya, axis, -1)
+        if xa is not None:
+            xs = jnp.moveaxis(xa, axis, -1) if xa.ndim == ya.ndim else xa
+            d = jnp.diff(xs, axis=-1)
+        else:
+            d = dx or 1.0
+        avg = (ya[..., 1:] + ya[..., :-1]) / 2.0
+        return jnp.moveaxis(jnp.cumsum(avg * d, axis=-1), -1, axis)
+
+    return apply_op(_ctz, y, x, _op_name="cumulative_trapezoid")
+
+
+def reduce_as(x, target, name=None):
+    def _ra(a, t):
+        extra = a.ndim - t.ndim
+        axes = tuple(range(extra)) + tuple(
+            i + extra for i, s in enumerate(t.shape) if s == 1 and a.shape[i + extra] != 1
+        )
+        out = jnp.sum(a, axis=axes, keepdims=False)
+        return out.reshape(t.shape)
+
+    return apply_op(_ra, x, target, _op_name="reduce_as")
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    def _hbe(a):
+        lo, hi = (min, max) if (min or max) else (jnp.min(a), jnp.max(a))
+        return jnp.linspace(lo, hi, bins + 1)
+
+    return apply_op(_hbe, input, _op_name="histogram_bin_edges")
+
+
+def pdist(x, p=2.0, name=None):
+    def _pd(a):
+        n = a.shape[0]
+        diffs = a[:, None, :] - a[None, :, :]
+        d = jnp.linalg.norm(diffs, ord=p, axis=-1)
+        iu = jnp.triu_indices(n, 1)
+        return d[iu]
+
+    return apply_op(_pd, x, _op_name="pdist")
+
+
+def hypot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.hypot(a, b), x, y, _op_name="hypot")
+
+
+# -- construction / reshaping ----------------------------------------------
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op(
+        lambda a: jnp.vander(a, N=n, increasing=increasing), x,
+        _op_name="vander")
+
+
+def block_diag(inputs, name=None):
+    return apply_op(
+        lambda *xs: jax.scipy.linalg.block_diag(*xs), *inputs,
+        _op_name="block_diag")
+
+
+def column_stack(x, name=None):
+    return apply_op(lambda *xs: jnp.column_stack(xs), *x,
+                    _op_name="column_stack")
+
+
+def row_stack(x, name=None):
+    return apply_op(lambda *xs: jnp.vstack(xs), *x, _op_name="row_stack")
+
+
+def hsplit(x, num_or_indices, name=None):
+    return apply_op(lambda a: jnp.hsplit(a, num_or_indices), x,
+                    _op_name="hsplit")
+
+
+def vsplit(x, num_or_indices, name=None):
+    return apply_op(lambda a: jnp.vsplit(a, num_or_indices), x,
+                    _op_name="vsplit")
+
+
+def dsplit(x, num_or_indices, name=None):
+    return apply_op(lambda a: jnp.dsplit(a, num_or_indices), x,
+                    _op_name="dsplit")
+
+
+def unflatten(x, axis, shape, name=None):
+    def _uf(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1:])
+        return a.reshape(new)
+
+    return apply_op(_uf, x, _op_name="unflatten")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    def _us(a):
+        return tuple(jnp.moveaxis(a, axis, 0))
+
+    return list(apply_op(_us, x, _op_name="unstack"))
+
+
+def matrix_transpose(x, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, -1, -2), x,
+                    _op_name="matrix_transpose")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=axis), x, y,
+                    _op_name="vecdot")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        x, _op_name="diagonal")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    def _cb(a):
+        n = a.shape[0]
+        it = (itertools.combinations_with_replacement(range(n), r)
+              if with_replacement else itertools.combinations(range(n), r))
+        idx = jnp.asarray(list(it))
+        return a[idx]
+
+    return apply_op(_cb, x, _op_name="combinations")
+
+
+def cartesian_prod(x, name=None):
+    def _cp(*xs):
+        grids = jnp.meshgrid(*xs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply_op(_cp, *x, _op_name="cartesian_prod")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def _rn(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1, keepdims=True)
+        scale = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return apply_op(_rn, x, _op_name="renorm")
+
+
+# -- scatter family ---------------------------------------------------------
+def select_scatter(x, values, axis, index, name=None):
+    def _ss(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v)
+
+    return apply_op(_ss, x, values, _op_name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def _sls(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sr)
+        return a.at[tuple(idx)].set(v)
+
+    return apply_op(_sls, x, value, _op_name="slice_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def _ds(a, v):
+        n = min(a.shape[axis1], a.shape[axis2])
+        rows = jnp.arange(max(0, -offset), max(0, -offset) + v.shape[-1])
+        cols = jnp.arange(max(0, offset), max(0, offset) + v.shape[-1])
+        idx = [slice(None)] * a.ndim
+        out = a
+        # build advanced index along the two axes
+        index = [slice(None)] * a.ndim
+        index[axis1] = rows
+        index[axis2] = cols
+        return out.at[tuple(index)].set(v)
+
+    return apply_op(_ds, x, y, _op_name="diagonal_scatter")
+
+
+def index_fill(x, index, axis, fill_value, name=None):
+    def _if(a, idx):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].set(fill_value)
+
+    return apply_op(_if, x, index, _op_name="index_fill")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    def _si(a):
+        size = index_num // nshards
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        inside = (a >= lo) & (a < hi)
+        return jnp.where(inside, a - lo, ignore_value)
+
+    return apply_op(_si, input, _op_name="shard_index")
+
+
+def increment(x, value=1.0, name=None):
+    out = apply_op(lambda a: a + value, x, _op_name="increment")
+    x._data = out._data
+    return x
+
+
+def reverse(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda a: jnp.flip(a, ax), x, _op_name="reverse")
+
+
+def view_as(x, other, name=None):
+    return apply_op(lambda a, b: a.reshape(b.shape), x, other,
+                    _op_name="view_as")
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                    _op_name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), x,
+                    _op_name="as_real")
+
+
+# -- random fills -----------------------------------------------------------
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    from .. import framework
+
+    key = framework.next_rng_key()
+    z = jax.random.normal(key, tuple(shape or [1]))
+    return Tensor(jnp.exp(mean + std * z))
+
+
+def standard_gamma(x, name=None):
+    from .. import framework
+
+    def _sg(a):
+        return jax.random.gamma(framework.next_rng_key(), a, a.shape)
+
+    return apply_op(_sg, x, _op_name="standard_gamma")
+
+
+# -- dlpack -----------------------------------------------------------------
+def to_dlpack(x):
+    """Return the jax array itself — it carries __dlpack__/__dlpack_device__
+    (the modern dlpack protocol passes the exporter object, not a capsule)."""
+    return x._data if isinstance(x, Tensor) else x
+
+
+def from_dlpack(ext):
+    if hasattr(ext, "__dlpack__"):
+        return Tensor(jnp.from_dlpack(ext))
+    # legacy capsule path
+    from jax import dlpack as jdl
+
+    return Tensor(jdl.from_dlpack(ext))

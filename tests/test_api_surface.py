@@ -1,0 +1,95 @@
+"""Top-level API surface parity with the reference's __all__ (439 names)."""
+import ast
+
+import numpy as np
+import pytest
+
+
+def test_all_reference_exports_present():
+    import re
+
+    import paddle_tpu
+
+    ref_init = open("/root/reference/python/paddle/__init__.py").read()
+    tree = ast.parse(ref_init)
+    ref_all = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    ref_all = [ast.literal_eval(e) for e in node.value.elts]
+    assert len(ref_all) > 400
+    missing = [n for n in ref_all if not hasattr(paddle_tpu, n)]
+    assert missing == [], f"missing top-level exports: {missing}"
+
+
+def test_inplace_variants_mutate():
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([1.0, 4.0, 9.0], np.float32))
+    y = x.sqrt_()
+    assert y is x
+    np.testing.assert_allclose(np.asarray(x.numpy()), [1, 2, 3])
+
+    z = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    z.add_(paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(z.numpy()), [2, 3])
+
+
+def test_compat_ops_numerics():
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.gammaln(x).numpy()),
+        [0.0, 0.0, np.log(2.0), np.log(6.0)], atol=1e-5)
+
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(
+        np.asarray(paddle.matrix_transpose(m).numpy()), m.numpy().T)
+
+    h = paddle.hsplit(paddle.to_tensor(np.arange(8, dtype=np.float32)), 2)
+    np.testing.assert_allclose(np.asarray(h[1].numpy()), [4, 5, 6, 7])
+
+    tz = paddle.trapezoid(paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+    assert float(tz.numpy()) == 4.0
+
+    bd = paddle.block_diag([paddle.ones([2, 2]), paddle.ones([1, 1]) * 3])
+    assert tuple(bd.shape) == (3, 3)
+    assert float(bd.numpy()[2, 2]) == 3.0
+
+    v = paddle.vander(paddle.to_tensor(np.array([1.0, 2.0], np.float32)), n=3)
+    np.testing.assert_allclose(np.asarray(v.numpy()), [[1, 1, 1], [4, 2, 1]])
+
+
+def test_scatter_variants():
+    import paddle_tpu as paddle
+
+    x = paddle.zeros([3, 4])
+    out = paddle.select_scatter(x, paddle.ones([4]) * 5, 0, 1)
+    np.testing.assert_allclose(np.asarray(out.numpy())[1], [5, 5, 5, 5])
+
+    d = paddle.diagonal_scatter(paddle.zeros([3, 3]), paddle.ones([3]) * 7)
+    np.testing.assert_allclose(np.diag(np.asarray(d.numpy())), [7, 7, 7])
+
+
+def test_dlpack_roundtrip():
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    cap = paddle.to_dlpack(x)
+    y = paddle.from_dlpack(cap)
+    np.testing.assert_allclose(np.asarray(y.numpy()), [0, 1, 2, 3])
+
+
+def test_data_parallel_wrapper():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    m = paddle.DataParallel(nn.Linear(4, 2))
+    out = m(paddle.ones([3, 4]))
+    assert tuple(out.shape) == (3, 2)
+    loss = (out ** 2).mean()
+    loss.backward()
+    m.apply_collective_grads()  # single-process: no-op
+    assert m._layers.weight.grad is not None
