@@ -48,3 +48,10 @@ from .clip import (  # noqa: F401
 )
 
 from ..param_attr import ParamAttr  # noqa: F401
+from .layer.compat import (  # noqa: F401
+    AdaptiveLogSoftmaxWithLoss, BeamSearchDecoder, FeatureAlphaDropout,
+    FractionalMaxPool2D, FractionalMaxPool3D, HSigmoidLoss, LPPool1D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, MultiMarginLoss, PairwiseDistance,
+    ParameterDict, RNNTLoss, Softmax2D, SpectralNorm,
+    TripletMarginWithDistanceLoss, Unflatten, ZeroPad1D, ZeroPad3D,
+    dynamic_decode)

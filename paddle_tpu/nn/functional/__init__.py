@@ -20,3 +20,13 @@ from .flash_attention import (  # noqa: F401
 )
 
 from ...ops.manipulation import pad as _ops_pad  # noqa: F401
+from .compat import *  # noqa: F401,F403
+from .compat import (  # noqa: F401
+    adaptive_log_softmax_with_loss, class_center_sample, dice_loss,
+    feature_alpha_dropout, flash_attn_qkvpacked,
+    flash_attn_varlen_qkvpacked, fractional_max_pool2d,
+    fractional_max_pool3d, gather_tree, hardtanh_, hsigmoid_loss,
+    leaky_relu_, lp_pool1d, margin_cross_entropy, max_unpool1d,
+    max_unpool2d, max_unpool3d, multi_margin_loss, npair_loss,
+    pairwise_distance, rnnt_loss, sequence_mask, sparse_attention,
+    temporal_shift, thresholded_relu_, triplet_margin_with_distance_loss)

@@ -1,0 +1,524 @@
+"""Long-tail nn.functional surface (parity: the remaining
+python/paddle/nn/functional exports)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), -1,
+                    keepdims=keepdim), 1.0 / p),
+        x, y, _op_name="pairwise_distance")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def _sm(lens):
+        m = maxlen or int(lens.max())
+        return (jnp.arange(m)[None, :] < lens[..., None]).astype(
+            jnp.dtype(dtype if dtype != "int64" else np.int64))
+
+    import numpy as np
+
+    lens_np = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    m = maxlen or int(lens_np.max())
+
+    def _sm2(lens):
+        return (jnp.arange(m)[None, :] < lens[..., None]).astype(np.int64)
+
+    return apply_op(_sm2, x, _op_name="sequence_mask")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    from ... import framework
+
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    neg = -alpha * scale
+
+    def _fad(a):
+        key = framework.next_rng_key()
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        A = (p + p * (1 - p) * neg ** 2) ** -0.5
+        B = -A * p * neg
+        return A * jnp.where(keep, a, neg) + B
+
+    return apply_op(_fad, x, _op_name="feature_alpha_dropout")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    stride = stride or kernel_size
+
+    def _lp(a):
+        k, s = int(kernel_size), int(stride)
+        if padding:
+            a = jnp.pad(a, ((0, 0), (0, 0), (padding, padding)))
+        n = (a.shape[-1] - k) // s + 1
+        idx = jnp.arange(n)[:, None] * s + jnp.arange(k)[None, :]
+        windows = a[..., idx]  # [N, C, n, k]
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(windows), norm_type), -1),
+                         1.0 / norm_type)
+
+    return apply_op(_lp, x, _op_name="lp_pool1d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    stride = stride or kernel_size
+
+    def _unpool(a, idx):
+        n, c, l = a.shape
+        out_l = output_size[-1] if output_size else (l - 1) * stride + kernel_size
+        flat = jnp.zeros((n, c, out_l), a.dtype)
+        return flat.at[
+            jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+            idx.astype(jnp.int32)
+        ].set(a)
+
+    return apply_op(_unpool, x, indices, _op_name="max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * 2
+    st = stride if isinstance(stride, (list, tuple)) else (
+        (stride,) * 2 if stride else ks)
+
+    def _unpool(a, idx):
+        n, c, h, w = a.shape
+        if output_size:
+            oh, ow = output_size[-2], output_size[-1]
+        else:
+            oh = (h - 1) * st[0] + ks[0]
+            ow = (w - 1) * st[1] + ks[1]
+        flat = jnp.zeros((n, c, oh * ow), a.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1).astype(jnp.int32)
+        ].set(a.reshape(n, c, -1))
+        return flat.reshape(n, c, oh, ow)
+
+    return apply_op(_unpool, x, indices, _op_name="max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * 3
+    st = stride if isinstance(stride, (list, tuple)) else (
+        (stride,) * 3 if stride else ks)
+
+    def _unpool(a, idx):
+        n, c, d, h, w = a.shape
+        if output_size:
+            od, oh, ow = output_size[-3:]
+        else:
+            od = (d - 1) * st[0] + ks[0]
+            oh = (h - 1) * st[1] + ks[1]
+            ow = (w - 1) * st[2] + ks[2]
+        flat = jnp.zeros((n, c, od * oh * ow), a.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1).astype(jnp.int32)
+        ].set(a.reshape(n, c, -1))
+        return flat.reshape(n, c, od, oh, ow)
+
+    return apply_op(_unpool, x, indices, _op_name="max_unpool3d")
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    os_ = output_size if isinstance(output_size, (list, tuple)) else (output_size,) * 2
+
+    def _fmp(a):
+        n, c, h, w = a.shape
+        oh, ow = os_
+        # deterministic pseudo-fractional index sequences (alpha spacing)
+        ridx = jnp.floor(jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+        cidx = jnp.floor(jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+        rend = jnp.concatenate([ridx[1:], jnp.asarray([h], jnp.int32)])
+        cend = jnp.concatenate([cidx[1:], jnp.asarray([w], jnp.int32)])
+        kh = int(jnp.max(rend - ridx)) if not return_mask else int(h // oh + 1)
+        kh = max(1, math.ceil(h / oh))
+        kw = max(1, math.ceil(w / ow))
+        rows = jnp.minimum(ridx[:, None] + jnp.arange(kh)[None, :], h - 1)
+        cols = jnp.minimum(cidx[:, None] + jnp.arange(kw)[None, :], w - 1)
+        win = a[:, :, rows][:, :, :, :, cols]  # [N,C,oh,kh,ow,kw]
+        return jnp.max(win, axis=(3, 5))
+
+    out = apply_op(_fmp, x, _op_name="fractional_max_pool2d")
+    if return_mask:
+        return out, None
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    os_ = output_size if isinstance(output_size, (list, tuple)) else (output_size,) * 3
+
+    def _fmp(a):
+        n, c, d, h, w = a.shape
+        od, oh, ow = os_
+        def mk(sz, o):
+            idx = jnp.floor(jnp.arange(o) * (sz / o)).astype(jnp.int32)
+            k = max(1, math.ceil(sz / o))
+            return jnp.minimum(idx[:, None] + jnp.arange(k)[None, :], sz - 1)
+        di, hi, wi = mk(d, od), mk(h, oh), mk(w, ow)
+        win = a[:, :, di]                      # [N,C,od,kd,H,W]
+        win = win[:, :, :, :, hi]              # [N,C,od,kd,oh,kh,W]
+        win = win[:, :, :, :, :, :, wi]        # [N,C,od,kd,oh,kh,ow,kw]
+        return jnp.max(win, axis=(3, 5, 7))
+
+    out = apply_op(_fmp, x, _op_name="fractional_max_pool3d")
+    if return_mask:
+        return out, None
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def _dl(p, y):
+        y1 = jax.nn.one_hot(y[..., 0].astype(jnp.int32), p.shape[-1])
+        inter = jnp.sum(p * y1, axis=tuple(range(1, p.ndim)))
+        union = jnp.sum(p, axis=tuple(range(1, p.ndim))) + jnp.sum(
+            y1, axis=tuple(range(1, p.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply_op(_dl, input, label, _op_name="dice_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid with the default complete-binary-tree coding."""
+    def _hs(x, y, w, b):
+        code_len = int(math.ceil(math.log2(max(2, num_classes))))
+        ids = y.reshape(-1).astype(jnp.int32) + num_classes  # leaf position
+        losses = []
+        cur = ids
+        for _ in range(code_len):
+            parent = cur // 2
+            bit = (cur % 2).astype(jnp.float32)  # 1 = right child
+            wrow = w[jnp.clip(parent - 1, 0, w.shape[0] - 1)]
+            logit = jnp.sum(wrow * x, -1)
+            if b is not None:
+                logit = logit + b.reshape(-1)[jnp.clip(parent - 1, 0, b.size - 1)]
+            losses.append(
+                jnp.maximum(logit, 0) - logit * bit + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+            cur = parent
+        return jnp.mean(sum(losses))
+
+    return apply_op(_hs, input, label, weight, bias, _op_name="hsigmoid_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    def _np(a, p, y):
+        sim = a @ p.T
+        eq = (y[:, None] == y[None, :]).astype(jnp.float32)
+        tgt = eq / jnp.sum(eq, -1, keepdims=True)
+        xent = jnp.mean(
+            jnp.sum(-tgt * jax.nn.log_softmax(sim, -1), -1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return xent + reg
+
+    return apply_op(_np, anchor, positive, labels, _op_name="npair_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-style margin softmax (margin_cross_entropy parity)."""
+    def _mce(lg, y):
+        yi = y.reshape(-1).astype(jnp.int32)
+        theta = jnp.arccos(jnp.clip(lg, -1 + 1e-7, 1 - 1e-7))
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(yi, lg.shape[-1])
+        adj = scale * jnp.where(onehot > 0, tgt, lg)
+        losses = -jnp.sum(onehot * jax.nn.log_softmax(adj, -1), -1)
+        if reduction == "mean":
+            loss = jnp.mean(losses)
+        elif reduction == "sum":
+            loss = jnp.sum(losses)
+        else:
+            loss = losses
+        if return_softmax:
+            return loss, jax.nn.softmax(adj, -1)
+        return loss
+
+    return apply_op(_mce, logits, label, _op_name="margin_cross_entropy")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T loss via the standard alpha-lattice dynamic program (log space).
+
+    input: [B, T, U+1, V] log-probs (or logits — normalised internally).
+    """
+    def _rnnt(lp, y, tl, ul):
+        lp = jax.nn.log_softmax(lp, -1)
+        b, t_max, u_max, v = lp.shape
+        yi = y.astype(jnp.int32)
+
+        blank_lp = lp[..., blank]                                 # [B,T,U+1]
+        idx_u = jnp.arange(u_max - 1)
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :-1, :], yi[:, None, :, None].repeat(t_max, 1), -1
+        )[..., 0]                                                  # [B,T,U]
+
+        NEG = -1e30
+
+        def step_t(alpha_prev, t):
+            # alpha_prev: [B, U+1] at time t-1 -> alpha at t
+            def step_u(carry, u):
+                pass
+            # emit transitions within time t handled by scan over u
+            # alpha[t, 0] = alpha[t-1, 0] + blank(t-1, 0)
+            first = alpha_prev[:, 0] + blank_lp[:, t - 1, 0]
+
+            def inner(carry, u):
+                # carry: alpha[t, u-1]
+                from_blank = alpha_prev[:, u] + blank_lp[:, t - 1, u]
+                from_emit = carry + lab_lp[:, t, u - 1]
+                val = jnp.logaddexp(from_blank, from_emit)
+                return val, val
+
+            _, rest = jax.lax.scan(inner, first, jnp.arange(1, u_max))
+            alpha_t = jnp.concatenate([first[:, None],
+                                       jnp.moveaxis(rest, 0, 1)], 1)
+            return alpha_t, alpha_t
+
+        # t = 0 row: only emits
+        def inner0(carry, u):
+            val = carry + lab_lp[:, 0, u - 1]
+            return val, val
+
+        a00 = jnp.zeros((b,))
+        _, rest0 = jax.lax.scan(inner0, a00, jnp.arange(1, u_max))
+        alpha0 = jnp.concatenate([a00[:, None], jnp.moveaxis(rest0, 0, 1)], 1)
+
+        alpha_T, _ = jax.lax.scan(step_t, alpha0, jnp.arange(1, t_max))
+        # gather alpha at (input_len-1, label_len) + final blank
+        alphas = jnp.concatenate([alpha0[None], _], 0)  # [T, B, U+1]
+        ti = jnp.clip(tl.astype(jnp.int32) - 1, 0, t_max - 1)
+        ui = jnp.clip(ul.astype(jnp.int32), 0, u_max - 1)
+        bidx = jnp.arange(b)
+        final = alphas[ti, bidx, ui] + blank_lp[bidx, ti, ui]
+        nll = -final
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply_op(_rnnt, input, label, input_lengths, label_lengths,
+                    _op_name="rnnt_loss")
+
+
+def gather_tree(ids, parents, name=None):
+    def _gt(ids_a, par_a):
+        # [T, B, beam]
+        t_max = ids_a.shape[0]
+
+        def back(carry, t):
+            beam_idx = carry  # [B, beam]
+            tok = jnp.take_along_axis(ids_a[t], beam_idx, -1)
+            nxt = jnp.take_along_axis(par_a[t], beam_idx, -1)
+            return nxt.astype(beam_idx.dtype), tok
+
+        init = jnp.broadcast_to(
+            jnp.arange(ids_a.shape[-1], dtype=ids_a.dtype)[None, :],
+            ids_a.shape[1:])
+        _, toks = jax.lax.scan(back, init, jnp.arange(t_max), reverse=True)
+        return toks
+
+    return apply_op(_gt, ids, parents, _op_name="gather_tree")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def _ts(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [a[:, 1:, :fold], jnp.zeros_like(a[:, :1, :fold])], 1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, fold:2 * fold]), a[:, :-1, fold:2 * fold]], 1)
+        mid = a[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, mid], 2).reshape(nt, c, h, w)
+
+    return apply_op(_ts, x, _op_name="temporal_shift")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    import numpy as np
+
+    from ... import framework
+
+    lab = np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos[:num_samples]
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        rng = np.random.RandomState(0)
+        extra = rng.choice(rest, num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, extra])
+    sampled = np.sort(sampled)
+    remap = {c: i for i, c in enumerate(sampled)}
+    new_lab = np.asarray([remap.get(int(v), -1) for v in lab.reshape(-1)])
+    return (Tensor(jnp.asarray(new_lab.reshape(lab.shape))),
+            Tensor(jnp.asarray(sampled)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention fallback: dense logits masked by the CSR
+    pattern (capability parity; a pallas splash-mask kernel is the TPU
+    optimisation path)."""
+    def _sa(q, k, v, offs, cols):
+        b, h, s, d = q.shape
+        logits = jnp.einsum("bhsd,bhtd->bhst", q / math.sqrt(d), k)
+
+        # expand CSR (offsets, columns) into the dense boolean mask:
+        # entry j belongs to the row r with offs[r] <= j < offs[r+1]
+        def per_bh(offs_bh, cols_bh):
+            row_of = jnp.searchsorted(offs_bh, jnp.arange(cols_bh.shape[0]),
+                                      side="right") - 1
+            m = jnp.zeros((s, s), bool)
+            return m.at[row_of, cols_bh.astype(jnp.int32)].set(True)
+
+        mask = jax.vmap(jax.vmap(per_bh))(offs, cols)
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, -1)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+
+    return apply_op(_sa, query, key, value, sparse_csr_offset,
+                    sparse_csr_columns, _op_name="sparse_attention")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn2 = dist(positive, negative)
+        dn = apply_op(lambda a, b: jnp.minimum(a, b), dn, dn2,
+                      _op_name="min")
+
+    def _tl(dpa, dna):
+        losses = jnp.maximum(dpa - dna + margin, 0.0)
+        if reduction == "mean":
+            return jnp.mean(losses)
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+
+    return apply_op(_tl, dp, dn, _op_name="triplet_margin_distance")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def _mm(x, y):
+        yi = y.reshape(-1).astype(jnp.int32)
+        correct = jnp.take_along_axis(x, yi[:, None], -1)
+        m = jnp.power(jnp.maximum(margin - correct + x, 0.0), p)
+        m = m.at[jnp.arange(x.shape[0]), yi].set(0.0)
+        losses = jnp.sum(m, -1) / x.shape[-1]
+        if reduction == "mean":
+            return jnp.mean(losses)
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+
+    return apply_op(_mm, input, label, _op_name="multi_margin_loss")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (parity: nn/functional adaptive_log_softmax)."""
+    def _als(x, y, hw, *rest):
+        n_clusters = len(cutoffs) - 1 if isinstance(cutoffs, (list, tuple)) else 0
+        head_logits = x @ hw
+        if head_bias is not None:
+            head_logits = head_logits + rest[-1]
+        head_lsm = jax.nn.log_softmax(head_logits, -1)
+        yi = y.reshape(-1).astype(jnp.int32)
+        shortlist = cutoffs[0]
+        in_short = yi < shortlist
+        out = jnp.where(
+            in_short,
+            jnp.take_along_axis(head_lsm, jnp.clip(yi, 0, shortlist - 1)[:, None], -1)[:, 0],
+            0.0,
+        )
+        for ci in range(n_clusters):
+            lo, hi = cutoffs[ci], cutoffs[ci + 1]
+            tw = rest[ci]
+            # project + cluster softmax
+            clust = jax.nn.log_softmax(x @ tw, -1)
+            rel = jnp.clip(yi - lo, 0, hi - lo - 1)
+            clust_lp = jnp.take_along_axis(clust, rel[:, None], -1)[:, 0]
+            gate = head_lsm[:, shortlist + ci]
+            out = jnp.where((yi >= lo) & (yi < hi), gate + clust_lp, out)
+        return out, -jnp.mean(out)
+
+    rest = list(tail_weights) + ([head_bias] if head_bias is not None else [])
+    return apply_op(_als, input, label, head_weight, *rest,
+                    _op_name="adaptive_log_softmax")
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, rng_name="", training=True,
+                         name=None):
+    from .flash_attention import flash_attention
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, return_softmax=False,
+                                varlen_padded=True, training=True, name=None):
+    return flash_attn_qkvpacked(qkv, dropout=dropout, causal=causal,
+                                return_softmax=return_softmax,
+                                training=training)
+
+
+# inplace activation variants
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    out = apply_op(lambda a: jnp.clip(a, min, max), x, _op_name="hardtanh_")
+    x._data = out._data
+    return x
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    out = apply_op(lambda a: jnp.where(a >= 0, a, negative_slope * a), x,
+                   _op_name="leaky_relu_")
+    x._data = out._data
+    return x
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    out = apply_op(lambda a: jnp.where(a > threshold, a, value), x,
+                   _op_name="thresholded_relu_")
+    x._data = out._data
+    return x

@@ -57,10 +57,33 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
                    jax.lax.max, lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                    "max_pool2d", ceil_mode)
     if return_mask:
-        # indices within each window's flattened input (approximation: argmax over unfold)
-        from .common import unfold as _unfold
+        # mask = flat H*W index of each window's argmax (paddle semantics)
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * 2
+        st = stride if isinstance(stride, (list, tuple)) else (
+            (stride,) * 2 if stride else ks)
+        pd = padding if isinstance(padding, (list, tuple)) else (padding,) * 2
 
-        return out, None
+        def _mask(a):
+            n, c, h, w = a.shape
+            if pd[0] or pd[1]:
+                a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
+                            constant_values=-jnp.inf)
+            hp, wp = a.shape[2], a.shape[3]
+            oh = (hp - ks[0]) // st[0] + 1
+            ow = (wp - ks[1]) // st[1] + 1
+            rows = jnp.arange(oh)[:, None] * st[0] + jnp.arange(ks[0])[None, :]
+            cols = jnp.arange(ow)[:, None] * st[1] + jnp.arange(ks[1])[None, :]
+            win = a[:, :, rows][:, :, :, :, cols]  # [N,C,oh,kh,ow,kw]
+            win = jnp.moveaxis(win, 3, 4)          # [N,C,oh,ow,kh,kw]
+            flat = win.reshape(n, c, oh, ow, -1)
+            arg = jnp.argmax(flat, -1)
+            di, dj = arg // ks[1], arg % ks[1]
+            r0 = jnp.arange(oh)[None, None, :, None] * st[0]
+            c0 = jnp.arange(ow)[None, None, None, :] * st[1]
+            return ((r0 + di - pd[0]) * w + (c0 + dj - pd[1])).astype(jnp.int32)
+
+        mask = apply_op(_mask, x, _op_name="max_pool2d_mask")
+        return out, mask
     return out
 
 
