@@ -182,6 +182,7 @@ from . import sparse  # noqa: F401
 from . import distribution  # noqa: F401
 from . import linalg_ns as linalg  # noqa: F401
 from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import onnx  # noqa: F401
 from . import text  # noqa: F401
 from . import audio  # noqa: F401

@@ -63,3 +63,31 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), x, _op_name="ifftshift")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(
+        lambda a: jnp.fft.irfft2(jnp.conj(a), s=s, axes=axes, norm=_inv(norm)),
+        x, _op_name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(
+        lambda a: jnp.conj(jnp.fft.rfft2(a, s=s, axes=axes, norm=_inv(norm))),
+        x, _op_name="ihfft2")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(
+        lambda a: jnp.fft.irfftn(jnp.conj(a), s=s, axes=axes, norm=_inv(norm)),
+        x, _op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(
+        lambda a: jnp.conj(jnp.fft.rfftn(a, s=s, axes=axes, norm=_inv(norm))),
+        x, _op_name="ihfftn")
+
+
+def _inv(norm):
+    return {"backward": "forward", "forward": "backward"}.get(norm, norm)

@@ -1,4 +1,8 @@
 """paddle.linalg namespace (parity: python/paddle/linalg.py)."""
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
 from .ops.linalg import (  # noqa: F401
     cholesky,
     cholesky_solve,
@@ -28,3 +32,158 @@ from .ops.linalg import (  # noqa: F401
     matmul,
     dot,
 )
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def _ci(a):
+        l = a if not upper else a.T
+        inv_l = jax.scipy.linalg.solve_triangular(
+            l, jnp.eye(a.shape[-1], dtype=a.dtype), lower=True)
+        return inv_l.T @ inv_l
+
+    return apply_op(_ci, x, _op_name="cholesky_inverse")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    from .ops.compat import vecdot as _vd
+
+    return _vd(x, y, axis=axis)
+
+
+def cond(x, p=None, name=None):
+    def _cond(a):
+        if p is None or p == 2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return s[..., 0] / s[..., -1]
+        if p in ("fro", "nuc"):
+            return (jnp.linalg.norm(a, ord=p, axis=(-2, -1))
+                    * jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1)))
+        return (jnp.linalg.norm(a, ord=p, axis=(-2, -1))
+                * jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1)))
+
+    return apply_op(_cond, x, _op_name="cond")
+
+
+def cross(x, y, axis=9, name=None):
+    ax = None if axis == 9 else axis
+
+    def _cross(a, b):
+        if ax is None:
+            for d, s in enumerate(a.shape):
+                if s == 3:
+                    return jnp.cross(a, b, axis=d)
+            return jnp.cross(a, b)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_op(_cross, x, y, _op_name="cross")
+
+
+def matrix_transpose(x, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, -1, -2), x,
+                    _op_name="matrix_transpose")
+
+
+def svdvals(x, name=None):
+    return apply_op(lambda a: jnp.linalg.svd(a, compute_uv=False), x,
+                    _op_name="svdvals")
+
+
+def diagonal(x, offset=0, axis1=-2, axis2=-1, name=None):
+    return apply_op(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        x, _op_name="diagonal")
+
+
+def matrix_exp(x, name=None):
+    return apply_op(lambda a: jax.scipy.linalg.expm(a), x,
+                    _op_name="matrix_exp")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    def _svl(a):
+        import paddle_tpu.framework as fw
+
+        m, n = a.shape[-2], a.shape[-1]
+        qq = min(q, m, n)
+        key = jax.random.PRNGKey(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, qq), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (a.swapaxes(-1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = qmat.swapaxes(-1, -2) @ a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u, s, vh.swapaxes(-1, -2)
+
+    return apply_op(_svl, x, _op_name="svd_lowrank")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def _pca(a):
+        m, n = a.shape[-2], a.shape[-1]
+        qq = q or min(6, m, n)
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        key = jax.random.PRNGKey(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, qq), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (a.swapaxes(-1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = qmat.swapaxes(-1, -2) @ a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u, s, vh.swapaxes(-1, -2)
+
+    return apply_op(_pca, x, _op_name="pca_lowrank")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def _lu(lu, piv):
+        n = lu.shape[-2]
+        l = jnp.tril(lu, -1) + jnp.eye(n, lu.shape[-1], dtype=lu.dtype)
+        l = l[..., :, :min(lu.shape[-2], lu.shape[-1])]
+        u = jnp.triu(lu)[..., :min(lu.shape[-2], lu.shape[-1]), :]
+        # pivots -> permutation matrix
+        perm = jnp.arange(n)
+        piv0 = piv.astype(jnp.int32) - 1
+
+        def body(i, p):
+            a, b = p[i], p[piv0[i]]
+            p = p.at[i].set(b)
+            return p.at[piv0[i]].set(a)
+
+        perm = jax.lax.fori_loop(0, piv0.shape[-1], body, perm)
+        pmat = jax.nn.one_hot(perm, n, dtype=lu.dtype).T
+        return pmat, l, u
+
+    return apply_op(_lu, x, y, _op_name="lu_unpack")
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    def _ormqr(a, t, other):
+        m = a.shape[-2]
+        q, _ = jnp.linalg.qr(a, mode="complete")
+        k = t.shape[-1]
+        qk = q[..., :, :]
+        qop = q if not transpose else q.swapaxes(-1, -2)
+        return qop @ other if left else other @ qop
+
+    return apply_op(_ormqr, x, tau, y, _op_name="ormqr")
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", name=None):
+    """fp8 gemm capability slot: on TPU this is an int8/fp8 MXU matmul;
+    numerics here use the same contract at bf16 precision."""
+    def _g(a, b, bias_a):
+        if transpose_x:
+            a = a.swapaxes(-1, -2)
+        if transpose_y:
+            b = b.swapaxes(-1, -2)
+        out = jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16)) * scale
+        if bias_a is not None:
+            out = out + bias_a
+        return out.astype(jnp.float16 if output_dtype == "float16" else jnp.bfloat16)
+
+    return apply_op(_g, x, y, bias, _op_name="fp8_gemm")

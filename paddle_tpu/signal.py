@@ -1,0 +1,97 @@
+"""paddle.signal — stft/istft (parity: python/paddle/signal.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames: [..., L] -> [..., frame_length, n]."""
+    def _fr(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        n = (moved.shape[-1] - frame_length) // hop_length + 1
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])
+        out = moved[..., idx]             # [..., n, frame_length]
+        return jnp.swapaxes(out, -1, -2)  # [..., frame_length, n]
+
+    return apply_op(_fr, x, _op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def _oa(a):
+        # a: [..., frame_length, n]
+        fl, n = a.shape[-2], a.shape[-1]
+        out_len = (n - 1) * hop_length + fl
+        out = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
+        for i in range(n):
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(a[..., i])
+        return out
+
+    return apply_op(_oa, x, _op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def _stft(a, w):
+        if w is None:
+            w = jnp.ones((wl,), a.dtype)
+        if wl < n_fft:
+            lpad = (n_fft - wl) // 2
+            w = jnp.pad(w, (lpad, n_fft - wl - lpad))
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = (a.shape[-1] - n_fft) // hop + 1
+        idx = jnp.arange(n)[:, None] * hop + jnp.arange(n_fft)[None, :]
+        frames = a[..., idx] * w  # [..., n, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, n_frames]
+
+    return apply_op(_stft, x, window, _op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def _istft(spec, w):
+        if w is None:
+            w = jnp.ones((wl,), jnp.float32)
+        if wl < n_fft:
+            lpad = (n_fft - wl) // 2
+            w = jnp.pad(w, (lpad, n_fft - wl - lpad))
+        frames = jnp.swapaxes(spec, -1, -2)  # [..., n, freq]
+        if normalized:
+            frames = frames * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        t = (jnp.fft.irfft(frames, n=n_fft, axis=-1) if onesided
+             else jnp.real(jnp.fft.ifft(frames, axis=-1)))
+        t = t * w
+        n = t.shape[-2]
+        out_len = (n - 1) * hop + n_fft
+        out = jnp.zeros(t.shape[:-2] + (out_len,), t.dtype)
+        wsum = jnp.zeros((out_len,), t.dtype)
+        for i in range(n):
+            out = out.at[..., i * hop:i * hop + n_fft].add(t[..., i, :])
+            wsum = wsum.at[i * hop:i * hop + n_fft].add(w * w)
+        out = out / jnp.maximum(wsum, 1e-10)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out.shape[-1] - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op(_istft, x, window, _op_name="istft")

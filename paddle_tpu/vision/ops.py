@@ -1,0 +1,346 @@
+"""paddle.vision.ops — detection ops (parity: python/paddle/vision/ops.py).
+
+TPU-native forms: box math is vectorised jnp; RoI align/pool use bilinear
+gather (XLA lowers to dynamic-slice gathers); nms runs the classic greedy
+suppression with a lax.fori loop over a fixed box budget (static shapes).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def box_area(boxes):
+    return apply_op(
+        lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), boxes,
+        _op_name="box_area")
+
+
+def box_iou(boxes1, boxes2):
+    def _iou(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+
+    return apply_op(_iou, boxes1, boxes2, _op_name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS; returns kept indices sorted by score."""
+    def _nms(bx, sc):
+        n = bx.shape[0]
+        if sc is None:
+            sc = jnp.arange(n, 0, -1).astype(jnp.float32)
+        order = jnp.argsort(-sc)
+        bx_sorted = bx[order]
+        area = (bx_sorted[:, 2] - bx_sorted[:, 0]) * (
+            bx_sorted[:, 3] - bx_sorted[:, 1])
+
+        def body(i, keep):
+            lt = jnp.maximum(bx_sorted[i, :2], bx_sorted[:, :2])
+            rb = jnp.minimum(bx_sorted[i, 2:], bx_sorted[:, 2:])
+            wh = jnp.clip(rb - lt, 0)
+            inter = wh[:, 0] * wh[:, 1]
+            iou = inter / (area[i] + area - inter)
+            suppress = (iou > iou_threshold) & (jnp.arange(n) > i)
+            return jnp.where(keep[i], keep & ~suppress, keep)
+
+        keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+        return order, keep
+
+    order, keep = apply_op(_nms, boxes, scores, _op_name="nms")
+    order_np = np.asarray(order._data)
+    keep_np = np.asarray(keep._data)
+    kept = order_np[keep_np]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    os_ = (output_size, output_size) if isinstance(output_size, int) else output_size
+
+    def _ra(feat, bx, bn):
+        n, c, h, w = feat.shape
+        oh, ow = os_
+        offset = 0.5 if aligned else 0.0
+        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=bx.shape[0])
+
+        def one_box(b, bi):
+            x1 = b[0] * spatial_scale - offset
+            y1 = b[1] * spatial_scale - offset
+            x2 = b[2] * spatial_scale - offset
+            y2 = b[3] * spatial_scale - offset
+            bw = jnp.maximum(x2 - x1, 1e-4)
+            bh = jnp.maximum(y2 - y1, 1e-4)
+            ys = y1 + (jnp.arange(oh) + 0.5) * bh / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * bw / ow
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1).astype(jnp.int32)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1).astype(jnp.int32)
+            y1i = jnp.clip(y0 + 1, 0, h - 1)
+            x1i = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy, 0, h - 1) - y0
+            wx = jnp.clip(xx, 0, w - 1) - x0
+            fm = feat[bi]  # [C, H, W]
+            v00 = fm[:, y0, x0]
+            v01 = fm[:, y0, x1i]
+            v10 = fm[:, y1i, x0]
+            v11 = fm[:, y1i, x1i]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                    + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        return jax.vmap(one_box)(bx, batch_idx)
+
+    return apply_op(_ra, x, boxes, boxes_num, _op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    os_ = (output_size, output_size) if isinstance(output_size, int) else output_size
+
+    def _rp(feat, bx, bn):
+        n, c, h, w = feat.shape
+        oh, ow = os_
+        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=bx.shape[0])
+
+        def one_box(b, bi):
+            x1 = jnp.floor(b[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.floor(b[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.ceil(b[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.ceil(b[3] * spatial_scale).astype(jnp.int32)
+            bh = jnp.maximum(y2 - y1, 1)
+            bw = jnp.maximum(x2 - x1, 1)
+            # 2x2 samples per output cell, max-pooled
+            gy = jnp.clip(y1 + (jnp.arange(oh * 2) * bh / (oh * 2))
+                          .astype(jnp.int32), 0, h - 1)
+            gx = jnp.clip(x1 + (jnp.arange(ow * 2) * bw / (ow * 2))
+                          .astype(jnp.int32), 0, w - 1)
+            fm = feat[bi][:, gy][:, :, gx]  # [C, oh*2, ow*2]
+            fm = fm.reshape(c, oh, 2, ow, 2)
+            return jnp.max(fm, axis=(2, 4))
+
+        return jax.vmap(one_box)(bx, batch_idx)
+
+    return apply_op(_rp, x, boxes, boxes_num, _op_name="roi_pool")
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling: channel group (i,j) feeds output
+    cell (i,j)."""
+    k = output_size if isinstance(output_size, int) else output_size[0]
+    pooled = roi_align(x, boxes, boxes_num, k, spatial_scale)
+
+    def _ps(p):
+        nb, c, oh, ow = p.shape
+        out_c = c // (oh * ow)
+        p = p.reshape(nb, out_c, oh, ow, oh, ow)
+        ii = jnp.arange(oh)
+        jj = jnp.arange(ow)
+        return p[:, :, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+
+    return apply_op(_ps, pooled, _op_name="psroi_pool")
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    def _yb(feat, sizes):
+        n, c, h, w = feat.shape
+        na = len(anchors) // 2
+        anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+        feat = feat.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w)[None, None, None, :]
+        gy = jnp.arange(h)[None, None, :, None]
+        bx = (jax.nn.sigmoid(feat[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx) / w
+        by = (jax.nn.sigmoid(feat[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy) / h
+        bw = jnp.exp(feat[:, :, 2]) * anc[None, :, 0, None, None] / (
+            w * downsample_ratio)
+        bh = jnp.exp(feat[:, :, 3]) * anc[None, :, 1, None, None] / (
+            h * downsample_ratio)
+        conf = jax.nn.sigmoid(feat[:, :, 4])
+        probs = jax.nn.sigmoid(feat[:, :, 5:]) * conf[:, :, None]
+        img_h = sizes[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = sizes[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * img_w
+        y1 = (by - bh / 2) * img_h
+        x2 = (bx + bw / 2) * img_w
+        y2 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+            x2 = jnp.clip(x2, 0, img_w - 1)
+            y2 = jnp.clip(y2, 0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        scores = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
+        keep = (conf.reshape(n, -1) > conf_thresh)[..., None]
+        return boxes * keep, scores * keep
+
+    return apply_op(_yb, x, img_size, _op_name="yolo_box")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 via bilinear sampling + einsum contraction.
+
+    Sampling grid per output position is shifted by the learned offsets
+    (and modulated by `mask` for v2); the contraction is a single MXU
+    einsum. deformable_groups == 1 supported.
+    """
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    if deformable_groups != 1:
+        raise NotImplementedError("deformable_groups > 1")
+
+    def _dc(xa, off, w, b, m):
+        n, cin, h, win_ = xa.shape
+        cout, cin_g, kh, kw = w.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (win_ + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        xa_p = jnp.pad(xa, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        hp, wp = xa_p.shape[2], xa_p.shape[3]
+        base_y = (jnp.arange(oh)[:, None, None, None] * st[0]
+                  + jnp.arange(kh)[None, None, :, None] * dl[0])
+        base_x = (jnp.arange(ow)[None, :, None, None] * st[1]
+                  + jnp.arange(kw)[None, None, None, :] * dl[1])
+        # offset layout [N, kh*kw*2, oh, ow] with (dy, dx) pairs per tap
+        offr = off.reshape(n, kh, kw, 2, oh, ow)
+        dy = jnp.transpose(offr[:, :, :, 0], (0, 3, 4, 1, 2))  # [N,oh,ow,kh,kw]
+        dx = jnp.transpose(offr[:, :, :, 1], (0, 3, 4, 1, 2))
+        sy = base_y[None] + dy
+        sx = base_x[None] + dx
+        y0 = jnp.clip(jnp.floor(sy), 0, hp - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(sx), 0, wp - 1).astype(jnp.int32)
+        y1 = jnp.clip(y0 + 1, 0, hp - 1)
+        x1 = jnp.clip(x0 + 1, 0, wp - 1)
+        wy = jnp.clip(sy, 0, hp - 1) - y0
+        wx = jnp.clip(sx, 0, wp - 1) - x0
+
+        def per_image(img, y0i, x0i, y1i, x1i, wyi, wxi, mi):
+            v00 = img[:, y0i, x0i]
+            v01 = img[:, y0i, x1i]
+            v10 = img[:, y1i, x0i]
+            v11 = img[:, y1i, x1i]
+            val = (v00 * (1 - wyi) * (1 - wxi) + v01 * (1 - wyi) * wxi
+                   + v10 * wyi * (1 - wxi) + v11 * wyi * wxi)
+            if mi is not None:
+                val = val * mi[None]
+            # val: [cin, oh, ow, kh, kw]
+            return jnp.einsum("cijkl,ockl->oij", val, w)
+
+        if m is not None:
+            mr = m.reshape(n, kh, kw, oh, ow)
+            mr = jnp.transpose(mr, (0, 3, 4, 1, 2))  # [N, oh, ow, kh, kw]
+        out = jax.vmap(per_image)(
+            xa_p, y0, x0, y1, x1, wy, wx,
+            mr if m is not None else jnp.ones((n, oh, ow, kh, kw), xa.dtype))
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    return apply_op(_dc, x, offset, weight, bias, mask,
+                    _op_name="deform_conv2d")
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+        self.args = (stride, padding, dilation, deformable_groups, groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]])
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([out_channels], is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        st, pd, dl, dg, g = self.args
+        return deform_conv2d(x, offset, self.weight, self.bias, st, pd, dl,
+                             dg, g, mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    rois = np.asarray(fpn_rois.numpy() if hasattr(fpn_rois, "numpy")
+                      else fpn_rois)
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1]), 1e-6))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    outs, index = [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.where(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[idx])))
+        index.append(idx)
+    restore = (np.argsort(np.concatenate(index)) if index
+               else np.array([], np.int64))
+    return (outs,
+            [Tensor(jnp.asarray(np.asarray([len(i)], np.int32)))
+             for i in index],
+            Tensor(jnp.asarray(restore.astype(np.int32))))
+
+
+def yolo_loss(*args, **kwargs):
+    raise NotImplementedError(
+        "yolo_loss: compose from yolo_box + standard losses; the fused "
+        "CUDA loss has no single TPU kernel equivalent yet")
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError(
+        "generate_proposals: compose box decoding + nms; end-to-end RPN "
+        "proposals land with the detection model family")

@@ -1,0 +1,127 @@
+"""vision.ops, vision.transforms long tail, signal stft/istft, linalg tail."""
+import numpy as np
+import pytest
+
+
+def test_nms_and_box_iou():
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import ops
+
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    kept = np.asarray(ops.nms(boxes, 0.5, scores).numpy())
+    assert list(kept) == [0, 2]
+    iou = np.asarray(ops.box_iou(boxes, boxes).numpy())
+    np.testing.assert_allclose(np.diag(iou), 1.0, atol=1e-6)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision import ops
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(1, 3, 8, 8).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(5, 3, 3, 3).astype(np.float32))
+    off = paddle.zeros([1, 18, 8, 8])
+    out = ops.deform_conv2d(x, off, w, padding=1)
+    ref = F.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), atol=1e-4, rtol=1e-4)
+
+
+def test_roi_align_constant_feature():
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import ops
+
+    x = paddle.ones([1, 2, 16, 16]) * 7.0
+    rois = paddle.to_tensor(np.array([[2.0, 2, 10, 10]], np.float32))
+    out = ops.roi_align(x, rois, paddle.to_tensor(np.array([1], np.int32)), 4)
+    np.testing.assert_allclose(np.asarray(out.numpy()), 7.0, atol=1e-5)
+
+
+def test_transforms_functional():
+    import paddle_tpu.vision.transforms as T
+
+    img = (np.random.RandomState(0).rand(12, 16, 3) * 255).astype(np.uint8)
+    assert T.vflip(img).shape == img.shape
+    np.testing.assert_array_equal(T.vflip(T.vflip(img)), img)
+    assert T.center_crop(img, 8).shape == (8, 8, 3)
+    assert T.pad(img, 2).shape == (16, 20, 3)
+    assert T.to_grayscale(img, 3).shape == img.shape
+    b = T.adjust_brightness(img, 0.5)
+    assert b.mean() < img.mean()
+    # exact 90-degree rotation matches rot90
+    sq = (np.random.RandomState(1).rand(16, 16, 3) * 255).astype(np.uint8)
+    rot = T.rotate(sq, 90)
+    interior = np.abs(rot[1:-1, 1:-1].astype(int)
+                      - np.rot90(sq)[1:-1, 1:-1].astype(int))
+    assert interior.mean() < 1.0
+
+
+def test_transform_classes_run():
+    import paddle_tpu.vision.transforms as T
+
+    img = (np.random.RandomState(0).rand(32, 32, 3) * 255).astype(np.uint8)
+    np.random.seed(0)
+    for t in [T.RandomResizedCrop(16), T.ColorJitter(0.4, 0.4, 0.4, 0.1),
+              T.Pad(2), T.RandomRotation(15), T.RandomAffine(10),
+              T.RandomPerspective(prob=1.0), T.Grayscale(3),
+              T.RandomErasing(prob=1.0)]:
+        out = t(img)
+        assert out is not None and out.ndim == 3
+
+
+def test_stft_istft_roundtrip():
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 1024).astype(np.float32))
+    spec = paddle.signal.stft(x, 128)
+    assert tuple(spec.shape)[1] == 65  # onesided freq bins
+    y = paddle.signal.istft(spec, 128, length=1024)
+    np.testing.assert_allclose(np.asarray(y.numpy()),
+                               np.asarray(x.numpy()), atol=1e-4)
+
+
+def test_linalg_tail():
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    l = paddle.to_tensor(np.linalg.cholesky(spd))
+    inv = np.asarray(paddle.linalg.cholesky_inverse(l).numpy())
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), atol=1e-3, rtol=1e-3)
+
+    s = np.asarray(paddle.linalg.svdvals(paddle.to_tensor(a)).numpy())
+    np.testing.assert_allclose(s, np.linalg.svd(a, compute_uv=False),
+                               atol=1e-4)
+
+    c = np.asarray(paddle.linalg.cross(
+        paddle.to_tensor(np.array([1.0, 0, 0], np.float32)),
+        paddle.to_tensor(np.array([0.0, 1, 0], np.float32))).numpy())
+    np.testing.assert_allclose(c, [0, 0, 1])
+
+    me = np.asarray(paddle.linalg.matrix_exp(
+        paddle.to_tensor(np.zeros((3, 3), np.float32))).numpy())
+    np.testing.assert_allclose(me, np.eye(3), atol=1e-6)
+
+    u, sv, v = paddle.linalg.svd_lowrank(paddle.to_tensor(a), q=4)
+    rec = np.asarray(u.numpy()) @ np.diag(np.asarray(sv.numpy())) @ np.asarray(v.numpy()).T
+    np.testing.assert_allclose(rec, a, atol=1e-3)
+
+
+def test_fft_hermitian_variants():
+    import paddle_tpu as paddle
+
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    t = paddle.to_tensor(x)
+    out = paddle.fft.ihfft2(t)
+    # ihfft normalises by 1/N (like ifft): conj(rfft2) with forward norm
+    ref = np.conj(np.fft.rfft2(x, norm="forward"))
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, atol=1e-4)
+    # hfft2 inverts ihfft2 up to the hermitian round-trip
+    back = paddle.fft.hfft2(out)
+    np.testing.assert_allclose(np.asarray(back.numpy()), x, atol=1e-4)
