@@ -36,10 +36,10 @@ from .ops.linalg import (  # noqa: F401
 
 def cholesky_inverse(x, upper=False, name=None):
     def _ci(a):
-        l = a if not upper else a.T
+        l = a if not upper else jnp.swapaxes(a, -1, -2)
         inv_l = jax.scipy.linalg.solve_triangular(
             l, jnp.eye(a.shape[-1], dtype=a.dtype), lower=True)
-        return inv_l.T @ inv_l
+        return jnp.swapaxes(inv_l, -1, -2) @ inv_l
 
     return apply_op(_ci, x, _op_name="cholesky_inverse")
 
@@ -55,9 +55,6 @@ def cond(x, p=None, name=None):
         if p is None or p == 2:
             s = jnp.linalg.svd(a, compute_uv=False)
             return s[..., 0] / s[..., -1]
-        if p in ("fro", "nuc"):
-            return (jnp.linalg.norm(a, ord=p, axis=(-2, -1))
-                    * jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1)))
         return (jnp.linalg.norm(a, ord=p, axis=(-2, -1))
                 * jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1)))
 
@@ -99,51 +96,46 @@ def matrix_exp(x, name=None):
                     _op_name="matrix_exp")
 
 
+def _randomized_svd(a, qq, niter):
+    """Shared randomized-SVD core (Halko et al.) for svd/pca_lowrank."""
+    key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, a.shape[:-2] + (a.shape[-1], qq), a.dtype)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -1, -2) @ a
+    u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return qmat @ u, s, jnp.swapaxes(vh, -1, -2)
+
+
 def svd_lowrank(x, q=6, niter=2, M=None, name=None):
-    def _svl(a):
-        import paddle_tpu.framework as fw
+    def _svl(a, m_arr):
+        if m_arr is not None:
+            a = a - m_arr
+        qq = min(q, a.shape[-2], a.shape[-1])
+        return _randomized_svd(a, qq, niter)
 
-        m, n = a.shape[-2], a.shape[-1]
-        qq = min(q, m, n)
-        key = jax.random.PRNGKey(0)
-        omega = jax.random.normal(key, a.shape[:-2] + (n, qq), a.dtype)
-        y = a @ omega
-        for _ in range(niter):
-            y = a @ (a.swapaxes(-1, -2) @ y)
-        qmat, _ = jnp.linalg.qr(y)
-        b = qmat.swapaxes(-1, -2) @ a
-        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
-        return qmat @ u, s, vh.swapaxes(-1, -2)
-
-    return apply_op(_svl, x, _op_name="svd_lowrank")
+    return apply_op(_svl, x, M, _op_name="svd_lowrank")
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     def _pca(a):
-        m, n = a.shape[-2], a.shape[-1]
-        qq = q or min(6, m, n)
         if center:
             a = a - jnp.mean(a, axis=-2, keepdims=True)
-        key = jax.random.PRNGKey(0)
-        omega = jax.random.normal(key, a.shape[:-2] + (n, qq), a.dtype)
-        y = a @ omega
-        for _ in range(niter):
-            y = a @ (a.swapaxes(-1, -2) @ y)
-        qmat, _ = jnp.linalg.qr(y)
-        b = qmat.swapaxes(-1, -2) @ a
-        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
-        return qmat @ u, s, vh.swapaxes(-1, -2)
+        qq = q or min(6, a.shape[-2], a.shape[-1])
+        return _randomized_svd(a, qq, niter)
 
     return apply_op(_pca, x, _op_name="pca_lowrank")
 
 
 def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
-    def _lu(lu, piv):
+    def _one(lu, piv):
         n = lu.shape[-2]
+        k = min(lu.shape[-2], lu.shape[-1])
         l = jnp.tril(lu, -1) + jnp.eye(n, lu.shape[-1], dtype=lu.dtype)
-        l = l[..., :, :min(lu.shape[-2], lu.shape[-1])]
-        u = jnp.triu(lu)[..., :min(lu.shape[-2], lu.shape[-1]), :]
-        # pivots -> permutation matrix
+        l = l[..., :, :k]
+        u = jnp.triu(lu)[..., :k, :]
         perm = jnp.arange(n)
         piv0 = piv.astype(jnp.int32) - 1
 
@@ -156,16 +148,31 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
         pmat = jax.nn.one_hot(perm, n, dtype=lu.dtype).T
         return pmat, l, u
 
+    def _lu(lu, piv):
+        batch = lu.shape[:-2]
+        if not batch:
+            return _one(lu, piv)
+        fn = _one
+        for _ in batch:
+            fn = jax.vmap(fn)
+        return fn(lu, piv)
+
     return apply_op(_lu, x, y, _op_name="lu_unpack")
 
 
 def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Apply Q (from geqrf's packed reflectors + tau) to y."""
     def _ormqr(a, t, other):
         m = a.shape[-2]
-        q, _ = jnp.linalg.qr(a, mode="complete")
         k = t.shape[-1]
-        qk = q[..., :, :]
-        qop = q if not transpose else q.swapaxes(-1, -2)
+        # rebuild Q from the Householder vectors stored below the diagonal
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(k):
+            v = jnp.where(jnp.arange(m) > i, a[:, i], 0.0)
+            v = v.at[i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
+            q = q @ h
+        qop = q.T if transpose else q
         return qop @ other if left else other @ qop
 
     return apply_op(_ormqr, x, tau, y, _op_name="ormqr")

@@ -8,26 +8,38 @@ from .core.dispatch import apply_op
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Slice into overlapping frames: [..., L] -> [..., frame_length, n]."""
+    """Overlapping frames. axis=-1: [..., L] -> [..., frame_length, n];
+    axis=0: [L, ...] -> [n, frame_length, ...] (paddle convention)."""
     def _fr(a):
         moved = jnp.moveaxis(a, axis, -1)
         n = (moved.shape[-1] - frame_length) // hop_length + 1
         idx = (jnp.arange(n)[:, None] * hop_length
                + jnp.arange(frame_length)[None, :])
         out = moved[..., idx]             # [..., n, frame_length]
-        return jnp.swapaxes(out, -1, -2)  # [..., frame_length, n]
+        if axis == 0:
+            # frames-first convention: [n, frame_length, ...]
+            return jnp.moveaxis(out, (-2, -1), (0, 1))
+        return jnp.swapaxes(out, -1, -2)   # [..., frame_length, n]
 
     return apply_op(_fr, x, _op_name="frame")
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame. axis=-1 input [..., frame_length, n];
+    axis=0 input [n, frame_length, ...] (paddle convention)."""
     def _oa(a):
-        # a: [..., frame_length, n]
-        fl, n = a.shape[-2], a.shape[-1]
+        if axis == 0:
+            frames = jnp.moveaxis(a, (0, 1), (-1, -2))  # -> [..., fl, n]
+        else:
+            frames = a                      # [..., fl, n]
+        fl, n = frames.shape[-2], frames.shape[-1]
         out_len = (n - 1) * hop_length + fl
-        out = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), a.dtype)
         for i in range(n):
-            out = out.at[..., i * hop_length:i * hop_length + fl].add(a[..., i])
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                frames[..., i])
+        if axis == 0:
+            return jnp.moveaxis(out, -1, 0)
         return out
 
     return apply_op(_oa, x, _op_name="overlap_add")

@@ -38,7 +38,33 @@ def box_iou(boxes1, boxes2):
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None):
-    """Greedy NMS; returns kept indices sorted by score."""
+    """Greedy NMS; returns kept indices sorted by score. With
+    category_idxs, suppression happens within each category only."""
+    if category_idxs is not None:
+        cats = np.asarray(category_idxs.numpy()
+                          if hasattr(category_idxs, "numpy")
+                          else category_idxs)
+        if categories is None:
+            categories = np.unique(cats)
+        sc_np = (np.asarray(scores.numpy()) if scores is not None else None)
+        kept_all = []
+        for c in categories:
+            idx = np.where(cats == c)[0]
+            if idx.size == 0:
+                continue
+            sub_boxes = Tensor(boxes._data[idx])
+            sub_scores = (Tensor(scores._data[idx])
+                          if scores is not None else None)
+            sub_kept = np.asarray(
+                nms(sub_boxes, iou_threshold, sub_scores).numpy())
+            kept_all.append(idx[sub_kept])
+        kept = np.concatenate(kept_all) if kept_all else np.array([], np.int64)
+        if sc_np is not None:
+            kept = kept[np.argsort(-sc_np[kept])]
+        if top_k is not None:
+            kept = kept[:top_k]
+        return Tensor(jnp.asarray(kept))
+
     def _nms(bx, sc):
         n = bx.shape[0]
         if sc is None:

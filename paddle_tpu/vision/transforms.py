@@ -268,21 +268,29 @@ def erase(img, i, j, h, w, v, inplace=False):
     return arr
 
 
-def _affine_grid_sample(arr, matrix, fill=0.0):
-    """Inverse-warp HWC image by 2x3 matrix via bilinear sampling."""
+def _affine_grid_sample(arr, matrix, fill=0.0, interpolation="bilinear",
+                        out_hw=None, offset=(0.0, 0.0)):
+    """Inverse-warp HWC image by a 2x3 matrix.
+
+    interpolation: "nearest" (order 0, exact for label/mask images) or
+    "bilinear". out_hw/offset support an expanded output canvas."""
     from scipy import ndimage as _nd  # scipy ships with the image
 
     h, w = arr.shape[:2]
+    oh, ow = out_hw or (h, w)
     inv = np.linalg.inv(np.vstack([matrix, [0, 0, 1]]))[:2]
-    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    ys, xs = np.mgrid[0:oh, 0:ow].astype(np.float32)
+    xs = xs + offset[0]
+    ys = ys + offset[1]
     coords = np.stack([xs, ys, np.ones_like(xs)], 0).reshape(3, -1)
     src = inv @ coords
-    sx, sy = src[0].reshape(h, w), src[1].reshape(h, w)
+    sx, sy = src[0].reshape(oh, ow), src[1].reshape(oh, ow)
+    order = 0 if interpolation == "nearest" else 1
     chans = []
     a3 = arr[..., None] if arr.ndim == 2 else arr
     for c in range(a3.shape[-1]):
         chans.append(_nd.map_coordinates(
-            a3[..., c].astype(np.float32), [sy, sx], order=1, cval=fill))
+            a3[..., c].astype(np.float32), [sy, sx], order=order, cval=fill))
     out = np.stack(chans, -1)
     return out[..., 0] if arr.ndim == 2 else out
 
@@ -296,7 +304,18 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     cos, sin = np.cos(rad), np.sin(rad)
     m = np.array([[cos, -sin, cx - cos * cx + sin * cy],
                   [sin, cos, cy - sin * cx - cos * cy]], np.float32)
-    return _affine_grid_sample(arr, m, fill).astype(arr.dtype)
+    out_hw, offset = None, (0.0, 0.0)
+    if expand:
+        # bounding box of the rotated corners
+        corners = np.array([[0, 0, 1], [w - 1, 0, 1],
+                            [w - 1, h - 1, 1], [0, h - 1, 1]], np.float32)
+        warped = corners @ m.T
+        xmin, ymin = warped.min(0)
+        xmax, ymax = warped.max(0)
+        out_hw = (int(np.ceil(ymax - ymin)) + 1, int(np.ceil(xmax - xmin)) + 1)
+        offset = (float(xmin), float(ymin))
+    return _affine_grid_sample(arr, m, fill, interpolation, out_hw,
+                               offset).astype(arr.dtype)
 
 
 def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
@@ -315,7 +334,7 @@ def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
         [a, b, cx + translate[0] - a * cx - b * cy],
         [c, d, cy + translate[1] - c * cx - d * cy],
     ], np.float32)
-    return _affine_grid_sample(arr, m, fill).astype(arr.dtype)
+    return _affine_grid_sample(arr, m, fill, interpolation).astype(arr.dtype)
 
 
 def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):

@@ -125,3 +125,82 @@ def test_fft_hermitian_variants():
     # hfft2 inverts ihfft2 up to the hermitian round-trip
     back = paddle.fft.hfft2(out)
     np.testing.assert_allclose(np.asarray(back.numpy()), x, atol=1e-4)
+
+
+def test_nms_per_category():
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import ops
+
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8], np.float32))
+    cats = paddle.to_tensor(np.array([0, 1], np.int64))
+    # different categories: both kept despite IoU > threshold
+    kept = np.asarray(ops.nms(boxes, 0.5, scores, category_idxs=cats,
+                              categories=[0, 1]).numpy())
+    assert sorted(kept.tolist()) == [0, 1]
+    # same category: one suppressed
+    cats2 = paddle.to_tensor(np.array([0, 0], np.int64))
+    kept2 = np.asarray(ops.nms(boxes, 0.5, scores, category_idxs=cats2,
+                               categories=[0]).numpy())
+    assert kept2.tolist() == [0]
+
+
+def test_rotate_expand_and_nearest():
+    import paddle_tpu.vision.transforms as T
+
+    img = np.zeros((10, 20), np.uint8)
+    img[:, :] = 3
+    out = T.rotate(img, 90, expand=True)
+    # expanded canvas swaps aspect
+    assert abs(out.shape[0] - 20) <= 1 and abs(out.shape[1] - 10) <= 1
+    # nearest keeps label values exact
+    lab = np.random.RandomState(0).randint(0, 5, (16, 16)).astype(np.uint8)
+    rot = T.rotate(lab, 30, interpolation="nearest")
+    assert set(np.unique(rot)).issubset(set(np.unique(lab)) | {0})
+
+
+def test_frame_overlap_add_axis0():
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+    f = paddle.signal.frame(x, 4, 2, axis=0)
+    assert tuple(f.shape) == (4, 4)  # n=4 frames of length 4
+    np.testing.assert_allclose(np.asarray(f.numpy())[0], [0, 1, 2, 3])
+    back = paddle.signal.overlap_add(f, 2, axis=0)
+    assert tuple(back.shape) == (10,)
+
+
+def test_lu_unpack_and_ormqr():
+    import paddle_tpu as paddle
+    import scipy.linalg as sla
+
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 4).astype(np.float32)
+    lu, piv = sla.lu_factor(a)
+    p, l, u = paddle.linalg.lu_unpack(
+        paddle.to_tensor(lu), paddle.to_tensor((piv + 1).astype(np.int32)))
+    rec = np.asarray(p.numpy()) @ np.asarray(l.numpy()) @ np.asarray(u.numpy())
+    np.testing.assert_allclose(rec, a, atol=1e-4)
+
+    # batched
+    ab = rng.randn(2, 3, 3).astype(np.float32)
+    lus, pivs = zip(*[sla.lu_factor(ab[i]) for i in range(2)])
+    pb, lb, ub = paddle.linalg.lu_unpack(
+        paddle.to_tensor(np.stack(lus)),
+        paddle.to_tensor(np.stack([pv + 1 for pv in pivs]).astype(np.int32)))
+    for i in range(2):
+        rec = (np.asarray(pb.numpy())[i] @ np.asarray(lb.numpy())[i]
+               @ np.asarray(ub.numpy())[i])
+        np.testing.assert_allclose(rec, ab[i], atol=1e-4)
+
+    # ormqr: Q @ y from geqrf-style reflectors
+    (h, tau), _ = sla.qr(a, mode="raw")
+    y = rng.randn(4, 2).astype(np.float32)
+    out = paddle.linalg.ormqr(
+        paddle.to_tensor(np.asarray(h, np.float32)),
+        paddle.to_tensor(np.asarray(tau, np.float32)),
+        paddle.to_tensor(y))
+    q_full = sla.qr(a)[0]
+    np.testing.assert_allclose(np.asarray(out.numpy()), q_full @ y,
+                               atol=1e-3, rtol=1e-3)
