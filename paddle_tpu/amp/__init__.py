@@ -220,3 +220,12 @@ class debugging:
     @staticmethod
     def disable_operator_stats_collection():
         pass
+
+
+def is_float16_supported(device=None):
+    # TPU compute is bf16-first; fp16 works via XLA but unaccelerated
+    return False
+
+
+def is_bfloat16_supported(device=None):
+    return True

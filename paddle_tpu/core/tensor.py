@@ -321,3 +321,48 @@ class Parameter(Tensor):
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()
+
+
+# -- introspection surface (parity: tensor.prototype.pyi long tail) ---------
+def _add_introspection():
+    import jax.numpy as jnp
+
+    Tensor.is_dense = lambda self: True
+    Tensor.is_dist = lambda self: getattr(self, "_dist_attr", None) is not None
+    Tensor.is_sparse = lambda self: False
+    Tensor.is_sparse_coo = lambda self: False
+    Tensor.is_sparse_csr = lambda self: False
+    Tensor.is_selected_rows = lambda self: False
+    Tensor.is_coalesced = lambda self: False
+    Tensor.is_same_shape = lambda self, other: tuple(self.shape) == tuple(other.shape)
+    Tensor.sparse_dim = lambda self: 0
+    Tensor.dense_dim = lambda self: self._data.ndim
+    Tensor.nnz = lambda self: int(jnp.count_nonzero(self._data))
+    Tensor.get_tensor = lambda self: self
+    Tensor.get_map_tensor = lambda self: self
+    Tensor.get_selected_rows = lambda self: self
+    Tensor.rows = lambda self: []
+    Tensor.cols = lambda self: []
+    Tensor.crows = lambda self: []
+    Tensor.layout = property(lambda self: "NCHW")
+    Tensor.type = lambda self: "DenseTensor"
+    Tensor.offset = lambda self: 0
+    Tensor.num_shard = lambda self: 1
+    Tensor.data_ptr = lambda self: id(self._data)
+    Tensor.get_strides = lambda self: list(self._data.strides) if hasattr(self._data, "strides") else []
+    Tensor.strides = property(lambda self: self.get_strides())
+    Tensor.grad_ = property(lambda self: self.grad)
+    Tensor.grad_fn = property(lambda self: self._grad_node)
+    Tensor._grad_ivar = lambda self: self.grad
+    Tensor.data = property(lambda self: self,
+                           lambda self, v: setattr(self, "_data",
+                                                   v._data if isinstance(v, Tensor) else v))
+    Tensor.process_mesh = property(
+        lambda self: self._dist_attr.process_mesh if self._dist_attr else None)
+    Tensor.placements = property(
+        lambda self: self._dist_attr.placements if self._dist_attr else None)
+    Tensor.set_vocab = lambda self, v: None
+    Tensor.set_string_list = lambda self, v: None
+
+
+_add_introspection()

@@ -168,3 +168,104 @@ def get_all_device_type():
 
 def get_available_device():
     return [f"tpu:{i}" for i in range(device_count())]
+
+
+# -- stream/event surface (parity: python/paddle/device) --------------------
+# XLA owns scheduling on TPU; streams/events are API-compatible no-ops that
+# preserve program semantics (synchronize flushes pending dispatch).
+
+class Stream:
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    _current_stream = stream
+    return stream
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def stream_guard(stream):
+    old = current_stream()
+    set_stream(stream)
+    try:
+        yield
+    finally:
+        set_stream(old)
+
+
+def synchronize(device=None):
+    """Block until all dispatched work completes."""
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def get_cudnn_version():
+    return None
+
+
+class IPUPlace:
+    pass
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_custom_device():
+    return []

@@ -7,3 +7,149 @@ from . import distributed  # noqa: F401
 def autotune(config=None):
     # XLA autotunes compiled programs natively; kept for API parity.
     return None
+
+
+# -- incubate top-level API (parity: python/paddle/incubate/__init__.py) ----
+def softmax_mask_fuse(x, mask, name=None):
+    import jax
+
+    from ..core.dispatch import apply_op
+
+    return apply_op(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask,
+                    _op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    def _smf(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+
+    return apply_op(_smf, x, _op_name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    from ..core.dispatch import apply_op
+    import jax.numpy as jnp
+
+    red = {"none": lambda a: a, 0: lambda a: a,
+           "sum": jnp.sum, 1: jnp.sum,
+           "mean": jnp.mean, 2: jnp.mean}[reduction]
+    return apply_op(red, x, _op_name="identity_loss")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, **kw):
+    raise NotImplementedError(
+        "graph_khop_sampler: host-side sampling; use numpy/scipy graph "
+        "sampling and feed the sampled subgraph")
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1, **kw):
+    raise NotImplementedError(
+        "graph_sample_neighbors: host-side sampling; use numpy/scipy graph "
+        "sampling and feed the sampled subgraph")
+
+
+def graph_reindex(x, neighbors, count, **kw):
+    raise NotImplementedError("graph_reindex: host-side preprocessing step")
+
+
+from ..geometric import (  # noqa: E402,F401
+    segment_max, segment_mean, segment_min, segment_sum)
+
+
+class LookAhead:
+    """LookAhead optimizer wrapper (parity: incubate/optimizer/lookahead.py):
+    slow weights track fast weights every k steps."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = None
+
+    def step(self):
+        import jax.numpy as jnp
+
+        self.inner_optimizer.step()
+        self._step += 1
+        params = self.inner_optimizer._parameter_list or []
+        if self._slow is None:
+            self._slow = [p._data for p in params]
+        if self._step % self.k == 0:
+            for p, slow in zip(params, self._slow):
+                new_slow = slow + self.alpha * (p._data - slow)
+                p._data = new_slow
+            self._slow = [p._data for p in params]
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+
+class ModelAverage:
+    """Exponential/window parameter averaging (incubate/optimizer/
+    modelaverage.py): apply() swaps in averaged weights for eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameters = list(parameters or [])
+        self._sums = None
+        self._count = 0
+
+    def step(self):
+        if self._sums is None:
+            self._sums = [p._data * 0 for p in self._parameters]
+        self._sums = [s + p._data for s, p in zip(self._sums,
+                                                  self._parameters)]
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            backup = [p._data for p in self._parameters]
+            if self._count:
+                for p, s in zip(self._parameters, self._sums):
+                    p._data = s / self._count
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for p, b in zip(self._parameters, backup):
+                        p._data = b
+
+        return ctx()
+
+    def restore(self, executor=None):
+        pass
+
+
+def inference(*args, **kwargs):
+    raise NotImplementedError(
+        "paddle.incubate.inference: serve jitted programs via jax.export/"
+        "StableHLO (see paddle.onnx.export)")

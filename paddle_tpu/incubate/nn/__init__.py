@@ -1,1 +1,5 @@
 from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedFeedForward,
+    FusedLinear, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer)

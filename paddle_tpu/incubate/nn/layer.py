@@ -1,0 +1,201 @@
+"""incubate.nn fused layers (parity: python/paddle/incubate/nn/layer/*).
+
+On TPU the "fusion" is the compiler's: these layers express the same math
+as straight-line jnp that XLA fuses into the surrounding matmuls; the
+attention core rides the Pallas flash kernel via nn.functional.
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.incubate.nn import functional as FF
+
+
+class FusedLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_features], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x):
+        return FF.fused_linear(x, self.weight, self.bias,
+                               self.transpose_weight)
+
+
+class FusedDropoutAdd(nn.Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return FF.fused_dropout_add(x, y, self.p, self.training, self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=nn.initializer.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        h = F.dropout(x + self.linear_bias, self.dropout_rate,
+                      training=self.training)
+        return F.layer_norm(h + residual, [h.shape[-1]], self.ln_scale,
+                            self.ln_bias, self.epsilon)
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """parity: incubate/nn/layer/fused_transformer.py FusedMultiHeadAttention."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        one = nn.initializer.Constant(1.0)
+        self.qkv_weight = self.create_parameter([embed_dim, 3 * embed_dim])
+        self.qkv_bias = self.create_parameter([3 * embed_dim], is_bias=True)
+        self.linear_weight = self.create_parameter([embed_dim, embed_dim])
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.pre_ln_scale = self.create_parameter([embed_dim],
+                                                  default_initializer=one)
+        self.pre_ln_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter([embed_dim],
+                                              default_initializer=one)
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, query, attn_mask=None, cache=None):
+        x = query
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.embed_dim], self.pre_ln_scale,
+                             self.pre_ln_bias, self.epsilon)
+        b, s, _ = x.shape
+        hd = self.embed_dim // self.num_heads
+        qkv = x.matmul(self.qkv_weight) + self.qkv_bias
+        q, k, v = paddle.split(qkv, 3, axis=-1)
+        q = q.reshape([b, s, self.num_heads, hd])
+        k = k.reshape([b, s, self.num_heads, hd])
+        v = v.reshape([b, s, self.num_heads, hd])
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, is_causal=False,
+            training=self.training)
+        out = out.reshape([b, s, self.embed_dim])
+        out = out.matmul(self.linear_weight) + self.linear_bias
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.embed_dim], self.ln_scale,
+                               self.ln_bias, self.epsilon)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        one = nn.initializer.Constant(1.0)
+        self.linear1_weight = self.create_parameter([d_model, dim_feedforward])
+        self.linear1_bias = self.create_parameter([dim_feedforward],
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter([dim_feedforward, d_model])
+        self.linear2_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln_scale = self.create_parameter([d_model],
+                                              default_initializer=one)
+        self.ln_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = src
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.d_model], self.ln_scale, self.ln_bias,
+                             self.epsilon)
+        act = getattr(F, self.activation)
+        h = act(x.matmul(self.linear1_weight) + self.linear1_bias)
+        h = F.dropout(h, self.act_dropout_rate, training=self.training)
+        h = h.matmul(self.linear2_weight) + self.linear2_bias
+        h = F.dropout(h, self.dropout_rate, training=self.training)
+        out = residual + h
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.d_model], self.ln_scale,
+                               self.ln_bias, self.epsilon)
+        return out
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(nn.Layer):
+    """Stacked fused decoder layers (inference-style API)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, nranks=1,
+                 ring_id=-1, name=None, **kw):
+        super().__init__()
+        self.layers = nn.LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward, dropout_rate,
+                activation, normalize_before=normalize_before)
+            for _ in range(num_layers)
+        ])
+
+    def forward(self, src, attn_mask=None, caches=None, **kw):
+        x = src
+        for layer in self.layers:
+            x = layer(x, src_mask=attn_mask)
+        return x

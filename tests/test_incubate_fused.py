@@ -1,0 +1,82 @@
+"""Incubate fused layers + optimizer wrappers + misc surfaces."""
+import numpy as np
+import pytest
+
+
+def test_fused_mha_and_ffn_train():
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate import nn as inn
+
+    layer = inn.FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+    x = paddle.randn([2, 8, 32])
+    out = layer(x)
+    assert tuple(out.shape) == (2, 8, 32)
+    loss = (out ** 2).mean()
+    loss.backward()
+    assert layer.fused_attn.qkv_weight.grad is not None
+
+
+def test_fused_multi_transformer():
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate import nn as inn
+
+    m = inn.FusedMultiTransformer(16, 2, 32, num_layers=2)
+    out = m(paddle.randn([1, 4, 16]))
+    assert tuple(out.shape) == (1, 4, 16)
+
+
+def test_lookahead_and_model_average():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.incubate import LookAhead, ModelAverage
+
+    model = nn.Linear(4, 1)
+    opt = LookAhead(paddle.optimizer.SGD(learning_rate=0.1,
+                                         parameters=model.parameters()),
+                    alpha=0.5, k=2)
+    ma = ModelAverage(parameters=model.parameters())
+    x = paddle.randn([8, 4])
+    y = paddle.randn([8, 1])
+    losses = []
+    for _ in range(6):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ma.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    w_before = np.asarray(model.weight.numpy()).copy()
+    with ma.apply():
+        w_avg = np.asarray(model.weight.numpy())
+        assert not np.allclose(w_avg, w_before)
+    np.testing.assert_allclose(np.asarray(model.weight.numpy()), w_before)
+
+
+def test_softmax_mask_fuse_upper_triangle():
+    import paddle_tpu as paddle
+    from paddle_tpu import incubate
+
+    x = paddle.randn([1, 2, 4, 4])
+    out = np.asarray(incubate.softmax_mask_fuse_upper_triangle(x).numpy())
+    # row 0 can only attend to position 0
+    np.testing.assert_allclose(out[0, 0, 0], [1, 0, 0, 0], atol=1e-6)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+
+def test_device_stream_api_and_tensor_introspection():
+    import paddle_tpu as paddle
+
+    s = paddle.device.Stream()
+    with paddle.device.stream_guard(s):
+        assert paddle.device.current_stream() is s
+    paddle.device.synchronize()
+    e = paddle.device.Event()
+    assert e.query()
+
+    t = paddle.ones([2, 3])
+    assert t.is_dense() and not t.is_sparse()
+    assert t.is_same_shape(paddle.zeros([2, 3]))
+    assert t.nnz() == 6
+    assert t.data is t
