@@ -8,3 +8,8 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply_op
 from . import functional  # noqa: F401
+
+from . import functional as features  # noqa: F401  (feature extractors live here)
+from . import datasets  # noqa: F401
+from . import backends  # noqa: F401
+from .backends import info, load, save  # noqa: F401

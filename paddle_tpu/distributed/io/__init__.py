@@ -1,0 +1,19 @@
+"""distributed.io (parity: python/paddle/distributed/io.py): save/load for
+distributed programs — delegates to the framework io + dist checkpoint."""
+from ...framework_io import load, save  # noqa: F401
+from ..checkpoint import load_state_dict, save_state_dict  # noqa: F401
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError("static PS persistables: use paddle.save / "
+                              "distributed.save_state_dict")
+
+
+def load_persistables(*a, **k):
+    raise NotImplementedError("static PS persistables: use paddle.load / "
+                              "distributed.load_state_dict")
+
+
+def is_persistable(var):
+    return True

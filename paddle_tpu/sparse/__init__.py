@@ -136,3 +136,126 @@ def relu(x, name=None):
     if isinstance(x, SparseCooTensor):
         return sparse_coo_tensor(x.indices(), _relu(x.values()), tuple(x.shape))
     return _relu(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    if isinstance(x, SparseCooTensor):
+        vals = x.values()
+        if value_dtype is not None:
+            vals = vals.astype(value_dtype)
+        ind = x.indices()
+        if index_dtype is not None:
+            ind = ind.astype(index_dtype)
+        return sparse_coo_tensor(ind, vals, tuple(x.shape))
+    return x.astype(value_dtype) if value_dtype else x
+
+
+deg2rad = _valuewise("deg2rad", jnp.deg2rad)
+rad2deg = _valuewise("rad2deg", jnp.rad2deg)
+isnan = _valuewise("isnan", jnp.isnan)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def mask_as(x, mask, name=None):
+    """Keep x's values at mask's nonzero coordinate pattern."""
+    if isinstance(mask, SparseCooTensor):
+        ind = mask.indices()
+        def _take(xd, idx):
+            return xd[tuple(idx)]
+        vals = apply_op(_take, x, ind, _op_name="mask_take")
+        return sparse_coo_tensor(ind, vals, tuple(x.shape))
+    return x * mask
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return input * beta + matmul(x, y) * alpha
+
+
+def _ew(name, jfn):
+    def op(x, y, name=None):
+        xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+        yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+        out = apply_op(jfn, xd, yd, _op_name=name)
+        if isinstance(x, SparseCooTensor):
+            return to_sparse_coo_auto(out)
+        return out
+
+    op.__name__ = name
+    return op
+
+
+subtract = _ew("subtract", lambda a, b: a - b)
+multiply = _ew("multiply", lambda a, b: a * b)
+divide = _ew("divide", lambda a, b: a / b)
+
+
+def to_sparse_coo_auto(dense):
+    arr = np.asarray(dense.numpy())
+    idx = np.stack(np.nonzero(arr))
+    return SparseCooTensor(Tensor(jnp.asarray(idx)),
+                           Tensor(jnp.asarray(arr[tuple(idx)])),
+                           arr.shape)
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        ind = np.asarray(x.indices().numpy())[list(perm)]
+        shape = tuple(np.asarray(x.shape)[list(perm)])
+        return sparse_coo_tensor(ind, x.values(), shape)
+    from ..ops.manipulation import transpose as _t
+
+    return _t(x, perm)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    return apply_op(lambda a: jnp.sum(a, axis=axis, keepdims=keepdim), xd,
+                    _op_name="sparse_sum")
+
+
+def coalesce(x, name=None):
+    """Merge duplicate coordinates (sums values)."""
+    ind = np.asarray(x.indices().numpy())
+    dense = np.asarray(x.to_dense().numpy())
+    idx = np.stack(np.nonzero(dense))
+    return SparseCooTensor(Tensor(jnp.asarray(idx)),
+                           Tensor(jnp.asarray(dense[tuple(idx)])),
+                           tuple(x.shape))
+
+
+def reshape(x, shape, name=None):
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    out = apply_op(lambda a: a.reshape(shape), xd, _op_name="sparse_reshape")
+    if isinstance(x, SparseCooTensor):
+        return to_sparse_coo_auto(out)
+    return out
+
+
+def slice(x, axes, starts, ends, name=None):
+    import builtins
+
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+
+    def _sl(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(st, en)
+        return a[tuple(idx)]
+
+    out = apply_op(_sl, xd, _op_name="sparse_slice")
+    if isinstance(x, SparseCooTensor):
+        return to_sparse_coo_auto(out)
+    return out
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    from ..linalg_ns import pca_lowrank as _pca
+
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    return _pca(xd, q=q, center=center, niter=niter)
+
+
+from . import nn  # noqa: E402,F401

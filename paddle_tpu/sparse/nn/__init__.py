@@ -1,0 +1,161 @@
+"""paddle.sparse.nn (parity: python/paddle/sparse/nn): layers operating on
+SparseCooTensor activations. TPU form: compute on values (elementwise) or
+densified neighborhoods (conv) — XLA has no sparse conv kernels, matching
+capability not kernel strategy."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layer.layers import Layer
+from .. import SparseCooTensor, sparse_coo_tensor
+from . import functional  # noqa: F401
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn.layer.norm import BatchNorm1D
+
+        self.inner = BatchNorm1D(num_features, momentum=momentum,
+                                 epsilon=epsilon)
+
+    def forward(self, x):
+        vals = self.inner(x.values())
+        return sparse_coo_tensor(x.indices(), vals, tuple(x.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class _SparseConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__()
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.subm = subm
+        self.stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+        self.padding = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels, out_channels])
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_channels], is_bias=True))
+
+    def forward(self, x):
+        # densify -> conv3d (NDHWC) -> resparsify
+        from ...core.dispatch import apply_op
+
+        dense = x.to_dense()
+
+        def _c(a, w, b):
+            out = jax.lax.conv_general_dilated(
+                a, w, window_strides=self.stride,
+                padding=[(p, p) for p in self.padding],
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            if b is not None:
+                out = out + b
+            return out
+
+        out = apply_op(_c, dense, self.weight, self.bias,
+                       _op_name="sparse_conv3d")
+        from .. import to_sparse_coo_auto
+
+        return to_sparse_coo_auto(out)
+
+
+class Conv3D(_SparseConvNd):
+    pass
+
+
+class SubmConv3D(_SparseConvNd):
+    def __init__(self, *args, **kwargs):
+        kwargs["subm"] = True
+        super().__init__(*args, **kwargs)
+
+
+class Conv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, key=None,
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__()
+        from ...nn.layer.conv import Conv2D as DenseConv2D
+
+        self.inner = DenseConv2D(in_channels, out_channels, kernel_size,
+                                 stride, padding, dilation, groups,
+                                 bias_attr=bias_attr)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        dense = x.to_dense()
+        nchw = paddle.transpose(dense, [0, 3, 1, 2])
+        out = self.inner(nchw)
+        out = paddle.transpose(out, [0, 2, 3, 1])
+        from .. import to_sparse_coo_auto
+
+        return to_sparse_coo_auto(out)
+
+
+class SubmConv2D(Conv2D):
+    pass
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride = self.ks if stride is None else (
+            (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+        self.padding = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+
+    def forward(self, x):
+        from ...core.dispatch import apply_op
+
+        dense = x.to_dense()
+
+        def _mp(a):
+            return jax.lax.reduce_window(
+                a, -jnp.inf, jax.lax.max,
+                (1,) + self.ks + (1,), (1,) + self.stride + (1,),
+                [(0, 0)] + [(p, p) for p in self.padding] + [(0, 0)])
+
+        out = apply_op(_mp, dense, _op_name="sparse_maxpool3d")
+        from .. import to_sparse_coo_auto
+
+        return to_sparse_coo_auto(out)

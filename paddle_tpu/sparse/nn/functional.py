@@ -1,0 +1,70 @@
+"""paddle.sparse.nn.functional: value-wise activations on sparse tensors."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from .. import SparseCooTensor, sparse_coo_tensor
+
+
+def _valuewise(name, jfn):
+    def op(x, *args, **kwargs):
+        if isinstance(x, SparseCooTensor):
+            vals = apply_op(jfn, x.values(), _op_name=name)
+            return sparse_coo_tensor(x.indices(), vals, tuple(x.shape))
+        return apply_op(jfn, x, _op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+relu = _valuewise("relu", lambda a: jnp.maximum(a, 0))
+relu6 = _valuewise("relu6", lambda a: jnp.clip(a, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    if isinstance(x, SparseCooTensor):
+        vals = apply_op(lambda a: jnp.where(a >= 0, a, negative_slope * a),
+                        x.values(), _op_name="leaky_relu")
+        return sparse_coo_tensor(x.indices(), vals, tuple(x.shape))
+    return apply_op(lambda a: jnp.where(a >= 0, a, negative_slope * a), x,
+                    _op_name="leaky_relu")
+
+
+def softmax(x, axis=-1):
+    """Sparse softmax over the last dense axis (on the dense view, zeros
+    excluded per-row via masking)."""
+    from ...core.dispatch import apply_op as _ao
+
+    if isinstance(x, SparseCooTensor):
+        dense = x.to_dense()
+
+        def _sm(a):
+            mask = a != 0
+            lg = jnp.where(mask, a, -1e30)
+            out = jax.nn.softmax(lg, axis=axis)
+            return jnp.where(mask, out, 0.0)
+
+        out = _ao(_sm, dense, _op_name="sparse_softmax")
+        from .. import to_sparse_coo_auto
+
+        return to_sparse_coo_auto(out)
+    return _ao(lambda a: jax.nn.softmax(a, axis=axis), x, _op_name="softmax")
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (parity: sparse/nn/functional/transformer.py)."""
+    from ...nn.functional.flash_attention import _xla_sdpa
+
+    mask_dense = sparse_mask.to_dense() if isinstance(
+        sparse_mask, SparseCooTensor) else sparse_mask
+
+    def _attn(q, k, v, m):
+        lg_mask = jnp.where(m != 0, 0.0, -1e30)
+        qh = jnp.swapaxes(q, 1, 2) if q.ndim == 4 else q
+        return _xla_sdpa(q, k, v, mask=lg_mask)
+
+    return apply_op(_attn, query, key, value, mask_dense,
+                    _op_name="sparse_attention")

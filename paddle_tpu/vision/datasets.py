@@ -134,3 +134,52 @@ class DatasetFolder(Dataset):
 
 
 ImageFolder = DatasetFolder
+
+
+class Flowers(Dataset):
+    """Synthetic-fallback Flowers102 (zero-egress stand-in)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend=None):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.n = 128
+        self.transform = transform
+        self.images = [
+            (rng.rand(64, 64, 3) * 255).astype(np.uint8)
+            for _ in range(self.n)
+        ]
+        self.labels = rng.randint(0, 102, (self.n,))
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return self.n
+
+
+class VOC2012(Dataset):
+    """Synthetic-fallback VOC segmentation pairs."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend=None):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.n = 64
+        self.transform = transform
+        self.images = [
+            (rng.rand(64, 64, 3) * 255).astype(np.uint8)
+            for _ in range(self.n)
+        ]
+        self.masks = [rng.randint(0, 21, (64, 64)).astype(np.uint8)
+                      for _ in range(self.n)]
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return self.n

@@ -315,3 +315,597 @@ class MobileNetV2(nn.Layer):
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
     return MobileNetV2(scale=scale, **kwargs)
+
+
+# -- resnext / wide resnet (ResNet parameterisations) ----------------------
+def resnext50_32x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, 50, groups=32, width=4, **kw)
+
+
+def resnext50_64x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, 50, groups=64, width=4, **kw)
+
+
+def resnext101_32x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, 101, groups=32, width=4, **kw)
+
+
+def resnext101_64x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, 101, groups=64, width=4, **kw)
+
+
+def resnext152_32x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, 152, groups=32, width=4, **kw)
+
+
+def resnext152_64x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, 152, groups=64, width=4, **kw)
+
+
+def wide_resnet50_2(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, 50, width=128, **kw)
+
+
+def wide_resnet101_2(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, 101, width=128, **kw)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kw):
+    cfg = [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]
+    return VGG(_make_vgg_layers(cfg, batch_norm), **kw)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kw):
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+           512, 512, "M"]
+    return VGG(_make_vgg_layers(cfg, batch_norm), **kw)
+
+
+# -- MobileNetV1 ------------------------------------------------------------
+class MobileNetV1(nn.Layer):
+    """parity: vision/models/mobilenetv1.py (depthwise-separable stacks)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        def dw_sep(inp, oup, stride):
+            return nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride, 1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp), nn.ReLU(),
+                nn.Conv2D(inp, oup, 1, 1, 0, bias_attr=False),
+                nn.BatchNorm2D(oup), nn.ReLU(),
+            )
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + [
+               (512, 1024, 2), (1024, 1024, 1)]
+        layers = [nn.Sequential(
+            nn.Conv2D(3, c(32), 3, 2, 1, bias_attr=False),
+            nn.BatchNorm2D(c(32)), nn.ReLU())]
+        for inp, oup, st in cfg:
+            layers.append(dw_sep(c(inp), c(oup), st))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.functional.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+# -- MobileNetV3 ------------------------------------------------------------
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, ch // squeeze, 1)
+        self.fc2 = nn.Conv2D(ch // squeeze, ch, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, inp, exp, out, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        act_layer = nn.Hardswish if act == "hs" else nn.ReLU
+        layers = []
+        if exp != inp:
+            layers += [nn.Conv2D(inp, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), act_layer()]
+        layers += [nn.Conv2D(exp, exp, k, stride, k // 2, groups=exp,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp), act_layer()]
+        if se:
+            layers.append(_SqueezeExcite(exp))
+        layers += [nn.Conv2D(exp, out, 1, bias_attr=False),
+                   nn.BatchNorm2D(out)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        layers = [nn.Sequential(
+            nn.Conv2D(3, c(16), 3, 2, 1, bias_attr=False),
+            nn.BatchNorm2D(c(16)), nn.Hardswish())]
+        inp = c(16)
+        for k, exp, out, se, act, st in cfg:
+            layers.append(_MBV3Block(inp, c(exp), c(out), k, st, se, act))
+            inp = c(out)
+        last_conv = c(cfg[-1][1])
+        layers.append(nn.Sequential(
+            nn.Conv2D(inp, last_conv, 1, bias_attr=False),
+            nn.BatchNorm2D(last_conv), nn.Hardswish()))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.functional.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+_MBV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hs", 2),
+    (5, 240, 40, True, "hs", 1), (5, 240, 40, True, "hs", 1),
+    (5, 120, 48, True, "hs", 1), (5, 144, 48, True, "hs", 1),
+    (5, 288, 96, True, "hs", 2), (5, 576, 96, True, "hs", 1),
+    (5, 576, 96, True, "hs", 1),
+]
+_MBV3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hs", 2), (3, 200, 80, False, "hs", 1),
+    (3, 184, 80, False, "hs", 1), (3, 184, 80, False, "hs", 1),
+    (3, 480, 112, True, "hs", 1), (3, 672, 112, True, "hs", 1),
+    (5, 672, 160, True, "hs", 2), (5, 960, 160, True, "hs", 1),
+    (5, 960, 160, True, "hs", 1),
+]
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+# -- DenseNet ---------------------------------------------------------------
+class _DenseLayer(nn.Layer):
+    def __init__(self, inp, growth, bn_size):
+        super().__init__()
+        self.block = nn.Sequential(
+            nn.BatchNorm2D(inp), nn.ReLU(),
+            nn.Conv2D(inp, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                      bias_attr=False),
+        )
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        return paddle.concat([x, self.block(x)], axis=1)
+
+
+class DenseNet(nn.Layer):
+    """parity: vision/models/densenet.py"""
+
+    _cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+             169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+             264: (6, 12, 64, 48)}
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        block_cfg = self._cfgs[layers]
+        ch = 2 * growth_rate
+        feats = [nn.Sequential(
+            nn.Conv2D(3, ch, 7, 2, 3, bias_attr=False),
+            nn.BatchNorm2D(ch), nn.ReLU(), nn.MaxPool2D(3, 2, 1))]
+        for bi, n_layers in enumerate(block_cfg):
+            for _ in range(n_layers):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if bi != len(block_cfg) - 1:
+                feats.append(nn.Sequential(
+                    nn.BatchNorm2D(ch), nn.ReLU(),
+                    nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                    nn.AvgPool2D(2, 2)))
+                ch //= 2
+        feats.append(nn.Sequential(nn.BatchNorm2D(ch), nn.ReLU()))
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.functional.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, growth_rate=48, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
+
+
+# -- SqueezeNet -------------------------------------------------------------
+class _Fire(nn.Layer):
+    def __init__(self, inp, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Sequential(nn.Conv2D(inp, squeeze, 1), nn.ReLU())
+        self.e1 = nn.Sequential(nn.Conv2D(squeeze, e1, 1), nn.ReLU())
+        self.e3 = nn.Sequential(nn.Conv2D(squeeze, e3, 3, padding=1),
+                                nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        s = self.squeeze(x)
+        return paddle.concat([self.e1(s), self.e3(s)], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """parity: vision/models/squeezenet.py (version 1.0/1.1)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, 2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, 2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2), _Fire(128, 32, 128, 128),
+                _Fire(256, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier_conv = nn.Conv2D(512, num_classes, 1)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = nn.functional.relu(self.classifier_conv(x))
+        x = self.pool(x)
+        return nn.functional.flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet("1.1", **kw)
+
+
+# -- InceptionV3 (compact faithful variant) ---------------------------------
+class _ConvBN(nn.Layer):
+    def __init__(self, inp, out, k, **kw):
+        super().__init__()
+        self.conv = nn.Conv2D(inp, out, k, bias_attr=False, **kw)
+        self.bn = nn.BatchNorm2D(out)
+
+    def forward(self, x):
+        return nn.functional.relu(self.bn(self.conv(x)))
+
+
+class InceptionV3(nn.Layer):
+    """parity: vision/models/inceptionv3.py — stem + mixed blocks;
+    structurally faithful (branch concat topology) at reduced block count
+    detail; classifier head matches (2048 -> num_classes)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), nn.MaxPool2D(3, 2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), nn.MaxPool2D(3, 2))
+
+        def mixed(inp, b1, b5r, b5, b3r, b3, pool_p):
+            class _Mixed(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.b1 = _ConvBN(inp, b1, 1)
+                    self.b5 = nn.Sequential(_ConvBN(inp, b5r, 1),
+                                            _ConvBN(b5r, b5, 5, padding=2))
+                    self.b3 = nn.Sequential(
+                        _ConvBN(inp, b3r, 1),
+                        _ConvBN(b3r, b3, 3, padding=1),
+                        _ConvBN(b3, b3, 3, padding=1))
+                    self.pool = nn.Sequential(nn.AvgPool2D(3, 1, 1),
+                                              _ConvBN(inp, pool_p, 1))
+
+                def forward(self, x):
+                    import paddle_tpu as paddle
+
+                    return paddle.concat(
+                        [self.b1(x), self.b5(x), self.b3(x), self.pool(x)],
+                        axis=1)
+
+            return _Mixed()
+
+        self.mixed1 = mixed(192, 64, 48, 64, 64, 96, 32)   # -> 256
+        self.mixed2 = mixed(256, 64, 48, 64, 64, 96, 64)   # -> 288
+        self.reduce1 = nn.Sequential(_ConvBN(288, 768, 3, stride=2))
+        self.mixed3 = mixed(768, 192, 128, 192, 128, 192, 192)  # -> 768
+        self.reduce2 = nn.Sequential(_ConvBN(768, 2048, 3, stride=2))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.mixed2(self.mixed1(x))
+        x = self.mixed3(self.reduce1(x))
+        x = self.reduce2(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.functional.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)
+
+
+# -- ShuffleNetV2 -----------------------------------------------------------
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, inp, out, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride, 1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+            in2 = inp
+        else:
+            self.branch1 = None
+            in2 = inp // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride, 1, groups=branch,
+                      bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        if self.stride == 2:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            x1, x2 = paddle.split(x, 2, axis=1)
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        # channel shuffle (groups=2)
+        n, c, h, w = out.shape
+        out = out.reshape([n, 2, c // 2, h, w]).transpose(
+            [0, 2, 1, 3, 4]).reshape([n, c, h, w])
+        return out
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        chs = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+               1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}[scale]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 24, 3, 2, 1, bias_attr=False), nn.BatchNorm2D(24),
+            nn.ReLU(), nn.MaxPool2D(3, 2, 1))
+        stages = []
+        inp = 24
+        for ci, reps in zip(chs[:3], (4, 8, 4)):
+            stages.append(_ShuffleUnit(inp, ci, 2))
+            for _ in range(reps - 1):
+                stages.append(_ShuffleUnit(ci, ci, 1))
+            inp = ci
+        self.stages = nn.Sequential(*stages)
+        self.final = nn.Sequential(
+            nn.Conv2D(inp, chs[3], 1, bias_attr=False),
+            nn.BatchNorm2D(chs[3]), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chs[3], num_classes)
+
+    def forward(self, x):
+        x = self.final(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.functional.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, act="swish", **kw)
+
+
+# -- GoogLeNet --------------------------------------------------------------
+class GoogLeNet(nn.Layer):
+    """Inception-v1 (structure-faithful compact form; main head only)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, 2, 3), nn.ReLU(), nn.MaxPool2D(3, 2, 1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2, 1))
+
+        def inc(inp, c1, c3r, c3, c5r, c5, pp):
+            class _Inc(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.b1 = nn.Sequential(nn.Conv2D(inp, c1, 1), nn.ReLU())
+                    self.b3 = nn.Sequential(nn.Conv2D(inp, c3r, 1), nn.ReLU(),
+                                            nn.Conv2D(c3r, c3, 3, padding=1),
+                                            nn.ReLU())
+                    self.b5 = nn.Sequential(nn.Conv2D(inp, c5r, 1), nn.ReLU(),
+                                            nn.Conv2D(c5r, c5, 5, padding=2),
+                                            nn.ReLU())
+                    self.bp = nn.Sequential(nn.MaxPool2D(3, 1, 1),
+                                            nn.Conv2D(inp, pp, 1), nn.ReLU())
+
+                def forward(self, x):
+                    import paddle_tpu as paddle
+
+                    return paddle.concat(
+                        [self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                        axis=1)
+
+            return _Inc()
+
+        self.i3a = inc(192, 64, 96, 128, 16, 32, 32)    # 256
+        self.i3b = inc(256, 128, 128, 192, 32, 96, 64)  # 480
+        self.pool3 = nn.MaxPool2D(3, 2, 1)
+        self.i4a = inc(480, 192, 96, 208, 16, 48, 64)   # 512
+        self.i4e = inc(512, 256, 160, 320, 32, 128, 128)  # 832
+        self.pool4 = nn.MaxPool2D(3, 2, 1)
+        self.i5b = inc(832, 384, 192, 384, 48, 128, 128)  # 1024
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4a(x)))
+        x = self.i5b(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.functional.flatten(x, 1)
+            x = self.fc(x)
+        return x, None, None  # parity: googlenet returns (main, aux1, aux2)
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
