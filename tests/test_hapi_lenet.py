@@ -1,0 +1,45 @@
+"""Baseline config 1: LeNet/MNIST via paddle.Model.fit (hapi end-to-end)."""
+import numpy as np
+import pytest
+
+
+def test_lenet_mnist_model_fit():
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.models import LeNet
+
+    class FakeMNIST(Dataset):
+        """Deterministic separable digits stand-in (28x28 grayscale)."""
+
+        def __init__(self, n=256):
+            rng = np.random.RandomState(0)
+            self.labels = rng.randint(0, 10, (n,))
+            self.images = np.zeros((n, 1, 28, 28), np.float32)
+            for i, lab in enumerate(self.labels):
+                self.images[i, 0, lab * 2:lab * 2 + 4, :] = 1.0
+                self.images[i] += rng.randn(1, 28, 28).astype(np.float32) * 0.05
+
+        def __getitem__(self, idx):
+            return self.images[idx], np.int64(self.labels[idx])
+
+        def __len__(self):
+            return len(self.labels)
+
+    paddle.seed(0)
+    model = paddle.Model(LeNet(num_classes=10))
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=model.network.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+        Accuracy(),
+    )
+    train_loader = DataLoader(FakeMNIST(256), batch_size=64, shuffle=True)
+    hist = model.fit(train_loader, epochs=6, verbose=0)
+
+    eval_loader = DataLoader(FakeMNIST(128), batch_size=64)
+    res = model.evaluate(eval_loader, verbose=0)
+    assert res["acc"] > 0.8, res
+
+    preds = model.predict(eval_loader)
+    assert np.asarray(preds[0][0]).shape[-1] == 10
