@@ -138,3 +138,310 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=
     from ....nn.functional.common import dropout
 
     return dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    def _fmb(a, b, bias_a):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        if bias_a is not None:
+            out = out + bias_a
+        return out
+
+    return apply_op(_fmb, x, y, bias, _op_name="fused_matmul_bias")
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "none": lambda a: a, "": lambda a: a}[activation]
+    return apply_op(act, out, _op_name="fused_linear_activation")
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """Functional fused MHA (fused_transformer.py parity).
+
+    qkv_weight: [3, H, D, E] (or [E, 3E] with transpose_qkv_wb).
+    """
+    from ....nn.functional.flash_attention import sdpa_arrays
+
+    def _fmha(xa, qkvw, lw, pls, plb, lns, lnb, qkvb, lb, mask):
+        b, s, e = xa.shape
+        h = xa
+        if pre_layer_norm:
+            mean = jnp.mean(h.astype(jnp.float32), -1, keepdims=True)
+            var = jnp.var(h.astype(jnp.float32), -1, keepdims=True)
+            h = ((h - mean) * jax.lax.rsqrt(var + pre_ln_epsilon)).astype(xa.dtype)
+            if pls is not None:
+                h = h * pls
+            if plb is not None:
+                h = h + plb
+        if transpose_qkv_wb:
+            nh = num_heads
+            qkv = h @ qkvw
+            if qkvb is not None:
+                qkv = qkv + qkvb
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            hd = e // nh
+        else:
+            three, nh, hd, _ = qkvw.shape
+            qkv = jnp.einsum("bse,nhde->bsnhd", h, qkvw)
+            if qkvb is not None:
+                qkv = qkv + qkvb[None, None]
+            q, k, v = qkv[:, :, 0].reshape(b, s, nh * hd), \
+                qkv[:, :, 1].reshape(b, s, nh * hd), \
+                qkv[:, :, 2].reshape(b, s, nh * hd)
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
+        if mask is not None:
+            from ....nn.functional.flash_attention import _xla_sdpa
+
+            out = _xla_sdpa(q, k, v, mask=mask)
+        else:
+            out = sdpa_arrays(q, k, v, causal=False)
+        out = out.reshape(b, s, nh * hd)
+        out = out @ lw
+        if lb is not None:
+            out = out + lb
+        if add_residual:
+            out = xa + out
+        if not pre_layer_norm:
+            mean = jnp.mean(out.astype(jnp.float32), -1, keepdims=True)
+            var = jnp.var(out.astype(jnp.float32), -1, keepdims=True)
+            out = ((out - mean) * jax.lax.rsqrt(var + ln_epsilon)).astype(xa.dtype)
+            if lns is not None:
+                out = out * lns
+            if lnb is not None:
+                out = out + lnb
+        return out
+
+    return apply_op(_fmha, x, qkv_weight, linear_weight, pre_ln_scale,
+                    pre_ln_bias, ln_scale, ln_bias, qkv_bias, linear_bias,
+                    attn_mask, _op_name="fused_multi_head_attention")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, name=None):
+    def _ffn(xa, w1, w2, b1, b2, s1, sb1, s2, sb2):
+        h = xa
+        def ln(a, scale, bias, eps):
+            mean = jnp.mean(a.astype(jnp.float32), -1, keepdims=True)
+            var = jnp.var(a.astype(jnp.float32), -1, keepdims=True)
+            out = ((a - mean) * jax.lax.rsqrt(var + eps)).astype(a.dtype)
+            if scale is not None:
+                out = out * scale
+            if bias is not None:
+                out = out + bias
+            return out
+
+        if pre_layer_norm:
+            h = ln(h, s1, sb1, ln1_epsilon)
+        act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+        h = act(h @ w1 + (b1 if b1 is not None else 0))
+        h = h @ w2 + (b2 if b2 is not None else 0)
+        out = xa + h
+        if not pre_layer_norm:
+            out = ln(out, s2, sb2, ln2_epsilon)
+        return out
+
+    return apply_op(_ffn, x, linear1_weight, linear2_weight, linear1_bias,
+                    linear2_bias, ln1_scale, ln1_bias, ln2_scale, ln2_bias,
+                    _op_name="fused_feedforward")
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-05, cache_kvs=None, pre_caches=None,
+                            seq_lens=None, rotary_embs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False, mode=None,
+                            trans_qkvw=True, ring_id=-1, name=None, **kw):
+    """Stacked fused decoder inference layers."""
+    out = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i] if ln_scales else None,
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            pre_layer_norm=pre_layer_norm, activation=activation,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            training=training)
+    if cache_kvs is not None:
+        return out, cache_kvs
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True,
+                                           mode="upscale_in_train",
+                                           name=None):
+    def _f(xa, res, b, s, lb):
+        h = xa + (b if b is not None else 0)
+        h = h + res
+        mean = jnp.mean(h.astype(jnp.float32), -1, keepdims=True)
+        var = jnp.var(h.astype(jnp.float32), -1, keepdims=True)
+        out = ((h - mean) * jax.lax.rsqrt(var + ln_epsilon)).astype(xa.dtype)
+        if s is not None:
+            out = out * s
+        if lb is not None:
+            out = out + lb
+        return out
+
+    return apply_op(_f, x, residual, bias, ln_scale, ln_bias,
+                    _op_name="fused_bias_dropout_residual_ln")
+
+
+def fused_moe(x, gate_weight, expert_weights1, expert_biases1,
+              expert_weights2, expert_biases2, moe_topk=2,
+              norm_topk_prob=True, group_moe=False, name=None):
+    """Fused MoE FFN (fusion/gpu fused_moe parity): top-k gate + stacked
+    expert FFNs via the GShard dense-dispatch einsums."""
+    from ....incubate.distributed.models.moe import _dense_dispatch_combine
+
+    def _moe(xa, gw, w1, b1, w2, b2):
+        shape = xa.shape
+        m = shape[-1]
+        flat = xa.reshape(-1, m)
+        logits = flat @ gw
+        e = logits.shape[-1]
+        val, idx = jax.lax.top_k(logits, moe_topk)
+        cap = flat.shape[0]  # full capacity: no drops in the fused op
+        ei, comb = _dense_dispatch_combine(flat, idx, val, e, cap)
+        h = jnp.einsum("ecm,emh->ech", ei, w1)
+        if b1 is not None:
+            h = h + b1[:, None]
+        h = jax.nn.gelu(h)
+        y = jnp.einsum("ech,ehm->ecm", h, w2)
+        if b2 is not None:
+            y = y + b2[:, None]
+        out = jnp.einsum("nec,ecm->nm", comb.astype(jnp.float32),
+                         y.astype(jnp.float32)).astype(xa.dtype)
+        return out.reshape(shape)
+
+    return apply_op(_moe, x, gate_weight, expert_weights1, expert_biases1,
+                    expert_weights2, expert_biases2, _op_name="fused_moe")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0,
+                                               name=None):
+    """Varlen attention: per-sequence validity masks over padded batches.
+    Layout [B, H, S, D] (matches the cutlass op)."""
+    import math as _math
+
+    def _vl(q, k, v, sl, kvl, m):
+        b, h, s, d = q.shape
+        sc = scale if scale is not None else 1.0 / _math.sqrt(d)
+        logits = jnp.einsum("bhsd,bhtd->bhst", q * sc, k)
+        kpos = jnp.arange(k.shape[2])[None, None, None, :]
+        valid = kpos < kvl.reshape(-1)[:, None, None, None]
+        if causal:
+            qpos = jnp.arange(s)[None, None, :, None]
+            valid = valid & (kpos <= qpos)
+        if m is not None:
+            logits = logits + m
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, -1)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+
+    return apply_op(_vl, query, key, value, seq_lens, kv_seq_lens, mask,
+                    _op_name="varlen_attention")
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default",
+                               out_scale=-1, quant_round_type=1,
+                               quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Single-token decode attention over a [2, B, H, MaxLen, D] cache
+    (fusion/gpu masked_multihead_attention parity)."""
+    def _mmha(xa, cache, b_in, mask):
+        b = xa.shape[0]
+        two, _, h, max_len, d = cache.shape
+        qkv = xa.reshape(b, 3, h, d)
+        if b_in is not None:
+            qkv = qkv + b_in.reshape(1, 3, h, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        # append to cache at the first empty slot = current length
+        # caller tracks length via sequence_lengths; default: use mask sum
+        if sequence_lengths is not None:
+            cur = sequence_lengths._data.reshape(-1)[0]
+        else:
+            cur = jnp.int32(0)
+        kc = jax.lax.dynamic_update_slice(
+            cache[0], k[:, :, None, :].astype(cache.dtype),
+            (jnp.int32(0), jnp.int32(0), jnp.int32(cur), jnp.int32(0)))
+        vc = jax.lax.dynamic_update_slice(
+            cache[1], v[:, :, None, :].astype(cache.dtype),
+            (jnp.int32(0), jnp.int32(0), jnp.int32(cur), jnp.int32(0)))
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        logits = jnp.einsum("bhd,bhtd->bht", q * scale, kc)
+        valid = jnp.arange(max_len)[None, None, :] <= cur
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, -1)
+        out = jnp.einsum("bht,bhtd->bhd", probs, vc)
+        return out.reshape(b, h * d), jnp.stack([kc, vc])
+
+    return apply_op(_mmha, x, cache_kv, bias, src_mask,
+                    _op_name="masked_multihead_attention")
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    def _g(a, b):
+        return jnp.max(a), jnp.max(b)
+
+    return apply_op(_g, seq_lens_encoder, seq_lens_decoder,
+                    _op_name="blha_get_max_len")
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets, cum_offsets, cu_seqlens_q,
+                              cu_seqlens_k, block_tables, *args, **kwargs):
+    raise NotImplementedError(
+        "block_multihead_attention (paged KV) is a serving-engine kernel; "
+        "the TPU decode path uses the fixed-shape kv cache in "
+        "models/llama.py generate() — paged attention lands with a pallas "
+        "kernel in a future round")

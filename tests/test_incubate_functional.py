@@ -1,0 +1,103 @@
+"""Incubate fused functional ops numerics."""
+import numpy as np
+import pytest
+
+
+def test_fused_mha_matches_unfused():
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as FF
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    b, s, e, h = 2, 8, 32, 4
+    x = paddle.to_tensor(rng.randn(b, s, e).astype(np.float32))
+    qkvw = paddle.to_tensor(rng.randn(3, h, e // h, e).astype(np.float32) * 0.1)
+    lw = paddle.to_tensor(rng.randn(e, e).astype(np.float32) * 0.1)
+
+    out = FF.fused_multi_head_attention(
+        x, qkvw, lw, pre_layer_norm=True, dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False)
+    assert tuple(out.shape) == (b, s, e)
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_fused_feedforward_residual_ln():
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as FF
+
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(2, 4, 16).astype(np.float32))
+    w1 = paddle.to_tensor(rng.randn(16, 32).astype(np.float32) * 0.1)
+    w2 = paddle.to_tensor(rng.randn(32, 16).astype(np.float32) * 0.1)
+    out = FF.fused_feedforward(x, w1, w2, pre_layer_norm=True,
+                               dropout1_rate=0.0, dropout2_rate=0.0)
+    assert tuple(out.shape) == (2, 4, 16)
+
+    res = paddle.to_tensor(rng.randn(2, 4, 16).astype(np.float32))
+    out2 = FF.fused_bias_dropout_residual_layer_norm(
+        x, res, dropout_rate=0.0)
+    ref = np.asarray((x + res).numpy())
+    mean = ref.mean(-1, keepdims=True)
+    var = ref.var(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(out2.numpy()),
+                               (ref - mean) / np.sqrt(var + 1e-5), atol=1e-4)
+
+
+def test_fused_moe_matches_dense_top1():
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as FF
+
+    rng = np.random.RandomState(2)
+    n, m, hdim, e = 6, 8, 16, 2
+    x = paddle.to_tensor(rng.randn(1, n, m).astype(np.float32))
+    gw = paddle.to_tensor(rng.randn(m, e).astype(np.float32))
+    w1 = paddle.to_tensor(rng.randn(e, m, hdim).astype(np.float32) * 0.3)
+    w2 = paddle.to_tensor(rng.randn(e, hdim, m).astype(np.float32) * 0.3)
+    out = FF.fused_moe(x, gw, w1, None, w2, None, moe_topk=1)
+
+    # top-1 reference: each token through its argmax expert (prob 1)
+    import jax
+
+    xa = np.asarray(x.numpy())[0]
+    choice = (xa @ np.asarray(gw.numpy())).argmax(-1)
+    ref = np.zeros_like(xa)
+    for t in range(n):
+        ei = int(choice[t])
+        h = np.asarray(jax.nn.gelu(xa[t] @ np.asarray(w1.numpy())[ei]))
+        ref[t] = h @ np.asarray(w2.numpy())[ei]
+    np.testing.assert_allclose(np.asarray(out.numpy())[0], ref,
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_varlen_attention_masks_padding():
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as FF
+
+    rng = np.random.RandomState(3)
+    q = paddle.to_tensor(rng.randn(1, 2, 6, 8).astype(np.float32))
+    sl = paddle.to_tensor(np.array([4], np.int32))
+    out = FF.variable_length_memory_efficient_attention(q, q, q, sl, sl)
+    # changing padded kv positions must not change the output
+    q2 = np.asarray(q.numpy()).copy()
+    q2[:, :, 4:] = 99.0
+    out2 = FF.variable_length_memory_efficient_attention(
+        paddle.to_tensor(q2), paddle.to_tensor(q2), paddle.to_tensor(q2),
+        sl, sl)
+    np.testing.assert_allclose(np.asarray(out.numpy())[:, :, :4],
+                               np.asarray(out2.numpy())[:, :, :4], atol=2e-5)
+
+
+def test_masked_multihead_attention_decode_step():
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as FF
+
+    rng = np.random.RandomState(4)
+    b, h, d, max_len = 2, 2, 8, 4
+    x = paddle.to_tensor(rng.randn(b, 3 * h * d).astype(np.float32))
+    cache = paddle.to_tensor(np.zeros((2, b, h, max_len, d), np.float32))
+    out, new_cache = FF.masked_multihead_attention(x, cache_kv=cache)
+    assert tuple(out.shape) == (b, h * d)
+    # first slot of the cache now holds k/v
+    nc = np.asarray(new_cache.numpy())
+    assert np.abs(nc[0][:, :, 0]).sum() > 0
+    assert np.abs(nc[0][:, :, 1:]).sum() == 0
