@@ -177,7 +177,17 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
 
     qkv_weight: [3, H, D, E] (or [E, 3E] with transpose_qkv_wb).
     """
+    from .... import framework
     from ....nn.functional.flash_attention import sdpa_arrays
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention cache_kv: use the kv-cache decode "
+            "path (models/llama.py generate) or masked_multihead_attention")
+    drop_key = (framework.next_rng_key()
+                if training and dropout_rate > 0.0 else None)
+    attn_key = (framework.next_rng_key()
+                if training and attn_dropout_rate > 0.0 else None)
 
     def _fmha(xa, qkvw, lw, pls, plb, lns, lnb, qkvb, lb, mask):
         b, s, e = xa.shape
@@ -208,16 +218,22 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         q = q.reshape(b, s, nh, hd)
         k = k.reshape(b, s, nh, hd)
         v = v.reshape(b, s, nh, hd)
-        if mask is not None:
+        if mask is not None or attn_key is not None:
             from ....nn.functional.flash_attention import _xla_sdpa
 
-            out = _xla_sdpa(q, k, v, mask=mask)
+            out = _xla_sdpa(q, k, v, mask=mask,
+                            dropout=attn_dropout_rate if attn_key is not None else 0.0,
+                            key=attn_key)
         else:
             out = sdpa_arrays(q, k, v, causal=False)
         out = out.reshape(b, s, nh * hd)
         out = out @ lw
         if lb is not None:
             out = out + lb
+        if drop_key is not None:
+            keep = jax.random.bernoulli(drop_key, 1.0 - dropout_rate,
+                                        out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
         if add_residual:
             out = xa + out
         if not pre_layer_norm:
@@ -242,6 +258,13 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
                       ln1_epsilon=1e-5, ln2_epsilon=1e-5,
                       pre_layer_norm=False, training=True,
                       mode="upscale_in_train", ring_id=-1, name=None):
+    from .... import framework
+
+    key1 = (framework.next_rng_key()
+            if training and dropout1_rate > 0.0 else None)
+    key2 = (framework.next_rng_key()
+            if training and dropout2_rate > 0.0 else None)
+
     def _ffn(xa, w1, w2, b1, b2, s1, sb1, s2, sb2):
         h = xa
         def ln(a, scale, bias, eps):
@@ -258,7 +281,13 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
             h = ln(h, s1, sb1, ln1_epsilon)
         act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
         h = act(h @ w1 + (b1 if b1 is not None else 0))
+        if key1 is not None:
+            keep = jax.random.bernoulli(key1, 1.0 - dropout1_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout1_rate), 0.0)
         h = h @ w2 + (b2 if b2 is not None else 0)
+        if key2 is not None:
+            keep = jax.random.bernoulli(key2, 1.0 - dropout2_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout2_rate), 0.0)
         out = xa + h
         if not pre_layer_norm:
             out = ln(out, s2, sb2, ln2_epsilon)
@@ -278,7 +307,12 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                             attn_mask=None, dropout_rate=0.0,
                             activation="gelu", training=False, mode=None,
                             trans_qkvw=True, ring_id=-1, name=None, **kw):
-    """Stacked fused decoder inference layers."""
+    """Stacked fused decoder inference layers (context/prefill form)."""
+    if cache_kvs is not None or time_step is not None or pre_caches is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer incremental decode (cache_kvs/"
+            "time_step): use models/llama.py generate() — the fixed-shape "
+            "kv-cache decode path")
     out = x
     n_layers = len(qkv_weights)
     for i in range(n_layers):
@@ -300,8 +334,6 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             pre_layer_norm=pre_layer_norm, activation=activation,
             dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
             training=training)
-    if cache_kvs is not None:
-        return out, cache_kvs
     return out
 
 
@@ -311,8 +343,16 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
                                            training=True,
                                            mode="upscale_in_train",
                                            name=None):
+    from .... import framework
+
+    dkey = (framework.next_rng_key()
+            if training and dropout_rate > 0.0 else None)
+
     def _f(xa, res, b, s, lb):
         h = xa + (b if b is not None else 0)
+        if dkey is not None:
+            keep = jax.random.bernoulli(dkey, 1.0 - dropout_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
         h = h + res
         mean = jnp.mean(h.astype(jnp.float32), -1, keepdims=True)
         var = jnp.var(h.astype(jnp.float32), -1, keepdims=True)
@@ -334,6 +374,9 @@ def fused_moe(x, gate_weight, expert_weights1, expert_biases1,
     expert FFNs via the GShard dense-dispatch einsums."""
     from ....incubate.distributed.models.moe import _dense_dispatch_combine
 
+    if group_moe:
+        raise NotImplementedError("fused_moe group_moe")
+
     def _moe(xa, gw, w1, b1, w2, b2):
         shape = xa.shape
         m = shape[-1]
@@ -343,6 +386,12 @@ def fused_moe(x, gate_weight, expert_weights1, expert_biases1,
         val, idx = jax.lax.top_k(logits, moe_topk)
         cap = flat.shape[0]  # full capacity: no drops in the fused op
         ei, comb = _dense_dispatch_combine(flat, idx, val, e, cap)
+        if not norm_topk_prob:
+            # reference weights by the full-softmax prob of each selected
+            # expert (sum < 1); comb rows are renormalised — rescale back
+            full = jax.nn.softmax(logits, -1)
+            sel = jnp.take_along_axis(full, idx, -1).sum(-1)
+            comb = comb * sel[:, None, None]
         h = jnp.einsum("ecm,emh->ech", ei, w1)
         if b1 is not None:
             h = h + b1[:, None]
@@ -375,7 +424,7 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
         valid = kpos < kvl.reshape(-1)[:, None, None, None]
         if causal:
             qpos = jnp.arange(s)[None, None, :, None]
-            valid = valid & (kpos <= qpos)
+            valid = valid & (kpos <= qpos + pre_cache_length)
         if m is not None:
             logits = logits + m
         logits = jnp.where(valid, logits, -1e30)
@@ -397,34 +446,33 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                                quant_min_bound=-127.0, name=None):
     """Single-token decode attention over a [2, B, H, MaxLen, D] cache
     (fusion/gpu masked_multihead_attention parity)."""
-    def _mmha(xa, cache, b_in, mask):
+    def _mmha(xa, cache, b_in, mask, seq_lens):
         b = xa.shape[0]
         two, _, h, max_len, d = cache.shape
         qkv = xa.reshape(b, 3, h, d)
         if b_in is not None:
             qkv = qkv + b_in.reshape(1, 3, h, d)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-        # append to cache at the first empty slot = current length
-        # caller tracks length via sequence_lengths; default: use mask sum
-        if sequence_lengths is not None:
-            cur = sequence_lengths._data.reshape(-1)[0]
+        # per-batch write position = that row's current length
+        if seq_lens is not None:
+            cur = seq_lens.reshape(-1).astype(jnp.int32)  # [B]
         else:
-            cur = jnp.int32(0)
-        kc = jax.lax.dynamic_update_slice(
-            cache[0], k[:, :, None, :].astype(cache.dtype),
-            (jnp.int32(0), jnp.int32(0), jnp.int32(cur), jnp.int32(0)))
-        vc = jax.lax.dynamic_update_slice(
-            cache[1], v[:, :, None, :].astype(cache.dtype),
-            (jnp.int32(0), jnp.int32(0), jnp.int32(cur), jnp.int32(0)))
+            cur = jnp.zeros((b,), jnp.int32)
+        bidx = jnp.arange(b)
+        kc = cache[0].at[bidx, :, cur, :].set(k.astype(cache.dtype))
+        vc = cache[1].at[bidx, :, cur, :].set(v.astype(cache.dtype))
         scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
         logits = jnp.einsum("bhd,bhtd->bht", q * scale, kc)
-        valid = jnp.arange(max_len)[None, None, :] <= cur
+        valid = (jnp.arange(max_len)[None, None, :]
+                 <= cur[:, None, None])
         logits = jnp.where(valid, logits, -1e30)
+        if mask is not None:
+            logits = logits + mask.reshape(b, 1, -1)[:, :, :max_len]
         probs = jax.nn.softmax(logits, -1)
         out = jnp.einsum("bht,bhtd->bhd", probs, vc)
         return out.reshape(b, h * d), jnp.stack([kc, vc])
 
-    return apply_op(_mmha, x, cache_kv, bias, src_mask,
+    return apply_op(_mmha, x, cache_kv, bias, src_mask, sequence_lengths,
                     _op_name="masked_multihead_attention")
 
 

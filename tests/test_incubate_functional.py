@@ -101,3 +101,61 @@ def test_masked_multihead_attention_decode_step():
     nc = np.asarray(new_cache.numpy())
     assert np.abs(nc[0][:, :, 0]).sum() > 0
     assert np.abs(nc[0][:, :, 1:]).sum() == 0
+
+
+def test_masked_mha_per_batch_lengths_and_mask():
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as FF
+
+    rng = np.random.RandomState(5)
+    b, h, d, max_len = 2, 1, 4, 6
+    cache = np.zeros((2, b, h, max_len, d), np.float32)
+    cache[0, 0, :, :3] = rng.randn(h, 3, d)  # batch 0 has 3 cached tokens
+    cache[1, 0, :, :3] = rng.randn(h, 3, d)
+    cache[0, 1, :, :5] = rng.randn(h, 5, d)  # batch 1 has 5
+    cache[1, 1, :, :5] = rng.randn(h, 5, d)
+    x = paddle.to_tensor(rng.randn(b, 3 * h * d).astype(np.float32))
+    lens = paddle.to_tensor(np.array([3, 5], np.int32))
+    out, nc = FF.masked_multihead_attention(
+        x, cache_kv=paddle.to_tensor(cache), sequence_lengths=lens)
+    nc = np.asarray(nc.numpy())
+    # each batch row's new kv written at ITS length slot
+    assert np.abs(nc[0][0, :, 3]).sum() > 0
+    assert np.abs(nc[0][1, :, 5]).sum() > 0
+    assert np.abs(nc[0][0, :, 4:]).sum() == 0
+
+
+def test_fused_mha_dropout_active_in_training():
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as FF
+
+    rng = np.random.RandomState(6)
+    x = paddle.to_tensor(rng.randn(1, 4, 16).astype(np.float32))
+    qkvw = paddle.to_tensor(rng.randn(3, 2, 8, 16).astype(np.float32) * 0.1)
+    lw = paddle.to_tensor(rng.randn(16, 16).astype(np.float32) * 0.1)
+    paddle.seed(0)
+    a = np.asarray(FF.fused_multi_head_attention(
+        x, qkvw, lw, dropout_rate=0.5, attn_dropout_rate=0.0,
+        training=True).numpy())
+    b = np.asarray(FF.fused_multi_head_attention(
+        x, qkvw, lw, dropout_rate=0.0, attn_dropout_rate=0.0,
+        training=True).numpy())
+    assert not np.allclose(a, b)
+
+
+def test_fused_moe_unnormalized_topk():
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as FF
+
+    rng = np.random.RandomState(7)
+    x = paddle.to_tensor(rng.randn(1, 4, 8).astype(np.float32))
+    gw = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    w1 = paddle.to_tensor(rng.randn(4, 8, 16).astype(np.float32) * 0.3)
+    w2 = paddle.to_tensor(rng.randn(4, 16, 8).astype(np.float32) * 0.3)
+    norm = np.asarray(FF.fused_moe(x, gw, w1, None, w2, None, moe_topk=2,
+                                   norm_topk_prob=True).numpy())
+    unnorm = np.asarray(FF.fused_moe(x, gw, w1, None, w2, None, moe_topk=2,
+                                     norm_topk_prob=False).numpy())
+    # unnormalized weights scale outputs down (selected probs sum < 1)
+    assert not np.allclose(norm, unnorm)
+    assert np.abs(unnorm).sum() < np.abs(norm).sum()
