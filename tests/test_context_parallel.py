@@ -106,3 +106,53 @@ def test_context_parallel_attention_api():
                                        strategy="ulysses")
     np.testing.assert_allclose(np.asarray(out_u._data), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_cp_attention_in_train_step():
+    """Ring attention trains inside the compiled sharded step (sep=4)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.context_parallel import (
+        context_parallel_attention)
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        mesh = fleet.get_fleet_mesh()
+
+        class CPAttn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.qkv = nn.Linear(16, 48)
+                self.out = nn.Linear(16, 1)
+
+            def forward(self, x):  # [B, S, 16]
+                q, k, v = paddle.split(self.qkv(x), 3, axis=-1)
+                def r(t):
+                    return t.reshape([t.shape[0], t.shape[1], 2, 8])
+                o = context_parallel_attention(
+                    r(q), r(k), r(v), mesh=mesh, causal=True,
+                    strategy="ring")
+                o = o.reshape([x.shape[0], x.shape[1], 16])
+                return self.out(o).mean(axis=[1, 2])
+
+        paddle.seed(11)
+        model = CPAttn()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+
+        def train_fn(x, y):
+            return ((model(x) - y) ** 2).mean()
+
+        step = ShardedTrainStep(model, train_fn, opt, mesh)
+        xs = paddle.randn([4, 32, 16])
+        ys = paddle.randn([4])
+        losses = [float(step(xs, ys)) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+    finally:
+        fleet._reset_for_tests()
