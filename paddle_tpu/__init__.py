@@ -195,7 +195,9 @@ from .ops.compat import to_dlpack, from_dlpack  # noqa: F401
 from .distributed.data_parallel import DataParallel  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 
-__version__ = "0.1.0"
+from . import version  # noqa: F401
+
+__version__ = version.full_version
 
 
 def disable_static(place=None):
