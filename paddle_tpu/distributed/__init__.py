@@ -520,3 +520,41 @@ def to_distributed(model, optimizer, dataloader, device_num=None,
     """parity: experimental to_distributed — returns the triple wired to
     the active mesh (ShardedTrainStep does placement at first step)."""
     return model, optimizer, dataloader
+
+
+class P2POp:
+    """parity: distributed.P2POp — a deferred send/recv description."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """parity: communication/batch_isend_irecv — under compiled SPMD the
+    batched p2p pairs lower to one fused ppermute; eagerly each op runs
+    through send/recv."""
+    tasks = []
+    for op in p2p_op_list:
+        if op.op in (isend, "isend", send):
+            tasks.append(isend(op.tensor, dst=op.peer, group=op.group))
+        else:
+            tasks.append(irecv(op.tensor, src=op.peer, group=op.group))
+    return tasks
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    barrier(group)
+
+
+def all_gather_into_tensor(output, input, group=None, sync_op=True):
+    """Concat-form all_gather writing into a preallocated output tensor."""
+    parts = []
+    all_gather(parts, input, group=group)
+    import paddle_tpu as _p
+
+    result = _p.concat(parts, axis=0)
+    output._data = result._data
+    return output
