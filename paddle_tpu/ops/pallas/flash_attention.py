@@ -176,6 +176,8 @@ def _fwd_call(kern, q, k, v, bhq, sq, sk, d, bq, bk, nq, nk, hq, hk,
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * bhq * sq * sk * d,
             bytes_accessed=(2 * bhq * sq * d + 2 * (bhq // (hq // hk)) * sk * d)
@@ -319,6 +321,8 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bhq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse8, delta8)
 
@@ -353,6 +357,8 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse8, delta8)
     return dq, dk, dv
