@@ -1,0 +1,248 @@
+"""OpTest-style battery: core op numerics + gradients vs torch.
+
+Mirrors the reference's test strategy (SURVEY §4: OpTest compares eager
+outputs and analytic gradients against a reference implementation).
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x))
+
+
+def _chk(ours, theirs, atol=1e-5, rtol=1e-5):
+    np.testing.assert_allclose(np.asarray(ours.numpy()), theirs.numpy(),
+                               atol=atol, rtol=rtol)
+
+
+UNARY = [
+    ("abs", {}), ("exp", {}), ("log", {}), ("sqrt", {}), ("rsqrt", {}),
+    ("sin", {}), ("cos", {}), ("tan", {}), ("sinh", {}), ("cosh", {}),
+    ("tanh", {}), ("asin", {}), ("acos", {}), ("atan", {}), ("asinh", {}),
+    ("acosh", {}), ("atanh", {}), ("erf", {}), ("erfinv", {}),
+    ("expm1", {}), ("log1p", {}), ("log2", {}), ("log10", {}),
+    ("floor", {}), ("ceil", {}), ("round", {}), ("trunc", {}),
+    ("sigmoid", {}), ("sign", {}), ("neg", {}), ("square", {}),
+    ("reciprocal", {}), ("digamma", {}), ("lgamma", {}), ("frac", {}),
+    ("i0", {}), ("logit", {"eps": 1e-6}),
+]
+
+
+def _domain(name, rng):
+    x = rng.randn(4, 5).astype(np.float32)
+    if name in ("log", "sqrt", "rsqrt", "log1p", "log2", "log10", "digamma",
+                "lgamma", "reciprocal"):
+        return np.abs(x) + 0.5
+    if name in ("asin", "acos", "atanh", "erfinv"):
+        return np.clip(x, -0.9, 0.9)
+    if name == "acosh":
+        return np.abs(x) + 1.5
+    if name == "logit":
+        return np.clip(np.abs(x), 0.05, 0.95)
+    return x
+
+
+def test_unary_ops_match_torch():
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(0)
+    failures = []
+    for name, kw in UNARY:
+        x = _domain(name, rng)
+        ours_fn = getattr(paddle, name)
+        theirs_fn = getattr(torch, name if name != "i0"
+                            else "special", None)
+        if name == "i0":
+            theirs = torch.special.i0(_t(x))
+        elif name == "logit":
+            theirs = torch.logit(_t(x), eps=kw.get("eps"))
+        else:
+            theirs = getattr(torch, name)(_t(x))
+        ours = ours_fn(paddle.to_tensor(x), **kw)
+        try:
+            np.testing.assert_allclose(np.asarray(ours.numpy()),
+                                       theirs.numpy(), atol=2e-5, rtol=2e-5)
+        except AssertionError as e:
+            failures.append((name, str(e).splitlines()[3]))
+    assert failures == [], failures
+
+
+BINARY = ["add", "subtract", "multiply", "divide", "maximum", "minimum",
+          "pow", "atan2", "fmax", "fmin", "remainder", "hypot",
+          "copysign", "nextafter", "logaddexp"]
+
+
+def test_binary_ops_match_torch():
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(1)
+    x = np.abs(rng.randn(4, 5)).astype(np.float32) + 0.5
+    y = np.abs(rng.randn(4, 5)).astype(np.float32) + 0.5
+    tmap = {"subtract": "sub", "multiply": "mul", "divide": "div"}
+    failures = []
+    for name in BINARY:
+        ours = getattr(paddle, name)(paddle.to_tensor(x), paddle.to_tensor(y))
+        theirs = getattr(torch, tmap.get(name, name))(_t(x), _t(y))
+        try:
+            np.testing.assert_allclose(np.asarray(ours.numpy()),
+                                       theirs.numpy(), atol=2e-5, rtol=2e-5)
+        except AssertionError as e:
+            failures.append(name)
+    assert failures == [], failures
+
+
+REDUCTIONS = [("sum", "sum"), ("mean", "mean"), ("max", "amax"),
+              ("min", "amin"), ("prod", "prod"),
+              ("logsumexp", "logsumexp"), ("std", "std"), ("var", "var"),
+              ("nansum", "nansum"), ("nanmean", "nanmean")]
+
+
+def test_median_matches_numpy():
+    # paddle's even-count median averages the two middles (numpy semantics,
+    # unlike torch's lower-middle)
+    import paddle_tpu as paddle
+
+    x = np.random.RandomState(9).randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(paddle.median(paddle.to_tensor(x), axis=1).numpy()),
+        np.median(x, axis=1), atol=1e-6)
+
+
+def test_reductions_match_torch():
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 6).astype(np.float32)
+    x[0, 0] = np.nan
+    failures = []
+    for ours_name, theirs_name in REDUCTIONS:
+        xs = x if "nan" in ours_name else np.abs(x) + 0.1
+        if ours_name == "logsumexp":
+            theirs = torch.logsumexp(_t(xs), dim=1)
+            ours = paddle.logsumexp(paddle.to_tensor(xs), axis=1)
+        else:
+            theirs = getattr(torch, theirs_name)(_t(xs), dim=1)
+            if not isinstance(theirs, torch.Tensor):  # e.g. median namedtuple
+                theirs = theirs.values
+            ours = getattr(paddle, ours_name)(paddle.to_tensor(xs), axis=1)
+        try:
+            np.testing.assert_allclose(np.asarray(ours.numpy()),
+                                       theirs.numpy(), atol=2e-5, rtol=2e-5)
+        except AssertionError:
+            failures.append(ours_name)
+    assert failures == [], failures
+
+
+def test_gradients_match_torch():
+    """Analytic gradients of composed expressions vs torch autograd."""
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(3)
+    x_np = (np.abs(rng.randn(3, 4)) + 0.5).astype(np.float32)
+
+    cases = [
+        (lambda t: (t ** 2).sum(), lambda t: (t ** 2).sum()),
+        (lambda t: t.sigmoid().mean(), lambda t: t.sigmoid().mean()),
+        (lambda t: (t.exp() * t.sin()).sum(),
+         lambda t: (t.exp() * t.sin()).sum()),
+        (lambda t: t.sqrt().log().sum(), lambda t: t.sqrt().log().sum()),
+        (lambda t: t.matmul(t.t()).trace(),
+         lambda t: t.matmul(t.t()).trace()),
+    ]
+    for ours_fn, theirs_fn in cases:
+        xp = paddle.to_tensor(x_np)
+        xp.stop_gradient = False
+        ours_fn(xp).backward()
+
+        xt = _t(x_np).requires_grad_(True)
+        theirs_fn(xt).backward()
+        np.testing.assert_allclose(np.asarray(xp.grad.numpy()),
+                                   xt.grad.numpy(), atol=1e-4, rtol=1e-4)
+
+
+def test_manipulation_ops_match_torch():
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    xp, xt = paddle.to_tensor(x), _t(x)
+
+    _chk(paddle.transpose(xp, [2, 0, 1]), xt.permute(2, 0, 1))
+    _chk(paddle.flip(xp, [1]), torch.flip(xt, [1]))
+    _chk(paddle.roll(xp, 2, 1), torch.roll(xt, 2, 1))
+    _chk(paddle.squeeze(paddle.unsqueeze(xp, 0), 0), xt)
+    _chk(paddle.tile(xp, [2, 1, 1]), xt.repeat(2, 1, 1))
+    _chk(paddle.cumsum(xp, 1), torch.cumsum(xt, 1))
+    _chk(paddle.cumprod(xp, 1), torch.cumprod(xt, 1))
+    _chk(paddle.diff(xp, axis=1), torch.diff(xt, dim=1))
+    _chk(paddle.sort(xp, 2), torch.sort(xt, 2).values)
+    _chk(paddle.argsort(xp, 2).astype("int64"), torch.argsort(xt, dim=2))
+    idx = np.array([2, 0], np.int64)
+    _chk(paddle.index_select(xp, paddle.to_tensor(idx), 1),
+         torch.index_select(xt, 1, _t(idx)))
+    _chk(paddle.gather(xp.reshape([12, 5]), paddle.to_tensor(idx)),
+         xt.reshape(12, 5)[_t(idx)])
+    _chk(paddle.kron(xp[0], xp[1]), torch.kron(xt[0], xt[1]))
+
+
+def test_activation_functionals_match_torch():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import torch.nn.functional as TF
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 8).astype(np.float32)
+    xp, xt = paddle.to_tensor(x), _t(x)
+
+    pairs = [
+        (F.relu, TF.relu), (F.gelu, TF.gelu), (F.silu, TF.silu),
+        (F.elu, TF.elu), (F.selu, TF.selu), (F.softplus, TF.softplus),
+        (F.softsign, TF.softsign), (F.hardtanh, TF.hardtanh),
+        (F.leaky_relu, TF.leaky_relu), (F.relu6, TF.relu6),
+        (F.hardswish, TF.hardswish), (F.hardsigmoid, TF.hardsigmoid),
+        (F.mish, TF.mish), (F.tanhshrink, TF.tanhshrink),
+        (F.softshrink, TF.softshrink), (F.hardshrink, TF.hardshrink),
+        (F.log_sigmoid, TF.logsigmoid),
+    ]
+    failures = []
+    for ours, theirs in pairs:
+        try:
+            np.testing.assert_allclose(
+                np.asarray(ours(xp).numpy()), theirs(xt).numpy(),
+                atol=2e-5, rtol=2e-5)
+        except AssertionError:
+            failures.append(ours.__name__)
+    assert failures == [], failures
+    _chk(F.softmax(xp, -1), TF.softmax(xt, -1))
+    _chk(F.log_softmax(xp, -1), TF.log_softmax(xt, -1))
+
+
+def test_loss_functionals_match_torch():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import torch.nn.functional as TF
+
+    rng = np.random.RandomState(6)
+    logits = rng.randn(6, 5).astype(np.float32)
+    labels = rng.randint(0, 5, (6,)).astype(np.int64)
+    probs = np.clip(np.abs(rng.randn(6, 5)), 0.05, 0.95).astype(np.float32)
+    x = rng.randn(6, 5).astype(np.float32)
+    y = rng.randn(6, 5).astype(np.float32)
+
+    _chk(F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels)),
+         TF.cross_entropy(_t(logits), _t(labels)))
+    _chk(F.mse_loss(paddle.to_tensor(x), paddle.to_tensor(y)),
+         TF.mse_loss(_t(x), _t(y)))
+    _chk(F.l1_loss(paddle.to_tensor(x), paddle.to_tensor(y)),
+         TF.l1_loss(_t(x), _t(y)))
+    _chk(F.smooth_l1_loss(paddle.to_tensor(x), paddle.to_tensor(y)),
+         TF.smooth_l1_loss(_t(x), _t(y)))
+    _chk(F.binary_cross_entropy(paddle.to_tensor(probs),
+                                paddle.to_tensor((probs > 0.5).astype(np.float32))),
+         TF.binary_cross_entropy(_t(probs), _t((probs > 0.5).astype(np.float32))))
+    _chk(F.kl_div(paddle.to_tensor(np.log(probs)), paddle.to_tensor(probs),
+                  reduction="mean"),
+         TF.kl_div(_t(np.log(probs)), _t(probs), reduction="mean"))
