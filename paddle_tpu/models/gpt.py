@@ -381,3 +381,84 @@ class GPTForCausalLMPipe(nn.Layer):
             logits.reshape([-1, self.config.vocab_size]),
             labels.reshape([-1]),
         )
+
+
+# ---------------------------------------------------------------------------
+# MoE variant (parity slot: PaddleNLP MoE GPT over incubate MoELayer)
+# ---------------------------------------------------------------------------
+class MoEDecoderLayer(nn.Layer):
+    """Decoder block whose MLP is a mixture of experts."""
+
+    def __init__(self, config: GPTConfig, num_experts=8, top_k=2,
+                 gate="gshard", capacity_factor=2.0):
+        super().__init__()
+        from paddle_tpu.incubate.distributed.models.moe import (
+            MoELayer, StackedExperts)
+
+        norm_cls = nn.RMSNorm if config.norm_type == "rmsnorm" else nn.LayerNorm
+        self.input_norm = norm_cls(config.hidden_size)
+        self.attn = Attention(config)
+        self.post_attn_norm = norm_cls(config.hidden_size)
+        self.moe = MoELayer(
+            config.hidden_size,
+            StackedExperts(num_experts, config.hidden_size,
+                           config.intermediate_size),
+            gate={"type": gate, "top_k": top_k},
+            capacity_factor=capacity_factor,
+        )
+
+    def forward(self, x, attn_mask=None):
+        h = x + self.attn(self.input_norm(x), attn_mask)
+        return h + self.moe(self.post_attn_norm(h))
+
+
+class GPTForCausalLMMoE(nn.Layer):
+    """Decoder LM with MoE FFNs; aux losses summed into .loss()."""
+
+    def __init__(self, config: GPTConfig, num_experts=8, top_k=2,
+                 gate="gshard", aux_loss_weight=0.01):
+        super().__init__()
+        self.config = config
+        self.aux_loss_weight = aux_loss_weight
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.layers = nn.LayerList([
+            MoEDecoderLayer(config, num_experts, top_k, gate)
+            for _ in range(config.num_layers)
+        ])
+        norm_cls = nn.RMSNorm if config.norm_type == "rmsnorm" else nn.LayerNorm
+        self.final_norm = norm_cls(config.hidden_size)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, attn_mask)
+        x = self.final_norm(x)
+        return paddle.matmul(x, self.embed_tokens.weight, transpose_y=True)
+
+    def aux_loss(self):
+        total = None
+        for layer in self.layers:
+            la = layer.moe.l_aux
+            if la is not None:
+                total = la if total is None else total + la
+        return total
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        lm = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]))
+        aux = self.aux_loss()
+        if aux is not None:
+            lm = lm + self.aux_loss_weight * aux
+        return lm
+
+    def apply_expert_placements(self, mesh, axis="dp"):
+        """Expert parallelism for every MoE layer."""
+        from paddle_tpu.incubate.distributed.models.moe import (
+            shard_expert_parameters)
+
+        for layer in self.layers:
+            shard_expert_parameters(layer.moe, mesh, axis)
+        return self

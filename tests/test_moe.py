@@ -151,3 +151,36 @@ def test_moe_expert_parallel_train_step():
     spec = model.moe.experts.w1._data.sharding.spec
     assert "dp" in str(spec)
     fleet._reset_for_tests()
+
+
+def test_moe_gpt_trains_with_expert_parallel():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMMoE
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(21)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=32, dropout=0.0)
+        model = GPTForCausalLMMoE(cfg, num_experts=4, top_k=2)
+        mesh = fleet.get_fleet_mesh()
+        model.apply_expert_placements(mesh, axis="dp")
+        opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                     parameters=model.parameters())
+
+        step = ShardedTrainStep(model, model.loss, opt, mesh)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 128, (8, 16)).astype(np.int32))
+        labels = paddle.to_tensor(
+            rng.randint(0, 128, (8, 16)).astype(np.int64))
+        losses = [float(step(ids, labels)) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+        spec = str(model.layers[0].moe.experts.w1._data.sharding.spec)
+        assert "dp" in spec
+    finally:
+        fleet._reset_for_tests()
