@@ -136,13 +136,14 @@ class DecoderLayer(nn.Layer):
 
 
 class GPTModel(nn.Layer):
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_factory=None):
         super().__init__()
         self.config = config
+        factory = layer_factory or (lambda: DecoderLayer(config))
         self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
         if not config.rope:
             self.embed_pos = nn.Embedding(config.max_seq_len, config.hidden_size)
-        self.layers = nn.LayerList([DecoderLayer(config) for _ in range(config.num_layers)])
+        self.layers = nn.LayerList([factory() for _ in range(config.num_layers)])
         norm_cls = nn.RMSNorm if config.norm_type == "rmsnorm" else nn.LayerNorm
         self.final_norm = norm_cls(config.hidden_size)
 
@@ -163,10 +164,10 @@ class GPTModel(nn.Layer):
 
 
 class GPTForCausalLM(nn.Layer):
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_factory=None):
         super().__init__()
         self.config = config
-        self.model = GPTModel(config)
+        self.model = GPTModel(config, layer_factory)
         if config.tie_embeddings:
             self.lm_head = None
         else:
@@ -412,33 +413,30 @@ class MoEDecoderLayer(nn.Layer):
         return h + self.moe(self.post_attn_norm(h))
 
 
-class GPTForCausalLMMoE(nn.Layer):
-    """Decoder LM with MoE FFNs; aux losses summed into .loss()."""
+class GPTForCausalLMMoE(GPTForCausalLM):
+    """Decoder LM with MoE FFNs; aux losses summed into .loss().
+
+    Reuses the GPTModel scaffolding (embed/pos/recompute/final-norm/tied
+    head) via the layer factory — only the block type differs."""
 
     def __init__(self, config: GPTConfig, num_experts=8, top_k=2,
-                 gate="gshard", aux_loss_weight=0.01):
-        super().__init__()
-        self.config = config
+                 gate="gshard", aux_loss_weight=0.01, capacity_factor=2.0):
+        if not config.tie_embeddings:
+            raise ValueError("GPTForCausalLMMoE ties the lm head to the "
+                             "token embedding")
+        if gate == "switch" and top_k != 1:
+            raise ValueError("switch gate is top-1: pass top_k=1")
+        super().__init__(config, layer_factory=lambda: MoEDecoderLayer(
+            config, num_experts, top_k, gate, capacity_factor))
         self.aux_loss_weight = aux_loss_weight
-        self.embed_tokens = nn.Embedding(config.vocab_size,
-                                         config.hidden_size)
-        self.layers = nn.LayerList([
-            MoEDecoderLayer(config, num_experts, top_k, gate)
-            for _ in range(config.num_layers)
-        ])
-        norm_cls = nn.RMSNorm if config.norm_type == "rmsnorm" else nn.LayerNorm
-        self.final_norm = norm_cls(config.hidden_size)
 
-    def forward(self, input_ids, attn_mask=None):
-        x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x, attn_mask)
-        x = self.final_norm(x)
-        return paddle.matmul(x, self.embed_tokens.weight, transpose_y=True)
+    @property
+    def layers(self):
+        return self.model.layers
 
     def aux_loss(self):
         total = None
-        for layer in self.layers:
+        for layer in self.model.layers:
             la = layer.moe.l_aux
             if la is not None:
                 total = la if total is None else total + la
@@ -459,6 +457,6 @@ class GPTForCausalLMMoE(nn.Layer):
         from paddle_tpu.incubate.distributed.models.moe import (
             shard_expert_parameters)
 
-        for layer in self.layers:
+        for layer in self.model.layers:
             shard_expert_parameters(layer.moe, mesh, axis)
         return self

@@ -184,3 +184,25 @@ def test_moe_gpt_trains_with_expert_parallel():
         assert "dp" in spec
     finally:
         fleet._reset_for_tests()
+
+
+def test_moe_gpt_config_validation():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMMoE
+
+    with pytest.raises(ValueError):
+        GPTForCausalLMMoE(GPTConfig(vocab_size=32, hidden_size=16,
+                                    num_layers=1, num_heads=2,
+                                    tie_embeddings=False))
+    with pytest.raises(ValueError):
+        GPTForCausalLMMoE(GPTConfig(vocab_size=32, hidden_size=16,
+                                    num_layers=1, num_heads=2),
+                          gate="switch", top_k=2)
+    # rope=False gets learned positions (no silent position-blindness)
+    m = GPTForCausalLMMoE(GPTConfig(vocab_size=32, hidden_size=16,
+                                    num_layers=1, num_heads=2, rope=False,
+                                    max_seq_len=16))
+    assert hasattr(m.model, "embed_pos")
+    ids = paddle.to_tensor(np.arange(8).reshape(1, 8).astype(np.int32))
+    out = m(ids)
+    assert tuple(out.shape) == (1, 8, 32)
