@@ -90,3 +90,55 @@ def test_missing_key_is_skipped(tmp_path):
     np.testing.assert_array_equal(np.asarray(out["present"]._data),
                                   np.ones((2, 2)))
     np.testing.assert_array_equal(np.asarray(extra._data), np.full((3,), 7.0))
+
+
+def test_cross_topology_model_checkpoint(tmp_path):
+    """Train under mp=2, save; reload into a dp-only replica; logits match.
+
+    The reference's headline checkpoint property (SURVEY aux): topology can
+    change between save and resume.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import (fleet, load_state_dict,
+                                        save_state_dict)
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0)
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, 64, (4, 16)).astype(np.int32)
+    path = str(tmp_path / "xtopo")
+
+    # -- train a few steps under dp=4 x mp=2 and save
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(5)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    step = ShardedTrainStep(
+        model, lambda a, b: model.loss(a, b), opt, fleet.get_fleet_mesh())
+    ids = paddle.to_tensor(ids_np)
+    labels = paddle.to_tensor(ids_np.astype(np.int64))
+    for _ in range(3):
+        step(ids, labels)
+    model.eval()
+    ref_logits = np.asarray(model(ids).numpy())
+    save_state_dict(model.state_dict(), path)
+    fleet._reset_for_tests()
+
+    # -- fresh process topology: dp=8, different placements
+    strategy2 = fleet.DistributedStrategy()
+    strategy2.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                                "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy2)
+    paddle.seed(999)  # different init — must be overwritten by the load
+    model2 = GPTForCausalLM(cfg)
+    load_state_dict(model2.state_dict(), path)
+    model2.eval()
+    new_logits = np.asarray(model2(ids).numpy())
+    np.testing.assert_allclose(new_logits, ref_logits, atol=1e-4, rtol=1e-4)
+    fleet._reset_for_tests()

@@ -1,0 +1,73 @@
+"""jit save/load, paddle.save/load, GradScaler, DataLoader workers."""
+import numpy as np
+import pytest
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    model.eval()
+    x = paddle.randn([3, 4])
+    ref = np.asarray(model(x).numpy())
+    path = str(tmp_path / "jit_model")
+    paddle.jit.save(model, path)
+    loaded = paddle.jit.load(path)
+    loaded.eval()
+    np.testing.assert_allclose(np.asarray(loaded(x).numpy()), ref, atol=1e-6)
+
+
+def test_paddle_save_load_nested(tmp_path):
+    import paddle_tpu as paddle
+
+    obj = {"w": paddle.ones([2, 2]), "meta": {"step": 7, "lr": 0.1},
+           "list": [paddle.zeros([3]), "tag"]}
+    path = str(tmp_path / "state.pdparams")
+    paddle.save(obj, path)
+    back = paddle.load(path)
+    np.testing.assert_array_equal(np.asarray(back["w"].numpy()),
+                                  np.ones((2, 2)))
+    assert back["meta"] == {"step": 7, "lr": 0.1}
+    assert back["list"][1] == "tag"
+
+
+def test_grad_scaler_api():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    model = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.randn([8, 4])
+    y = paddle.randn([8, 1])
+    for _ in range(3):
+        with paddle.amp.auto_cast(enable=False):
+            loss = ((model(x) - y) ** 2).mean()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+    assert np.isfinite(float(loss))
+
+
+def test_dataloader_workers_and_prefetch():
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.full((2,), i, np.float32), np.int64(i)
+
+        def __len__(self):
+            return 10
+
+    for workers in (0, 2):
+        loader = DataLoader(DS(), batch_size=4, num_workers=workers,
+                            shuffle=False, drop_last=False)
+        batches = list(loader)
+        assert len(batches) == 3
+        np.testing.assert_array_equal(
+            np.asarray(batches[0][0].numpy())[:, 0], [0, 1, 2, 3])
