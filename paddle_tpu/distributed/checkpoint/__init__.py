@@ -109,6 +109,38 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         meta.state_dict_metadata[key] = metas
         meta.flat_mapping[key] = tuple(getattr(arr, "shape", ()))
 
+    # In a multi-controller run each process only sees its own addressable
+    # shards, so the coordinator must merge every rank's metadata before
+    # writing the global .metadata file (reference save_state_dict.py:252-275
+    # all_gather_object + merge) — otherwise non-coordinator ranks' .distcp
+    # files are written but never referenced and load silently zero-fills.
+    from ..communication import _is_dist_multiprocess, all_gather_object
+
+    if _is_dist_multiprocess():
+        gathered = []
+        all_gather_object(
+            gathered,
+            (meta.state_dict_metadata, meta.storage_metadata, meta.flat_mapping),
+        )
+        if rank == coordinator_rank:
+            merged = Metadata()
+            for sd_meta, st_meta, flat in gathered:
+                for key, metas in sd_meta.items():
+                    have = merged.state_dict_metadata.setdefault(key, [])
+                    seen = {(tuple(m.global_offset), tuple(m.local_shape))
+                            for m in have}
+                    for m in metas:
+                        sig = (tuple(m.global_offset), tuple(m.local_shape))
+                        if sig not in seen:
+                            have.append(m)
+                            seen.add(sig)
+                for idx, fn in st_meta.items():
+                    # first writer wins: replicated (unsharded) values are
+                    # saved by every rank; reference only one file per box
+                    merged.storage_metadata.setdefault(idx, fn)
+                merged.flat_mapping.update(flat)
+            meta = merged
+
     def _write():
         with open(os.path.join(path, data_file), "wb") as f:
             pickle.dump(payload, f, protocol=4)
@@ -173,36 +205,98 @@ def load_state_dict(state_dict, path, process_group=None,
                 cache[fn] = pickle.load(f)
         return cache[fn]
 
+    def _boxes_for(key):
+        """[(offset, shape, file)] of every saved box of `key` (metadata only)."""
+        out = []
+        for fn, idxs in files.items():
+            for idx in idxs:
+                if idx.tensor_key != key:
+                    continue
+                for m in shard_meta.get(key, ()):
+                    if tuple(m.global_offset) == tuple(idx.global_offset):
+                        out.append((tuple(m.global_offset),
+                                    tuple(m.local_shape), fn))
+                        break
+        return out
+
+    def _fill(buf, buf_offset, key, boxes):
+        """Copy the intersection of each saved box into `buf` (a local window
+        of the global tensor starting at buf_offset). Returns hit count."""
+        hits = 0
+        for offset, shape, fn in boxes:
+            if len(shape) != buf.ndim:
+                continue
+            lo = [max(o, bo) for o, bo in zip(offset, buf_offset)]
+            hi = [min(o + s, bo + bs)
+                  for o, s, bo, bs in zip(offset, shape, buf_offset, buf.shape)]
+            if any(l >= h for l, h in zip(lo, hi)):
+                continue
+            block = _payload(fn).get(f"{key}|{','.join(map(str, offset))}")
+            if block is None:
+                continue
+            src = tuple(slice(l - o, h - o) for l, h, o in zip(lo, hi, offset))
+            dst = tuple(slice(l - bo, h - bo)
+                        for l, h, bo in zip(lo, hi, buf_offset))
+            buf[dst] = block[src]
+            hits += 1
+        return hits
+
     for key, target in state_dict.items():
         if key not in shard_meta:
             continue
         tarr = _to_array(target)
         global_shape = tuple(tarr.shape)
-        # assemble the global value from saved boxes
-        out = None
-        for idx, fn in (
-            (i, f) for f, idxs in files.items() for i in idxs
-        ):
-            if idx.tensor_key != key:
-                continue
-            block = _payload(fn).get(
-                f"{key}|{','.join(map(str, idx.global_offset))}"
-            )
+        boxes = _boxes_for(key)
+        if not boxes:
+            continue
+
+        # 0-d tensors: single box, no slicing
+        if not global_shape:
+            block = _payload(boxes[0][2]).get(
+                f"{key}|{','.join(map(str, boxes[0][0]))}")
             if block is None:
                 continue
-            if out is None:
-                out = np.zeros(global_shape, block.dtype)
-            if block.ndim == 0:
-                out = np.asarray(block)
-                break
-            slices = tuple(
-                slice(o, o + s) for o, s in zip(idx.global_offset, block.shape)
-            )
-            out[slices] = block
-        if out is None:
+            if isinstance(target, Tensor):
+                import jax.numpy as jnp
+
+                target._data = jnp.asarray(np.asarray(block), dtype=tarr.dtype)
+            else:
+                np.copyto(state_dict[key], np.asarray(block))
+            continue
+
+        sharding = getattr(tarr, "sharding", None)
+        shards = getattr(tarr, "addressable_shards", None)
+        if (isinstance(target, Tensor) and shards is not None
+                and sharding is not None and hasattr(sharding, "mesh")):
+            # Per-shard assembly: materialize only the LOCAL windows each
+            # addressable device needs (reference load_state_dict computes the
+            # saved-box/needed-slice overlap the same way) — host memory stays
+            # O(local shards), not O(global) × world_size.
+            bufs = []
+            total_hits = 0
+            for sh in shards:
+                off = tuple((s.start or 0) if isinstance(s, slice) else 0
+                            for s in sh.index)
+                shape = tuple(
+                    ((s.stop if s.stop is not None else g)
+                     - (s.start or 0)) if isinstance(s, slice) else 1
+                    for s, g in zip(sh.index, global_shape)
+                )
+                buf = np.zeros(shape, tarr.dtype)
+                total_hits += _fill(buf, off, key, boxes)
+                bufs.append(jax.device_put(buf, sh.device))
+            if total_hits == 0:
+                continue  # payload missing/mismatched: keep the live value
+            target._data = jax.make_array_from_single_device_arrays(
+                global_shape, sharding, bufs)
+            continue
+
+        # unsharded / numpy target: assemble the full value
+        out = np.zeros(global_shape,
+                       tarr.dtype if hasattr(tarr, "dtype") else np.float32)
+        if _fill(out, (0,) * len(global_shape), key, boxes) == 0:
             continue
         if isinstance(target, Tensor):
-            sharding = getattr(tarr, "sharding", None)
             import jax.numpy as jnp
 
             new = jnp.asarray(out, dtype=tarr.dtype)
