@@ -196,6 +196,7 @@ from .distributed.data_parallel import DataParallel  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 
 from . import version  # noqa: F401
+from . import inference  # noqa: F401
 
 __version__ = version.full_version
 

@@ -266,7 +266,10 @@ class Model:
         else:
             from .. import jit
 
-            jit.save(self.network, path)
+            # the Model's declared input specs drive the inference export
+            # (reference: Model.save uses self._inputs for jit.save)
+            jit.save(self.network, path,
+                     input_spec=self._inputs or None)
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from .. import framework_io

@@ -1,0 +1,244 @@
+"""paddle.inference — the deployment engine.
+
+Capability slot: the reference's AnalysisPredictor
+(paddle/fluid/inference/api/analysis_predictor.h:101; ZeroCopyRun :211):
+load a *serialized* model in a fresh process, optimize, and serve
+run(feeds)->fetches with zero-copy tensor handles.
+
+TPU-native design: the artifact is a StableHLO program emitted by
+``paddle.jit.save`` (jax.export — no pickled Python). "Analysis passes"
+are XLA's job: the program is AOT-compiled once at load; weights live as
+device-resident arrays inside the predictor, so each ``run()`` only
+transfers the feeds (ZeroCopy contract).
+"""
+from __future__ import annotations
+
+import enum
+import os
+
+import numpy as np
+
+
+class PrecisionType(enum.Enum):
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+    TPU = 4
+
+
+class Config:
+    """Predictor configuration (parity: paddle_infer.Config).
+
+    Accepts the jit.save prefix, a model dir containing one artifact, or the
+    explicit (prog_file, params_file) pair the reference takes.
+    """
+
+    def __init__(self, prog_file=None, params_file=None):
+        self._prefix = None
+        self._params_file = params_file
+        if prog_file is not None and params_file is None and (
+                os.path.isdir(prog_file)):
+            cands = [f[: -len(".pdmodel")] for f in os.listdir(prog_file)
+                     if f.endswith(".pdmodel")]
+            if len(cands) != 1:
+                raise ValueError(
+                    f"model dir {prog_file!r} must hold exactly one .pdmodel")
+            self._prefix = os.path.join(prog_file, cands[0])
+        elif prog_file is not None:
+            p = prog_file
+            if p.endswith(".pdmodel"):
+                p = p[: -len(".pdmodel")]
+            self._prefix = p
+        self._mem_optim = True
+        self._ir_optim = True
+        self._glog_info = True
+        self._num_threads = 1
+
+    # --- reference surface (most toggles are XLA's job; kept as records) ---
+    def set_model(self, prog_file, params_file=None):
+        self._prefix = prog_file[: -len(".pdmodel")] if prog_file.endswith(
+            ".pdmodel") else prog_file
+        self._params_file = params_file
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._prefix or "") + ".pdiparams"
+
+    def enable_memory_optim(self, flag=True):
+        self._mem_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._num_threads = n
+
+    def enable_use_gpu(self, *a, **kw):
+        pass  # device selection is jax's; the program runs where it compiled
+
+    def disable_gpu(self):
+        pass
+
+    def use_gpu(self):
+        return False
+
+    def summary(self):
+        return f"Config(prefix={self._prefix!r})"
+
+
+class Tensor_:
+    """Zero-copy handle (parity: ZeroCopyTensor / paddle_infer.Tensor)."""
+
+    def __init__(self, name, predictor, is_input, index):
+        self.name = name
+        self._p = predictor
+        self._is_input = is_input
+        self._i = index
+
+    def shape(self):
+        if self._is_input:
+            return list(self._p._input_avals[self._i].shape)
+        out = self._p._outputs
+        return list(out[self._i].shape) if out is not None else []
+
+    def reshape(self, shape):
+        pass  # shapes are fixed by the exported program
+
+    def copy_from_cpu(self, data):
+        if not self._is_input:
+            raise RuntimeError("copy_from_cpu on an output handle")
+        aval = self._p._input_avals[self._i]
+        arr = np.asarray(data)
+        want = tuple(aval.shape)
+        ok = len(arr.shape) == len(want) and all(
+            w < 0 or g == w for g, w in zip(arr.shape, want))
+        if not ok:  # -1 marks a dynamic (symbolic) dim in the artifact
+            raise ValueError(
+                f"feed {self.name!r}: expected shape {want}, "
+                f"got {tuple(arr.shape)}")
+        self._p._feeds[self._i] = arr.astype(aval.dtype, copy=False)
+
+    def share_external_data(self, data):
+        self.copy_from_cpu(data)
+
+    def copy_to_cpu(self):
+        if self._is_input:
+            raise RuntimeError("copy_to_cpu on an input handle")
+        if self._p._outputs is None:
+            raise RuntimeError("run() the predictor before copy_to_cpu")
+        return np.asarray(self._p._outputs[self._i])
+
+
+class Predictor:
+    """AOT predictor over a jit.save artifact (parity: AnalysisPredictor)."""
+
+    def __init__(self, config: Config):
+        import jax
+
+        from ..jit import load_artifact
+
+        if isinstance(config, str):
+            config = Config(config)
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        self._config = config
+        exported, weights, meta = load_artifact(
+            config._prefix, params_file=config._params_file)
+        self._exported = exported
+        self._meta = meta
+        class _Aval:
+            def __init__(self, shape, dtype):
+                self.shape, self.dtype = shape, dtype
+
+        self._input_names = meta["input_names"]
+        # dims of -1 are dynamic (symbolic in the exported program)
+        self._input_avals = [
+            _Aval(tuple(s["shape"]), np.dtype(s["dtype"]))
+            for s in meta["inputs"]
+        ]
+        # weights go to device once; runs only move the feeds (ZeroCopyRun)
+        self._weights = [jax.device_put(w) for w in weights]
+        self._jit = jax.jit(exported.call)
+        self._feeds = [None] * len(self._input_avals)
+        self._outputs = None
+        self._n_outputs = self._count_leaves(meta["outputs"])
+        self._compiled = {}  # feed-shapes -> AOT executable
+
+    @staticmethod
+    def _count_leaves(desc):
+        if desc["kind"] == "leaf":
+            return 1
+        if desc["kind"] == "none":
+            return 0
+        return sum(Predictor._count_leaves(d) for d in desc["items"])
+
+    # --- ZeroCopy surface --------------------------------------------------
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_output_names(self):
+        return [f"fetch_{i}" for i in range(self._n_outputs)]
+
+    def get_input_handle(self, name):
+        return Tensor_(name, self, True, self._input_names.index(name))
+
+    def get_output_handle(self, name):
+        return Tensor_(name, self, False, int(name.rsplit("_", 1)[1]))
+
+    def run(self, inputs=None):
+        """Execute the program. ``inputs`` (optional list of arrays, feed
+        order) is the convenience form; otherwise use the input handles."""
+        if inputs is not None:
+            for i, a in enumerate(inputs):
+                # same normalization copy_from_cpu applies (python lists feed
+                # float64 otherwise, and the exported program is dtype-exact)
+                self._feeds[i] = np.asarray(a).astype(
+                    self._input_avals[i].dtype, copy=False)
+        missing = [self._input_names[i]
+                   for i, f in enumerate(self._feeds) if f is None]
+        if missing:
+            raise RuntimeError(f"missing feeds: {missing}")
+        key = tuple(f.shape for f in self._feeds)
+        if key not in self._compiled:  # AOT compile per concrete shape set
+            self._compiled[key] = self._jit.lower(
+                self._weights, *self._feeds).compile()
+        self._outputs = self._compiled[key](self._weights, *self._feeds)
+        return list(self._outputs)
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+    def clone(self):
+        return Predictor(self._config)
+
+
+def create_predictor(config) -> Predictor:
+    return Predictor(config)
+
+
+# convenience aliases matching paddle_infer's module-level names
+Tensor = Tensor_
+
+__all__ = [
+    "Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
+    "create_predictor",
+]

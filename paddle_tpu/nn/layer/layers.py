@@ -33,6 +33,9 @@ class HookRemoveHelper:
         self._container.pop(self._key, None)
 
 
+_CALL_DEPTH = [0]  # >0 while inside some Layer's forward (sublayer calls)
+
+
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         self.training = True
@@ -264,7 +267,21 @@ class Layer:
             out = hook(self, inputs)
             if out is not None:
                 inputs = out if isinstance(out, tuple) else (out,)
-        outputs = self.forward(*inputs, **kwargs)
+        # remember the OUTERMOST call's tensor signature so
+        # jit.save(input_spec=None) can re-trace the layer (reference records
+        # via SOT capture); sublayer calls only pay a depth counter
+        if _CALL_DEPTH[0] == 0:
+            spec = tuple(
+                (tuple(t.shape), str(t._data.dtype))
+                for t in inputs if hasattr(t, "_data")
+            )
+            if spec and len(spec) == len(inputs):
+                object.__setattr__(self, "_last_call_spec", spec)
+        _CALL_DEPTH[0] += 1
+        try:
+            outputs = self.forward(*inputs, **kwargs)
+        finally:
+            _CALL_DEPTH[0] -= 1
         for hook in self._forward_post_hooks.values():
             o = hook(self, inputs, outputs)
             if o is not None:
