@@ -23,8 +23,10 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis
         rows = 1
         for s in a.shape[:-1]:
             rows *= s
+        from ....ops.pallas import on_tpu_device
+
         if (ax == a.ndim - 1 and b is None and rows % 8 == 0
-                and jax.default_backend() == "tpu"):
+                and on_tpu_device()):
             from ....ops.pallas import rms_norm as _pallas_rms
 
             return _pallas_rms(a, w, epsilon)
@@ -132,6 +134,53 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
         return out
 
     return apply_op(_fl, x, weight, bias, _op_name="fused_linear")
+
+
+def fused_linear_cross_entropy(x, weight, labels, transpose_y=True,
+                               chunk_size=512, ignore_index=-100, name=None):
+    """LM-head matmul + softmax cross entropy WITHOUT materializing the
+    [N, vocab] logits (capability slot: the reference's fused CE path —
+    c_softmax_with_cross_entropy / fused kernels in phi/kernels/fusion).
+
+    Chunks the flattened rows; each chunk computes its logits with fp32
+    accumulation, takes logsumexp, and is dropped — jax.checkpoint makes the
+    backward recompute per chunk, so peak memory is O(chunk_size * vocab)
+    instead of O(N * vocab). Returns the mean loss over non-ignored rows.
+
+    x: [..., H] hidden states; weight: [V, H] (transpose_y=True, the tied
+    embedding layout) or [H, V]; labels: [...] int.
+    """
+    def _flce(h, w, y):
+        H = h.shape[-1]
+        hf = h.reshape(-1, H)
+        yf = y.reshape(-1).astype(jnp.int32)
+        n = hf.shape[0]
+        c = min(chunk_size, n)
+        pad = (-n) % c
+        if pad:
+            hf = jnp.concatenate([hf, jnp.zeros((pad, H), hf.dtype)])
+            yf = jnp.concatenate([yf, jnp.full((pad,), ignore_index, yf.dtype)])
+        valid = (yf != ignore_index)
+        hs = hf.reshape(-1, c, H)
+        ys = jnp.where(valid, yf, 0).reshape(-1, c)
+        ms = valid.astype(jnp.float32).reshape(-1, c)
+
+        spec = "ch,vh->cv" if transpose_y else "ch,hv->cv"
+
+        def chunk_fn(args):
+            hc, yc, mc = args
+            logits = jnp.einsum(spec, hc, w,
+                                preferred_element_type=jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, yc[:, None], axis=-1)[:, 0]
+            return ((lse - gold) * mc).sum()
+
+        sums = jax.lax.map(jax.checkpoint(chunk_fn), (hs, ys, ms))
+        count = jnp.maximum(ms.sum(), 1.0)
+        return sums.sum() / count
+
+    return apply_op(_flce, x, weight, labels,
+                    _op_name="fused_linear_cross_entropy")
 
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):

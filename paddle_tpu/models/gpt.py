@@ -36,6 +36,7 @@ class GPTConfig:
         tie_embeddings=True,
         dtype="float32",
         recompute=False,
+        recompute_policy="full",
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -53,6 +54,11 @@ class GPTConfig:
         self.tie_embeddings = tie_embeddings
         self.dtype = dtype
         self.recompute = recompute
+        # "full" = rerun the whole block in backward (lowest memory);
+        # "dots" = save matmul/attention outputs, recompute elementwise only
+        # (jax.checkpoint_policies selective remat — the standard single-chip
+        # throughput/memory middle ground)
+        self.recompute_policy = recompute_policy
 
 
 def llama_config(size="7b", **overrides):
@@ -232,6 +238,8 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True):
     import jax
     import jax.numpy as jnp
 
+    from jax.ad_checkpoint import checkpoint_name
+
     ln1, wq, wk, wv, wo, ln2, wg, wu, wd = p
     b, s, hdim = x.shape
     hd = hdim // num_heads
@@ -242,6 +250,10 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True):
     if use_rope:
         q, k = _rope_pure(q), _rope_pure(k)
     o = _sdpa_pure(q, k, v, causal=True).reshape(b, s, num_heads * hd)
+    # selective-remat anchor: with recompute_policy="attn" the backward pass
+    # reuses this tensor instead of re-running flash attention (the one block
+    # intermediate whose recompute is quadratic in seq)
+    o = checkpoint_name(o, "attn_out")
     x = x + o @ wo
     h2 = _rms_pure(x, ln2)
     return x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
@@ -324,7 +336,16 @@ class StackedDecoder(nn.Layer):
                                    cfg.rope)
 
             if cfg.recompute:
-                block = jax.checkpoint(block)
+                pol = getattr(cfg, "recompute_policy", "full")
+                if pol == "dots":
+                    policy = (jax.checkpoint_policies
+                              .dots_with_no_batch_dims_saveable)
+                elif pol == "attn":
+                    policy = jax.checkpoint_policies.save_only_these_names(
+                        "attn_out")
+                else:
+                    policy = None
+                block = jax.checkpoint(block, policy=policy)
 
             def step(x, p):
                 return block(x, p), None
@@ -377,11 +398,14 @@ class GPTForCausalLMPipe(nn.Layer):
         return paddle.matmul(x, self.embed_tokens.weight, transpose_y=True)
 
     def loss(self, input_ids, labels):
-        logits = self(input_ids)
-        return F.cross_entropy(
-            logits.reshape([-1, self.config.vocab_size]),
-            labels.reshape([-1]),
-        )
+        """Fused tied-head LM loss: hidden @ embed^T + CE computed in row
+        chunks so the full [N, vocab] logits never hit HBM (the fp32 logits
+        copy alone is ~1GB at 1.3B/seq2048/batch4)."""
+        x = self.embed_tokens(input_ids)
+        x = self.decoder(x)
+        x = self.final_norm(x)
+        return FF.fused_linear_cross_entropy(
+            x, self.embed_tokens.weight, labels, transpose_y=True)
 
 
 # ---------------------------------------------------------------------------

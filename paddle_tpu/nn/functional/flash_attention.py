@@ -44,12 +44,9 @@ def _xla_sdpa(q, k, v, mask=None, causal=False, dropout=0.0, scale=None, key=Non
 
 
 def _use_pallas(q_shape):
-    import jax
+    from ...ops.pallas import on_tpu_device
 
-    try:
-        if jax.default_backend() != "tpu":
-            return False
-    except Exception:
+    if not on_tpu_device():
         return False
     from ...ops.pallas.flash_attention import supported_seq
 
@@ -64,13 +61,18 @@ def sdpa_arrays(q, k, v, causal=True, scale=None):
 
     The single dispatch point shared by the functional API and the pure
     model paths (models/gpt.py stacked decoder)."""
+    from ...ops.pallas import log_path_once
+
     if _use_pallas(q.shape):
         try:
             from ...ops.pallas import flash_attention as _fa_kernel
 
-            return _fa_kernel(q, k, v, causal=causal, scale=scale)
+            out = _fa_kernel(q, k, v, causal=causal, scale=scale)
+            log_path_once("sdpa", "pallas_flash")
+            return out
         except Exception:
             pass
+    log_path_once("sdpa", "xla_sdpa")
     if k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)

@@ -12,16 +12,52 @@ All kernels run in interpret mode on CPU (tests) and compiled on TPU.
 """
 from __future__ import annotations
 
+import logging
+import os
+
 import jax
+
+_log = logging.getLogger("paddle_tpu.pallas")
+_tpu_cache = [None]
+
+
+def on_tpu_device() -> bool:
+    """True when the addressable devices can compile Mosaic kernels.
+
+    Gate on the *device* platform (not ``jax.default_backend()`` alone) so
+    experimental platform registrations that tunnel to a real chip (e.g. the
+    axon remote-v5e plugin, whose devices report platform="tpu",
+    device_kind="TPU v5 lite") take the compiled path. Override with
+    PADDLE_TPU_FORCE_PALLAS=1/0.
+    """
+    force = os.environ.get("PADDLE_TPU_FORCE_PALLAS")
+    if force is not None:
+        return force not in ("0", "false", "")
+    if _tpu_cache[0] is None:
+        try:
+            _tpu_cache[0] = jax.devices()[0].platform == "tpu"
+        except Exception:
+            _tpu_cache[0] = False
+    return _tpu_cache[0]
 
 
 def use_interpret() -> bool:
     """Interpret-mode on non-TPU backends so the same kernel code is tested
     on the CPU mesh (SURVEY §4: fake-backend strategy)."""
-    try:
-        return jax.default_backend() != "tpu"
-    except Exception:
-        return True
+    return not on_tpu_device()
+
+
+_path_logged = set()
+
+
+def log_path_once(op: str, path: str) -> None:
+    """One-line record of which implementation served an op (pallas vs xla),
+    so benchmarks can prove the fast path engaged. Keyed on (op, path): a
+    mid-run path switch (shape-dependent fallback) is logged too. INFO level
+    — bench.py raises this logger to INFO to record the path."""
+    if (op, path) not in _path_logged:
+        _path_logged.add((op, path))
+        _log.info("paddle_tpu dispatch path: %s -> %s", op, path)
 
 
 from .flash_attention import flash_attention, flash_attention_fwd  # noqa: E402

@@ -64,32 +64,38 @@ class Adam(Optimizer):
 
     def _init_slots(self, p):
         f32 = jnp.float32
+        # reference semantics (optimizer.py _add_accumulator): moments live in
+        # the PARAM dtype; fp32 moments + master weights only under
+        # multi_precision. At 1.3B bf16 this halves optimizer HBM (10.8G→5.4G).
+        mdt = f32 if (self._multi_precision and p.dtype != f32) else p.dtype
         slots = {
-            "moment1": jnp.zeros(p.shape, f32),
-            "moment2": jnp.zeros(p.shape, f32),
+            "moment1": jnp.zeros(p.shape, mdt),
+            "moment2": jnp.zeros(p.shape, mdt),
             "beta1_pow": jnp.ones((), f32),
             "beta2_pow": jnp.ones((), f32),
         }
         if self._amsgrad:
-            slots["moment2_max"] = jnp.zeros(p.shape, f32)
+            slots["moment2_max"] = jnp.zeros(p.shape, mdt)
         if self._multi_precision and p.dtype != jnp.float32:
             slots["master_weight"] = p.astype(f32)
         return slots
 
     def _update(self, p, g, slots, lr):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        mdt = slots["moment1"].dtype
         gf = g.astype(jnp.float32)
-        m1 = b1 * slots["moment1"] + (1 - b1) * gf
-        m2 = b2 * slots["moment2"] + (1 - b2) * gf * gf
+        m1 = b1 * slots["moment1"].astype(jnp.float32) + (1 - b1) * gf
+        m2 = b2 * slots["moment2"].astype(jnp.float32) + (1 - b2) * gf * gf
         b1p = slots["beta1_pow"] * b1
         b2p = slots["beta2_pow"] * b2
         m1_hat = m1 / (1 - b1p)
         denom_m2 = m2
-        new_slots = {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+        new_slots = {"moment1": m1.astype(mdt), "moment2": m2.astype(mdt),
+                     "beta1_pow": b1p, "beta2_pow": b2p}
         if self._amsgrad:
-            m2max = jnp.maximum(slots["moment2_max"], m2)
+            m2max = jnp.maximum(slots["moment2_max"].astype(jnp.float32), m2)
             denom_m2 = m2max
-            new_slots["moment2_max"] = m2max
+            new_slots["moment2_max"] = m2max.astype(mdt)
         m2_hat = denom_m2 / (1 - b2p)
         update = m1_hat / (jnp.sqrt(m2_hat) + eps)
         master = slots.get("master_weight")
