@@ -21,20 +21,21 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Row masks from lengths. When maxlen is None the mask width is
+    data-dependent — that needs one host sync and is trace-hostile (raises
+    the standard concretization error under jit; pass maxlen to stay
+    compiled)."""
+    from ... import dtypes as _dt
+
+    if maxlen is None:
+        lens = np.asarray(x._data if isinstance(x, Tensor) else x)
+        maxlen = int(lens.max())
+    jdt = _dt.to_np(dtype)
+
     def _sm(lens):
-        m = maxlen or int(lens.max())
-        return (jnp.arange(m)[None, :] < lens[..., None]).astype(
-            jnp.dtype(dtype if dtype != "int64" else np.int64))
+        return (jnp.arange(maxlen)[None, :] < lens[..., None]).astype(jdt)
 
-    import numpy as np
-
-    lens_np = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
-    m = maxlen or int(lens_np.max())
-
-    def _sm2(lens):
-        return (jnp.arange(m)[None, :] < lens[..., None]).astype(np.int64)
-
-    return apply_op(_sm2, x, _op_name="sequence_mask")
+    return apply_op(_sm, x, _op_name="sequence_mask")
 
 
 def feature_alpha_dropout(x, p=0.5, training=True, name=None):

@@ -66,8 +66,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
         def _mask(a):
             n, c, h, w = a.shape
             if pd[0] or pd[1]:
+                neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                       else jnp.iinfo(a.dtype).min)
                 a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
-                            constant_values=-jnp.inf)
+                            constant_values=neg)
             hp, wp = a.shape[2], a.shape[3]
             oh = (hp - ks[0]) // st[0] + 1
             ow = (wp - ks[1]) // st[1] + 1

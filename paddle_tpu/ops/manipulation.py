@@ -11,10 +11,16 @@ from ..core.tensor import Tensor
 
 
 def _static_ints(v):
-    """Resolve a shape-like argument (may contain Tensors) to python ints."""
+    """Resolve a shape-like argument (may contain Tensors) to python ints.
+
+    XLA needs static shapes: under jit tracing, a traced element raises the
+    standard jax concretization error (which to_static catches to fall back
+    to eager) instead of silently mis-resolving.
+    """
     if isinstance(v, Tensor):
-        out = v.numpy().tolist()
-        return [int(i) for i in out] if isinstance(out, list) else int(out)
+        v = v._data
+    if hasattr(v, "ndim") and getattr(v, "ndim", 0) >= 1:
+        return [int(i) for i in np.asarray(v)]  # one host sync, not per-element
     if isinstance(v, (list, tuple)):
         return [int(i.item()) if isinstance(i, Tensor) else int(i) for i in v]
     return int(v)

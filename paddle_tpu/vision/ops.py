@@ -99,6 +99,11 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     os_ = (output_size, output_size) if isinstance(output_size, int) else output_size
 
+    # samples per bin edge: the reference uses ceil(bin_size) when
+    # sampling_ratio<=0, which is data-dependent per box — XLA needs static
+    # shapes, so the adaptive case uses the common fixed default of 2
+    grid = sampling_ratio if sampling_ratio > 0 else 2
+
     def _ra(feat, bx, bn):
         n, c, h, w = feat.shape
         oh, ow = os_
@@ -111,24 +116,39 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             y1 = b[1] * spatial_scale - offset
             x2 = b[2] * spatial_scale - offset
             y2 = b[3] * spatial_scale - offset
-            bw = jnp.maximum(x2 - x1, 1e-4)
-            bh = jnp.maximum(y2 - y1, 1e-4)
-            ys = y1 + (jnp.arange(oh) + 0.5) * bh / oh
-            xs = x1 + (jnp.arange(ow) + 0.5) * bw / ow
-            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
-            y0 = jnp.clip(jnp.floor(yy), 0, h - 1).astype(jnp.int32)
-            x0 = jnp.clip(jnp.floor(xx), 0, w - 1).astype(jnp.int32)
+            bw = x2 - x1
+            bh = y2 - y1
+            if not aligned:  # reference clamps unaligned rois to >= 1 pixel
+                bw = jnp.maximum(bw, 1.0)
+                bh = jnp.maximum(bh, 1.0)
+            else:
+                bw = jnp.maximum(bw, 1e-4)
+                bh = jnp.maximum(bh, 1e-4)
+            # sample centers: bin ph, sub-sample iy -> y1 + (ph + (iy+.5)/g)*bh/oh
+            sub = (jnp.arange(grid) + 0.5) / grid
+            ys = y1 + (jnp.arange(oh)[:, None] + sub[None, :]) * bh / oh
+            xs = x1 + (jnp.arange(ow)[:, None] + sub[None, :]) * bw / ow
+            yy = jnp.broadcast_to(ys[:, :, None, None], (oh, grid, ow, grid))
+            xx = jnp.broadcast_to(xs[None, None, :, :], (oh, grid, ow, grid))
+            # reference bilinear rule: samples outside [-1, H/W] contribute 0
+            valid = ((yy >= -1.0) & (yy <= h) & (xx >= -1.0) & (xx <= w))
+            yc = jnp.clip(yy, 0.0, h - 1)
+            xc = jnp.clip(xx, 0.0, w - 1)
+            y0 = jnp.floor(yc).astype(jnp.int32)
+            x0 = jnp.floor(xc).astype(jnp.int32)
             y1i = jnp.clip(y0 + 1, 0, h - 1)
             x1i = jnp.clip(x0 + 1, 0, w - 1)
-            wy = jnp.clip(yy, 0, h - 1) - y0
-            wx = jnp.clip(xx, 0, w - 1) - x0
+            wy = yc - y0
+            wx = xc - x0
             fm = feat[bi]  # [C, H, W]
             v00 = fm[:, y0, x0]
             v01 = fm[:, y0, x1i]
             v10 = fm[:, y1i, x0]
             v11 = fm[:, y1i, x1i]
-            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
-                    + v10 * wy * (1 - wx) + v11 * wy * wx)
+            val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                   + v10 * wy * (1 - wx) + v11 * wy * wx)
+            val = jnp.where(valid[None], val, 0.0)
+            return val.mean(axis=(2, 4))  # average the grid x grid samples
 
         return jax.vmap(one_box)(bx, batch_idx)
 
