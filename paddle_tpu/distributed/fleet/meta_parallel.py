@@ -85,6 +85,8 @@ class PipelineLayer(Layer):
         self.run_function = built
         self._layers = LayerList([l for l, _ in built if isinstance(l, Layer)])
         self._stage_bounds = self._partition(len(built), self._num_stages, seg_method)
+        self._uniform_cache = None
+        self._num_micro = None  # microbatches for the compiled schedule
 
     @staticmethod
     def _partition(n, stages, seg_method):
@@ -97,13 +99,141 @@ class PipelineLayer(Layer):
                 return s
         return self._num_stages - 1
 
-    def forward(self, x):
-        for i, (layer, ffn) in enumerate(self.run_function):
+    # -- compiled pipeline execution ------------------------------------
+    def _mesh_pp(self):
+        from ...distributed.auto_parallel import get_mesh
+        from . import get_fleet_mesh
+
+        mesh = get_fleet_mesh() or get_mesh()
+        if mesh is None or "pp" not in mesh.dim_names:
+            return None, 1
+        return mesh, mesh.get_dim_size("pp")
+
+    def _run_segment(self, s, x):
+        """Apply stages [bounds[s], bounds[s+1]) to Tensor x."""
+        lo, hi = self._stage_bounds[s], self._stage_bounds[s + 1]
+        for layer, ffn in self.run_function[lo:hi]:
             if ffn is not None:
                 x = ffn(layer, x)
-            elif isinstance(layer, Layer) or callable(layer):
+            else:
                 x = layer(x)
         return x
+
+    def _segments_uniform(self, x):
+        """True when the compiled ring schedule can serve this layer: every
+        stage maps the activation to the same aval AND no stage mutates a
+        buffer (the schedule's scan cannot thread per-tick buffer writes
+        back out — BatchNorm-style layers take the straight-line path)."""
+        import jax
+
+        from ...core.tensor import Tensor
+
+        if self._uniform_cache is not None:
+            return self._uniform_cache
+        try:
+            aval = jax.ShapeDtypeStruct(tuple(x.shape), x._data.dtype)
+            state = self.state_dict()
+            names = sorted(state)
+            state_avals = [
+                jax.ShapeDtypeStruct(tuple(state[n].shape),
+                                     state[n]._data.dtype) for n in names]
+            # every probe runs under _swap_state so a stage that writes its
+            # buffers only ever touches trace-local tracers (restored on exit)
+            for s in range(self._num_stages):
+                def seg_probe(flat, a, s=s):
+                    with self._swap_state(dict(zip(names, flat))):
+                        return self._run_segment(s, Tensor(a))._data
+
+                out = jax.eval_shape(seg_probe, state_avals, aval)
+                if (tuple(out.shape) != tuple(aval.shape)
+                        or out.dtype != aval.dtype):
+                    self._uniform_cache = False
+                    return False
+
+            # buffer-mutation probe: run the whole forward once abstractly
+            # and see whether any state entry was reassigned
+            flag = [False]
+
+            def probe(flat, a):
+                sw = dict(zip(names, flat))
+                with self._swap_state(sw) as mut:
+                    t = Tensor(a)
+                    for s in range(self._num_stages):
+                        t = self._run_segment(s, t)
+                flag[0] = flag[0] or any(
+                    mut.get(n) is not sw[n] for n in sw)
+                return t._data
+
+            jax.eval_shape(probe, state_avals, aval)
+            self._uniform_cache = not flag[0]
+            return self._uniform_cache
+        except Exception:
+            self._uniform_cache = False
+            return False
+
+    def forward(self, x):
+        mesh, pp = self._mesh_pp()
+        n_micro = self._num_micro or pp
+        if (pp > 1 and self._num_stages == pp
+                and n_micro >= pp and x.shape[0] % n_micro == 0
+                and self._segments_uniform(x)):
+            return self._forward_pipelined(x, mesh, pp)
+        for s in range(self._num_stages):
+            x = self._run_segment(s, x)
+        return x
+
+    def _forward_pipelined(self, x, mesh, pp):
+        """Compiled ring schedule for arbitrary (shape-uniform) stages.
+
+        Heterogeneous stage programs are selected per device with
+        ``lax.switch`` on the pp axis index; all parameters travel into the
+        shard_map replicated over "pp" (stage placement of memory is the
+        stacked-decoder path's job — this is the generic-correctness one;
+        reference slot: pipeline_parallel.py:242 1F1B for any PipelineLayer).
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ...core.tensor import Tensor
+        from ..pipeline import microbatch, pipeline_schedule, unmicrobatch
+
+        state = self.state_dict()
+        names = sorted(state)
+        flat = [state[n]._data for n in names]
+        n_micro = self._num_micro or pp
+
+        def body(flat_params, x_mb):
+            # mark params varying over pp: each device consumes them through
+            # a DIFFERENT switch branch, and pcast's transpose is the psum
+            # that routes every stage's weight cotangent home (without it the
+            # vma invariance analysis drops non-zero-stage grads)
+            flat_params = [jax.lax.pcast(a, "pp", to="varying")
+                           for a in flat_params]
+
+            def make_branch(s):
+                def branch(params, a):
+                    # params as explicit operands (not closure): the switch
+                    # transpose then routes weight cotangents through the
+                    # branch each device actually executed
+                    with self._swap_state(dict(zip(names, params))):
+                        return self._run_segment(s, Tensor(a))._data
+                return branch
+
+            branches = [make_branch(s) for s in range(pp)]
+
+            def stage_fn(a):
+                idx = jax.lax.axis_index("pp")
+                return jax.lax.switch(idx, branches, tuple(flat_params), a)
+
+            return pipeline_schedule(stage_fn, x_mb, pp)
+
+        out = jax.shard_map(
+            body, mesh=mesh.jax_mesh,
+            in_specs=(P(), P()),
+            out_specs=P(),
+            axis_names={"pp"},
+        )(flat, microbatch(x._data, n_micro))
+        return Tensor(unmicrobatch(out))
 
 
 class _FleetModelWrapper(Layer):

@@ -107,6 +107,135 @@ def spmd_pipeline(stage_fn, mesh, n_stages, axis_name="pp",
     )
 
 
+def schedule_ticks(n_micro, n_stages):
+    """Tick count of the plain schedule (each tick = FULL per-device stage)."""
+    return n_micro + n_stages - 1
+
+
+def interleaved_ticks(n_micro, pp, v):
+    """Tick count of the circular/interleaved schedule (each tick = 1/v of a
+    device's layers). Normalised bubble: (pp-1)/v small-ticks vs (pp-1) full
+    ticks for the plain schedule — the VPP win
+    (reference: pipeline_parallel.py:1308 PipelineParallelWithInterleave)."""
+    return v * n_micro + pp - 1
+
+
+def interleaved_pipeline_schedule(stage_fn, x_mb, pp, v, axis_name="pp"):
+    """Circular (virtual-stage / VPP) schedule, run inside shard_map.
+
+    Device s holds v chunks; chunk c acts as virtual stage c*pp + s. A
+    microbatch makes v laps of the ring; lap l of microbatch m runs on
+    device s at tick l*n_micro + m + s. Wrap-around activations (device
+    pp-1 -> 0) wait n_micro - pp ticks in a rolling FIFO, so n_micro >= pp
+    is required.
+
+    stage_fn(chunk_idx, x) -> x (applies this device's chunk `chunk_idx`).
+    x_mb: [n_micro, ...] stage-0 inputs (replicated over pp).
+    """
+    idx = jax.lax.axis_index(axis_name)
+    n_micro = x_mb.shape[0]
+    if n_micro < pp:
+        raise ValueError(
+            f"interleaved schedule needs n_micro >= pp ({n_micro} < {pp})")
+    total = interleaved_ticks(n_micro, pp, v)
+    wait = n_micro - pp
+    fifo_len = wait + 1
+    perm = [(j, (j + 1) % pp) for j in range(pp)]
+
+    out_aval = jax.eval_shape(
+        lambda x: stage_fn(jnp.zeros((), jnp.int32),
+                           jax.lax.pcast(x, axis_name, to="varying")),
+        jax.ShapeDtypeStruct(x_mb.shape[1:], x_mb.dtype),
+    )
+
+    def _z(shape):
+        return jax.lax.pcast(
+            jnp.zeros(shape, out_aval.dtype), axis_name, to="varying")
+
+    state0 = _z(out_aval.shape)
+    fifo0 = _z((fifo_len,) + tuple(out_aval.shape))
+    out_buf0 = _z((n_micro,) + tuple(out_aval.shape))
+
+    def tick(carry, t):
+        fifo, state, out_buf = carry
+        # incoming rotated activation -> FIFO slot t%len; device 0 pops the
+        # one written `wait` ticks ago (lap wrap), others pop the newest
+        w = jnp.mod(t, fifo_len)
+        fifo = jax.lax.dynamic_update_index_in_dim(fifo, state, w, 0)
+        r = jnp.where(idx == 0, jnp.mod(t - wait + fifo_len, fifo_len), w)
+        queued = jax.lax.dynamic_index_in_dim(fifo, r, 0, keepdims=False)
+
+        mb_new = jnp.clip(t, 0, n_micro - 1)
+        fresh = jax.lax.dynamic_index_in_dim(x_mb, mb_new, 0, keepdims=False)
+        inp = jnp.where((idx == 0) & (t < n_micro), fresh, queued)
+
+        rel = t - idx  # ticks since this device's first real work
+        lap = jnp.clip((rel + v * n_micro) // n_micro - v, 0, v - 1)
+        out = stage_fn(lap, inp)
+
+        m = jnp.mod(rel + v * n_micro, n_micro)
+        valid = ((idx == pp - 1) & (rel >= (v - 1) * n_micro)
+                 & (rel < v * n_micro))
+        cur = jax.lax.dynamic_index_in_dim(out_buf, m, 0, keepdims=False)
+        out_buf = jax.lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(valid, out, cur), m, 0)
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return (fifo, state, out_buf), None
+
+    (_, _, out_buf), _ = jax.lax.scan(
+        tick, (fifo0, state0, out_buf0), jnp.arange(total))
+    return jax.lax.psum(
+        jnp.where(idx == pp - 1, out_buf, jnp.zeros_like(out_buf)),
+        axis_name,
+    )
+
+
+def spmd_pipeline_interleaved(stage_fn, mesh, pp, v, axis_name="pp",
+                              remat=False):
+    """Jittable interleaved pipeline over leading-axis-stacked params.
+
+    stage_fn(chunk_params, x) -> x where chunk_params is one chunk's slice
+    [n_layers/(pp*v), ...] of each stacked param. The caller passes params
+    stacked [L, ...] with L % (pp*v) == 0; virtual stage j gets layers
+    [j*g, (j+1)*g), g = L/(pp*v), and device s holds chunks {c*pp+s}.
+    """
+    inner = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def body(stacked_local, x_mb):
+        # local leaves arrive as [v, 1, g, ...] (axis 1 = this device's shard)
+        local = jax.tree_util.tree_map(
+            lambda a: a.reshape((a.shape[0],) + tuple(a.shape[2:])),
+            stacked_local)
+
+        def one_stage(lap, x):
+            chunk = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, lap, 0, keepdims=False),
+                local)
+            return inner(chunk, x)
+
+        return interleaved_pipeline_schedule(one_stage, x_mb, pp, v,
+                                             axis_name)
+
+    def pipelined(stacked_params, x_mb):
+        def split(a):
+            L = a.shape[0]
+            g = L // (pp * v)
+            # [L, ...] -> [v, pp, g, ...]: layer j = (c*pp+s)*g + i lands at
+            # [c, s, i] — device s's chunk c is virtual stage c*pp+s
+            return a.reshape((v, pp, g) + tuple(a.shape[1:]))
+
+        stacked = jax.tree_util.tree_map(split, stacked_params)
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, axis_name), P()),
+            out_specs=P(),
+            axis_names={axis_name},
+        )(stacked, x_mb)
+
+    return pipelined
+
+
 def microbatch(batch, n_micro, axis=0):
     """[B, ...] -> [n_micro, B/n_micro, ...]"""
     def _one(x):

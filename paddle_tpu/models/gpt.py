@@ -37,6 +37,7 @@ class GPTConfig:
         dtype="float32",
         recompute=False,
         recompute_policy="full",
+        pp_interleave=1,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -59,6 +60,8 @@ class GPTConfig:
         # (jax.checkpoint_policies selective remat — the standard single-chip
         # throughput/memory middle ground)
         self.recompute_policy = recompute_policy
+        # virtual pipeline stages per device (VPP): bubble shrinks by 1/v
+        self.pp_interleave = pp_interleave
 
 
 def llama_config(size="7b", **overrides):
@@ -256,7 +259,8 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True):
     o = checkpoint_name(o, "attn_out")
     x = x + o @ wo
     h2 = _rms_pure(x, ln2)
-    return x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+    ffn = checkpoint_name(jax.nn.silu(h2 @ wg) * (h2 @ wu), "ffn_out")
+    return x + ffn @ wd
 
 
 class StackedDecoder(nn.Layer):
@@ -343,6 +347,9 @@ class StackedDecoder(nn.Layer):
                 elif pol == "attn":
                     policy = jax.checkpoint_policies.save_only_these_names(
                         "attn_out")
+                elif pol == "attn_ffn":
+                    policy = jax.checkpoint_policies.save_only_these_names(
+                        "attn_out", "ffn_out")
                 else:
                     policy = None
                 block = jax.checkpoint(block, policy=policy)
@@ -355,9 +362,8 @@ class StackedDecoder(nn.Layer):
                 return out
 
             from paddle_tpu.distributed.pipeline import (
-                microbatch, spmd_pipeline, unmicrobatch)
-
-            n_micro = getattr(cfg, "pp_microbatches", None) or pp
+                microbatch, spmd_pipeline, spmd_pipeline_interleaved,
+                unmicrobatch)
 
             def stage_fn(stage_params, x):
                 out, _ = jax.lax.scan(step, x, stage_params)
@@ -365,10 +371,20 @@ class StackedDecoder(nn.Layer):
 
             from jax.sharding import PartitionSpec as P
 
-            pipe = spmd_pipeline(
-                stage_fn, mesh.jax_mesh, pp,
-                params_spec=P("pp"), remat=cfg.recompute,
-            )
+            v = getattr(cfg, "pp_interleave", 1) or 1
+            n_micro = getattr(cfg, "pp_microbatches", None) or pp
+            if v > 1:
+                if cfg.num_layers % (pp * v) != 0:
+                    raise ValueError(
+                        f"pp_interleave={v} needs num_layers "
+                        f"({cfg.num_layers}) divisible by pp*v ({pp * v})")
+                pipe = spmd_pipeline_interleaved(
+                    stage_fn, mesh.jax_mesh, pp, v, remat=cfg.recompute)
+            else:
+                pipe = spmd_pipeline(
+                    stage_fn, mesh.jax_mesh, pp,
+                    params_spec=P("pp"), remat=cfg.recompute,
+                )
             return unmicrobatch(pipe(tuple(params), microbatch(x, n_micro)))
 
         return apply_op(
