@@ -98,6 +98,15 @@ def init_parallel_env():
             num_processes=world,
             process_id=get_rank(),
         )
+        # start the eager-p2p store NOW (rank 0 hosts it): a lazy start on
+        # rank 0's first send() would leave other ranks' early recv()
+        # connects timing out behind a slow first step
+        try:
+            from .communication import _get_p2p_store
+
+            _get_p2p_store()
+        except Exception:
+            pass  # p2p stays lazy if the side port is unavailable
     _initialized[0] = True
     return ParallelEnv()
 

@@ -184,6 +184,17 @@ def _stack_ranks(tensors):
 
 def _this_rank_view(group, stacked, rank=None):
     r = rank if rank is not None else max(group.rank, 0)
+    if _is_dist_multiprocess():
+        # global indexing with a per-process-DIFFERENT index is not SPMD
+        # (each process would contribute its row and GSPMD sums them);
+        # this rank's row is exactly its addressable shard — read it directly
+        for sh in stacked.addressable_shards:
+            idx0 = sh.index[0] if sh.index else None
+            start = (idx0.start or 0) if isinstance(idx0, slice) else 0
+            if start == r:
+                return jnp.asarray(np.asarray(sh.data)[0])
+        # replicated case: any shard holds the full value
+        return jnp.asarray(np.asarray(stacked.addressable_shards[0].data)[r])
     return stacked[r]
 
 
@@ -337,8 +348,10 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     mesh = group.mesh
     out = shard_map(
         _a2a, mesh=mesh, in_specs=(P("g"),), out_specs=P(None, "g"), check_vma=False
-    )(g)  # global [n, n, ...]; out[:, r] = rank r's received list
-    row = np.asarray(out[:, max(group.rank, 0)])
+    )(g)  # global [n, n, ...]; column r = rank r's received list
+    # this rank's column IS its addressable shard (global indexing with a
+    # per-process index is not SPMD — see _this_rank_view)
+    row = np.asarray(out.addressable_shards[0].data)[:, 0]
     for i in range(n):
         out_tensor_list.append(Tensor(jnp.asarray(row[i])))
     return out_tensor_list
@@ -358,20 +371,58 @@ def gather(tensor: Tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return tensor
 
 
+# Eager p2p: host-side transfer over a TCPStore ring (control-plane grade —
+# the COMPILED path uses lax.ppermute over ICI; this serves the reference's
+# eager send/recv API in multi-controller runs).
+_p2p_store = [None]
+_p2p_seq = {}
+
+
+def _get_p2p_store():
+    if _p2p_store[0] is None:
+        import os
+
+        master = os.environ.get("PADDLE_MASTER")
+        if master is None:
+            raise NotImplementedError(
+                "eager send/recv needs a multi-controller run (PADDLE_MASTER "
+                "set by the launcher); in-program transfers compile to "
+                "lax.ppermute (paddle_tpu.distributed.pipeline)")
+        from .store import TCPStore
+
+        host, port = master.rsplit(":", 1)
+        # the master port itself hosts the jax coordinator; p2p rides +1
+        _p2p_store[0] = TCPStore(host=host, port=int(port) + 1,
+                                 is_master=get_rank() == 0,
+                                 world_size=get_world_size())
+    return _p2p_store[0]
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager send/recv is not supported: point-to-point transfers compile "
-        "to lax.ppermute inside jit'd programs (see "
-        "paddle_tpu.distributed.pipeline for the schedule that uses them)"
-    )
+    import pickle
+
+    store = _get_p2p_store()
+    src = get_rank()
+    seq = _p2p_seq.setdefault((src, dst), [0])
+    key = f"p2p/{src}/{dst}/{seq[0]}"
+    seq[0] += 1
+    store.set(key, pickle.dumps(np.asarray(tensor._data), protocol=4))
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager send/recv is not supported: point-to-point transfers compile "
-        "to lax.ppermute inside jit'd programs (see "
-        "paddle_tpu.distributed.pipeline for the schedule that uses them)"
-    )
+    import pickle
+
+    store = _get_p2p_store()
+    dst = get_rank()
+    seq = _p2p_seq.setdefault((src, dst), [0])
+    key = f"p2p/{src}/{dst}/{seq[0]}"
+    seq[0] += 1
+    store.wait(key)
+    val = np.asarray(pickle.loads(store.get(key)))
+    store.delete_key(key)  # the store is a mailbox, not an archive
+    tensor._data = jnp.asarray(val.astype(np.asarray(tensor._data).dtype))
+    return tensor
 
 
 def barrier(group=None):

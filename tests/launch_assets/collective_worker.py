@@ -1,0 +1,86 @@
+"""Multi-controller collective worker, spawned by the launch CLI.
+
+Mirrors the reference's subprocess self-launch pattern
+(test/collective/test_communication_api_base.py:58-79 + the worker scripts
+beside it): each OS process is one rank; jax.distributed.initialize is the
+comm bootstrap; collectives must agree with the single-process math.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, world
+    assert jax.process_count() == 2, jax.process_count()
+
+    # all_reduce: sum of rank-dependent payloads
+    x = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(x)
+    np.testing.assert_allclose(np.asarray(x.numpy()), np.full((4,), 3.0))
+
+    # all_gather: every rank sees both payloads in rank order
+    y = paddle.to_tensor(np.full((2,), float(10 * rank), np.float32))
+    got = []
+    dist.all_gather(got, y)
+    assert len(got) == 2
+    np.testing.assert_allclose(np.asarray(got[0].numpy()), [0.0, 0.0])
+    np.testing.assert_allclose(np.asarray(got[1].numpy()), [10.0, 10.0])
+
+    # broadcast from rank 0
+    z = paddle.to_tensor(np.full((3,), float(rank + 7), np.float32))
+    dist.broadcast(z, src=0)
+    np.testing.assert_allclose(np.asarray(z.numpy()), np.full((3,), 7.0))
+
+    # object collective
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": f"r{rank}"})
+    assert [o["rank"] for o in objs] == [0, 1], objs
+
+    # reduce_scatter: rank r gets sum over ranks of slot r
+    ins = [paddle.to_tensor(np.full((2,), float(rank * 2 + s), np.float32))
+           for s in range(2)]
+    out = paddle.to_tensor(np.zeros((2,), np.float32))
+    dist.reduce_scatter(out, ins)
+    # slot r summed over ranks: (0*2+r) + (1*2+r) = 2 + 2r
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.full((2,), 2.0 + 2.0 * rank))
+
+    # all_to_all: rank r sends slot s to rank s; receives [from0, from1]
+    a2a_in = [paddle.to_tensor(np.full((2,), float(rank * 10 + s), np.float32))
+              for s in range(2)]
+    a2a_out = []
+    dist.all_to_all(a2a_out, a2a_in)
+    np.testing.assert_allclose(np.asarray(a2a_out[0].numpy()),
+                               np.full((2,), float(rank)))
+    np.testing.assert_allclose(np.asarray(a2a_out[1].numpy()),
+                               np.full((2,), float(10 + rank)))
+
+    # eager p2p over the store ring
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.arange(3, dtype=np.float32)), dst=1)
+    else:
+        buf = paddle.to_tensor(np.zeros((3,), np.float32))
+        dist.recv(buf, src=0)
+        np.testing.assert_allclose(np.asarray(buf.numpy()), [0.0, 1.0, 2.0])
+
+    dist.barrier()
+    print(f"WORKER_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -1,0 +1,52 @@
+"""Subprocess self-launch: REAL multi-controller collectives.
+
+The launch CLI spawns 2 OS processes (one rank each, jax.distributed
+bootstrap over the PADDLE_MASTER coordinator); the worker asserts
+all_reduce/all_gather/broadcast/reduce_scatter/object/send-recv parity with
+the single-process math. Reference pattern:
+test/collective/test_communication_api_base.py:58-79.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_launch_two_process_collectives(tmp_path):
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "launch_assets",
+                          "collective_worker.py")
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "JAX_PLATFORMS": "cpu",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}",
+         "--nnodes", "1", "--nproc_per_node", "2",
+         "--log_dir", str(tmp_path / "logs"),
+         worker],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(tmp_path),
+    )
+    logs = ""
+    log_dir = tmp_path / "logs"
+    if log_dir.exists():
+        for f in sorted(log_dir.iterdir()):
+            logs += f"\n--- {f.name} ---\n" + f.read_text()[-2000:]
+    assert proc.returncode == 0, (proc.stdout[-1000:], proc.stderr[-1000:],
+                                  logs[-4000:])
+    assert logs.count("WORKER_OK") == 2, logs[-4000:]
