@@ -214,7 +214,27 @@ def interpolate(
             "area": "linear",
         }[mode]
         out_shape = (a_cl.shape[0],) + tuple(out_spatial) + (a_cl.shape[-1],)
-        out = jax.image.resize(a_cl, out_shape, method=method)
+        if align_corners and method in ("linear", "bilinear", "trilinear"):
+            # jax.image.resize has no align_corners; do separable per-axis
+            # linear interpolation on the corner-aligned grid
+            out = a_cl
+            for ax, o in enumerate(out_spatial, start=1):
+                i = out.shape[ax]
+                if o == i:
+                    continue
+                scale = (i - 1) / (o - 1) if o > 1 else 0.0
+                coords = jnp.arange(o) * scale
+                lo = jnp.floor(coords).astype(jnp.int32)
+                hi = jnp.clip(lo + 1, 0, i - 1)
+                frac = (coords - lo).astype(jnp.float32)
+                shape = [1] * out.ndim
+                shape[ax] = o
+                frac = frac.reshape(shape)
+                lo_v = jnp.take(out, lo, axis=ax).astype(jnp.float32)
+                hi_v = jnp.take(out, hi, axis=ax).astype(jnp.float32)
+                out = lo_v * (1 - frac) + hi_v * frac
+        else:
+            out = jax.image.resize(a_cl, out_shape, method=method)
         if not chan_last:
             inv = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
             out = jnp.transpose(out, inv)

@@ -119,6 +119,10 @@ def _conv_transpose_nd(
     )
 
     def _convt(a, w, b):
+        # transposed conv = gradient-of-conv: the kernel runs spatially
+        # FLIPPED (lax.conv_transpose does not flip by default; without this
+        # only symmetric kernels match the reference)
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
         if isinstance(pad, str):
             lax_pad = pad
         else:

@@ -58,8 +58,18 @@ def frexp(x, name=None):
 
 
 def ldexp(x, y, name=None):
-    return apply_op(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y,
-                    _op_name="ldexp")
+    # x * 2**y (reference math.py ldexp uses pow: fractional exponents scale
+    # fractionally). Integer exponents ride jnp.ldexp (exact, no overflow at
+    # large y in float64); the working dtype is the promoted float of (x, y).
+    def _ldexp(a, b):
+        out_dt = jnp.promote_types(jnp.promote_types(a.dtype, b.dtype),
+                                   jnp.float32)
+        if jnp.issubdtype(b.dtype, jnp.integer):
+            return jnp.ldexp(a.astype(out_dt), b)
+        return a.astype(out_dt) * jnp.power(jnp.asarray(2.0, out_dt),
+                                            b.astype(out_dt))
+
+    return apply_op(_ldexp, x, y, _op_name="ldexp")
 
 
 # -- reductions -------------------------------------------------------------

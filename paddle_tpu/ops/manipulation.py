@@ -1,6 +1,8 @@
 """Shape / layout manipulation ops (parity: python/paddle/tensor/manipulation.py)."""
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -308,7 +310,8 @@ def index_sample(x, index, name=None):
 
 def index_add(x, index, axis, value, name=None):
     def _index_add(a, i, v):
-        idx = [slice(None)] * a.ndim
+        # builtins.slice: the module-level paddle `slice` op shadows it here
+        idx = [builtins.slice(None)] * a.ndim
         idx[axis] = i
         return a.at[tuple(idx)].add(v)
 
