@@ -48,7 +48,16 @@ def functional_call(layer: Layer, state: dict, *args, **kwargs):
 
 
 class StaticFunction:
-    """Compiled wrapper around a Layer or a pure tensor function."""
+    """Compiled wrapper around a Layer or a pure tensor function.
+
+    Guards (reference: jit/sot guard.py semantics): the compiled-program
+    cache is keyed on (training, input shapes, input dtypes) — a shape or
+    dtype change triggers a retrace instead of running a stale program.
+    Graph breaks (reference: SOT graph-break fallback): data-dependent
+    Python control flow raises a jax concretization error during tracing;
+    the call falls back to eager for that invocation with a one-time
+    warning instead of a hard failure.
+    """
 
     def __init__(self, function, input_spec=None, **kwargs):
         if isinstance(function, Layer):
@@ -59,13 +68,25 @@ class StaticFunction:
             self._fn = function
         self._input_spec = input_spec
         self._compiled = {}
+        self._fallback_warned = False
 
-    def _trace_key(self):
+    def _trace_key(self, raw_args, raw_kwargs):
         training = self._layer.training if self._layer is not None else False
-        return (training,)
 
-    def _get_compiled(self):
-        key = self._trace_key()
+        def leaf_sig(a):
+            if hasattr(a, "shape"):
+                return (tuple(a.shape), str(a.dtype))
+            if isinstance(a, float):
+                # floats trace as values inside the program — keying by
+                # value would recompile per lr/scale; key by type only
+                return ("<float>",)
+            return a  # bools/ints/strings: small value sets, key by value
+
+        sig = tuple(leaf_sig(a)
+                    for a in tree_util.tree_leaves((raw_args, raw_kwargs)))
+        return (training, sig)
+
+    def _get_compiled(self, key):
         if key not in self._compiled:
             layer = self._layer
             fn = self._fn
@@ -92,21 +113,56 @@ class StaticFunction:
                 self._compiled[key] = jax.jit(pure_fn)
         return self._compiled[key]
 
+    _GRAPH_BREAK_ERRORS = (
+        jax.errors.TracerBoolConversionError,
+        jax.errors.TracerIntegerConversionError,
+        jax.errors.TracerArrayConversionError,
+        jax.errors.ConcretizationTypeError,
+    )
+
+    def _eager_call(self, args, kwargs):
+        fn = self._fn if self._fn is not None else self._layer
+        return fn(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
-        compiled = self._get_compiled()
         raw_args = _unwrap_tensors(args)
         raw_kwargs = _unwrap_tensors(kwargs)
+        key = self._trace_key(raw_args, raw_kwargs)
+        if self._compiled.get(key, False) is None:  # known graph break
+            return self._eager_call(args, kwargs)
+        compiled = self._get_compiled(key)
         key_arr = framework.next_rng_key()
-        if self._layer is not None:
-            state = {k: v._data for k, v in self._layer.state_dict().items()}
-            out_arrays, mutated = compiled(state, key_arr, raw_args, raw_kwargs)
-            # write back mutated buffers (e.g. batchnorm stats)
-            entries = self._layer.state_dict()
-            for name, arr in mutated.items():
-                if name in entries:
-                    entries[name]._data = arr
-            return _wrap_arrays(out_arrays)
-        return _wrap_arrays(compiled(key_arr, raw_args, raw_kwargs))
+        try:
+            if self._layer is not None:
+                state = {k: v._data
+                         for k, v in self._layer.state_dict().items()}
+                out_arrays, mutated = compiled(state, key_arr, raw_args,
+                                               raw_kwargs)
+                # write back mutated buffers (e.g. batchnorm stats)
+                entries = self._layer.state_dict()
+                for name, arr in mutated.items():
+                    if name in entries:
+                        entries[name]._data = arr
+                return _wrap_arrays(out_arrays)
+            return _wrap_arrays(compiled(key_arr, raw_args, raw_kwargs))
+        except self._GRAPH_BREAK_ERRORS as e:
+            # graph break: data-dependent Python control flow cannot trace;
+            # run this call eagerly (SOT fallback semantics) and remember so
+            # later same-signature calls skip the doomed trace
+            self._compiled[key] = None
+            if not self._fallback_warned:
+                self._fallback_warned = True
+                import warnings
+
+                target = self._fn or self._layer
+                warnings.warn(
+                    f"to_static: graph break in "
+                    f"{getattr(target, '__name__', type(target).__name__)} "
+                    f"({type(e).__name__}); falling back to eager for such "
+                    "calls — hoist data-dependent Python branching out of "
+                    "forward (or use paddle.where / lax.cond) to stay "
+                    "compiled")
+            return self._eager_call(args, kwargs)
 
     @property
     def dygraph_function(self):

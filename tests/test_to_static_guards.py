@@ -1,0 +1,122 @@
+"""to_static robustness: graph-break fallback + shape/dtype guards
+(reference: jit/sot opcode_executor graph breaks + guard.py cache keys)."""
+import warnings
+
+import numpy as np
+
+
+def test_graph_break_falls_back_to_eager():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class Branchy(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 4)
+            self.b = nn.Linear(4, 4)
+
+        def forward(self, x):
+            # data-dependent Python branch: untraceable by design
+            if float(x.sum()) > 0:
+                return self.a(x)
+            return self.b(x)
+
+    model = paddle.jit.to_static(Branchy())
+    xpos = paddle.to_tensor(np.full((2, 4), 1.0, np.float32))
+    xneg = paddle.to_tensor(np.full((2, 4), -1.0, np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out1 = model(xpos)
+        out2 = model(xneg)
+    assert any("graph break" in str(x.message) for x in w), [
+        str(x.message) for x in w]
+    ref1 = model._layer.a(xpos)
+    ref2 = model._layer.b(xneg)
+    np.testing.assert_allclose(np.asarray(out1.numpy()),
+                               np.asarray(ref1.numpy()), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out2.numpy()),
+                               np.asarray(ref2.numpy()), atol=1e-6)
+
+
+def test_graph_break_layer_still_trains():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class Branchy(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 1)
+
+        def forward(self, x):
+            if float(x.mean()) > 1e9:  # never taken, but untraceable
+                return self.fc(x) * 0
+            return self.fc(x)
+
+    model = paddle.jit.to_static(Branchy())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(16, 1).astype(np.float32))
+    losses = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(10):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_shape_change_triggers_retrace():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    traces = [0]
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            traces[0] += 1  # python side effect: runs once per trace
+            return self.fc(x)
+
+    model = paddle.jit.to_static(Net())
+    model.eval()
+    a = paddle.randn([2, 4])
+    b = paddle.randn([5, 4])
+
+    model(a)
+    n_after_first = traces[0]
+    model(a)
+    assert traces[0] == n_after_first, "same signature must NOT retrace"
+    out = model(b)
+    assert traces[0] > n_after_first, "new shape must retrace"
+    assert tuple(out.shape) == (5, 2)
+    model(b)
+    assert traces[0] == n_after_first + (traces[0] - n_after_first), traces
+
+    # dtype change also retraces and runs correctly
+    c = paddle.randn([2, 4]).astype("float64")
+    out64 = model(c)
+    assert tuple(out64.shape) == (2, 2)
+
+
+def test_train_eval_mode_guard():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    model = paddle.jit.to_static(nn.Sequential(nn.Linear(4, 4),
+                                               nn.Dropout(0.5)))
+    x = paddle.randn([3, 4])
+    model.train()
+    _ = model(x)
+    model.eval()
+    out1 = model(x)
+    out2 = model(x)
+    np.testing.assert_allclose(np.asarray(out1.numpy()),
+                               np.asarray(out2.numpy()), atol=1e-6)
