@@ -1,16 +1,21 @@
 """paddle.io — datasets, samplers, DataLoader.
 
 Parity: python/paddle/io (DataLoader at io/reader.py:262, workers at
-io/dataloader/worker.py).  The loader runs a background prefetch thread that
-collates numpy batches and stages them to device ahead of consumption —
-the TPU-appropriate equivalent of the reference's shared-memory worker pool
-(host→HBM transfer overlaps compute; heavy decode work can still use
-num_workers threads).
+io/dataloader/worker.py). num_workers > 0 starts real OS worker processes
+(fork) that fetch+collate numpy batches and hand them to the parent through
+POSIX shared memory — the reference's mmap_allocator transport
+(phi/core/memory/allocation/mmap_allocator.cc) rebuilt on
+multiprocessing.shared_memory. The parent additionally runs a prefetch
+thread that stages ready batches to device ahead of consumption (host→HBM
+overlap). Workers never touch jax: transport is numpy; device placement
+happens in the parent.
 """
 from __future__ import annotations
 
 import itertools
 import math
+import multiprocessing as _mp
+import os
 import queue as _queue
 import threading
 
@@ -289,6 +294,423 @@ def _to_jax(arr):
     return jax.device_put(arr)
 
 
+# --------------------------------------------------------------------------
+# multiprocess workers + shared-memory transport
+# --------------------------------------------------------------------------
+def _collate_np(batch):
+    """Worker-side collate: numpy only (workers never touch jax)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return [
+            _collate_np(list(fields)) for fields in zip(*batch)
+        ]
+    if isinstance(sample, dict):
+        return {k: _collate_np([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _tree_to_np(obj):
+    """Normalize a collated pytree so it can ride shared memory."""
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if isinstance(obj, (list, tuple)):
+        return [_tree_to_np(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _tree_to_np(v) for k, v in obj.items()}
+    return obj
+
+
+def _flatten_arrays(obj, out):
+    """Replace np arrays with {"@arr": i} markers, collecting them in out."""
+    if isinstance(obj, np.ndarray):
+        out.append(obj)
+        return {"@arr": len(out) - 1}
+    if isinstance(obj, (list, tuple)):
+        return [_flatten_arrays(o, out) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _flatten_arrays(v, out) for k, v in obj.items()}
+    return obj
+
+
+def _unflatten_arrays(obj, arrays):
+    if isinstance(obj, dict) and "@arr" in obj and len(obj) == 1:
+        return arrays[obj["@arr"]]
+    if isinstance(obj, list):
+        return [_unflatten_arrays(o, arrays) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _unflatten_arrays(v, arrays) for k, v in obj.items()}
+    return obj
+
+
+def _shm_pack(batch):
+    """(structure, metas, shm_name|None): arrays concatenated into one
+    SharedMemory segment; the structure references them by index."""
+    from multiprocessing import shared_memory
+
+    arrays = []
+    struct = _flatten_arrays(batch, arrays)
+    if not arrays:
+        return struct, [], None
+    total = sum(int(a.nbytes) for a in arrays)
+    shm = shared_memory.SharedMemory(create=True, size=max(total, 1))
+    metas = []
+    off = 0
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        shm.buf[off:off + a.nbytes] = a.tobytes()
+        metas.append((str(a.dtype), tuple(a.shape), off, int(a.nbytes)))
+        off += a.nbytes
+    name = shm.name
+    # ownership transfers to the parent: without unregistering, the worker's
+    # resource tracker unlinks the segment the moment the worker exits —
+    # before the parent has read it
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    shm.close()  # segment persists until the parent unlinks it
+    return struct, metas, name
+
+
+def _shm_unpack(struct, metas, name):
+    from multiprocessing import shared_memory
+
+    if name is None:
+        return _unflatten_arrays(struct, [])
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        arrays = []
+        for dtype, shape, off, nbytes in metas:
+            # bytes() copies out without keeping an exported pointer into
+            # the segment (a live np view would make shm.close() fail)
+            raw = bytes(shm.buf[off:off + nbytes])
+            arrays.append(np.frombuffer(raw, dtype=np.dtype(dtype))
+                          .reshape(shape))
+        return _unflatten_arrays(struct, arrays)
+    finally:
+        shm.close()
+        shm.unlink()
+
+
+def _safe_put(result_q, stop_evt, tag, payload):
+    """Deliver unless the parent asked for shutdown; on abort, unlink the
+    payload's shm segment ourselves (the parent will never see it)."""
+    while not stop_evt.is_set():
+        try:
+            result_q.put((tag, payload), timeout=0.2)
+            return True
+        except _queue.Full:
+            continue
+    _unlink_payload(payload)
+    return False
+
+
+def _worker_loop(dataset, collate_fn, index_q, result_q, stop_evt, wid,
+                 num_workers, worker_init_fn, use_shm):
+    """Runs in the child process: fetch -> collate -> shm -> result queue."""
+    global _worker_ctx
+    _worker_ctx = WorkerInfo(id=wid, num_workers=num_workers,
+                             dataset=dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    try:
+        while not stop_evt.is_set():
+            try:
+                item = index_q.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            if item is None:
+                return
+            bidx, idxs = item
+            samples = [dataset[i] for i in idxs]
+            batch = _tree_to_np(
+                collate_fn(samples) if collate_fn is not None
+                else _collate_np(samples))
+            payload = _shm_pack(batch) if use_shm else (batch, None, None)
+            if not _safe_put(result_q, stop_evt, bidx, payload):
+                return
+    except KeyboardInterrupt:
+        pass
+    except Exception as e:  # surface the traceback to the parent
+        import traceback
+
+        result_q.put(("error", (wid, f"{e}\n{traceback.format_exc()}", None)))
+
+
+class _MultiprocessIter:
+    """Parent side of the worker pool: dispatch index batches round-robin,
+    reorder results, rebuild device tensors from shm payloads."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        ctx = _mp.get_context("fork")
+        self._index_q = ctx.Queue()
+        self._stop = ctx.Event()
+        self._result_q = ctx.Queue(
+            maxsize=max(2, loader.prefetch_factor) * loader.num_workers)
+        self._batches = list(loader.batch_sampler)
+        self._n = len(self._batches)
+        self._next = 0
+        self._buffer = {}
+        self._workers = []
+        for w in range(loader.num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, loader.collate_fn, self._index_q,
+                      self._result_q, self._stop, w, loader.num_workers,
+                      loader.worker_init_fn, loader.use_shared_memory),
+                daemon=True,
+            )
+            p.start()
+            self._workers.append(p)
+        for bidx, idxs in enumerate(self._batches):
+            self._index_q.put((bidx, list(idxs)))
+        for _ in self._workers:
+            self._index_q.put(None)
+
+    def _shutdown(self):
+        _pool_shutdown(self._stop, self._workers, self._result_q,
+                       self._buffer)
+        self._workers = []
+        self._buffer = {}
+
+    def close(self):
+        self._shutdown()
+
+    def __next__(self):
+        if self._next >= self._n:
+            self._shutdown()
+            raise StopIteration
+        while self._next not in self._buffer:
+            try:
+                bidx, payload = self._result_q.get(timeout=5.0)
+            except _queue.Empty:
+                dead = [i for i, p in enumerate(self._workers)
+                        if not p.is_alive()]
+                if dead and self._result_q.empty():
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited unexpectedly")
+                continue
+            if bidx == "error":
+                wid, tb, _ = payload
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker {wid} raised:\n{tb}")
+            self._buffer[bidx] = payload
+        struct, metas, name = self._buffer.pop(self._next)
+        self._next += 1
+        batch = _shm_unpack(struct, metas, name)
+        return _np_tree_to_tensors(batch)
+
+    def __iter__(self):
+        return self
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+def _unlink_payload(payload):
+    from multiprocessing import shared_memory
+
+    name = payload[2] if isinstance(payload, tuple) and len(payload) == 3 \
+        else None
+    if isinstance(name, str):
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def _pool_shutdown(stop_evt, workers, result_q, buffer):
+    """Cooperative pool teardown with no shm leaks: signal stop, drain the
+    queue (unlinking undelivered payloads) until workers exit, then reap."""
+    import time as _time
+
+    stop_evt.set()
+    for payload in buffer.values():
+        _unlink_payload(payload)
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        try:
+            tag, payload = result_q.get(timeout=0.1)
+            if tag != "error":
+                _unlink_payload(payload)
+            continue
+        except (_queue.Empty, EOFError, OSError):
+            pass
+        if not any(p.is_alive() for p in workers):
+            break
+    # final sweep after all workers exited
+    while True:
+        try:
+            tag, payload = result_q.get_nowait()
+        except (_queue.Empty, EOFError, OSError):
+            break
+        if tag != "error":
+            _unlink_payload(payload)
+    for p in workers:
+        if p.is_alive():
+            p.terminate()
+        p.join(timeout=1.0)
+
+
+def _worker_loop_iterable(dataset, collate_fn, batch_size, drop_last,
+                          result_q, stop_evt, wid, num_workers,
+                          worker_init_fn, use_shm):
+    """Iterable-dataset worker: every worker consumes the FULL stream
+    (reference worker semantics — shard inside the dataset via
+    get_worker_info(), else data duplicates across workers)."""
+    global _worker_ctx
+    _worker_ctx = WorkerInfo(id=wid, num_workers=num_workers,
+                             dataset=dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    try:
+        def emit(seq, samples):
+            batch = _tree_to_np(
+                collate_fn(samples) if collate_fn is not None
+                else _collate_np(samples))
+            payload = _shm_pack(batch) if use_shm else (batch, None, None)
+            return _safe_put(result_q, stop_evt, ("b", wid, seq), payload)
+
+        seq = 0
+        batch = []
+        for sample in dataset:
+            if stop_evt.is_set():
+                return
+            batch.append(sample)
+            if len(batch) == batch_size:
+                if not emit(seq, batch):
+                    return
+                seq += 1
+                batch = []
+        if batch and not drop_last:
+            if not emit(seq, batch):
+                return
+            seq += 1
+        _safe_put(result_q, stop_evt, ("end", wid, seq), (None, None, None))
+    except KeyboardInterrupt:
+        pass
+    except Exception as e:
+        import traceback
+
+        result_q.put(("error", (wid, f"{e}\n{traceback.format_exc()}", None)))
+
+
+class _MultiprocessIterableIter:
+    """Worker pool over an IterableDataset: results interleaved round-robin
+    across workers (w0.b0, w1.b0, w0.b1, ...)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        ctx = _mp.get_context("fork")
+        self._stop = ctx.Event()
+        self._result_q = ctx.Queue(
+            maxsize=max(2, loader.prefetch_factor) * loader.num_workers)
+        self._buffer = {}
+        self._ends = {}  # wid -> total batches produced
+        self._cursor = [0] * loader.num_workers  # next seq per worker
+        self._turn = 0
+        self._workers = []
+        for w in range(loader.num_workers):
+            p = ctx.Process(
+                target=_worker_loop_iterable,
+                args=(loader.dataset, loader.collate_fn, loader.batch_size,
+                      loader.drop_last, self._result_q, self._stop, w,
+                      loader.num_workers, loader.worker_init_fn,
+                      loader.use_shared_memory),
+                daemon=True,
+            )
+            p.start()
+            self._workers.append(p)
+
+    def _shutdown(self):
+        _pool_shutdown(self._stop, self._workers, self._result_q,
+                       self._buffer)
+        self._workers = []
+        self._buffer = {}
+
+    def close(self):
+        self._shutdown()
+
+    def _advance_turn(self):
+        n = len(self._cursor)
+        for _ in range(n):
+            self._turn = (self._turn + 1) % n
+            w = self._turn
+            if w not in self._ends or self._cursor[w] < self._ends[w]:
+                return True
+        return False
+
+    def __next__(self):
+        n = len(self._cursor)
+        while True:
+            w = self._turn
+            if w in self._ends and self._cursor[w] >= self._ends[w]:
+                # this worker is exhausted; find one that isn't
+                if not self._advance_turn():
+                    self._shutdown()
+                    raise StopIteration
+                continue
+            want = ("b", w, self._cursor[w])
+            if want in self._buffer:
+                payload = self._buffer.pop(want)
+                self._cursor[w] += 1
+                self._advance_turn()
+                batch = _shm_unpack(*payload)
+                return _np_tree_to_tensors(batch)
+            try:
+                tag, payload = self._result_q.get(timeout=5.0)
+            except _queue.Empty:
+                dead = [i for i, p in enumerate(self._workers)
+                        if not p.is_alive() and i not in self._ends]
+                if dead and self._result_q.empty():
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited unexpectedly")
+                continue
+            if tag == "error":
+                wid, tb, _ = payload
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker {wid} raised:\n{tb}")
+            if tag[0] == "end":
+                self._ends[tag[1]] = tag[2]
+            else:
+                self._buffer[tag] = payload
+
+    def __iter__(self):
+        return self
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+def _np_tree_to_tensors(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(_to_jax(obj))
+    if isinstance(obj, list):
+        return [_np_tree_to_tensors(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _np_tree_to_tensors(v) for k, v in obj.items()}
+    return obj
+
+
 class _DataLoaderIter:
     def __init__(self, loader):
         self.loader = loader
@@ -369,6 +791,9 @@ class DataLoader:
         self.drop_last = drop_last
         self.prefetch_factor = prefetch_factor if use_buffer_reader else 0
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
             self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
@@ -382,6 +807,10 @@ class DataLoader:
             self.batch_size = batch_size
 
     def __iter__(self):
+        if self.num_workers > 0:
+            if self.batch_sampler is not None:
+                return _MultiprocessIter(self)
+            return _MultiprocessIterableIter(self)
         return _DataLoaderIter(self)
 
     def __len__(self):
@@ -390,5 +819,18 @@ class DataLoader:
         raise TypeError("IterableDataset DataLoader has no len()")
 
 
+class WorkerInfo:
+    """Visible inside worker processes via get_worker_info()
+    (parity: io/dataloader/worker.py WorkerInfo)."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_ctx = None  # set by _worker_loop inside each worker process
+
+
 def get_worker_info():
-    return None
+    return _worker_ctx
