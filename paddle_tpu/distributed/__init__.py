@@ -57,6 +57,11 @@ from .auto_parallel import (  # noqa: F401
     shard_tensor,
     shard_activation,
 )
+from .spmd_rules import (  # noqa: F401
+    DistTensorSpec,
+    get_spmd_rule,
+    register_spmd_rule,
+)
 from .parallel_step import (  # noqa: F401
     ShardedTrainStep,
     group_sharded_parallel,

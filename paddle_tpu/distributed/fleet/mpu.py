@@ -63,7 +63,20 @@ class VocabParallelEmbedding(Layer):
         )
 
     def forward(self, x):
-        return F.embedding(x, self.weight)
+        out = F.embedding(x, self.weight)
+        mesh = get_fleet_mesh()
+        if mesh is not None and "mp" in mesh.dim_names and mesh.get_dim_size("mp") > 1:
+            # spmd rule `embedding` (spmd_rules.py, tested in
+            # test_spmd_rules.py::TestEmbeddingRule): vocab-sharded table ->
+            # output partial over mp; the resolved placement (replicated
+            # over mp, batch on the data axes) binds the masked-lookup +
+            # allreduce plan — the c_embedding pattern (embedding.cc:30) —
+            # instead of letting propagation all_gather the sharded table.
+            from ..spmd_rules import constraints_enabled
+
+            if constraints_enabled():
+                out = shard_activation(out, mesh=mesh, spec=_replicate_spec(mesh))
+        return out
 
 
 class ColumnParallelLinear(Layer):

@@ -1,0 +1,696 @@
+"""Per-op SPMD sharding rules.
+
+Parity slot: `paddle/phi/infermeta/spmd_rules/` (121 rule files, e.g.
+`matmul.cc:42-80`) and the rule tests under
+`test/auto_parallel/spmd_rules/test_matmul_rule.py`.
+
+GSPMD propagation is the framework default (the compiler propagates
+shardings through the whole jaxpr), but propagation alone mis-shards a
+handful of ops whose optimal placement is a *semantic* decision, not a
+dataflow one: vocab-parallel embedding (masked-lookup + allreduce beats
+gathering the sharded table), attention (shard heads, never head_dim),
+softmax/norm reduction axes, and MoE dispatch (expert dim over "ep").
+This module supplies:
+
+1. ``DistTensorSpec`` + an einsum-notation inference engine that, given
+   input dims_mappings, produces merged input mappings and output
+   mappings with partial (pending-reduction) mesh dims — the same
+   contract as the reference's ``infer_forward``.
+2. A registry of per-op rules (``get_spmd_rule(name).infer_forward``)
+   covering matmul/elementwise/embedding/reduction/softmax/layer_norm/
+   flash_attention/cross_entropy/reshape/transpose/concat/split/moe
+   and friends.
+3. ``constrain(op, mesh, out, *input_placement_lists)`` — applies the
+   rule's inferred output placement as a ``lax.with_sharding_constraint``
+   so the decision binds inside jit (the analogue of the reference
+   inserting a reshard op from the inferred dist_attr).
+
+dims_mapping convention matches the reference: ``dims_mapping[i]`` is
+the mesh-dim *index* sharding tensor dim ``i``, or ``-1`` for
+replicated. Partial state is a set of mesh-dim indices carrying an
+unreduced sum (phi ``TensorDistAttr::_partial_dims()``).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from jax.sharding import PartitionSpec
+
+__all__ = [
+    "DistTensorSpec",
+    "get_spmd_rule",
+    "register_spmd_rule",
+    "constrain",
+    "constraints_enabled",
+]
+
+
+def constraints_enabled() -> bool:
+    """Master switch for rule-driven constraint insertion
+    (``FLAGS_spmd_rule_constraints``) — gates the embedding, attention,
+    and MoE-dispatch sites."""
+    from ..utils.flags import get_flags
+
+    return bool(get_flags("spmd_rule_constraints")["spmd_rule_constraints"])
+
+
+class DistTensorSpec:
+    """Shape + dims_mapping (+ partial dims) — phi ``DistTensorSpec``."""
+
+    def __init__(self, shape, dims_mapping=None, partial_dims=()):
+        self.shape = list(shape)
+        if dims_mapping is None:
+            dims_mapping = [-1] * len(self.shape)
+        if len(dims_mapping) != len(self.shape):
+            raise ValueError(
+                f"dims_mapping rank {len(dims_mapping)} != tensor rank {len(self.shape)}"
+            )
+        self.dims_mapping = list(dims_mapping)
+        self.partial_dims = set(partial_dims)
+
+    # reference-test API
+    def set_dims_mapping(self, dm):
+        self.dims_mapping = list(dm)
+
+    def _is_partial(self):
+        return bool(self.partial_dims)
+
+    def _partial_dims(self):
+        return set(self.partial_dims)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        p = f", partial={sorted(self.partial_dims)}" if self.partial_dims else ""
+        return f"DistTensorSpec({self.shape}, {self.dims_mapping}{p})"
+
+    def partition_spec(self, mesh_dim_names: Sequence[str]) -> PartitionSpec:
+        entries = [
+            None if m < 0 else mesh_dim_names[m] for m in self.dims_mapping
+        ]
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+
+# ---------------------------------------------------------------------------
+# einsum-notation inference engine
+# ---------------------------------------------------------------------------
+def _merge_axis(candidates: List[int]) -> int:
+    """Merge per-letter mesh dims from multiple inputs.
+
+    Reference semantics (`ShardingMergeForAxis`): sharded beats
+    replicated; two different shardings of the same letter keep the
+    first (the later input is inferred resharded to match).
+    """
+    for c in candidates:
+        if c >= 0:
+            return c
+    return -1
+
+
+def einsum_infer(notation: str, specs: Sequence[DistTensorSpec]):
+    """Infer shardings through an einsum-style notation.
+
+    ``notation`` e.g. ``"mk,kn->mn"``. Returns
+    ``(inferred_inputs, inferred_outputs)`` where contracted letters
+    that remain sharded surface as partial dims on the outputs —
+    exactly the reference matmul rule's contract
+    (`matmul.cc:42-80`: mk[1,0] x kn[0,-1] -> mn[1,-1] partial{0}).
+
+    A ``1`` in the notation marks a broadcast dim (size-1), always
+    replicated. A ``*`` marks a dim forced replicated (e.g. a softmax
+    or norm axis).
+    """
+    lhs, rhs = notation.split("->")
+    in_subs = lhs.split(",")
+    out_subs = rhs.split(",") if rhs else []
+    if len(in_subs) != len(specs):
+        raise ValueError(f"notation {notation!r} has {len(in_subs)} operands, got {len(specs)} specs")
+
+    # 1. merge each letter's sharding across inputs
+    letter_map = {}
+    order = []
+    for sub, spec in zip(in_subs, specs):
+        if len(sub) != spec.ndim:
+            raise ValueError(f"operand {sub!r} rank != spec rank {spec.ndim}")
+        for letter, m in zip(sub, spec.dims_mapping):
+            if letter in "1*":
+                continue
+            if letter not in letter_map:
+                letter_map[letter] = []
+                order.append(letter)
+            letter_map[letter].append(m)
+    merged = {lt: _merge_axis(ms) for lt, ms in letter_map.items()}
+
+    # 2. a mesh dim may shard at most one letter: first letter wins
+    used = {}
+    for lt in order:
+        m = merged[lt]
+        if m < 0:
+            continue
+        if m in used:
+            merged[lt] = -1
+        else:
+            used[m] = lt
+
+    # 3. inferred (corrected) input specs
+    inferred_inputs = []
+    for sub, spec in zip(in_subs, specs):
+        dm = [
+            -1 if letter in "1*" else merged[letter]
+            for letter in sub
+        ]
+        inferred_inputs.append(DistTensorSpec(spec.shape, dm))
+
+    # 4. outputs: contracted sharded letters become partial dims
+    out_letters = set("".join(out_subs))
+    pending = {
+        merged[lt]
+        for lt in order
+        if merged[lt] >= 0 and lt not in out_letters
+    }
+    inferred_outputs = []
+    for sub in out_subs:
+        dm = [-1 if letter in "1*" else merged.get(letter, -1) for letter in sub]
+        # output shape is unknown to the engine; synthesize rank-only shape
+        inferred_outputs.append(DistTensorSpec([0] * len(sub), dm, partial_dims=pending))
+    return inferred_inputs, inferred_outputs
+
+
+def _letters(n, skip=""):
+    pool = [c for c in "abcdefghijklmnopqrstuvwxyz" if c not in skip]
+    return "".join(pool[:n])
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+_RULES = {}
+
+
+def register_spmd_rule(name):
+    def deco(fn):
+        _RULES[name] = SpmdRule(name, fn)
+        return fn
+
+    return deco
+
+
+class SpmdRule:
+    def __init__(self, name, fn):
+        self.name = name
+        self._fn = fn
+
+    def infer_forward(self, *specs, **attrs):
+        """Returns ([inferred input specs], [inferred output specs])."""
+        return self._fn(*specs, **attrs)
+
+
+def get_spmd_rule(name) -> SpmdRule:
+    try:
+        return _RULES[name]
+    except KeyError:
+        raise KeyError(
+            f"no SPMD rule registered for {name!r}; GSPMD propagation is the default"
+        ) from None
+
+
+# -- matmul ------------------------------------------------------------------
+@register_spmd_rule("matmul")
+def _matmul_rule(x: DistTensorSpec, y: DistTensorSpec, trans_x=False, trans_y=False):
+    """`matmul.cc:42-80`. Batched, broadcast-aware."""
+    xd, yd = x.ndim, y.ndim
+    x_mat = "mk" if not trans_x else "km"
+    y_mat = "kn" if not trans_y else "nk"
+    if xd == 1:
+        x_mat = "k"
+    if yd == 1:
+        y_mat = "k"
+    xb, yb = max(xd - len(x_mat), 0), max(yd - len(y_mat), 0)
+    nb = max(xb, yb)
+    batch = _letters(nb, skip="mnk")
+    x_sub = batch[nb - xb:] + x_mat
+    y_sub = batch[nb - yb:] + y_mat
+    out = batch + ("m" if "m" in x_mat else "") + ("n" if "n" in y_mat else "")
+    return einsum_infer(f"{x_sub},{y_sub}->{out}", [x, y])
+
+
+@register_spmd_rule("einsum")
+def _einsum_rule(*specs, equation):
+    return einsum_infer(equation, list(specs))
+
+
+# -- elementwise -------------------------------------------------------------
+def _broadcast_subs(specs):
+    nd = max(s.ndim for s in specs)
+    letters = _letters(nd)
+    subs = []
+    for s in specs:
+        sub = letters[nd - s.ndim:]
+        # size-1 dims broadcast: force replicated
+        sub = "".join(
+            "1" if s.shape[i] == 1 else c for i, c in enumerate(sub)
+        )
+        subs.append(sub)
+    return ",".join(subs) + "->" + letters
+
+
+@register_spmd_rule("elementwise")
+def _elementwise_rule(*specs):
+    """`elementwise.cc` — broadcast-aware letter merge."""
+    return einsum_infer(_broadcast_subs(specs), list(specs))
+
+
+@register_spmd_rule("where")
+def _where_rule(cond, x, y):
+    return einsum_infer(_broadcast_subs([cond, x, y]), [cond, x, y])
+
+
+@register_spmd_rule("cast")
+def _cast_rule(x):
+    return einsum_infer(f"{_letters(x.ndim)}->{_letters(x.ndim)}", [x])
+
+
+# -- embedding ---------------------------------------------------------------
+@register_spmd_rule("embedding")
+def _embedding_rule(x: DistTensorSpec, w: DistTensorSpec, padding_idx=-1, sparse=False):
+    """`embedding.cc:30`. ids [...], weight [V, H] -> out [..., H].
+
+    Row-sharded weight (vocab over mp) keeps the sharding and the
+    output becomes *partial* over that mesh dim — the c_embedding
+    masked-lookup + allreduce pattern. The ids must not be sharded on
+    the same mesh dim as the vocab axis.
+    """
+    ids = _letters(x.ndim, skip="vh")
+    notation = f"{ids},vh->{ids}h"
+    return einsum_infer(notation, [x, w])
+
+
+@register_spmd_rule("c_embedding")
+def _c_embedding_rule(w: DistTensorSpec, x: DistTensorSpec, start_index=0):
+    ins, outs = _embedding_rule(x, w)
+    return [ins[1], ins[0]], outs
+
+
+# -- reductions --------------------------------------------------------------
+@register_spmd_rule("reduction")
+def _reduction_rule(x: DistTensorSpec, axis=None, keepdim=False, reduce_type="sum"):
+    """`reduction.cc`. Sharded reduced axes -> partial output (sum/mean)
+    or forced-replicated input (max/min, where partial isn't linear)."""
+    nd = x.ndim
+    if axis is None:
+        axes = list(range(nd))
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        axes = [a % nd for a in axes]
+    letters = _letters(nd)
+    linear = reduce_type in ("sum", "mean", "avg")
+    x_sub = letters
+    if not linear:
+        x_sub = "".join("*" if i in axes else c for i, c in enumerate(letters))
+    if keepdim:
+        out = "".join("*" if i in axes else c for i, c in enumerate(letters))
+    else:
+        out = "".join(c for i, c in enumerate(letters) if i not in axes)
+    return einsum_infer(f"{x_sub}->{out}", [x])
+
+
+@register_spmd_rule("softmax")
+def _softmax_rule(x: DistTensorSpec, axis=-1):
+    """`softmax.cc:28` — the softmax axis must be replicated."""
+    nd = x.ndim
+    axis %= nd
+    letters = _letters(nd)
+    sub = "".join("*" if i == axis else c for i, c in enumerate(letters))
+    return einsum_infer(f"{sub}->{sub}", [x])
+
+
+@register_spmd_rule("layer_norm")
+def _layer_norm_rule(x: DistTensorSpec, scale=None, bias=None, begin_norm_axis=-1):
+    """`layer_norm.cc` — normalized trailing dims replicated; leading
+    (batch/seq) dims keep their sharding. Returns out, mean, variance."""
+    nd = x.ndim
+    begin_norm_axis %= nd
+    letters = _letters(nd)
+    sub = "".join(
+        "*" if i >= begin_norm_axis else c for i, c in enumerate(letters)
+    )
+    lead = sub[:begin_norm_axis]
+    specs = [x]
+    subs = [sub]
+    for extra in (scale, bias):
+        if extra is not None:
+            specs.append(extra)
+            subs.append("*" * extra.ndim)
+    ins, outs = einsum_infer(
+        ",".join(subs) + f"->{sub},{lead},{lead}", specs
+    )
+    return ins, outs
+
+
+@register_spmd_rule("rms_norm")
+def _rms_norm_rule(x: DistTensorSpec, scale=None, begin_norm_axis=-1):
+    ins, outs = _layer_norm_rule(x, scale, None, begin_norm_axis)
+    return ins, outs[:1]
+
+
+# -- shape manipulation ------------------------------------------------------
+@register_spmd_rule("transpose")
+def _transpose_rule(x: DistTensorSpec, perm=None):
+    nd = x.ndim
+    perm = list(range(nd))[::-1] if perm is None else [p % nd for p in perm]
+    letters = _letters(nd)
+    out = "".join(letters[p] for p in perm)
+    return einsum_infer(f"{letters}->{out}", [x])
+
+
+@register_spmd_rule("reshape")
+def _reshape_rule(x: DistTensorSpec, shape=None):
+    """`reshape.cc` — map shardings through merged/split dim groups.
+
+    Supports the common cases: dims preserved 1:1, a group of input
+    dims merged into one output dim (sharding of the *leading* input
+    dim survives), one input dim split into several output dims
+    (sharding moves to the leading output dim, which must divide).
+    Anything more exotic degrades to replicated — a correct (if
+    conservative) placement, same as the reference's fallback.
+    """
+    in_shape = list(x.shape)
+    out_shape = list(shape)
+    # resolve a single -1
+    if -1 in out_shape:
+        known = 1
+        for d in out_shape:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in in_shape:
+            total *= d
+        out_shape[out_shape.index(-1)] = total // max(known, 1)
+
+    out_dm = [-1] * len(out_shape)
+    i = j = 0
+    while i < len(in_shape) and j < len(out_shape):
+        isz, osz = in_shape[i], out_shape[j]
+        if isz == osz:
+            out_dm[j] = x.dims_mapping[i]
+            i += 1
+            j += 1
+            continue
+        if isz < osz:
+            # merge group of input dims -> out dim j; leading in-dim sharding survives
+            lead = x.dims_mapping[i]
+            prod = isz
+            i += 1
+            while prod < osz and i < len(in_shape):
+                prod *= in_shape[i]
+                i += 1
+            if prod != osz:
+                return _replicated_fallback(x, out_shape)
+            out_dm[j] = lead
+            j += 1
+        else:
+            # split input dim i -> group of out dims; sharding moves to leading out dim
+            lead_out = j
+            prod = osz
+            j += 1
+            while prod < isz and j < len(out_shape):
+                prod *= out_shape[j]
+                j += 1
+            if prod != isz:
+                return _replicated_fallback(x, out_shape)
+            out_dm[lead_out] = x.dims_mapping[i]
+            i += 1
+    return [DistTensorSpec(x.shape, x.dims_mapping)], [
+        DistTensorSpec(out_shape, out_dm)
+    ]
+
+
+def _replicated_fallback(x, out_shape):
+    return [DistTensorSpec(x.shape, x.dims_mapping)], [
+        DistTensorSpec(out_shape, [-1] * len(out_shape))
+    ]
+
+
+@register_spmd_rule("squeeze")
+def _squeeze_rule(x: DistTensorSpec, axis=None):
+    nd = x.ndim
+    if axis is None:
+        axes = [i for i, s in enumerate(x.shape) if s == 1]
+    else:
+        axes = [a % nd for a in (axis if isinstance(axis, (list, tuple)) else [axis])]
+    letters = _letters(nd)
+    sub = "".join("1" if i in axes else c for i, c in enumerate(letters))
+    out = "".join(c for i, c in enumerate(letters) if i not in axes)
+    return einsum_infer(f"{sub}->{out}", [x])
+
+
+@register_spmd_rule("unsqueeze")
+def _unsqueeze_rule(x: DistTensorSpec, axis=0):
+    axes = sorted(
+        a % (x.ndim + 1)
+        for a in (axis if isinstance(axis, (list, tuple)) else [axis])
+    )
+    out_dm = []
+    out_shape = []
+    i = 0
+    nd_out = x.ndim + len(axes)
+    for d in range(nd_out):
+        if d in axes:
+            out_dm.append(-1)
+            out_shape.append(1)
+        else:
+            out_dm.append(x.dims_mapping[i])
+            out_shape.append(x.shape[i])
+            i += 1
+    return [DistTensorSpec(x.shape, x.dims_mapping)], [DistTensorSpec(out_shape, out_dm)]
+
+
+@register_spmd_rule("concat")
+def _concat_rule(*specs, axis=0):
+    """`concat.cc` — the concat axis must be replicated (ragged shards
+    otherwise); other dims merge across inputs."""
+    nd = specs[0].ndim
+    axis %= nd
+    letters = _letters(nd)
+    sub = "".join("*" if i == axis else c for i, c in enumerate(letters))
+    notation = ",".join([sub] * len(specs)) + f"->{sub}"
+    return einsum_infer(notation, list(specs))
+
+
+@register_spmd_rule("split")
+def _split_rule(x: DistTensorSpec, num_or_sections=2, axis=0):
+    nd = x.ndim
+    axis %= nd
+    letters = _letters(nd)
+    sub = "".join("*" if i == axis else c for i, c in enumerate(letters))
+    n = (
+        num_or_sections
+        if isinstance(num_or_sections, int)
+        else len(num_or_sections)
+    )
+    notation = sub + "->" + ",".join([sub] * n)
+    return einsum_infer(notation, [x])
+
+
+@register_spmd_rule("slice")
+def _slice_rule(x: DistTensorSpec, axes=()):
+    """Sliced axes must be replicated (a shard boundary may bisect the
+    slice); others pass through."""
+    nd = x.ndim
+    ax = {a % nd for a in axes}
+    letters = _letters(nd)
+    sub = "".join("*" if i in ax else c for i, c in enumerate(letters))
+    return einsum_infer(f"{sub}->{sub}", [x])
+
+
+@register_spmd_rule("stack")
+def _stack_rule(*specs, axis=0):
+    nd = specs[0].ndim
+    axis %= nd + 1
+    letters = _letters(nd)
+    notation = ",".join([letters] * len(specs)) + "->" + letters[:axis] + "1" + letters[axis:]
+    ins, outs = einsum_infer(notation, list(specs))
+    return ins, outs
+
+
+@register_spmd_rule("tile")
+def _tile_rule(x: DistTensorSpec, repeat_times=()):
+    """Tiled axes must be replicated."""
+    nd = x.ndim
+    rep = list(repeat_times)
+    rep = [1] * (nd - len(rep)) + rep[-nd:] if len(rep) <= nd else rep[-nd:]
+    letters = _letters(nd)
+    sub = "".join("*" if rep[i] != 1 else c for i, c in enumerate(letters))
+    return einsum_infer(f"{sub}->{sub}", [x])
+
+
+# -- indexing ----------------------------------------------------------------
+@register_spmd_rule("gather")
+def _gather_rule(x: DistTensorSpec, index: DistTensorSpec, axis=0):
+    """Gather along ``axis``: that axis of x must be replicated (the
+    lookup crosses shard boundaries); index dims replace it."""
+    nd = x.ndim
+    axis %= nd
+    letters = _letters(nd)
+    idx_letters = _letters(index.ndim, skip=letters)
+    x_sub = "".join("*" if i == axis else c for i, c in enumerate(letters))
+    out = x_sub[:axis] + idx_letters + x_sub[axis + 1:]
+    return einsum_infer(f"{x_sub},{idx_letters}->{out}", [x, index])
+
+
+@register_spmd_rule("scatter")
+def _scatter_rule(x: DistTensorSpec, index: DistTensorSpec, updates: DistTensorSpec, axis=0):
+    nd = x.ndim
+    axis %= nd
+    letters = _letters(nd)
+    x_sub = "".join("*" if i == axis else c for i, c in enumerate(letters))
+    upd_sub = x_sub
+    idx_sub = "*" * index.ndim
+    return einsum_infer(f"{x_sub},{idx_sub},{upd_sub}->{x_sub}", [x, index, updates])
+
+
+# -- losses ------------------------------------------------------------------
+@register_spmd_rule("cross_entropy_with_softmax")
+def _ce_rule(logits: DistTensorSpec, label: DistTensorSpec, axis=-1):
+    """`cross_entropy_with_softmax.cc:36`: a vocab-sharded logit keeps
+    its sharding and the loss comes out *partial* over that mesh dim —
+    the ParallelCrossEntropy pattern (max/sum over local vocab +
+    allreduce). Returns (softmax_out, loss)."""
+    nd = logits.ndim
+    axis %= nd
+    letters = _letters(nd, skip="v")
+    lg = letters[:axis] + "v" + letters[axis:nd - 1]
+    lead = lg.replace("v", "")
+    lbl = lead if label.ndim == nd - 1 else lead + "1"
+    v_mesh = logits.dims_mapping[axis]
+    ins, outs = einsum_infer(f"{lg},{lbl}->{lg},{lead}", [logits, label])
+    if v_mesh >= 0:
+        # keep the vocab sharding on the input (einsum_infer already
+        # does) and mark the reduced loss partial over it
+        ins[0].dims_mapping[axis] = v_mesh
+        outs[0].dims_mapping[axis] = v_mesh
+        outs[1].partial_dims.add(v_mesh)
+    return ins, outs
+
+
+# -- attention ---------------------------------------------------------------
+@register_spmd_rule("flash_attention")
+def _flash_attention_rule(
+    q: DistTensorSpec,
+    k: DistTensorSpec,
+    v: DistTensorSpec,
+    causal=True,
+    context_parallel=False,
+):
+    """`flash_attention.cc` redesigned for the TPU layouts:
+
+    [b, s, n, d]: batch over dp, heads over mp; head_dim must be
+    replicated. The kv sequence dim must be replicated *unless* the
+    caller runs ring attention (context_parallel=True), where the
+    q-sequence sharding is kept and kv blocks rotate over the sep axis.
+    """
+    # q: b s n d ; k/v: b t m d (m = kv heads, GQA-merged with n)
+    q_sub, k_sub, v_sub = "bsnd", "btnd", "btnd"
+    if context_parallel:
+        # ring attention: kv seq sharding equals q seq sharding (blocks
+        # rotate via ppermute outside this op)
+        k_sub = v_sub = "bsnd"
+    # head_dim always replicated
+    q_sub = q_sub[:3] + "*"
+    k_sub = k_sub[:3] + "*"
+    v_sub = v_sub[:3] + "*"
+    if not context_parallel:
+        # kv sequence must be whole for plain softmax
+        k_sub = k_sub[0] + "*" + k_sub[2:]
+        v_sub = v_sub[0] + "*" + v_sub[2:]
+    ins, outs = einsum_infer(f"{q_sub},{k_sub},{v_sub}->{q_sub}", [q, k, v])
+    return ins, outs
+
+
+# -- MoE ---------------------------------------------------------------------
+@register_spmd_rule("moe_gate")
+def _moe_gate_rule(x: DistTensorSpec, gate_w: DistTensorSpec):
+    """Gating logits [s, e]: token dim keeps its (dp) sharding, the
+    expert dim replicated (every rank routes against all experts)."""
+    return einsum_infer("sd,d*->s*", [x, gate_w])
+
+
+@register_spmd_rule("moe_dispatch")
+def _moe_dispatch_rule(x: DistTensorSpec, ep_mesh_dim=None):
+    """Dispatched tokens [e, c, d]: expert dim sharded over the "ep"
+    mesh dim (`moe_sublayers` dispatch → all_to_all over ep); capacity
+    and feature dims replicated. Token input must be replicated over ep
+    (each rank contributes its tokens via the all_to_all)."""
+    in_dm = list(x.dims_mapping)
+    if ep_mesh_dim is not None:
+        in_dm = [-1 if m == ep_mesh_dim else m for m in in_dm]
+    out_dm = [ep_mesh_dim if ep_mesh_dim is not None else -1, -1, -1]
+    return (
+        [DistTensorSpec(x.shape, in_dm)],
+        [DistTensorSpec([0, 0, 0], out_dm)],
+    )
+
+
+# -- misc passthroughs -------------------------------------------------------
+@register_spmd_rule("dropout")
+def _dropout_rule(x: DistTensorSpec, p=0.5):
+    sub = _letters(x.ndim)
+    return einsum_infer(f"{sub}->{sub}", [x])
+
+
+@register_spmd_rule("triu")
+def _triu_rule(x: DistTensorSpec, diagonal=0):
+    sub = _letters(x.ndim)
+    return einsum_infer(f"{sub}->{sub}", [x])
+
+
+@register_spmd_rule("cumsum")
+def _cumsum_rule(x: DistTensorSpec, axis=-1):
+    nd = x.ndim
+    axis %= nd
+    letters = _letters(nd)
+    sub = "".join("*" if i == axis else c for i, c in enumerate(letters))
+    return einsum_infer(f"{sub}->{sub}", [x])
+
+
+@register_spmd_rule("topk")
+def _topk_rule(x: DistTensorSpec, k=1, axis=-1):
+    nd = x.ndim
+    axis %= nd
+    letters = _letters(nd)
+    sub = "".join("*" if i == axis else c for i, c in enumerate(letters))
+    return einsum_infer(f"{sub}->{sub},{sub}", [x])
+
+
+@register_spmd_rule("argmax")
+def _argmax_rule(x: DistTensorSpec, axis=-1, keepdim=False):
+    return _reduction_rule(x, axis=axis, keepdim=keepdim, reduce_type="max")
+
+
+# ---------------------------------------------------------------------------
+# application: bind a rule's decision inside jit
+# ---------------------------------------------------------------------------
+def constrain(op_name, mesh, out, *specs, **attrs):
+    """Apply ``get_spmd_rule(op_name)``'s inferred output placement to
+    ``out`` as a sharding constraint on ``mesh`` (a ProcessMesh).
+
+    The partial state cannot be expressed to with_sharding_constraint —
+    partial outputs are constrained *resolved* (replicated over the
+    pending dim), which makes XLA insert the allreduce exactly where
+    the reference inserts its c_allreduce_sum.
+    """
+    from .auto_parallel import shard_activation
+
+    _, outs = get_spmd_rule(op_name).infer_forward(*specs, **attrs)
+    spec = outs[0].partition_spec(mesh.dim_names)
+    return shard_activation(out, mesh=mesh, spec=spec)
+
+
+def spec_for(op_name, mesh, *specs, **attrs) -> PartitionSpec:
+    """Rule-inferred PartitionSpec of the first output (resolved)."""
+    _, outs = get_spmd_rule(op_name).infer_forward(*specs, **attrs)
+    return outs[0].partition_spec(mesh.dim_names)

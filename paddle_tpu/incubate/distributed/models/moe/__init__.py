@@ -199,14 +199,27 @@ class MoELayer(nn.Layer):
                 xa, idxa, vala, self.num_expert, cap
             )
             if ep_axis is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
                 from paddle_tpu.distributed.auto_parallel import get_mesh
+                from paddle_tpu.distributed.spmd_rules import (
+                    DistTensorSpec,
+                    constrain,
+                    constraints_enabled,
+                )
 
                 mesh = get_mesh()
-                if mesh is not None and ep_axis in mesh.dim_names:
-                    ei = jax.lax.with_sharding_constraint(
-                        ei, NamedSharding(mesh.jax_mesh, P(ep_axis))
+                if (
+                    mesh is not None
+                    and ep_axis in mesh.dim_names
+                    and constraints_enabled()
+                ):
+                    # spmd rule `moe_dispatch`: expert dim over ep, tokens
+                    # contributed via all_to_all (spmd_rules.py)
+                    ei = constrain(
+                        "moe_dispatch",
+                        mesh,
+                        ei,
+                        DistTensorSpec(list(xa.shape), [-1] * xa.ndim),
+                        ep_mesh_dim=mesh.dim_names.index(ep_axis),
                     )
             return ei, comb
 

@@ -19,6 +19,7 @@ from ...core.dispatch import apply_op
 
 def _xla_sdpa(q, k, v, mask=None, causal=False, dropout=0.0, scale=None, key=None):
     """Reference attention in pure XLA: [B, S, H, D] layout."""
+    q, k, v = _constrain_heads_over_mp(q, k, v)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     # [B,H,S,D]
@@ -56,6 +57,50 @@ def _use_pallas(q_shape):
     return supported_seq(s) and d <= 256
 
 
+def _constrain_heads_over_mp(q, k, v):
+    """spmd rule `flash_attention` (distributed/spmd_rules.py): shard the
+    heads dim over "mp", never seq_kv or head_dim. Binds the Megatron
+    attention layout inside jit instead of trusting propagation (the
+    explicit analogue of `flash_attn_spmd_rule`)."""
+    from ...distributed.auto_parallel import get_mesh
+    from ...distributed.fleet import get_fleet_mesh
+    from ...distributed.spmd_rules import constraints_enabled
+
+    mesh = get_fleet_mesh() or get_mesh()
+    mp_size = (
+        mesh.get_dim_size("mp")
+        if mesh is not None and "mp" in mesh.dim_names
+        else 1
+    )
+    if mp_size == 1 or q.ndim != 4 or not constraints_enabled():
+        return q, k, v
+    from jax.sharding import PartitionSpec
+
+    from ...distributed.auto_parallel import shard_activation
+    from ...distributed.spmd_rules import DistTensorSpec, get_spmd_rule
+
+    mp = mesh.dim_names.index("mp")
+    specs = [DistTensorSpec(list(t.shape), [-1, -1, mp, -1]) for t in (q, k, v)]
+    ins, _ = get_spmd_rule("flash_attention").infer_forward(*specs)
+    # Pin only the semantic dims the rule decides: heads over "mp",
+    # head_dim replicated. Batch and seq stay UNCONSTRAINED so GSPMD keeps
+    # whatever dp/sharding/sep layout the surrounding program chose (sep
+    # shards the sequence dim; forcing it here would gather the sequence).
+    # GQA: constrain each tensor independently — an MQA/GQA kv with
+    # indivisible heads is skipped while q still gets pinned.
+    U = PartitionSpec.UNCONSTRAINED
+    out = []
+    for t, s in zip((q, k, v), ins):
+        if t.shape[2] % mp_size != 0:
+            out.append(t)
+            continue
+        rule_spec = s.partition_spec(mesh.dim_names)
+        ext = list(rule_spec) + [None] * (4 - len(rule_spec))
+        spec = PartitionSpec(U, U, ext[2], ext[3])
+        out.append(shard_activation(t, mesh=mesh, spec=spec))
+    return tuple(out)
+
+
 def sdpa_arrays(q, k, v, causal=True, scale=None):
     """Array-level attention: pallas flash kernel when eligible, XLA fallback.
 
@@ -63,6 +108,7 @@ def sdpa_arrays(q, k, v, causal=True, scale=None):
     model paths (models/gpt.py stacked decoder)."""
     from ...ops.pallas import log_path_once
 
+    q, k, v = _constrain_heads_over_mp(q, k, v)
     if _use_pallas(q.shape):
         try:
             from ...ops.pallas import flash_attention as _fa_kernel

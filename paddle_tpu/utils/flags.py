@@ -77,3 +77,6 @@ define_flag("benchmark", False, "synchronous timing mode")
 define_flag("use_pallas_kernels", True, "use Pallas kernels for fused ops on TPU")
 define_flag("allocator_strategy", "xla", "memory allocator strategy (XLA-managed)")
 define_flag("tpu_matmul_precision", "default", "jax matmul precision")
+define_flag("spmd_rule_constraints", True,
+            "insert per-op spmd-rule sharding constraints (embedding/"
+            "attention/moe) when a hybrid mesh is active")

@@ -1,0 +1,341 @@
+"""Per-op SPMD rule tests — placement in, placement out, no devices.
+
+Parity: `test/auto_parallel/spmd_rules/test_matmul_rule.py` (and the
+sibling rule tests). dims_mapping convention identical to the
+reference: mesh-dim index per tensor dim, -1 = replicated.
+"""
+import pytest
+
+from paddle_tpu.distributed.spmd_rules import (
+    DistTensorSpec,
+    get_spmd_rule,
+)
+
+
+def dm(spec):
+    return spec.dims_mapping
+
+
+class TestMatmulRule:
+    """The exact cases of test_matmul_rule.py:34-120."""
+
+    def infer(self, x_dm, y_dm, trans_x=False, trans_y=False, x_shape=(64, 32), y_shape=(32, 48)):
+        rule = get_spmd_rule("matmul")
+        x = DistTensorSpec(x_shape, x_dm)
+        y = DistTensorSpec(y_shape, y_dm)
+        return rule.infer_forward(x, y, trans_x=trans_x, trans_y=trans_y)
+
+    def test_mk_kn_contracted_partial(self):
+        # mk[1, 0] x kn[0, -1] -> mn[1, -1], partial {0}
+        ins, outs = self.infer([1, 0], [0, -1])
+        assert dm(ins[0]) == [1, 0]
+        assert dm(ins[1]) == [0, -1]
+        assert dm(outs[0]) == [1, -1]
+        assert outs[0]._is_partial()
+        assert outs[0]._partial_dims() == {0}
+
+    def test_row_parallel_no_partial(self):
+        # mk[1, -1] x kn[-1, -1] -> mn[1, -1], no partial
+        ins, outs = self.infer([1, -1], [-1, -1])
+        assert dm(outs[0]) == [1, -1]
+        assert not outs[0]._is_partial()
+
+    def test_col_parallel(self):
+        # mk[-1, -1] x kn[-1, 0] -> mn[-1, 0]
+        _, outs = self.infer([-1, -1], [-1, 0])
+        assert dm(outs[0]) == [-1, 0]
+        assert not outs[0]._is_partial()
+
+    def test_conflict_resolution_first_wins(self):
+        # mk[1, 0] x kn[1, 0]: mesh dim 1 claimed by both m and k'... the
+        # merge keeps m=1 (first), unshards y's k-claim of 1; k merges to 0.
+        ins, outs = self.infer([1, 0], [1, 0])
+        assert dm(ins[0]) == [1, 0]
+        assert dm(ins[1]) == [0, -1]  # k corrected to merged 0, n loses 0 (taken)
+        assert dm(outs[0]) == [1, -1]
+        assert outs[0]._partial_dims() == {0}
+
+    def test_trans_y(self):
+        # mk[-1, 0] x nk[1, 0] (trans_y) -> mn[-1, 1], partial {0}
+        ins, outs = self.infer([-1, 0], [1, 0], trans_y=True, y_shape=(48, 32))
+        assert dm(outs[0]) == [-1, 1]
+        assert outs[0]._partial_dims() == {0}
+
+    def test_batched_matmul(self):
+        # bmk[0, -1, -1] x bkn[0, -1, 1] -> bmn[0, -1, 1]
+        _, outs = self.infer(
+            [0, -1, -1], [0, -1, 1], x_shape=(8, 64, 32), y_shape=(8, 32, 48)
+        )
+        assert dm(outs[0]) == [0, -1, 1]
+
+    def test_vec_matmul(self):
+        # k[-1] x kn[-1, 0] -> n[0]
+        _, outs = self.infer([-1], [-1, 0], x_shape=(32,))
+        assert dm(outs[0]) == [0]
+
+
+class TestElementwiseRule:
+    def test_broadcast_merge(self):
+        rule = get_spmd_rule("elementwise")
+        x = DistTensorSpec([8, 1, 32], [0, -1, -1])
+        y = DistTensorSpec([16, 32], [1, -1])
+        ins, outs = rule.infer_forward(x, y)
+        # out [8, 16, 32]: batch from x (0), middle from y (1)
+        assert dm(outs[0]) == [0, 1, -1]
+        # x's size-1 middle dim stays replicated
+        assert dm(ins[0]) == [0, -1, -1]
+
+    def test_sharding_propagates_to_unsharded_input(self):
+        rule = get_spmd_rule("elementwise")
+        x = DistTensorSpec([8, 32], [0, 1])
+        y = DistTensorSpec([8, 32], [-1, -1])
+        ins, _ = rule.infer_forward(x, y)
+        assert dm(ins[1]) == [0, 1]
+
+
+class TestEmbeddingRule:
+    def test_vocab_parallel_partial_output(self):
+        # ids [b, s] dp-sharded; weight [V, H] vocab-sharded over mp(=1)
+        rule = get_spmd_rule("embedding")
+        ids = DistTensorSpec([4, 128], [0, -1])
+        w = DistTensorSpec([50304, 1024], [1, -1])
+        ins, outs = rule.infer_forward(ids, w)
+        assert dm(ins[1]) == [1, -1]          # table keeps vocab sharding
+        assert dm(outs[0]) == [0, -1, -1]      # [b, s, h]
+        assert outs[0]._partial_dims() == {1}  # pending allreduce over mp
+
+    def test_col_sharded_table_no_partial(self):
+        rule = get_spmd_rule("embedding")
+        ids = DistTensorSpec([4, 128], [0, -1])
+        w = DistTensorSpec([50304, 1024], [-1, 1])
+        _, outs = rule.infer_forward(ids, w)
+        assert dm(outs[0]) == [0, -1, 1]
+        assert not outs[0]._is_partial()
+
+
+class TestReductionRule:
+    def test_sum_sharded_axis_partial(self):
+        rule = get_spmd_rule("reduction")
+        x = DistTensorSpec([8, 32], [0, 1])
+        ins, outs = rule.infer_forward(x, axis=1, reduce_type="sum")
+        assert dm(outs[0]) == [0]
+        assert outs[0]._partial_dims() == {1}
+
+    def test_max_unshards_axis(self):
+        rule = get_spmd_rule("reduction")
+        x = DistTensorSpec([8, 32], [0, 1])
+        ins, outs = rule.infer_forward(x, axis=1, reduce_type="max")
+        assert dm(ins[0]) == [0, -1]  # max can't be partial: unshard
+        assert dm(outs[0]) == [0]
+        assert not outs[0]._is_partial()
+
+    def test_keepdim(self):
+        rule = get_spmd_rule("reduction")
+        x = DistTensorSpec([8, 32], [0, -1])
+        _, outs = rule.infer_forward(x, axis=1, keepdim=True)
+        assert dm(outs[0]) == [0, -1]
+
+
+class TestSoftmaxNormRules:
+    def test_softmax_axis_replicated(self):
+        rule = get_spmd_rule("softmax")
+        x = DistTensorSpec([4, 16, 1024], [0, 1, 2])
+        ins, outs = rule.infer_forward(x, axis=-1)
+        assert dm(ins[0]) == [0, 1, -1]
+        assert dm(outs[0]) == [0, 1, -1]
+
+    def test_layer_norm(self):
+        rule = get_spmd_rule("layer_norm")
+        x = DistTensorSpec([4, 128, 1024], [0, 1, 2])
+        scale = DistTensorSpec([1024], [-1])
+        bias = DistTensorSpec([1024], [-1])
+        ins, outs = rule.infer_forward(x, scale, bias, begin_norm_axis=2)
+        assert dm(ins[0]) == [0, 1, -1]   # normalized dim unsharded
+        assert dm(outs[0]) == [0, 1, -1]
+        assert dm(outs[1]) == [0, 1]       # mean
+        assert dm(outs[2]) == [0, 1]       # variance
+
+
+class TestShapeRules:
+    def test_transpose(self):
+        rule = get_spmd_rule("transpose")
+        x = DistTensorSpec([4, 8, 16], [0, -1, 1])
+        _, outs = rule.infer_forward(x, perm=[2, 0, 1])
+        assert dm(outs[0]) == [1, 0, -1]
+
+    def test_reshape_merge(self):
+        rule = get_spmd_rule("reshape")
+        # [4, 128, 16, 64] dp on 0, mp on 2 -> [4, 128, 1024]: heads*dim merge,
+        # leading (head) sharding survives on the merged dim
+        x = DistTensorSpec([4, 128, 16, 64], [0, -1, 1, -1])
+        _, outs = rule.infer_forward(x, shape=[4, 128, 1024])
+        assert dm(outs[0]) == [0, -1, 1]
+
+    def test_reshape_split(self):
+        rule = get_spmd_rule("reshape")
+        # [4, 128, 1024] -> [4, 128, 16, 64]: sharding moves to leading out dim
+        x = DistTensorSpec([4, 128, 1024], [0, -1, 1])
+        _, outs = rule.infer_forward(x, shape=[4, 128, 16, 64])
+        assert dm(outs[0]) == [0, -1, 1, -1]
+
+    def test_reshape_minus_one(self):
+        rule = get_spmd_rule("reshape")
+        x = DistTensorSpec([4, 128, 1024], [0, -1, -1])
+        _, outs = rule.infer_forward(x, shape=[-1, 1024])
+        assert dm(outs[0]) == [0, -1]
+
+    def test_concat_axis_replicated(self):
+        rule = get_spmd_rule("concat")
+        a = DistTensorSpec([4, 8], [0, 1])
+        b = DistTensorSpec([4, 8], [0, 1])
+        ins, outs = rule.infer_forward(a, b, axis=1)
+        assert dm(ins[0]) == [0, -1]
+        assert dm(outs[0]) == [0, -1]
+
+    def test_split_axis_replicated(self):
+        rule = get_spmd_rule("split")
+        x = DistTensorSpec([4, 8], [0, 1])
+        ins, outs = rule.infer_forward(x, num_or_sections=2, axis=1)
+        assert len(outs) == 2
+        assert dm(outs[0]) == [0, -1]
+
+    def test_unsqueeze(self):
+        rule = get_spmd_rule("unsqueeze")
+        x = DistTensorSpec([4, 8], [0, 1])
+        _, outs = rule.infer_forward(x, axis=1)
+        assert dm(outs[0]) == [0, -1, 1]
+        assert outs[0].shape == [4, 1, 8]
+
+
+class TestLossAttentionMoERules:
+    def test_parallel_cross_entropy(self):
+        rule = get_spmd_rule("cross_entropy_with_softmax")
+        logits = DistTensorSpec([4, 128, 50304], [0, -1, 1])  # vocab over mp
+        label = DistTensorSpec([4, 128], [0, -1])
+        ins, outs = rule.infer_forward(logits, label, axis=-1)
+        assert dm(ins[0]) == [0, -1, 1]     # vocab sharding KEPT
+        assert dm(outs[1]) == [0, -1]        # loss [b, s]
+        assert outs[1]._partial_dims() == {1}
+
+    def test_flash_attention_heads_over_mp(self):
+        rule = get_spmd_rule("flash_attention")
+        q = DistTensorSpec([4, 2048, 16, 64], [0, -1, 1, -1])
+        k = DistTensorSpec([4, 2048, 16, 64], [0, -1, 1, -1])
+        v = DistTensorSpec([4, 2048, 16, 64], [0, -1, 1, -1])
+        ins, outs = rule.infer_forward(q, k, v)
+        assert dm(outs[0]) == [0, -1, 1, -1]
+        # kv seq must be replicated in the non-ring path
+        assert dm(ins[1]) == [0, -1, 1, -1]
+
+    def test_flash_attention_rejects_head_dim_shard(self):
+        rule = get_spmd_rule("flash_attention")
+        q = DistTensorSpec([4, 2048, 16, 64], [0, -1, -1, 1])  # head_dim sharded: wrong
+        k = DistTensorSpec([4, 2048, 16, 64], [-1, -1, -1, -1])
+        v = DistTensorSpec([4, 2048, 16, 64], [-1, -1, -1, -1])
+        ins, outs = rule.infer_forward(q, k, v)
+        assert dm(ins[0]) == [0, -1, -1, -1]  # head_dim forcibly replicated
+        assert dm(outs[0]) == [0, -1, -1, -1]
+
+    def test_flash_attention_context_parallel_keeps_seq(self):
+        rule = get_spmd_rule("flash_attention")
+        q = DistTensorSpec([4, 2048, 16, 64], [-1, 2, 1, -1])  # seq over sep
+        k = DistTensorSpec([4, 2048, 16, 64], [-1, 2, 1, -1])
+        v = DistTensorSpec([4, 2048, 16, 64], [-1, 2, 1, -1])
+        ins, outs = rule.infer_forward(q, k, v, context_parallel=True)
+        assert dm(outs[0]) == [-1, 2, 1, -1]
+        assert dm(ins[1]) == [-1, 2, 1, -1]
+
+    def test_moe_dispatch(self):
+        rule = get_spmd_rule("moe_dispatch")
+        x = DistTensorSpec([4096, 1024], [2, -1])  # tokens sharded over ep(=2)
+        ins, outs = rule.infer_forward(x, ep_mesh_dim=2)
+        assert dm(ins[0]) == [-1, -1]   # tokens contributed via all_to_all
+        assert dm(outs[0]) == [2, -1, -1]  # expert dim over ep
+
+
+class TestIndexingRules:
+    def test_gather_axis_replicated(self):
+        rule = get_spmd_rule("gather")
+        x = DistTensorSpec([100, 64], [0, 1])
+        idx = DistTensorSpec([32], [-1])
+        ins, outs = rule.infer_forward(x, idx, axis=0)
+        assert dm(ins[0]) == [-1, 1]
+        assert dm(outs[0]) == [-1, 1]
+
+    def test_scatter(self):
+        rule = get_spmd_rule("scatter")
+        x = DistTensorSpec([100, 64], [0, 1])
+        idx = DistTensorSpec([32], [-1])
+        upd = DistTensorSpec([32, 64], [-1, -1])
+        ins, outs = rule.infer_forward(x, idx, upd, axis=0)
+        assert dm(outs[0]) == [-1, 1]
+
+
+class TestRuleApplication:
+    """The rules bind as real sharding constraints on the 8-dev CPU mesh."""
+
+    def _fleet(self, dp=2, mp=2):
+        from paddle_tpu.distributed import fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        return fleet
+
+    def test_vocab_parallel_embedding_resolved_over_mp(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        fleet = self._fleet()
+        emb = fleet.VocabParallelEmbedding(64, 16)
+        ids = paddle.to_tensor(np.zeros((4, 8), np.int32))
+        out = emb(ids)
+        spec = out._data.sharding.spec
+        flat = [
+            a
+            for e in spec
+            if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))
+        ]
+        assert "mp" not in flat  # partial resolved: replicated over mp
+
+    def test_attention_heads_constrained_over_mp(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.functional.flash_attention import (
+            _constrain_heads_over_mp,
+        )
+
+        self._fleet()
+        q = jnp.zeros((2, 16, 4, 8), jnp.float32)
+        q2, k2, v2 = _constrain_heads_over_mp(q, q, q)
+        for t in (q2, k2, v2):
+            spec = list(t.sharding.spec)
+            spec += [None] * (4 - len(spec))
+            assert spec[2] == "mp"      # heads sharded over mp
+            assert spec[3] is None       # head_dim replicated
+
+    def test_attention_indivisible_heads_skips(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.functional.flash_attention import (
+            _constrain_heads_over_mp,
+        )
+
+        self._fleet()
+        q = jnp.zeros((2, 16, 3, 8), jnp.float32)  # 3 heads, mp=2
+        q2, _, _ = _constrain_heads_over_mp(q, q, q)
+        assert q2 is q
+
+
+class TestPartitionSpecExport:
+    def test_partition_spec(self):
+        s = DistTensorSpec([4, 8, 16], [0, -1, 2])
+        assert s.partition_spec(("dp", "mp", "pp")) == __import__(
+            "jax.sharding", fromlist=["PartitionSpec"]
+        ).PartitionSpec("dp", None, "pp")
+
+    def test_unknown_rule_raises(self):
+        with pytest.raises(KeyError):
+            get_spmd_rule("definitely_not_an_op")
