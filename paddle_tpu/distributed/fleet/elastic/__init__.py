@@ -1,16 +1,35 @@
-"""fleet.elastic — elastic training manager (parity: fleet/elastic/
-manager.py:125 ElasticManager over etcd leases).
+"""fleet.elastic — elastic training manager.
 
-TPU-native: heartbeats and membership live in the native TCPStore (no
-etcd in the image); fault tolerance is restart-from-checkpoint, driven by
-the launcher's --max_restart (launch/main.py), same recovery model as the
-reference (SURVEY §5 failure detection).
+Parity: `fleet/elastic/manager.py:125-520` (ElasticManager over etcd
+leases: node registration with TTL heartbeats, watch-driven membership
+change detection, scale in/out between `--nnodes lo:hi`, restart with a
+new world size, resume from checkpoint).
+
+TPU-native redesign: membership lives in the native TCPStore
+(core/native/store.cc) instead of etcd — heartbeat keys with timestamps
+stand in for leases, and a monotonically increasing **generation
+number** stands in for the etcd watch: any member that observes a
+generation bump stops, re-registers under the new generation, and gets
+a dense new rank. The launcher (`launch/main.py --elastic_level 2`)
+drives the process side: on worker death it re-launches the survivors
+with the shrunken world size (scale-in) as long as it stays >= the
+`--nnodes lo` bound; recovery of state is checkpoint-resume, same model
+as the reference (SURVEY §5 failure detection).
 """
 from __future__ import annotations
 
 import os
 import threading
 import time
+
+
+def _store_int(raw: bytes) -> int:
+    """Decode a store counter: ascii int (set) or the native store's
+    atomic-ADD 8-byte little-endian representation."""
+    try:
+        return int(raw)
+    except ValueError:
+        return int.from_bytes(raw, "little")
 
 
 class ElasticLevel:
@@ -27,13 +46,27 @@ class ElasticStatus:
 
 
 class ElasticManager:
-    def __init__(self, args=None, etcd_client=None, store=None):
+    """Store-backed membership with generation numbers.
+
+    Keys (all under ``elastic/``):
+      generation              int — bumped on every membership change
+      gen/{g}/members/{id}    heartbeat timestamp of member `id` in gen g
+      gen/{g}/rank            atomic counter for dense re-rank assignment
+      gen/{g}/world           world size frozen for generation g
+    """
+
+    def __init__(self, args=None, etcd_client=None, store=None,
+                 heartbeat_interval=1.0, heartbeat_timeout=6.0):
         self.args = args
         self._store = store
         self._stop = False
         self._hb = None
         self.host = os.environ.get("POD_IP", "127.0.0.1")
         self.np = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.member_id = os.environ.get(
+            "PADDLE_TRAINER_ID", f"{self.host}:{os.getpid()}")
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_timeout = heartbeat_timeout
         self.enabled = store is not None or (
             args is not None and getattr(args, "elastic_level", -1) > 0)
         if self.enabled and self._store is None:
@@ -45,38 +78,147 @@ class ElasticManager:
             self._store = TCPStore(host=host, port=int(port),
                                    is_master=(rank == 0), world_size=self.np)
 
-    def start_heartbeat(self, interval=2.0):
+    # -- generation ---------------------------------------------------------
+    def generation(self) -> int:
+        if not self.enabled:
+            return 0
+        raw = self._store.get("elastic/generation")
+        return _store_int(raw) if raw else 0
+
+    def bump_generation(self) -> int:
+        """Coordinator: announce a membership change. Returns the new gen."""
+        return self._store.add("elastic/generation", 1)
+
+    # -- membership ---------------------------------------------------------
+    def register(self, member_id=None, generation=None):
+        """Join the current generation's membership and start heartbeats."""
         if not self.enabled:
             return
+        if member_id is not None:
+            self.member_id = str(member_id)
+        gen = self.generation() if generation is None else generation
+        self._beat(gen)
+        if self._hb is None:
+            self._hb = threading.Thread(target=self._beat_loop, daemon=True)
+            self._hb.start()
 
-        def beat():
-            rank = os.environ.get("PADDLE_TRAINER_ID", "0")
-            while not self._stop:
-                self._store.set(f"elastic/beat/{rank}",
-                                str(time.time()).encode())
-                time.sleep(interval)
+    def _beat(self, gen):
+        self._store.set(
+            f"elastic/gen/{gen}/members/{self.member_id}",
+            str(time.time()).encode())
 
-        self._hb = threading.Thread(target=beat, daemon=True)
-        self._hb.start()
+    def _beat_loop(self):
+        while not self._stop:
+            try:
+                self._beat(self.generation())
+            except Exception:
+                return  # store gone: job is tearing down
+            time.sleep(self.heartbeat_interval)
+
+    def alive_members(self, gen=None, timeout=None):
+        """Member ids with a fresh heartbeat in generation `gen`."""
+        if not self.enabled:
+            return [str(r) for r in range(self.np)]
+        gen = self.generation() if gen is None else gen
+        timeout = timeout or self.heartbeat_timeout
+        now = time.time()
+        alive = []
+        for mid in self._member_ids(gen):
+            raw = self._store.get(f"elastic/gen/{gen}/members/{mid}")
+            if raw is not None and now - float(raw) < timeout:
+                alive.append(mid)
+        return sorted(alive)
+
+    def _member_ids(self, gen):
+        """Enumerate ids announced in `gen`: read the atomic slot counter,
+        then each slot key — no read-modify-write, so concurrent announces
+        can never drop a member."""
+        raw = self._store.get(f"elastic/gen/{gen}/roster_slots")
+        if raw is None:
+            return []
+        nslots = _store_int(raw)
+        ids = []
+        for s in range(1, nslots + 1):
+            v = self._store.get(f"elastic/gen/{gen}/roster/{s}")
+            if v:
+                ids.append(v.decode())
+        return sorted(set(ids))
+
+    def announce(self, gen=None):
+        """Claim an atomic roster slot for this member in generation `gen`."""
+        if not self.enabled:
+            return
+        gen = self.generation() if gen is None else gen
+        slot = self._store.add(f"elastic/gen/{gen}/roster_slots", 1)
+        self._store.set(f"elastic/gen/{gen}/roster/{slot}", self.member_id.encode())
+
+    # -- legacy round-1 API (kept: launcher + tests use it) -----------------
+    def start_heartbeat(self, interval=2.0):
+        self.heartbeat_interval = interval
+        self.register()
 
     def alive_ranks(self, timeout=10.0):
         if not self.enabled:
             return list(range(self.np))
-        now = time.time()
-        alive = []
-        for r in range(self.np):
-            raw = self._store.get(f"elastic/beat/{r}")
-            if raw is not None and now - float(raw) < timeout:
-                alive.append(r)
-        return alive
+        alive = self.alive_members(timeout=timeout)
+        out = []
+        for m in alive:
+            try:
+                out.append(int(m))
+            except ValueError:
+                out.append(m)
+        return out
 
     def should_restart(self):
-        return self.enabled and len(self.alive_ranks()) < self.np
+        return self.enabled and len(self.alive_members()) < self.np
+
+    # -- re-rendezvous ------------------------------------------------------
+    def membership_changed(self, known_generation) -> bool:
+        return self.generation() != known_generation
+
+    def wait_generation_change(self, known_generation, timeout=30.0):
+        """Block until the generation moves past `known_generation`."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            g = self.generation()
+            if g != known_generation:
+                return g
+            time.sleep(self.heartbeat_interval / 2)
+        return known_generation
+
+    def rerendezvous(self):
+        """Join the current generation and obtain a dense new rank.
+
+        Returns (new_rank, new_world, generation). The world size is
+        frozen by the coordinator (`freeze_world`); callers rebuild their
+        mesh from it and resume from the last checkpoint — the
+        capability the reference drives through manager.py:462 _match +
+        pod re-launch.
+        """
+        gen = self.generation()
+        self.announce(gen)
+        self.register(generation=gen)
+        new_rank = self._store.add(f"elastic/gen/{gen}/rank", 1) - 1
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            raw = self._store.get(f"elastic/gen/{gen}/world")
+            if raw:
+                return new_rank, _store_int(raw), gen
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"rerendezvous: coordinator never froze elastic/gen/{gen}/world "
+            f"— coordinator lost during membership change?")
+
+    def freeze_world(self, world, gen=None):
+        """Coordinator: fix the world size for a generation."""
+        gen = self.generation() if gen is None else gen
+        self._store.set(f"elastic/gen/{gen}/world", str(world).encode())
 
     def exit(self, completed=True):
         self._stop = True
         if self._hb is not None:
             self._hb.join(timeout=3)
+            self._hb = None
         if self._store is not None:
             self._store.close()
             self._store = None

@@ -81,6 +81,87 @@ def _rendezvous(master: str, rank: int, nnodes: int, job_id: str):
     return rank, store
 
 
+def _spawn_ranks(args, node_rank, nproc, world, script_args, generation=0):
+    """Spawn `nproc` local rank processes; returns (procs, logfiles)."""
+    procs, logs = [], []
+    for i in range(nproc):
+        rank = node_rank * nproc + i
+        env = dict(os.environ)
+        env.update(
+            PADDLE_TRAINER_ID=str(rank),
+            PADDLE_TRAINERS_NUM=str(world),
+            PADDLE_LOCAL_RANK=str(i),
+            PADDLE_NNODES=str(max(world // max(nproc, 1), 1)),
+            PADDLE_JOB_ID=args.job_id,
+            PADDLE_ELASTIC_GENERATION=str(generation),
+            FLAGS_selected_tpus=str(i),
+        )
+        if args.master:
+            env["PADDLE_MASTER"] = args.master
+        log_path = os.path.join(args.log_dir, f"{args.job_id}.{rank}.log")
+        lf = open(log_path, "ab")
+        logs.append(lf)
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script] + script_args,
+            env=env, stdout=lf, stderr=subprocess.STDOUT,
+        ))
+    return procs, logs
+
+
+def _launch_elastic(args, node_rank, nproc, min_world, script_args) -> None:
+    """Elastic (level 2) process supervision: scale-in re-rendezvous.
+
+    Capability parity: fleet/elastic/manager.py:462 `_match` + pod
+    relaunch — on member death the job does NOT abort: the survivors are
+    re-launched as a new *generation* with the shrunken world size (as
+    long as it stays >= the `--nnodes lo` bound), and training resumes
+    from checkpoint. Generation numbers reach workers via
+    PADDLE_ELASTIC_GENERATION.
+    """
+    world = nproc
+    generation = 0
+    relaunches = 0
+    while True:
+        procs, logs = _spawn_ranks(args, node_rank, world, world,
+                                   script_args, generation)
+        # supervise: a dead member must trigger re-rendezvous IMMEDIATELY —
+        # survivors may be blocked in a collective waiting for it, so
+        # waiting for all ranks to exit would deadlock the job
+        codes = [None] * world
+        while any(c is None for c in codes):
+            time.sleep(0.2)
+            codes = [p.poll() for p in procs]
+            if any(c is not None and c != 0 for c in codes):
+                for p, c in zip(procs, codes):
+                    if c is None:
+                        p.terminate()
+                for p in procs:
+                    p.wait()
+                codes = [p.returncode for p in procs]
+                break
+        for lf in logs:
+            lf.close()
+        if all(c == 0 for c in codes):
+            return
+        # terminated survivors (negative returncode from our SIGTERM) are
+        # still members; only self-failed ranks count as dead
+        n_dead = sum(1 for c in codes if c is not None and c > 0)
+        n_dead = max(n_dead, 1)
+        new_world = world - n_dead
+        relaunches += 1
+        if new_world < min_world or relaunches > args.max_restart:
+            sys.stderr.write(
+                f"elastic: cannot continue (world {world} -> {new_world}, "
+                f"min {min_world}, relaunch {relaunches}/{args.max_restart})\n")
+            sys.exit(next((c for c in codes if c and c > 0), 1))
+        generation += 1
+        sys.stderr.write(
+            f"elastic: {n_dead} member(s) lost; re-rendezvous generation "
+            f"{generation} with world {new_world}\n")
+        world = new_world
+        time.sleep(0.5)
+
+
 def launch() -> None:
     args = _parse()
     nnodes = _nnodes(args.nnodes)
@@ -95,31 +176,14 @@ def launch() -> None:
     os.makedirs(args.log_dir, exist_ok=True)
     script_args = [a for a in args.training_script_args if a != "--"]
 
-    for attempt in range(args.max_restart + 1):
-        procs = []
-        logs = []
-        for i in range(nproc):
-            rank = node_rank * nproc + i
-            env = dict(os.environ)
-            env.update(
-                PADDLE_TRAINER_ID=str(rank),
-                PADDLE_TRAINERS_NUM=str(world),
-                PADDLE_LOCAL_RANK=str(i),
-                PADDLE_NNODES=str(nnodes),
-                PADDLE_JOB_ID=args.job_id,
-                FLAGS_selected_tpus=str(i),
-            )
-            if args.master:
-                env["PADDLE_MASTER"] = args.master
-            log_path = os.path.join(
-                args.log_dir, f"{args.job_id}.{rank}.log")
-            lf = open(log_path, "ab")
-            logs.append(lf)
-            procs.append(subprocess.Popen(
-                [sys.executable, args.training_script] + script_args,
-                env=env, stdout=lf, stderr=subprocess.STDOUT,
-            ))
+    if args.elastic_level >= 2 and nnodes == 1:
+        _launch_elastic(args, node_rank, nproc, nnodes, script_args)
+        if store is not None:
+            store.close()
+        return
 
+    for attempt in range(args.max_restart + 1):
+        procs, logs = _spawn_ranks(args, node_rank, nproc, world, script_args)
         codes = [p.wait() for p in procs]
         for lf in logs:
             lf.close()
@@ -139,7 +203,7 @@ def launch() -> None:
                                 f.read()[-2000:].decode(errors="replace"))
                     except OSError:
                         pass
-            sys.exit(max(codes))
+            sys.exit(next((c for c in codes if c and c > 0), 1))
         time.sleep(1.0)
 
     if store is not None:

@@ -147,6 +147,9 @@ class TCPStore:
                 port = self._server.port
         self.port = port
         self._sock = None
+        # one request/response in flight per client: heartbeat threads
+        # (fleet.elastic) share the store with the main thread
+        self._lock = threading.Lock()
         self._connect()
 
     @property
@@ -171,11 +174,12 @@ class TCPStore:
     def _req(self, cmd, key, val=b""):
         k = key.encode()
         msg = struct.pack("<BI", cmd, len(k)) + k + struct.pack("<Q", len(val)) + val
-        self._sock.sendall(msg)
-        (vlen,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
-        if vlen == _MISS:
-            return None
-        return _recv_exact(self._sock, vlen) if vlen else b""
+        with self._lock:
+            self._sock.sendall(msg)
+            (vlen,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+            if vlen == _MISS:
+                return None
+            return _recv_exact(self._sock, vlen) if vlen else b""
 
     def set(self, key, value):
         if isinstance(value, str):
@@ -189,8 +193,19 @@ class TCPStore:
         out = self._req(_CMD_ADD, key, struct.pack("<q", int(amount)))
         return struct.unpack("<q", out)[0]
 
-    def wait(self, key):
-        return self._req(_CMD_WAIT, key)
+    def wait(self, key, timeout=None):
+        """Block until `key` exists. Client-side poll (get + sleep) rather
+        than the server's blocking WAIT: the per-client lock is released
+        between probes, so threads sharing this store (e.g. the elastic
+        heartbeat) are not starved for the duration."""
+        deadline = time.time() + (timeout if timeout is not None else self.timeout)
+        while True:
+            val = self._req(_CMD_GET, key)
+            if val is not None:
+                return val
+            if time.time() >= deadline:
+                raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+            time.sleep(0.02)
 
     def delete_key(self, key):
         self._req(_CMD_DEL, key)

@@ -1,0 +1,33 @@
+"""Elastic scale-in worker: trains (simulated) to step 6 with
+checkpoint-resume; the last rank of generation 0 dies at step 3 to force
+the launcher's elastic re-rendezvous.
+"""
+import json
+import os
+import sys
+import time
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+gen = int(os.environ.get("PADDLE_ELASTIC_GENERATION", 0))
+
+CKPT = "ckpt.json"
+TARGET = 6
+
+start = 0
+if os.path.exists(CKPT):
+    with open(CKPT) as f:
+        start = json.load(f)["step"]
+
+for step in range(start + 1, TARGET + 1):
+    time.sleep(0.05)  # a "training step"
+    if rank == 0:  # coordinator checkpoints
+        tmp = CKPT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "gen": gen, "world": world}, f)
+        os.replace(tmp, CKPT)
+    if gen == 0 and rank == world - 1 and step == 3:
+        sys.stderr.write(f"rank {rank} simulating member death at step {step}\n")
+        sys.exit(1)
+
+print(f"ELASTIC_OK rank={rank} world={world} gen={gen} start_step={start}")
