@@ -143,6 +143,11 @@ void serve(Server* srv) {
     int r = ::poll(fds.data(), fds.size(), 100 /*ms*/);
     if (r <= 0) continue;
 
+    // conns polled THIS round: an accept below grows srv->conns past the
+    // fds snapshot, and indexing fds[i+1] for the new conn would read out
+    // of bounds — garbage revents can fake a POLLIN on the idle socket and
+    // wedge the whole single-threaded loop in a blocking recv.
+    const size_t n_polled = fds.size() - 1;
     if (fds[0].revents & POLLIN) {
       int fd = ::accept(srv->listen_fd, nullptr, nullptr);
       if (fd >= 0) {
@@ -153,6 +158,10 @@ void serve(Server* srv) {
     }
     std::vector<Conn*> alive;
     for (size_t i = 0; i < srv->conns.size(); ++i) {
+      if (i >= n_polled) {  // accepted this round; poll it next iteration
+        alive.push_back(srv->conns[i]);
+        continue;
+      }
       Conn* c = srv->conns[i];
       bool dead = false;
       if (fds[i + 1].revents & (POLLIN | POLLHUP | POLLERR)) {

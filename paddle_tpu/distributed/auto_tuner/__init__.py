@@ -1,25 +1,49 @@
-"""Auto-tuner: black-box search over hybrid-parallel configs.
+"""Auto-tuner: search over hybrid-parallel configs with pruning + cost model.
 
-Parity: `python/paddle/distributed/auto_tuner/` (tuner.py:21 AutoTuner,
-search.py grid search, prune.py constraint pruning). Searches
-(dp, mp, pp, sharding, micro_batch) combinations for a world size, prunes
-infeasible ones with a memory model, and ranks candidates by a
-user-supplied run function (throughput) — the same measure-and-pick loop
-the reference drives with real training trials.
+Parity: `python/paddle/distributed/auto_tuner/` — tuner.py:21 AutoTuner,
+search.py grid search, prune.py's @register_prune rule registry
+(prune_by_mp/pp/mbs/sharding/memory_estimation + history variants),
+memory_cost_model.py get_model_memory_usage, recorder.py. The reference
+drives real training trials per candidate; the loop here is the same
+measure-and-pick, but the static models are TPU-flavored:
+
+- memory model: transformer param count, ZeRO-stage-aware optimizer
+  state sharding, activation bytes under none/attn/full rematerialisation
+  (jax.checkpoint policies), vpp weight duplication ratio — against HBM
+  per chip (v5e 16GB / v5p 95GB).
+- cost model: per-chip FLOPs vs MXU throughput + TP allreduce bytes over
+  ICI + the pipeline bubble factor (pp-1)/(m*vpp) — a roofline ranking
+  so trials start from the most promising candidate, which is how the
+  reference's `search_algo: grid -> prune -> cost-model sort` behaves.
 """
 from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
 
+__all__ = [
+    "TunerCfg",
+    "ModelCfg",
+    "AutoTuner",
+    "generate_candidates",
+    "estimate_memory_gb",
+    "estimate_step_time_ms",
+    "prune_by_memory",
+    "register_prune",
+    "PRUNE_RULES",
+]
+
 
 @dataclass
 class TunerCfg:
-    dp: int
-    mp: int
-    pp: int
-    sharding: int
-    micro_batch: int
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding: int = 1
+    micro_batch: int = 1
+    vpp: int = 1
+    sharding_stage: int = 1          # ZeRO 1/2/3
+    recompute: str = "none"          # none | attn | full
 
     def degree(self):
         return self.dp * self.mp * self.pp * self.sharding
@@ -27,16 +51,222 @@ class TunerCfg:
     def to_dict(self):
         return dict(dp_degree=self.dp, mp_degree=self.mp, pp_degree=self.pp,
                     sharding_degree=self.sharding,
-                    micro_batch_size=self.micro_batch)
+                    micro_batch_size=self.micro_batch,
+                    vpp_degree=self.vpp, sharding_stage=self.sharding_stage,
+                    recompute=self.recompute)
+
+
+@dataclass
+class ModelCfg:
+    """Model + hardware description for the static models (the reference's
+    tuner_cfg["model_cfg"] block)."""
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_attention_heads: int = 32
+    vocab_size: int = 32000
+    seq_length: int = 2048
+    intermediate_size: int = 0       # 0 -> 4h
+    global_batch_size: int = 256
+    bytes_per_param: int = 2         # bf16
+    hbm_gb: float = 95.0             # v5p default
+    mxu_tflops: float = 459.0        # v5p bf16 peak
+    ici_gbps: float = 90.0           # per-link bidirectional-ish
+    params_b: float = 0.0            # explicit param count override
+
+    @property
+    def ffn(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+    def param_count(self):
+        """Transformer params: embeddings + L * (attn 4h^2 + mlp 2*h*ffn +
+        norms); `params_b` overrides when the model isn't transformer-shaped."""
+        if self.params_b:
+            return self.params_b
+        h, L, V = self.hidden_size, self.num_layers, self.vocab_size
+        per_layer = 4 * h * h + 2 * h * self.ffn + 4 * h
+        return V * h + L * per_layer + h
 
 
 def _divisors(n):
     return [d for d in range(1, n + 1) if n % d == 0]
 
 
-def generate_candidates(world_size, global_batch=None, max_mp=None,
-                        max_pp=None):
-    """All (dp, mp, pp, sharding, mbs) filling exactly `world_size`."""
+# ---------------------------------------------------------------------------
+# memory model (memory_cost_model.py:86 get_model_memory_usage analogue)
+# ---------------------------------------------------------------------------
+def estimate_memory_gb(cfg: TunerCfg, model: ModelCfg):
+    """Per-chip peak memory: params + grads + optimizer states (placement
+    by ZeRO stage) + activations (remat-dependent) + vpp duplication."""
+    P = model.param_count()
+    h, L, s = model.hidden_size, model.num_layers, model.seq_length
+    b = cfg.micro_batch
+    bpp = model.bytes_per_param
+
+    model_shard = cfg.mp * cfg.pp                 # params always split by tp/pp
+    # grads follow params; ZeRO-2/3 additionally shard grads; ZeRO-3 params
+    grad_shard = model_shard * (cfg.sharding if cfg.sharding_stage >= 2 else 1)
+    param_shard = model_shard * (cfg.sharding if cfg.sharding_stage >= 3 else 1)
+    params = P * bpp / param_shard
+    grads = P * bpp / grad_shard
+    # adam: two fp32 moments (+ fp32 master in mixed precision ~ 3x4 bytes)
+    opt = P * 12 / (model_shard * cfg.sharding)
+
+    # activations per layer per microbatch (bf16):
+    # none: ~ s*b*h*(34 + 5*a*s/h) (Megatron formula, attn scores incl.)
+    # attn: attention internals recomputed -> ~ s*b*h*34
+    # full: only layer boundaries saved -> ~ s*b*h*2
+    a = model.num_attention_heads
+    sb_h = s * b * h
+    if cfg.recompute == "full":
+        act_per_layer = 2 * sb_h
+    elif cfg.recompute == "attn":
+        act_per_layer = 34 * sb_h
+    else:
+        act_per_layer = 34 * sb_h + 5 * a * s * s * b
+    # layers resident per chip; vpp interleave holds (1 + (pp-1)/(pp*vpp))
+    # extra in-flight microbatch activations (pipeline_zero_bubble.py ratio)
+    layers_local = max(L // cfg.pp, 1)
+    vpp_ratio = 1.0 if cfg.pp == 1 else 1.0 + (cfg.pp - 1) / (cfg.pp * cfg.vpp)
+    # pp keeps up to pp in-flight microbatches of the first stage's acts
+    inflight = min(cfg.pp, max(model.global_batch_size
+                               // (cfg.dp * cfg.sharding * b), 1))
+    acts = act_per_layer * layers_local / cfg.mp * vpp_ratio * inflight
+
+    return (params + grads + opt + acts) / 1e9
+
+
+# ---------------------------------------------------------------------------
+# cost model (roofline ranking; cost_model.py get_mem + sorting analogue)
+# ---------------------------------------------------------------------------
+def estimate_step_time_ms(cfg: TunerCfg, model: ModelCfg):
+    """Rank candidates: compute time on the MXU + TP collectives over ICI
+    + pipeline bubble. Absolute numbers are rough; the ORDER is what the
+    tuner uses (best-first trial schedule)."""
+    P = model.param_count()
+    gbs, s = model.global_batch_size, model.seq_length
+    data_world = cfg.dp * cfg.sharding
+    if gbs % data_world:
+        return float("inf")
+    local_batch = gbs // data_world
+    m = max(local_batch // cfg.micro_batch, 1)   # microbatches in flight
+
+    # compute: 6*P*tokens flops for fwd+bwd, split over mp*pp
+    tokens_local = local_batch * s
+    flops = 6.0 * P * tokens_local
+    if cfg.recompute == "full":
+        flops *= 4.0 / 3.0                        # extra forward
+    elif cfg.recompute == "attn":
+        flops *= 1.15
+    compute_ms = flops / (cfg.mp * cfg.pp) / (model.mxu_tflops * 1e12) * 1e3
+
+    # TP comm: 4 allreduces of s*b*h bytes per layer per microbatch,
+    # ring cost 2*(mp-1)/mp
+    comm_ms = 0.0
+    if cfg.mp > 1:
+        bytes_tp = (4 * model.num_layers // cfg.pp) * m * (
+            s * cfg.micro_batch * model.hidden_size * model.bytes_per_param)
+        comm_ms += bytes_tp * 2 * (cfg.mp - 1) / cfg.mp / (
+            model.ici_gbps * 1e9) * 1e3
+    # dp/sharding grad sync: 2 bytes * P / (mp*pp), ring over data axis
+    if data_world > 1:
+        bytes_dp = P * model.bytes_per_param / (cfg.mp * cfg.pp)
+        comm_ms += bytes_dp * 2 * (data_world - 1) / data_world / (
+            model.ici_gbps * 1e9) * 1e3
+
+    # pipeline bubble: (pp-1)/(m*vpp) of the compute is idle
+    bubble = (cfg.pp - 1) / max(m * cfg.vpp, 1) if cfg.pp > 1 else 0.0
+    return (compute_ms + comm_ms) * (1.0 + bubble)
+
+
+# ---------------------------------------------------------------------------
+# prune rules (prune.py's @register_prune registry)
+# ---------------------------------------------------------------------------
+PRUNE_RULES = []
+
+
+def register_prune(fn):
+    """A rule returns True to PRUNE `cfg`. Signature (cfg, model, history)."""
+    PRUNE_RULES.append(fn)
+    return fn
+
+
+@register_prune
+def prune_by_mp(cfg, model, history):
+    """prune.py:129 — mp must divide heads and hidden; mp>hidden invalid."""
+    return (model.num_attention_heads % cfg.mp != 0
+            or model.hidden_size % cfg.mp != 0)
+
+
+@register_prune
+def prune_by_pp(cfg, model, history):
+    """prune.py:173 — layers must divide into pp stages."""
+    return model.num_layers % cfg.pp != 0
+
+
+@register_prune
+def prune_by_vpp(cfg, model, history):
+    """prune.py:234 — layers/pp must divide vpp; vpp>1 needs pp>2."""
+    if cfg.vpp == 1:
+        return False
+    if cfg.pp <= 2:
+        return True
+    return (model.num_layers // cfg.pp) % cfg.vpp != 0
+
+
+@register_prune
+def prune_by_mbs(cfg, model, history):
+    """prune.py:307 — gbs divisible down to microbatches."""
+    data_world = cfg.dp * cfg.sharding
+    if model.global_batch_size % data_world != 0:
+        return True
+    return (model.global_batch_size // data_world) % cfg.micro_batch != 0
+
+
+@register_prune
+def prune_by_sharding(cfg, model, history):
+    """prune.py:395 — stage>1 needs sharding degree>1 to mean anything."""
+    return cfg.sharding == 1 and cfg.sharding_stage > 1
+
+
+@register_prune
+def prune_by_memory_estimation(cfg, model, history):
+    """prune.py:605 — static OOM check against per-chip HBM."""
+    return estimate_memory_gb(cfg, model) > model.hbm_gb
+
+
+@register_prune
+def prune_by_mbs_history(cfg, model, history):
+    """prune.py:361 — if a no-heavier config with the same layout OOMed
+    (metric None), this one will too. "No heavier" must hold on every
+    memory axis: micro_batch, remat, ZeRO stage, and vpp (higher stage /
+    vpp / remat all REDUCE memory, so the OOMed config must have had
+    >= values there and <= micro_batch)."""
+    for prev, metric in history:
+        if metric is None and (
+            prev.dp, prev.mp, prev.pp, prev.sharding) == (
+            cfg.dp, cfg.mp, cfg.pp, cfg.sharding
+        ) and prev.micro_batch <= cfg.micro_batch and (
+            _remat_rank(prev.recompute) >= _remat_rank(cfg.recompute)
+        ) and prev.sharding_stage >= cfg.sharding_stage and (
+            prev.vpp >= cfg.vpp
+        ):
+            return True
+    return False
+
+
+def _remat_rank(r):
+    return {"none": 0, "attn": 1, "full": 2}[r]
+
+
+# ---------------------------------------------------------------------------
+# candidate generation (search.py grid)
+# ---------------------------------------------------------------------------
+def generate_candidates(world_size, model: ModelCfg = None, global_batch=None,
+                        max_mp=None, max_pp=None, tune_recompute=False):
+    """All (dp, mp, pp, sharding, mbs[, vpp, recompute]) filling exactly
+    `world_size` chips, pre-divisibility only (rules prune the rest)."""
+    if model is not None and global_batch is None:
+        global_batch = model.global_batch_size
     out = []
     for mp in _divisors(world_size):
         if max_mp and mp > max_mp:
@@ -51,74 +281,118 @@ def generate_candidates(world_size, global_batch=None, max_mp=None,
                 if global_batch:
                     per = global_batch // max(dp * sharding, 1)
                     mbs_opts = [m for m in mbs_opts if per and per % m == 0]
-                for mbs in (mbs_opts or [1]):
-                    out.append(TunerCfg(dp, mp, pp, sharding, mbs))
+                vpps = [1] if pp <= 2 else [1, 2]
+                remats = ["none", "full"] if tune_recompute else ["none"]
+                stages = [1] if sharding == 1 else [1, 2, 3]
+                for mbs, vpp, remat, stage in itertools.product(
+                        mbs_opts or [1], vpps, remats, stages):
+                    out.append(TunerCfg(dp, mp, pp, sharding, mbs, vpp,
+                                        stage, remat))
     return out
 
 
-def estimate_memory_gb(cfg: TunerCfg, model_params_b, hidden=4096,
-                       layers=32, seq=2048, bytes_per_param=2):
-    """Coarse per-chip memory model (prune.py analogue): params + grads +
-    optimizer states (sharded) + activations (mp/pp/microbatch split)."""
-    shard_factor = cfg.mp * cfg.pp * cfg.sharding
-    param_gb = model_params_b * bytes_per_param / shard_factor / 1e9
-    grad_gb = param_gb
-    # adam moments in fp32
-    opt_gb = model_params_b * 8 / (cfg.mp * cfg.pp * cfg.sharding) / 1e9
-    act_gb = (cfg.micro_batch * seq * hidden * layers * 2 * 12
-              / (cfg.mp * cfg.pp)) / 1e9
-    return param_gb + grad_gb + opt_gb + act_gb
-
-
-def prune_by_memory(candidates, model_params_b, hbm_gb=95, **model_kw):
+def prune_by_memory(candidates, model_params_b=None, hbm_gb=95, model=None,
+                    **model_kw):
+    """Filter by the memory model. Accepts either a ModelCfg (budget =
+    model.hbm_gb) or the legacy round-1 keywords (model_params_b plus
+    hidden/layers/seq/bytes_per_param)."""
+    if model is None:
+        legacy_names = {"hidden": "hidden_size", "layers": "num_layers",
+                        "seq": "seq_length"}
+        kw = {legacy_names.get(k, k): v for k, v in model_kw.items()}
+        model = ModelCfg(hbm_gb=hbm_gb, **kw)
+        if model_params_b is not None:
+            model.params_b = float(model_params_b)
     return [c for c in candidates
-            if estimate_memory_gb(c, model_params_b, **model_kw) < hbm_gb]
+            if estimate_memory_gb(c, model) < model.hbm_gb]
 
 
+# ---------------------------------------------------------------------------
+# tuner (tuner.py:21)
+# ---------------------------------------------------------------------------
 class AutoTuner:
-    """parity: auto_tuner/tuner.py:21."""
+    """Grid -> prune (rule registry) -> cost-model sort -> measure loop.
+
+    tuner_cfg keys (reference naming): world_size, model_cfg (dict for
+    ModelCfg), max_mp_degree, max_pp_degree, tune_recompute,
+    max_time_per_task. A run_fn returning None marks the trial OOM/failed
+    (feeds the history prune rules); higher metric = better.
+    """
 
     def __init__(self, tuner_cfg: dict):
         self.cfg = tuner_cfg
         world = tuner_cfg.get("world_size", 8)
+        mc = dict(tuner_cfg.get("model_cfg", {}))
+        # legacy round-1 keys
+        if "hbm_gb" in tuner_cfg:
+            mc.setdefault("hbm_gb", tuner_cfg["hbm_gb"])
+        if "global_batch_size" in tuner_cfg:
+            mc.setdefault("global_batch_size", tuner_cfg["global_batch_size"])
+        self.model = ModelCfg(**mc)
         cands = generate_candidates(
-            world,
-            global_batch=tuner_cfg.get("global_batch_size"),
+            world, self.model,
             max_mp=tuner_cfg.get("max_mp_degree"),
             max_pp=tuner_cfg.get("max_pp_degree"),
+            tune_recompute=tuner_cfg.get("tune_recompute", False),
         )
-        params_b = tuner_cfg.get("model_params_b")
-        if params_b:
-            cands = prune_by_memory(
-                cands, params_b, hbm_gb=tuner_cfg.get("hbm_gb", 95))
-        self.candidates = cands
         self.history = []
-        self._it = iter(self.candidates)
+        self.pruned = []
+        cands = [c for c in cands if not self._pruned_static(c)]
+        # best-first trial order by the cost model
+        cands.sort(key=lambda c: estimate_step_time_ms(c, self.model))
+        self.candidates = cands
+        self._idx = 0
+
+    def _pruned_static(self, cfg):
+        for rule in PRUNE_RULES:
+            if rule.__name__.endswith("_history"):
+                continue
+            if rule(cfg, self.model, self.history):
+                self.pruned.append((cfg, rule.__name__))
+                return True
+        return False
+
+    def _pruned_history(self, cfg):
+        for rule in PRUNE_RULES:
+            if not rule.__name__.endswith("_history"):
+                continue
+            if rule(cfg, self.model, self.history):
+                self.pruned.append((cfg, rule.__name__))
+                return True
+        return False
 
     def search_once(self):
-        """Next untried candidate or None when exhausted."""
-        try:
-            return next(self._it)
-        except StopIteration:
-            return None
+        """Next untried, not-history-pruned candidate (None = exhausted)."""
+        while self._idx < len(self.candidates):
+            cfg = self.candidates[self._idx]
+            self._idx += 1
+            if not self._pruned_history(cfg):
+                return cfg
+        return None
 
-    def add_cfg(self, cfg: TunerCfg, metric: float):
+    def add_cfg(self, cfg: TunerCfg, metric):
+        """metric None = OOM/failure (feeds history prunes)."""
         self.history.append((cfg, metric))
 
     def get_best_cfg(self):
-        if not self.history:
+        scored = [(c, m) for c, m in self.history if m is not None]
+        if not scored:
             return None
-        return max(self.history, key=lambda kv: kv[1])[0]
+        return max(scored, key=lambda kv: kv[1])[0]
 
     def tune(self, run_fn, max_trials=None):
-        """Measure each candidate with run_fn(cfg) -> throughput; returns
-        the best config."""
-        for i, cfg in enumerate(self.candidates):
-            if max_trials is not None and i >= max_trials:
+        """Measure candidates best-predicted-first; returns the best."""
+        trials = 0
+        while True:
+            if max_trials is not None and trials >= max_trials:
+                break
+            cfg = self.search_once()
+            if cfg is None:
                 break
             try:
                 metric = run_fn(cfg)
             except Exception:
-                metric = float("-inf")
+                metric = None
             self.add_cfg(cfg, metric)
+            trials += 1
         return self.get_best_cfg()

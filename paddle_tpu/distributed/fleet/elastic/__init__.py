@@ -63,8 +63,8 @@ class ElasticManager:
         self._hb = None
         self.host = os.environ.get("POD_IP", "127.0.0.1")
         self.np = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
-        self.member_id = os.environ.get(
-            "PADDLE_TRAINER_ID", f"{self.host}:{os.getpid()}")
+        self.member_id = os.environ.get("PADDLE_TRAINER_ID", "0")
+        self._announced_gens = set()
         self.heartbeat_interval = heartbeat_interval
         self.heartbeat_timeout = heartbeat_timeout
         self.enabled = store is not None or (
@@ -97,6 +97,7 @@ class ElasticManager:
         if member_id is not None:
             self.member_id = str(member_id)
         gen = self.generation() if generation is None else generation
+        self.announce(gen)
         self._beat(gen)
         if self._hb is None:
             self._hb = threading.Thread(target=self._beat_loop, daemon=True)
@@ -145,10 +146,14 @@ class ElasticManager:
         return sorted(set(ids))
 
     def announce(self, gen=None):
-        """Claim an atomic roster slot for this member in generation `gen`."""
+        """Claim an atomic roster slot for this member in generation `gen`
+        (idempotent per generation; duplicate slots dedupe by member id)."""
         if not self.enabled:
             return
         gen = self.generation() if gen is None else gen
+        if gen in self._announced_gens:
+            return
+        self._announced_gens.add(gen)
         slot = self._store.add(f"elastic/gen/{gen}/roster_slots", 1)
         self._store.set(f"elastic/gen/{gen}/roster/{slot}", self.member_id.encode())
 

@@ -1,0 +1,140 @@
+"""Auto-tuner static models + prune rules + search loop.
+
+Parity: auto_tuner/prune.py rule registry (prune_by_mp/pp/vpp/mbs/
+memory_estimation + history), memory_cost_model.py, tuner.py measure loop.
+Pure-python — no devices.
+"""
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner,
+    ModelCfg,
+    TunerCfg,
+    estimate_memory_gb,
+    estimate_step_time_ms,
+    generate_candidates,
+)
+
+LLAMA7B = ModelCfg(hidden_size=4096, num_layers=32, num_attention_heads=32,
+                   vocab_size=32000, seq_length=2048, global_batch_size=256)
+
+
+class TestMemoryModel:
+    def test_7b_single_chip_oom_but_sharded_fits(self):
+        # 7B adam fp32 moments alone ~84GB: one v5p chip can't hold it
+        # unsharded with activations, 8-way sharding must fit easily
+        dense = estimate_memory_gb(TunerCfg(dp=1, mp=1, micro_batch=1), LLAMA7B)
+        assert dense > 95
+        sharded = estimate_memory_gb(
+            TunerCfg(dp=1, mp=1, sharding=8, sharding_stage=3,
+                     micro_batch=1, recompute="full"), LLAMA7B)
+        assert sharded < 40
+
+    def test_param_count_close_to_7b(self):
+        assert 6.0e9 < LLAMA7B.param_count() < 8.5e9
+
+    def test_recompute_reduces_activations(self):
+        base = dict(dp=1, mp=8, micro_batch=4)
+        none = estimate_memory_gb(TunerCfg(**base, recompute="none"), LLAMA7B)
+        attn = estimate_memory_gb(TunerCfg(**base, recompute="attn"), LLAMA7B)
+        full = estimate_memory_gb(TunerCfg(**base, recompute="full"), LLAMA7B)
+        assert full < attn < none
+
+    def test_zero_stages_monotonic(self):
+        base = dict(dp=1, mp=1, sharding=8, micro_batch=1, recompute="full")
+        s1 = estimate_memory_gb(TunerCfg(**base, sharding_stage=1), LLAMA7B)
+        s2 = estimate_memory_gb(TunerCfg(**base, sharding_stage=2), LLAMA7B)
+        s3 = estimate_memory_gb(TunerCfg(**base, sharding_stage=3), LLAMA7B)
+        assert s3 < s2 < s1
+
+
+class TestCostModel:
+    def test_bubble_shrinks_with_more_microbatches(self):
+        # same layout, same per-chip FLOPs: smaller micro_batch -> more
+        # in-flight microbatches -> smaller (pp-1)/m bubble -> faster
+        m = ModelCfg(global_batch_size=64)
+        coarse = estimate_step_time_ms(TunerCfg(dp=1, pp=8, micro_batch=8), m)
+        fine = estimate_step_time_ms(TunerCfg(dp=1, pp=8, micro_batch=1), m)
+        assert fine < coarse
+
+    def test_indivisible_batch_is_infeasible(self):
+        assert estimate_step_time_ms(
+            TunerCfg(dp=3, micro_batch=1), LLAMA7B) == float("inf")
+
+    def test_vpp_shrinks_bubble(self):
+        v1 = estimate_step_time_ms(TunerCfg(pp=4, dp=2, micro_batch=1,
+                                            vpp=1), LLAMA7B)
+        v2 = estimate_step_time_ms(TunerCfg(pp=4, dp=2, micro_batch=1,
+                                            vpp=2), LLAMA7B)
+        assert v2 < v1
+
+
+class TestPruneRules:
+    def _tuner(self, **model_kw):
+        return AutoTuner({"world_size": 8,
+                          "model_cfg": {**LLAMA7B.__dict__, **model_kw}})
+
+    def test_mp_divides_heads(self):
+        t = AutoTuner({"world_size": 8,
+                       "model_cfg": dict(num_attention_heads=6)})
+        assert all(c.mp in (1, 2) for c in t.candidates)  # 6 % 4 != 0
+        assert any(name == "prune_by_mp" for _, name in t.pruned)
+
+    def test_pp_divides_layers(self):
+        t = AutoTuner({"world_size": 8, "model_cfg": dict(num_layers=30)})
+        assert all(30 % c.pp == 0 for c in t.candidates)
+
+    def test_memory_prune_drops_unsharded_7b(self):
+        t = self._tuner()
+        assert all(estimate_memory_gb(c, t.model) <= t.model.hbm_gb
+                   for c in t.candidates)
+        assert any(name == "prune_by_memory_estimation"
+                   for _, name in t.pruned)
+
+    def test_candidates_sorted_by_cost(self):
+        t = self._tuner()
+        times = [estimate_step_time_ms(c, t.model) for c in t.candidates]
+        assert times == sorted(times)
+
+    def test_history_prune_skips_bigger_mbs_after_oom(self):
+        t = self._tuner()
+        first = t.search_once()
+        assert first is not None
+        t.add_cfg(first, None)  # OOM
+        seen = []
+        while True:
+            c = t.search_once()
+            if c is None:
+                break
+            seen.append(c)
+        same_layout_bigger = [
+            c for c in seen
+            if (c.dp, c.mp, c.pp, c.sharding) ==
+               (first.dp, first.mp, first.pp, first.sharding)
+            and c.micro_batch >= first.micro_batch
+            and c.recompute == first.recompute]
+        assert not same_layout_bigger
+
+
+class TestTuneLoop:
+    def test_oom_trials_never_win(self):
+        t = AutoTuner({"world_size": 8})
+
+        def run(cfg):
+            if cfg.mp != 2:
+                return None  # everything else "OOMs"
+            return float(cfg.micro_batch)
+
+        best = t.tune(run, max_trials=50)
+        assert best is not None and best.mp == 2
+
+    def test_max_trials_bounds_measurements(self):
+        t = AutoTuner({"world_size": 8})
+        calls = []
+
+        def run(cfg):
+            calls.append(cfg)
+            return 1.0
+
+        t.tune(run, max_trials=5)
+        assert len(calls) == 5

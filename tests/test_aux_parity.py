@@ -103,18 +103,20 @@ def test_asp_2to4_masks():
 
 
 def test_auto_tuner_search():
-    from paddle_tpu.distributed.auto_tuner import (
-        AutoTuner, estimate_memory_gb, generate_candidates)
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, generate_candidates
 
     cands = generate_candidates(8)
     assert all(c.degree() == 8 for c in cands)
     assert any(c.mp == 2 and c.pp == 2 for c in cands)
 
-    tuner = AutoTuner({"world_size": 8, "model_params_b": 7e9,
-                       "hbm_gb": 95})
+    # small model so the memory prune isn't binding
+    tuner = AutoTuner({"world_size": 8,
+                       "model_cfg": dict(hidden_size=512, num_layers=4,
+                                         num_attention_heads=8,
+                                         vocab_size=1000)})
     assert tuner.candidates  # pruning leaves feasible configs
 
-    # fake measurement: prefer mp=2, mbs=4
+    # fake measurement: prefer mp=2, biggest microbatch
     def run(cfg):
         return (10 if cfg.mp == 2 else 0) + cfg.micro_batch
 
@@ -123,8 +125,10 @@ def test_auto_tuner_search():
 
 
 def test_memory_model_monotonic():
-    from paddle_tpu.distributed.auto_tuner import TunerCfg, estimate_memory_gb
+    from paddle_tpu.distributed.auto_tuner import (
+        ModelCfg, TunerCfg, estimate_memory_gb)
 
-    small = estimate_memory_gb(TunerCfg(1, 8, 1, 1, 1), 7e9)
-    big = estimate_memory_gb(TunerCfg(8, 1, 1, 1, 1), 7e9)
+    model = ModelCfg()
+    small = estimate_memory_gb(TunerCfg(dp=1, mp=8), model)
+    big = estimate_memory_gb(TunerCfg(dp=8, mp=1), model)
     assert small < big
