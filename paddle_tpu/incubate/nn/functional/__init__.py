@@ -62,6 +62,28 @@ def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5, begin_norm_ax
     return apply_op(_fln, x, norm_weight, norm_bias, bias, residual, _op_name="fused_layer_norm")
 
 
+def _apply_rotary(x, sin, cos, neox):
+    """Shared rotary core: x [..., D] with sin/cos broadcastable [..., D/2].
+    neox rotates halves; interleaved pairs otherwise."""
+    d = x.shape[-1]
+    if neox:
+        x1, x2 = x[..., : d // 2], x[..., d // 2:]
+        return jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _rotary_sin_cos(pos, d, theta):
+    """Standard rope table rows for integer positions `pos` -> [T, D/2]."""
+    inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = pos.astype(jnp.float32)[..., None] * inv
+    return jnp.sin(freqs), jnp.cos(freqs)
+
+
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, position_ids=None, use_neox_rotary_style=True, time_major=False, rotary_emb_base=10000.0):
     """parity: incubate/nn/functional/fused_rotary_position_embedding."""
 
@@ -72,26 +94,13 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, posit
         d = x.shape[-1]
         if sin_t is None:
             pos = jnp.arange(x.shape[1], dtype=jnp.float32)
-            inv = rotary_emb_base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-            freqs = jnp.outer(pos, inv)
-            sin_l = jnp.sin(freqs)
-            cos_l = jnp.cos(freqs)
+            sin_l, cos_l = _rotary_sin_cos(pos, d, rotary_emb_base)
         else:
             sin_l = sin_t.reshape(sin_t.shape[-2], -1)[:, : d // 2]
             cos_l = cos_t.reshape(cos_t.shape[-2], -1)[:, : d // 2]
         sin_b = sin_l[None, :, None, :]
         cos_b = cos_l[None, :, None, :]
-        if use_neox_rotary_style:
-            x1, x2 = x[..., : d // 2], x[..., d // 2 :]
-            o1 = x1 * cos_b - x2 * sin_b
-            o2 = x2 * cos_b + x1 * sin_b
-            return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
-        x1 = x[..., 0::2]
-        x2 = x[..., 1::2]
-        o1 = x1 * cos_b - x2 * sin_b
-        o2 = x2 * cos_b + x1 * sin_b
-        out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
-        return out.astype(x.dtype)
+        return _apply_rotary(x, sin_b, cos_b, use_neox_rotary_style)
 
     def _rope(q_, k_, v_, sin_t, cos_t):
         return tuple(_rope_one(t, sin_t, cos_t) for t in (q_, k_, v_) if t is not None)
@@ -510,6 +519,18 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         bidx = jnp.arange(b)
         kc = cache[0].at[bidx, :, cur, :].set(k.astype(cache.dtype))
         vc = cache[1].at[bidx, :, cur, :].set(v.astype(cache.dtype))
+        from ....ops.pallas import log_path_once, on_tpu_device
+
+        if mask is None and on_tpu_device() and d <= 256 and max_len % 8 == 0:
+            # pallas decode kernel (decode_attention.py): online softmax,
+            # KV streamed through VMEM — the masked_multihead_attention
+            # fusion slot on TPU
+            from ....ops.pallas.decode_attention import decode_attention
+
+            log_path_once("mmha", "pallas_decode")
+            out = decode_attention(q.astype(kc.dtype), kc, vc, cur + 1)
+            return out.reshape(b, h * d), jnp.stack([kc, vc])
+        log_path_once("mmha", "xla_decode")
         scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
         logits = jnp.einsum("bhd,bhtd->bht", q * scale, kc)
         valid = (jnp.arange(max_len)[None, None, :]
@@ -533,12 +554,194 @@ def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
                     _op_name="blha_get_max_len")
 
 
+def paged_attention(q, k_pages, v_pages, block_tables, lengths, scale=None):
+    """TPU-native paged-KV decode attention (the clean entry over the
+    pallas kernel; `block_multihead_attention` is the reference-shaped
+    wrapper). q [B, Hq, D]; pages [Hkv, NumPages, PageSize, D]."""
+    from ....ops.pallas.decode_attention import paged_attention as _pa
+
+    def _run(qa, kp, vp, bt, ln):
+        return _pa(qa, kp, vp, bt, ln, scale=scale)
+
+    return apply_op(_run, q, k_pages, v_pages, block_tables, lengths,
+                    _op_name="paged_attention")
+
+
 def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                               seq_lens_decoder, seq_lens_this_time,
                               padding_offsets, cum_offsets, cu_seqlens_q,
-                              cu_seqlens_k, block_tables, *args, **kwargs):
-    raise NotImplementedError(
-        "block_multihead_attention (paged KV) is a serving-engine kernel; "
-        "the TPU decode path uses the fixed-shape kv cache in "
-        "models/llama.py generate() — paged attention lands with a pallas "
-        "kernel in a future round")
+                              cu_seqlens_k, block_tables, pre_key_cache=None,
+                              pre_value_cache=None, cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None, qkv_out_scale=None,
+                              qkv_bias=None, out_shift=None, out_smooth=None,
+                              max_enc_len_this_time=None,
+                              max_dec_len_this_time=None, rope_emb=None,
+                              mask=None, tgt_mask=None, max_seq_len=-1,
+                              block_size=64, use_neox_style=False,
+                              rope_theta=10000.0, **kwargs):
+    """Paged-KV attention (parity: fusion/gpu block_multi_head_attention;
+    python surface `incubate/nn/functional/block_multihead_attention.py:56`).
+
+    Reference cache layout [MaxBlockNum, H, BlockSize, D] with
+    block_tables [B, BlocksPerSeq]. Decode steps (every live slot's
+    seq_lens_this_time <= 1) run the pallas paged kernel
+    (`ops/pallas/decode_attention.py`) — finished slots (== 0) are simply
+    excluded from the batch; prefill writes each sequence's tokens into
+    its pages and runs causal attention per sequence (eager path — the
+    serving engine drives steps eagerly). KV-cache int8 quantization is
+    not implemented (raises). Returns (out, qkv, key_cache, value_cache).
+    """
+    import numpy as _np
+
+    if any(s is not None for s in (cache_k_quant_scales, cache_v_quant_scales,
+                                   cache_k_dequant_scales,
+                                   cache_v_dequant_scales, qkv_out_scale,
+                                   out_shift, out_smooth)):
+        raise NotImplementedError(
+            "block_multihead_attention: int8 KV-cache / output quantization "
+            "is not implemented on the TPU path")
+
+    def _to_arr(t):
+        return t.value if hasattr(t, "value") else (
+            t._data if hasattr(t, "_data") else t)
+
+    qkv_a = _to_arr(qkv)
+    kc = _to_arr(key_cache)
+    vc = _to_arr(value_cache)
+    tables = _to_arr(block_tables).astype(jnp.int32)
+    enc = _np.asarray(_to_arr(seq_lens_encoder)).reshape(-1)
+    dec = _np.asarray(_to_arr(seq_lens_decoder)).reshape(-1)
+    this = _np.asarray(_to_arr(seq_lens_this_time)).reshape(-1)
+    rope = None if rope_emb is None else _to_arr(rope_emb)
+    tmask = None if tgt_mask is None else _to_arr(tgt_mask)
+    pmask = None if mask is None else _to_arr(mask)
+    b = this.shape[0]
+    nblocks, h, bsz, d = kc.shape           # h = kv heads
+    hq = qkv_a.shape[-1] // d - 2 * h       # GQA: qkv packs [hq + 2*h] heads
+
+    if qkv_bias is not None:
+        qkv_a = qkv_a + _to_arr(qkv_bias).reshape(1, -1)
+
+    def _split_qkv(rows):
+        """[T, (hq+2h)*d] -> q [T,hq,d], k [T,h,d], v [T,h,d]."""
+        t = rows.shape[0]
+        flat = rows.reshape(t, hq + 2 * h, d)
+        return flat[:, :hq], flat[:, hq:hq + h], flat[:, hq + h:]
+
+    def _rope_at(x, pos, seq_idx):
+        """Rotary at integer positions, [T, H, D]. Uses the CALLER's rope
+        table (rope_emb [2, B, max_seq, 1, D/2]: [0]=cos rows, [1]=sin —
+        NTK/linear scaling arrives through the table, never recomputed)."""
+        if rope is not None:
+            cos_t = rope[0, seq_idx, pos].reshape(pos.shape[0], 1, -1)
+            sin_t = rope[1, seq_idx, pos].reshape(pos.shape[0], 1, -1)
+        else:
+            sin_t, cos_t = _rotary_sin_cos(pos, d, rope_theta)
+            sin_t, cos_t = sin_t[:, None, :], cos_t[:, None, :]
+        return _apply_rotary(x, sin_t, cos_t, use_neox_style)
+
+    use_rope = rope_emb is not None
+    live = this > 0
+
+    if (this[live] == 1).all() and (enc == 0).all():
+        # ---- decode: one token per LIVE slot, pallas paged kernel ------
+        active = _np.nonzero(live)[0]                       # slot ids, in order
+        ba = len(active)
+        act = jnp.asarray(active, jnp.int32)
+        cur = jnp.asarray(dec[active], jnp.int32)           # cached lengths
+        tab_a = tables[act]                                 # [Ba, pages]
+
+        def _decode(rows, kc, vc):
+            q, k, v = _split_qkv(rows)                      # [Ba, hq|h, D]
+            if use_rope:
+                q = _rope_at(q, cur, act)
+                k = _rope_at(k, cur, act)
+            page_ids = tab_a[jnp.arange(ba), cur // bsz]    # [Ba]
+            offs = cur % bsz
+            kc = kc.at[page_ids, :, offs, :].set(k.astype(kc.dtype))
+            vc = vc.at[page_ids, :, offs, :].set(v.astype(vc.dtype))
+            from ....ops.pallas import log_path_once
+
+            if tmask is None:
+                from ....ops.pallas.decode_attention import (
+                    paged_attention as _pa,
+                )
+
+                log_path_once("blha", "pallas_paged")
+                out = _pa(q, jnp.swapaxes(kc, 0, 1), jnp.swapaxes(vc, 0, 1),
+                          tab_a, cur + 1)
+            else:
+                # masked decode: dense gather fallback (kernel is unmasked)
+                log_path_once("blha", "xla_paged_masked")
+                kd = jnp.swapaxes(kc[tab_a], 1, 2).reshape(ba, h, -1, d)
+                vd = jnp.swapaxes(vc[tab_a], 1, 2).reshape(ba, h, -1, d)
+                s = kd.shape[2]
+                kd = jnp.repeat(kd, hq // h, 1).astype(jnp.float32)
+                vd = jnp.repeat(vd, hq // h, 1).astype(jnp.float32)
+                logits = jnp.einsum(
+                    "bhd,bhtd->bht", q.astype(jnp.float32) / (d ** 0.5), kd)
+                valid = jnp.arange(s)[None, None, :] <= cur[:, None, None]
+                logits = jnp.where(valid, logits, -1e30)
+                logits = logits + tmask.reshape(b, 1, -1)[act, :, :s]
+                out = jnp.einsum("bht,bhtd->bhd",
+                                 jax.nn.softmax(logits, -1), vd)
+            return out.reshape(ba, hq * d).astype(rows.dtype), kc, vc
+
+        out, kc, vc = apply_op(_decode, qkv_a, kc, vc, _op_name="blha_decode")
+    else:
+        # ---- prefill / mixed: eager per-sequence causal attention -------
+        from ....ops.pallas import log_path_once
+
+        log_path_once("blha", "xla_prefill")
+        cu = _np.zeros(b + 1, _np.int64)
+        _np.cumsum(this, out=cu[1:])
+
+        def _prefill(qkv_a, kc, vc):
+            outs = []
+            for i in range(b):
+                t = int(this[i])
+                if t == 0:
+                    continue
+                q, k, v = _split_qkv(qkv_a[int(cu[i]): int(cu[i]) + t])
+                start = int(dec[i])
+                pos = jnp.arange(start, start + t)
+                if use_rope:
+                    q, k = _rope_at(q, pos, i), _rope_at(k, pos, i)
+                pids = tables[i, (_np.arange(start, start + t) // bsz)]
+                offs = jnp.asarray(_np.arange(start, start + t) % bsz)
+                kc = kc.at[pids, :, offs, :].set(k.astype(kc.dtype))
+                vc = vc.at[pids, :, offs, :].set(v.astype(vc.dtype))
+                # causal attention over this sequence's full cache
+                total = start + t
+                npg = (total + bsz - 1) // bsz
+                kseq = jnp.concatenate(
+                    [kc[tables[i, pg]] for pg in range(npg)], axis=1)[:, :total]
+                vseq = jnp.concatenate(
+                    [vc[tables[i, pg]] for pg in range(npg)], axis=1)[:, :total]
+                if hq != h:                                  # GQA repeat
+                    kseq = jnp.repeat(kseq, hq // h, axis=0)
+                    vseq = jnp.repeat(vseq, hq // h, axis=0)
+                logits = jnp.einsum(
+                    "thd,hxd->htx", q.astype(jnp.float32) / (d ** 0.5),
+                    kseq.astype(jnp.float32))
+                qpos = pos[None, :, None]
+                kpos = jnp.arange(total)[None, None, :]
+                logits = jnp.where(kpos <= qpos, logits, -1e30)
+                if pmask is not None:
+                    logits = logits + pmask[i, 0][start:start + t, :total][None]
+                probs = jax.nn.softmax(logits, -1)
+                o = jnp.einsum("htx,hxd->thd", probs, vseq.astype(jnp.float32))
+                outs.append(o.reshape(t, hq * d).astype(qkv_a.dtype))
+            return jnp.concatenate(outs, axis=0), kc, vc
+
+        out, kc, vc = apply_op(_prefill, qkv_a, kc, vc,
+                               _op_name="blha_prefill")
+
+    from ....core.tensor import Tensor as _T
+
+    def _wrap(x):
+        return x if isinstance(x, _T) else _T(x)
+
+    return _wrap(out), qkv, _wrap(kc), _wrap(vc)

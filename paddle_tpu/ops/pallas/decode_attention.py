@@ -1,0 +1,236 @@
+"""Decode-serving attention as Pallas TPU kernels.
+
+Capability parity: the reference's serving attention fusion kernels —
+`phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu` (single-token
+decode over a dense [B, H, MaxLen, D] cache) and
+`phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu` (paged KV
+cache addressed through block tables). TPU redesign: one online-softmax
+kernel per cache layout, KV streamed through VMEM in blocks/pages, q heads
+grouped by their shared kv head (GQA never materialises repeated KV), and
+per-batch valid lengths arriving via scalar prefetch so block tables can
+drive the BlockSpec index maps (the pages a sequence doesn't own are never
+even fetched from HBM).
+
+Decode is HBM-bandwidth-bound (the whole KV cache is read once per token),
+so the kernels optimise for streaming: f32 accumulation scratch, last grid
+dim sequential over KV, page/block granularity aligned to Mosaic tiling.
+
+Layouts:
+  decode_attention:  q [B, Hq, D], cache [B, Hkv, S, D], lengths [B]
+  paged_attention:   q [B, Hq, D], pages [Hkv, NumPages, PageSize, D],
+                     block_tables [B, PagesPerSeq], lengths [B]
+`lengths[b]` counts the VALID kv positions (including the current token's
+freshly-written slot).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
+                   *, scale, bk, nk):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    length = len_ref[b]
+
+    @pl.when(j * bk < length)          # skip fully-invalid kv blocks
+    def _():
+        q = q_ref[0, 0]                # [rep, d]
+        k = k_ref[0, 0]                # [bk, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                      # [rep, bk]
+        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bk
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, 0:1] = alpha * l_scr[:, 0:1] + jnp.sum(p, -1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0:1] = m_new
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_scr[:, 0:1]
+        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, lengths, *, scale=None,
+                     block_k=512, interpret=None):
+    """Single-token decode attention over a dense KV cache.
+
+    q [B, Hq, D] -> out [B, Hq, D]; cache [B, Hkv, S, D]; lengths [B].
+    """
+    from . import use_interpret
+
+    if interpret is None:
+        interpret = use_interpret()
+    b, hq, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    rep = hq // hkv
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    bk = min(block_k, s)
+    while s % bk:
+        bk //= 2
+    nk = s // bk
+
+    qg = q.reshape(b, hkv, rep, d)
+    kern = functools.partial(_decode_kernel, scale=scale, bk=bk, nk=nk)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kern,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(b, hkv, nk),
+                in_specs=[
+                    pl.BlockSpec((1, 1, rep, d), lambda bi, h, j, L: (bi, h, 0, 0)),
+                    pl.BlockSpec((1, 1, bk, d), lambda bi, h, j, L: (bi, h, j, 0)),
+                    pl.BlockSpec((1, 1, bk, d), lambda bi, h, j, L: (bi, h, j, 0)),
+                ],
+                out_specs=pl.BlockSpec(
+                    (1, 1, rep, d), lambda bi, h, j, L: (bi, h, 0, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((rep, d), jnp.float32),
+                    pltpu.VMEM((rep, 128), jnp.float32),
+                    pltpu.VMEM((rep, 128), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            cost_estimate=pl.CostEstimate(
+                flops=4 * b * hq * s * d,
+                bytes_accessed=(b * hq * d + 2 * b * hkv * s * d)
+                * q.dtype.itemsize,
+                transcendentals=b * hq * s,
+            ),
+        )(lengths.astype(jnp.int32), qg, k_cache, v_cache)
+    return out.reshape(b, hq, d)
+
+
+# ------------------------------------------------------------------ paged
+
+def _paged_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc, m_scr, l_scr, *, scale, page, npages):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    length = len_ref[b]
+
+    @pl.when(j * page < length)
+    def _():
+        q = q_ref[0, 0]                # [rep, d]
+        k = k_ref[0, 0]                # [page, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                      # [rep, page]
+        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * page
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, 0:1] = alpha * l_scr[:, 0:1] + jnp.sum(p, -1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0:1] = m_new
+
+    @pl.when(j == npages - 1)
+    def _():
+        l = l_scr[:, 0:1]
+        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
+                    scale=None, interpret=None):
+    """Paged-KV decode attention (block_multi_head_attention slot).
+
+    q [B, Hq, D]; pages [Hkv, NumPages, PageSize, D];
+    block_tables [B, PagesPerSeq] (page ids per sequence, row-major);
+    lengths [B] valid kv length. The BlockSpec index map reads the block
+    table via scalar prefetch, so only the pages a sequence actually owns
+    are fetched from HBM.
+    """
+    from . import use_interpret
+
+    if interpret is None:
+        interpret = use_interpret()
+    b, hq, d = q.shape
+    hkv, num_pages, page, _ = k_pages.shape
+    rep = hq // hkv
+    pages_per_seq = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    def _page_index(bi, h, j, tables, lens):
+        # clamp so garbage table entries past `lengths` stay in-bounds
+        t = tables[bi, j]
+        return (h, jnp.clip(t, 0, num_pages - 1), 0, 0)
+
+    qg = q.reshape(b, hkv, rep, d)
+    kern = functools.partial(_paged_kernel, scale=scale, page=page,
+                             npages=pages_per_seq)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kern,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(b, hkv, pages_per_seq),
+                in_specs=[
+                    pl.BlockSpec((1, 1, rep, d),
+                                 lambda bi, h, j, T, L: (bi, h, 0, 0)),
+                    pl.BlockSpec((1, 1, page, d), _page_index),
+                    pl.BlockSpec((1, 1, page, d), _page_index),
+                ],
+                out_specs=pl.BlockSpec(
+                    (1, 1, rep, d), lambda bi, h, j, T, L: (bi, h, 0, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((rep, d), jnp.float32),
+                    pltpu.VMEM((rep, 128), jnp.float32),
+                    pltpu.VMEM((rep, 128), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            cost_estimate=pl.CostEstimate(
+                flops=4 * b * hq * pages_per_seq * page * d,
+                bytes_accessed=(b * hq * d
+                                + 2 * b * hkv * pages_per_seq * page * d)
+                * q.dtype.itemsize,
+                transcendentals=b * hq * pages_per_seq * page,
+            ),
+        )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+          qg, k_pages, v_pages)
+    return out.reshape(b, hq, d)

@@ -1,0 +1,320 @@
+"""Pallas decode/paged attention kernels vs jnp reference.
+
+Parity slot: fusion/gpu masked_multihead_attention (dense cache decode) and
+block_multi_head_attention (paged KV). Runs in interpret mode on the CPU
+mesh; the same kernels compile on TPU.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.decode_attention import (
+    decode_attention,
+    paged_attention,
+)
+
+
+def ref_decode(q, k, v, lengths, scale=None):
+    """[B,Hq,D] x [B,Hkv,S,D] masked softmax reference in f32."""
+    b, hq, d = q.shape
+    hkv, s = k.shape[1], k.shape[2]
+    rep = hq // hkv
+    kf = jnp.repeat(k, rep, axis=1).astype(jnp.float32)
+    vf = jnp.repeat(v, rep, axis=1).astype(jnp.float32)
+    scale = scale or 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32) * scale, kf)
+    valid = jnp.arange(s)[None, None, :] < lengths[:, None, None]
+    probs = jax.nn.softmax(jnp.where(valid, logits, -1e30), -1)
+    return jnp.einsum("bht,bhtd->bhd", probs, vf).astype(q.dtype)
+
+
+def _rand(shape, dtype=jnp.float32, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), dtype)
+
+
+class TestDecodeAttention:
+    @pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 2), (4, 1)])
+    def test_matches_reference_gqa(self, hq, hkv):
+        b, s, d = 2, 1024, 128
+        q = _rand((b, hq, d))
+        k = _rand((b, hkv, s, d), seed=1)
+        v = _rand((b, hkv, s, d), seed=2)
+        lengths = jnp.array([1000, 321], jnp.int32)
+        out = decode_attention(q, k, v, lengths)
+        ref = ref_decode(q, k, v, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_realistic_kv_length_8k(self):
+        b, hq, hkv, s, d = 1, 8, 2, 8192, 128
+        q = _rand((b, hq, d))
+        k = _rand((b, hkv, s, d), seed=1)
+        v = _rand((b, hkv, s, d), seed=2)
+        lengths = jnp.array([7531], jnp.int32)
+        out = decode_attention(q, k, v, lengths)
+        ref = ref_decode(q, k, v, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_length_one_and_full(self):
+        b, h, s, d = 2, 4, 256, 64
+        q = _rand((b, h, d))
+        k = _rand((b, h, s, d), seed=1)
+        v = _rand((b, h, s, d), seed=2)
+        lengths = jnp.array([1, s], jnp.int32)
+        out = decode_attention(q, k, v, lengths)
+        ref = ref_decode(q, k, v, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bfloat16(self):
+        b, h, s, d = 2, 4, 512, 128
+        q = _rand((b, h, d), jnp.bfloat16)
+        k = _rand((b, h, s, d), jnp.bfloat16, seed=1)
+        v = _rand((b, h, s, d), jnp.bfloat16, seed=2)
+        lengths = jnp.array([400, 512], jnp.int32)
+        out = decode_attention(q, k, v, lengths)
+        ref = ref_decode(q, k, v, lengths)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=3e-2, atol=3e-2)
+
+
+class TestBlockMultiheadAttention:
+    """incubate.nn.functional.block_multihead_attention: prefill writes the
+    paged cache, decode steps run the pallas paged kernel; both must match
+    dense causal attention."""
+
+    def _dense_causal(self, q, k, v):
+        # q,k,v [T, H, D] -> [T, H*D]
+        t, h, d = q.shape
+        logits = jnp.einsum("thd,xhd->htx", q / np.sqrt(d), k)
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        probs = jax.nn.softmax(jnp.where(mask[None], logits, -1e30), -1)
+        return jnp.einsum("htx,xhd->thd", probs, v).reshape(t, h * d)
+
+    def test_prefill_then_decode_matches_dense(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import functional as FF
+
+        h, d, bsz, blocks_per_seq = 4, 64, 64, 4
+        b = 1
+        prefill_len, decode_steps = 100, 3
+        total = prefill_len + decode_steps
+        rng = np.random.default_rng(0)
+        all_qkv = rng.standard_normal((total, 3 * h * d)).astype(np.float32)
+
+        kc = paddle.to_tensor(np.zeros((8, h, bsz, d), np.float32))
+        vc = paddle.to_tensor(np.zeros((8, h, bsz, d), np.float32))
+        tables = paddle.to_tensor(
+            np.array([[5, 2, 7, 0]], np.int32))  # scattered pages
+
+        def _lens(e, dd, tt):
+            return (paddle.to_tensor(np.array([[e]], np.int32)),
+                    paddle.to_tensor(np.array([[dd]], np.int32)),
+                    paddle.to_tensor(np.array([[tt]], np.int32)))
+
+        # prefill
+        enc, dec, this = _lens(prefill_len, 0, prefill_len)
+        out_p, _, kc, vc = FF.block_multihead_attention(
+            paddle.to_tensor(all_qkv[:prefill_len]), kc, vc, enc, dec, this,
+            None, None, None, None, tables, block_size=bsz)
+        # decode steps
+        outs = [np.asarray(out_p.numpy())]
+        for step in range(decode_steps):
+            cur = prefill_len + step
+            enc, dec, this = _lens(0, cur, 1)
+            out_d, _, kc, vc = FF.block_multihead_attention(
+                paddle.to_tensor(all_qkv[cur:cur + 1]), kc, vc, enc, dec,
+                this, None, None, None, None, tables, block_size=bsz)
+            outs.append(np.asarray(out_d.numpy()))
+        got = np.concatenate(outs, axis=0)
+
+        flat = jnp.asarray(all_qkv).reshape(total, 3, h, d)
+        want = self._dense_causal(flat[:, 0], flat[:, 1], flat[:, 2])
+        np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_gqa_decode(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import functional as FF
+
+        hq, hkv, d, bsz = 8, 2, 64, 64
+        width = (hq + 2 * hkv) * d
+        rng = np.random.default_rng(1)
+        kc = paddle.to_tensor(
+            rng.standard_normal((4, hkv, bsz, d)).astype(np.float32))
+        vc = paddle.to_tensor(
+            rng.standard_normal((4, hkv, bsz, d)).astype(np.float32))
+        tables = paddle.to_tensor(np.array([[1, 3]], np.int32))
+        cached = 50
+        qkv = paddle.to_tensor(
+            rng.standard_normal((1, width)).astype(np.float32))
+        enc = paddle.to_tensor(np.array([[0]], np.int32))
+        dec = paddle.to_tensor(np.array([[cached]], np.int32))
+        this = paddle.to_tensor(np.array([[1]], np.int32))
+        out, _, kc2, vc2 = FF.block_multihead_attention(
+            qkv, kc, vc, enc, dec, this, None, None, None, None, tables,
+            block_size=bsz)
+        assert out.shape == [1, hq * d]
+        # reference: dense over the first `cached+1` positions of the
+        # sequence's pages (page 1 then 3), with the new k/v written in
+        flat = np.asarray(qkv.numpy()).reshape(hq + 2 * hkv, d)
+        q = jnp.asarray(flat[:hq])[None]                     # [1, hq, d]
+        kd = jnp.concatenate([np.asarray(kc2.numpy())[1],
+                              np.asarray(kc2.numpy())[3]], axis=1)[None]
+        vd = jnp.concatenate([np.asarray(vc2.numpy())[1],
+                              np.asarray(vc2.numpy())[3]], axis=1)[None]
+        ref = ref_decode(q, kd, vd, jnp.array([cached + 1], jnp.int32))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(ref).reshape(1, hq * d),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestBlockMHAServingEdges:
+    def _setup(self, b=2, h=2, d=64, bsz=64, pages=3):
+        import paddle_tpu as paddle
+
+        rng = np.random.default_rng(3)
+        kc = paddle.to_tensor(
+            rng.standard_normal((8, h, bsz, d)).astype(np.float32))
+        vc = paddle.to_tensor(
+            rng.standard_normal((8, h, bsz, d)).astype(np.float32))
+        tables = paddle.to_tensor(
+            rng.permutation(8)[: b * pages].reshape(b, pages).astype(np.int32))
+        return paddle, kc, vc, tables
+
+    def test_finished_slot_keeps_pallas_batch(self):
+        """A finished slot (seq_lens_this_time == 0) is excluded; live rows
+        still decode through the kernel and output has only live rows."""
+        from paddle_tpu.incubate.nn import functional as FF
+
+        paddle, kc, vc, tables = self._setup(b=2)
+        h, d = 2, 64
+        rng = np.random.default_rng(4)
+        qkv = paddle.to_tensor(
+            rng.standard_normal((1, 3 * h * d)).astype(np.float32))  # 1 live row
+        enc = paddle.to_tensor(np.array([[0], [0]], np.int32))
+        dec = paddle.to_tensor(np.array([[40], [90]], np.int32))
+        this = paddle.to_tensor(np.array([[0], [1]], np.int32))  # slot 0 done
+        out, _, kc2, vc2 = FF.block_multihead_attention(
+            qkv, kc, vc, enc, dec, this, None, None, None, None, tables,
+            block_size=64)
+        assert out.shape == [1, h * d]
+        # reference for the live slot (index 1)
+        flat = np.asarray(qkv.numpy()).reshape(h * 3, d)
+        q = jnp.asarray(flat[:h])[None]
+        t1 = np.asarray(tables.numpy())[1]
+        kd = jnp.concatenate([np.asarray(kc2.numpy())[p] for p in t1], 1)[None]
+        vd = jnp.concatenate([np.asarray(vc2.numpy())[p] for p in t1], 1)[None]
+        ref = ref_decode(q, kd, vd, jnp.array([91], jnp.int32))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(ref).reshape(1, -1),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_rope_table_values_are_used(self):
+        """A scaled rope table must change the output vs the default table
+        (the kernel must read the table, not recompute theta-10000)."""
+        from paddle_tpu.incubate.nn import functional as FF
+
+        paddle, kc, vc, tables = self._setup(b=1)
+        h, d, max_seq = 2, 64, 192
+        rng = np.random.default_rng(5)
+        qkv_np = rng.standard_normal((1, 3 * h * d)).astype(np.float32)
+        enc = paddle.to_tensor(np.array([[0]], np.int32))
+        dec = paddle.to_tensor(np.array([[50]], np.int32))
+        this = paddle.to_tensor(np.array([[1]], np.int32))
+
+        def table(scale):
+            pos = np.arange(max_seq, dtype=np.float32) / scale
+            inv = 10000.0 ** (-np.arange(0, d, 2, dtype=np.float32) / d)
+            f = np.outer(pos, inv)
+            t = np.stack([np.cos(f), np.sin(f)])  # [2, max_seq, d/2]
+            return paddle.to_tensor(
+                t.reshape(2, 1, max_seq, 1, d // 2).astype(np.float32))
+
+        outs = []
+        for scale in (1.0, 4.0):
+            o, _, _, _ = FF.block_multihead_attention(
+                paddle.to_tensor(qkv_np), kc, vc, enc, dec, this,
+                None, None, None, None, tables, rope_emb=table(scale),
+                block_size=64)
+            outs.append(np.asarray(o.numpy()))
+        assert not np.allclose(outs[0], outs[1])  # scaling reached the math
+
+    def test_quantization_raises_loudly(self):
+        from paddle_tpu.incubate.nn import functional as FF
+
+        paddle, kc, vc, tables = self._setup(b=1)
+        with pytest.raises(NotImplementedError):
+            FF.block_multihead_attention(
+                paddle.to_tensor(np.zeros((1, 3 * 2 * 64), np.float32)),
+                kc, vc,
+                paddle.to_tensor(np.array([[0]], np.int32)),
+                paddle.to_tensor(np.array([[1]], np.int32)),
+                paddle.to_tensor(np.array([[1]], np.int32)),
+                None, None, None, None, tables,
+                cache_k_quant_scales=paddle.to_tensor(
+                    np.ones((2,), np.float32)))
+
+
+class TestPagedAttention:
+    def _paged_setup(self, b, hq, hkv, d, page, pages_per_seq, lengths,
+                     seed=0):
+        """Build a paged cache + the equivalent dense cache."""
+        s = page * pages_per_seq
+        num_pages = b * pages_per_seq + 3  # a few spare pages
+        k_pages = _rand((hkv, num_pages, page, d), seed=seed + 1)
+        v_pages = _rand((hkv, num_pages, page, d), seed=seed + 2)
+        # each sequence owns a scattered set of pages
+        rng = np.random.default_rng(seed + 3)
+        tables = rng.permutation(num_pages)[: b * pages_per_seq]
+        tables = jnp.asarray(tables.reshape(b, pages_per_seq), jnp.int32)
+        # dense view: gather pages per sequence
+        k_dense = jnp.stack([
+            jnp.concatenate([k_pages[:, tables[i, p]] for p in
+                             range(pages_per_seq)], axis=1)
+            for i in range(b)])  # [B, Hkv, S, D]
+        v_dense = jnp.stack([
+            jnp.concatenate([v_pages[:, tables[i, p]] for p in
+                             range(pages_per_seq)], axis=1)
+            for i in range(b)])
+        return k_pages, v_pages, tables, k_dense, v_dense, s
+
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)])
+    def test_matches_dense_reference(self, hq, hkv):
+        b, d, page, pps = 2, 128, 64, 8
+        lengths = jnp.array([500, 129], jnp.int32)
+        k_pages, v_pages, tables, k_dense, v_dense, s = self._paged_setup(
+            b, hq, hkv, d, page, pps, lengths)
+        q = _rand((b, hq, d))
+        out = paged_attention(q, k_pages, v_pages, tables, lengths)
+        ref = ref_decode(q, k_dense, v_dense, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_long_context_4k_pages(self):
+        b, hq, hkv, d, page, pps = 1, 8, 8, 128, 128, 32  # 4096 ctx
+        lengths = jnp.array([4000], jnp.int32)
+        k_pages, v_pages, tables, k_dense, v_dense, s = self._paged_setup(
+            b, hq, hkv, d, page, pps, lengths, seed=7)
+        q = _rand((b, hq, d), seed=9)
+        out = paged_attention(q, k_pages, v_pages, tables, lengths)
+        ref = ref_decode(q, k_dense, v_dense, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_garbage_table_entries_beyond_length_ignored(self):
+        b, hq, hkv, d, page, pps = 1, 4, 4, 64, 64, 4
+        lengths = jnp.array([64], jnp.int32)  # only first page valid
+        k_pages, v_pages, tables, k_dense, v_dense, s = self._paged_setup(
+            b, hq, hkv, d, page, pps, lengths)
+        # poison the unused table entries with out-of-range page ids
+        poisoned = tables.at[0, 2:].set(10**6)
+        q = _rand((b, hq, d))
+        out = paged_attention(q, k_pages, v_pages, poisoned, lengths)
+        ref = ref_decode(q, k_dense, v_dense, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
