@@ -194,10 +194,77 @@ class RoleMakerBase:
 
 
 class PaddleCloudRoleMaker(RoleMakerBase):
-    """parity: fleet/base/role_maker.py:548 — reads the PADDLE_* env."""
+    """parity: fleet/base/role_maker.py:548 — reads the PADDLE_* env.
+
+    PS mode: TRAINING_ROLE=PSERVER|TRAINER selects the role;
+    PADDLE_PSERVER_NUMS / PADDLE_TRAINERS_NUM size the two groups."""
 
     def __init__(self, is_collective=False, **kwargs):
         self._is_collective = is_collective
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+
+    def is_worker(self):
+        return self._is_collective or self._role == "TRAINER"
+
+    def is_server(self):
+        return not self._is_collective and self._role == "PSERVER"
+
+    def server_num(self):
+        return int(os.environ.get("PADDLE_PSERVER_NUMS", 1))
+
+    def server_index(self):
+        return int(os.environ.get("PADDLE_PSERVER_ID", 0))
+
+
+# -- PS mode (parity: fleet.init_server/run_server/init_worker over the
+#    distributed/ps tables — see distributed/ps/__init__.py) ---------------
+_ps_state = {"server": None, "client": None, "stop": None}
+
+
+def init_server(model_dir=None, **kwargs):
+    from ..ps import get_global_server
+
+    server = get_global_server()
+    if model_dir:
+        server.load(model_dir)
+    _ps_state["server"] = server
+    return server
+
+
+def run_server():
+    import threading
+
+    from ..ps import serve_forever
+
+    _ps_state["stop"] = threading.Event()
+    serve_forever(_ps_state["stop"])
+
+
+def init_worker(servers=None, **kwargs):
+    """`servers`: rpc server names or in-process PSServer objects; default
+    = the process-global server (single-node mode)."""
+    from ..ps import PSClient, get_global_server
+
+    _ps_state["client"] = PSClient(servers or [get_global_server()])
+    return _ps_state["client"]
+
+
+def get_ps_client():
+    if _ps_state["client"] is None:
+        raise RuntimeError("fleet.init_worker() has not been called")
+    return _ps_state["client"]
+
+
+def stop_worker():
+    client = _ps_state["client"]
+    if client is not None:
+        try:
+            client.stop_servers()   # remote stop verb unparks run_server
+        except Exception:
+            pass
+    _ps_state["client"] = None
+    if _ps_state["stop"] is not None:
+        _ps_state["stop"].set()
 
 
 class UserDefinedRoleMaker(RoleMakerBase):
@@ -257,10 +324,12 @@ class Fleet:
         return worker_num()
 
     def is_worker(self):
-        return True
+        return PaddleCloudRoleMaker(
+            is_collective=_get_strategy() is not None).is_worker()
 
     def is_server(self):
-        return False
+        return PaddleCloudRoleMaker(
+            is_collective=_get_strategy() is not None).is_server()
 
     def barrier_worker(self):
         from .. import barrier as _b
@@ -272,6 +341,19 @@ class Fleet:
 
     def distributed_optimizer(self, optimizer, strategy=None):
         return distributed_optimizer(optimizer, strategy)
+
+    # PS mode
+    def init_server(self, *a, **k):
+        return init_server(*a, **k)
+
+    def run_server(self):
+        return run_server()
+
+    def init_worker(self, *a, **k):
+        return init_worker(*a, **k)
+
+    def stop_worker(self):
+        return stop_worker()
 
 
 class MultiSlotDataGenerator:

@@ -1,0 +1,383 @@
+"""Parameter server (dense + sparse tables, push/pull workers).
+
+Parity slot: `paddle/fluid/distributed/ps/` (~35k C++: table storage,
+brpc push/pull services, PsService server, fleet PS mode) and the python
+layer `paddle/incubate/distributed/fleet/`. The reference PS exists for
+CPU sparse workloads (billion-row embeddings, async SGD). TPU-native
+redesign keeps the capability but swaps the machinery:
+
+- Tables are numpy state on the server (dense arrays; sparse dict of
+  lazily-initialised rows) with the optimizer applied SERVER-side on
+  push (async-SGD semantics, `a_sync` strategy).
+- Transport is the repo's store-backed RPC (`distributed/rpc`) — the
+  same push/pull RPC shape as brpc PsService, minus 35k lines. Dense
+  tables are round-robin over servers; sparse rows shard by `id % n`.
+- Workers embed a `PSClient`; `sparse_embedding` pulls rows for a
+  batch's ids, computes on device, and pushes row gradients back.
+
+This is explicitly the lowest-priority subsystem for TPU dense training
+(VERDICT), but the capability is real: multi-server sharding, lazy row
+init, server-side SGD/Adagrad, pull/push round-trips, and fleet PS-mode
+wiring (`fleet.init_server()/run_server()/init_worker()`).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+
+import numpy as np
+
+__all__ = [
+    "DenseTable",
+    "SparseTable",
+    "PSServer",
+    "PSClient",
+    "sparse_embedding_lookup",
+    "get_global_server",
+    "serve_forever",
+]
+
+
+class DenseTable:
+    """A dense parameter block; optimizer applied on push (downpour SGD)."""
+
+    def __init__(self, name, shape, init=None, lr=0.01, optimizer="sgd"):
+        self.name = name
+        self.value = (np.zeros(shape, np.float32) if init is None
+                      else np.asarray(init, np.float32).reshape(shape))
+        self.lr = lr
+        self.optimizer = optimizer
+        self._accum = np.zeros_like(self.value) if optimizer == "adagrad" else None
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad):
+        grad = np.asarray(grad, np.float32).reshape(self.value.shape)
+        with self._lock:
+            if self.optimizer == "adagrad":
+                self._accum += grad * grad
+                self.value -= self.lr * grad / (np.sqrt(self._accum) + 1e-10)
+            else:
+                self.value -= self.lr * grad
+
+
+class SparseTable:
+    """id -> row table with lazy initialisation (the big-embedding case)."""
+
+    def __init__(self, name, dim, lr=0.01, optimizer="sgd",
+                 initializer="uniform", init_scale=0.01, seed=0):
+        self.name = name
+        self.dim = dim
+        self.lr = lr
+        self.optimizer = optimizer
+        self.rows = {}
+        self._accum = {}
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer
+        self._scale = init_scale
+        self._lock = threading.Lock()
+
+    def _new_row(self):
+        if self._init == "zeros":
+            return np.zeros(self.dim, np.float32)
+        return self._rng.uniform(
+            -self._scale, self._scale, self.dim).astype(np.float32)
+
+    def pull(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        with self._lock:
+            out = np.empty((len(ids), self.dim), np.float32)
+            for i, r in enumerate(ids):
+                key = int(r)
+                if key not in self.rows:
+                    self.rows[key] = self._new_row()
+                out[i] = self.rows[key]
+        return out
+
+    def push(self, ids, grads):
+        """Duplicate ids in one push are accumulated before the update
+        (the reference merges gradients by key in the worker sender)."""
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        merged = {}
+        for r, g in zip(ids, grads):
+            merged.setdefault(int(r), np.zeros(self.dim, np.float32))
+            merged[int(r)] += g
+        with self._lock:
+            for key, g in merged.items():
+                row = self.rows.setdefault(key, self._new_row())
+                if self.optimizer == "adagrad":
+                    acc = self._accum.setdefault(
+                        key, np.zeros(self.dim, np.float32))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-10)
+                else:
+                    row -= self.lr * g
+
+    def size(self):
+        with self._lock:
+            return len(self.rows)
+
+
+class PSServer:
+    """Holds tables; the request surface of the reference PsService."""
+
+    def __init__(self, index=0):
+        self.index = index
+        self.tables = {}
+        self._lock = threading.Lock()
+
+    # table management -------------------------------------------------
+    def create_dense_table(self, name, shape, **kw):
+        with self._lock:
+            if name not in self.tables:
+                self.tables[name] = DenseTable(name, shape, **kw)
+                self._load_table(name, self.tables[name])
+        return True
+
+    def create_sparse_table(self, name, dim, **kw):
+        with self._lock:
+            if name not in self.tables:
+                self.tables[name] = SparseTable(name, dim, **kw)
+                self._load_table(name, self.tables[name])
+        return True
+
+    # PsService verbs ---------------------------------------------------
+    def pull_dense(self, name):
+        return self.tables[name].pull()
+
+    def push_dense(self, name, grad):
+        self.tables[name].push(grad)
+        return True
+
+    def pull_sparse(self, name, ids):
+        return self.tables[name].pull(ids)
+
+    def push_sparse(self, name, ids, grads):
+        self.tables[name].push(ids, grads)
+        return True
+
+    def save(self, dirname):
+        """Persist values AND optimizer accumulators per table."""
+        os.makedirs(dirname, exist_ok=True)
+        for name, t in self.tables.items():
+            if isinstance(t, DenseTable):
+                np.savez(os.path.join(dirname, f"{name}.dense.npz"),
+                         value=t.value,
+                         accum=(t._accum if t._accum is not None
+                                else np.zeros((0,), np.float32)))
+            else:
+                ids = np.array(sorted(t.rows), np.int64)
+                vals = np.stack([t.rows[i] for i in ids]) if len(ids) else \
+                    np.zeros((0, t.dim), np.float32)
+                accums = np.stack(
+                    [t._accum.get(i, np.zeros(t.dim, np.float32))
+                     for i in ids]) if len(ids) else \
+                    np.zeros((0, t.dim), np.float32)
+                np.savez(os.path.join(dirname, f"{name}.sparse.npz"),
+                         ids=ids, vals=vals, accums=accums)
+        return True
+
+    def load(self, dirname):
+        """Restore existing tables from `dirname`, and remember it so
+        tables created LATER (the usual init_server-before-create order)
+        pick up their saved state on creation."""
+        self._pending_load = dirname
+        for name, t in self.tables.items():
+            self._load_table(name, t)
+        return True
+
+    def _load_table(self, name, t):
+        dirname = getattr(self, "_pending_load", None)
+        if dirname is None:
+            return
+        if isinstance(t, DenseTable):
+            p = os.path.join(dirname, f"{name}.dense.npz")
+            if os.path.exists(p):
+                z = np.load(p)
+                t.value = z["value"]
+                if z["accum"].size:
+                    t._accum = z["accum"]
+        else:
+            p = os.path.join(dirname, f"{name}.sparse.npz")
+            if os.path.exists(p):
+                z = np.load(p)
+                t.rows = {int(i): v for i, v in zip(z["ids"], z["vals"])}
+                if "accums" in z.files and z["accums"].size:
+                    t._accum = {int(i): a for i, a in
+                                zip(z["ids"], z["accums"])}
+
+
+# -- process-global server (the rpc handlers dispatch here) -----------------
+_GLOBAL_SERVER = None
+
+
+def get_global_server() -> PSServer:
+    global _GLOBAL_SERVER
+    if _GLOBAL_SERVER is None:
+        _GLOBAL_SERVER = PSServer()
+    return _GLOBAL_SERVER
+
+
+# module-level rpc handlers: pickled by reference, executed server-side
+def _rpc_create_dense(name, shape, kw):
+    return get_global_server().create_dense_table(name, shape, **kw)
+
+
+def _rpc_create_sparse(name, dim, kw):
+    return get_global_server().create_sparse_table(name, dim, **kw)
+
+
+def _rpc_pull_dense(name):
+    return get_global_server().pull_dense(name)
+
+
+def _rpc_push_dense(name, grad):
+    return get_global_server().push_dense(name, grad)
+
+
+def _rpc_pull_sparse(name, ids):
+    return get_global_server().pull_sparse(name, ids)
+
+
+def _rpc_push_sparse(name, ids, grads):
+    return get_global_server().push_sparse(name, ids, grads)
+
+
+def _rpc_save(dirname):
+    return get_global_server().save(dirname)
+
+
+_STOP_EVENT = threading.Event()
+
+
+def _rpc_stop():
+    """Remote shutdown verb (PsService stop_server): unparks
+    serve_forever in the server process."""
+    _STOP_EVENT.set()
+    return True
+
+
+def serve_forever(stop_event=None, poll_interval=0.5):
+    """Run-server loop (fleet.run_server): the rpc poller thread already
+    executes requests; parks until a local stop_event or the remote
+    `_rpc_stop` verb fires."""
+    import time
+
+    while not _STOP_EVENT.is_set() and (
+            stop_event is None or not stop_event.is_set()):
+        time.sleep(poll_interval)
+
+
+class PSClient:
+    """Worker-side stub: shards tables over servers, moves numpy.
+
+    `servers` is a list of rpc worker names (cross-process mode) or
+    PSServer objects (in-process mode — unit tests, single-node runs).
+    Dense tables land on `hash(name) % n`; sparse rows shard `id % n`.
+    """
+
+    def __init__(self, servers):
+        if not servers:
+            raise ValueError("PSClient needs at least one server")
+        self.servers = list(servers)
+        self.n = len(self.servers)
+
+    def _call(self, idx, fn, *args):
+        target = self.servers[idx]
+        if isinstance(target, PSServer):
+            local = {
+                _rpc_create_dense: lambda n_, s_, k_: target.create_dense_table(n_, s_, **k_),
+                _rpc_create_sparse: lambda n_, d_, k_: target.create_sparse_table(n_, d_, **k_),
+                _rpc_pull_dense: target.pull_dense,
+                _rpc_push_dense: target.push_dense,
+                _rpc_pull_sparse: target.pull_sparse,
+                _rpc_push_sparse: target.push_sparse,
+                _rpc_save: target.save,
+                _rpc_stop: lambda: True,  # in-process server: nothing parked
+            }
+            return local[fn](*args)
+        from ..rpc import rpc_sync
+
+        return rpc_sync(target, fn, args=args)
+
+    def _dense_server(self, name):
+        # stable across processes (str hash is PYTHONHASHSEED-randomized)
+        return zlib.crc32(name.encode()) % self.n
+
+    # dense -------------------------------------------------------------
+    def create_dense_table(self, name, shape, **kw):
+        return self._call(self._dense_server(name), _rpc_create_dense,
+                          name, shape, kw)
+
+    def pull_dense(self, name):
+        return self._call(self._dense_server(name), _rpc_pull_dense, name)
+
+    def push_dense(self, name, grad):
+        return self._call(self._dense_server(name), _rpc_push_dense,
+                          name, np.asarray(grad))
+
+    # sparse ------------------------------------------------------------
+    def create_sparse_table(self, name, dim, **kw):
+        for i in range(self.n):
+            self._call(i, _rpc_create_sparse, name, dim, kw)
+        return True
+
+    def pull_sparse(self, name, ids):
+        ids = np.asarray(ids).reshape(-1)
+        parts = {}
+        for i in range(self.n):
+            sel = np.nonzero(ids % self.n == i)[0]
+            if len(sel):
+                parts[i] = (sel, self._call(i, _rpc_pull_sparse, name,
+                                            ids[sel]))
+        dim = next(iter(parts.values()))[1].shape[1] if parts else 0
+        out = np.zeros((len(ids), dim), np.float32)
+        for sel, vals in parts.values():
+            out[sel] = vals
+        return out
+
+    def push_sparse(self, name, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        for i in range(self.n):
+            sel = np.nonzero(ids % self.n == i)[0]
+            if len(sel):
+                self._call(i, _rpc_push_sparse, name, ids[sel], grads[sel])
+        return True
+
+    def save(self, dirname):
+        for i in range(self.n):
+            self._call(i, _rpc_save, os.path.join(dirname, f"server{i}"))
+        return True
+
+    def stop_servers(self):
+        """fleet.stop_worker(): release every server's run_server park."""
+        for i in range(self.n):
+            self._call(i, _rpc_stop)
+        return True
+
+
+def sparse_embedding_lookup(client: PSClient, table: str, ids, dim: int):
+    """Distributed embedding lookup returning a device tensor whose
+    backward pushes row grads to the table (the sparse_embedding op).
+
+    Eager: pull -> to device; caller computes loss and calls
+    `push_sparse_grad(client, table, ids, grad_rows)` with the rows'
+    gradient (obtained from autograd on the returned tensor)."""
+    import paddle_tpu as paddle
+
+    rows = client.pull_sparse(table, np.asarray(ids).reshape(-1))
+    t = paddle.to_tensor(rows.reshape(list(np.asarray(ids).shape) + [dim]))
+    t.stop_gradient = False
+    return t
+
+
+def push_sparse_grad(client: PSClient, table: str, ids, grad):
+    g = np.asarray(grad, np.float32)
+    ids = np.asarray(ids).reshape(-1)
+    return client.push_sparse(table, ids, g.reshape(len(ids), -1))

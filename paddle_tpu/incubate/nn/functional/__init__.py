@@ -160,11 +160,15 @@ def fused_linear_cross_entropy(x, weight, labels, transpose_y=True,
     embedding layout) or [H, V]; labels: [...] int.
     """
     def _flce(h, w, y):
+        import os as _os
+
         H = h.shape[-1]
         hf = h.reshape(-1, H)
         yf = y.reshape(-1).astype(jnp.int32)
         n = hf.shape[0]
-        c = min(chunk_size, n)
+        # perf knob: bigger chunks = fewer serialized lax.map steps, more
+        # logits resident (O(chunk * vocab) fp32)
+        c = min(max(1, int(_os.environ.get("PTPU_CE_CHUNK", chunk_size))), n)
         pad = (-n) % c
         if pad:
             hf = jnp.concatenate([hf, jnp.zeros((pad, H), hf.dtype)])

@@ -5,6 +5,8 @@ test_hapi_lenet.py; config 4 (GPT mp2/pp2) in test_pipeline_parallel.py.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 
 def _fleet(cfg):
     from paddle_tpu.distributed import fleet

@@ -88,6 +88,7 @@ def test_cp_attention_grads(strategy):
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_context_parallel_attention_api():
     import paddle_tpu as paddle
     from paddle_tpu.distributed.context_parallel import (

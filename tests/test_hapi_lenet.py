@@ -2,6 +2,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 
 def test_lenet_mnist_model_fit():
     import paddle_tpu as paddle

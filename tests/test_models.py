@@ -3,6 +3,7 @@ import numpy as np
 import pytest
 
 
+@pytest.mark.slow
 def test_llama_generate_matches_forward():
     """KV-cache decode must agree with full-context argmax at every step."""
     import paddle_tpu as paddle

@@ -72,6 +72,7 @@ def test_moe_capacity_drops_overflow():
     assert (np.abs(vals).sum(axis=-1) < 1e-6).any()
 
 
+@pytest.mark.slow
 def test_moe_backward():
     import paddle_tpu as paddle
 

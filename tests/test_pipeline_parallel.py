@@ -295,6 +295,7 @@ def test_stacked_decoder_matches_layerwise():
     )
 
 
+@pytest.mark.slow
 def test_fleet_pipeline_train_batch():
     """pp=4 fleet: train the pipe model; loss must drop and match the
     pp=1 run step-for-step (same weights, same data)."""
@@ -344,6 +345,7 @@ def test_fleet_pipeline_train_batch():
     np.testing.assert_allclose(l_pp, l_ref, atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_fleet_pipeline_interleaved_train_batch():
     """VPP: pp=2 with 2 virtual stages per device matches the pp=1 run."""
     import paddle_tpu as paddle

@@ -57,6 +57,7 @@ def test_subpackage_surfaces_complete():
     assert problems == [], problems
 
 
+@pytest.mark.slow
 def test_cnn_model_zoo_forward():
     import paddle_tpu as paddle
     from paddle_tpu.vision import models as M
@@ -71,6 +72,7 @@ def test_cnn_model_zoo_forward():
         assert shape == (1, 10), (ctor.__name__, shape)
 
 
+@pytest.mark.slow
 def test_densenet_and_resnext_forward():
     import paddle_tpu as paddle
     from paddle_tpu.vision import models as M
