@@ -105,9 +105,11 @@ def _differentiable(leaf):
     return jnp.issubdtype(leaf._data.dtype, jnp.inexact)
 
 
-def _record_static(fn, leaves, arrays, treedef, out_tree):
+def _record_static(fn, leaves, arrays, treedef, out_tree, op_name=None):
     """Append a replayable closure to the active static Program (the
-    analogue of op-desc insertion, see paddle_tpu/static)."""
+    analogue of op-desc insertion, see paddle_tpu/static). The op name
+    resolves registry metadata (ops/registry.py) onto the record — the
+    program-level view of the reference's per-op YAML attrs."""
     from ..static import _active_program
 
     prog = _active_program()
@@ -125,7 +127,8 @@ def _record_static(fn, leaves, arrays, treedef, out_tree):
 
     out_leaves = [t for t in tree_util.tree_flatten(
         out_tree, is_leaf=_is_tensor)[0] if _is_tensor(t)]
-    prog._record(replay, [leaves[i] for i in tensor_pos], out_leaves)
+    prog._record(replay, [leaves[i] for i in tensor_pos], out_leaves,
+                 op_name=op_name)
 
 
 def apply_op(fn, *args, _op_name=None, **kwargs):
@@ -152,7 +155,8 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
         a2, k2 = tree_util.tree_unflatten(treedef, arrays)
         out = fn(*a2, **k2)
         wrapped = _wrap_outputs(out, node=None)
-        _record_static(fn, leaves, arrays, treedef, wrapped)
+        _record_static(fn, leaves, arrays, treedef, wrapped,
+                       op_name=name_for_amp)
         return wrapped
 
     def pure(diff_arrays):
@@ -175,9 +179,9 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
 
     out_leaves, out_treedef = tree_util.tree_flatten(out)
     out_avals = [(tuple(o.shape), np.dtype(o.dtype)) for o in out_leaves]
-    name = _op_name or getattr(fn, "__name__", "op")
     in_tensors = [leaves[i] for i in diff_pos]
-    node = GradNode(name, pure, in_arrays, in_tensors, edges, out_avals, out_treedef)
+    node = GradNode(name_for_amp, pure, in_arrays, in_tensors, edges,
+                    out_avals, out_treedef)
 
     wrapped = []
     for idx, o in enumerate(out_leaves):
@@ -187,7 +191,8 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
             t._out_index = idx
         wrapped.append(t)
     out_tree = tree_util.tree_unflatten(out_treedef, wrapped)
-    _record_static(fn, leaves, arrays, treedef, out_tree)
+    _record_static(fn, leaves, arrays, treedef, out_tree,
+                   op_name=name_for_amp)
     return out_tree
 
 

@@ -34,23 +34,29 @@ NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() exact zero
                  # without nan from (-inf) - (-inf) in the rescale path
 
 
-def _block_for(s: int):
+def _block_for(s: int, env="PTPU_FA_BLOCK", default=1024):
     """Pick a seq block size whose lse/delta blocks satisfy Mosaic's
     last-dim tiling (multiple of 128, or the full dimension).
-    PTPU_FA_BLOCK overrides the preferred size (perf knob; measured on v5e
-    at seq 2048 end-to-end 1.3B pretrain: 1024 > 512 by 4.3%, 512 > 256/128
-    by 17%/40% — bigger q/k tiles amortise the VMEM streaming)."""
+    PTPU_FA_BLOCK / PTPU_FA_BWD_BLOCK override the preferred fwd/bwd sizes
+    (perf knobs; measured on v5e at seq 2048 end-to-end 1.3B pretrain:
+    fwd 1024 > 512 by 4.3%, 512 > 256/128 by 17%/40% — bigger q/k tiles
+    amortise the VMEM streaming; the bwd kernels hold more live blocks so
+    their sweet spot can differ)."""
     import os
 
-    pref = int(os.environ.get("PTPU_FA_BLOCK", "1024"))
+    pref = int(os.environ.get(env, default))
     if pref % 128:
-        pref = 1024  # Mosaic tiling requires multiples of 128
+        pref = default  # Mosaic tiling requires multiples of 128
     if s <= 512:
         return s  # full-dim block (always tileable at these sizes)
     for b in (pref, 1024, 512, 256, 128):
         if b % 128 == 0 and s % b == 0:
             return b
     return None
+
+
+def _bwd_block_for(s: int):
+    return _block_for(s, env="PTPU_FA_BWD_BLOCK", default=512)
 
 
 def supported_seq(s: int) -> bool:
@@ -288,13 +294,6 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
-    bhq, sq, d = q.shape
-    bhk, sk, _ = k.shape
-    bq, bk = _block_for(sq), _block_for(sk)
-    nq, nk = sq // bq, sk // bk
-    rep = hq // hk
-    offset = sk - sq
-
     with jax.enable_x64(False):
         return _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret,
                          hq, hk)
@@ -303,7 +302,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
     bhq, sq, d = q.shape
     bhk, sk, _ = k.shape
-    bq, bk = _block_for(sq), _block_for(sk)
+    bq, bk = _bwd_block_for(sq), _bwd_block_for(sk)
     nq, nk = sq // bq, sk // bk
     rep = hq // hk
     offset = sk - sq

@@ -1,0 +1,168 @@
+"""Declarative op registry — per-op metadata the reference keeps in YAML.
+
+Parity slot: `paddle/phi/ops/yaml/ops.yaml` + `legacy_ops.yaml` (args,
+infer_meta, kernel, inplace contracts, backward names) and the codegen that
+consumes them. The TPU design needs none of the codegen (apply_op + jax
+tracing replace generated wrappers, `jax.eval_shape` replaces InferMeta,
+XLA replaces kernel selection), so what remains *useful* from the YAML is
+the queryable metadata itself:
+
+- **inplace contracts**: which public ops mutate their first argument
+  (`x -> out` aliasing). The reference encodes `inplace: (x -> out)` per
+  YAML entry; here every trailing-underscore Tensor method must have a
+  registered contract, enforced by `tests/test_op_registry.py`.
+- **spmd_rule**: the per-op sharding rule name, resolving into
+  `distributed/spmd_rules.py` (the analogue of the YAML's `spmd_rule:`
+  field added for auto-parallel).
+- **backward**: whether the op is differentiable on the tape.
+- **tags**: coarse grouping (math/manipulation/creation/...) used by the
+  surface sweeps.
+
+`get_op_spec(name)` is the lookup the rest of the framework uses (e.g.
+static Program recording annotates ops; tests enforce coverage).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["OpSpec", "register_op", "get_op_spec", "registered_ops"]
+
+
+@dataclass(frozen=True)
+class OpSpec:
+    name: str
+    inplace: dict = field(default_factory=dict)   # {"x": "out"} aliasing
+    spmd_rule: str | None = None                  # name in spmd_rules registry
+    backward: bool = True                         # differentiable on the tape
+    tags: tuple = ()
+
+
+_REGISTRY: dict[str, OpSpec] = {}
+
+
+def register_op(name, inplace=None, spmd_rule=None, backward=True, tags=()):
+    spec = OpSpec(name, dict(inplace or {}), spmd_rule, backward,
+                  tuple(tags))
+    _REGISTRY[name] = spec
+    return spec
+
+
+def get_op_spec(name) -> OpSpec | None:
+    return _REGISTRY.get(name)
+
+
+def registered_ops():
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# registry population
+# ---------------------------------------------------------------------------
+_ELEMENTWISE_UNARY = [
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil", "cos",
+    "cosh", "digamma", "erf", "erfinv", "exp", "expm1", "floor", "frac",
+    "i0", "lgamma", "log", "log10", "log1p", "log2", "logit", "neg",
+    "reciprocal", "round", "rsqrt", "sigmoid", "sin", "sinc", "sinh",
+    "sqrt", "square", "tan", "tanh", "trunc", "nan_to_num", "polygamma",
+    "multigammaln", "gammaln",
+]
+_ELEMENTWISE_BINARY = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "floor_mod",
+    "mod", "remainder", "pow", "maximum", "minimum", "copysign", "hypot",
+    "ldexp", "lerp", "gammainc", "gammaincc",
+]
+_LOGIC = [
+    "equal", "not_equal", "greater_equal", "greater_than", "less",
+    "less_equal", "less_than", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_invert", "bitwise_left_shift", "bitwise_right_shift", "isclose",
+    "allclose", "isnan", "isinf", "isfinite",
+]
+_RANDOM_INPLACE = [
+    "bernoulli", "cauchy", "exponential", "geometric", "log_normal",
+    "normal", "uniform",
+]
+_MANIP_INPLACE = [
+    "reshape", "squeeze", "unsqueeze", "flatten", "t", "tril", "triu",
+    "clip", "scale", "cast", "fill", "zero", "fill_diagonal", "index_add",
+    "index_fill", "index_put", "masked_fill", "masked_scatter", "scatter",
+    "where", "cumsum", "cumprod", "renorm", "addmm", "gcd", "lcm",
+    "detach", "copy", "grad",
+]
+_NONDIFF = set(_LOGIC) | {
+    "bernoulli", "gcd", "lcm", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "argmax", "argmin", "argsort",
+}
+
+for _n in _ELEMENTWISE_UNARY:
+    register_op(_n, spmd_rule="elementwise", tags=("math", "unary"))
+for _n in _ELEMENTWISE_BINARY:
+    register_op(_n, spmd_rule="elementwise", tags=("math", "binary"))
+for _n in _LOGIC:
+    register_op(_n, spmd_rule="elementwise", backward=False, tags=("logic",))
+for _n in _RANDOM_INPLACE:
+    register_op(_n, backward=False, tags=("random",))
+for _n in _MANIP_INPLACE:
+    if _n not in _REGISTRY:
+        register_op(_n, tags=("manipulation",))
+
+# structural / compute ops with dedicated spmd rules
+register_op("matmul", spmd_rule="matmul", tags=("linalg",))
+register_op("einsum", spmd_rule="einsum", tags=("linalg",))
+register_op("embedding", spmd_rule="embedding", tags=("nn",))
+register_op("c_embedding", spmd_rule="c_embedding", tags=("nn", "dist"))
+register_op("softmax", spmd_rule="softmax", tags=("nn",))
+register_op("log_softmax", spmd_rule="softmax", tags=("nn",))
+register_op("layer_norm", spmd_rule="layer_norm", tags=("nn",))
+register_op("rms_norm", spmd_rule="rms_norm", tags=("nn",))
+register_op("dropout", spmd_rule="dropout", tags=("nn",))
+register_op("cross_entropy_with_softmax",
+            spmd_rule="cross_entropy_with_softmax", tags=("loss",))
+register_op("flash_attention", spmd_rule="flash_attention", tags=("nn",))
+register_op("moe_gate", spmd_rule="moe_gate", backward=True, tags=("moe",))
+register_op("moe_dispatch", spmd_rule="moe_dispatch", tags=("moe",))
+register_op("transpose", spmd_rule="transpose", tags=("manipulation",))
+register_op("concat", spmd_rule="concat", tags=("manipulation",))
+register_op("split", spmd_rule="split", tags=("manipulation",))
+register_op("slice", spmd_rule="slice", tags=("manipulation",))
+register_op("stack", spmd_rule="stack", tags=("manipulation",))
+register_op("tile", spmd_rule="tile", tags=("manipulation",))
+register_op("gather", spmd_rule="gather", tags=("indexing",))
+register_op("topk", spmd_rule="topk", tags=("search",))
+register_op("argmax", spmd_rule="argmax", backward=False, tags=("search",))
+register_op("sum", spmd_rule="reduction", tags=("math", "reduce"))
+register_op("mean", spmd_rule="reduction", tags=("math", "reduce"))
+register_op("max", spmd_rule="reduction", tags=("math", "reduce"))
+register_op("min", spmd_rule="reduction", tags=("math", "reduce"))
+register_op("prod", spmd_rule="reduction", tags=("math", "reduce"))
+
+# inplace-only framework verbs without out-of-place public variants
+register_op("set_value", inplace={"x": "out"}, backward=False,
+            tags=("framework",))
+
+# Every op with an `x_` Tensor-method variant carries the x->out inplace
+# contract (the YAML `inplace:` field). Applied LAST so dedicated
+# registrations above don't drop it. Ops registered above WITHOUT a
+# trailing-underscore method are excluded — a contract on a method that
+# doesn't exist would be a lie.
+_NO_INPLACE_METHOD = {
+    "isnan", "isinf", "isfinite", "allclose", "isclose",
+    "acosh", "asinh", "atanh", "maximum", "minimum",
+}
+_INPLACE_VARIANTS = [
+    n for n in (_ELEMENTWISE_UNARY + _ELEMENTWISE_BINARY + _LOGIC
+                + _RANDOM_INPLACE + _MANIP_INPLACE)
+    if n not in _NO_INPLACE_METHOD
+]
+for _n in _INPLACE_VARIANTS:
+    _spec = _REGISTRY.get(_n)
+    if _spec is not None:
+        _REGISTRY[_n] = OpSpec(_spec.name, {"x": "out"}, _spec.spmd_rule,
+                               _spec.backward, _spec.tags)
+
+# non-differentiable ops that the grouped loops registered backward=True
+for _n in _NONDIFF:
+    _spec = _REGISTRY.get(_n)
+    if _spec is not None and _spec.backward:
+        _REGISTRY[_n] = OpSpec(_spec.name, _spec.inplace, _spec.spmd_rule,
+                               False, _spec.tags)

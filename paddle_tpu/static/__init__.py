@@ -32,12 +32,24 @@ class Program:
     def __init__(self):
         self.feeds = {}        # name -> placeholder Tensor
         self.records = []      # (replay_fn, in_tensors, out_tensors)
+        self._op_names = []    # op name per record (registry metadata key)
         self._minimize = None  # (optimizer, loss Tensor)
         self.random_seed = None
 
     # -- recording hooks (called from core.dispatch.apply_op) -------------
-    def _record(self, replay_fn, in_tensors, out_tensors):
+    def _record(self, replay_fn, in_tensors, out_tensors, op_name=None):
         self.records.append((replay_fn, list(in_tensors), list(out_tensors)))
+        self._op_names.append(op_name or getattr(replay_fn, "__name__", "op"))
+
+    def op_names(self):
+        """Recorded op names in program order (framework.Program.ops)."""
+        return list(self._op_names)
+
+    def op_specs(self):
+        """(name, OpSpec|None) per recorded op — the YAML metadata view."""
+        from ..ops.registry import get_op_spec
+
+        return [(n, get_op_spec(n)) for n in self._op_names]
 
     def trainable_params(self):
         seen, out = set(), []
@@ -61,6 +73,7 @@ class Program:
         p = Program()
         p.feeds = dict(self.feeds)
         p.records = list(self.records)
+        p._op_names = list(self._op_names)
         if not for_test:
             p._minimize = self._minimize
         return p

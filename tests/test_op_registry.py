@@ -1,0 +1,104 @@
+"""Declarative op registry enforcement.
+
+Parity: the reference's ops.yaml metadata (`paddle/phi/ops/yaml/ops.yaml`
+`inplace:` / `spmd_rule:` fields). The registry must stay in sync with the
+actual API: every trailing-underscore Tensor method needs a registered
+inplace contract, and every named spmd_rule must resolve.
+"""
+import paddle_tpu as paddle
+from paddle_tpu.ops.registry import get_op_spec, registered_ops
+
+
+def _inplace_tensor_methods():
+    return sorted(
+        m[:-1] for m in dir(paddle.Tensor)
+        if m.endswith("_") and not m.startswith("_")
+    )
+
+
+def test_every_inplace_method_has_contract():
+    missing = []
+    for base in _inplace_tensor_methods():
+        spec = get_op_spec(base)
+        if spec is None or not spec.inplace:
+            missing.append(base + "_")
+    assert not missing, (
+        f"{len(missing)} inplace Tensor methods lack a registered "
+        f"inplace contract: {missing}")
+
+
+def test_spmd_rule_names_resolve():
+    from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+    for name, spec in registered_ops().items():
+        if spec.spmd_rule is not None:
+            rule = get_spmd_rule(spec.spmd_rule)  # raises KeyError if absent
+            assert rule.name == spec.spmd_rule
+
+
+def test_registered_public_ops_exist():
+    """Every registered non-framework op resolves somewhere in the public
+    API: paddle.<name>, Tensor.<name>, or nn.functional.<name>."""
+    import paddle_tpu.nn.functional as F
+
+    missing = []
+    for name, spec in registered_ops().items():
+        if "framework" in spec.tags or "dist" in spec.tags or \
+                "moe" in spec.tags:
+            continue
+        aliases = {
+            "neg": "neg", "cross_entropy_with_softmax": "cross_entropy",
+            "rms_norm": "rms_norm", "flash_attention":
+                "scaled_dot_product_attention", "moe_gate": None,
+            "c_embedding": None,
+        }
+        target = aliases.get(name, name)
+        if target is None:
+            continue
+        if (hasattr(paddle, target) or hasattr(paddle.Tensor, target)
+                or hasattr(F, target)
+                or hasattr(paddle.Tensor, target + "_")):  # inplace-only ops
+            continue
+        missing.append(name)
+    assert not missing, missing
+
+
+def test_backward_flags_consistent():
+    """Logic/compare ops must be marked non-differentiable."""
+    for name in ("equal", "logical_and", "bitwise_or", "isnan", "argmax"):
+        assert get_op_spec(name).backward is False
+    for name in ("matmul", "softmax", "add", "exp"):
+        assert get_op_spec(name).backward is True
+
+
+def test_static_program_records_op_metadata():
+    """Program.op_specs() exposes per-op registry metadata (the
+    framework.Program.ops + YAML attrs view)."""
+    import numpy as np
+
+    import paddle_tpu.static as static
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 3], "float32")
+        y = paddle.exp(x)
+        z = paddle.matmul(y, paddle.transpose(y, [1, 0]))
+    names = main.op_names()
+    assert any("exp" in n for n in names), names
+    assert any("matmul" in n for n in names), names
+    specs = dict(main.op_specs())
+    matmul_key = next(n for n in names if "matmul" in n)
+    if specs.get(matmul_key) is not None:
+        assert specs[matmul_key].spmd_rule == "matmul"
+
+
+def test_inplace_contract_matches_semantics():
+    """Spot-check: the contract's aliasing is what the method really does."""
+    import numpy as np
+
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    out = t.add_(paddle.to_tensor(np.ones((2, 2), np.float32)))
+    assert out is t or np.allclose(out.numpy(), t.numpy())
+    assert get_op_spec("add").inplace == {"x": "out"}
+    np.testing.assert_allclose(t.numpy(), 2.0)
