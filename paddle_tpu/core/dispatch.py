@@ -131,6 +131,25 @@ def _record_static(fn, leaves, arrays, treedef, out_tree, op_name=None):
                  op_name=op_name)
 
 
+def _check_nan_inf(op_name, out):
+    """FLAGS_check_nan_inf eager hook: after every op, sync and verify all
+    float outputs are finite, raising with the op's name (reference:
+    nan_inf_utils per-kernel check, enabled by the same flag). Off by
+    default — the flag read is the only cost."""
+    from ..utils.flags import get_flags
+
+    if not get_flags("check_nan_inf")["check_nan_inf"]:
+        return
+    for leaf in tree_util.tree_leaves(out):
+        if isinstance(leaf, jax.core.Tracer):
+            return  # inside a trace: the checkify-instrumented step covers it
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(leaf))):
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: op '{op_name}' produced nan/inf "
+                    f"(shape {tuple(leaf.shape)}, dtype {leaf.dtype})")
+
+
 def apply_op(fn, *args, _op_name=None, **kwargs):
     """Run pure jax function `fn` over (args, kwargs) that may contain Tensors.
 
@@ -154,6 +173,7 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
     if not diff_pos:
         a2, k2 = tree_util.tree_unflatten(treedef, arrays)
         out = fn(*a2, **k2)
+        _check_nan_inf(name_for_amp, out)
         wrapped = _wrap_outputs(out, node=None)
         _record_static(fn, leaves, arrays, treedef, wrapped,
                        op_name=name_for_amp)
@@ -168,6 +188,7 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
 
     in_arrays = [arrays[i] for i in diff_pos]
     out = pure(in_arrays)
+    _check_nan_inf(name_for_amp, out)
 
     edges = []
     for i in diff_pos:

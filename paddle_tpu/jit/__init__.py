@@ -59,7 +59,8 @@ class StaticFunction:
     warning instead of a hard failure.
     """
 
-    def __init__(self, function, input_spec=None, **kwargs):
+    def __init__(self, function, input_spec=None,
+                 bucket_dynamic_shapes=False, **kwargs):
         if isinstance(function, Layer):
             self._layer = function
             self._fn = None
@@ -69,6 +70,56 @@ class StaticFunction:
         self._input_spec = input_spec
         self._compiled = {}
         self._fallback_warned = False
+        # dynamic-dim bucketing (SURVEY hard-part 6): dims declared
+        # None/-1 in input_spec are padded up to the next power of two, so
+        # a stream of varying lengths costs O(log) compilations instead of
+        # one per shape. Opt-in: padding changes values for ops that
+        # reduce over the padded region — the caller owns masking, exactly
+        # like the reference's dynamic-shape dy2st deployments pad inputs.
+        self._bucket_axes = None
+        if bucket_dynamic_shapes and input_spec is not None:
+            from ..static import InputSpec
+
+            axes = []
+            for spec in (input_spec if isinstance(input_spec, (list, tuple))
+                         else [input_spec]):
+                if isinstance(spec, InputSpec):
+                    axes.append(tuple(
+                        i for i, d in enumerate(spec.shape)
+                        if d is None or d == -1))
+                else:
+                    axes.append(())
+            self._bucket_axes = axes
+
+    @staticmethod
+    def _next_bucket(n):
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def _bucketize(self, raw_args):
+        if self._bucket_axes is None:
+            return raw_args
+        import numpy as _np
+
+        out = []
+        for i, a in enumerate(raw_args):
+            axes = (self._bucket_axes[i]
+                    if i < len(self._bucket_axes) else ())
+            if axes and hasattr(a, "shape"):
+                pad = [(0, 0)] * a.ndim
+                needs = False
+                for ax in axes:
+                    tgt = self._next_bucket(a.shape[ax])
+                    if tgt != a.shape[ax]:
+                        pad[ax] = (0, tgt - a.shape[ax])
+                        needs = True
+                if needs:
+                    a = jnp.pad(a, pad) if not isinstance(a, _np.ndarray) \
+                        else _np.pad(a, pad)
+            out.append(a)
+        return tuple(out)
 
     def _trace_key(self, raw_args, raw_kwargs):
         training = self._layer.training if self._layer is not None else False
@@ -125,8 +176,15 @@ class StaticFunction:
         return fn(*args, **kwargs)
 
     def __call__(self, *args, **kwargs):
-        raw_args = _unwrap_tensors(args)
+        raw_args = self._bucketize(_unwrap_tensors(args))
         raw_kwargs = _unwrap_tensors(kwargs)
+        if self._bucket_axes is not None and any(
+                hasattr(v, "shape") for v in
+                tree_util.tree_leaves(raw_kwargs)):
+            raise ValueError(
+                "bucket_dynamic_shapes: input_spec maps to POSITIONAL "
+                "arguments only — pass tensors positionally so they can "
+                "be padded to their bucket")
         key = self._trace_key(raw_args, raw_kwargs)
         if self._compiled.get(key, False) is None:  # known graph break
             return self._eager_call(args, kwargs)
@@ -177,11 +235,11 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
 
     def decorate(fn):
         if isinstance(fn, Layer):
-            static = StaticFunction(fn, input_spec)
+            static = StaticFunction(fn, input_spec, **kwargs)
             # wrap the layer: calling the proxy runs the compiled path while
             # attribute access (parameters, state_dict...) hits the layer
             return _StaticLayerProxy(fn, static)
-        return functools.wraps(fn)(StaticFunction(fn, input_spec))
+        return functools.wraps(fn)(StaticFunction(fn, input_spec, **kwargs))
 
     if function is not None:
         return decorate(function)
@@ -294,11 +352,34 @@ class TrainStep:
             new_params, new_opt_state = opt.functional_update(params, grads, opt_state, lr)
             return loss, new_params, new_buffers, new_opt_state
 
-        self._compiled = jax.jit(step, donate_argnums=(0, 2))
+        from ..utils.flags import get_flags
+
+        if get_flags("check_nan_inf")["check_nan_inf"]:
+            # FLAGS_check_nan_inf inside the COMPILED step: checkify
+            # instruments every float op so the raised error names the
+            # first NaN-producing primitive and its traceback — the
+            # compiled-mode analogue of the reference's per-kernel
+            # CheckNumerics pass (paddle/fluid/framework/details/
+            # nan_inf_utils_detail). Costs extra compute; debug-only.
+            from jax.experimental import checkify
+
+            self._checkified = True
+            # NO buffer donation in debug mode: on a nan error the step's
+            # outputs are discarded and the caller must still be able to
+            # inspect the pre-step params/opt-state
+            self._compiled = jax.jit(
+                checkify.checkify(step, errors=checkify.float_checks))
+        else:
+            self._checkified = False
+            self._compiled = jax.jit(step, donate_argnums=(0, 2))
 
     def __call__(self, *batch):
-        if self._compiled is None:
-            self._build()
+        from ..utils.flags import get_flags
+
+        want_check = bool(get_flags("check_nan_inf")["check_nan_inf"])
+        if self._compiled is None or want_check != getattr(
+                self, "_checkified", False):
+            self._build()  # flag flipped since last compile: rebuild
         entries = self.model.state_dict()
         params = {n: entries[n]._data for n in self._param_names}
         buffers = {n: entries[n]._data for n in self._buffer_names}
@@ -307,9 +388,18 @@ class TrainStep:
         lr = self.optimizer.get_lr()
         key_arr = framework.next_rng_key()
         raw_batch = _unwrap_tensors(batch)
-        loss, new_params, new_buffers, self._opt_state = self._compiled(
-            params, buffers, self._opt_state, lr, key_arr, raw_batch
-        )
+        if self._checkified:
+            err, out = self._compiled(params, buffers, self._opt_state, lr,
+                                      key_arr, raw_batch)
+            # raise BEFORE adopting any of the step's outputs: params,
+            # buffers, and opt state all stay at their pre-step values so
+            # the user can inspect or skip the batch
+            err.throw()
+            loss, new_params, new_buffers, self._opt_state = out
+        else:
+            loss, new_params, new_buffers, self._opt_state = self._compiled(
+                params, buffers, self._opt_state, lr, key_arr, raw_batch
+            )
         for n, arr in new_params.items():
             entries[n]._data = arr
         for n, arr in new_buffers.items():

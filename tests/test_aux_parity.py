@@ -102,6 +102,50 @@ def test_asp_2to4_masks():
     assert ((w2.reshape(-1, 4) != 0).sum(axis=1) <= 2).all()
 
 
+def test_asp_mask_2d_algorithms():
+    from paddle_tpu.incubate import asp
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 8)).astype(np.float32)
+
+    greedy = asp.get_mask_2d_greedy(w)
+    best = asp.get_mask_2d_best(w)
+    for mask in (greedy, best):
+        assert asp.check_mask_2d(mask)          # 2:4 in BOTH directions
+        assert mask.sum() == w.size / 2          # exactly half kept
+    # best is optimal: its kept magnitude >= greedy's in every block
+    assert (np.abs(w) * best).sum() >= (np.abs(w) * greedy).sum() - 1e-6
+    # 1d mask satisfies rows but generally not columns
+    m1 = asp.get_mask_1d(w)
+    assert asp.check_mask_1d(m1)
+
+
+def test_asp_excluded_layers_and_training_guarantee():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.incubate import asp
+
+    model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.reset_excluded_layers()
+    asp.set_excluded_layers(model, ["1"])       # second layer excluded
+    asp.prune_model(model, mask_algo="mask_2d_best")
+    assert abs(asp.calculate_density(model[0].weight) - 0.5) < 1e-6
+    assert asp.calculate_density(model[1].weight) > 0.9  # untouched
+    asp.reset_excluded_layers()
+
+    # masks survive several optimizer steps (sparsity guarantee)
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    for _ in range(3):
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (4, 8)).astype(np.float32))
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert asp.check_sparsity(model[0].weight, func_name="check_mask_2d")
+
+
 def test_auto_tuner_search():
     from paddle_tpu.distributed.auto_tuner import AutoTuner, generate_candidates
 

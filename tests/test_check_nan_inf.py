@@ -1,0 +1,75 @@
+"""FLAGS_check_nan_inf: per-op eager checks + checkify-instrumented
+compiled steps (parity: the reference flag + nan_inf_utils per-kernel
+checks; compiled mode localizes the first bad primitive via checkify).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.flags import set_flags
+
+
+@pytest.fixture
+def nan_checks():
+    set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestEagerChecks:
+    def test_bad_op_raises_with_op_name(self, nan_checks):
+        x = paddle.to_tensor(np.zeros((4,), np.float32))
+        with pytest.raises(FloatingPointError, match="divide"):
+            _ = paddle.divide(x, x)  # 0/0 -> nan
+
+    def test_log_of_negative(self, nan_checks):
+        x = paddle.to_tensor(np.array([-1.0], np.float32))
+        with pytest.raises(FloatingPointError, match="log"):
+            _ = paddle.log(x)
+
+    def test_finite_ops_pass(self, nan_checks):
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        y = paddle.exp(paddle.add(x, x))
+        assert np.isfinite(y.numpy()).all()
+
+    def test_flag_off_no_error(self):
+        x = paddle.to_tensor(np.zeros((4,), np.float32))
+        out = paddle.divide(x, x)
+        assert np.isnan(out.numpy()).all()  # silently nan, like eager math
+
+
+class TestCompiledStepChecks:
+    def _step(self):
+        from paddle_tpu import nn
+        from paddle_tpu.jit import TrainStep
+
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+
+        def train_fn(x, y):
+            pred = model(x)
+            return paddle.log(pred.sum() - y.sum())  # log of possibly <0
+
+        return model, TrainStep(model, train_fn, opt)
+
+    def test_checkified_step_raises_on_nan(self, nan_checks):
+        model, step = self._step()
+        # force log(negative): weights zero, y large positive
+        for p in model.parameters():
+            p.set_value(paddle.to_tensor(
+                np.zeros(p.shape, np.float32)))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = paddle.to_tensor(np.full((2, 4), 10.0, np.float32))
+        with pytest.raises(Exception, match="nan"):
+            step(x, y)
+
+    def test_checkified_step_passes_when_finite(self, nan_checks):
+        model, step = self._step()
+        for p in model.parameters():
+            p.set_value(paddle.to_tensor(
+                np.full(p.shape, 2.0, np.float32)))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        loss = step(x, y)
+        assert np.isfinite(loss.numpy())

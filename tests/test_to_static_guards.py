@@ -4,6 +4,8 @@ import warnings
 
 import numpy as np
 
+import paddle_tpu as paddle
+
 
 def test_graph_break_falls_back_to_eager():
     import paddle_tpu as paddle
@@ -120,3 +122,40 @@ def test_train_eval_mode_guard():
     out2 = model(x)
     np.testing.assert_allclose(np.asarray(out1.numpy()),
                                np.asarray(out2.numpy()), atol=1e-6)
+
+
+class TestDynamicDimBucketing:
+    """input_spec None/-1 dims + bucket_dynamic_shapes: varying lengths pad
+    to power-of-two buckets, bounding recompilation (SURVEY hard-part 6)."""
+
+
+    def test_bucketed_lengths_share_compilations(self):
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.static import InputSpec
+
+        def double(x):
+            return x * 2.0
+
+        fn = to_static(double,
+                       input_spec=[InputSpec([None, 4], "float32")],
+                       bucket_dynamic_shapes=True)
+        for n in (5, 6, 7):   # all pad to 8 -> ONE compilation
+            x = paddle.to_tensor(np.ones((n, 4), np.float32))
+            out = fn(x)
+            assert out.shape[0] == 8          # padded bucket shape
+            np.testing.assert_allclose(out.numpy()[:n], 2.0)
+            np.testing.assert_allclose(out.numpy()[n:], 0.0)  # zero pad
+        assert len(fn._compiled) == 1
+        out = fn(paddle.to_tensor(np.ones((9, 4), np.float32)))
+        assert out.shape[0] == 16
+        assert len(fn._compiled) == 2
+
+    def test_without_optin_each_shape_retraces(self):
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.static import InputSpec
+
+        fn = to_static(lambda x: x + 1.0,
+                       input_spec=[InputSpec([None, 4], "float32")])
+        for n in (5, 6, 7):
+            fn(paddle.to_tensor(np.ones((n, 4), np.float32)))
+        assert len(fn._compiled) == 3  # guard+retrace per shape (default)
