@@ -253,10 +253,15 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True):
     if use_rope:
         q, k = _rope_pure(q), _rope_pure(k)
     o = _sdpa_pure(q, k, v, causal=True).reshape(b, s, num_heads * hd)
-    # selective-remat anchor: with recompute_policy="attn" the backward pass
-    # reuses this tensor instead of re-running flash attention (the one block
-    # intermediate whose recompute is quadratic in seq)
-    o = checkpoint_name(o, "attn_out")
+    # selective-remat anchor for the XLA-fallback path: with
+    # recompute_policy="attn" the backward reuses this tensor instead of
+    # re-running attention (quadratic in seq). On the pallas path the
+    # custom_vjp residuals carry their own "attn_res"/"attn_lse" names —
+    # tagging here too would save the same activation twice, so skip.
+    from paddle_tpu.nn.functional.flash_attention import _use_pallas
+
+    if not _use_pallas(q.shape):
+        o = checkpoint_name(o, "attn_out")
     x = x + o @ wo
     h2 = _rms_pure(x, ln2)
     ffn = checkpoint_name(jax.nn.silu(h2 @ wg) * (h2 @ wu), "ffn_out")
@@ -346,10 +351,10 @@ class StackedDecoder(nn.Layer):
                               .dots_with_no_batch_dims_saveable)
                 elif pol == "attn":
                     policy = jax.checkpoint_policies.save_only_these_names(
-                        "attn_out")
+                        "attn_out", "attn_res", "attn_lse")
                 elif pol == "attn_ffn":
                     policy = jax.checkpoint_policies.save_only_these_names(
-                        "attn_out", "ffn_out")
+                        "attn_out", "attn_res", "attn_lse", "ffn_out")
                 else:
                     policy = None
                 block = jax.checkpoint(block, policy=policy)

@@ -56,7 +56,9 @@ def _block_for(s: int, env="PTPU_FA_BLOCK", default=1024):
 
 
 def _bwd_block_for(s: int):
-    return _block_for(s, env="PTPU_FA_BWD_BLOCK", default=512)
+    # 1024 measured best once causally-skipped blocks stopped being
+    # fetched (the clamp halved bwd DMA volume; before it, 512 won)
+    return _block_for(s, env="PTPU_FA_BWD_BLOCK", default=1024)
 
 
 def supported_seq(s: int) -> bool:
@@ -162,19 +164,50 @@ def _fwd(q, k, v, scale, causal, interpret, hq, hk):
     # the int64 literals x64 promotion produces.
     with jax.enable_x64(False):
         o, lse = _fwd_call(kern, q, k, v, bhq, sq, sk, d, bq, bk, nq, nk,
-                           hq, hk, interpret)
+                           hq, hk, interpret, causal)
     return o, lse[:, 0, :]
 
 
+def _clamp_kv_j(j, i, bq, bk, offset):
+    """Causal fetch clamp: kv blocks past the diagonal are never computed
+    (pl.when guards), so point their index map at the LAST VALID block —
+    Mosaic skips the DMA when consecutive grid steps map the same block,
+    removing the wasted fetches entirely."""
+    jmax = jax.lax.div(
+        jax.lax.add(jax.lax.mul(i, jnp.int32(bq)),
+                    jnp.int32(bq - 1 + offset)),
+        jnp.int32(bk))
+    return jax.lax.min(j, jax.lax.max(jmax, jnp.int32(0)))
+
+
+def _clamp_qi(qi, jk, bq, bk, offset):
+    """Causal fetch clamp for the dkdv sweep: q blocks strictly above the
+    diagonal contribute nothing for kv block jk; clamp to the first valid."""
+    qi_min = jax.lax.max(
+        jnp.int32(0),
+        jax.lax.div(
+            jax.lax.sub(jax.lax.mul(jk, jnp.int32(bk)), jnp.int32(offset)),
+            jnp.int32(bq)))
+    return jax.lax.max(qi, qi_min)
+
+
 def _fwd_call(kern, q, k, v, bhq, sq, sk, d, bq, bk, nq, nk, hq, hk,
-              interpret):
+              interpret, causal):
+    if causal:
+        def kv_j(b, i, j):
+            return (_kv_index(b, hq, hk),
+                    _clamp_kv_j(j, i, bq, bk, sk - sq), 0)
+    else:
+        def kv_j(b, i, j):
+            return (_kv_index(b, hq, hk), j, 0)
+
     return pl.pallas_call(
         kern,
         grid=(bhq, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
+            pl.BlockSpec((1, bk, d), kv_j),
+            pl.BlockSpec((1, bk, d), kv_j),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -313,14 +346,21 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
     delta8 = jnp.broadcast_to(delta[:, None, :],
                               (delta.shape[0], 8, delta.shape[1]))
 
+    if causal:
+        def _dq_kv_j(b, i, j):
+            return (_kv_index(b, hq, hk), _clamp_kv_j(j, i, bq, bk, offset), 0)
+    else:
+        def _dq_kv_j(b, i, j):
+            return (_kv_index(b, hq, hk), j, 0)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, offset=offset),
         grid=(bhq, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (_kv_index(b, hq, hk), j, 0)),
+            pl.BlockSpec((1, bk, d), _dq_kv_j),
+            pl.BlockSpec((1, bk, d), _dq_kv_j),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
@@ -339,18 +379,26 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
         hi = b % hk
         return bi * hq + hi * rep + j // nq
 
+    if causal:
+        def _qi_of(jk, j):
+            return _clamp_qi(jax.lax.rem(j, jnp.int32(nq)), jk, bq, bk,
+                             offset)
+    else:
+        def _qi_of(jk, j):
+            return jax.lax.rem(j, jnp.int32(nq))
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, nq_total=rep * nq,
                           offset=offset),
         grid=(bhk, nk, rep * nq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, jk, j: (_q_index(b, j), j % nq, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, jk, j: (_q_index(b, j), _qi_of(jk, j), 0)),
             pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
             pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, jk, j: (_q_index(b, j), j % nq, 0)),
-            pl.BlockSpec((1, 8, bq), lambda b, jk, j: (_q_index(b, j), 0, j % nq)),
-            pl.BlockSpec((1, 8, bq), lambda b, jk, j: (_q_index(b, j), 0, j % nq)),
+            pl.BlockSpec((1, bq, d), lambda b, jk, j: (_q_index(b, j), _qi_of(jk, j), 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, jk, j: (_q_index(b, j), 0, _qi_of(jk, j))),
+            pl.BlockSpec((1, 8, bq), lambda b, jk, j: (_q_index(b, j), 0, _qi_of(jk, j))),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
@@ -381,6 +429,16 @@ def _flash(q, k, v, scale, causal, interpret, hq, hk):
 
 def _flash_fwd_rule(q, k, v, scale, causal, interpret, hq, hk):
     o, lse = _fwd(q, k, v, scale, causal, interpret, hq, hk)
+    # name the residuals for selective remat: with a policy saving
+    # attn_res/attn_lse the backward reuses them instead of re-running
+    # this kernel just to regenerate lse (o is b*s*h*d, lse a tiny f32
+    # sidecar — saving both removes a full fwd-kernel launch per layer
+    # from the backward pass). Distinct from the model-level "attn_out"
+    # tag so the two never double-save the same activation.
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "attn_res")
+    lse = checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, o, lse)
 
 
