@@ -120,6 +120,8 @@ def ulysses_attention(q, k, v, axis_name="sep", causal=False, scale=None,
 
     qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
     if attn_fn is None:
+        attn_fn = _default_local_attn(qg.shape)
+    if attn_fn is None:
         sq = qg.shape[1]
         mask = None
         if causal:
@@ -130,6 +132,21 @@ def ulysses_attention(q, k, v, axis_name="sep", causal=False, scale=None,
     else:
         og = attn_fn(qg, kg, vg, causal=causal, scale=scale)
     return head_to_seq(og)
+
+
+def _default_local_attn(q_shape):
+    """Pick the local-attention kernel for the post-exchange block: the
+    differentiable pallas flash kernel on TPU when the shape tiles (it runs
+    fine inside shard_map — kernels are per-device), else None for the
+    jnp online-softmax fallback. Eligibility is THE shared `_use_pallas`
+    predicate so the dispatch never drifts from the kernel's constraints."""
+    from ..nn.functional.flash_attention import _use_pallas
+
+    if _use_pallas(q_shape):
+        from ..ops.pallas import flash_attention as _flash_kernel
+
+        return _flash_kernel
+    return None
 
 
 # ------------------------------------------------------------------ API level
@@ -171,7 +188,12 @@ def _mapped_cp(jmesh, strategy, causal, axis_name):
     compilation cache instead of retracing."""
     fn = ring_attention if strategy == "ring" else ulysses_attention
     spec = PartitionSpec(None, axis_name, None, None)
+    # check_vma=False only where needed: the ulysses path may run the
+    # pallas flash kernel, whose out_shape can't annotate varying mesh
+    # axes; ring keeps shard_map's vma verification
+    kw = {"check_vma": False} if strategy == "ulysses" else {}
     return jax.shard_map(
         functools.partial(fn, axis_name=axis_name, causal=causal),
         mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
+        **kw,
     )

@@ -231,8 +231,22 @@ def _rms_pure(x, w, eps=1e-6):
 
 
 def _sdpa_pure(q, k, v, causal=True):
-    from paddle_tpu.nn.functional.flash_attention import sdpa_arrays
+    """Flagship attention dispatch. Calls the pallas kernel DIRECTLY when
+    `_use_pallas` holds (no silent try/except fallback: a kernel failure
+    here must be loud, because the selective-remat anchors in `_block_pure`
+    are chosen from the same predicate and a silent fallback would leave
+    attention with no saved residual at all)."""
+    from paddle_tpu.nn.functional.flash_attention import (
+        _constrain_heads_over_mp,
+        _use_pallas,
+        sdpa_arrays,
+    )
 
+    if _use_pallas(q.shape):
+        from paddle_tpu.ops.pallas import flash_attention as _flash_kernel
+
+        q, k, v = _constrain_heads_over_mp(q, k, v)
+        return _flash_kernel(q, k, v, causal=causal)
     return sdpa_arrays(q, k, v, causal=causal)
 
 
