@@ -119,8 +119,7 @@ def ulysses_attention(q, k, v, axis_name="sep", causal=False, scale=None,
                                   tiled=True)
 
     qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
-    if attn_fn is None:
-        attn_fn = _default_local_attn(qg.shape)
+    attn_fn = attn_fn or _default_local_attn(qg.shape)
     if attn_fn is None:
         sq = qg.shape[1]
         mask = None

@@ -219,6 +219,27 @@ class ElasticManager:
         gen = self.generation() if gen is None else gen
         self._store.set(f"elastic/gen/{gen}/world", str(world).encode())
 
+    # -- scale-out ----------------------------------------------------------
+    def request_join(self):
+        """A (re)joining member asks the supervisor to grow the world at
+        the next re-rendezvous (manager.py scale-out: a pod re-registers
+        and the job restarts with the larger world). The supervisor's
+        store is the launcher's PADDLE_ELASTIC_ENDPOINT."""
+        if not self.enabled:
+            return 0
+        return self._store.add("elastic/join_requests", 1)
+
+    def pending_join_requests(self) -> int:
+        if not self.enabled:
+            return 0
+        raw = self._store.get("elastic/join_requests")
+        return _store_int(raw) if raw else 0
+
+    def consume_join_requests(self, count):
+        """Supervisor: mark `count` join requests as honored."""
+        if self.enabled:
+            self._store.add("elastic/join_requests", -int(count))
+
     def exit(self, completed=True):
         self._stop = True
         if self._hb is not None:

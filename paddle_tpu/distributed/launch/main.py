@@ -109,15 +109,38 @@ def _spawn_ranks(args, node_rank, nproc, world, script_args, generation=0):
 
 
 def _launch_elastic(args, node_rank, nproc, min_world, script_args) -> None:
-    """Elastic (level 2) process supervision: scale-in re-rendezvous.
+    """Elastic (level 2) process supervision: scale-in AND scale-out
+    re-rendezvous.
 
     Capability parity: fleet/elastic/manager.py:462 `_match` + pod
     relaunch — on member death the job does NOT abort: the survivors are
     re-launched as a new *generation* with the shrunken world size (as
     long as it stays >= the `--nnodes lo` bound), and training resumes
-    from checkpoint. Generation numbers reach workers via
-    PADDLE_ELASTIC_GENERATION.
+    from checkpoint. Scale-out: a (re)joining member calls
+    ElasticManager.request_join() against the job store (`--master`);
+    the supervisor honors pending requests up to the original world by
+    relaunching the next generation larger. Generation numbers reach
+    workers via PADDLE_ELASTIC_GENERATION.
     """
+    # Dedicated supervisor store on an EPHEMERAL port — never the --master
+    # port, which rank 0 must bind for jax.distributed / rendezvous. The
+    # endpoint reaches workers via PADDLE_ELASTIC_ENDPOINT; external
+    # rejoiners get it out-of-band (it is printed on startup).
+    from ..fleet.elastic import _store_int
+    from ..store import TCPStore
+
+    store = TCPStore(host="127.0.0.1", port=0, is_master=True, world_size=1)
+    endpoint = f"127.0.0.1:{store.port}"
+    os.environ["PADDLE_ELASTIC_ENDPOINT"] = endpoint
+    sys.stderr.write(f"elastic: supervisor endpoint {endpoint}\n")
+
+    def _pending_joins():
+        raw = store.get("elastic/join_requests")
+        return _store_int(raw) if raw else 0
+
+    def _consume_joins(k):
+        store.add("elastic/join_requests", -int(k))
+
     world = nproc
     generation = 0
     relaunches = 0
@@ -128,6 +151,8 @@ def _launch_elastic(args, node_rank, nproc, min_world, script_args) -> None:
         # survivors may be blocked in a collective waiting for it, so
         # waiting for all ranks to exit would deadlock the job
         codes = [None] * world
+        scale_out = 0
+        last_join_check = 0.0
         while any(c is None for c in codes):
             time.sleep(0.2)
             codes = [p.poll() for p in procs]
@@ -139,9 +164,42 @@ def _launch_elastic(args, node_rank, nproc, min_world, script_args) -> None:
                     p.wait()
                 codes = [p.returncode for p in procs]
                 break
+            now = time.time()
+            if now - last_join_check > 0.3:
+                last_join_check = now
+                joins = _pending_joins()
+                if joins > 0:
+                    grow = min(joins, nproc - world)
+                    # consume EVERY pending request: capacity-exceeding
+                    # requests are discarded, not banked — a stale request
+                    # must never trigger a surprise re-rendezvous later
+                    _consume_joins(joins)
+                    if grow > 0:
+                        for p in procs:
+                            p.terminate()
+                        for p in procs:
+                            p.wait()
+                        codes = [p.returncode for p in procs]
+                        scale_out = grow
+                        break
         for lf in logs:
             lf.close()
+        if scale_out:
+            relaunches += 1  # scale-out counts against max_restart too:
+            if relaunches > args.max_restart:  # bounds join/term loops
+                sys.stderr.write(
+                    f"elastic: relaunch budget exhausted "
+                    f"({relaunches}/{args.max_restart})\n")
+                sys.exit(1)
+            generation += 1
+            world += scale_out
+            sys.stderr.write(
+                f"elastic: {scale_out} member(s) joined; re-rendezvous "
+                f"generation {generation} with world {world}\n")
+            time.sleep(0.3)
+            continue
         if all(c == 0 for c in codes):
+            store.close()
             return
         # terminated survivors (negative returncode from our SIGTERM) are
         # still members; only self-failed ranks count as dead

@@ -1,0 +1,53 @@
+"""Elastic scale-in THEN scale-out worker.
+
+gen 0 (world 3): last rank dies at step 2 -> scale-in.
+gen 1 (world 2): rank 0 files a join request at step 4 (the recovered
+member asking back in) -> supervisor scales out.
+gen 2 (world 3): everyone resumes from checkpoint and finishes.
+"""
+import json
+import os
+import signal
+import sys
+import time
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+gen = int(os.environ.get("PADDLE_ELASTIC_GENERATION", 0))
+
+# the supervisor terminates us for re-rendezvous; exit cleanly on SIGTERM
+signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+
+CKPT = "ckpt.json"
+TARGET = 60
+
+start = 0
+if os.path.exists(CKPT):
+    with open(CKPT) as f:
+        start = json.load(f)["step"]
+
+requested = False
+for step in range(start + 1, TARGET + 1):
+    time.sleep(0.05)
+    if rank == 0:
+        tmp = CKPT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "gen": gen, "world": world}, f)
+        os.replace(tmp, CKPT)
+    if gen == 0 and rank == world - 1 and step == 2:
+        sys.stderr.write(f"rank {rank}: simulating death at step {step}\n")
+        sys.exit(1)
+    if gen == 1 and rank == 0 and step >= start + 4 and not requested:
+        requested = True
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        from paddle_tpu.distributed.store import TCPStore
+
+        host, port = os.environ["PADDLE_ELASTIC_ENDPOINT"].split(":")
+        store = TCPStore(host=host, port=int(port), is_master=False,
+                         world_size=1)
+        mgr = ElasticManager(store=store)
+        mgr.request_join()
+        sys.stderr.write("rank 0: filed join request for the lost member\n")
+
+print(f"ELASTIC_OK rank={rank} world={world} gen={gen} start_step={start}",
+      flush=True)

@@ -120,3 +120,37 @@ def test_launcher_elastic_scale_in(tmp_path):
         assert "world=2" in ln
         start = int(ln.split("start_step=")[1])
         assert start >= 3, ln  # resumed from checkpoint, not from scratch
+
+
+@pytest.mark.slow
+def test_launcher_elastic_scale_out(tmp_path):
+    """Scale-in then scale-OUT: rank dies -> world 2; a join request via
+    the job store -> world back to 3; all three finish from checkpoint."""
+    worker = os.path.join(os.path.dirname(__file__), "launch_assets",
+                          "elastic_join_worker.py")
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    }
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "1", "--nproc_per_node", "3",
+         "--elastic_level", "2", "--max_restart", "4",
+         "--log_dir", str(tmp_path / "logs"),
+         worker],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=str(tmp_path),
+    )
+    logs = ""
+    for f in sorted((tmp_path / "logs").iterdir()):
+        logs += f"\n--- {f.name} ---\n" + f.read_text()
+    assert proc.returncode == 0, (proc.stderr[-2500:], logs[-4000:])
+    assert "re-rendezvous generation 1 with world 2" in proc.stderr
+    assert "joined; re-rendezvous generation 2 with world 3" in proc.stderr
+    done = [ln for ln in logs.splitlines()
+            if ln.startswith("ELASTIC_OK") and "gen=2" in ln]
+    assert len(done) == 3, (proc.stderr[-1500:], logs[-3000:])
+    for ln in done:
+        assert "world=3" in ln
+        assert int(ln.split("start_step=")[1]) >= 4  # resumed, not restarted
