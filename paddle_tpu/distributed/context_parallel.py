@@ -51,11 +51,191 @@ def _block_attn(q, k, v, scale, mask):
     return acc, m, l
 
 
+# ---------------------------------------------------------------------------
+# flash-kernel ring attention (the TPU long-context training path)
+# ---------------------------------------------------------------------------
+def _flash_with_lse(q, k, v, causal, scale, interpret=None):
+    """[B, S, H, D] flash forward returning (o, lse [B, H, S]) — the
+    per-ring-step building block (lse merges across steps)."""
+    from ..ops.pallas import use_interpret
+    from ..ops.pallas.flash_attention import _fwd, from_bh, to_bh
+
+    if interpret is None:
+        interpret = use_interpret()
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    if hq % hk != 0:
+        raise ValueError(
+            f"ring flash attention: q heads ({hq}) must be a multiple of "
+            f"kv heads ({hk})")
+    o, lse = _fwd(to_bh(q, hq), to_bh(k, hk), to_bh(v, hk), float(scale),
+                  bool(causal), bool(interpret), hq, hk)
+    return from_bh(o, b, hq), lse.reshape(b, hq, sq)
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret):
+    """At step t the device holds kv block src = (idx - t) % n; under the
+    global causal mask the step is 'full' (src < idx), 'diag' (src == idx)
+    or fully-masked 'skip' (src > idx)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    acc = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full((b, q.shape[2], s_loc), NEG_INF, jnp.float32)
+
+    kt, vt = k, v
+    for t in range(n):
+        src = (idx - t) % n
+
+        def full_case(q, kt, vt):
+            return _flash_with_lse(q, kt, vt, False, scale, interpret)
+
+        def diag_case(q, kt, vt):
+            return _flash_with_lse(q, kt, vt, True, scale, interpret)
+
+        def skip_case(q, kt, vt):
+            return (jnp.zeros(q.shape, q.dtype),
+                    jnp.full((b, q.shape[2], s_loc), NEG_INF, jnp.float32))
+
+        if causal:
+            o_blk, lse_blk = jax.lax.cond(
+                src == idx,
+                diag_case,
+                lambda q, kt, vt: jax.lax.cond(
+                    src < idx, full_case, skip_case, q, kt, vt),
+                q, kt, vt)
+        else:
+            o_blk, lse_blk = full_case(q, kt, vt)
+
+        # merge via lse (numerically the online-softmax combine)
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        a = jnp.exp(lse - lse_new)[..., None]          # [B, H, S, 1]
+        bta = jnp.exp(lse_blk - lse_new)[..., None]
+        a = jnp.transpose(a, (0, 2, 1, 3))             # -> [B, S, H, 1]
+        bta = jnp.transpose(bta, (0, 2, 1, 3))
+        acc = acc * a + o_blk.astype(jnp.float32) * bta
+        lse = lse_new
+        if t != n - 1:
+            kt = jax.lax.ppermute(kt, axis_name, perm)
+            vt = jax.lax.ppermute(vt, axis_name, perm)
+    return acc.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_flash_attention(q, k, v, axis_name="sep", causal=False, scale=None,
+                         interpret=None):
+    """Ring attention whose per-block math runs the pallas flash kernels —
+    O(S_local) memory AND no materialised score matrices. Call inside
+    shard_map with seq-sharded [B, S_loc, H, D] blocks."""
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
+                                  interpret)
+    return out
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, causal, scale, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
+                                    interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, causal, scale, interpret, res, dout):
+    """Ring backward: replay the kv rotation; per step run the flash bwd
+    kernels against the GLOBAL lse (p = exp(s - lse) is exact for the
+    full softmax, so per-block dq/dk/dv sum to the true grads). dk/dv
+    accumulators travel WITH their kv block and come home after a final
+    rotation."""
+    from ..ops.pallas import use_interpret
+    from ..ops.pallas.flash_attention import _bwd, from_bh as _from_bh, to_bh as _to_bh
+
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    interp = use_interpret() if interpret is None else interpret
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    hk = k.shape[2]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def to_bh(x, hh):
+        return _to_bh(x, hh)
+
+    def from_bh(x, hh):
+        return _from_bh(x, b, hh)
+
+    q_bh, o_bh, do_bh = to_bh(q, h), to_bh(out, h), to_bh(dout, h)
+    lse_bh = lse.reshape(b * h, s_loc)
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)   # travels with kt/vt
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    kt, vt = k, v
+    for t in range(n):
+        src = (idx - t) % n
+
+        def run(causal_flag, q_bh=q_bh, o_bh=o_bh, do_bh=do_bh,
+                lse_bh=lse_bh):
+            def f(kt, vt):
+                dq_b, dk_b, dv_b = _bwd(
+                    q_bh, to_bh(kt, hk), to_bh(vt, hk), o_bh, lse_bh,
+                    do_bh, float(scale), causal_flag, bool(interp), h, hk)
+                return (from_bh(dq_b, h).astype(jnp.float32),
+                        from_bh(dk_b, hk).astype(jnp.float32),
+                        from_bh(dv_b, hk).astype(jnp.float32))
+            return f
+
+        def skip(kt, vt):
+            return (jnp.zeros(q.shape, jnp.float32),
+                    jnp.zeros(k.shape, jnp.float32),
+                    jnp.zeros(v.shape, jnp.float32))
+
+        if causal:
+            dq_b, dk_b, dv_b = jax.lax.cond(
+                src == idx,
+                run(True),
+                lambda kt, vt: jax.lax.cond(src < idx, run(False), skip,
+                                            kt, vt),
+                kt, vt)
+        else:
+            dq_b, dk_b, dv_b = run(False)(kt, vt)
+
+        dq = dq + dq_b
+        dk_acc = dk_acc + dk_b
+        dv_acc = dv_acc + dv_b
+        # rotate kv AND its grad accumulators together; after the loop one
+        # more rotation brings every block's grads back to its owner
+        kt = jax.lax.ppermute(kt, axis_name, perm)
+        vt = jax.lax.ppermute(vt, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
 def ring_attention(q, k, v, axis_name="sep", causal=False, scale=None):
     """Ring attention over seq-sharded q/k/v local blocks [B, S_loc, H, D].
 
     Must be called inside shard_map/jit with ``axis_name`` bound in the mesh.
+    Dispatches the per-block math to the pallas flash kernels when the
+    local shape is eligible (TPU); the jnp online-softmax path otherwise.
     """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if _default_local_attn(q.shape) is not None:
+        return ring_flash_attention(q, k, v, axis_name, causal, scale, None)
+    return _ring_attention_jnp(q, k, v, axis_name=axis_name, causal=causal,
+                               scale=scale)
+
+
+def _ring_attention_jnp(q, k, v, axis_name="sep", causal=False, scale=None):
+    """jnp online-softmax ring (fallback path)."""
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
@@ -187,12 +367,11 @@ def _mapped_cp(jmesh, strategy, causal, axis_name):
     compilation cache instead of retracing."""
     fn = ring_attention if strategy == "ring" else ulysses_attention
     spec = PartitionSpec(None, axis_name, None, None)
-    # check_vma=False only where needed: the ulysses path may run the
-    # pallas flash kernel, whose out_shape can't annotate varying mesh
-    # axes; ring keeps shard_map's vma verification
-    kw = {"check_vma": False} if strategy == "ulysses" else {}
+    # check_vma=False: BOTH strategies can dispatch to the pallas flash
+    # kernels (ring via ring_flash_attention, ulysses as local attention),
+    # and pallas out_shapes can't annotate varying mesh axes
     return jax.shard_map(
         functools.partial(fn, axis_name=axis_name, causal=causal),
         mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
-        **kw,
+        check_vma=False,
     )

@@ -65,6 +65,18 @@ def supported_seq(s: int) -> bool:
     return _block_for(s) is not None
 
 
+def to_bh(x, h):
+    """[B, S, H, D] -> the kernel layout [B*H, S, D]."""
+    b, s, _, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+
+
+def from_bh(x, b, h):
+    """[B*H, S, D] -> [B, S, H, D]."""
+    s, d = x.shape[1], x.shape[2]
+    return jnp.transpose(x.reshape(b, h, s, d), (0, 2, 1, 3))
+
+
 def _causal_mask(qi, ki, bq, bk, offset):
     """[bq, bk] bool: True where key col <= query row + offset."""
     rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
@@ -467,12 +479,9 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 
-    def to_bh(x, h):  # [B,S,H,D] -> [B*H,S,D]
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
-
     o = _flash(to_bh(q, hq), to_bh(k, hk), to_bh(v, hk), float(scale),
                bool(causal), bool(interpret), hq, hk)
-    return jnp.transpose(o.reshape(b, hq, sq, d), (0, 2, 1, 3))
+    return from_bh(o, b, hq)
 
 
 # Back-compat name used by nn.functional.flash_attention
