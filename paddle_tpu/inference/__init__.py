@@ -228,7 +228,14 @@ class Predictor:
         pass
 
     def clone(self):
-        return Predictor(self._config)
+        """A predictor sharing this one's program, device weights, and
+        compiled executables — only the feed/fetch state is fresh (the
+        reference's per-thread clone contract)."""
+        twin = Predictor.__new__(Predictor)
+        twin.__dict__.update(self.__dict__)
+        twin._feeds = [None] * len(self._input_avals)
+        twin._outputs = None
+        return twin
 
 
 def create_predictor(config) -> Predictor:
@@ -238,7 +245,156 @@ def create_predictor(config) -> Predictor:
 # convenience aliases matching paddle_infer's module-level names
 Tensor = Tensor_
 
+
+class DataType(enum.Enum):
+    """parity: paddle_infer DataType (ordinals match the reference)."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    BOOL = 7
+    FLOAT64 = 8
+
+
+_NUM_BYTES = {
+    DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.INT64: 8,
+    DataType.INT32: 4, DataType.UINT8: 1, DataType.INT8: 1,
+    DataType.BOOL: 1, DataType.BFLOAT16: 2, DataType.FLOAT64: 8,
+}
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    return _NUM_BYTES[DataType(dtype) if not isinstance(dtype, DataType)
+                      else dtype]
+
+
+def get_version() -> str:
+    from .. import version
+
+    return f"paddle_tpu inference {version.full_version}"
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU — the XLA compiler fills the slot."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    return op_name  # one compiler: op names ARE the kernel names
+
+
+def _artifact_prefix(p):
+    for suf in (".pdmodel", ".pdiparams", ".pdmeta.json"):
+        if p.endswith(suf):
+            return p[: -len(suf)]
+    return p
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Rewrite a saved jit.save artifact to hold bf16 weights (the TPU
+    mixed precision; parity: inference convert_to_mixed_precision).
+
+    The program is re-exported as a wrapper that accepts bf16 weights and
+    upcasts at the boundary, so the artifact halves its weight bytes (disk
+    and HBM) without needing the original Python class; XLA folds the
+    casts into the first consumers."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import export as jax_export
+
+    from ..jit import _ARTIFACT_VERSION, load_artifact
+
+    if black_list:
+        raise NotImplementedError(
+            "convert_to_mixed_precision: per-op black_list requires "
+            "retracing the model; re-save with a custom dtype policy "
+            "instead")
+    if mixed_precision is not None and str(mixed_precision).lower() not in (
+            "precisiontype.half", "precisiontype.bfloat16", "bfloat16",
+            "bf16", "float16", "fp16"):
+        raise ValueError(
+            f"unsupported mixed_precision {mixed_precision!r}: the TPU "
+            "conversion targets bfloat16")
+
+    src = _artifact_prefix(model_file)
+    dst = _artifact_prefix(mixed_model_file)
+    exported, weights, meta = load_artifact(src, params_file)
+
+    orig_dtypes = [jnp.asarray(w).dtype for w in weights]
+    keep = [not jnp.issubdtype(d, jnp.floating) for d in orig_dtypes]
+    casted = [w if k else jnp.asarray(w).astype(jnp.bfloat16)
+              for w, k in zip(weights, keep)]
+
+    def wrapped(ws, *inputs):
+        restored = [w if k else w.astype(d)
+                    for w, k, d in zip(ws, keep, orig_dtypes)]
+        return exported.call(restored, *inputs)
+
+    n_w = len(weights)
+    in_avals = list(exported.in_avals)[n_w:]
+    w_avals = [jax.ShapeDtypeStruct(w.shape, w.dtype) for w in casted]
+    try:
+        mixed = jax_export.export(jax.jit(wrapped),
+                                  platforms=("cpu", "tpu"))(w_avals, *in_avals)
+    except Exception:
+        mixed = jax_export.export(jax.jit(wrapped))(w_avals, *in_avals)
+
+    os.makedirs(os.path.dirname(os.path.abspath(dst)) or ".", exist_ok=True)
+    os.makedirs(os.path.dirname(os.path.abspath(
+        _artifact_prefix(mixed_params_file))) or ".", exist_ok=True)
+    with open(dst + ".pdmodel", "wb") as f:
+        f.write(mixed.serialize())
+    from ..jit import _pack_weights
+
+    packed, params_meta = _pack_weights(
+        casted, [pm["name"] for pm in meta["params"]])
+    with open(_artifact_prefix(mixed_params_file) + ".pdiparams", "wb") as f:
+        np.savez(f, **packed)
+    new_meta = dict(meta, params=params_meta, version=_ARTIFACT_VERSION)
+    with open(dst + ".pdmeta.json", "w") as f:
+        json.dump(new_meta, f)
+    return mixed_model_file
+
+
+class PredictorPool:
+    """A pool of cloned predictors (parity: paddle_infer PredictorPool —
+    per-thread predictors sharing the program)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [Predictor(config)]
+        for _ in range(size - 1):
+            self._predictors.append(self._predictors[0].clone())
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]
+
+
+class XpuConfig:
+    """Device-specific config placeholder (reference: kunlun XPU knobs;
+    the TPU analogue is XLA flags, set via env)."""
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
 __all__ = [
     "Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
-    "create_predictor",
+    "DataType", "create_predictor", "get_version",
+    "get_num_bytes_of_data_type", "get_trt_compile_version",
+    "get_trt_runtime_version", "convert_to_mixed_precision",
+    "PredictorPool", "XpuConfig", "_get_phi_kernel_name",
 ]

@@ -429,6 +429,20 @@ class TrainStep:
 _ARTIFACT_VERSION = 1
 
 
+def _pack_weights(weights, names):
+    """Shared artifact weight packing (used by jit.save and
+    inference.convert_to_mixed_precision — one format, one writer)."""
+    import numpy as np
+
+    packed, params_meta = {}, []
+    for i, (n, w) in enumerate(zip(names, weights)):
+        a = np.asarray(w)
+        packed[f"w{i}"] = np.frombuffer(a.tobytes(), np.uint8)
+        params_meta.append({"name": n, "dtype": str(a.dtype),
+                            "shape": list(a.shape)})
+    return packed, params_meta
+
+
 def _encode_struct(tree, counter):
     """JSON-able description of an output pytree; leaves become indices."""
     if isinstance(tree, (list, tuple)):
@@ -574,13 +588,7 @@ def save(layer, path, input_spec=None, **configs):
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:
         f.write(blob)
-    params_meta = []
-    packed = {}
-    for i, (n, w) in enumerate(zip(names, weights)):
-        a = np.asarray(w)
-        packed[f"w{i}"] = np.frombuffer(a.tobytes(), np.uint8)
-        params_meta.append({"name": n, "dtype": str(a.dtype),
-                            "shape": list(a.shape)})
+    packed, params_meta = _pack_weights(weights, names)
     with open(path + ".pdiparams", "wb") as f:
         np.savez(f, **packed)
     meta = {

@@ -186,3 +186,50 @@ def test_save_with_input_spec_and_multi_output(tmp_path):
     assert pred.get_input_names() == ["feat"]
     outs = pred.run([np.asarray(x.numpy())])
     assert len(outs) == 2
+
+
+def test_inference_surface_and_mixed_precision(tmp_path):
+    """DataType/version helpers + convert_to_mixed_precision: the mixed
+    artifact loads class-free, halves weight bytes, matches fp32 output."""
+    import os
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn
+    from paddle_tpu.jit import save
+    from paddle_tpu.static import InputSpec
+
+    assert inference.get_num_bytes_of_data_type(
+        inference.DataType.FLOAT32) == 4
+    assert "paddle_tpu" in inference.get_version()
+
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    prefix = str(tmp_path / "m")
+    save(model, prefix, input_spec=[InputSpec([2, 8], "float32")])
+
+    mixed = str(tmp_path / "m_bf16")
+    inference.convert_to_mixed_precision(
+        prefix + ".pdmodel", prefix + ".pdiparams",
+        mixed + ".pdmodel", mixed + ".pdiparams")
+    assert os.path.getsize(mixed + ".pdiparams") < \
+        os.path.getsize(prefix + ".pdiparams")
+
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+    cfg32 = inference.Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    cfg16 = inference.Config(mixed + ".pdmodel", mixed + ".pdiparams")
+    p32, p16 = inference.Predictor(cfg32), inference.Predictor(cfg16)
+
+    def run(p):
+        h = p.get_input_handle(p.get_input_names()[0])
+        h.copy_from_cpu(x)
+        p.run()
+        return p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+
+    np.testing.assert_allclose(run(p16), run(p32), rtol=2e-2, atol=2e-2)
+
+    pool = inference.PredictorPool(cfg32, 2)
+    # clones share the program + device weights (no per-member reload)
+    assert pool.retrieve(1)._exported is pool.retrieve(0)._exported
+    assert pool.retrieve(1)._weights is pool.retrieve(0)._weights
+    np.testing.assert_allclose(run(pool.retrieve(1)), run(p32), rtol=1e-6)
