@@ -322,6 +322,20 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
             "convert_to_mixed_precision: per-op black_list requires "
             "retracing the model; re-save with a custom dtype policy "
             "instead")
+    if not keep_io_types:
+        raise NotImplementedError(
+            "convert_to_mixed_precision: keep_io_types=False (bf16 IO) is "
+            "not supported — the wrapper upcasts weights only and the "
+            "program keeps its original input/output dtypes")
+    for p, suffix in ((model_file, ".pdmodel"), (params_file, ".pdiparams"),
+                      (mixed_model_file, ".pdmodel"),
+                      (mixed_params_file, ".pdiparams")):
+        if p is not None and not str(p).endswith(suffix):
+            raise ValueError(
+                f"convert_to_mixed_precision: {p!r} must end with "
+                f"{suffix!r} (the artifact is the .pdmodel/.pdiparams/"
+                ".pdmeta.json triplet; outputs are written at exactly the "
+                "paths given)")
     if mixed_precision is not None and str(mixed_precision).lower() not in (
             "precisiontype.half", "precisiontype.bfloat16", "bfloat16",
             "bf16", "float16", "fp16"):
