@@ -197,6 +197,10 @@ from .param_attr import ParamAttr  # noqa: F401
 
 from . import version  # noqa: F401
 from . import inference  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import hub  # noqa: F401
 
 __version__ = version.full_version
 

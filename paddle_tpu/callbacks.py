@@ -1,0 +1,17 @@
+"""paddle.callbacks — re-export of the hapi callback family
+(parity: python/paddle/callbacks/__init__.py)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+    VisualDL,
+    WandbCallback,
+)
+
+__all__ = [
+    "Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+    "LRScheduler", "EarlyStopping", "ReduceLROnPlateau", "WandbCallback",
+]

@@ -176,3 +176,131 @@ class CallbackList:
 
     def on_train_batch_end(self, step, logs=None):
         self._call("on_train_batch_end", step, logs)
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer lr when a monitored metric stops improving
+    (parity: hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            # same inference rule as EarlyStopping above: loss-like metrics
+            # minimize, everything else (acc/f1/precision/auc...) maximizes
+            mode = "min" if "loss" in monitor else "max"
+        self.mode = mode
+        self.best = float("-inf") if mode == "max" else float("inf")
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, cur):
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def _metric(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if isinstance(v, (list, tuple)):
+            v = v[0]
+        return v
+
+    def _step(self, logs):
+        cur = self._metric(logs)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(float(cur)):
+            self.best = float(cur)
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    new_lr = max(float(opt.get_lr()) * self.factor,
+                                 self.min_lr)
+                    opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        # epoch-end only: eval metrics land in the epoch logs, and hooking
+        # on_eval_end too would double-count an epoch against `patience`
+        self._step(logs)
+
+
+class VisualDL(Callback):
+    """Scalar logger (parity: hapi VisualDL callback). The visualdl
+    package is not in the TPU image, so scalars append to
+    ``{log_dir}/scalars.jsonl`` — same data, greppable format."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        rec = {}
+        for k, v in (logs or {}).items():
+            if isinstance(v, (int, float)):
+                rec[k] = v
+            elif isinstance(v, (list, tuple)) and v and \
+                    isinstance(v[0], (int, float)):
+                rec[k] = v[0]
+        # the cumulative counter orders records across epochs; a per-epoch
+        # logs["step"] (last batch index) must not clobber it
+        rec["step"] = self._step
+        rec["tag"] = tag
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger. wandb is not installed in the TPU image;
+    without it this callback raises at construction with guidance
+    (matching the reference's hard dependency) unless ``anonymous_ok``."""
+
+    def __init__(self, project=None, anonymous_ok=False, **kwargs):
+        super().__init__()
+        try:
+            import wandb  # noqa: F401
+        except ImportError:
+            if not anonymous_ok:
+                raise ImportError(
+                    "WandbCallback requires the wandb package (not in the "
+                    "TPU image); pass anonymous_ok=True to no-op, or use "
+                    "the VisualDL callback's jsonl scalars")
+            self._wandb = None
+        else:
+            import wandb
+
+            self._wandb = wandb.init(project=project, **kwargs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._wandb is not None:
+            self._wandb.log(dict(logs or {}, epoch=epoch))

@@ -1,0 +1,77 @@
+"""paddle.hub (parity: python/paddle/hapi/hub.py — list/help/load over a
+repo's hubconf.py).
+
+The TPU environment has zero network egress, so ``source='local'`` (a
+directory containing ``hubconf.py``) is the first-class path — identical
+semantics to the reference's local source. github/gitee sources raise
+with guidance instead of hanging on a dead network.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load", "load_state_dict_from_url"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # fresh module each call (= force_reload)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r} (expected 'github', 'gitee' or "
+            "'local')")
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access, which this "
+            "environment does not have — clone the repo and use "
+            "source='local'")
+    return repo_dir
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoints (public callables) exposed by the repo's hubconf."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [n for n in dir(mod)
+            if not n.startswith("_") and callable(getattr(mod, n))]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model {model!r} not in hubconf "
+                         f"(has {list(repo_dir, source)})")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model {model!r} not in hubconf "
+                         f"(has {list(repo_dir, source)})")
+    return fn(**kwargs)
+
+
+def load_state_dict_from_url(url, model_dir=None, check_hash=False,
+                             file_name=None, map_location=None):
+    """Local-path / file:// loads only (zero-egress environment)."""
+    import paddle_tpu as paddle
+
+    path = url[len("file://"):] if str(url).startswith("file://") else url
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"load_state_dict_from_url: {url!r} is not a local path and "
+            "this environment has no network — download the weights "
+            "out-of-band and pass the file path")
+    return paddle.load(path)

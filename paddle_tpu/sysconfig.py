@@ -1,0 +1,20 @@
+"""paddle.sysconfig (parity: python/paddle/sysconfig.py) — build-time
+paths for extension authors. The TPU package has no bundled C headers
+(custom ops build against the CPython API via utils.cpp_extension), so
+get_include points at the package dir and get_lib at the native library
+directory (core/native holds the compiled runtime .so)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    # no bundled C headers: custom ops build against the CPython API
+    # (utils.cpp_extension), so the package dir is the include root
+    return _PKG
+
+
+def get_lib() -> str:
+    return os.path.join(_PKG, "core", "native")

@@ -176,3 +176,52 @@ def test_memory_model_monotonic():
     small = estimate_memory_gb(TunerCfg(dp=1, mp=8), model)
     big = estimate_memory_gb(TunerCfg(dp=8, mp=1), model)
     assert small < big
+
+
+def test_callbacks_regularizer_sysconfig_hub_namespaces(tmp_path):
+    """paddle.callbacks / regularizer / sysconfig / hub exist with the
+    reference __all__ and behave."""
+    import paddle_tpu as paddle
+
+    for name in ("Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+                 "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+                 "WandbCallback"):
+        assert hasattr(paddle.callbacks, name), name
+    assert paddle.regularizer.L2Decay(1e-4) is not None
+    assert paddle.sysconfig.get_lib().endswith("native")
+
+    # hub over a local hubconf
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_linear(out=3):\n"
+        "    \"\"\"a tiny model\"\"\"\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(2, out)\n")
+    assert paddle.hub.list(str(tmp_path)) == ["tiny_linear"]
+    assert "tiny model" in paddle.hub.help(str(tmp_path), "tiny_linear")
+    layer = paddle.hub.load(str(tmp_path), "tiny_linear", out=5)
+    assert layer.weight.shape == [2, 5]
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="network"):
+        paddle.hub.load("user/repo", "m", source="github")
+
+
+def test_reduce_lr_on_plateau_callback():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.callbacks import ReduceLROnPlateau
+
+    model_net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model_net.parameters())
+
+    class _M:  # minimal hapi-model shim carrying the optimizer
+        _optimizer = opt
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2, verbose=0)
+    cb.set_model(_M())
+    for loss in (1.0, 0.9, 0.9, 0.9, 0.9):  # stalls after step 2
+        cb.on_epoch_end(0, {"loss": loss})
+    assert abs(opt.get_lr() - 0.05) < 1e-9  # reduced once
