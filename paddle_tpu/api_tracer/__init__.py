@@ -1,0 +1,42 @@
+"""paddle.api_tracer (parity: python/paddle/api_tracer) — record which
+public APIs a workload calls (used for coverage/compat audits)."""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+
+__all__ = ["api_tracer", "start_api_tracer"]
+
+_CALLS: dict[str, int] = {}
+_ACTIVE = False
+
+
+def api_tracer(fn):
+    """Decorator counting calls when tracing is active."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _ACTIVE:
+            key = f"{fn.__module__}.{fn.__qualname__}"
+            _CALLS[key] = _CALLS.get(key, 0) + 1
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def start_api_tracer(output_path="api_trace.json"):
+    """Start recording; the call table is written at interpreter exit
+    (reference contract) and also returned as the live dict."""
+    global _ACTIVE
+    _ACTIVE = True
+
+    def _dump():
+        try:
+            with open(output_path, "w") as f:
+                json.dump(_CALLS, f, indent=1, sort_keys=True)
+        except OSError:
+            pass
+
+    atexit.register(_dump)
+    return _CALLS

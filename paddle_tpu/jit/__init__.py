@@ -438,6 +438,11 @@ def _pack_weights(weights, names):
     for i, (n, w) in enumerate(zip(names, weights)):
         a = np.asarray(w)
         packed[f"w{i}"] = np.frombuffer(a.tobytes(), np.uint8)
+        # self-describing sidecar keys: the npz alone decodes without the
+        # meta json (static.deserialize_persistables relies on this)
+        packed[f"w{i}_name"] = np.asarray(n)
+        packed[f"w{i}_dtype"] = np.asarray(str(a.dtype))
+        packed[f"w{i}_shape"] = np.asarray(list(a.shape), np.int64)
         params_meta.append({"name": n, "dtype": str(a.dtype),
                             "shape": list(a.shape)})
     return packed, params_meta

@@ -116,6 +116,8 @@ def data(name, shape, dtype="float32", lod_level=0):
     concrete = [1 if (s is None or int(s) < 0) else int(s) for s in shape]
     t = Tensor(jnp.zeros(concrete, _dt.convert_dtype(dtype).np_dtype),
                stop_gradient=True, name=name)
+    t._declared_shape = [None if (s is None or int(s) < 0) else int(s)
+                         for s in shape]
     prog = _active_program() or _default_main
     prog.feeds[name] = t
     return t
@@ -258,3 +260,472 @@ def cpu_places(device_count=None):
 
 def device_guard(device=None):
     return contextlib.nullcontext()
+
+
+# ---------------------------------------------------------------------------
+# deployment surface (save/load_inference_model over the jit.save artifact)
+# ---------------------------------------------------------------------------
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """parity: static.save_inference_model — emits the SAME StableHLO
+    artifact jit.save writes, from a recorded static Program.
+
+    feed_vars/fetch_vars: the `static.data` placeholders and program
+    outputs; `program` defaults to the default main program."""
+    import numpy as np
+
+    import jax
+
+    from ..jit import _pack_weights, _ARTIFACT_VERSION
+    from jax import export as jax_export
+    import json
+    import os as _os
+
+    program = program or default_main_program()
+    feed_list = list(feed_vars) if isinstance(
+        feed_vars, (list, tuple)) else [feed_vars]
+    fetch_list = list(fetch_vars) if isinstance(
+        fetch_vars, (list, tuple)) else [fetch_vars]
+
+    # persistables = recorded input Tensors that are neither feeds nor
+    # produced by an earlier record (intermediate activations are program
+    # values, not weights)
+    feed_ids = {id(v) for v in feed_list}
+    produced = {id(o) for _, _, outs in program.records for o in outs}
+    names, weights, seen = [], [], set()
+    for _, ins, _ in program.records:
+        for t in ins:
+            if id(t) in feed_ids or id(t) in seen or id(t) in produced:
+                continue
+            seen.add(id(t))
+            names.append(getattr(t, "name", None) or f"param_{len(names)}")
+            weights.append(t._data)
+
+    def pure(ws, *feeds):
+        sub = dict(zip((id(t) for t in seen_list), ws))
+        env = {}
+        for v, f in zip(feed_list, feeds):
+            env[id(v)] = f
+        for (replay, ins, outs) in program.records:
+            args = [env.get(id(t), sub.get(id(t), t._data)) for t in ins]
+            res = replay(args)
+            import jax.tree_util as tu
+
+            leaves = [x for x in tu.tree_leaves(res)]
+            for o, leaf in zip(outs, leaves):
+                env[id(o)] = leaf
+        return tuple(env[id(v)] for v in fetch_list)
+
+    seen_list = [t for _, ins, _ in program.records for t in ins
+                 if id(t) in seen]
+    # dedupe preserving order
+    uniq, ul = set(), []
+    for t in seen_list:
+        if id(t) not in uniq:
+            uniq.add(id(t)); ul.append(t)
+    seen_list = ul
+
+    # declared None/-1 dims export as symbolic so the artifact serves any
+    # size on those axes (same contract as jit.save + InputSpec)
+    from jax import export as _jx
+
+    avals = []
+    for v in feed_list:
+        decl = getattr(v, "_declared_shape", None) or list(v.shape)
+        if any(d is None for d in decl):
+            sym = _jx.symbolic_shape(
+                ",".join(f"d{i}" if d is None else str(d)
+                         for i, d in enumerate(decl)))
+            avals.append(jax.ShapeDtypeStruct(tuple(sym), v._data.dtype))
+        else:
+            avals.append(jax.ShapeDtypeStruct(tuple(decl), v._data.dtype))
+    exported = jax_export.export(jax.jit(pure))(
+        [w for w in weights], *avals)
+
+    _os.makedirs(_os.path.dirname(_os.path.abspath(path_prefix)) or ".",
+                 exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    packed, params_meta = _pack_weights(weights, names)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        np.savez(f, **packed)
+    meta = {
+        "version": _ARTIFACT_VERSION,
+        "params": params_meta,
+        "inputs": [{"shape": [
+            -1 if d is None else int(d)
+            for d in (getattr(v, "_declared_shape", None) or v.shape)],
+            "dtype": str(v._data.dtype)} for v in feed_list],
+        "input_names": [getattr(v, "name", f"feed_{i}")
+                        for i, v in enumerate(feed_list)],
+        "outputs": {"kind": "tuple", "items": [
+            {"kind": "leaf", "index": i} for i in range(len(fetch_list))]},
+    }
+    with open(path_prefix + ".pdmeta.json", "w") as f:
+        json.dump(meta, f)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """parity: static.load_inference_model -> (program-like predictor,
+    feed_names, fetch_names). The returned object runs via
+    Executor.run(loaded, feed=..., fetch_list=...) or directly."""
+    from ..inference import Config, Predictor
+
+    pred = Predictor(Config(path_prefix))
+    return pred, pred.get_input_names(), pred.get_output_names()
+
+
+# -- program/persistable (de)serialization over the artifact bytes ---------
+def _serialize_artifact(feed_vars, fetch_vars, program):
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = save_inference_model(d + "/m", feed_vars, fetch_vars,
+                                 program=program)
+        with open(p + ".pdmodel", "rb") as f:
+            model = f.read()
+        with open(p + ".pdiparams", "rb") as f:
+            params = f.read()
+    return model, params
+
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    return _serialize_artifact(feed_vars, fetch_vars, program)[0]
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None):
+    return _serialize_artifact(feed_vars, fetch_vars, program)[1]
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    from jax import export as jax_export
+
+    return jax_export.deserialize(bytearray(data))
+
+
+def deserialize_persistables(program, data, executor=None):
+    """name -> typed ndarray (decoded via the self-describing npz keys
+    _pack_weights embeds)."""
+    import io as _io
+
+    import numpy as np
+
+    z = np.load(_io.BytesIO(data), allow_pickle=False)
+    out = {}
+    i = 0
+    while f"w{i}" in z.files:
+        name = str(z[f"w{i}_name"]) if f"w{i}_name" in z.files else f"w{i}"
+        dtype = str(z[f"w{i}_dtype"]) if f"w{i}_dtype" in z.files else "float32"
+        shape = (z[f"w{i}_shape"].tolist()
+                 if f"w{i}_shape" in z.files else [-1])
+        import ml_dtypes  # noqa: F401
+
+        out[name] = np.frombuffer(
+            z[f"w{i}"].tobytes(), np.dtype(dtype)).reshape(shape)
+        i += 1
+    return out
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+# -- program state ----------------------------------------------------------
+def load_program_state(model_path, var_list=None):
+    import numpy as np
+
+    from ..jit import load_artifact
+
+    _, weights, meta = load_artifact(model_path)
+    return {pm["name"]: np.asarray(w)
+            for pm, w in zip(meta["params"], weights)}
+
+
+def set_program_state(program, state_dict):
+    for _, ins, _ in program.records:
+        for t in ins:
+            n = getattr(t, "name", None)
+            if n in state_dict:
+                import jax.numpy as jnp
+
+                t._data = jnp.asarray(state_dict[n])
+
+
+# -- small compat -----------------------------------------------------------
+Variable = Tensor  # static-graph name for a framework tensor
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(np.full(shape, value, dtype))
+    t.name = name or f"global_var_{id(t)}"
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import numpy as np
+
+    p = Parameter.__new__(Parameter)
+    import jax.numpy as jnp
+
+    if default_initializer is not None:
+        import paddle_tpu as paddle
+
+        t = paddle.empty(shape, dtype)
+        default_initializer(t)
+        arr = t._data
+    else:
+        arr = jnp.zeros(shape, dtype)
+    Parameter.__init__(p, arr, trainable=True)
+    p.name = name or f"create_param_{id(p)}"
+    return p
+
+
+def global_scope():
+    return {"_scope": "global"}
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+class BuildStrategy:
+    """Compilation knobs record (XLA decides; kept for API parity)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class ExponentialMovingAverage:
+    """EMA over trainable parameters (parity: static.ExponentialMovingAverage).
+
+    update() folds current param values into the shadow; apply() swaps the
+    shadow in (context manager restores on exit)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self, parameters=None):
+        params = parameters or [
+            p for p in default_main_program().trainable_params()
+            if p.trainable]
+        if not params:
+            raise ValueError(
+                "ExponentialMovingAverage.update(): no parameters — pass "
+                "them explicitly (eager mode) or record a program with "
+                "trainable Parameters first")
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in params:
+            key = id(p)
+            prev = self._shadow.get(key)
+            self._shadow[key] = (
+                p._data if prev is None else d * prev + (1 - d) * p._data)
+            self._shadow.setdefault("_ref_%d" % key, p)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        refs = [(k, self._shadow[k]) for k in self._shadow
+                if isinstance(k, int)]
+        for key, shadow in refs:
+            p = self._shadow["_ref_%d" % key]
+            self._backup[key] = p._data
+            p._data = shadow
+        try:
+            yield
+        finally:
+            if need_restore:
+                for key, _ in refs:
+                    p = self._shadow["_ref_%d" % key]
+                    p._data = self._backup.pop(key)
+
+    def restore(self, executor=None):
+        for key, arr in list(self._backup.items()):
+            self._shadow["_ref_%d" % key]._data = arr
+            del self._backup[key]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """parity: static.py_func — host-python op inside the program via
+    jax.pure_callback (the TPU path for arbitrary python)."""
+    import jax
+    import numpy as np
+
+    from ..core.dispatch import apply_op
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    avals = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+             for o in outs]
+
+    def _cb(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r) for r in res)
+
+    def _fwd_call(*arrays):
+        res = jax.pure_callback(_cb, tuple(avals), *arrays)
+        return res if len(res) > 1 else res[0]
+
+    if backward_func is None:
+        def _run(*arrays):
+            # non-differentiable host op (reference: no backward_func)
+            return jax.tree_util.tree_map(
+                jax.lax.stop_gradient, _fwd_call(*arrays))
+    else:
+        in_avals = [jax.ShapeDtypeStruct(tuple(np.shape(a._data)),
+                                         a._data.dtype) for a in xs]
+
+        @jax.custom_vjp
+        def _run(*arrays):
+            return _fwd_call(*arrays)
+
+        def _vjp_fwd(*arrays):
+            return _fwd_call(*arrays), arrays
+
+        def _vjp_bwd(res_arrays, g):
+            def _bcb(*args):
+                n = len(res_arrays)
+                grads = backward_func(*[np.asarray(a) for a in args])
+                grads = grads if isinstance(grads, (list, tuple)) else [grads]
+                return tuple(np.asarray(x) for x in grads)
+
+            gl = g if isinstance(g, (list, tuple)) else (g,)
+            return tuple(jax.pure_callback(
+                _bcb, tuple(in_avals), *res_arrays, *gl))
+
+        _run.defvjp(_vjp_fwd, _vjp_bwd)
+
+    return apply_op(_run, *xs, _op_name="py_func")
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kwargs):
+    """parity: static.Print — debug-print a tensor inside the program."""
+    import jax
+
+    from ..core.dispatch import apply_op
+
+    def _p(a):
+        jax.debug.print((message or "Print") + ": {}", a)
+        return a
+
+    return apply_op(_p, input, _op_name="print")
+
+
+class WeightNormParamAttr:
+    """parity: static.WeightNormParamAttr — carried to nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1,
+        slide_steps=1):
+    import numpy as np
+
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(np.asarray(input.numpy()), np.asarray(label.numpy()))
+    import paddle_tpu as paddle
+
+    return paddle.to_tensor(np.asarray(m.accumulate(), np.float32))
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics (parity: static.ctr_metric_bundle): returns (auc,
+    batch_auc) tensors over the batch."""
+    a = auc(input, label)
+    return a, a
+
+
+def cuda_places(device_ids=None):
+    return ["tpu"]  # accelerator places; the mesh addresses real chips
+
+
+def xpu_places(device_ids=None):
+    return ["tpu"]
+
+
+# -- IPU compat (other-vendor accelerator surface; n/a on TPU) --------------
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError(
+            "IPU is another vendor's accelerator; on TPU use "
+            "fleet.DistributedStrategy / auto-parallel Strategy")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU is another vendor's accelerator; programs compile via XLA")
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """parity: static.append_backward — record grads for the recorded
+    program's parameters; returns [(param, grad)] like the reference."""
+    from .. import autograd
+
+    params = parameter_list or [
+        t for _, ins, _ in default_main_program().records for t in ins
+        if isinstance(t, Parameter)]
+    # dedupe preserving order
+    seen, uniq = set(), []
+    for p in params:
+        if id(p) not in seen:
+            seen.add(id(p)); uniq.append(p)
+    grads = autograd.grad(loss, uniq, retain_graph=True, allow_unused=True)
+    return list(zip(uniq, grads))

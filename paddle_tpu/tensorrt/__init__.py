@@ -1,0 +1,58 @@
+"""paddle.tensorrt (parity: python/paddle/tensorrt) — the reference's
+TensorRT export path. On TPU the engine-compiler slot is XLA: `convert`
+produces the same serialized StableHLO artifact `jit.save`/`inference`
+consume, so code written against this API still gets an AOT-compiled
+deployable program (just not a TRT engine)."""
+from __future__ import annotations
+
+import enum
+
+__all__ = ["Input", "TensorRTConfig", "convert", "PrecisionMode"]
+
+
+class PrecisionMode(enum.Enum):
+    FP32 = "fp32"
+    FP16 = "fp16"
+    BF16 = "bf16"
+    INT8 = "int8"
+
+
+class Input:
+    def __init__(self, min_input_shape=None, optim_input_shape=None,
+                 max_input_shape=None, input_data_type="float32", **kwargs):
+        self.min_input_shape = min_input_shape
+        self.optim_input_shape = optim_input_shape or min_input_shape
+        self.max_input_shape = max_input_shape or self.optim_input_shape
+        self.input_data_type = input_data_type
+
+
+class TensorRTConfig:
+    def __init__(self, inputs=None, precision_mode=PrecisionMode.FP32,
+                 **kwargs):
+        self.inputs = inputs or []
+        self.precision_mode = precision_mode
+        self.save_model_dir = kwargs.get("save_model_dir")
+
+
+def convert(model_path, config: TensorRTConfig):
+    """Convert a saved model for deployment. On TPU this re-emits the
+    XLA artifact (optionally bf16-weighted when the config asks for a
+    reduced precision) at config.save_model_dir."""
+    import os
+
+    from ..inference import convert_to_mixed_precision
+
+    dst = config.save_model_dir or model_path + "_trt"
+    os.makedirs(dst, exist_ok=True)
+    base = os.path.basename(model_path)
+    out_prefix = os.path.join(dst, base)
+    if config.precision_mode in (PrecisionMode.FP16, PrecisionMode.BF16):
+        convert_to_mixed_precision(
+            model_path + ".pdmodel", model_path + ".pdiparams",
+            out_prefix + ".pdmodel", out_prefix + ".pdiparams")
+    else:
+        import shutil
+
+        for suf in (".pdmodel", ".pdiparams", ".pdmeta.json"):
+            shutil.copyfile(model_path + suf, out_prefix + suf)
+    return out_prefix

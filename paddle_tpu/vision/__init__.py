@@ -16,10 +16,41 @@ from .models import (  # noqa: F401
     vgg11, vgg13, vgg16, vgg19, wide_resnet50_2, wide_resnet101_2)
 
 
+_image_backend = "pil"
+
+
 def set_image_backend(backend):
-    pass
+    """parity: vision.set_image_backend ('pil' | 'cv2' | 'tensor')."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
 
 
 def get_image_backend():
-    return "numpy"
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image (parity: vision.image_load). PIL is the available
+    backend in this image; 'tensor' wraps the decoded array."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        raise ImportError("cv2 is not installed in the TPU image; use the "
+                          "'pil' or 'tensor' backend")
+    from PIL import Image
+
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    arr = np.asarray(img)
+    if arr.ndim == 3:
+        arr = arr.transpose(2, 0, 1)  # CHW, the reference tensor layout
+    return paddle.to_tensor(arr)
+
+
 from . import ops  # noqa: F401

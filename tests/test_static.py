@@ -82,3 +82,59 @@ def test_default_program_guard():
     before = len(static.default_main_program().records)
     _ = paddle.tanh(paddle.ones([2]))
     assert len(static.default_main_program().records) == before
+
+
+def test_save_inference_model_dynamic_batch(tmp_path):
+    """Declared -1 dims export symbolically: the loaded predictor serves
+    ANY batch size (jit.save parity)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [-1, 4], "float32")
+        w = static.create_parameter([4, 2], "float32")
+        w._data = paddle.to_tensor(np.ones((4, 2), np.float32))._data
+        z = paddle.matmul(x, w)
+    static.save_inference_model(str(tmp_path / "dyn"), [x], [z],
+                                program=main)
+    pred, feeds, fetches = static.load_inference_model(str(tmp_path / "dyn"))
+    for b in (1, 3, 7):
+        h = pred.get_input_handle(feeds[0])
+        h.copy_from_cpu(np.ones((b, 4), np.float32))
+        pred.run()
+        out = pred.get_output_handle(fetches[0]).copy_to_cpu()
+        assert out.shape == (b, 2)
+        np.testing.assert_allclose(out, 4.0)
+
+
+def test_py_func_backward_and_deserialize_persistables(tmp_path):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+
+    # custom backward reaches autograd through the host callback
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    out_proto = paddle.zeros([2])
+    y = static.py_func(lambda v: v * v, x, out_proto,
+                       backward_func=lambda v, g: 2.0 * v * g)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), [4.0, 6.0])
+
+    # deserialize_persistables returns name -> typed arrays
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        xi = static.data("xi", [2, 4], "float32")
+        w = static.create_parameter([4, 2], "float32", name="fc_w")
+        w._data = paddle.to_tensor(
+            np.arange(8, dtype=np.float32).reshape(4, 2))._data
+        z = paddle.matmul(xi, w)
+    blob = static.serialize_persistables([xi], [z], program=main)
+    state = static.deserialize_persistables(main, blob)
+    assert "fc_w" in state
+    np.testing.assert_allclose(state["fc_w"],
+                               np.arange(8, dtype=np.float32).reshape(4, 2))
