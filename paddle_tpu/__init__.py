@@ -252,3 +252,5 @@ def synchronize():
 class CUDAGraph:  # capability slot: jit already gives whole-step graphs on TPU
     def __init__(self, *a, **k):
         raise NotImplementedError("Use paddle_tpu.jit — XLA compiles whole-step graphs.")
+
+from . import cinn  # noqa: F401

@@ -1,0 +1,103 @@
+"""paddle.audio.features (parity: audio/features/layers.py) — feature
+extraction Layers over the functional fbank/dct/window helpers."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...nn.layer.layers import Layer
+from .. import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = jnp.asarray(
+            AF.get_window(window, self.win_length, dtype=dtype))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        spec = paddle.signal.stft(
+            x, n_fft=self.n_fft, hop_length=self.hop_length,
+            win_length=self.win_length,
+            window=paddle.to_tensor(np.asarray(self.window)),
+            center=self.center, pad_mode=self.pad_mode)
+        mag = spec.abs()
+        return mag ** self.power if self.power != 1.0 else mag
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype)
+        self.fbank = jnp.asarray(AF.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm))
+
+    def forward(self, x):
+        from ...core.dispatch import apply_op
+
+        spec = self.spectrogram(x)
+
+        def _mel(s):
+            return jnp.einsum("mf,...ft->...mt", self.fbank,
+                              s.astype(jnp.float32)).astype(s.dtype)
+
+        return apply_op(_mel, spec, _op_name="mel_spectrogram")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm, dtype)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), ref_value=self.ref_value,
+                              amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        n_mels, f_min, f_max, htk, norm,
+                                        ref_value, amin, top_db, dtype)
+        self.dct = jnp.asarray(AF.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        from ...core.dispatch import apply_op
+
+        lm = self.logmel(x)
+
+        def _dct(s):
+            return jnp.einsum("nm,...mt->...nt", self.dct.T,
+                              s.astype(jnp.float32)).astype(s.dtype)
+
+        return apply_op(_dct, lm, _op_name="mfcc")

@@ -88,3 +88,26 @@ def create_dct(n_mfcc, n_mels, norm="ortho"):
         dct[0] *= 1.0 / math.sqrt(2.0)
         dct *= math.sqrt(2.0 / n_mels)
     return Tensor(jnp.asarray(dct.T, jnp.float32))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """parity: audio.functional.fft_frequencies."""
+    import numpy as _np
+
+    import paddle_tpu as paddle
+
+    return paddle.to_tensor(
+        _np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """parity: audio.functional.mel_frequencies."""
+    import numpy as _np
+
+    import paddle_tpu as paddle
+
+    lo, hi = hz_to_mel(f_min, htk), hz_to_mel(f_max, htk)
+    mels = _np.linspace(lo, hi, n_mels)
+    return paddle.to_tensor(
+        _np.asarray([mel_to_hz(m, htk) for m in mels]).astype(dtype))

@@ -1,0 +1,4 @@
+"""paddle.cinn — the reference's tensor compiler. XLA fills this slot on
+TPU (SURVEY: CINN's capability = fused codegen from graphs, which is
+exactly what jax.jit/XLA do for every program here)."""
+from . import compiler, runtime  # noqa: F401

@@ -1,0 +1,14 @@
+"""cinn.compiler — `compile` maps to jax.jit (the TPU graph compiler)."""
+__all__ = ["compile"]
+
+
+def compile(fn=None, *, static_argnums=None, **kwargs):
+    import builtins
+
+    import jax
+
+    if isinstance(fn, builtins.str):
+        raise NotImplementedError(
+            "compiling CINN IR source text is reference-internal; pass a "
+            "python callable (compiled via XLA)")
+    return jax.jit(fn, static_argnums=static_argnums)

@@ -1,0 +1,24 @@
+"""cinn.runtime — jit-callable module shims over XLA compilation."""
+__all__ = ["CinnLowerLevelIrJit", "Module"]
+
+
+class Module:
+    """A compiled-function container (cinn runtime Module analogue)."""
+
+    def __init__(self):
+        self._fns = {}
+
+    def add(self, name, fn):
+        import jax
+
+        self._fns[name] = jax.jit(fn)
+        return self._fns[name]
+
+    def get_function(self, name):
+        return self._fns[name]
+
+
+def CinnLowerLevelIrJit(fn=None, **kwargs):
+    import jax
+
+    return jax.jit(fn) if fn is not None else jax.jit

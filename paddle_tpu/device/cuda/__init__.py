@@ -1,0 +1,91 @@
+"""paddle.device.cuda (parity surface) — on the TPU build these APIs
+address the ACCELERATOR (the reference's cuda namespace is its generic
+'the accelerator' surface): streams/events/synchronize/memory stats
+delegate to the device runtime over the TPU chip."""
+from ...device import (  # noqa: F401
+    Event,
+    Stream,
+    current_stream,
+    stream_guard,
+    synchronize,
+)
+
+__all__ = [
+    "Stream", "Event", "current_stream", "synchronize", "device_count",
+    "empty_cache", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "stream_guard",
+    "get_device_properties", "get_device_name", "get_device_capability",
+    "reset_max_memory_allocated", "reset_max_memory_reserved",
+]
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+def _stats(device=None):
+    import jax
+
+    d = jax.devices()[device or 0] if not hasattr(device, "platform") else device
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    return int(_stats(device).get("bytes_reserved",
+                                  _stats(device).get("bytes_limit", 0)))
+
+
+def max_memory_reserved(device=None):
+    return int(_stats(device).get("largest_alloc_size",
+                                  max_memory_allocated(device)))
+
+
+def reset_max_memory_allocated(device=None):
+    pass  # XLA's allocator owns peak tracking; no reset hook
+
+
+def reset_max_memory_reserved(device=None):
+    pass
+
+
+def empty_cache():
+    import gc
+
+    gc.collect()  # dropping refs releases XLA buffers
+
+
+def get_device_properties(device=None):
+    import jax
+
+    d = jax.devices()[device or 0] if not hasattr(device, "platform") else device
+
+    class _Props:
+        name = d.device_kind
+        total_memory = int(_stats(d).get("bytes_limit", 0))
+        major, minor = 0, 0
+        multi_processor_count = 1
+
+    return _Props()
+
+
+def get_device_name(device=None):
+    import jax
+
+    return jax.devices()[device or 0].device_kind
+
+
+def get_device_capability(device=None):
+    return (0, 0)  # CUDA compute capability has no TPU analogue

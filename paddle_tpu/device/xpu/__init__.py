@@ -1,0 +1,5 @@
+"""paddle.device.xpu surface — delegates to the accelerator runtime."""
+from ...device import synchronize  # noqa: F401
+from ..cuda import empty_cache  # noqa: F401
+
+__all__ = ["synchronize", "empty_cache"]

@@ -572,3 +572,7 @@ def all_gather_into_tensor(output, input, group=None, sync_op=True):
     result = _p.concat(parts, axis=0)
     output._data = result._data
     return output
+
+
+from . import passes  # noqa: F401,E402
+from . import sharding  # noqa: F401,E402

@@ -1,0 +1,68 @@
+"""paddle.distributed.passes (parity: passes/pass_base.py new_pass /
+PassManager). On TPU the heavy passes (fusion, scheduling, comm
+optimization) belong to XLA; the registry remains for USER program
+passes over the recorded static Program (each pass is a callable
+Program -> Program)."""
+from __future__ import annotations
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+_PASSES = {}
+
+
+def register_pass(name):
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+
+    return deco
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs = {}
+
+    def set_attr(self, k, v):
+        self.attrs[k] = v
+
+    def get_attr(self, k, default=None):
+        return self.attrs.get(k, default)
+
+
+class _Pass:
+    def __init__(self, name, fn, attrs):
+        self.name = name
+        self._fn = fn
+        self._attrs = dict(attrs or {})
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        progs = (main_programs if isinstance(main_programs, (list, tuple))
+                 else [main_programs])
+        for p in progs:
+            self._fn(p, context or PassContext(), **self._attrs)
+        return progs
+
+
+def _xla_owned(program, context, **attrs):
+    # fusion/memory/comm passes: XLA applies these during compilation of
+    # the replayed program; recording the request is the honest action
+    context.set_attr("delegated_to_xla", True)
+
+
+def new_pass(name, pass_attrs=None):
+    fn = _PASSES.get(name, _xla_owned)
+    return _Pass(name, fn, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes=()):
+        self._passes = list(passes)
+
+    def append(self, p):
+        self._passes.append(p)
+
+    def apply(self, main_programs, startup_programs=None):
+        ctx = PassContext()
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, ctx)
+        return main_programs, startup_programs

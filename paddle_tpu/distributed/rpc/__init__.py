@@ -150,3 +150,11 @@ def shutdown(graceful=True):
     if _state["store"] is not None:
         _state["store"].close()
     _state.update(store=None, poller=None)
+
+
+def get_current_worker_info():
+    """parity: rpc.get_current_worker_info — this process's WorkerInfo."""
+    name = _state["name"]
+    if name is None:
+        raise RuntimeError("rpc is not initialized; call init_rpc first")
+    return _state["workers"][name]

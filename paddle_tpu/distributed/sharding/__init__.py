@@ -1,0 +1,18 @@
+"""paddle.distributed.sharding (parity: group_sharded_parallel API)."""
+from ..parallel_step import group_sharded_parallel  # noqa: F401
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Save a group-sharded model (gathers full values; parity:
+    sharding/group_sharded.py save_group_sharded_model)."""
+    import os
+
+    import paddle_tpu as paddle
+
+    os.makedirs(output, exist_ok=True)
+    paddle.save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        paddle.save(optimizer.state_dict(),
+                    os.path.join(output, "model.pdopt"))

@@ -153,3 +153,7 @@ def inference(*args, **kwargs):
     raise NotImplementedError(
         "paddle.incubate.inference: serve jitted programs via jax.export/"
         "StableHLO (see paddle.onnx.export)")
+
+
+from . import autograd  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402

@@ -161,21 +161,46 @@ def calculate_density(tensor) -> float:
 # ---------------------------------------------------------------------------
 # prune + training guarantee (asp.py prune_model / decorate)
 # ---------------------------------------------------------------------------
+_EXTRA_SUPPORTED = {}
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register an extra layer TYPE (or type name) whose `weight` should
+    be pruned by prune_model; `pruning_func(weight, n, m) -> mask`
+    overrides the default mask algorithm for that layer
+    (asp.py add_supported_layer)."""
+    key = layer if isinstance(layer, type) else str(layer)
+    _EXTRA_SUPPORTED[key] = pruning_func
+
+
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     """Apply n:m masks to every trainable Linear weight (minus excluded)."""
     from paddle_tpu import nn
 
     algo = _MASK_ALGOS[mask_algo]
+    extra_types = tuple(t for t in _EXTRA_SUPPORTED if isinstance(t, type))
+    extra_names = {t for t in _EXTRA_SUPPORTED if isinstance(t, str)}
     pruned = {}
     for name, layer in model.named_sublayers():
-        if not isinstance(layer, nn.Linear):
+        supported = (isinstance(layer, nn.Linear)
+                     or isinstance(layer, extra_types)
+                     or type(layer).__name__ in extra_names)
+        if not supported or not hasattr(layer, "weight"):
             continue
+        custom = None
+        for key, fn in _EXTRA_SUPPORTED.items():
+            if fn is not None and (
+                    (isinstance(key, type) and isinstance(layer, key))
+                    or type(layer).__name__ == key):
+                custom = fn
+                break
+        layer_algo = custom or algo
         p = layer.weight
         pname = getattr(p, "name", name + ".weight")
         if name in _EXCLUDED or pname in _EXCLUDED:
             continue
         w = np.asarray(p.numpy())
-        mask = algo(w, n, m)
+        mask = layer_algo(w, n, m)
         p._data = jnp.asarray(w * mask, p._data.dtype)
         if with_mask:
             _MASKS[id(p)] = jnp.asarray(mask, p._data.dtype)

@@ -1,0 +1,39 @@
+"""paddle.incubate.autograd (parity: primapi) — jax primitives ARE the
+prim system, so enable/disable are honest toggles over an always-on
+capability; the functional transforms re-export paddle.autograd's."""
+from ...autograd.functional import Hessian, Jacobian, jvp, vjp  # noqa: F401
+from ...autograd import grad  # noqa: F401
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+_PRIM = {"enabled": True}  # jax composes from primitives unconditionally
+
+
+def enable_prim():
+    _PRIM["enabled"] = True
+
+
+def disable_prim():
+    # cannot actually leave primitive-land on this backend; record intent
+    _PRIM["enabled"] = False
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode grad (primapi.forward_grad) = jvp tangents."""
+    import paddle_tpu as paddle
+
+    def fn(*xs):
+        return outputs(*xs) if callable(outputs) else outputs
+
+    if callable(outputs):
+        raise TypeError("forward_grad takes computed outputs; use "
+                        "paddle.incubate.autograd.jvp for callables")
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    tangents = grad_inputs or [paddle.ones_like(x) for x in ins]
+    # recompute via vjp-of-vjp would lose fwd-mode; use autograd.functional
+    from ...autograd.functional import jvp as _jvp
+
+    raise NotImplementedError(
+        "forward_grad over recorded static programs is not supported on "
+        "the TPU build; call paddle.incubate.autograd.jvp(fn, xs, v)")

@@ -1,0 +1,4 @@
+"""paddle.incubate.optimizer — LBFGS graduated into paddle.optimizer."""
+from ...optimizer import LBFGS  # noqa: F401
+
+__all__ = ["LBFGS"]

@@ -55,3 +55,6 @@ from .layer.compat import (  # noqa: F401
     ParameterDict, RNNTLoss, Softmax2D, SpectralNorm,
     TripletMarginWithDistanceLoss, Unflatten, ZeroPad1D, ZeroPad3D,
     dynamic_decode)
+
+from . import quant  # noqa: F401
+from . import utils  # noqa: F401

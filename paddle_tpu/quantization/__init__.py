@@ -185,3 +185,7 @@ def quanter(class_name):
         return cls
 
     return deco
+
+
+from . import observers  # noqa: F401,E402
+from . import quanters  # noqa: F401,E402

@@ -1,0 +1,12 @@
+"""paddle.quantization.observers (parity: observers/abs_max.py etc.)."""
+from .. import AbsmaxObserver  # noqa: F401
+
+__all__ = ["AbsmaxObserver", "GroupWiseWeightObserver"]
+
+
+class GroupWiseWeightObserver(AbsmaxObserver):
+    """Per-group absmax over the quant axis (observers/groupwise.py)."""
+
+    def __init__(self, quant_bits=8, group_size=128, **kwargs):
+        super().__init__(quant_bits=quant_bits)
+        self.group_size = group_size

@@ -1,0 +1,4 @@
+"""paddle.quantization.quanters (parity: quanters/abs_max.py)."""
+from .. import FakeQuanterWithAbsMax as FakeQuanterWithAbsMaxObserver  # noqa: F401
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]

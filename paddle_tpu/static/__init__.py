@@ -134,6 +134,18 @@ class Executor:
         import jax
         from jax import tree_util
 
+        from ..inference import Predictor as _Predictor
+
+        if isinstance(program, _Predictor):
+            # loaded inference model (load_inference_model contract)
+            pred = program
+            for name, arr in (feed or {}).items():
+                h = pred.get_input_handle(name)
+                h.copy_from_cpu(np.asarray(arr))
+            pred.run()
+            outs = [pred.get_output_handle(n).copy_to_cpu()
+                    for n in (fetch_list or pred.get_output_names())]
+            return outs if return_numpy else [Tensor(o) for o in outs]
         program = program if isinstance(program, Program) else (
             program or _default_main)
         feed = feed or {}
@@ -326,16 +338,21 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     seen_list = ul
 
     # declared None/-1 dims export as symbolic so the artifact serves any
-    # size on those axes (same contract as jit.save + InputSpec)
+    # size on those axes (same contract as jit.save + InputSpec). One
+    # SHARED scope, and the dim NAME is shared by axis index across feeds
+    # ("_dyn0" = every feed's dynamic axis 0): multiple feeds with a
+    # dynamic batch axis combine (add/concat/matmul) because the export
+    # knows the sizes are equal — the dominant shared-batch contract.
     from jax import export as _jx
 
+    scope = _jx.SymbolicScope()
     avals = []
     for v in feed_list:
         decl = getattr(v, "_declared_shape", None) or list(v.shape)
         if any(d is None for d in decl):
-            sym = _jx.symbolic_shape(
-                ",".join(f"d{i}" if d is None else str(d)
-                         for i, d in enumerate(decl)))
+            names = [f"_dyn{ax}" if d is None else str(int(d))
+                     for ax, d in enumerate(decl)]
+            sym = _jx.symbolic_shape(", ".join(names), scope=scope)
             avals.append(jax.ShapeDtypeStruct(tuple(sym), v._data.dtype))
         else:
             avals.append(jax.ShapeDtypeStruct(tuple(decl), v._data.dtype))
@@ -377,9 +394,19 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
 
 # -- program/persistable (de)serialization over the artifact bytes ---------
+_SERIALIZE_MEMO = {}
+
+
 def _serialize_artifact(feed_vars, fetch_vars, program):
     import tempfile
 
+    program = program or default_main_program()
+    fv = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    ov = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    key = (id(program), len(program.records),
+           tuple(id(v) for v in fv), tuple(id(v) for v in ov))
+    if key in _SERIALIZE_MEMO:   # serialize_program + serialize_persistables
+        return _SERIALIZE_MEMO[key]  # back-to-back export only once
     with tempfile.TemporaryDirectory() as d:
         p = save_inference_model(d + "/m", feed_vars, fetch_vars,
                                  program=program)
@@ -387,6 +414,8 @@ def _serialize_artifact(feed_vars, fetch_vars, program):
             model = f.read()
         with open(p + ".pdiparams", "rb") as f:
             params = f.read()
+    _SERIALIZE_MEMO.clear()
+    _SERIALIZE_MEMO[key] = (model, params)
     return model, params
 
 
@@ -435,6 +464,10 @@ def deserialize_persistables(program, data, executor=None):
         out[name] = np.frombuffer(
             z[f"w{i}"].tobytes(), np.dtype(dtype)).reshape(shape)
         i += 1
+    # reference contract: restoring persistables takes effect on the
+    # program (callers often discard the return value)
+    if program is not None:
+        set_program_state(program, out)
     return out
 
 
@@ -533,6 +566,7 @@ class ExponentialMovingAverage:
 
     def __init__(self, decay=0.999, thres_steps=None, name=None):
         self._decay = decay
+        self._thres_steps = thres_steps
         self._shadow = {}
         self._backup = {}
         self._step = 0
@@ -547,7 +581,10 @@ class ExponentialMovingAverage:
                 "them explicitly (eager mode) or record a program with "
                 "trainable Parameters first")
         self._step += 1
-        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        # warm-up schedule only when thres_steps is requested (reference:
+        # flat decay otherwise)
+        d = (min(self._decay, (1 + self._step) / (10 + self._step))
+             if self._thres_steps is not None else self._decay)
         for p in params:
             key = id(p)
             prev = self._shadow.get(key)
@@ -638,7 +675,8 @@ def Print(input, first_n=-1, message=None, summarize=20, **kwargs):
     from ..core.dispatch import apply_op
 
     def _p(a):
-        jax.debug.print((message or "Print") + ": {}", a)
+        msg = (message or "Print").replace("{", "{{").replace("}", "}}")
+        jax.debug.print(msg + ": {}", a)
         return a
 
     return apply_op(_p, input, _op_name="print")
@@ -729,3 +767,6 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             seen.add(id(p)); uniq.append(p)
     grads = autograd.grad(loss, uniq, retain_graph=True, allow_unused=True)
     return list(zip(uniq, grads))
+
+
+from . import nn  # noqa: F401,E402

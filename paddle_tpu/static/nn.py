@@ -1,0 +1,462 @@
+"""paddle.static.nn (parity: python/paddle/static/nn) — static-graph
+layer builders and control flow.
+
+TPU-native: builders create Parameters and run the SAME functional ops
+the dygraph layers use (every call records into the active Program via
+apply_op); control flow (`cond`, `while_loop`, `case`, `switch_case`)
+lowers to `lax.cond`/`lax.while_loop` so data-dependent branching stays
+compiled instead of breaking the graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Parameter, Tensor
+from . import create_parameter, py_func  # noqa: F401
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+]
+
+
+def _param(shape, dtype="float32", init=None):
+    p = create_parameter(shape, dtype, default_initializer=init)
+    return p
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+# -- layer builders ---------------------------------------------------------
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """static.nn.fc — flatten trailing dims, affine, optional activation."""
+    import paddle_tpu.nn.functional as F
+
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    w = _param([in_dim, size], str(np.asarray(x.numpy()).dtype))
+    b = None if bias_attr is False else _param([size])
+    flat = x.reshape(list(x.shape[:num_flatten_dims]) + [in_dim])
+    out = F.linear(flat, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    import paddle_tpu.nn.functional as F
+
+    w = _param(list(size), dtype)
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32", **kwargs):
+    """PS-backed large embedding: pulls rows from the fleet sparse table
+    when PS mode is active, dense embedding otherwise."""
+    from ..distributed.fleet import _ps_state
+
+    if _ps_state.get("client") is not None:
+        from ..distributed.ps import sparse_embedding_lookup
+
+        client = _ps_state["client"]
+        client.create_sparse_table("sparse_embedding", dim=int(size[-1]))
+        return sparse_embedding_lookup(client, "sparse_embedding",
+                                       np.asarray(input.numpy()),
+                                       int(size[-1]))
+    return embedding(input, size, padding_idx=padding_idx, dtype=dtype)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, **kwargs):
+    import paddle_tpu.nn.functional as F
+
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale, bias = _param([c]), _param([c])
+    scale._data = jnp.ones([c], jnp.float32)
+    mean = Tensor(jnp.zeros([c], jnp.float32))
+    var = Tensor(jnp.ones([c], jnp.float32))
+    out = F.batch_norm(input, mean, var, weight=scale, bias=bias,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None):
+    import paddle_tpu.nn.functional as F
+
+    shape = list(input.shape[begin_norm_axis:])
+    w = _param(shape) if scale else None
+    if w is not None:
+        w._data = jnp.ones(shape, jnp.float32)
+    b = _param(shape) if shift else None
+    out = F.layer_norm(input, shape, weight=w, bias=b, epsilon=epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW"):
+    import paddle_tpu.nn.functional as F
+
+    c = input.shape[1]
+    w, b = _param([c]), _param([c])
+    w._data = jnp.ones([c], jnp.float32)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None):
+    import paddle_tpu.nn.functional as F
+
+    c = input.shape[1]
+    w, b = _param([c]), _param([c])
+    w._data = jnp.ones([c], jnp.float32)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, **kwargs):
+    """Normalization by accumulated batch statistics (CTR models)."""
+    mean = input.mean(axis=0, keepdim=True)
+    std = ((input - mean) ** 2).mean(axis=0, keepdim=True)
+    out = (input - mean) / (std + epsilon).sqrt()
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def _conv(input, num_filters, filter_size, stride, padding, dilation,
+          groups, nd, transpose=False):
+    import paddle_tpu.nn.functional as F
+
+    c_in = input.shape[1]
+    ks = ([filter_size] * nd if isinstance(filter_size, int)
+          else list(filter_size))
+    if transpose:
+        w = _param([c_in, num_filters // (groups or 1)] + ks)
+        fn = F.conv2d_transpose if nd == 2 else F.conv3d_transpose
+    else:
+        w = _param([num_filters, c_in // (groups or 1)] + ks)
+        fn = F.conv2d if nd == 2 else F.conv3d
+    return fn(input, w, bias=None, stride=stride, padding=padding,
+              dilation=dilation, groups=groups or 1)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           act=None, **kwargs):
+    out = _conv(input, num_filters, filter_size, stride, padding,
+                dilation, groups, nd=2)
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, **kwargs):
+    return _conv(input, num_filters, filter_size, stride, padding,
+                 dilation, groups, nd=3)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=3,
+                     stride=1, padding=0, dilation=1, groups=None, **kw):
+    return _conv(input, num_filters, filter_size, stride, padding,
+                 dilation, groups, nd=2, transpose=True)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=3,
+                     stride=1, padding=0, dilation=1, groups=None, **kw):
+    return _conv(input, num_filters, filter_size, stride, padding,
+                 dilation, groups, nd=3, transpose=True)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=None, deformable_groups=1,
+                  **kwargs):
+    from ..vision.ops import deform_conv2d as _dc
+
+    c_in = input.shape[1]
+    ks = ([filter_size] * 2 if isinstance(filter_size, int)
+          else list(filter_size))
+    w = _param([num_filters, c_in // (groups or 1)] + ks)
+    return _dc(input, offset, w, mask=mask, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups or 1)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    import paddle_tpu.nn.functional as F
+
+    w = _param([size, x.shape[-1], y.shape[-1]])
+    b = _param([size])
+    out = F.bilinear(x, y, w, b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    import paddle_tpu.nn.functional as F
+
+    n = (1 if mode == "all"
+         else x.shape[1] if mode == "channel" else int(np.prod(x.shape[1:])))
+    alpha = _param([n])
+    alpha._data = jnp.full([n], 0.25, jnp.float32)
+    if mode == "element":
+        alpha._data = alpha._data.reshape([1] + list(x.shape[1:]))
+    return F.prelu(x, alpha)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Functional spectral norm of a weight tensor."""
+    def _sn(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1).astype(
+            jnp.float32)
+        u = jnp.ones((mat.shape[0],), jnp.float32) / np.sqrt(mat.shape[0])
+        v = None
+        for _ in range(max(power_iters, 1)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ mat @ v
+        return (w / sigma.astype(w.dtype))
+
+    return apply_op(_sn, weight, _op_name="spectral_norm")
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        **kwargs):
+    """Noise-contrastive estimation loss with uniform negative sampling."""
+    import paddle_tpu as paddle
+
+    dim = input.shape[-1]
+    w = _param([num_total_classes, dim])
+    b = _param([num_total_classes])
+
+    def _nce(h, y, wv, bv):
+        n = h.shape[0]
+        key = jax.random.PRNGKey(0)
+        neg = jax.random.randint(key, (n, num_neg_samples), 0,
+                                 num_total_classes)
+        pos_logit = jnp.sum(h * wv[y.reshape(-1)], -1) + bv[y.reshape(-1)]
+        neg_logit = jnp.einsum("nd,nkd->nk", h, wv[neg]) + bv[neg]
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jax.nn.softplus(neg_logit).sum(-1)
+        return (pos_loss + neg_loss).reshape(n, 1)
+
+    return apply_op(_nce, input, label, w, b, _op_name="nce")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (`row_conv`): out[t] = sum_{i<=k} w[i]*x[t+i]."""
+    import paddle_tpu.nn.functional as F
+
+    d = input.shape[-1]
+    w = _param([future_context_size + 1, d])
+
+    def _rc(x, wv):
+        k = wv.shape[0]
+        pads = [(0, 0)] * x.ndim
+        pads[1] = (0, k - 1)
+        xp = jnp.pad(x, pads)
+        out = sum(xp[:, i:i + x.shape[1]] * wv[i] for i in range(k))
+        return out.astype(x.dtype)
+
+    out = apply_op(_rc, input, w, _op_name="row_conv")
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+# -- sequence ops (padded batches; the lod-free TPU form) -------------------
+def sequence_softmax(input, axis=1, **kwargs):
+    import paddle_tpu.nn.functional as F
+
+    return F.softmax(input, axis=axis)
+
+
+def sequence_pool(input, pool_type="sum", **kwargs):
+    pool_type = pool_type.lower()
+    if pool_type in ("sum",):
+        return input.sum(axis=1)
+    if pool_type in ("average", "avg", "mean"):
+        return input.mean(axis=1)
+    if pool_type == "max":
+        return input.max(axis=1)
+    if pool_type == "sqrt":
+        return input.sum(axis=1) / float(np.sqrt(input.shape[1]))
+    if pool_type == "first":
+        return sequence_first_step(input)
+    if pool_type == "last":
+        return sequence_last_step(input)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(input):
+    return input[:, 0]
+
+
+def sequence_last_step(input):
+    return input[:, -1]
+
+
+def sequence_expand(x, y, ref_level=-1):
+    def _se(xa, ya):
+        rep = ya.shape[1] // max(xa.shape[1], 1)
+        return jnp.repeat(xa, rep, axis=1)
+
+    return apply_op(_se, x, y, _op_name="sequence_expand")
+
+
+def sequence_conv(input, num_filters, filter_size=3, padding=True,
+                  param_attr=None, bias_attr=None, act=None, **kwargs):
+    import paddle_tpu.nn.functional as F
+
+    d = input.shape[-1]
+    w = _param([filter_size * d, num_filters])
+
+    def _sc(x, wv):
+        k = filter_size
+        half = (k - 1) // 2
+        pads = [(0, 0)] * x.ndim
+        pads[1] = (half, k - 1 - half)
+        xp = jnp.pad(x, pads)
+        windows = jnp.concatenate(
+            [xp[:, i:i + x.shape[1]] for i in range(k)], axis=-1)
+        return windows @ wv
+
+    out = apply_op(_sc, input, w, _op_name="sequence_conv")
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+# -- control flow (lax-lowered: stays compiled) -----------------------------
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """lax.cond over Tensor-returning branches (static_nn/control_flow)."""
+    def _cond(p):
+        return jax.lax.cond(
+            jnp.asarray(p).reshape(()).astype(bool),
+            lambda: _unwrap_tree(true_fn()),
+            lambda: _unwrap_tree(false_fn()),
+        )
+
+    return apply_op(_cond, pred, _op_name="cond")
+
+
+def _unwrap_tree(out):
+    from jax import tree_util
+
+    return tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First true predicate wins (reference static.nn.case)."""
+    def build(i):
+        if i == len(pred_fn_pairs):
+            if default is None:
+                return lambda: _unwrap_tree(pred_fn_pairs[-1][1]())
+            return lambda: _unwrap_tree(default())
+        p, fn = pred_fn_pairs[i]
+        nxt = build(i + 1)
+        return lambda: jax.lax.cond(
+            jnp.asarray(_unwrap(p)).reshape(()).astype(bool),
+            lambda: _unwrap_tree(fn()), nxt)
+
+    def _case():
+        return build(0)()
+
+    return apply_op(_case, _op_name="case")
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    def _sw(idx):
+        fns = branch_fns
+        if isinstance(fns, dict):
+            keys = sorted(fns)
+            ordered = [fns[k] for k in keys]
+            # map arbitrary integer keys onto dense positions
+            pos = sum(jnp.where(jnp.asarray(idx) == k, i, 0)
+                      for i, k in enumerate(keys))
+            branches = [(lambda f=f: _unwrap_tree(f())) for f in ordered]
+            if default is not None:
+                branches.append(lambda: _unwrap_tree(default()))
+                known = sum((jnp.asarray(idx) == k).astype(jnp.int32)
+                            for k in keys)
+                pos = jnp.where(known > 0, pos, len(ordered))
+            return jax.lax.switch(pos, branches)
+        branches = [(lambda f=f: _unwrap_tree(f())) for f in fns]
+        return jax.lax.switch(jnp.clip(jnp.asarray(idx), 0,
+                                       len(branches) - 1), branches)
+
+    return apply_op(_sw, branch_index, _op_name="switch_case")
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """lax.while_loop over Tensor loop state."""
+    def _wl(*state):
+        def c(s):
+            out = cond(*[Tensor(a) for a in s])
+            return jnp.asarray(_unwrap(out)).reshape(()).astype(bool)
+
+        def b(s):
+            out = body(*[Tensor(a) for a in s])
+            out = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(_unwrap(o) for o in out)
+
+        return jax.lax.while_loop(c, b, tuple(state))
+
+    return apply_op(_wl, *loop_vars, _op_name="while_loop")
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Custom fwd/bwd region in a static program (static_pylayer op)."""
+    if backward_fn is None:
+        out = forward_fn(*inputs)
+        return out
+
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    @jax.custom_vjp
+    def _run(*arrays):
+        out = forward_fn(*[Tensor(a) for a in arrays])
+        return _unwrap_tree(out)
+
+    def _fwd(*arrays):
+        return _run(*arrays), arrays
+
+    def _bwd(res, g):
+        gl = g if isinstance(g, (list, tuple)) else (g,)
+        grads = backward_fn(*[Tensor(a) for a in gl])
+        grads = grads if isinstance(grads, (list, tuple)) else [grads]
+        return tuple(_unwrap(x) for x in grads)
+
+    _run.defvjp(_fwd, _bwd)
+    return apply_op(_run, *xs, _op_name="static_pylayer")

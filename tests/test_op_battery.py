@@ -1036,7 +1036,11 @@ def _to_torch(a, dtype):
 def test_op(c):
     import paddle_tpu as paddle
 
-    rng = np.random.RandomState(abs(hash(c.name)) % (2 ** 31))
+    # stable per-op seed: str hash is PYTHONHASHSEED-randomized, which made
+    # boundary-sensitive ops (floor on bf16 values near integers) flaky
+    import zlib
+
+    rng = np.random.RandomState(zlib.crc32(c.name.encode()) % (2 ** 31))
     raw = c.make(rng)
 
     for dtype in c.dtypes:

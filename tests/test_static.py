@@ -138,3 +138,29 @@ def test_py_func_backward_and_deserialize_persistables(tmp_path):
     assert "fc_w" in state
     np.testing.assert_allclose(state["fc_w"],
                                np.arange(8, dtype=np.float32).reshape(4, 2))
+
+
+def test_save_inference_model_multi_dynamic_inputs_and_executor_run(tmp_path):
+    """Two dynamic-batch feeds share one symbolic scope; the loaded model
+    runs through the documented Executor.run(loaded, ...) contract."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        a = static.data("a", [-1, 4], "float32")
+        b = static.data("b", [-1, 4], "float32")
+        z = paddle.add(a, b)
+    static.save_inference_model(str(tmp_path / "mm"), [a, b], [z],
+                                program=main)
+    loaded, feeds, fetches = static.load_inference_model(str(tmp_path / "mm"))
+    exe = static.Executor()
+    for batch in (2, 5):
+        outs = exe.run(loaded,
+                       feed={feeds[0]: np.full((batch, 4), 2.0, np.float32),
+                             feeds[1]: np.full((batch, 4), 3.0, np.float32)},
+                       fetch_list=fetches)
+        np.testing.assert_allclose(outs[0], 5.0)
+        assert outs[0].shape == (batch, 4)

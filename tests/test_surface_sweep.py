@@ -157,3 +157,43 @@ def test_sparse_extras():
     out = sparse.nn.functional.relu(
         sparse.sparse_coo_tensor(ind, [-1.0, 3.0], [2, 2]))
     np.testing.assert_allclose(np.asarray(out.values().numpy()), [0.0, 3.0])
+
+
+def test_nested_namespace_all_closure():
+    """Every reference subpackage __all__ (depth <= 2) resolves against the
+    matching paddle_tpu module — the switch-and-find-everything contract."""
+    import ast
+    import importlib
+
+    REF = "/root/reference/python/paddle"
+    gaps = []
+    for root, dirs, files in os.walk(REF):
+        if "__init__.py" not in files:
+            continue
+        rel = os.path.relpath(root, REF)
+        if rel == "." or rel.count(os.sep) > 1:
+            continue
+        try:
+            tree = ast.parse(open(os.path.join(root, "__init__.py")).read())
+        except SyntaxError:
+            continue
+        ref_all = None
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Assign) and \
+                    getattr(n.targets[0], "id", "") == "__all__":
+                try:
+                    ref_all = [ast.literal_eval(e) for e in n.value.elts]
+                except Exception:
+                    pass
+        if not ref_all:
+            continue
+        mod = "paddle_tpu." + rel.replace(os.sep, ".")
+        try:
+            mine = importlib.import_module(mod)
+        except ImportError as e:
+            gaps.append((rel, "MODULE MISSING", str(e)[:80]))
+            continue
+        missing = [n for n in ref_all if not hasattr(mine, n)]
+        if missing:
+            gaps.append((rel, missing))
+    assert not gaps, gaps
