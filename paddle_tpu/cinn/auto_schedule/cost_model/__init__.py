@@ -1,0 +1,21 @@
+"""cinn.auto_schedule.cost_model — scheduling cost models. The XLA slot:
+costs come from compiled cost analysis (see paddle.cost_model)."""
+from ....cost_model import CostModel  # noqa: F401
+
+__all__ = ["CostModel", "CostModelType", "XgbCostModel"]
+
+
+class CostModelType:
+    XGB = "xgb"
+    ANALYTIC = "analytic"
+
+
+class XgbCostModel(CostModel):
+    """The reference trains an XGBoost regressor on measured schedules;
+    xgboost is not in the TPU image and XLA owns scheduling, so this
+    subclass keeps the surface and raises on train()."""
+
+    def train(self, samples, labels):
+        raise NotImplementedError(
+            "schedule search is XLA's job on TPU; use CostModel."
+            "profile_measure for compiled cost estimates")

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from jax import shard_map
 
-from ..core.tensor import Tensor
+from ...core.tensor import Tensor
 
 P = PartitionSpec
 
@@ -388,7 +388,7 @@ def _get_p2p_store():
                 "eager send/recv needs a multi-controller run (PADDLE_MASTER "
                 "set by the launcher); in-program transfers compile to "
                 "lax.ppermute (paddle_tpu.distributed.pipeline)")
-        from .store import TCPStore
+        from ..store import TCPStore
 
         host, port = master.rsplit(":", 1)
         # the master port itself hosts the jax coordinator; p2p rides +1
@@ -438,15 +438,7 @@ def wait(tensor, group=None, use_calc_stream=True):
         tensor._data.block_until_ready()
 
 
-# stream namespace parity (communication/stream/*)
-class _StreamNS:
-    all_reduce = staticmethod(all_reduce)
-    all_gather = staticmethod(all_gather)
-    reduce_scatter = staticmethod(reduce_scatter)
-    broadcast = staticmethod(broadcast)
-    alltoall = staticmethod(all_to_all)
-    scatter = staticmethod(scatter)
-    reduce = staticmethod(reduce)
-
-
-stream = _StreamNS()
+# stream namespace: the real submodule (communication/stream.py) is the
+# single surface — imported at the bottom so `communication.stream`
+# always resolves to it regardless of import order
+from . import stream  # noqa: F401,E402

@@ -1,0 +1,42 @@
+"""paddle.distributed.communication.stream — stream-variant collectives.
+
+The reference exposes per-stream versions (sync_op/use_calc_stream
+control). Under XLA there is one ordered stream per device and
+collectives are compiled, so these delegate to the standard API; the
+returned task object carries the async-looking surface (`wait`)."""
+from __future__ import annotations
+
+from . import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    broadcast,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    gather,
+)
+from . import all_to_all as alltoall  # noqa: F401  (stream-module naming)
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    """Single-tensor all-to-all: splits along dim 0 (the reference's
+    alltoall_single), built on the list-based all_to_all."""
+    import paddle_tpu as paddle
+
+    n = paddle.distributed.get_world_size(group)
+    ins = list(paddle.split(in_tensor, in_split_sizes or n, axis=0))         if not isinstance(in_tensor, (list, tuple)) else list(in_tensor)
+    outs = []  # all_to_all BUILDS the list (append)
+    alltoall(outs, ins, group=group, sync_op=sync_op)
+    result = paddle.concat(outs, axis=0)
+    out_tensor._assign_result_(result) if hasattr(
+        out_tensor, "_assign_result_") else None
+    return result
+
+__all__ = [
+    "all_gather", "all_reduce", "alltoall", "alltoall_single", "broadcast",
+    "reduce", "reduce_scatter", "recv", "scatter", "send", "gather",
+]

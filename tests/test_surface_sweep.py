@@ -160,7 +160,7 @@ def test_sparse_extras():
 
 
 def test_nested_namespace_all_closure():
-    """Every reference subpackage __all__ (depth <= 2) resolves against the
+    """Every reference subpackage __all__ (depth <= 3) resolves against the
     matching paddle_tpu module — the switch-and-find-everything contract."""
     import ast
     import importlib
@@ -171,7 +171,7 @@ def test_nested_namespace_all_closure():
         if "__init__.py" not in files:
             continue
         rel = os.path.relpath(root, REF)
-        if rel == "." or rel.count(os.sep) > 1:
+        if rel == "." or rel.count(os.sep) > 2:
             continue
         try:
             tree = ast.parse(open(os.path.join(root, "__init__.py")).read())
