@@ -405,9 +405,12 @@ class XpuConfig:
             setattr(self, k, v)
 
 
+from .serving import ContinuousBatchingEngine, PagePool  # noqa: E402
+
 __all__ = [
     "Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
     "DataType", "create_predictor", "get_version",
+    "ContinuousBatchingEngine", "PagePool",
     "get_num_bytes_of_data_type", "get_trt_compile_version",
     "get_trt_runtime_version", "convert_to_mixed_precision",
     "PredictorPool", "XpuConfig", "_get_phi_kernel_name",

@@ -1,0 +1,284 @@
+"""Continuous-batching LLM serving over paged KV caches.
+
+Capability slot: the reference's LLM serving stack (the C++ side of
+`block_multi_head_attention` + the fastdeploy/serving slot managers that
+drive it). TPU-native design:
+
+- KV lives in PAGES `[num_pages, Hkv, page_size, D]` per layer; a
+  `PagePool` hands pages to sequences on admission and reclaims them on
+  completion, so memory scales with live tokens, not max_seq * slots.
+- `ContinuousBatchingEngine` drives the vLLM-style loop: admit waiting
+  requests into free slots (prefill writes the prompt's KV into that
+  sequence's pages), then run ONE batched decode step for every live
+  slot per `step()` — new requests join mid-flight without stalling
+  running ones, finished slots free their pages immediately.
+- The decode step's attention is the pallas paged kernel
+  (`ops/pallas/decode_attention.paged_attention`): block tables via
+  scalar prefetch, so only the pages a sequence owns are fetched.
+
+Greedy decoding; works with the GPT/LLaMA stacked-weights families
+(anything exposing `_decode_params()` — llama.py:66).
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+
+import numpy as np
+
+__all__ = ["PagePool", "ContinuousBatchingEngine"]
+
+
+class PagePool:
+    """Free-list page allocator (the block manager)."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free = deque(range(num_pages))
+
+    def alloc(self, n: int):
+        if n > len(self._free):
+            raise MemoryError(
+                f"PagePool: need {n} pages, {len(self._free)} free")
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, pages):
+        self._free.extend(pages)
+
+    @property
+    def available(self):
+        return len(self._free)
+
+
+class _Request:
+    __slots__ = ("rid", "prompt", "generated", "length", "pages")
+
+    def __init__(self, rid, prompt):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.generated = []
+        self.length = 0          # tokens currently in the kv pages
+        self.pages = []
+
+
+class ContinuousBatchingEngine:
+    def __init__(self, model, max_slots=4, page_size=64, num_pages=None,
+                 max_seq_len=None, max_new_tokens=32, eos_token_id=None):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax, self._jnp = jax, jnp
+        cfg = model.config
+        self.cfg = cfg
+        self.page = page_size
+        self.max_seq = max_seq_len or cfg.max_seq_len
+        self.pages_per_seq = (self.max_seq + page_size - 1) // page_size
+        self.max_slots = max_slots
+        self.max_new_tokens = max_new_tokens
+        self.eos = eos_token_id
+        num_pages = num_pages or (max_slots * self.pages_per_seq + 2)
+        self.pool = PagePool(num_pages)
+
+        hd = cfg.hidden_size // cfg.num_heads
+        self.hd, self.hkv = hd, cfg.num_kv_heads
+
+        # weights, flattened like llama.generate
+        params = model._decode_params()
+        self._lp = [tuple(lp[k]._data for k in
+                          ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg",
+                           "wu", "wd")) for lp in params]
+        self._embed = model.model.embed_tokens.weight._data
+        self._fnorm = model.model.final_norm.weight._data
+        self._head = (model.lm_head.weight._data
+                      if model.lm_head is not None else None)
+
+        # paged caches per layer, KERNEL layout [Hkv, num_pages, page, D]
+        # (what paged_attention consumes — no per-step transposes)
+        dt = self._embed.dtype
+        self.kc = [jnp.zeros((self.hkv, num_pages, page_size, hd), dt)
+                   for _ in range(cfg.num_layers)]
+        self.vc = [jnp.zeros((self.hkv, num_pages, page_size, hd), dt)
+                   for _ in range(cfg.num_layers)]
+
+        self._slots: list[_Request | None] = [None] * max_slots
+        self._waiting: deque[_Request] = deque()
+        self._next_rid = 0
+        self._decode_jit = jax.jit(self._decode_step,
+                           donate_argnums=(3, 4))
+
+    # -- model math ---------------------------------------------------------
+    @staticmethod
+    def _rope(x, pos):
+        """Shared framework rope (models/gpt.py) — serving stays
+        bit-identical to training/generate."""
+        from ..models.gpt import _rope_at_positions
+
+        return _rope_at_positions(x, pos)
+
+    def _prefill(self, req: _Request):
+        """Run the prompt, write its KV into the request's pages, return
+        the next (greedy) token. Per-request; the decode path is batched.
+
+        Runs eagerly: each page-cache write copies the pool once per
+        layer, a per-ADMISSION cost (not per-token). Jitting would need
+        per-prompt-length retraces (bucket lengths first if admission
+        cost ever dominates — see jit.to_static bucket_dynamic_shapes)."""
+        jax, jnp = self._jax, self._jnp
+        from .. import models  # noqa: F401  (keep import surface warm)
+        from ..models.gpt import _rms_pure
+
+        ids = jnp.asarray(np.asarray(req.prompt)[None, :])   # [1, S]
+        s = ids.shape[1]
+        x = self._embed[ids]
+        pos0 = jnp.zeros((1,), jnp.int32)
+        page_ids = np.asarray(req.pages, np.int64)
+        for li, lp in enumerate(self._lp):
+            ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
+            h = _rms_pure(x, ln1)
+            q = (h @ wq).reshape(1, s, self.cfg.num_heads, self.hd)
+            k = (h @ wk).reshape(1, s, self.hkv, self.hd)
+            v = (h @ wv).reshape(1, s, self.hkv, self.hd)
+            q, k = self._rope(q, pos0), self._rope(k, pos0)
+            # causal attention over the prompt itself (no history)
+            scale = 1.0 / math.sqrt(self.hd)
+            rep = self.cfg.num_heads // self.hkv
+            ck = jnp.repeat(k, rep, 2) if rep > 1 else k
+            cv = jnp.repeat(v, rep, 2) if rep > 1 else v
+            logits = jnp.einsum("bthd,bshd->bhts",
+                                (q * scale).astype(jnp.float32),
+                                ck.astype(jnp.float32))
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, -1)
+            o = jnp.einsum("bhts,bshd->bthd", probs,
+                           cv.astype(jnp.float32)).astype(x.dtype)
+            x = x + o.reshape(1, s, -1) @ wo
+            h2 = _rms_pure(x, ln2)
+            x = x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+            # scatter this layer's k/v into the owned pages; ADJACENT
+            # advanced indices (axes 1,2) stay in place -> value layout
+            # [Hkv, S, D]
+            tok_pages = page_ids[np.arange(s) // self.page]
+            offs = jnp.asarray(np.arange(s) % self.page)
+            self.kc[li] = self.kc[li].at[:, tok_pages, offs, :].set(
+                jnp.swapaxes(k[0], 0, 1).astype(self.kc[li].dtype))
+            self.vc[li] = self.vc[li].at[:, tok_pages, offs, :].set(
+                jnp.swapaxes(v[0], 0, 1).astype(self.vc[li].dtype))
+        x = _rms_pure(x, self._fnorm)[:, -1]
+        lg = x @ self._head if self._head is not None else x @ self._embed.T
+        req.length = s
+        return int(np.asarray(jnp.argmax(lg, -1))[0])
+
+    def _decode_step(self, tokens, lens, tables, kc, vc):
+        """ONE batched decode: tokens [B] (last emitted), lens [B] tokens
+        already cached, tables [B, pages_per_seq]. Returns (next [B],
+        new kc, new vc)."""
+        jax, jnp = self._jax, self._jnp
+        from ..models.gpt import _rms_pure
+        from ..ops.pallas.decode_attention import paged_attention
+
+        b = tokens.shape[0]
+        x = self._embed[tokens][:, None]                 # [B, 1, H]
+        page_ids = tables[jnp.arange(b), lens // self.page]
+        offs = lens % self.page
+        for li, lp in enumerate(self._lp):
+            ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
+            h = _rms_pure(x, ln1)
+            q = (h @ wq).reshape(b, 1, self.cfg.num_heads, self.hd)
+            k = (h @ wk).reshape(b, 1, self.hkv, self.hd)
+            v = (h @ wv).reshape(b, 1, self.hkv, self.hd)
+            q, k = self._rope(q, lens), self._rope(k, lens)
+            kc_l = kc[li].at[:, page_ids, offs, :].set(
+                jnp.swapaxes(k[:, 0], 0, 1).astype(kc[li].dtype))
+            vc_l = vc[li].at[:, page_ids, offs, :].set(
+                jnp.swapaxes(v[:, 0], 0, 1).astype(vc[li].dtype))
+            kc[li], vc[li] = kc_l, vc_l
+            o = paged_attention(q[:, 0], kc_l, vc_l, tables, lens + 1)
+            x = x + o.reshape(b, 1, -1).astype(x.dtype) @ wo
+            h2 = _rms_pure(x, ln2)
+            x = x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+        x = _rms_pure(x, self._fnorm)[:, 0]
+        lg = x @ self._head if self._head is not None else x @ self._embed.T
+        return jnp.argmax(lg, -1).astype(jnp.int32), kc, vc
+
+    # -- engine surface -----------------------------------------------------
+    def submit(self, prompt_ids) -> int:
+        total = len(prompt_ids) + self.max_new_tokens
+        if total > self.max_seq:
+            raise ValueError(
+                f"request needs {total} tokens (prompt "
+                f"{len(prompt_ids)} + max_new {self.max_new_tokens}) > "
+                f"max_seq_len {self.max_seq}")
+        need = (total + self.page - 1) // self.page
+        if need > self.pool.num_pages:
+            raise ValueError(
+                f"request needs {need} pages > pool size "
+                f"{self.pool.num_pages}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._waiting.append(_Request(rid, [int(t) for t in prompt_ids]))
+        return rid
+
+    def _admit(self):
+        for i in range(self.max_slots):
+            if self._slots[i] is not None or not self._waiting:
+                continue
+            req = self._waiting[0]
+            need = (len(req.prompt) + self.max_new_tokens
+                    + self.page - 1) // self.page
+            if need > self.pool.available:
+                break  # head-of-line waits for pages
+            self._waiting.popleft()
+            req.pages = self.pool.alloc(need)
+            first = self._prefill(req)
+            req.generated.append(first)
+            self._slots[i] = req
+
+    def _retire(self, req: _Request):
+        self.pool.free(req.pages)
+        req.pages = []
+        return req.prompt + req.generated
+
+    def step(self):
+        """Admit + one batched decode tick. Returns {rid: full_ids} for
+        requests finishing THIS tick."""
+        jnp = self._jnp
+        newly = {}
+        # retire FIRST: a finishing slot frees pages and a slot for this
+        # very tick's admissions
+        for i, r in enumerate(list(self._slots)):
+            if r is not None and (
+                    len(r.generated) >= self.max_new_tokens or (
+                    self.eos is not None and r.generated
+                    and r.generated[-1] == self.eos)):
+                newly[r.rid] = self._retire(r)
+                self._slots[i] = None
+        self._admit()
+        live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
+        if not live:
+            return newly
+        # fixed-width batch: pad with slot 0's state (results discarded)
+        pad_to = self.max_slots
+        rows = [r for _, r in live] + [live[0][1]] * (pad_to - len(live))
+        tokens = jnp.asarray([r.generated[-1] for r in rows], jnp.int32)
+        lens = jnp.asarray([r.length for r in rows], jnp.int32)
+        table_rows = []
+        for r in rows:
+            row = list(r.pages) + [0] * (self.pages_per_seq - len(r.pages))
+            table_rows.append(row[: self.pages_per_seq])
+        tables = jnp.asarray(np.asarray(table_rows, np.int32))
+        nxt, self.kc, self.vc = self._decode_jit(
+            tokens, lens, tables, list(self.kc), list(self.vc))
+        nxt = np.asarray(nxt)
+        for j, (i, r) in enumerate(live):
+            r.length += 1
+            r.generated.append(int(nxt[j]))
+        return newly
+
+    def run_until_complete(self, max_ticks=10000):
+        done = {}
+        for _ in range(max_ticks):
+            done.update(self.step())
+            if not self._waiting and all(s is None for s in self._slots):
+                return done
+        raise TimeoutError("serving loop did not drain")

@@ -206,20 +206,34 @@ def causal_lm_loss(model, batch):
 # (parity: PaddleNLP GPTForCausalLMPipe over fleet PipelineLayer/1F1B;
 #  reference runtime: fleet/meta_parallel/pipeline_parallel.py:242)
 # ---------------------------------------------------------------------------
-def _rope_pure(x, base=10000.0):
-    """Neox-style rope on [B, S, H, D] arrays."""
+def _rope_at_positions(x, pos, base=10000.0):
+    """Neox-style rope on [B, T, H, D] at absolute positions.
+
+    ``pos``: [B] per-row start offsets (the kv-cache / paged-serving
+    case) — every consumer (training forward, generate, the serving
+    engine) shares THIS formula, so decode paths stay bit-identical to
+    the training path."""
     import jax.numpy as jnp
 
     d = x.shape[-1]
-    pos = jnp.arange(x.shape[1], dtype=jnp.float32)
+    t = x.shape[1]
+    p = (pos[:, None] + jnp.arange(t)[None, :]).astype(jnp.float32)
     inv = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    freqs = jnp.outer(pos, inv)
-    sin = jnp.sin(freqs)[None, :, None, :]
-    cos = jnp.cos(freqs)[None, :, None, :]
-    x1, x2 = x[..., : d // 2], x[..., d // 2 :]
+    freqs = p[..., None] * inv                     # [B, T, d/2]
+    sin = jnp.sin(freqs)[:, :, None, :]
+    cos = jnp.cos(freqs)[:, :, None, :]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     ).astype(x.dtype)
+
+
+def _rope_pure(x, base=10000.0):
+    """Neox-style rope on [B, S, H, D] arrays (positions 0..S-1)."""
+    import jax.numpy as jnp
+
+    return _rope_at_positions(
+        x, jnp.zeros((x.shape[0],), jnp.int32), base)
 
 
 def _rms_pure(x, w, eps=1e-6):
