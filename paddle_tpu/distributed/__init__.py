@@ -103,15 +103,10 @@ def init_parallel_env():
             num_processes=world,
             process_id=get_rank(),
         )
-        # start the eager-p2p store NOW (rank 0 hosts it): a lazy start on
-        # rank 0's first send() would leave other ranks' early recv()
-        # connects timing out behind a slow first step
-        try:
-            from .communication import _get_p2p_store
-
-            _get_p2p_store()
-        except Exception:
-            pass  # p2p stays lazy if the side port is unavailable
+        # no eager-p2p store here: with jax.distributed live, send/recv
+        # compile to ppermute over the {src, dst} device pair; the TCPStore
+        # mailbox tier only serves PADDLE_MASTER-without-jax.distributed
+        # runs and starts lazily on first use
     _initialized[0] = True
     return ParallelEnv()
 

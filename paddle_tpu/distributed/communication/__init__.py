@@ -371,11 +371,63 @@ def gather(tensor: Tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return tensor
 
 
-# Eager p2p: host-side transfer over a TCPStore ring (control-plane grade —
-# the COMPILED path uses lax.ppermute over ICI; this serves the reference's
-# eager send/recv API in multi-controller runs).
+# Eager p2p. Three tiers (parity: ProcessGroupNCCL point-to-point on the
+# comm stream, fluid/distributed/collective/process_group_nccl.cc):
+#   1. jax multi-controller live  -> compiled lax.ppermute over the
+#      2-device {src, dst} mesh — the transfer rides ICI/DCN, only the two
+#      owning processes enter the program.
+#   2. single controller          -> in-process mailbox + the same compiled
+#      ppermute moving the payload onto the dst rank's device.
+#   3. PADDLE_MASTER w/o jax.distributed -> TCPStore mailbox (control-plane
+#      fallback; correctness only).
 _p2p_store = [None]
 _p2p_seq = {}
+_p2p_inproc = {}
+
+
+def _p2p_pair_transfer(data, src, dst, dtype=None):
+    """Compiled point-to-point: ppermute over the 2-device {src, dst} mesh.
+
+    ``data`` is this process's contribution for the mesh rows it owns (the
+    payload on the src process, a same-shape placeholder on the dst).
+    Returns the transferred row (meaningful on the dst process)."""
+    devs = jax.devices()
+    sd, dd = devs[src % len(devs)], devs[dst % len(devs)]
+    arr = jnp.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    if sd == dd:
+        return arr
+    mesh = Mesh(np.array([sd, dd], dtype=object), ("p",))
+    sharding = NamedSharding(mesh, P("p"))
+    shape = (2,) + tuple(arr.shape)
+    if _is_dist_multiprocess():
+        me = jax.process_index()
+        rows = []
+        if sd.process_index == me:
+            rows.append(np.asarray(arr))
+        if dd.process_index == me:
+            rows.append(np.zeros_like(np.asarray(arr)))
+        if not rows:
+            raise RuntimeError(
+                f"p2p transfer {src}->{dst}: this process owns neither "
+                "endpoint device")
+        local = np.stack(rows, axis=0)
+        stacked = jax.make_array_from_process_local_data(
+            sharding, local, shape)
+    else:
+        stacked = jax.device_put(
+            jnp.stack([arr, jnp.zeros_like(arr)], axis=0), sharding)
+    out = shard_map(
+        lambda b: jax.lax.ppermute(b, "p", perm=[(0, 1)]),
+        mesh=mesh, in_specs=(P("p"),), out_specs=P("p"), check_vma=False,
+    )(stacked)
+    if _is_dist_multiprocess():
+        for sh in out.addressable_shards:
+            if sh.device == dd:
+                return jnp.asarray(np.asarray(sh.data)[0])
+        return arr  # src side: nothing to read back
+    return jax.device_put(out[1], dd)  # land on the dst rank's device
 
 
 def _get_p2p_store():
@@ -399,29 +451,68 @@ def _get_p2p_store():
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    import pickle
+    """Eager point-to-point send.
 
-    store = _get_p2p_store()
+    Multi-controller tier: dtypes MUST match on both ends — each side
+    compiles its half of one shared XLA program, so a mismatch means
+    mismatched programs (the same contract as NCCL send/recv in the
+    reference, process_group_nccl.cc). The single-controller and store
+    tiers cast to the recv buffer's dtype as a convenience."""
     src = get_rank()
-    seq = _p2p_seq.setdefault((src, dst), [0])
-    key = f"p2p/{src}/{dst}/{seq[0]}"
+    # role-scoped sequence counters: in the single-controller simulation
+    # the sending and receiving "ranks" share this process, so one shared
+    # counter would double-count
+    seq = _p2p_seq.setdefault(("send", src, dst), [0])
+    n = seq[0]
     seq[0] += 1
-    store.set(key, pickle.dumps(np.asarray(tensor._data), protocol=4))
+    if _is_dist_multiprocess():
+        # both endpoints enter the same 2-device compiled transfer; the
+        # matching recv() on the dst process supplies the placeholder row
+        _p2p_pair_transfer(tensor._data, src, dst)
+        return tensor
+    import os
+
+    if os.environ.get("PADDLE_MASTER") and get_world_size() > 1:
+        import pickle
+
+        store = _get_p2p_store()
+        store.set(f"p2p/{src}/{dst}/{n}",
+                  pickle.dumps(np.asarray(tensor._data), protocol=4))
+        return tensor
+    # single controller: mailbox of device arrays, drained by recv()
+    _p2p_inproc[(src, dst, n)] = tensor._data
     return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    import pickle
-
-    store = _get_p2p_store()
     dst = get_rank()
-    seq = _p2p_seq.setdefault((src, dst), [0])
-    key = f"p2p/{src}/{dst}/{seq[0]}"
+    seq = _p2p_seq.setdefault(("recv", src, dst), [0])
+    n = seq[0]
     seq[0] += 1
-    store.wait(key)
-    val = np.asarray(pickle.loads(store.get(key)))
-    store.delete_key(key)  # the store is a mailbox, not an archive
-    tensor._data = jnp.asarray(val.astype(np.asarray(tensor._data).dtype))
+    dtype = tensor._data.dtype
+    if _is_dist_multiprocess():
+        out = _p2p_pair_transfer(jnp.zeros_like(tensor._data), src, dst,
+                                 dtype=dtype)
+        tensor._data = out
+        return tensor
+    import os
+
+    if os.environ.get("PADDLE_MASTER") and get_world_size() > 1:
+        import pickle
+
+        store = _get_p2p_store()
+        key = f"p2p/{src}/{dst}/{n}"
+        store.wait(key)
+        val = np.asarray(pickle.loads(store.get(key)))
+        store.delete_key(key)  # the store is a mailbox, not an archive
+        tensor._data = jnp.asarray(val).astype(dtype)
+        return tensor
+    data = _p2p_inproc.pop((src, dst, n), None)
+    if data is None:
+        raise RuntimeError(
+            f"recv(src={src}) found no matching send (dst={dst}, seq={n}); "
+            "single-controller eager p2p requires send before recv")
+    tensor._data = _p2p_pair_transfer(data, src, dst, dtype=dtype)
     return tensor
 
 

@@ -239,6 +239,15 @@ def launch() -> None:
         if store is not None:
             store.close()
         return
+    if args.elastic_level >= 2 and nnodes > 1:
+        # Per-rank elastic supervision is single-node only today; multi-node
+        # jobs degrade to the whole-job restart loop below. Say so loudly
+        # instead of silently downgrading the documented behavior.
+        sys.stderr.write(
+            "paddle_tpu.launch: --elastic_level >= 2 with nnodes > 1 is not "
+            "supported; falling back to whole-job restart (max_restart="
+            f"{args.max_restart}). Scale-in/out supervision runs only with "
+            "nnodes == 1.\n")
 
     for attempt in range(args.max_restart + 1):
         procs, logs = _spawn_ranks(args, node_rank, nproc, world, script_args)

@@ -197,15 +197,26 @@ class TCPStore:
         """Block until `key` exists. Client-side poll (get + sleep) rather
         than the server's blocking WAIT: the per-client lock is released
         between probes, so threads sharing this store (e.g. the elastic
-        heartbeat) are not starved for the duration."""
-        deadline = time.time() + (timeout if timeout is not None else self.timeout)
+        heartbeat) are not starved for the duration.
+
+        The poll interval backs off exponentially (20ms -> 500ms) so many
+        ranks parked on one rendezvous key don't multiply load on the
+        single-threaded server. ``timeout=float('inf')`` (or any
+        non-finite value) waits forever — the rendezvous-style contract
+        the reference's blocking WAIT provides (tcp_store.h:121)."""
+        import math
+
+        t = timeout if timeout is not None else self.timeout
+        deadline = None if (t is None or not math.isfinite(t)) else time.time() + t
+        interval = 0.02
         while True:
             val = self._req(_CMD_GET, key)
             if val is not None:
                 return val
-            if time.time() >= deadline:
+            if deadline is not None and time.time() >= deadline:
                 raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
-            time.sleep(0.02)
+            time.sleep(interval)
+            interval = min(interval * 1.5, 0.5)
 
     def delete_key(self, key):
         self._req(_CMD_DEL, key)

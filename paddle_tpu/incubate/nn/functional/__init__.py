@@ -14,19 +14,33 @@ from ....core.dispatch import apply_op
 
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1, bias=None, residual=None, quant_scale=-1, **kw):
+    """RMS norm with optional fused bias/residual add.
+
+    Matches the reference contract (incubate/nn/functional/fused_rms_norm.py:59):
+    with ``residual`` the op returns ``(out, residual_out)`` where
+    ``residual_out = x (+bias) + residual`` is the updated residual stream;
+    without it, just ``out``. On TPU the residual+norm path runs the fused
+    Pallas kernel (ops/pallas/add_rms_norm.py — one VMEM pass emits both)."""
     def _frms(a, w, b, bias_in, res):
         if bias_in is not None:
             a = a + bias_in
-        if res is not None:
-            a = a + res
         ax = begin_norm_axis % a.ndim
         rows = 1
         for s in a.shape[:-1]:
             rows *= s
         from ....ops.pallas import on_tpu_device
 
-        if (ax == a.ndim - 1 and b is None and rows % 8 == 0
-                and on_tpu_device()):
+        fast = (ax == a.ndim - 1 and b is None and rows % 8 == 0
+                and on_tpu_device())
+        if res is not None:
+            if fast:
+                from ....ops.pallas.add_rms_norm import add_rms_norm
+
+                y, out = add_rms_norm(a, res, w, epsilon)
+                return out, y
+            a = a + res
+        if fast:
+            # res is always None here (the fast+residual case returned above)
             from ....ops.pallas import rms_norm as _pallas_rms
 
             return _pallas_rms(a, w, epsilon)
@@ -36,7 +50,7 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis
         out = out * w
         if b is not None:
             out = out + b
-        return out
+        return (out, a) if res is not None else out
 
     return apply_op(_frms, x, norm_weight, norm_bias, bias, residual, _op_name="fused_rms_norm")
 
@@ -57,7 +71,8 @@ def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5, begin_norm_ax
             out = out * w
         if b is not None:
             out = out + b
-        return out
+        # reference contract: residual path returns (out, residual_out)
+        return (out, a) if res is not None else out
 
     return apply_op(_fln, x, norm_weight, norm_bias, bias, residual, _op_name="fused_layer_norm")
 

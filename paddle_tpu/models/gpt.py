@@ -222,14 +222,41 @@ def _rope_at_positions(x, pos, base=10000.0):
     freqs = p[..., None] * inv                     # [B, T, d/2]
     sin = jnp.sin(freqs)[:, :, None, :]
     cos = jnp.cos(freqs)[:, :, None, :]
+    return _rope_rotate(x, sin, cos)
+
+
+def _rope_rotate(x, sin, cos):
+    """Apply the half-split rotation given broadcast-ready sin/cos."""
+    import jax.numpy as jnp
+
+    d = x.shape[-1]
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     ).astype(x.dtype)
 
 
-def _rope_pure(x, base=10000.0):
+def _rope_tables(t, d, base=10000.0):
+    """sin/cos tables for positions 0..t-1, broadcast-ready for
+    [B, T, H, D] activations: shape [1, T, 1, d/2] each.
+
+    Hoisting these out of the layer scan (computed ONCE per step instead
+    of per layer per pass) removes 2 * L * (fwd + remat) transcendental
+    sweeps from the train step — sin/cos of a [T, d/2] grid is ~1MB and
+    becomes a saved checkpoint input, never recomputed in backward."""
+    import jax.numpy as jnp
+
+    p = jnp.arange(t, dtype=jnp.float32)
+    inv = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = p[:, None] * inv                       # [T, d/2]
+    return (jnp.sin(freqs)[None, :, None, :],
+            jnp.cos(freqs)[None, :, None, :])
+
+
+def _rope_pure(x, base=10000.0, tables=None):
     """Neox-style rope on [B, S, H, D] arrays (positions 0..S-1)."""
+    if tables is not None:
+        return _rope_rotate(x, *tables)
     import jax.numpy as jnp
 
     return _rope_at_positions(
@@ -264,7 +291,8 @@ def _sdpa_pure(q, k, v, causal=True):
     return sdpa_arrays(q, k, v, causal=causal)
 
 
-def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True):
+def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
+                rope_tables=None):
     """One decoder block on arrays. p = (ln1, wq, wk, wv, wo, ln2, wg, wu, wd)."""
     import jax
     import jax.numpy as jnp
@@ -279,7 +307,8 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True):
     k = (h @ wk).reshape(b, s, num_kv_heads, hd)
     v = (h @ wv).reshape(b, s, num_kv_heads, hd)
     if use_rope:
-        q, k = _rope_pure(q), _rope_pure(k)
+        q = _rope_pure(q, tables=rope_tables)
+        k = _rope_pure(k, tables=rope_tables)
     o = _sdpa_pure(q, k, v, causal=True).reshape(b, s, num_heads * hd)
     # selective-remat anchor for the XLA-fallback path: with
     # recompute_policy="attn" the backward reuses this tensor instead of
@@ -368,9 +397,21 @@ class StackedDecoder(nn.Layer):
         mesh, pp = self._mesh_pp()
 
         def _run(x, *params):
+            import os
+
+            # PTPU_ROPE_HOIST=1 precomputes sin/cos tables once per step
+            # outside the scan. Measured SLOWER on v5e (0.5007 vs 0.5072 MFU
+            # A/B, r3): XLA fuses the inline sin/cos into the rotation's
+            # elementwise kernel for free, while hoisted tables add per-layer
+            # HBM reads. Kept as a knob — the tradeoff may flip at longer
+            # sequences where the table amortises more transcendentals.
+            tables = (_rope_tables(x.shape[1], cfg.hidden_size // cfg.num_heads)
+                      if cfg.rope and os.environ.get("PTPU_ROPE_HOIST")
+                      else None)
+
             def block(x, p):
                 return _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
-                                   cfg.rope)
+                                   cfg.rope, rope_tables=tables)
 
             if cfg.recompute:
                 pol = getattr(cfg, "recompute_policy", "full")
@@ -383,6 +424,12 @@ class StackedDecoder(nn.Layer):
                 elif pol == "attn_ffn":
                     policy = jax.checkpoint_policies.save_only_these_names(
                         "attn_out", "attn_res", "attn_lse", "ffn_out")
+                elif isinstance(pol, str) and pol.startswith("names:"):
+                    # free-form selective remat: comma-separated
+                    # checkpoint_name tags (perf-sweep surface; the
+                    # available anchors are tagged in _block_pure)
+                    policy = jax.checkpoint_policies.save_only_these_names(
+                        *[n for n in pol[len("names:"):].split(",") if n])
                 else:
                     policy = None
                 block = jax.checkpoint(block, policy=policy)
