@@ -162,9 +162,23 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
     )
     arrays = [l._data if _is_tensor(l) else l for l in leaves]
 
+    name_for_amp = _op_name or getattr(fn, "__name__", "op")
+
+    # Segment capture (jit/lazy.py): record the op into the current
+    # segment instead of dispatching — graph-broken to_static calls
+    # compile op RUNS, not single ops. No-grad only (the eager autograd
+    # engine needs concrete per-op arrays); AMP casting is skipped in
+    # capture mode (inference-grade fallback).
+    if not framework.is_grad_enabled():
+        from ..jit.lazy import current_trace
+
+        _trace = current_trace()
+        if _trace is not None:
+            out = _trace.record(fn, arrays, treedef, name_for_amp)
+            return _wrap_outputs(out, node=None)
+
     # AMP autocast: per-op white/black list casting (reference analogue:
     # AMP logic injected per-op by eager codegen, eager_gen.py:1996-2055).
-    name_for_amp = _op_name or getattr(fn, "__name__", "op")
     arrays = _maybe_autocast(name_for_amp, arrays)
 
     record = framework.is_grad_enabled()

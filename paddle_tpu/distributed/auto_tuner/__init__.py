@@ -116,13 +116,15 @@ def estimate_memory_gb(cfg: TunerCfg, model: ModelCfg):
     # attn: attention internals recomputed -> ~ s*b*h*34
     # full: only layer boundaries saved -> ~ s*b*h*2
     a = model.num_attention_heads
-    sb_h = s * b * h
+    # the Megatron activation count is in UNITS OF ELEMENTS scaled for
+    # 2-byte activations; activations are stored in the training dtype
+    sb_h = s * b * h * (bpp / 2)
     if cfg.recompute == "full":
         act_per_layer = 2 * sb_h
     elif cfg.recompute == "attn":
         act_per_layer = 34 * sb_h
     else:
-        act_per_layer = 34 * sb_h + 5 * a * s * s * b
+        act_per_layer = 34 * sb_h + 5 * a * s * s * b * (bpp / 2)
     # layers resident per chip; vpp interleave holds (1 + (pp-1)/(pp*vpp))
     # extra in-flight microbatch activations (pipeline_zero_bubble.py ratio)
     layers_local = max(L // cfg.pp, 1)
@@ -396,3 +398,60 @@ class AutoTuner:
             self.add_cfg(cfg, metric)
             trials += 1
         return self.get_best_cfg()
+
+    def measure(self, top_k=3, steps=3, run_fn=None, seq_len=None):
+        """Run the top-k *predicted* candidates for real and re-rank.
+
+        The reference tuner's core loop is search-over-measured-runs
+        (tuner.py:21); the static roofline above only orders the trial
+        schedule. This executes the built-in trial runner (hybrid-parallel
+        step on the local device mesh — see measure.py) for each of the
+        first `top_k` surviving candidates, records measured step time and
+        XLA buffer-assignment memory, and re-ranks by measured throughput.
+
+        Populates ``self.calibration``: one dict per measured candidate
+        with predicted_ms / measured_ms / predicted_gb / measured_gb and
+        the time_ratio, memory_ratio columns — the measured-vs-predicted
+        record the static models can be sanity-checked against.
+
+        Returns (best_cfg, ranked) where ranked is the measured ordering
+        [(cfg, tokens_per_sec), ...] best first.
+        """
+        if run_fn is None:
+            from .measure import build_trial_runner
+
+            run_fn = build_trial_runner(self.model, steps=steps,
+                                        seq_len=seq_len)
+        self.calibration = []
+        measured = []
+        trials = 0
+        while trials < top_k:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            try:
+                metric = run_fn(cfg)
+            except Exception:
+                metric = None
+            self.add_cfg(cfg, metric)
+            if metric is not None:
+                row = {
+                    "cfg": cfg,
+                    "predicted_ms": estimate_step_time_ms(cfg, self.model),
+                    "predicted_gb": estimate_memory_gb(cfg, self.model),
+                    "tokens_per_sec": float(metric),
+                }
+                details = getattr(metric, "details", None)
+                if details:
+                    row["measured_ms"] = details["step_ms"]
+                    row["measured_gb"] = details["peak_bytes"] / 1e9
+                    row["time_ratio"] = row["measured_ms"] / max(
+                        row["predicted_ms"], 1e-9)
+                    row["memory_ratio"] = row["measured_gb"] / max(
+                        row["predicted_gb"], 1e-9)
+                self.calibration.append(row)
+                measured.append((cfg, float(metric)))
+            trials += 1
+        measured.sort(key=lambda kv: -kv[1])
+        best = measured[0][0] if measured else None
+        return best, measured

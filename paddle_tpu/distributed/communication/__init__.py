@@ -392,7 +392,19 @@ def _p2p_pair_transfer(data, src, dst, dtype=None):
     payload on the src process, a same-shape placeholder on the dst).
     Returns the transferred row (meaningful on the dst process)."""
     devs = jax.devices()
-    sd, dd = devs[src % len(devs)], devs[dst % len(devs)]
+
+    def _dev_of(rank):
+        # multi-host with several chips per process: rank r's endpoint is
+        # a device OWNED by r's process (ranks map 1:1 to processes in
+        # that deployment); single-controller keeps the ambient
+        # rank-per-device convention
+        if _is_dist_multiprocess() and get_world_size() == jax.process_count():
+            mine = [d for d in devs if d.process_index == rank]
+            if mine:
+                return mine[0]
+        return devs[rank % len(devs)]
+
+    sd, dd = _dev_of(src), _dev_of(dst)
     arr = jnp.asarray(data)
     if dtype is not None:
         arr = arr.astype(dtype)

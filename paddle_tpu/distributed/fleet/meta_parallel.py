@@ -182,16 +182,79 @@ class PipelineLayer(Layer):
             x = self._run_segment(s, x)
         return x
 
+    # -- stage-partitioned parameter memory ------------------------------
+    def _param_stage_map(self):
+        """state_dict name -> owning stage index (absent = shared or
+        layer-level state, kept replicated)."""
+        mapping = {}
+        shared_ids = {id(l) for l in self._shared.values()}
+        li = 0
+        for i, (layer, _) in enumerate(self.run_function):
+            if not isinstance(layer, Layer):
+                continue
+            prefix = f"_layers.{li}."
+            li += 1
+            if id(layer) in shared_ids:
+                continue  # tied across stages -> replicated
+            stage = self.get_stage_from_index(i)
+            for n in layer.state_dict():
+                mapping[prefix + n] = stage
+        return mapping
+
+    def shard_stage_parameters(self, mesh=None):
+        """ZeRO-3-style striping of every stage-owned parameter over the
+        "pp" mesh axis: per-device persistent param memory drops to
+        ~total/pp (the reason to use PP at all — reference slot:
+        pp_layers.py:258, stages own only their layers). The compiled
+        pipeline repacks the stripes into per-stage rows inside the step
+        (one XLA reshard), so the lax.switch branches still read only the
+        local stage's weights."""
+        from ...distributed.auto_parallel import (Replicate, Shard,
+                                                  TensorDistAttr)
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if mesh is None:
+            mesh, pp = self._mesh_pp()
+            if mesh is None:
+                return self
+        pp = mesh.get_dim_size("pp")
+        ax = mesh.dim_names.index("pp")
+        mapping = self._param_stage_map()
+        state = self.state_dict()
+        for name, stage in mapping.items():
+            t = state.get(name)
+            if t is None:
+                continue
+            dim = next((d for d, s in enumerate(t.shape) if s % pp == 0),
+                       None)
+            if dim is None:
+                continue  # no divisible dim: stays replicated
+            placements = [Replicate() for _ in mesh.dim_names]
+            placements[ax] = Shard(dim)
+            t._dist_attr = TensorDistAttr(mesh, placements)
+            spec = [None] * len(t.shape)
+            spec[dim] = "pp"
+            t._data = jax.device_put(
+                t._data, NamedSharding(mesh.jax_mesh, P(*spec)))
+        return self
+
     def _forward_pipelined(self, x, mesh, pp):
         """Compiled ring schedule for arbitrary (shape-uniform) stages.
 
         Heterogeneous stage programs are selected per device with
-        ``lax.switch`` on the pp axis index; all parameters travel into the
-        shard_map replicated over "pp" (stage placement of memory is the
-        stacked-decoder path's job — this is the generic-correctness one;
-        reference slot: pipeline_parallel.py:242 1F1B for any PipelineLayer).
+        ``lax.switch`` on the pp axis index. Stage-owned parameters are
+        PACKED: each stage's params flatten-concat into one row of a
+        [pp, L] buffer sharded over "pp", so inside the shard_map every
+        device holds exactly its own stage's weights (per-device pipeline
+        memory O(total/pp) when combined with `shard_stage_parameters`;
+        reference slot: pipeline_parallel.py:242 1F1B for any
+        PipelineLayer). Shared/tied params stay replicated operands.
         """
         import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
         from ...core.tensor import Tensor
@@ -199,23 +262,66 @@ class PipelineLayer(Layer):
 
         state = self.state_dict()
         names = sorted(state)
-        flat = [state[n]._data for n in names]
         n_micro = self._num_micro or pp
+        stage_of = self._param_stage_map()
 
-        def body(flat_params, x_mb):
-            # mark params varying over pp: each device consumes them through
-            # a DIFFERENT switch branch, and pcast's transpose is the psum
-            # that routes every stage's weight cotangent home (without it the
-            # vma invariance analysis drops non-zero-stage grads)
-            flat_params = [jax.lax.pcast(a, "pp", to="varying")
-                           for a in flat_params]
+        staged = [n for n in names if stage_of.get(n) is not None]
+        shared_names = [n for n in names if stage_of.get(n) is None]
+        dtypes = sorted({str(state[n]._data.dtype) for n in staged})
+        # static packing plan: dtype -> per-stage [(name, offset, size,
+        # shape)] + row length
+        plan, row_len = {}, {}
+        for dt in dtypes:
+            per_stage = [[] for _ in range(pp)]
+            for n in staged:
+                a = state[n]._data
+                if str(a.dtype) != dt:
+                    continue
+                s = stage_of[n]
+                off = sum(e[2] for e in per_stage[s])
+                per_stage[s].append((n, off, int(np.prod(a.shape) or 1),
+                                     tuple(a.shape)))
+            plan[dt] = per_stage
+            row_len[dt] = max(
+                (sum(e[2] for e in st) for st in per_stage), default=0)
+
+        def pack(flat_state):
+            packed = {}
+            for dt in dtypes:
+                rows = []
+                for s in range(pp):
+                    parts = [flat_state[n].reshape(-1)
+                             for n, _, _, _ in plan[dt][s]]
+                    row = (jnp.concatenate(parts) if parts
+                           else jnp.zeros((0,), dt))
+                    pad = row_len[dt] - row.shape[0]
+                    if pad:
+                        row = jnp.concatenate(
+                            [row, jnp.zeros((pad,), row.dtype)])
+                    rows.append(row)
+                arr = jnp.stack(rows)           # [pp, L]
+                packed[dt] = jax.lax.with_sharding_constraint(
+                    arr, NamedSharding(mesh.jax_mesh, P("pp")))
+            return packed
+
+        flat_all = {n: state[n]._data for n in names}
+        shared_flat = [flat_all[n] for n in shared_names]
+
+        def body(packed, shared, x_mb):
+            # shared params consumed by several branches: pcast-varying so
+            # the switch transpose psums their cotangents home
+            shared = [jax.lax.pcast(a, "pp", to="varying") for a in shared]
 
             def make_branch(s):
-                def branch(params, a):
-                    # params as explicit operands (not closure): the switch
-                    # transpose then routes weight cotangents through the
-                    # branch each device actually executed
-                    with self._swap_state(dict(zip(names, params))):
+                def branch(packed_local, shared_ops, a):
+                    params = {}
+                    for dt in dtypes:
+                        row = packed_local[dt][0]      # local [1, L] row
+                        for n, off, size, shape in plan[dt][s]:
+                            params[n] = jax.lax.dynamic_slice_in_dim(
+                                row, off, size).reshape(shape)
+                    params.update(zip(shared_names, shared_ops))
+                    with self._swap_state(params):
                         return self._run_segment(s, Tensor(a))._data
                 return branch
 
@@ -223,16 +329,17 @@ class PipelineLayer(Layer):
 
             def stage_fn(a):
                 idx = jax.lax.axis_index("pp")
-                return jax.lax.switch(idx, branches, tuple(flat_params), a)
+                return jax.lax.switch(idx, branches, packed, tuple(shared),
+                                      a)
 
             return pipeline_schedule(stage_fn, x_mb, pp)
 
         out = jax.shard_map(
             body, mesh=mesh.jax_mesh,
-            in_specs=(P(), P()),
+            in_specs=({dt: P("pp") for dt in dtypes}, P(), P()),
             out_specs=P(),
             axis_names={"pp"},
-        )(flat, microbatch(x._data, n_micro))
+        )(pack(flat_all), shared_flat, microbatch(x._data, n_micro))
         return Tensor(unmicrobatch(out))
 
 

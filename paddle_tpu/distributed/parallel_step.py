@@ -126,6 +126,19 @@ class ShardedTrainStep(TrainStep):
                 placed.append(arr)
         return tuple(placed)
 
+    def _prepare_batch(self, raw_batch):
+        """memory_stats hook: mirror __call__'s placement so the lowered
+        program matches the one real steps run (sharded batch, placed
+        model/opt state)."""
+        if not self._placed:
+            self._place_model()
+        if self._opt_state is None:
+            entries = self.model.state_dict()
+            params = {n: entries[n]._data for n in self._param_names}
+            self._opt_state = self.optimizer.functional_state(params)
+            self._place_opt_state(params)
+        return self._place_batch(raw_batch)
+
     # -- step --------------------------------------------------------------
     def __call__(self, *batch):
         if not self._placed:

@@ -254,3 +254,414 @@ def unmicrobatch(mb):
         return x.reshape((-1,) + tuple(x.shape[2:]))
 
     return jax.tree_util.tree_map(_one, mb)
+
+
+# ---------------------------------------------------------------------------
+# Zero-bubble schedule (ZB-H1 analogue)
+# ---------------------------------------------------------------------------
+def zero_bubble_cost(n_micro, pp, v=1, cf=1.0, cb=2.0, cw_frac=1.0 / 3.0):
+    """Normalised fwd+bwd cost of the zero-bubble schedule, in full-tick
+    units (cf = one stage forward, cb = one stage full backward, of which
+    cw_frac is the weight-grad share).
+
+    ZB structure: the backward RING carries only dgrad (cost cb*(1-cw_frac)
+    per tick); every weight grad runs AFTER the ring as one batched
+    bubble-free contraction (cost n_micro * cb * cw_frac, no fill/drain).
+    Composes with v-way interleaving: ring ticks shrink by 1/v.
+
+    Reference: passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62 —
+    same wgrad-off-the-critical-path idea, expressed as a compiled
+    schedule instead of instruction reordering."""
+    ring_ticks = (v * n_micro + pp - 1) / v
+    dgrad = cb * (1.0 - cw_frac)
+    return ring_ticks * (cf + dgrad) + n_micro * cb * cw_frac
+
+
+def plain_cost(n_micro, pp, cf=1.0, cb=2.0):
+    """Plain compiled ring: AD reverses the scan, every bwd tick carries
+    dgrad AND wgrad."""
+    return (n_micro + pp - 1) * (cf + cb)
+
+
+def interleaved_cost(n_micro, pp, v, cf=1.0, cb=2.0):
+    """AD-reversed interleaved ring in full-tick units."""
+    return (v * n_micro + pp - 1) / v * (cf + cb)
+
+
+def spmd_pipeline_zero_bubble(stage_fn, mesh, n_stages, axis_name="pp",
+                              params_spec=None, remat=False):
+    """Zero-bubble pipelined function over leading-axis-stacked params.
+
+    Same contract as `spmd_pipeline`: returns
+    pipelined(stacked_params, x_mb) -> [n_micro, ...] last-stage outputs.
+    The HAND-WRITTEN backward splits dgrad from wgrad: the reverse ring
+    propagates activation cotangents only (short critical path), and all
+    weight gradients are computed afterwards as ONE batched vjp over the
+    stashed per-tick (input, cotangent) pairs — wgrad has no pipeline
+    bubble at all, the ZB-H1 property in compiled-SPMD form.
+    """
+    if params_spec is None:
+        params_spec = P(axis_name)
+    inner = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def _fwd_body(stacked_local, x_mb):
+        """Forward ring; also returns the per-tick stage inputs (stash)."""
+        idx = jax.lax.axis_index(axis_name)
+        n_micro = x_mb.shape[0]
+        total = n_micro + n_stages - 1
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        out_aval = jax.eval_shape(
+            lambda x: inner(stacked_local,
+                            jax.lax.pcast(x, axis_name, to="varying")),
+            jax.ShapeDtypeStruct(x_mb.shape[1:], x_mb.dtype))
+
+        def _z(shape, dt):
+            return jax.lax.pcast(jnp.zeros(shape, dt), axis_name,
+                                 to="varying")
+
+        state0 = _z(out_aval.shape, out_aval.dtype)
+        out_buf0 = _z((n_micro,) + tuple(out_aval.shape), out_aval.dtype)
+        stash0 = _z((total,) + tuple(x_mb.shape[1:]), x_mb.dtype)
+
+        def tick(carry, t):
+            state, out_buf, stash = carry
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            x_in = jax.lax.dynamic_index_in_dim(x_mb, mb_idx, 0,
+                                                keepdims=False)
+            inp = jnp.where(idx == 0, x_in, state)
+            stash = jax.lax.dynamic_update_index_in_dim(stash, inp, t, 0)
+            out = inner(stacked_local, inp)
+            o_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            valid = (t >= n_stages - 1) & (idx == n_stages - 1)
+            cur = jax.lax.dynamic_index_in_dim(out_buf, o_idx, 0,
+                                               keepdims=False)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(valid, out, cur), o_idx, 0)
+            state = jax.lax.ppermute(out, axis_name, perm)
+            return (state, out_buf, stash), None
+
+        (state, out_buf, stash), _ = jax.lax.scan(
+            tick, (state0, out_buf0, stash0), jnp.arange(total))
+        out = jax.lax.psum(
+            jnp.where(idx == n_stages - 1, out_buf,
+                      jnp.zeros_like(out_buf)), axis_name)
+        return out, stash
+
+    def _bwd_body(stacked_local, stash, g_mb):
+        """Reverse ring (dgrad only) + batched post-ring wgrad."""
+        idx = jax.lax.axis_index(axis_name)
+        n_micro = g_mb.shape[0]
+        total = n_micro + n_stages - 1
+        # reverse routing: cotangent of stage s's input goes to stage s-1
+        rperm = [(j, (j - 1) % n_stages) for j in range(n_stages)]
+
+        def dx_of(act, g):
+            _, pull = jax.vjp(lambda a: inner(stacked_local, a), act)
+            (da,) = pull(g)
+            return da
+
+        g0 = jax.lax.pcast(jnp.zeros(g_mb.shape[1:], g_mb.dtype),
+                           axis_name, to="varying")
+        gbuf0 = jax.lax.pcast(
+            jnp.zeros((total,) + tuple(g_mb.shape[1:]), g_mb.dtype),
+            axis_name, to="varying")
+        dxmb0 = jax.lax.pcast(jnp.zeros_like(g_mb), axis_name, to="varying")
+
+        def tick(carry, u):
+            g_state, g_used, dx_mb = carry
+            t = total - 1 - u                      # mirrored fwd tick
+            # microbatch handled by THIS device at fwd tick t
+            m = t - idx
+            m_valid = (m >= 0) & (m < n_micro)
+            # last stage injects the loss cotangent for its microbatch
+            g_inj = jax.lax.dynamic_index_in_dim(
+                g_mb, jnp.clip(m, 0, n_micro - 1), 0, keepdims=False)
+            g = jnp.where(idx == n_stages - 1, g_inj, g_state)
+            g = jnp.where(m_valid, g, jnp.zeros_like(g))
+            # record the (tick -> cotangent) pair for the post-ring wgrad
+            g_used = jax.lax.dynamic_update_index_in_dim(g_used, g, t, 0)
+            act = jax.lax.dynamic_index_in_dim(stash, t, 0, keepdims=False)
+            da = dx_of(act, g)
+            # stage 0's da is the cotangent of x_mb[m]
+            put = (idx == 0) & m_valid
+            mi = jnp.clip(m, 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(dx_mb, mi, 0, keepdims=False)
+            dx_mb = jax.lax.dynamic_update_index_in_dim(
+                dx_mb, jnp.where(put, da, cur), mi, 0)
+            g_state = jax.lax.ppermute(da, axis_name, rperm)
+            return (g_state, g_used, dx_mb), None
+
+        (g_state, g_used, dx_mb), _ = jax.lax.scan(
+            tick, (g0, gbuf0, dxmb0), jnp.arange(total))
+
+        # ---- wgrad: ONE batched vjp over every stashed pair (no ring,
+        # no bubble; garbage ticks carry zero cotangents) ----
+        def batched(params):
+            return jax.vmap(lambda a: inner(params, a))(stash)
+
+        _, pull = jax.vjp(batched, stacked_local)
+        (dW,) = pull(g_used)
+        dx_all = jax.lax.psum(dx_mb, axis_name)   # only stage 0 contributed
+        return dW, dx_all
+
+    @jax.custom_vjp
+    def pipelined(stacked_params, x_mb):
+        out, _ = jax.shard_map(
+            _fwd_body, mesh=mesh,
+            in_specs=(params_spec, P()),
+            out_specs=(P(), P(axis_name)),
+            axis_names={axis_name},
+        )(stacked_params, x_mb)
+        return out
+
+    def pipelined_fwd(stacked_params, x_mb):
+        out, stash = jax.shard_map(
+            _fwd_body, mesh=mesh,
+            in_specs=(params_spec, P()),
+            out_specs=(P(), P(axis_name)),
+            axis_names={axis_name},
+        )(stacked_params, x_mb)
+        return out, (stacked_params, stash, x_mb)
+
+    def pipelined_bwd(res, g):
+        stacked_params, stash, x_mb = res
+        dW, dx = jax.shard_map(
+            _bwd_body, mesh=mesh,
+            in_specs=(params_spec, P(axis_name), P()),
+            out_specs=(params_spec, P()),
+            axis_names={axis_name},
+        )(stacked_params, stash, g)
+        return dW, dx
+
+    pipelined.defvjp(pipelined_fwd, pipelined_bwd)
+    return pipelined
+
+
+def spmd_pipeline_zero_bubble_interleaved(stage_fn, mesh, pp, v,
+                                          axis_name="pp", remat=False):
+    """Zero-bubble over the circular (VPP) schedule: 1/v-sized ring ticks
+    carrying forward (then dgrad-only in reverse), with every weight grad
+    batched AFTER the ring. Combines both bubble shrinkers — cost
+    ``zero_bubble_cost(n, pp, v)``, which beats plain interleaving at
+    pp=4/n_micro=4 (15.5 vs 16.5 full-tick units at cb=2cf, cw=cb/3).
+
+    Contract matches `spmd_pipeline_interleaved`:
+    stage_fn(chunk_params, x) -> x over [L/(pp*v), ...] chunk slices of
+    [L, ...]-stacked params.
+    """
+    if remat:
+        # the dgrad ring and batched wgrad both re-run the chunk forward
+        # through jax.vjp of the checkpointed fn — same policy semantics
+        # as the AD schedules
+        stage_fn = jax.checkpoint(stage_fn)
+
+    def _split(a):
+        L = a.shape[0]
+        g = L // (pp * v)
+        return a.reshape((v, pp, g) + tuple(a.shape[1:]))
+
+    def _lap_of(t, idx, n_micro):
+        rel = t - idx
+        return jnp.clip((rel + v * n_micro) // n_micro - v, 0, v - 1), rel
+
+    def _fwd_body(stacked_local, x_mb):
+        idx = jax.lax.axis_index(axis_name)
+        n_micro = x_mb.shape[0]
+        if n_micro < pp:
+            raise ValueError(
+                f"interleaved zb needs n_micro >= pp ({n_micro} < {pp})")
+        total = v * n_micro + pp - 1
+        wait = n_micro - pp
+        fifo_len = wait + 1
+        perm = [(j, (j + 1) % pp) for j in range(pp)]
+
+        local = jax.tree_util.tree_map(
+            lambda a: a.reshape((a.shape[0],) + tuple(a.shape[2:])),
+            stacked_local)
+
+        def chunk_apply(lap, x):
+            chunk = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, lap, 0, keepdims=False), local)
+            return stage_fn(chunk, x)
+
+        out_aval = jax.eval_shape(
+            lambda x: chunk_apply(jnp.zeros((), jnp.int32),
+                                  jax.lax.pcast(x, axis_name, to="varying")),
+            jax.ShapeDtypeStruct(x_mb.shape[1:], x_mb.dtype))
+
+        def _z(shape):
+            return jax.lax.pcast(
+                jnp.zeros(shape, out_aval.dtype), axis_name, to="varying")
+
+        state0 = _z(out_aval.shape)
+        fifo0 = _z((fifo_len,) + tuple(out_aval.shape))
+        out_buf0 = _z((n_micro,) + tuple(out_aval.shape))
+        stash0 = _z((total,) + tuple(x_mb.shape[1:]))
+
+        def tick(carry, t):
+            fifo, state, out_buf, stash = carry
+            w = jnp.mod(t, fifo_len)
+            fifo = jax.lax.dynamic_update_index_in_dim(fifo, state, w, 0)
+            r = jnp.where(idx == 0, jnp.mod(t - wait + fifo_len, fifo_len), w)
+            queued = jax.lax.dynamic_index_in_dim(fifo, r, 0, keepdims=False)
+            mb_new = jnp.clip(t, 0, n_micro - 1)
+            fresh = jax.lax.dynamic_index_in_dim(x_mb, mb_new, 0,
+                                                 keepdims=False)
+            inp = jnp.where((idx == 0) & (t < n_micro), fresh, queued)
+            stash = jax.lax.dynamic_update_index_in_dim(stash, inp, t, 0)
+            lap, rel = _lap_of(t, idx, n_micro)
+            out = chunk_apply(lap, inp)
+            m = jnp.mod(rel + v * n_micro, n_micro)
+            valid = ((idx == pp - 1) & (rel >= (v - 1) * n_micro)
+                     & (rel < v * n_micro))
+            cur = jax.lax.dynamic_index_in_dim(out_buf, m, 0, keepdims=False)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(valid, out, cur), m, 0)
+            state = jax.lax.ppermute(out, axis_name, perm)
+            return (fifo, state, out_buf, stash), None
+
+        (_, _, out_buf, stash), _ = jax.lax.scan(
+            tick, (fifo0, state0, out_buf0, stash0), jnp.arange(total))
+        out = jax.lax.psum(
+            jnp.where(idx == pp - 1, out_buf, jnp.zeros_like(out_buf)),
+            axis_name)
+        return out, stash
+
+    def _bwd_body(stacked_local, stash, g_mb):
+        idx = jax.lax.axis_index(axis_name)
+        n_micro = g_mb.shape[0]
+        total = v * n_micro + pp - 1
+        wait = n_micro - pp
+        fifo_len = wait + 1
+        rperm = [(j, (j - 1) % pp) for j in range(pp)]
+
+        local = jax.tree_util.tree_map(
+            lambda a: a.reshape((a.shape[0],) + tuple(a.shape[2:])),
+            stacked_local)
+
+        def chunk_apply(params_local, lap, x):
+            chunk = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, lap, 0, keepdims=False), params_local)
+            return stage_fn(chunk, x)
+
+        def dx_of(lap, act, g):
+            _, pull = jax.vjp(lambda a: chunk_apply(local, lap, a), act)
+            (da,) = pull(g)
+            return da
+
+        g0 = jax.lax.pcast(jnp.zeros(g_mb.shape[1:], g_mb.dtype),
+                           axis_name, to="varying")
+        fifo0 = jax.lax.pcast(
+            jnp.zeros((fifo_len,) + tuple(g_mb.shape[1:]), g_mb.dtype),
+            axis_name, to="varying")
+        gbuf0 = jax.lax.pcast(
+            jnp.zeros((total,) + tuple(g_mb.shape[1:]), g_mb.dtype),
+            axis_name, to="varying")
+        dxmb0 = jax.lax.pcast(jnp.zeros_like(g_mb), axis_name, to="varying")
+
+        def tick(carry, u):
+            fifo, g_state, g_used, dx_mb = carry
+            t = total - 1 - u
+            # reverse wrap edge (0 -> pp-1) is delayed by `wait` ticks: the
+            # mirror of the forward FIFO on the pp-1 -> 0 edge
+            w = jnp.mod(u, fifo_len)
+            fifo = jax.lax.dynamic_update_index_in_dim(fifo, g_state, w, 0)
+            r = jnp.where(idx == pp - 1,
+                          jnp.mod(u - wait + fifo_len, fifo_len), w)
+            queued = jax.lax.dynamic_index_in_dim(fifo, r, 0, keepdims=False)
+
+            lap, rel = _lap_of(t, idx, n_micro)
+            real = (rel >= 0) & (rel < v * n_micro)
+            m = jnp.mod(rel + v * n_micro, n_micro)
+            # final-output cotangent injection mirrors the fwd out_buf write
+            inject = ((idx == pp - 1) & (rel >= (v - 1) * n_micro)
+                      & (rel < v * n_micro))
+            g_inj = jax.lax.dynamic_index_in_dim(g_mb, m, 0, keepdims=False)
+            g = jnp.where(inject, g_inj, queued)
+            g = jnp.where(real, g, jnp.zeros_like(g))
+            g_used = jax.lax.dynamic_update_index_in_dim(g_used, g, t, 0)
+
+            act = jax.lax.dynamic_index_in_dim(stash, t, 0, keepdims=False)
+            da = dx_of(lap, act, g)
+            put = (idx == 0) & (t < n_micro)
+            mi = jnp.clip(t, 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(dx_mb, mi, 0, keepdims=False)
+            dx_mb = jax.lax.dynamic_update_index_in_dim(
+                dx_mb, jnp.where(put, da, cur), mi, 0)
+            g_state = jax.lax.ppermute(da, axis_name, rperm)
+            return (fifo, g_state, g_used, dx_mb), None
+
+        (_, _, g_used, dx_mb), _ = jax.lax.scan(
+            tick, (fifo0, g0, gbuf0, dxmb0), jnp.arange(total))
+
+        # ---- batched wgrad per chunk: gather exactly the n_micro real
+        # ticks of each chunk (tick of (chunk c, mb m) = c*n + m + idx) ----
+        def dW_of():
+            dWs = []
+            for c in range(v):
+                ticks = c * n_micro + jnp.arange(n_micro) + idx   # [n]
+                acts = jnp.take(stash, ticks, axis=0)
+                gs = jnp.take(g_used, ticks, axis=0)
+
+                def batched(params_local):
+                    chunk = jax.tree_util.tree_map(
+                        lambda a: jax.lax.dynamic_index_in_dim(
+                            a, c, 0, keepdims=False), params_local)
+                    return jax.vmap(lambda a: stage_fn(chunk, a))(acts)
+
+                _, pull = jax.vjp(batched, local)
+                (dW_c,) = pull(gs)
+                dWs.append(dW_c)
+            # sum of per-chunk pullbacks: each wrote only its chunk's rows
+            out = jax.tree_util.tree_map(lambda *xs: sum(xs), *dWs)
+            return jax.tree_util.tree_map(
+                lambda a: a.reshape((a.shape[0], 1) + tuple(a.shape[1:])),
+                out)
+
+        dW = dW_of()
+        dx_all = jax.lax.psum(dx_mb, axis_name)
+        return dW, dx_all
+
+    def _shmap(body, out_specs):
+        return functools.partial(
+            jax.shard_map, body, mesh=mesh, axis_names={axis_name})
+
+    @jax.custom_vjp
+    def pipelined(stacked_params, x_mb):
+        stacked = jax.tree_util.tree_map(_split, stacked_params)
+        out, _ = jax.shard_map(
+            _fwd_body, mesh=mesh,
+            in_specs=(P(None, axis_name), P()),
+            out_specs=(P(), P(axis_name)),
+            axis_names={axis_name},
+        )(stacked, x_mb)
+        return out
+
+    def pipelined_fwd(stacked_params, x_mb):
+        stacked = jax.tree_util.tree_map(_split, stacked_params)
+        out, stash = jax.shard_map(
+            _fwd_body, mesh=mesh,
+            in_specs=(P(None, axis_name), P()),
+            out_specs=(P(), P(axis_name)),
+            axis_names={axis_name},
+        )(stacked, x_mb)
+        return out, (stacked_params, stash, x_mb)
+
+    def pipelined_bwd(res, g):
+        stacked_params, stash, x_mb = res
+        stacked = jax.tree_util.tree_map(_split, stacked_params)
+        dW4, dx = jax.shard_map(
+            _bwd_body, mesh=mesh,
+            in_specs=(P(None, axis_name), P(axis_name), P()),
+            out_specs=(P(None, axis_name), P()),
+            axis_names={axis_name},
+        )(stacked, stash, g)
+        dW = jax.tree_util.tree_map(
+            lambda a, p: a.reshape(p.shape), dW4, stacked_params)
+        return dW, dx
+
+    pipelined.defvjp(pipelined_fwd, pipelined_bwd)
+    return pipelined

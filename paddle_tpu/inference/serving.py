@@ -8,16 +8,25 @@ drive it). TPU-native design:
   `PagePool` hands pages to sequences on admission and reclaims them on
   completion, so memory scales with live tokens, not max_seq * slots.
 - `ContinuousBatchingEngine` drives the vLLM-style loop: admit waiting
-  requests into free slots (prefill writes the prompt's KV into that
-  sequence's pages), then run ONE batched decode step for every live
-  slot per `step()` — new requests join mid-flight without stalling
-  running ones, finished slots free their pages immediately.
+  requests into free slots (prefill writes the prompts' KV into their
+  pages), then run ONE batched decode step for every live slot per
+  `step()` — new requests join mid-flight without stalling running ones,
+  finished slots free their pages immediately.
+- Admission prefills ALL newly admitted prompts as one padded batch —
+  one pass over the weights per admission group, not per request.
 - The decode step's attention is the pallas paged kernel
   (`ops/pallas/decode_attention.paged_attention`): block tables via
   scalar prefetch, so only the pages a sequence owns are fetched.
+- Sampling runs inside the jitted decode step: per-request temperature /
+  top-k / top-p (temperature 0 = greedy, the default). Per-token
+  streaming callbacks fire as tokens are emitted.
 
-Greedy decoding; works with the GPT/LLaMA stacked-weights families
-(anything exposing `_decode_params()` — llama.py:66).
+Weights are packed into an explicit pytree passed to the jitted step (not
+closed-over constants), so `reload_weights()` on a live engine takes
+effect without recompilation.
+
+Works with the GPT/LLaMA stacked-weights families (anything exposing
+`_decode_params()` — llama.py:66).
 """
 from __future__ import annotations
 
@@ -51,19 +60,51 @@ class PagePool:
 
 
 class _Request:
-    __slots__ = ("rid", "prompt", "generated", "length", "pages")
+    __slots__ = ("rid", "prompt", "generated", "length", "pages",
+                 "temperature", "top_k", "top_p", "on_token")
 
-    def __init__(self, rid, prompt):
+    def __init__(self, rid, prompt, temperature=0.0, top_k=0, top_p=1.0,
+                 on_token=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.generated = []
         self.length = 0          # tokens currently in the kv pages
         self.pages = []
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.on_token = on_token
+
+
+def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
+    """Per-row temperature / top-k / top-p sampling; temp<=0 rows take
+    argmax. Runs inside the jitted decode step."""
+    f32 = logits.astype(jnp.float32)
+    greedy = jnp.argmax(f32, -1).astype(jnp.int32)
+    V = f32.shape[-1]
+    srt = jnp.flip(jnp.sort(f32, -1), -1)                     # desc [B, V]
+    k_eff = jnp.where(top_ks > 0, top_ks, V)
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(k_eff - 1, 0, V - 1)[:, None], 1)       # [B, 1]
+    topk_sorted = jnp.where(srt < kth, -jnp.inf, srt)
+    probs_sorted = jax.nn.softmax(topk_sorted, -1)
+    csum = jnp.cumsum(probs_sorted, -1)
+    # nucleus: keep the smallest prefix with cumulative mass >= top_p
+    # (the first token is always kept: csum - p_i < p holds at i=0)
+    keep = (csum - probs_sorted) < top_ps[:, None]
+    thr = jnp.min(jnp.where(keep, topk_sorted, jnp.inf), -1, keepdims=True)
+    # a logit survives only if it passes BOTH filters (max of thresholds);
+    # keep[:, 0] is always True so thr is finite
+    masked = jnp.where(f32 < jnp.maximum(kth, thr), -jnp.inf, f32)
+    scaled = masked / jnp.maximum(temps[:, None], 1e-6)
+    sampled = jax.random.categorical(key, scaled, -1).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
 
 
 class ContinuousBatchingEngine:
     def __init__(self, model, max_slots=4, page_size=64, num_pages=None,
-                 max_seq_len=None, max_new_tokens=32, eos_token_id=None):
+                 max_seq_len=None, max_new_tokens=32, eos_token_id=None,
+                 seed=0):
         import jax
         import jax.numpy as jnp
 
@@ -82,19 +123,13 @@ class ContinuousBatchingEngine:
         hd = cfg.hidden_size // cfg.num_heads
         self.hd, self.hkv = hd, cfg.num_kv_heads
 
-        # weights, flattened like llama.generate
-        params = model._decode_params()
-        self._lp = [tuple(lp[k]._data for k in
-                          ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg",
-                           "wu", "wd")) for lp in params]
-        self._embed = model.model.embed_tokens.weight._data
-        self._fnorm = model.model.final_norm.weight._data
-        self._head = (model.lm_head.weight._data
-                      if model.lm_head is not None else None)
+        self._model = model
+        self._weights = self._pack_weights(model)
+        self._key = jax.random.PRNGKey(seed)
 
         # paged caches per layer, KERNEL layout [Hkv, num_pages, page, D]
         # (what paged_attention consumes — no per-step transposes)
-        dt = self._embed.dtype
+        dt = self._weights["embed"].dtype
         self.kc = [jnp.zeros((self.hkv, num_pages, page_size, hd), dt)
                    for _ in range(cfg.num_layers)]
         self.vc = [jnp.zeros((self.hkv, num_pages, page_size, hd), dt)
@@ -103,8 +138,29 @@ class ContinuousBatchingEngine:
         self._slots: list[_Request | None] = [None] * max_slots
         self._waiting: deque[_Request] = deque()
         self._next_rid = 0
-        self._decode_jit = jax.jit(self._decode_step,
-                           donate_argnums=(3, 4))
+        # weights are argument 0 — NOT closed-over jit constants — so a
+        # reload on a live engine feeds the already-compiled step
+        self._decode_jit = jax.jit(self._decode_step, donate_argnums=(4, 5),
+                                   static_argnums=(10,))
+        self.prefill_batches = 0      # observability: admission group count
+
+    @staticmethod
+    def _pack_weights(model):
+        params = model._decode_params()
+        return {
+            "layers": [tuple(lp[k]._data for k in
+                             ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg",
+                              "wu", "wd")) for lp in params],
+            "embed": model.model.embed_tokens.weight._data,
+            "fnorm": model.model.final_norm.weight._data,
+            "head": (model.lm_head.weight._data
+                     if model.lm_head is not None else None),
+        }
+
+    def reload_weights(self, model=None):
+        """Re-read weights from the model (e.g. after an in-place update);
+        the compiled decode step picks them up on the next tick."""
+        self._weights = self._pack_weights(model or self._model)
 
     # -- model math ---------------------------------------------------------
     @staticmethod
@@ -115,61 +171,90 @@ class ContinuousBatchingEngine:
 
         return _rope_at_positions(x, pos)
 
-    def _prefill(self, req: _Request):
-        """Run the prompt, write its KV into the request's pages, return
-        the next (greedy) token. Per-request; the decode path is batched.
+    def _prefill_group(self, reqs):
+        """Run ALL newly admitted prompts as ONE padded batch: write each
+        prompt's KV into its pages, return the first generated token per
+        request.
 
-        Runs eagerly: each page-cache write copies the pool once per
-        layer, a per-ADMISSION cost (not per-token). Jitting would need
-        per-prompt-length retraces (bucket lengths first if admission
-        cost ever dominates — see jit.to_static bucket_dynamic_shapes)."""
+        One pass over the weights per admission group (the reference's
+        serving stack batches prefill the same way before handing slots to
+        the decode loop). Runs eagerly: page-cache writes copy the pool
+        once per layer per GROUP; jitting would retrace per padded length
+        (bucket lengths first if admission cost ever dominates)."""
         jax, jnp = self._jax, self._jnp
-        from .. import models  # noqa: F401  (keep import surface warm)
         from ..models.gpt import _rms_pure
 
-        ids = jnp.asarray(np.asarray(req.prompt)[None, :])   # [1, S]
-        s = ids.shape[1]
-        x = self._embed[ids]
-        pos0 = jnp.zeros((1,), jnp.int32)
-        page_ids = np.asarray(req.pages, np.int64)
-        for li, lp in enumerate(self._lp):
+        self.prefill_batches += 1
+        w = self._weights
+        B = len(reqs)
+        lens = np.asarray([len(r.prompt) for r in reqs])
+        S = int(lens.max())
+        ids_np = np.zeros((B, S), np.int32)
+        for i, r in enumerate(reqs):
+            ids_np[i, : lens[i]] = r.prompt
+        ids = jnp.asarray(ids_np)
+        x = w["embed"][ids]                                  # [B, S, H]
+        pos0 = jnp.zeros((B,), jnp.int32)
+        scale = 1.0 / math.sqrt(self.hd)
+        rep = self.cfg.num_heads // self.hkv
+        mask = jnp.tril(jnp.ones((S, S), bool))
+
+        # flattened valid (row, pos) pairs -> page/offset scatter targets
+        rows = np.concatenate([np.full(l, i) for i, l in enumerate(lens)])
+        poss = np.concatenate([np.arange(l) for l in lens])
+        tok_pages = np.concatenate(
+            [np.asarray(r.pages, np.int64)[np.arange(l) // self.page]
+             for r, l in zip(reqs, lens)])
+        offs = jnp.asarray(poss % self.page)
+        rows_j, poss_j = jnp.asarray(rows), jnp.asarray(poss)
+
+        for li, lp in enumerate(w["layers"]):
             ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
             h = _rms_pure(x, ln1)
-            q = (h @ wq).reshape(1, s, self.cfg.num_heads, self.hd)
-            k = (h @ wk).reshape(1, s, self.hkv, self.hd)
-            v = (h @ wv).reshape(1, s, self.hkv, self.hd)
+            q = (h @ wq).reshape(B, S, self.cfg.num_heads, self.hd)
+            k = (h @ wk).reshape(B, S, self.hkv, self.hd)
+            v = (h @ wv).reshape(B, S, self.hkv, self.hd)
             q, k = self._rope(q, pos0), self._rope(k, pos0)
-            # causal attention over the prompt itself (no history)
-            scale = 1.0 / math.sqrt(self.hd)
-            rep = self.cfg.num_heads // self.hkv
             ck = jnp.repeat(k, rep, 2) if rep > 1 else k
             cv = jnp.repeat(v, rep, 2) if rep > 1 else v
             logits = jnp.einsum("bthd,bshd->bhts",
                                 (q * scale).astype(jnp.float32),
                                 ck.astype(jnp.float32))
-            mask = jnp.tril(jnp.ones((s, s), bool))
             logits = jnp.where(mask[None, None], logits, -1e30)
             probs = jax.nn.softmax(logits, -1)
             o = jnp.einsum("bhts,bshd->bthd", probs,
                            cv.astype(jnp.float32)).astype(x.dtype)
-            x = x + o.reshape(1, s, -1) @ wo
+            x = x + o.reshape(B, S, -1) @ wo
             h2 = _rms_pure(x, ln2)
             x = x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
-            # scatter this layer's k/v into the owned pages; ADJACENT
-            # advanced indices (axes 1,2) stay in place -> value layout
-            # [Hkv, S, D]
-            tok_pages = page_ids[np.arange(s) // self.page]
-            offs = jnp.asarray(np.arange(s) % self.page)
+            # scatter the group's valid k/v into the owned pages; ADJACENT
+            # advanced indices (axes 1,2) stay in place -> [Hkv, N, D]
+            kvals = jnp.swapaxes(k[rows_j, poss_j], 0, 1)
+            vvals = jnp.swapaxes(v[rows_j, poss_j], 0, 1)
             self.kc[li] = self.kc[li].at[:, tok_pages, offs, :].set(
-                jnp.swapaxes(k[0], 0, 1).astype(self.kc[li].dtype))
+                kvals.astype(self.kc[li].dtype))
             self.vc[li] = self.vc[li].at[:, tok_pages, offs, :].set(
-                jnp.swapaxes(v[0], 0, 1).astype(self.vc[li].dtype))
-        x = _rms_pure(x, self._fnorm)[:, -1]
-        lg = x @ self._head if self._head is not None else x @ self._embed.T
-        req.length = s
-        return int(np.asarray(jnp.argmax(lg, -1))[0])
+                vvals.astype(self.vc[li].dtype))
+        x = _rms_pure(x, w["fnorm"])
+        last = x[jnp.arange(B), jnp.asarray(lens - 1)]       # [B, H]
+        lg = (last @ w["head"] if w["head"] is not None
+              else last @ w["embed"].T)
+        self._key, sub = jax.random.split(self._key)
+        if any(r.temperature > 0.0 for r in reqs):
+            toks = _sample_rows(
+                jax, jnp, lg,
+                jnp.asarray([r.temperature for r in reqs], jnp.float32),
+                jnp.asarray([r.top_k for r in reqs], jnp.int32),
+                jnp.asarray([r.top_p for r in reqs], jnp.float32), sub)
+        else:
+            toks = jnp.argmax(lg.astype(jnp.float32), -1)
+        toks = np.asarray(toks)
+        for i, r in enumerate(reqs):
+            r.length = int(lens[i])
+        return [int(t) for t in toks]
 
-    def _decode_step(self, tokens, lens, tables, kc, vc):
+    def _decode_step(self, weights, tokens, lens, tables, kc, vc,
+                     temps, top_ks, top_ps, key, do_sample=False):
         """ONE batched decode: tokens [B] (last emitted), lens [B] tokens
         already cached, tables [B, pages_per_seq]. Returns (next [B],
         new kc, new vc)."""
@@ -178,10 +263,10 @@ class ContinuousBatchingEngine:
         from ..ops.pallas.decode_attention import paged_attention
 
         b = tokens.shape[0]
-        x = self._embed[tokens][:, None]                 # [B, 1, H]
+        x = weights["embed"][tokens][:, None]                # [B, 1, H]
         page_ids = tables[jnp.arange(b), lens // self.page]
         offs = lens % self.page
-        for li, lp in enumerate(self._lp):
+        for li, lp in enumerate(weights["layers"]):
             ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
             h = _rms_pure(x, ln1)
             q = (h @ wq).reshape(b, 1, self.cfg.num_heads, self.hd)
@@ -197,12 +282,22 @@ class ContinuousBatchingEngine:
             x = x + o.reshape(b, 1, -1).astype(x.dtype) @ wo
             h2 = _rms_pure(x, ln2)
             x = x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
-        x = _rms_pure(x, self._fnorm)[:, 0]
-        lg = x @ self._head if self._head is not None else x @ self._embed.T
-        return jnp.argmax(lg, -1).astype(jnp.int32), kc, vc
+        x = _rms_pure(x, weights["fnorm"])[:, 0]
+        lg = (x @ weights["head"] if weights["head"] is not None
+              else x @ weights["embed"].T)
+        if do_sample:
+            nxt = _sample_rows(jax, jnp, lg, temps, top_ks, top_ps, key)
+        else:
+            # greedy-only tick: skip the full-vocab sort/cumsum entirely
+            nxt = jnp.argmax(lg.astype(jnp.float32), -1).astype(jnp.int32)
+        return nxt, kc, vc
 
     # -- engine surface -----------------------------------------------------
-    def submit(self, prompt_ids) -> int:
+    def submit(self, prompt_ids, temperature=0.0, top_k=0, top_p=1.0,
+               on_token=None) -> int:
+        """Queue a request. ``temperature=0`` decodes greedily; otherwise
+        softmax sampling with optional top_k / top_p truncation.
+        ``on_token(rid, token_id)`` streams each generated token."""
         total = len(prompt_ids) + self.max_new_tokens
         if total > self.max_seq:
             raise ValueError(
@@ -216,10 +311,18 @@ class ContinuousBatchingEngine:
                 f"{self.pool.num_pages}")
         rid = self._next_rid
         self._next_rid += 1
-        self._waiting.append(_Request(rid, [int(t) for t in prompt_ids]))
+        self._waiting.append(_Request(
+            rid, [int(t) for t in prompt_ids], temperature, top_k, top_p,
+            on_token))
         return rid
 
+    def _emit(self, req, tok):
+        req.generated.append(tok)
+        if req.on_token is not None:
+            req.on_token(req.rid, tok)
+
     def _admit(self):
+        group = []
         for i in range(self.max_slots):
             if self._slots[i] is not None or not self._waiting:
                 continue
@@ -230,9 +333,12 @@ class ContinuousBatchingEngine:
                 break  # head-of-line waits for pages
             self._waiting.popleft()
             req.pages = self.pool.alloc(need)
-            first = self._prefill(req)
-            req.generated.append(first)
             self._slots[i] = req
+            group.append(req)
+        if group:
+            first = self._prefill_group(group)
+            for req, tok in zip(group, first):
+                self._emit(req, tok)
 
     def _retire(self, req: _Request):
         self.pool.free(req.pages)
@@ -242,7 +348,7 @@ class ContinuousBatchingEngine:
     def step(self):
         """Admit + one batched decode tick. Returns {rid: full_ids} for
         requests finishing THIS tick."""
-        jnp = self._jnp
+        jax, jnp = self._jax, self._jnp
         newly = {}
         # retire FIRST: a finishing slot frees pages and a slot for this
         # very tick's admissions
@@ -267,12 +373,20 @@ class ContinuousBatchingEngine:
             row = list(r.pages) + [0] * (self.pages_per_seq - len(r.pages))
             table_rows.append(row[: self.pages_per_seq])
         tables = jnp.asarray(np.asarray(table_rows, np.int32))
+        temps = jnp.asarray([r.temperature for r in rows], jnp.float32)
+        top_ks = jnp.asarray([r.top_k for r in rows], jnp.int32)
+        top_ps = jnp.asarray([r.top_p for r in rows], jnp.float32)
+        self._key, sub = jax.random.split(self._key)
+        # static greedy/sampling mode: one retrace per mode, and the
+        # default all-greedy workload never pays the vocab sort
+        do_sample = any(r.temperature > 0.0 for _, r in live)
         nxt, self.kc, self.vc = self._decode_jit(
-            tokens, lens, tables, list(self.kc), list(self.vc))
+            self._weights, tokens, lens, tables, list(self.kc),
+            list(self.vc), temps, top_ks, top_ps, sub, do_sample)
         nxt = np.asarray(nxt)
         for j, (i, r) in enumerate(live):
             r.length += 1
-            r.generated.append(int(nxt[j]))
+            self._emit(r, int(nxt[j]))
         return newly
 
     def run_until_complete(self, max_ticks=10000):

@@ -1,0 +1,255 @@
+"""Segment capture: partial-graph compilation around graph breaks.
+
+Capability slot: the reference's SOT compiles the subgraphs AROUND a
+data-dependent break and stitches them (jit/sot/opcode_translator/executor/
+function_graph.py) — one stray ``if tensor.item():`` costs one host sync,
+not the whole function's compilation.
+
+TPU-native design (LazyTensor-style, no bytecode rewriting): when a
+``to_static`` call site is known to graph-break, the fallback no longer
+dispatches op-by-op. Ops accumulate into a SEGMENT — a recorded graph of
+apply_op calls whose outputs are placeholder `LazyValue`s (shape/dtype via
+``jax.eval_shape``, no device work). The first *value* access (``.item()``,
+``bool()``, ``.numpy()`` — the break itself) flushes the segment: the
+recorded graph compiles to ONE jitted program (memoized per op-sequence +
+input avals), runs, and fills every placeholder. Execution then continues
+eagerly through the Python branch, and the ops after it accumulate into a
+new segment — prefix compiled, break on host, suffix compiled.
+
+Grad-recording calls bypass capture (the eager autograd engine needs
+concrete arrays per op); ``to_static``'s compiled path is no-grad, so the
+fallback matches its semantics.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+logger = logging.getLogger("paddle_tpu.jit.lazy")
+
+_state = threading.local()
+
+
+class LazyValue:
+    """Placeholder for a not-yet-computed array. Knows its aval; forcing
+    it flushes the owning segment. Any consumer outside apply_op (numpy
+    conversion, a raw jnp op via __jax_array__) transparently forces."""
+
+    __slots__ = ("trace", "shape", "dtype", "_concrete")
+
+    def __init__(self, trace, shape, dtype):
+        self.trace = trace
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self._concrete = None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def force(self):
+        if self._concrete is None:
+            self.trace.flush()
+        return self._concrete
+
+    # numpy / jax interop: any direct consumption materialises
+    def __array__(self, dtype=None):
+        a = np.asarray(self.force())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self.force()
+
+
+class _Op:
+    __slots__ = ("fn", "arg_plan", "treedef", "out_lazy", "key")
+
+    def __init__(self, fn, arg_plan, treedef, out_lazy, key):
+        self.fn = fn
+        self.arg_plan = arg_plan      # per leaf: ("lazy", LazyValue) |
+        self.treedef = treedef        #           ("in", input_index)
+        self.out_lazy = out_lazy      # flat list of LazyValue outputs
+        self.key = key                # hashable op identity for memoizing
+
+
+def _op_key(fn, statics):
+    """Op identity for segment memoization: code object + hashable
+    closure constants (unhashable cells — typically captured arrays —
+    key by id; stable for long-lived weights)."""
+    cells = []
+    try:
+        closure = fn.__closure__ or ()
+    except AttributeError:   # custom_vjp wrappers forward getattr oddly
+        closure = ()
+    for cell in closure:
+        v = cell.cell_contents
+        try:
+            hash(v)
+            cells.append(v)
+        except TypeError:
+            cells.append(("#id", id(v)))
+    try:
+        code = fn.__code__
+    except AttributeError:
+        code = id(fn)
+    return (code, tuple(cells), statics)
+
+
+class SegmentTrace:
+    """One capture session (one to_static fallback call)."""
+
+    _cache: dict = {}
+
+    def __init__(self):
+        self.ops: list[_Op] = []
+        self.inputs: list = []        # concrete arrays, in encounter order
+        self.segments = 0             # flush count (observability)
+        self.recorded_ops = 0
+
+    # -- recording ----------------------------------------------------------
+    def record(self, fn, leaf_arrays, treedef, op_name):
+        plan, statics, dyn = [], [], []
+        for a in leaf_arrays:
+            if isinstance(a, LazyValue):
+                if a.trace is not self:
+                    # foreign (outer-trace) placeholder: force it — this
+                    # trace's segment program can't reference another
+                    # trace's graph nodes
+                    a.force()
+                if a._concrete is not None:       # already flushed earlier
+                    plan.append(("in", len(self.inputs)))
+                    self.inputs.append(a._concrete)
+                    dyn.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+                else:
+                    plan.append(("lazy", a))
+                    dyn.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+            elif hasattr(a, "shape") and hasattr(a, "dtype"):
+                plan.append(("in", len(self.inputs)))
+                self.inputs.append(a)
+                dyn.append(jax.ShapeDtypeStruct(
+                    tuple(a.shape), np.dtype(a.dtype)))
+            else:
+                plan.append(("static", a))
+                statics.append(a if _hashable(a) else repr(a))
+
+        def shaped_call(*dyn_leaves):
+            it = iter(dyn_leaves)
+            leaves = [p[1] if p[0] == "static" else next(it) for p in plan]
+            a2, k2 = tree_util.tree_unflatten(treedef, leaves)
+            return fn(*a2, **k2)
+
+        out_shape = jax.eval_shape(shaped_call, *dyn)
+        out_leaves, out_tree = tree_util.tree_flatten(out_shape)
+        out_lazy = [LazyValue(self, o.shape, o.dtype) for o in out_leaves]
+        self.ops.append(_Op(fn, plan, treedef,
+                            out_lazy, _op_key(fn, tuple(statics))))
+        self.recorded_ops += 1
+        return tree_util.tree_unflatten(out_tree, out_lazy)
+
+    # -- flushing -----------------------------------------------------------
+    def flush(self):
+        if not self.ops:
+            return
+        ops, inputs = self.ops, self.inputs
+        self.ops, self.inputs = [], []
+        self.segments += 1
+
+        sig = (tuple(op.key for op in ops),
+               tuple((tuple(a.shape), str(getattr(a, "dtype", type(a))))
+                     for a in inputs))
+        entry = self._cache.get(_freeze(sig))
+        if entry is None:
+            def seg_fn(inputs):
+                env = {}
+                for op, live in zip(ops, entry_ops):
+                    leaves = []
+                    for kind, ref in live.arg_plan:
+                        if kind == "lazy":
+                            leaves.append(env[id(ref)])
+                        elif kind == "in":
+                            leaves.append(inputs[ref])
+                        else:
+                            leaves.append(ref)
+                    a2, k2 = tree_util.tree_unflatten(live.treedef, leaves)
+                    outs = live.fn(*a2, **k2)
+                    for lz, val in zip(live.out_lazy,
+                                       tree_util.tree_leaves(outs)):
+                        env[id(lz)] = val
+                return [env[id(lz)] for op in entry_ops
+                        for lz in op.out_lazy]
+
+            entry_ops = ops
+            entry = jax.jit(seg_fn)
+            self._cache[_freeze(sig)] = (entry, ops)
+            logger.info("segment compiled: %d ops, %d inputs",
+                        len(ops), len(inputs))
+            results = entry(inputs)
+        else:
+            entry, cached_ops = entry
+            # replay the CACHED program; map results onto THIS call's
+            # placeholders positionally (same op sequence by key)
+            results = entry(inputs)
+        flat_lazy = [lz for op in ops for lz in op.out_lazy]
+        for lz, val in zip(flat_lazy, results):
+            lz._concrete = val
+
+
+def _hashable(v):
+    try:
+        hash(v)
+        return True
+    except TypeError:
+        return False
+
+
+def _freeze(sig):
+    try:
+        hash(sig)
+        return sig
+    except TypeError:
+        return repr(sig)
+
+
+# ---------------------------------------------------------------- context
+def lazy_active() -> bool:
+    return getattr(_state, "trace", None) is not None
+
+
+def current_trace() -> SegmentTrace | None:
+    return getattr(_state, "trace", None)
+
+
+class segment_capture:
+    """Context manager: run a python function with op-segment capture."""
+
+    def __enter__(self):
+        self.prev = getattr(_state, "trace", None)
+        _state.trace = SegmentTrace()
+        return _state.trace
+
+    def __exit__(self, *exc):
+        trace = _state.trace
+        _state.trace = self.prev
+        if exc[0] is None:
+            trace.flush()        # materialise anything still pending
+        return False
+
+
+def materialize_tree(out):
+    """Force every LazyValue left in a result pytree (call on the capture
+    result AFTER the context exits — flush() has filled them)."""
+    from ..core.tensor import Tensor
+
+    def fix(t):
+        if isinstance(t, Tensor) and isinstance(t._data, LazyValue):
+            t._data = t._data.force()
+        return t
+
+    return tree_util.tree_map(
+        fix, out, is_leaf=lambda x: isinstance(x, Tensor))

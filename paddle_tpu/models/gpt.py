@@ -38,6 +38,7 @@ class GPTConfig:
         recompute=False,
         recompute_policy="full",
         pp_interleave=1,
+        pp_schedule="1f1b",
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -62,6 +63,10 @@ class GPTConfig:
         self.recompute_policy = recompute_policy
         # virtual pipeline stages per device (VPP): bubble shrinks by 1/v
         self.pp_interleave = pp_interleave
+        # "1f1b" (AD-reversed ring) or "zb" (zero-bubble: dgrad-only ring,
+        # weight grads batched bubble-free after it — ZB-H1 analogue,
+        # reference passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62)
+        self.pp_schedule = pp_schedule
 
 
 def llama_config(size="7b", **overrides):
@@ -264,9 +269,24 @@ def _rope_pure(x, base=10000.0, tables=None):
 
 
 def _rms_pure(x, w, eps=1e-6):
+    import os
+
     import jax
     import jax.numpy as jnp
 
+    if os.environ.get("PTPU_PALLAS_RMS"):
+        # A/B knob: the Pallas rms kernel saves its rstd residual (named
+        # "rms_rstd") so selective-remat backward skips the variance
+        # reduce instead of re-running it
+        from ..ops.pallas import on_tpu_device
+
+        rows = 1
+        for s in x.shape[:-1]:
+            rows *= s
+        if on_tpu_device() and rows % 8 == 0:
+            from ..ops.pallas.rms_norm import rms_norm
+
+            return rms_norm(x, w, eps)
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return ((x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(x.dtype)) * w
 
@@ -309,6 +329,12 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     if use_rope:
         q = _rope_pure(q, tables=rope_tables)
         k = _rope_pure(k, tables=rope_tables)
+    # remat anchors (inert under policies that don't name them): saving
+    # post-rope q/k/v lets the flash backward skip re-running rms1 + the
+    # three projections + rope
+    q = checkpoint_name(q, "attn_q")
+    k = checkpoint_name(k, "attn_k")
+    v = checkpoint_name(v, "attn_v")
     o = _sdpa_pure(q, k, v, causal=True).reshape(b, s, num_heads * hd)
     # selective-remat anchor for the XLA-fallback path: with
     # recompute_policy="attn" the backward reuses this tensor instead of
@@ -319,9 +345,24 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
 
     if not _use_pallas(q.shape):
         o = checkpoint_name(o, "attn_out")
-    x = x + o @ wo
-    h2 = _rms_pure(x, ln2)
-    ffn = checkpoint_name(jax.nn.silu(h2 @ wg) * (h2 @ wu), "ffn_out")
+    import os
+
+    if os.environ.get("PTPU_FUSED_ADDRMS") and _use_pallas(q.shape):
+        # fused residual-add + rms in one Pallas pass (named residuals
+        # addrms_y/rms_rstd make the backward reuse, not re-run, it)
+        from ..ops.pallas.add_rms_norm import add_rms_norm
+
+        x, h2 = add_rms_norm(o @ wo, x, ln2)
+    else:
+        # anchors: resid_mid skips the o-proj re-run; ln2_out feeds the
+        # gate/up recompute without re-running rms2
+        x = checkpoint_name(x + o @ wo, "resid_mid")
+        h2 = checkpoint_name(_rms_pure(x, ln2), "ln2_out")
+    # per-projection anchors: saving gate/up outputs individually lets a
+    # policy trade ~67MB/layer (b4) for skipping that matmul's re-run
+    gate = checkpoint_name(h2 @ wg, "ffn_gate")
+    up = checkpoint_name(h2 @ wu, "ffn_up")
+    ffn = checkpoint_name(jax.nn.silu(gate) * up, "ffn_out")
     return x + ffn @ wd
 
 
@@ -443,7 +484,8 @@ class StackedDecoder(nn.Layer):
 
             from paddle_tpu.distributed.pipeline import (
                 microbatch, spmd_pipeline, spmd_pipeline_interleaved,
-                unmicrobatch)
+                spmd_pipeline_zero_bubble,
+                spmd_pipeline_zero_bubble_interleaved, unmicrobatch)
 
             def stage_fn(stage_params, x):
                 out, _ = jax.lax.scan(step, x, stage_params)
@@ -453,13 +495,20 @@ class StackedDecoder(nn.Layer):
 
             v = getattr(cfg, "pp_interleave", 1) or 1
             n_micro = getattr(cfg, "pp_microbatches", None) or pp
+            zb = getattr(cfg, "pp_schedule", "1f1b") == "zb"
             if v > 1:
                 if cfg.num_layers % (pp * v) != 0:
                     raise ValueError(
                         f"pp_interleave={v} needs num_layers "
                         f"({cfg.num_layers}) divisible by pp*v ({pp * v})")
-                pipe = spmd_pipeline_interleaved(
-                    stage_fn, mesh.jax_mesh, pp, v, remat=cfg.recompute)
+                mk = (spmd_pipeline_zero_bubble_interleaved if zb
+                      else spmd_pipeline_interleaved)
+                pipe = mk(stage_fn, mesh.jax_mesh, pp, v,
+                          remat=cfg.recompute)
+            elif zb:
+                pipe = spmd_pipeline_zero_bubble(
+                    stage_fn, mesh.jax_mesh, pp,
+                    params_spec=P("pp"), remat=cfg.recompute)
             else:
                 pipe = spmd_pipeline(
                     stage_fn, mesh.jax_mesh, pp,

@@ -76,6 +76,13 @@ def _add_rms(x2, r2, w, eps, interpret):
 
 def _add_rms_fwd(x2, r2, w, eps, interpret):
     y, o, rstd = _fwd(x2, r2, w, eps, interpret)
+    # named residuals: under selective remat, policies saving
+    # "addrms_y"/"rms_rstd" let the backward reuse them instead of
+    # re-running this kernel
+    from jax.ad_checkpoint import checkpoint_name
+
+    y = checkpoint_name(y, "addrms_y")
+    rstd = checkpoint_name(rstd, "rms_rstd")
     return (y, o), (y, w, rstd)
 
 

@@ -66,6 +66,12 @@ def _rms(x2, w, eps, interpret):
 
 def _rms_fwd(x2, w, eps, interpret):
     o, rstd = _fwd(x2, w, eps, interpret)
+    # named residual: selective-remat policies listing "rms_rstd" keep the
+    # [rows] f32 sidecar so the backward reuses it instead of re-running
+    # the forward kernel to regenerate the variance
+    from jax.ad_checkpoint import checkpoint_name
+
+    rstd = checkpoint_name(rstd, "rms_rstd")
     return o, (x2, w, rstd)
 
 

@@ -1,0 +1,210 @@
+"""XPlane (.xplane.pb) parser + per-op device-time statistics.
+
+Capability slot: the reference builds per-op device-time summary tables
+from CUPTI traces (``python/paddle/profiler/profiler_statistic.py`` over
+``fluid/platform/profiler/cuda_tracer.cc``). On TPU the device trace is
+the XPlane protobuf that ``jax.profiler`` writes; this module decodes it
+with a self-contained protobuf *wire-format* reader (no tensorflow /
+tensorboard dependency — the schema is pinned to openxla's
+``tsl/profiler/protobuf/xplane.proto``) and aggregates XLA-op events into
+the same kind of table the reference prints.
+
+Wire schema (field numbers are load-bearing, the rest of the proto is
+skipped generically):
+  XSpace.planes=1 ; XPlane{id=1, name=2, lines=3, event_metadata=4(map),
+  stat_metadata=5(map)} ; XLine{id=1, name=2, timestamp_ns=3, events=4} ;
+  XEvent{metadata_id=1, offset_ps=2, duration_ps=3} ;
+  XEventMetadata{id=1, name=2, display_name=4} ; map entry {key=1, value=2}.
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import os
+
+
+# ---------------------------------------------------------------- wire reader
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) over a message buffer.
+    Length-delimited values come back as memoryview slices."""
+    pos, end = 0, len(buf)
+    while pos < end:
+        key, pos = _read_varint(buf, pos)
+        fnum, wtype = key >> 3, key & 7
+        if wtype == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wtype == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wtype == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wtype == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:  # groups (3/4) do not appear in xplane.proto
+            raise ValueError(f"unsupported wire type {wtype}")
+        yield fnum, wtype, val
+
+
+def _submessages(buf, want_fnum):
+    return [v for f, w, v in _fields(buf) if f == want_fnum and w == 2]
+
+
+def _scalar(buf, want_fnum, default=0):
+    for f, w, v in _fields(buf):
+        if f == want_fnum and w == 0:
+            return v
+    return default
+
+
+def _string(buf, want_fnum, default=""):
+    for f, w, v in _fields(buf):
+        if f == want_fnum and w == 2:
+            return bytes(v).decode("utf-8", "replace")
+    return default
+
+
+# ---------------------------------------------------------------- model
+class XEvent:
+    __slots__ = ("name", "offset_ps", "duration_ps")
+
+    def __init__(self, name, offset_ps, duration_ps):
+        self.name = name
+        self.offset_ps = offset_ps
+        self.duration_ps = duration_ps
+
+
+class XLine:
+    __slots__ = ("name", "timestamp_ns", "events")
+
+    def __init__(self, name, timestamp_ns, events):
+        self.name = name
+        self.timestamp_ns = timestamp_ns
+        self.events = events
+
+
+class XPlane:
+    __slots__ = ("name", "lines")
+
+    def __init__(self, name, lines):
+        self.name = name
+        self.lines = lines
+
+
+def parse_xspace(path):
+    """Parse one .xplane.pb file into a list of XPlane objects."""
+    with open(path, "rb") as f:
+        data = memoryview(f.read())
+    planes = []
+    for pbuf in _submessages(data, 1):
+        name = _string(pbuf, 2)
+        # event metadata id -> display-or-plain name
+        meta = {}
+        for entry in _submessages(pbuf, 4):
+            key = _scalar(entry, 1)
+            mbufs = _submessages(entry, 2)
+            if mbufs:
+                mname = _string(mbufs[0], 4) or _string(mbufs[0], 2)
+                meta[key] = mname
+        lines = []
+        for lbuf in _submessages(pbuf, 3):
+            lname = _string(lbuf, 2)
+            ts = _scalar(lbuf, 3)
+            events = []
+            for ebuf in _submessages(lbuf, 4):
+                mid = _scalar(ebuf, 1)
+                events.append(XEvent(meta.get(mid, str(mid)),
+                                     _scalar(ebuf, 2), _scalar(ebuf, 3)))
+            lines.append(XLine(lname, ts, events))
+        planes.append(XPlane(name, lines))
+    return planes
+
+
+# ---------------------------------------------------------------- statistics
+def _classify(op_name):
+    """Bucket an XLA HLO op name into a coarse family (for the summary)."""
+    n = op_name.lower()
+    if "fusion" in n:
+        return "fusion"
+    for kw, fam in (("dot", "matmul"), ("conv", "conv"),
+                    ("custom-call", "custom_call"), ("copy", "copy"),
+                    ("all-reduce", "collective"), ("all-gather", "collective"),
+                    ("collective", "collective"), ("reduce-scatter", "collective"),
+                    ("scatter", "scatter"), ("gather", "gather"),
+                    ("dynamic-update-slice", "dus"), ("rng", "rng")):
+        if kw in n:
+            return fam
+    return "other"
+
+
+def device_op_stats(logdir_or_file):
+    """Aggregate device-plane XLA op events into per-op totals.
+
+    Returns a list of dicts {name, calls, total_us, avg_us, family},
+    sorted by total time descending — the TPU analogue of the reference's
+    ``profiler_statistic.py`` device-kernel table.
+    """
+    if os.path.isdir(logdir_or_file):
+        paths = sorted(glob.glob(os.path.join(
+            logdir_or_file, "**", "*.xplane.pb"), recursive=True))
+    else:
+        paths = [logdir_or_file]
+    acc = collections.defaultdict(lambda: [0, 0])  # name -> [calls, ps]
+    for p in paths:
+        for plane in parse_xspace(p):
+            pname = plane.name.lower()
+            if not ("device" in pname or "tpu" in pname or "/gpu" in pname
+                    or "xla op" in pname):
+                continue
+            for line in plane.lines:
+                # device planes carry one line per core/stream of XLA ops
+                if "step" in line.name.lower():
+                    continue
+                for ev in line.events:
+                    slot = acc[ev.name]
+                    slot[0] += 1
+                    slot[1] += ev.duration_ps
+    rows = [
+        {"name": k, "calls": c, "total_us": ps / 1e6,
+         "avg_us": ps / 1e6 / max(c, 1), "family": _classify(k)}
+        for k, (c, ps) in acc.items()
+    ]
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows
+
+
+def summarize_families(rows):
+    """Collapse an op table into per-family totals (matmul/fusion/...)."""
+    fam = collections.defaultdict(lambda: [0, 0.0])
+    for r in rows:
+        fam[r["family"]][0] += r["calls"]
+        fam[r["family"]][1] += r["total_us"]
+    out = [{"family": k, "calls": c, "total_us": us}
+           for k, (c, us) in fam.items()]
+    out.sort(key=lambda r: -r["total_us"])
+    return out
+
+
+def format_table(rows, limit=30):
+    """Render the op table the way the reference's summary prints."""
+    total = sum(r["total_us"] for r in rows) or 1.0
+    lines = [f"{'op':<64} {'calls':>6} {'total_us':>12} {'avg_us':>10} {'%':>6}"]
+    for r in rows[:limit]:
+        lines.append(
+            f"{r['name'][:64]:<64} {r['calls']:>6} {r['total_us']:>12.1f} "
+            f"{r['avg_us']:>10.2f} {100 * r['total_us'] / total:>5.1f}%")
+    return "\n".join(lines)

@@ -390,3 +390,234 @@ def test_fleet_pipeline_interleaved_train_batch():
     l_ref = run(1, 1)
     assert l_vpp[-1] < l_vpp[0], l_vpp
     np.testing.assert_allclose(l_vpp, l_ref, atol=2e-3, rtol=2e-3)
+
+
+def test_stage_partitioned_parameter_memory():
+    """VERDICT r2 item 2: generic PipelineLayer partitions MEMORY over pp,
+    not just compute — per-device addressable param bytes ~= total/pp and
+    loss parity holds (reference: pp_layers.py:258, stages own only their
+    layers)."""
+    import jax as _jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer)
+
+    H = 16
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(H, H)
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x))
+
+    def _strategy(pp):
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": pp,
+                            "sharding_degree": 1}
+        return s
+
+    def run(pp_degree, shard_stages, steps=4):
+        paddle.seed(11)
+        fleet.init(is_collective=True, strategy=_strategy(pp_degree))
+        model = PipelineLayer([LayerDesc(Block) for _ in range(8)],
+                              num_stages=pp_degree)
+        if shard_stages:
+            model.shard_stage_parameters()
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=model.parameters())
+        dmodel = fleet.distributed_model(model)
+        dopt = fleet.distributed_optimizer(opt)
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(8, H).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, H).astype(np.float32))
+        losses = []
+        for _ in range(steps):
+            loss = dmodel.train_batch(
+                [x, y], dopt,
+                loss_fn=lambda out, yy: ((out - yy) ** 2).mean())
+            losses.append(float(loss))
+
+        # per-device addressable parameter bytes
+        per_dev = {d.id: 0 for d in _jax.devices()}
+        total = 0
+        for _, p in model.named_parameters():
+            nbytes = int(np.prod(p.shape)) * p._data.dtype.itemsize
+            total += nbytes
+            for sh in p._data.addressable_shards:
+                per_dev[sh.device.id] += int(
+                    np.prod(sh.data.shape)) * p._data.dtype.itemsize
+        fleet._reset_for_tests()
+        return losses, per_dev, total
+
+    l_sharded, per_dev, total = run(4, shard_stages=True)
+    l_ref, per_dev_ref, _ = run(4, shard_stages=False)
+
+    # replicated baseline: every device holds ALL params
+    assert max(per_dev_ref.values()) >= total
+    # stage-partitioned: each device holds ~total/pp (pp=4; mesh has only
+    # a pp axis here so the other 4 devices of the 8-dev host hold 0)
+    pp = 4
+    busy = [v for v in per_dev.values() if v > 0]
+    assert len(busy) == pp, per_dev
+    for v in busy:
+        assert v <= total / pp * 1.01, (v, total)
+    # loss parity with the replicated pipeline
+    np.testing.assert_allclose(l_sharded, l_ref, atol=2e-4, rtol=2e-4)
+
+
+class TestZeroBubble:
+    """VERDICT r2 item 3: zero-bubble schedule (ZB-H1 analogue) — dgrad-only
+    reverse ring + bubble-free batched wgrad (reference:
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62)."""
+
+    def _stage(self):
+        def stage_fn(w_local, xx):
+            def step(xx, w1):
+                return jnp.tanh(xx @ w1), None
+            out, _ = jax.lax.scan(step, xx, w_local)
+            return out
+        return stage_fn
+
+    def test_plain_zb_matches_ad_pipeline(self):
+        from paddle_tpu.distributed.pipeline import (
+            microbatch, spmd_pipeline, spmd_pipeline_zero_bubble,
+            unmicrobatch)
+
+        pp, L, H, n_micro = 4, 4, 8, 4
+        mesh = _mesh((pp,), ("pp",))
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(L, H, H) * 0.3, jnp.float32)
+        x = jnp.asarray(rng.randn(8, H), jnp.float32)
+        stage_fn = self._stage()
+        zb = spmd_pipeline_zero_bubble(stage_fn, mesh, pp)
+        ad = spmd_pipeline(stage_fn, mesh, pp)
+        xm = microbatch(x, n_micro)
+
+        def loss(f, w, xm):
+            return jnp.sum(unmicrobatch(f(w, xm)) ** 2)
+
+        np.testing.assert_allclose(
+            np.asarray(unmicrobatch(zb(w, xm))),
+            np.asarray(unmicrobatch(ad(w, xm))), atol=1e-6)
+        g_zb = jax.jit(jax.grad(lambda w: loss(zb, w, xm)))(w)
+        g_ad = jax.jit(jax.grad(lambda w: loss(ad, w, xm)))(w)
+        np.testing.assert_allclose(np.asarray(g_zb), np.asarray(g_ad),
+                                   atol=1e-5)
+        gx_zb = jax.jit(jax.grad(lambda xm: loss(zb, w, xm)))(xm)
+        gx_ad = jax.jit(jax.grad(lambda xm: loss(ad, w, xm)))(xm)
+        np.testing.assert_allclose(np.asarray(gx_zb), np.asarray(gx_ad),
+                                   atol=1e-5)
+
+    def test_interleaved_zb_matches_ad_interleaved(self):
+        from paddle_tpu.distributed.pipeline import (
+            microbatch, spmd_pipeline_interleaved,
+            spmd_pipeline_zero_bubble_interleaved, unmicrobatch)
+
+        pp, v, n_micro, L, H = 4, 2, 4, 8, 8
+        mesh = _mesh((pp,), ("pp",))
+        rng = np.random.RandomState(1)
+        w = jnp.asarray(rng.randn(L, H, H) * 0.3, jnp.float32)
+        x = jnp.asarray(rng.randn(8, H), jnp.float32)
+        stage_fn = self._stage()
+        zbi = spmd_pipeline_zero_bubble_interleaved(stage_fn, mesh, pp, v)
+        adi = spmd_pipeline_interleaved(stage_fn, mesh, pp, v)
+        xm = microbatch(x, n_micro)
+
+        def loss(f, w, xm):
+            return jnp.sum(unmicrobatch(f(w, xm)) ** 2)
+
+        np.testing.assert_allclose(
+            np.asarray(unmicrobatch(zbi(w, xm))),
+            np.asarray(unmicrobatch(adi(w, xm))), atol=1e-6)
+        g_zb = jax.jit(jax.grad(lambda w: loss(zbi, w, xm)))(w)
+        g_ad = jax.jit(jax.grad(lambda w: loss(adi, w, xm)))(w)
+        np.testing.assert_allclose(np.asarray(g_zb), np.asarray(g_ad),
+                                   atol=1e-5)
+
+    def test_cost_model_beats_interleaved_at_pp4(self):
+        # VERDICT done-criterion: tick accounting beating interleaved at
+        # pp=4 / n_micro=4 (full-tick units, cb=2cf, wgrad=cb/3), and both
+        # beat the plain AD ring
+        from paddle_tpu.distributed.pipeline import (
+            interleaved_cost, plain_cost, zero_bubble_cost)
+
+        zb_v2 = zero_bubble_cost(4, 4, v=2)
+        inter_v2 = interleaved_cost(4, 4, 2)
+        plain = plain_cost(4, 4)
+        assert zb_v2 < inter_v2 < plain, (zb_v2, inter_v2, plain)
+        # plain zb also beats the plain ring
+        assert zero_bubble_cost(4, 4) < plain
+
+    def test_flagship_zb_trains(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
+
+        def run(schedule):
+            paddle.seed(3)
+            s = fleet.DistributedStrategy()
+            s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                                "pp_degree": 4, "sharding_degree": 1}
+            fleet.init(is_collective=True, strategy=s)
+            cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                            num_heads=4, max_seq_len=32, dropout=0.0,
+                            pp_schedule=schedule)
+            model = GPTForCausalLMPipe(cfg)
+            model.decoder.apply_pipeline_placements()
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+            step = ShardedTrainStep(model, lambda i, l: model.loss(i, l),
+                                    opt, fleet.get_fleet_mesh())
+            rng = np.random.default_rng(0)
+            ids = paddle.to_tensor(
+                rng.integers(0, 128, (8, 16)).astype(np.int32))
+            lab = paddle.to_tensor(
+                rng.integers(0, 128, (8, 16)).astype(np.int64))
+            losses = [float(step(ids, lab).numpy()) for _ in range(3)]
+            fleet._reset_for_tests()
+            return losses
+
+        l_zb = run("zb")
+        l_ad = run("1f1b")
+        assert all(np.isfinite(l_zb)), l_zb
+        np.testing.assert_allclose(l_zb, l_ad, atol=1e-4, rtol=1e-4)
+
+
+def test_flagship_zb_interleaved_config_path():
+    """zb composes with VPP through the GPTConfig path (code-review r3:
+    the mk(..., remat=...) call needs the remat kwarg)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
+
+    paddle.seed(4)
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 4,
+                        "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=8,
+                    num_heads=4, max_seq_len=32, dropout=0.0,
+                    recompute=True, recompute_policy="full",
+                    pp_schedule="zb", pp_interleave=2)
+    cfg.pp_microbatches = 4
+    model = GPTForCausalLMPipe(cfg)
+    model.decoder.apply_pipeline_placements()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = ShardedTrainStep(model, lambda i, l: model.loss(i, l), opt,
+                            fleet.get_fleet_mesh())
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, 128, (8, 16)).astype(np.int32))
+    lab = paddle.to_tensor(rng.integers(0, 128, (8, 16)).astype(np.int64))
+    losses = [float(step(ids, lab).numpy()) for _ in range(3)]
+    fleet._reset_for_tests()
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses

@@ -95,3 +95,153 @@ def test_submit_rejects_oversized_requests():
                                    max_seq_len=32, max_new_tokens=8)
     with pytest.raises(ValueError, match="max_seq_len"):
         eng.submit(list(range(1, 30)))  # 29 + 8 > 32
+
+
+class TestBatchedPrefillAndSampling:
+    """VERDICT r2 item 5: batched admission prefill, sampling, streaming."""
+
+    def test_group_prefill_one_pass_and_faster(self):
+        import time
+
+        cfg = LlamaConfig(vocab_size=256, hidden_size=256, num_layers=4,
+                          num_heads=8, num_kv_heads=4, max_seq_len=256,
+                          dropout=0.0)
+        paddle.seed(3)
+        model = LlamaForCausalLM(cfg)
+
+        rng = np.random.default_rng(1)
+
+        def four_prompts():
+            return [rng.integers(1, 256, (48,)).tolist() for _ in range(4)]
+
+        def serve(eng, prompts):
+            for p in prompts:
+                eng.submit(p)
+            done = {}
+            while len(done) < len(prompts):
+                done.update(eng.step())
+            return done
+
+        eng = ContinuousBatchingEngine(model, max_slots=4, page_size=16,
+                                       max_seq_len=128, max_new_tokens=4)
+        eng2 = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
+                                        max_seq_len=128, max_new_tokens=4)
+        # warm pass: compiles the decode step + eager prefill op cache
+        serve(eng, four_prompts())
+        serve(eng2, four_prompts())
+        assert eng.prefill_batches == 1       # 4-slot: ONE admission group
+        assert eng2.prefill_batches == 4      # 1-slot: one group per request
+
+        # steady-state: 4-wide admission (one weight pass + shared decode
+        # ticks) beats four sequential requests; best-of-2 guards against
+        # scheduler noise on shared CI hosts
+        def best_of(engine, n=2):
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.perf_counter()
+                serve(engine, four_prompts())
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t_batched = best_of(eng)
+        t_seq = best_of(eng2)
+        assert t_batched < t_seq, (t_batched, t_seq)
+
+    def test_sampling_distribution_and_greedy_default(self):
+        model = _tiny_model(seed=5)
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(1, 96, (6,)).tolist()
+
+        # temperature 0 (default) stays exact-greedy and deterministic
+        outs = set()
+        for seed in (0, 1, 2):
+            eng = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
+                                           max_seq_len=64, max_new_tokens=8,
+                                           seed=seed)
+            eng.submit(prompt)
+            outs.add(tuple(eng.run_until_complete()[0]))
+        assert len(outs) == 1
+
+        # temperature > 0 explores: different seeds give different strings
+        outs = set()
+        for seed in range(4):
+            eng = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
+                                           max_seq_len=64, max_new_tokens=8,
+                                           seed=seed)
+            eng.submit(prompt, temperature=1.0, top_k=50)
+            outs.add(tuple(eng.run_until_complete()[0]))
+        assert len(outs) > 1
+
+        # top_k=1 degenerates to greedy regardless of temperature
+        eng_g = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
+                                         max_seq_len=64, max_new_tokens=8)
+        eng_g.submit(prompt)
+        want = eng_g.run_until_complete()[0]
+        eng_k1 = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
+                                          max_seq_len=64, max_new_tokens=8,
+                                          seed=9)
+        eng_k1.submit(prompt, temperature=1.0, top_k=1)
+        assert eng_k1.run_until_complete()[0] == want
+
+    def test_streaming_callback_order(self):
+        model = _tiny_model(seed=7)
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(1, 96, (5,)).tolist()
+        seen = []
+        eng = ContinuousBatchingEngine(model, max_slots=2, page_size=16,
+                                       max_seq_len=64, max_new_tokens=5)
+        rid = eng.submit(prompt, on_token=lambda r, t: seen.append((r, t)))
+        done = eng.run_until_complete()
+        gen = done[rid][len(prompt):]
+        assert [t for _, t in seen] == gen
+        assert all(r == rid for r, _ in seen)
+
+    def test_reload_weights_takes_effect(self):
+        model = _tiny_model(seed=11)
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(1, 96, (5,)).tolist()
+        eng = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
+                                       max_seq_len=64, max_new_tokens=4)
+        eng.submit(prompt)
+        before = eng.run_until_complete()[0]
+
+        # zero the lm path -> logits change -> different generation
+        with paddle.no_grad():
+            w = model.model.embed_tokens.weight
+            w.set_value(paddle.to_tensor(
+                rng.standard_normal(w.shape).astype(np.float32) * 0.5))
+        eng.reload_weights()
+        eng.submit(prompt)
+        after = eng.run_until_complete()[1]
+        assert before != after
+
+
+def test_top_p_truncates_distribution():
+    """top_p must actually filter: with a tiny nucleus the sampler may only
+    ever emit the highest-probability tokens (code-review r3 finding)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.inference.serving import _sample_rows
+
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray([[5.0, 4.9] + [0.0] * 62], jnp.float32)
+    allowed = {0, 1}
+    for seed in range(24):
+        got = _sample_rows(jax, jnp, logits,
+                           jnp.asarray([1.0], jnp.float32),
+                           jnp.asarray([0], jnp.int32),
+                           jnp.asarray([0.6], jnp.float32),
+                           jax.random.PRNGKey(seed))
+        assert int(got[0]) in allowed, int(got[0])
+    # and with top_p=1.0 the tail is reachable (sanity that filtering off
+    # actually widens the support)
+    seen = set()
+    for seed in range(64):
+        got = _sample_rows(jax, jnp, logits,
+                           jnp.asarray([3.0], jnp.float32),
+                           jnp.asarray([0], jnp.int32),
+                           jnp.asarray([1.0], jnp.float32),
+                           jax.random.PRNGKey(seed))
+        seen.add(int(got[0]))
+    assert len(seen - allowed) > 0, seen

@@ -159,3 +159,93 @@ class TestDynamicDimBucketing:
         for n in (5, 6, 7):
             fn(paddle.to_tensor(np.ones((n, 4), np.float32)))
         assert len(fn._compiled) == 3  # guard+retrace per shape (default)
+
+
+class TestSegmentCapture:
+    """VERDICT r2 item 7: a graph break costs one host sync, not the whole
+    call's compilation — prefix/suffix compile as segments (jit/lazy.py;
+    reference: jit/sot .. function_graph.py subgraph stitching)."""
+
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        class Branchy(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.pre = nn.LayerList([nn.Linear(16, 16) for _ in range(4)])
+                self.post = nn.LayerList([nn.Linear(16, 16) for _ in range(4)])
+
+            def forward(self, x):
+                for l in self.pre:
+                    x = paddle.nn.functional.relu(l(x))
+                if float(x.mean()) > 0:        # the one host branch
+                    x = x * 2.0
+                for l in self.post:
+                    x = paddle.nn.functional.relu(l(x))
+                return x
+
+        paddle.seed(0)
+        return Branchy()
+
+    def test_break_splits_into_two_segments(self):
+        import warnings
+
+        import paddle_tpu as paddle
+
+        layer = self._model()
+        model = paddle.jit.to_static(layer)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 16).astype(np.float32))
+        with paddle.no_grad(), warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out1 = model(x)   # trace attempt -> break -> captured fallback
+            out2 = model(x)   # known break -> captured fallback directly
+        stats = model._segment_stats
+        # exactly two compiled segments: prefix (4 linear+relu) and suffix
+        assert stats["segments"] == 2, stats
+        # every tensor op ran inside a compiled segment -> >=90% of FLOPs
+        # compiled (the host branch itself does no tensor math)
+        assert stats["ops"] >= 8, stats
+        # numerics match plain eager
+        with paddle.no_grad():
+            ref = layer(x)
+        np.testing.assert_allclose(out2.numpy(), ref.numpy(), atol=1e-5)
+        np.testing.assert_allclose(out1.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_segments_memoize_across_calls(self):
+        import warnings
+
+        import paddle_tpu as paddle
+        from paddle_tpu.jit.lazy import SegmentTrace
+
+        model = paddle.jit.to_static(self._model())
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 16).astype(np.float32))
+        with paddle.no_grad(), warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model(x)
+            model(x)
+            before = len(SegmentTrace._cache)
+            model(x)
+            model(x)
+            after = len(SegmentTrace._cache)
+        assert after == before  # steady state: no new segment compilations
+
+    def test_both_branch_paths_work(self):
+        import warnings
+
+        import paddle_tpu as paddle
+
+        layer = self._model()
+        model = paddle.jit.to_static(layer)
+        rng = np.random.RandomState(2)
+        xs = [paddle.to_tensor(rng.randn(2, 16).astype(np.float32) + s)
+              for s in (3.0, -3.0)]
+        with paddle.no_grad(), warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for x in xs:
+                got = model(x)
+                ref = layer(x)
+                np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                           atol=1e-5)
