@@ -34,6 +34,7 @@ class _AmpState(threading.local):
         self.enabled = False
         self.dtype = _dt.bfloat16
         self.level = "O1"
+        self.fp8 = False
 
 
 _state = _AmpState()
@@ -71,6 +72,26 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level
 
 
 amp_guard = auto_cast
+
+
+def is_fp8_enabled():
+    return _state.fp8
+
+
+@contextlib.contextmanager
+def fp8_autocast(enabled=True):
+    """FP8 matmul region (capability slot: the reference's fp8 gemm
+    fusion kernels, phi/kernels/fusion/fp8_gemm/). Inside, Linear-family
+    matmuls quantise BOTH operands to float8_e4m3fn with per-tensor
+    dynamic scales (incubate.nn.functional.fp8.fp8_gemm); backward stays
+    wide. Composes with auto_cast — fp8 applies to the matmul operands,
+    amp dtype to everything else."""
+    prev = _state.fp8
+    _state.fp8 = enabled
+    try:
+        yield
+    finally:
+        _state.fp8 = prev
 
 
 def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None, save_dtype=None, master_grad=False, excluded_layers=None):

@@ -764,3 +764,5 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         return x if isinstance(x, _T) else _T(x)
 
     return _wrap(out), qkv, _wrap(kc), _wrap(vc)
+
+from .fp8 import fp8_gemm, fp8_linear  # noqa: E402,F401

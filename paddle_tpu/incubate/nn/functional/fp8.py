@@ -1,0 +1,83 @@
+"""FP8 (e4m3) matmul path with per-tensor dynamic scales.
+
+Capability slot: the reference's fp8 gemm fusion kernels
+(``phi/kernels/fusion/fp8_gemm/``). TPU-native form: quantise both
+operands to ``float8_e4m3fn`` with per-tensor absmax scales and let the
+MXU run the narrow matmul (fp8 ops double the MXU rate on fp8-capable
+TPUs; on older chips XLA upcasts, keeping the path portable). The
+backward runs in the ORIGINAL dtype (bf16/fp32) through a custom_vjp —
+the standard fp8-training recipe (forward narrow, gradients wide).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply_op
+
+E4M3_MAX = 448.0
+
+
+def _quantize(a):
+    """Per-tensor absmax scaling into e4m3. Returns (q, scale)."""
+    amax = jnp.max(jnp.abs(a.astype(jnp.float32)))
+    scale = jnp.maximum(amax / E4M3_MAX, 1e-12)
+    q = (a.astype(jnp.float32) / scale).astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+@jax.custom_vjp
+def _fp8_matmul(x, w):
+    qx, sx = _quantize(x)
+    qw, sw = _quantize(w)
+    out = jnp.matmul(qx, qw, preferred_element_type=jnp.float32)
+    return (out * (sx * sw)).astype(x.dtype)
+
+
+def _fp8_fwd(x, w):
+    return _fp8_matmul(x, w), (x, w)
+
+
+def _fp8_bwd(res, g):
+    x, w = res
+    # wide backward: dgrad/wgrad precision limits fp8 training far more
+    # than the forward does
+    gw = g.astype(jnp.float32)
+    dx = jnp.matmul(gw, jnp.swapaxes(w.astype(jnp.float32), -1, -2))
+    xw = x.astype(jnp.float32)
+    x2 = xw.reshape(-1, xw.shape[-1])
+    g2 = gw.reshape(-1, gw.shape[-1])
+    dw = jnp.matmul(x2.T, g2)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_fp8_matmul.defvjp(_fp8_fwd, _fp8_bwd)
+
+
+def fp8_gemm(x, y, transpose_x=False, transpose_y=False, name=None):
+    """FP8 (e4m3) matmul: ``x @ y`` with per-tensor dynamic scales on both
+    operands and a wide (fp32-accumulated) backward.
+
+    x: [..., M, K] (2D+); y: [K, N]. transpose flags mirror paddle.matmul.
+    """
+    def _run(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return _fp8_matmul(a, b)
+
+    return apply_op(_run, x, y, _op_name="fp8_gemm")
+
+
+def fp8_linear(x, weight, bias=None, name=None):
+    """Linear layer forward on the fp8 path: ``x @ W (+ b)``."""
+    def _run(a, w, b):
+        out = _fp8_matmul(a, w)
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(_run, x, weight, bias, _op_name="fp8_linear")

@@ -14,7 +14,16 @@ from ...core.tensor import Tensor
 
 
 def linear(x, weight, bias=None, name=None):
-    """y = x @ W (+ b). Weight layout [in, out] like the reference."""
+    """y = x @ W (+ b). Weight layout [in, out] like the reference.
+
+    Under ``paddle.amp.fp8_autocast()`` the matmul runs on the fp8
+    (e4m3, per-tensor-scaled) path with a wide backward."""
+    from ...amp import is_fp8_enabled
+
+    if is_fp8_enabled():
+        from ...incubate.nn.functional.fp8 import fp8_linear
+
+        return fp8_linear(x, weight, bias)
 
     def _linear(a, w, b):
         out = jnp.matmul(a, w)

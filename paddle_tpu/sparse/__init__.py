@@ -15,16 +15,48 @@ from ..core.dispatch import apply_op
 
 
 class SparseCooTensor(Tensor):
+    """COO tensor whose DENSE view is lazy: construction stores only
+    indices+values (O(nnz) memory); ``_data`` densifies on first access by
+    a dense-only consumer. Sparse-native paths (value-wise ops, rulebook
+    convs, bcoo matmul) never trigger it — peak memory scales with nnz,
+    not volume (the reference's whole sparse-kernel point,
+    phi/kernels/sparse/)."""
+
     def __init__(self, indices, values, shape, coalesced=False):
         from jax.experimental import sparse as jsparse
 
         ind = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
         val = values._data if isinstance(values, Tensor) else jnp.asarray(values)
         self._bcoo = jsparse.BCOO((val, ind.T), shape=tuple(shape))
-        super().__init__(self._bcoo.todense(), stop_gradient=True)
+        super().__init__(None, stop_gradient=True)
         self._indices = Tensor(ind)
         # keep the caller's Tensor so the autograd graph reaches the values
         self._values = values if isinstance(values, Tensor) else Tensor(val)
+
+    # -- lazy dense payload ------------------------------------------------
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._bcoo.todense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, v):
+        self._dense_cache = v
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def ndim(self):
+        return len(self._bcoo.shape)
+
+    @property
+    def size(self):
+        import numpy as _np
+
+        return int(_np.prod(self._bcoo.shape)) if self._bcoo.shape else 1
 
     def indices(self):
         return self._indices
@@ -32,8 +64,11 @@ class SparseCooTensor(Tensor):
     def values(self):
         return self._values
 
+    def nnz(self):
+        return int(self._values._data.shape[0])
+
     def to_dense(self):
-        return Tensor(self._bcoo.todense())
+        return Tensor(self._data)
 
     def is_sparse_coo(self):
         return True

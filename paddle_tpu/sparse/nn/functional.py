@@ -3,6 +3,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.dispatch import apply_op
 from .. import SparseCooTensor, sparse_coo_tensor
@@ -71,9 +72,167 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
 
 
 # -- sparse conv functionals (parity: sparse/nn/functional/conv.py) ---------
+def _build_rulebook(indices_np, spatial, ksize, stride, padding, dilation,
+                    subm, batch_size=1):
+    """Host-built rulebook (reference: the GPU rulebook construction in
+    phi/kernels/sparse/gpu/conv_kernel.cu): per kernel offset, the
+    (input_row, output_row) gather/scatter pairs.
+
+    indices_np: [1+nd, nnz] (batch + nd spatial coords). Returns
+    (out_indices [1+nd, n_out], [(in_rows, out_rows)] per offset).
+    Vectorised numpy: coordinate hashing = linearisation + searchsorted —
+    no dense volume is ever materialised.
+    """
+    import itertools
+
+    nd = len(ksize)
+    coords = indices_np.T.astype(np.int64)              # [nnz, 1+nd]
+    dims = [int(batch_size)] + list(spatial)
+
+    def lin(c):                                          # [m, 1+nd] -> [m]
+        out = c[:, 0]
+        for d in range(nd):
+            out = out * spatial[d] + c[:, d + 1]
+        return out
+
+    in_lin = lin(coords)
+    order = np.argsort(in_lin)
+    in_sorted = in_lin[order]
+
+    def lookup(cand_coords, valid):
+        cl = lin(np.where(valid[:, None], cand_coords, 0))
+        pos = np.searchsorted(in_sorted, cl)
+        pos = np.clip(pos, 0, len(in_sorted) - 1)
+        hit = valid & (in_sorted[pos] == cl) if len(in_sorted) else valid & False
+        return order[pos], hit
+
+    offsets = list(itertools.product(*[range(k) for k in ksize]))
+    center = [(k - 1) // 2 for k in ksize]
+
+    if subm:
+        out_coords = coords
+        n_out = len(coords)
+        out_row_of = np.arange(n_out)
+        rulebook = []
+        for off in offsets:
+            # out[p] += w[off] * in[p + (off - center)*dil]
+            delta = np.array([0] + [(off[d] - center[d]) * dilation[d]
+                                    for d in range(nd)], np.int64)
+            cand = out_coords + delta
+            valid = np.ones(len(cand), bool)
+            for d in range(nd):
+                valid &= (cand[:, d + 1] >= 0) & (cand[:, d + 1] < spatial[d])
+            in_rows, hit = lookup(cand, valid)
+            rulebook.append((in_rows[hit], out_row_of[hit]))
+        return indices_np, rulebook, [int(d) for d in dims]
+
+    # full conv: out[p] = sum_off w[off] * in[p*stride - pad + off*dil]
+    out_spatial = [
+        (spatial[d] + 2 * padding[d] - dilation[d] * (ksize[d] - 1) - 1)
+        // stride[d] + 1 for d in range(nd)]
+    pair_in, pair_out_coord, pair_off = [], [], []
+    for oi, off in enumerate(offsets):
+        # in coord q maps to out p = (q + pad - off*dil) / stride
+        num = coords[:, 1:] + np.array(
+            [padding[d] - off[d] * dilation[d] for d in range(nd)], np.int64)
+        ok = np.ones(len(coords), bool)
+        for d in range(nd):
+            ok &= (num[:, d] % stride[d] == 0)
+        p = num // np.array(stride, np.int64)
+        for d in range(nd):
+            ok &= (p[:, d] >= 0) & (p[:, d] < out_spatial[d])
+        oc = np.concatenate([coords[:, :1], p], axis=1)
+        pair_in.append(np.arange(len(coords))[ok])
+        pair_out_coord.append(oc[ok])
+        pair_off.append(np.full(ok.sum(), oi))
+    all_out = (np.concatenate(pair_out_coord) if pair_out_coord
+               else np.zeros((0, 1 + nd), np.int64))
+
+    def lin_out(c):
+        out = c[:, 0]
+        for d in range(nd):
+            out = out * out_spatial[d] + c[:, d + 1]
+        return out
+
+    uniq_lin, inverse = np.unique(lin_out(all_out), return_inverse=True)
+    # reconstruct unique out coords from the first occurrence
+    first = np.zeros(len(uniq_lin), np.int64)
+    first[inverse] = np.arange(len(all_out))
+    out_coords = all_out[first]
+    rulebook, base = [], 0
+    for oi in range(len(offsets)):
+        n = len(pair_in[oi])
+        rulebook.append((pair_in[oi], inverse[base:base + n]))
+        base += n
+    out_dims = [int(dims[0])] + [int(s) for s in out_spatial]
+    return out_coords.T, rulebook, out_dims
+
+
+def _rulebook_conv_values(values, w_flat, bias, rulebook, n_out):
+    """Pure gather-matmul-scatter compute: values [nnz, Cin], w_flat
+    [K, Cin, Cout]. Peak memory O(nnz * C), never O(volume)."""
+    cout = w_flat.shape[-1]
+    out = jnp.zeros((n_out, cout), values.dtype)
+    for k, (ii, oi) in enumerate(rulebook):
+        if len(ii) == 0:
+            continue
+        contrib = values[jnp.asarray(ii)] @ w_flat[k]
+        out = out.at[jnp.asarray(oi)].add(contrib)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _conv_nd_rulebook(x, weight, bias, stride, padding, dilation, subm, nd):
+    from .. import sparse_coo_tensor
+
+    if subm and any(s != 1 for s in stride):
+        raise ValueError(
+            "submanifold conv preserves the input sparsity pattern; "
+            f"stride={tuple(stride)} is not representable (use the "
+            "non-subm conv for strided downsampling)")
+
+    indices_np = np.asarray(x.indices().numpy())
+    spatial = [int(s) for s in x.shape[1:1 + nd]]
+
+    # coalesce duplicate sites first (sparse_coo_tensor never coalesces;
+    # the dense path summed duplicates via todense, so must we)
+    lin = indices_np[0].astype(np.int64)
+    for d in range(nd):
+        lin = lin * spatial[d] + indices_np[1 + d]
+    uniq, first_idx, inverse = np.unique(lin, return_index=True,
+                                         return_inverse=True)
+    coalesced = len(uniq) != indices_np.shape[1]
+    if coalesced:
+        indices_np = indices_np[:, first_idx]
+
+    out_idx, rulebook, out_dims = _build_rulebook(
+        indices_np, spatial, [int(weight.shape[d]) for d in range(nd)],
+        list(stride), list(padding), list(dilation), subm,
+        batch_size=int(x.shape[0]))
+    n_out = out_idx.shape[1]
+    cout = int(weight.shape[-1])
+    inv = jnp.asarray(inverse)
+    n_uniq = len(uniq)
+
+    def _compute(vals, w, b):
+        if coalesced:
+            vals = jnp.zeros((n_uniq,) + tuple(vals.shape[1:]),
+                             vals.dtype).at[inv].add(vals)
+        w_flat = w.reshape((-1,) + tuple(w.shape[-2:]))  # [K, Cin, Cout]
+        return _rulebook_conv_values(vals, w_flat, b, rulebook, n_out)
+
+    out_vals = apply_op(_compute, x.values(), weight, bias,
+                        _op_name=f"subm_conv{nd}d" if subm
+                        else f"sparse_conv{nd}d")
+    shape = tuple(out_dims) + (cout,)
+    return sparse_coo_tensor(jnp.asarray(out_idx), out_vals, shape)
+
+
 def _conv_nd(x, weight, bias, stride, padding, dilation, groups, subm, nd):
-    """Densify -> lax conv (channel-last) -> resparsify; subm keeps the
-    input's sparsity pattern (submanifold semantics)."""
+    """Sparse-native gather-matmul-scatter conv over a host-built rulebook
+    (COO inputs, peak memory O(nnz)); dense inputs / grouped convs take
+    the lax conv path."""
     import jax
     import jax.numpy as jnp
 
@@ -87,6 +246,10 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, subm, nd):
     stride = (stride,) * nd if isinstance(stride, int) else tuple(stride)
     padding = (padding,) * nd if isinstance(padding, int) else tuple(padding)
     dilation = (dilation,) * nd if isinstance(dilation, int) else tuple(dilation)
+
+    if isinstance(x, SparseCooTensor) and groups == 1:
+        return _conv_nd_rulebook(x, weight, bias, stride, padding,
+                                 dilation, subm, nd)
 
     dense = x.to_dense() if isinstance(x, SparseCooTensor) else x
 

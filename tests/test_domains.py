@@ -113,3 +113,150 @@ def test_rpc_sync_async():
             rpc.rpc_sync("worker0", lambda: 1 / 0)
     finally:
         rpc.shutdown()
+
+
+class TestRulebookSparseConv:
+    """VERDICT r2 item 4: real submanifold sparse conv — host rulebook +
+    gather-matmul-scatter, never densifying (reference:
+    phi/kernels/sparse/gpu/conv_kernel.cu)."""
+
+    def _coo_input(self, rng, shape, nnz, nd):
+        import paddle_tpu as paddle
+        from paddle_tpu import sparse as psp
+
+        # unique random sites
+        coords = set()
+        while len(coords) < nnz:
+            coords.add(tuple(
+                int(rng.integers(0, s)) for s in shape[:-1]))
+        idx = np.asarray(sorted(coords)).T                 # [1+nd, nnz]
+        vals = rng.standard_normal((nnz, shape[-1])).astype(np.float32)
+        return psp.sparse_coo_tensor(idx, vals, shape), idx, vals
+
+    def _dense_ref(self, x, w, subm, nd, stride=1, padding=0):
+        # reference: the old densify path (lax conv on the dense view)
+        from paddle_tpu.sparse.nn import functional as F
+        import paddle_tpu as paddle
+
+        dense = x.to_dense()
+        out = F._conv_nd(dense, w, None, stride, padding, 1, 1, subm, nd)
+        return out
+
+    def test_subm_conv3d_matches_densify(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.sparse.nn import functional as F
+
+        rng = np.random.default_rng(0)
+        shape = (2, 6, 5, 4, 3)
+        x, idx, vals = self._coo_input(rng, shape, nnz=17, nd=3)
+        w = paddle.to_tensor(
+            rng.standard_normal((3, 3, 3, 3, 4)).astype(np.float32) * 0.3)
+
+        out = F.subm_conv3d(x, w, padding=1)
+        # same sparsity pattern (submanifold)
+        np.testing.assert_array_equal(out.indices().numpy(), idx)
+        # the densify reference on the dense view has values at INACTIVE
+        # sites too (no site mask for dense inputs); submanifold semantics
+        # compare at the active sites
+        ref = self._dense_ref(x, w, subm=True, nd=3, padding=1)
+        ref_np = np.asarray(ref.to_dense().numpy())
+        oi = out.indices().numpy()
+        np.testing.assert_allclose(out.values().numpy(),
+                                   ref_np[tuple(oi)], atol=1e-4, rtol=1e-4)
+
+    def test_full_conv2d_matches_densify_with_stride(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.sparse.nn import functional as F
+
+        rng = np.random.default_rng(1)
+        shape = (1, 9, 8, 2)
+        x, idx, vals = self._coo_input(rng, shape, nnz=11, nd=2)
+        w = paddle.to_tensor(
+            rng.standard_normal((3, 3, 2, 5)).astype(np.float32) * 0.3)
+        out = F.conv2d(x, w, stride=2, padding=1)
+        ref = self._dense_ref(x, w, subm=False, nd=2, stride=2, padding=1)
+        ref_np = np.asarray(ref.to_dense().numpy())
+        got = np.zeros(ref_np.shape, np.float32)
+        oi = out.indices().numpy()
+        got[tuple(oi)] = out.values().numpy()
+        np.testing.assert_allclose(got, ref_np, atol=1e-4, rtol=1e-4)
+
+    def test_memory_scales_with_nnz_not_volume(self):
+        import jax
+        from paddle_tpu.sparse.nn.functional import (_build_rulebook,
+                                                     _rulebook_conv_values)
+
+        rng = np.random.default_rng(2)
+        # large volume (64^3 = 262144 sites), tiny nnz
+        nnz, cin, cout = 40, 4, 8
+        spatial = [64, 64, 64]
+        coords = set()
+        while len(coords) < nnz:
+            coords.add((0,) + tuple(int(rng.integers(0, 64))
+                                    for _ in range(3)))
+        idx = np.asarray(sorted(coords)).T
+        out_idx, rb, dims = _build_rulebook(
+            idx, spatial, [3, 3, 3], [1, 1, 1], [1, 1, 1], [1, 1, 1],
+            subm=True)
+        vals = rng.standard_normal((nnz, cin)).astype(np.float32)
+        w = rng.standard_normal((27, cin, cout)).astype(np.float32)
+
+        jaxpr = jax.make_jaxpr(
+            lambda v, w: _rulebook_conv_values(v, w, None, rb, nnz))(vals, w)
+        volume = int(np.prod(spatial)) * cout
+        biggest = max(int(np.prod(v.aval.shape) or 1)
+                      for eqn in jaxpr.eqns for v in eqn.outvars)
+        # every intermediate stays O(nnz * C) — orders below the volume
+        assert biggest <= nnz * max(cin, cout) * 27, biggest
+        assert biggest < volume / 100, (biggest, volume)
+
+    def test_rulebook_conv_grads_flow(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.sparse.nn import functional as F
+
+        rng = np.random.default_rng(3)
+        shape = (1, 5, 5, 5, 2)
+        x, idx, vals = self._coo_input(rng, shape, nnz=9, nd=3)
+        w = paddle.to_tensor(
+            rng.standard_normal((3, 3, 3, 2, 3)).astype(np.float32) * 0.3)
+        w.stop_gradient = False
+        v = x.values()
+        v.stop_gradient = False
+        out = F.subm_conv3d(x, w, padding=1)
+        loss = (out.values() ** 2).sum()
+        loss.backward()
+        assert w.grad is not None and np.isfinite(w.grad.numpy()).all()
+        assert v.grad is not None and np.isfinite(v.grad.numpy()).all()
+
+    def test_rulebook_coalesces_duplicates_and_keeps_batch_dim(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import sparse as psp
+        from paddle_tpu.sparse.nn import functional as F
+
+        rng = np.random.default_rng(4)
+        # duplicate site (0,1,1,1) twice; all nonzeros in batch 0 of a
+        # batch-2 tensor (code-review r3 findings)
+        idx = np.asarray([[0, 0, 0], [1, 1, 2], [1, 1, 0], [1, 1, 1]])
+        vals = rng.standard_normal((3, 2)).astype(np.float32)
+        x = psp.sparse_coo_tensor(idx, vals, (2, 4, 4, 4, 2))
+        w = paddle.to_tensor(
+            rng.standard_normal((3, 3, 3, 2, 3)).astype(np.float32) * 0.3)
+        out = F.subm_conv3d(x, w, padding=1)
+        assert out.shape[0] == 2                     # batch dim preserved
+        ref_np = np.asarray(self._dense_ref(
+            x, w, subm=True, nd=3, padding=1).to_dense().numpy())
+        oi = out.indices().numpy()
+        np.testing.assert_allclose(out.values().numpy(), ref_np[tuple(oi)],
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_subm_stride_raises(self):
+        import paddle_tpu as paddle
+        import pytest as _pytest
+        from paddle_tpu.sparse.nn import functional as F
+
+        rng = np.random.default_rng(5)
+        x, _, _ = self._coo_input(rng, (1, 5, 5, 5, 2), nnz=5, nd=3)
+        w = paddle.to_tensor(
+            rng.standard_normal((3, 3, 3, 2, 2)).astype(np.float32))
+        with _pytest.raises(ValueError, match="submanifold"):
+            F.subm_conv3d(x, w, stride=2, padding=1)

@@ -159,3 +159,78 @@ def test_fused_moe_unnormalized_topk():
     # unnormalized weights scale outputs down (selected probs sum < 1)
     assert not np.allclose(norm, unnorm)
     assert np.abs(unnorm).sum() < np.abs(norm).sum()
+
+
+class TestFP8Path:
+    """VERDICT r2 item 9: fp8 (e4m3) matmul path with per-tensor scales
+    (reference slot: phi/kernels/fusion/fp8_gemm/)."""
+
+    def test_fp8_gemm_parity_tolerance(self):
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn.functional import fp8_gemm
+
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((32, 64)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((64, 16)).astype(np.float32))
+        out = fp8_gemm(x, w)
+        ref = x.numpy() @ w.numpy()
+        # e4m3 has ~2 decimal digits; per-tensor scaling keeps relative
+        # error of randn matmuls in the few-percent band
+        err = np.abs(out.numpy() - ref) / (np.abs(ref) + 1.0)
+        assert err.mean() < 0.08, err.mean()
+        # and it IS quantised (not secretly running fp32)
+        assert np.abs(out.numpy() - ref).max() > 0
+
+    def test_fp8_matches_manual_quantization(self):
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn.functional import fp8_gemm
+
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        w = rng.standard_normal((16, 4)).astype(np.float32)
+        out = fp8_gemm(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+        sx = max(np.abs(x).max() / 448.0, 1e-12)
+        sw = max(np.abs(w).max() / 448.0, 1e-12)
+        qx = np.asarray(jnp.asarray(x / sx).astype(jnp.float8_e4m3fn),
+                        np.float32)
+        qw = np.asarray(jnp.asarray(w / sw).astype(jnp.float8_e4m3fn),
+                        np.float32)
+        np.testing.assert_allclose(out, (qx @ qw) * (sx * sw),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_fp8_backward_is_wide(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn.functional import fp8_linear
+
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+        x.stop_gradient = False
+        w.stop_gradient = False
+        out = fp8_linear(x, w)
+        out.sum().backward()
+        # wide backward == exact grads of the UNQUANTISED matmul for sum()
+        np.testing.assert_allclose(w.grad.numpy(),
+                                   x.numpy().sum(0)[:, None].repeat(4, 1),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.tile(w.numpy().sum(1), (8, 1)),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_fp8_autocast_routes_linear(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        rng = np.random.default_rng(3)
+        paddle.seed(7)
+        lin = nn.Linear(32, 8)
+        x = paddle.to_tensor(rng.standard_normal((4, 32)).astype(np.float32))
+        ref = lin(x).numpy()
+        with paddle.amp.fp8_autocast():
+            got = lin(x).numpy()
+        assert not np.array_equal(got, ref)          # quantisation visible
+        np.testing.assert_allclose(got, ref, atol=0.35, rtol=0.2)
+        after = lin(x).numpy()                       # state restored
+        np.testing.assert_array_equal(after, ref)
