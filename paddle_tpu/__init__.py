@@ -179,6 +179,7 @@ from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401
 from . import sparse  # noqa: F401
+from . import strings  # noqa: F401
 from . import distribution  # noqa: F401
 from . import linalg_ns as linalg  # noqa: F401
 from . import fft  # noqa: F401

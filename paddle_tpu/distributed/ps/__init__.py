@@ -44,8 +44,11 @@ class DenseTable:
 
     def __init__(self, name, shape, init=None, lr=0.01, optimizer="sgd"):
         self.name = name
+        # np.array (not asarray): the table must OWN its buffer — a view
+        # of the caller's array would let worker-side in-place updates
+        # mutate the server state without a push
         self.value = (np.zeros(shape, np.float32) if init is None
-                      else np.asarray(init, np.float32).reshape(shape))
+                      else np.array(init, np.float32).reshape(shape))
         self.lr = lr
         self.optimizer = optimizer
         self._accum = np.zeros_like(self.value) if optimizer == "adagrad" else None
@@ -63,6 +66,14 @@ class DenseTable:
                 self.value -= self.lr * grad / (np.sqrt(self._accum) + 1e-10)
             else:
                 self.value -= self.lr * grad
+
+    def add_delta(self, delta):
+        """Geo-SGD accumulation: the server SUMS worker deltas (the
+        reference's geo strategy applies raw parameter diffs, not
+        optimizer steps — ps/service geo mode)."""
+        delta = np.asarray(delta, np.float32).reshape(self.value.shape)
+        with self._lock:
+            self.value += delta
 
 
 class SparseTable:
@@ -154,6 +165,10 @@ class PSServer:
         self.tables[name].push(grad)
         return True
 
+    def push_dense_delta(self, name, delta):
+        self.tables[name].add_delta(delta)
+        return True
+
     def pull_sparse(self, name, ids):
         return self.tables[name].pull(ids)
 
@@ -240,6 +255,10 @@ def _rpc_push_dense(name, grad):
     return get_global_server().push_dense(name, grad)
 
 
+def _rpc_push_dense_delta(name, delta):
+    return get_global_server().push_dense_delta(name, delta)
+
+
 def _rpc_pull_sparse(name, ids):
     return get_global_server().pull_sparse(name, ids)
 
@@ -279,13 +298,24 @@ class PSClient:
     `servers` is a list of rpc worker names (cross-process mode) or
     PSServer objects (in-process mode — unit tests, single-node runs).
     Dense tables land on `hash(name) % n`; sparse rows shard `id % n`.
+
+    ``replication=r`` keeps every dense table on r consecutive servers
+    (fault tolerance: pushes fan out to all live replicas, pulls fail
+    over down the replica chain — the reference PS's table replication,
+    fluid/distributed/ps/service). Known limitation, shared with the
+    reference's best-effort mode: a replica that misses a push while
+    TRANSIENTLY down stays behind until the table is re-created or
+    reloaded from a checkpoint — there is no anti-entropy resync, so a
+    later failover can serve a slightly stale table. Durable recovery is
+    the save()/load() path.
     """
 
-    def __init__(self, servers):
+    def __init__(self, servers, replication=1):
         if not servers:
             raise ValueError("PSClient needs at least one server")
         self.servers = list(servers)
         self.n = len(self.servers)
+        self.replication = max(1, min(int(replication), self.n))
 
     def _call(self, idx, fn, *args):
         target = self.servers[idx]
@@ -295,6 +325,7 @@ class PSClient:
                 _rpc_create_sparse: lambda n_, d_, k_: target.create_sparse_table(n_, d_, **k_),
                 _rpc_pull_dense: target.pull_dense,
                 _rpc_push_dense: target.push_dense,
+                _rpc_push_dense_delta: target.push_dense_delta,
                 _rpc_pull_sparse: target.pull_sparse,
                 _rpc_push_sparse: target.push_sparse,
                 _rpc_save: target.save,
@@ -309,17 +340,52 @@ class PSClient:
         # stable across processes (str hash is PYTHONHASHSEED-randomized)
         return zlib.crc32(name.encode()) % self.n
 
+    def _dense_replicas(self, name):
+        base = self._dense_server(name)
+        return [(base + i) % self.n for i in range(self.replication)]
+
     # dense -------------------------------------------------------------
     def create_dense_table(self, name, shape, **kw):
-        return self._call(self._dense_server(name), _rpc_create_dense,
-                          name, shape, kw)
+        out, ok, last_err = None, False, None
+        for idx in self._dense_replicas(name):
+            try:
+                out = self._call(idx, _rpc_create_dense, name, shape, kw)
+                ok = True
+            except Exception as e:  # same best-effort contract as pushes
+                last_err = e
+        if not ok:
+            raise last_err
+        return out
 
     def pull_dense(self, name):
-        return self._call(self._dense_server(name), _rpc_pull_dense, name)
+        last_err = None
+        for idx in self._dense_replicas(name):
+            try:
+                return self._call(idx, _rpc_pull_dense, name)
+            except Exception as e:  # replica down: fail over
+                last_err = e
+        raise last_err
+
+    def _push_replicated(self, name, fn, *payload):
+        ok, last_err = False, None
+        for idx in self._dense_replicas(name):
+            try:
+                self._call(idx, fn, name, *payload)
+                ok = True
+            except Exception as e:  # dead replica: best-effort continue
+                last_err = e
+        if not ok:
+            raise last_err
+        return True
 
     def push_dense(self, name, grad):
-        return self._call(self._dense_server(name), _rpc_push_dense,
-                          name, np.asarray(grad))
+        return self._push_replicated(name, _rpc_push_dense,
+                                     np.asarray(grad))
+
+    def push_dense_delta(self, name, delta):
+        """Geo-SGD verb: server ADDS the raw parameter delta."""
+        return self._push_replicated(name, _rpc_push_dense_delta,
+                                     np.asarray(delta))
 
     # sparse ------------------------------------------------------------
     def create_sparse_table(self, name, dim, **kw):

@@ -1,0 +1,132 @@
+"""Parameter-server training strategies: async and geo.
+
+Capability parity: the reference's the_one_ps modes
+(``python/paddle/distributed/ps/`` + ``fluid/distributed/ps/service``):
+- **sync**: every worker pushes, a barrier, then everyone pulls — that is
+  the default PSClient flow (callers order the calls).
+- **async** (downpour): pushes are fire-and-forget — the server applies
+  updates as they arrive, pulls read possibly-stale values; workers never
+  barrier. `AsyncPSClient` gives a PSClient that queues pushes onto a
+  background sender thread.
+- **geo** (Geo-SGD): each worker trains a LOCAL replica with its own
+  optimizer; every ``geo_step`` steps it pushes the parameter DELTA
+  (local - base) to the server, pulls the fresh global value and rebases.
+  `GeoSGDWorker` implements the worker-side protocol over the
+  ``push_dense_delta`` verb.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["AsyncPSClient", "GeoSGDWorker"]
+
+
+class AsyncPSClient:
+    """Non-blocking push wrapper: a background thread drains the send
+    queue in order; `flush()` waits until everything pushed so far has
+    been applied server-side (the reference's async-mode semantics —
+    pulls may observe stale parameters between flushes)."""
+
+    def __init__(self, client, max_queue=1024):
+        self._client = client
+        self._q = queue.Queue(maxsize=max_queue)
+        self._err = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception as e:  # surfaced on next flush/push
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _check(self):
+        if self._closed:
+            raise RuntimeError("AsyncPSClient is shut down")
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    # -- async verbs ------------------------------------------------------
+    def push_dense(self, name, grad):
+        self._check()
+        self._q.put((self._client.push_dense, (name, np.asarray(grad))))
+
+    def push_sparse(self, name, ids, grads):
+        self._check()
+        self._q.put((self._client.push_sparse,
+                     (name, np.asarray(ids), np.asarray(grads))))
+
+    def flush(self):
+        """Barrier for THIS worker's outstanding pushes."""
+        self._q.join()
+        self._check()
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    # -- pass-through (synchronous) verbs ---------------------------------
+    def __getattr__(self, name):
+        return getattr(self._client, name)
+
+
+class GeoSGDWorker:
+    """Worker half of Geo-SGD over dense tables.
+
+    Usage::
+
+        worker = GeoSGDWorker(client, {"w": w0_numpy}, geo_step=8)
+        for batch in data:
+            worker.params["w"] -= lr * local_grad(batch)   # any local opt
+            worker.step()                                  # maybe syncs
+
+    Every ``geo_step`` local steps: push ``local - base`` (the server
+    sums deltas from all workers), pull the fresh global value, rebase.
+    """
+
+    def __init__(self, client, init_params: dict, geo_step=8,
+                 create_tables=True):
+        self.client = client
+        self.geo_step = int(geo_step)
+        self.params = {k: np.array(v, np.float32)
+                       for k, v in init_params.items()}
+        self._base = {k: v.copy() for k, v in self.params.items()}
+        self._local_steps = 0
+        if create_tables:
+            for k, v in self.params.items():
+                client.create_dense_table(k, v.shape, init=v)
+
+    def step(self):
+        """Count one local optimizer step; sync when the period elapses."""
+        self._local_steps += 1
+        if self._local_steps % self.geo_step == 0:
+            self.sync()
+
+    def sync(self):
+        """Push deltas, pull the merged globals, rebase the local copy."""
+        for k, local in self.params.items():
+            delta = local - self._base[k]
+            self.client.push_dense_delta(k, delta)
+        for k in self.params:
+            fresh = np.asarray(self.client.pull_dense(k), np.float32)
+            self.params[k] = fresh.copy()
+            self._base[k] = fresh.copy()

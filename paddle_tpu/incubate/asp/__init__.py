@@ -20,7 +20,9 @@ import jax.numpy as jnp
 
 from .. import nn as _nn  # noqa: F401  (import cycle guard)
 
-_MASKS = {}            # id(param) -> jnp mask
+# masks live ON the param object (p._asp_mask): a global dict keyed
+# by id(param) collides when CPython reuses a freed id — a stale
+# mask from a dead model would silently corrupt a new one
 _EXCLUDED = set()      # param names excluded from pruning
 
 
@@ -203,7 +205,7 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         mask = layer_algo(w, n, m)
         p._data = jnp.asarray(w * mask, p._data.dtype)
         if with_mask:
-            _MASKS[id(p)] = jnp.asarray(mask, p._data.dtype)
+            p._asp_mask = jnp.asarray(mask, p._data.dtype)
         pruned[name] = mask
     return pruned
 
@@ -219,7 +221,7 @@ def decorate(optimizer):
     def step(*args, **kwargs):
         out = orig_step(*args, **kwargs)
         for p in optimizer._parameter_list:
-            mask = _MASKS.get(id(p))
+            mask = getattr(p, "_asp_mask", None)
             if mask is not None:
                 p._data = p._data * mask
         return out

@@ -1,0 +1,325 @@
+"""paddle_tpu.ir — pattern rewriting over jaxprs.
+
+Capability slot: the reference's PIR pattern-rewrite infrastructure
+(``paddle/pir/include/pattern_rewrite/``, declarative DRR in
+``fluid/pir/drr/``) and its pass manager. On TPU the IR *is* the jaxpr
+(SURVEY §7 design stance: jax.jit/XLA replace PIR+executors), so the
+user-visible rewrite surface operates on jaxprs:
+
+- `RewritePattern`: match one equation (or a single-use CHAIN of
+  equations) and emit replacement computation with ordinary jnp ops.
+- `PatternRewriter.rewrite(fn)`: returns a new function whose jaxpr has
+  every match replaced — implemented by re-tracing an interpreter over
+  the original jaxpr (no manual Var surgery, so it composes with any
+  primitive, including scan/pjit), with optional dead-code elimination.
+
+The rewritten function is a normal traceable callable: jit it, grad it,
+inspect it with jax.make_jaxpr — exactly how PIR passes feed the rest of
+the reference stack.
+"""
+from __future__ import annotations
+
+import jax
+from jax.extend import core as jex_core
+from jax import tree_util
+
+__all__ = ["RewritePattern", "ChainPattern", "PatternRewriter",
+           "TransposePairPattern", "CastChainPattern", "AddZeroPattern",
+           "dead_code_elimination"]
+
+
+class RewritePattern:
+    """Single-equation pattern. Subclass and implement:
+
+    - ``matches(eqn) -> bool`` — inspect primitive/params.
+    - ``rewrite(*invals) -> outputs`` — replacement computation in jnp
+      ops (tuple matching the eqn's outputs, or a single value).
+    """
+
+    def matches(self, eqn) -> bool:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def rewrite(self, *invals):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class ChainPattern(RewritePattern):
+    """Match a linear chain of primitives ``[p0, p1, ...]`` where each
+    intermediate value has exactly ONE use (the next link). Subclasses
+    implement ``rewrite_chain(eqns, *invals)`` receiving the matched
+    equations (first-to-last) and the FIRST eqn's inputs."""
+
+    prims: tuple = ()
+
+    def matches(self, eqn) -> bool:
+        return bool(self.prims) and eqn.primitive.name == self.prims[0]
+
+    def rewrite_chain(self, eqns, *invals):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def _iter_eqn_invals(eqn):
+    return [v for v in eqn.invars if not isinstance(v, jex_core.Literal)]
+
+
+def _plan_chains(jaxpr, patterns):
+    """Find chain matches: eqn index -> (pattern, [eqn indices])."""
+    use_count = {}
+    producers = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in _iter_eqn_invals(eqn):
+            use_count[v] = use_count.get(v, 0) + 1
+        for v in eqn.outvars:
+            producers[v] = i
+    for v in jaxpr.outvars:
+        if not isinstance(v, jex_core.Literal):
+            use_count[v] = use_count.get(v, 0) + 1
+
+    consumers = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in _iter_eqn_invals(eqn):
+            consumers.setdefault(v, []).append(i)
+
+    matches = {}
+    claimed = set()
+    for i, eqn in enumerate(jaxpr.eqns):
+        if i in claimed:
+            continue
+        for pat in patterns:
+            if not isinstance(pat, ChainPattern) or not pat.matches(eqn):
+                continue
+            idxs, cur = [i], eqn
+            ok = True
+            for want in pat.prims[1:]:
+                if len(cur.outvars) != 1:
+                    ok = False
+                    break
+                out = cur.outvars[0]
+                if use_count.get(out, 0) != 1 or out not in consumers:
+                    ok = False
+                    break
+                nxt = consumers[out][0]
+                if jaxpr.eqns[nxt].primitive.name != want:
+                    ok = False
+                    break
+                idxs.append(nxt)
+                cur = jaxpr.eqns[nxt]
+            if ok and not (set(idxs) & claimed):
+                matches[i] = (pat, idxs)
+                claimed.update(idxs)
+                break
+    return matches
+
+
+def dead_code_elimination(jaxpr):
+    """Indices of live equations (transitively reaching the outputs)."""
+    live_vars = {v for v in jaxpr.outvars
+                 if not isinstance(v, jex_core.Literal)}
+    live_eqns = set()
+    for i in range(len(jaxpr.eqns) - 1, -1, -1):
+        eqn = jaxpr.eqns[i]
+        # effects (io_callback, debug prints) pin an eqn live
+        if any(v in live_vars for v in eqn.outvars) or eqn.effects:
+            live_eqns.add(i)
+            live_vars.update(_iter_eqn_invals(eqn))
+    return live_eqns
+
+
+class PatternRewriter:
+    """Apply patterns greedily until fixpoint (bounded), then DCE.
+
+    parity: pir::PassManager + pattern_rewrite's greedy driver
+    (ApplyPatternsGreedily).
+    """
+
+    def __init__(self, patterns, dce=True, max_iterations=8):
+        self.patterns = list(patterns)
+        self.dce = dce
+        self.max_iterations = max_iterations
+
+    # -- single pass over one closed jaxpr --------------------------------
+    def _rewrite_once(self, closed, args_flat):
+        jaxpr = closed.jaxpr
+        chain_matches = _plan_chains(jaxpr, self.patterns)
+        changed = [False]
+        live = (dead_code_elimination(jaxpr) if self.dce
+                else set(range(len(jaxpr.eqns))))
+        if len(live) != len(jaxpr.eqns):
+            changed[0] = True
+
+        def interp(*flat_args):
+            env = {}
+
+            def read(v):
+                if isinstance(v, jex_core.Literal):
+                    return v.val
+                return env[v]
+
+            def write(v, val):
+                env[v] = val
+
+            for cv, cval in zip(jaxpr.constvars, closed.consts):
+                write(cv, cval)
+            for iv, aval in zip(jaxpr.invars, flat_args):
+                write(iv, aval)
+
+            skip = set()
+            i = 0
+            while i < len(jaxpr.eqns):
+                eqn = jaxpr.eqns[i]
+                if i in skip or i not in live:
+                    i += 1
+                    continue
+                if i in chain_matches:
+                    pat, idxs = chain_matches[i]
+                    first, last = jaxpr.eqns[idxs[0]], jaxpr.eqns[idxs[-1]]
+                    invals = [read(v) for v in first.invars
+                              if not isinstance(v, jex_core.Literal)]
+                    out = pat.rewrite_chain([jaxpr.eqns[j] for j in idxs],
+                                            *invals)
+                    outs = out if isinstance(out, (tuple, list)) else (out,)
+                    for v, val in zip(last.outvars, outs):
+                        write(v, val)
+                    skip.update(idxs)
+                    changed[0] = True
+                    i += 1
+                    continue
+                pat = next((p for p in self.patterns
+                            if not isinstance(p, ChainPattern)
+                            and p.matches(eqn)), None)
+                if pat is not None:
+                    invals = [read(v) for v in eqn.invars
+                              if not isinstance(v, jex_core.Literal)]
+                    out = pat.rewrite(*invals)
+                    outs = out if isinstance(out, (tuple, list)) else (out,)
+                    for v, val in zip(eqn.outvars, outs):
+                        write(v, val)
+                    changed[0] = True
+                    i += 1
+                    continue
+                # default: evaluate the eqn unchanged (the canonical
+                # eval_jaxpr binding dance, incl. call-like primitives)
+                subfuns, bind_params = eqn.primitive.get_bind_params(
+                    eqn.params)
+                invals = [read(v) for v in eqn.invars]
+                outs = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+                if not eqn.primitive.multiple_results:
+                    outs = (outs,)
+                for v, val in zip(eqn.outvars, outs):
+                    write(v, val)
+                i += 1
+            return [read(v) for v in jaxpr.outvars]
+
+        new_closed = jax.make_jaxpr(interp)(*args_flat)
+        return new_closed, changed[0]
+
+    def rewrite(self, fn):
+        """fn -> rewritten callable (same signature, pytree in/out).
+
+        The rewritten jaxpr is CACHED per input signature (treedef +
+        avals): repeated calls pay only jaxpr evaluation, not retracing
+        + the rewrite fixpoint."""
+        rewriter = self
+        cache = {}
+
+        def wrapped(*args, **kwargs):
+            flat, in_tree = tree_util.tree_flatten((args, kwargs))
+            sig = (in_tree, tuple(
+                (tuple(getattr(a, "shape", ())),
+                 str(getattr(a, "dtype", type(a)))) for a in flat))
+            entry = cache.get(sig)
+            if entry is None:
+                def flat_fn(*flat_args):
+                    a, k = tree_util.tree_unflatten(in_tree, flat_args)
+                    out = fn(*a, **k)
+                    leaves, out_tree = tree_util.tree_flatten(out)
+                    flat_fn.out_tree = out_tree
+                    return leaves
+
+                closed = jax.make_jaxpr(flat_fn)(*flat)
+                for _ in range(rewriter.max_iterations):
+                    closed, changed = rewriter._rewrite_once(closed, flat)
+                    if not changed:
+                        break
+                entry = (closed, flat_fn.out_tree)
+                cache[sig] = entry
+            closed, out_tree = entry
+            out_flat = jax.core.eval_jaxpr(
+                closed.jaxpr, closed.consts, *flat)
+            return tree_util.tree_unflatten(out_tree, out_flat)
+
+        wrapped.__name__ = getattr(fn, "__name__", "rewritten")
+        return wrapped
+
+    def jaxpr_of(self, fn, *example_args):
+        """The post-rewrite jaxpr (inspection surface, paddle.pir-style)."""
+        flat, in_tree = tree_util.tree_flatten((example_args, {}))
+
+        def flat_fn(*flat_args):
+            a, k = tree_util.tree_unflatten(in_tree, flat_args)
+            return tree_util.tree_leaves(fn(*a, **k))
+
+        closed = jax.make_jaxpr(flat_fn)(*flat)
+        for _ in range(self.max_iterations):
+            closed, changed = self._rewrite_once(closed, flat)
+            if not changed:
+                break
+        return closed
+
+
+# ---------------------------------------------------------------------------
+# built-in patterns (the reference ships a library of canonicalisations)
+# ---------------------------------------------------------------------------
+class TransposePairPattern(ChainPattern):
+    """transpose(transpose(x, p), p') == x when p' inverts p."""
+
+    prims = ("transpose", "transpose")
+
+    def rewrite_chain(self, eqns, x):
+        import numpy as np
+
+        p0 = eqns[0].params["permutation"]
+        p1 = eqns[1].params["permutation"]
+        perm = tuple(np.asarray(p0)[list(p1)])
+        if perm == tuple(range(len(perm))):
+            return x
+        import jax.numpy as jnp
+
+        return jnp.transpose(x, perm)  # still fuses the pair into one
+
+
+class CastChainPattern(ChainPattern):
+    """convert(convert(x, a), b) -> convert(x, b) (lossy-mid casts are
+    NOT collapsed: f32->bf16->f32 must keep the rounding)."""
+
+    prims = ("convert_element_type", "convert_element_type")
+
+    def rewrite_chain(self, eqns, x):
+        import jax.numpy as jnp
+
+        mid = eqns[0].params["new_dtype"]
+        final = eqns[1].params["new_dtype"]
+        src = x.dtype
+        # collapse ONLY provably-lossless intermediates: float -> wider
+        # (or equal) float. Anything else (narrowing floats, any integer
+        # hop — int wrap-around, float->int truncation) changes values,
+        # so both casts stay.
+        if (jnp.issubdtype(src, jnp.floating)
+                and jnp.issubdtype(mid, jnp.floating)
+                and jnp.finfo(mid).bits >= jnp.finfo(src).bits):
+            return x.astype(final)
+        return x.astype(mid).astype(final)
+
+
+class AddZeroPattern(RewritePattern):
+    """x + 0 (literal) -> x."""
+
+    def matches(self, eqn):
+        if eqn.primitive.name != "add":
+            return False
+        return any(isinstance(v, jex_core.Literal)
+                   and getattr(v.val, "shape", None) in ((), None)
+                   and v.val == 0 for v in eqn.invars)
+
+    def rewrite(self, *invals):
+        return invals[0]

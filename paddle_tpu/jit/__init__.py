@@ -77,19 +77,24 @@ class StaticFunction:
         # reduce over the padded region — the caller owns masking, exactly
         # like the reference's dynamic-shape dy2st deployments pad inputs.
         self._bucket_axes = None
+        self._bucket_kw = None
         if bucket_dynamic_shapes and input_spec is not None:
             from ..static import InputSpec
 
-            axes = []
+            axes, kw = [], {}
             for spec in (input_spec if isinstance(input_spec, (list, tuple))
                          else [input_spec]):
                 if isinstance(spec, InputSpec):
-                    axes.append(tuple(
-                        i for i, d in enumerate(spec.shape)
-                        if d is None or d == -1))
+                    dyn = tuple(i for i, d in enumerate(spec.shape)
+                                if d is None or d == -1)
+                    axes.append(dyn)
+                    # NAMED specs additionally bucket same-named kwargs
+                    if getattr(spec, "name", None):
+                        kw[spec.name] = dyn
                 else:
                     axes.append(())
             self._bucket_axes = axes
+            self._bucket_kw = kw
 
     @staticmethod
     def _next_bucket(n):
@@ -108,18 +113,53 @@ class StaticFunction:
             axes = (self._bucket_axes[i]
                     if i < len(self._bucket_axes) else ())
             if axes and hasattr(a, "shape"):
-                pad = [(0, 0)] * a.ndim
-                needs = False
-                for ax in axes:
-                    tgt = self._next_bucket(a.shape[ax])
-                    if tgt != a.shape[ax]:
-                        pad[ax] = (0, tgt - a.shape[ax])
-                        needs = True
-                if needs:
-                    a = jnp.pad(a, pad) if not isinstance(a, _np.ndarray) \
-                        else _np.pad(a, pad)
+                a = self._pad_to_buckets(a, axes)
             out.append(a)
         return tuple(out)
+
+    def _pad_to_buckets(self, a, axes):
+        import numpy as _np
+
+        pad = [(0, 0)] * a.ndim
+        needs = False
+        for ax in axes:
+            tgt = self._next_bucket(a.shape[ax])
+            if tgt != a.shape[ax]:
+                pad[ax] = (0, tgt - a.shape[ax])
+                needs = True
+        if not needs:
+            return a
+        return (_np.pad(a, pad) if isinstance(a, _np.ndarray)
+                else jnp.pad(a, pad))
+
+    def _bucketize_kwargs(self, raw_kwargs):
+        """Bucket keyword tensors through their NAMED InputSpecs."""
+        if self._bucket_axes is None or not raw_kwargs:
+            return raw_kwargs
+        out = {}
+        for k, v in raw_kwargs.items():
+            axes = (self._bucket_kw or {}).get(k, ())
+            if hasattr(v, "shape") and v.ndim >= 1:
+                if axes:
+                    v = self._pad_to_buckets(v, axes)
+                elif k not in (self._bucket_kw or {}):
+                    raise ValueError(
+                        "bucket_dynamic_shapes: tensor keyword argument "
+                        f"{k!r} has no matching NAMED InputSpec — name the "
+                        "spec (InputSpec(shape, name=...)) or pass the "
+                        "tensor positionally")
+            elif k not in (self._bucket_kw or {}) and any(
+                    hasattr(leaf, "shape")
+                    for leaf in tree_util.tree_leaves(v)):
+                # tensors hidden in containers can't be bucketed — raise
+                # loudly rather than silently recompiling per shape
+                raise ValueError(
+                    "bucket_dynamic_shapes: keyword argument "
+                    f"{k!r} contains tensors inside a container — pass "
+                    "them as named top-level arguments so they can be "
+                    "padded to their bucket")
+            out[k] = v
+        return out
 
     def _trace_key(self, raw_args, raw_kwargs):
         training = self._layer.training if self._layer is not None else False
@@ -195,14 +235,7 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         raw_args = self._bucketize(_unwrap_tensors(args))
-        raw_kwargs = _unwrap_tensors(kwargs)
-        if self._bucket_axes is not None and any(
-                hasattr(v, "shape") for v in
-                tree_util.tree_leaves(raw_kwargs)):
-            raise ValueError(
-                "bucket_dynamic_shapes: input_spec maps to POSITIONAL "
-                "arguments only — pass tensors positionally so they can "
-                "be padded to their bucket")
+        raw_kwargs = self._bucketize_kwargs(_unwrap_tensors(kwargs))
         key = self._trace_key(raw_args, raw_kwargs)
         if self._compiled.get(key, False) is None:  # known graph break
             return self._eager_call(args, kwargs)

@@ -129,6 +129,7 @@ class Profiler:
             import jax
 
             jax.profiler.stop_trace()
+            self._trace_written = True   # a trace from THIS session exists
         except Exception:
             pass
         self._jax_active = False
@@ -165,9 +166,45 @@ class Profiler:
                     f"{s['total']*1e3:>12.3f}{s['avg']*1e3:>10.3f}"
                     f"{s['max']*1e3:>10.3f}{s['min']*1e3:>10.3f}"
                 )
+        # device-side per-op table (parity: profiler_statistic.py's
+        # device-kernel summary from CUPTI; here decoded from the XPlane
+        # trace jax wrote — see profiler/xplane.py)
+        dev = self.device_summary(limit=20)
+        if dev:
+            lines.append("")
+            lines.append("-- device ops (XPlane) --")
+            lines.append(dev)
         out = "\n".join(lines) if lines else "no events recorded"
         print(out)
         return out
+
+    def device_summary(self, limit=30, by_family=False, logdir=None):
+        """Per-op device-time table decoded from the XPlane trace dir
+        (the reference builds the same table from CUPTI in
+        profiler_statistic.py; on TPU the device plane is the XPlane
+        protobuf). Returns "" when THIS session captured no device trace
+        — stale runs from a previous process in the same logdir are never
+        presented as current (pass ``logdir`` explicitly to inspect one)."""
+        if logdir is None:
+            if not getattr(self, "_trace_written", False):
+                return ""
+            logdir = self._export_dir or os.path.join(os.getcwd(),
+                                                      "profiler_log")
+        try:
+            from .xplane import (device_op_stats, format_table,
+                                 summarize_families)
+
+            rows = device_op_stats(logdir)
+        except (OSError, ValueError):
+            return ""
+        if not rows:
+            return ""
+        if by_family:
+            fams = summarize_families(rows)
+            return "\n".join(
+                f"{r['family']:<16}{r['calls']:>8}{r['total_us']:>14.1f}us"
+                for r in fams)
+        return format_table(rows, limit=limit)
 
     def _drain_host_events(self):
         from ..core import native

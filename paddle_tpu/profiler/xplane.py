@@ -161,15 +161,31 @@ def device_op_stats(logdir_or_file):
     if os.path.isdir(logdir_or_file):
         paths = sorted(glob.glob(os.path.join(
             logdir_or_file, "**", "*.xplane.pb"), recursive=True))
+        # jax writes each trace under plugins/profile/<timestamp>/ —
+        # restrict to the NEWEST run so repeated profiling into one
+        # logdir doesn't aggregate stale runs
+        by_dir = collections.defaultdict(list)
+        for p in paths:
+            by_dir[os.path.dirname(p)].append(p)
+        if by_dir:
+            paths = by_dir[max(by_dir)]
     else:
         paths = [logdir_or_file]
+
+    def _is_device(pname):
+        return ("device" in pname or "tpu" in pname or "/gpu" in pname
+                or "xla op" in pname)
+
+    planes = [pl for p in paths for pl in parse_xspace(p)]
+    device_planes = [pl for pl in planes if _is_device(pl.name.lower())]
+    if not device_planes:
+        # XLA:CPU runs put op events on "/host:CPU"; only fall back to it
+        # when NO real device plane exists (on TPU/GPU that plane holds
+        # host TraceMe events, not device time)
+        device_planes = [pl for pl in planes
+                         if pl.name.lower() == "/host:cpu"]
     acc = collections.defaultdict(lambda: [0, 0])  # name -> [calls, ps]
-    for p in paths:
-        for plane in parse_xspace(p):
-            pname = plane.name.lower()
-            if not ("device" in pname or "tpu" in pname or "/gpu" in pname
-                    or "xla op" in pname):
-                continue
+    for plane in device_planes:
             for line in plane.lines:
                 # device planes carry one line per core/stream of XLA ops
                 if "step" in line.name.lower():

@@ -1,0 +1,140 @@
+"""String tensors + case-conversion ops.
+
+Capability parity: the reference's strings kernel group
+(``paddle/phi/kernels/strings/`` — StringTensor at
+``paddle/phi/core/string_tensor.h:33``, lower/upper kernels in
+``strings_lower_upper_kernel.h``, unicode tables in ``unicode.cc``). The
+reference exposes NO public python surface for these (the kernels back
+internal tokenization); here the same capability is a small host-side
+tensor type — strings are control-plane data on TPU (variable-length
+bytes can't ride the MXU), so the design keeps them in host memory as a
+numpy object array with tensor-like shape semantics, convertible to/from
+the device world via encode/decode.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "lower", "upper",
+           "encode_utf8", "decode_utf8"]
+
+
+class StringTensor:
+    """Dense tensor of python strings (host memory, numpy object array)."""
+
+    def __init__(self, data):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def reshape(self, shape):
+        return StringTensor(self._data.reshape(shape))
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __eq__(self, other):
+        other_arr = other._data if isinstance(other, StringTensor) else other
+        return self._data == other_arr
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def to_string_tensor(data):
+    return data if isinstance(data, StringTensor) else StringTensor(data)
+
+
+def empty(shape):
+    """parity: strings_empty_kernel.cc — an uninitialised string tensor."""
+    return StringTensor(np.full(shape, "", dtype=object))
+
+
+def _case_op(x, fn, use_utf8_encoding):
+    t = to_string_tensor(x)
+    if use_utf8_encoding:
+        out = np.frompyfunc(fn, 1, 1)(t._data)
+    else:
+        # ASCII-only mode (the reference's non-utf8 kernel variant only
+        # touches [A-Za-z])
+        def ascii_case(s):
+            return "".join(fn(c) if c.isascii() else c for c in s)
+
+        out = np.frompyfunc(ascii_case, 1, 1)(t._data)
+    return StringTensor(out)
+
+
+def lower(x, use_utf8_encoding=True, name=None):
+    """parity: strings_lower_upper_kernel.h StringLower."""
+    return _case_op(x, str.lower, use_utf8_encoding)
+
+
+def upper(x, use_utf8_encoding=True, name=None):
+    """parity: strings_lower_upper_kernel.h StringUpper."""
+    return _case_op(x, str.upper, use_utf8_encoding)
+
+
+def encode_utf8(x, max_bytes=None, pad=0):
+    """StringTensor -> (uint8 device tensor [*, max_bytes], lengths):
+    the bridge from host strings into the device world (tokenizers etc.)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    t = to_string_tensor(x)
+    flat = [s.encode("utf-8") for s in t._data.reshape(-1)]
+    width = max_bytes or max((len(b) for b in flat), default=0)
+    buf = np.full((len(flat), width), pad, np.uint8)
+    lens = np.zeros(len(flat), np.int32)
+    for i, b in enumerate(flat):
+        n = min(len(b), width)
+        # never cut inside a multi-byte UTF-8 sequence: back off past any
+        # continuation bytes (0b10xxxxxx) so decode_utf8 round-trips the
+        # kept prefix losslessly
+        while n > 0 and n < len(b) and (b[n] & 0xC0) == 0x80:
+            n -= 1
+        buf[i, :n] = np.frombuffer(b[:n], np.uint8)
+        lens[i] = n
+    shape = tuple(t._data.shape) + (width,)
+    return (Tensor(jnp.asarray(buf.reshape(shape))),
+            Tensor(jnp.asarray(lens.reshape(t._data.shape))))
+
+
+def decode_utf8(codes, lengths=None):
+    """(uint8 tensor [*, W], lengths) -> StringTensor (inverse bridge)."""
+    from ..core.tensor import Tensor
+
+    arr = np.asarray(codes._data if isinstance(codes, Tensor) else codes,
+                     np.uint8)
+    lens = None
+    if lengths is not None:
+        lens = np.asarray(
+            lengths._data if isinstance(lengths, Tensor) else lengths,
+            np.int64).reshape(-1)
+    flat = arr.reshape(-1, arr.shape[-1])
+    out = []
+    for i, row in enumerate(flat):
+        n = int(lens[i]) if lens is not None else len(row)
+        out.append(bytes(row[:n]).decode("utf-8", "replace"))
+    return StringTensor(
+        np.asarray(out, object).reshape(arr.shape[:-1]))

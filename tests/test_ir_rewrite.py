@@ -1,0 +1,129 @@
+"""jaxpr pattern rewriting (parity slot: pir pattern_rewrite + DRR,
+paddle/pir/include/pattern_rewrite, fluid/pir/drr)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _prims(closed):
+    return [e.primitive.name for e in closed.jaxpr.eqns]
+
+
+class TestPatternRewriter:
+    def test_transpose_pair_eliminated(self):
+        from paddle_tpu.ir import PatternRewriter, TransposePairPattern
+
+        def f(x):
+            return jnp.transpose(jnp.transpose(x, (1, 0)), (1, 0)) * 2.0
+
+        rw = PatternRewriter([TransposePairPattern()])
+        x = jnp.asarray(np.random.RandomState(0).randn(3, 4), jnp.float32)
+        out = rw.rewrite(f)(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(f(x)))
+        prims = _prims(rw.jaxpr_of(f, x))
+        assert "transpose" not in prims, prims
+
+    def test_cast_chain_collapsed_but_lossy_kept(self):
+        from paddle_tpu.ir import CastChainPattern, PatternRewriter
+
+        rw = PatternRewriter([CastChainPattern()])
+
+        def widen(x):  # f32 -> f64 -> f32: mid is lossless, collapse
+            return x.astype(jnp.float64).astype(jnp.float32) + 1.0
+
+        x = jnp.asarray([1.2345678], jnp.float32)
+        assert _prims(rw.jaxpr_of(widen, x)).count(
+            "convert_element_type") <= 1
+        np.testing.assert_allclose(np.asarray(rw.rewrite(widen)(x)),
+                                   np.asarray(widen(x)))
+
+        def lossy(x):  # f32 -> bf16 -> f32 keeps the rounding
+            return x.astype(jnp.bfloat16).astype(jnp.float32)
+
+        np.testing.assert_array_equal(np.asarray(rw.rewrite(lossy)(x)),
+                                      np.asarray(lossy(x)))
+
+    def test_dead_code_eliminated(self):
+        from paddle_tpu.ir import PatternRewriter
+
+        def f(x):
+            unused = jnp.sin(x) @ jnp.cos(x).T   # never used
+            return x * 3.0
+
+        rw = PatternRewriter([])
+        x = jnp.ones((4, 4), jnp.float32)
+        prims = _prims(rw.jaxpr_of(f, x))
+        assert "sin" not in prims and "dot_general" not in prims, prims
+        np.testing.assert_allclose(np.asarray(rw.rewrite(f)(x)),
+                                   np.asarray(f(x)))
+
+    def test_rewritten_fn_is_traceable_and_differentiable(self):
+        from paddle_tpu.ir import PatternRewriter, TransposePairPattern
+
+        def f(x):
+            return jnp.sum(jnp.transpose(jnp.transpose(x)) ** 2)
+
+        rw = PatternRewriter([TransposePairPattern()])
+        g = rw.rewrite(f)
+        x = jnp.asarray(np.random.RandomState(1).randn(3, 3), jnp.float32)
+        gj = jax.jit(jax.grad(g))(x)
+        np.testing.assert_allclose(np.asarray(gj), np.asarray(2 * x),
+                                   atol=1e-6)
+
+    def test_custom_user_pattern(self):
+        # DRR-style user extension: fold exp(log(x)) -> x
+        from paddle_tpu.ir import ChainPattern, PatternRewriter
+
+        class ExpLog(ChainPattern):
+            prims = ("log", "exp")
+
+            def rewrite_chain(self, eqns, x):
+                return x
+
+        def f(x):
+            return jnp.exp(jnp.log(x)) + 1.0
+
+        rw = PatternRewriter([ExpLog()])
+        x = jnp.asarray([2.0, 3.0], jnp.float32)
+        prims = _prims(rw.jaxpr_of(f, x))
+        assert "log" not in prims and "exp" not in prims, prims
+        np.testing.assert_allclose(np.asarray(rw.rewrite(f)(x)),
+                                   np.asarray(x + 1.0))
+
+    def test_composes_with_scan(self):
+        # the interpreter must pass through call-like primitives untouched
+        from paddle_tpu.ir import PatternRewriter, TransposePairPattern
+
+        def f(x):
+            def step(c, _):
+                return c * 1.5, None
+            out, _ = jax.lax.scan(step, x, None, length=3)
+            return jnp.transpose(jnp.transpose(out))
+
+        rw = PatternRewriter([TransposePairPattern()])
+        x = jnp.ones((2, 2), jnp.float32)
+        np.testing.assert_allclose(np.asarray(rw.rewrite(f)(x)),
+                                   np.asarray(f(x)))
+        assert "scan" in _prims(rw.jaxpr_of(f, x))
+
+    def test_integer_cast_chains_never_collapsed(self):
+        # code-review r3: int-narrowing / float->int hops change values —
+        # only float->wider-float intermediates may collapse
+        from paddle_tpu.ir import CastChainPattern, PatternRewriter
+
+        rw = PatternRewriter([CastChainPattern()])
+
+        def wrap(x):  # int64 -> int32 (wraps) -> int64
+            return x.astype(jnp.int32).astype(jnp.int64)
+
+        with jax.enable_x64(True):
+            x = jnp.asarray([2 ** 40], jnp.int64)
+            np.testing.assert_array_equal(np.asarray(rw.rewrite(wrap)(x)),
+                                          np.asarray(wrap(x)))
+
+        def trunc(x):  # float -> int (truncates) -> float
+            return x.astype(jnp.int32).astype(jnp.float32)
+
+        x = jnp.asarray([3.7], jnp.float32)
+        np.testing.assert_array_equal(np.asarray(rw.rewrite(trunc)(x)),
+                                      np.asarray(trunc(x)))

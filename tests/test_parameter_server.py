@@ -200,3 +200,87 @@ def test_ps_over_rpc_two_processes(tmp_path):
     ps_out, _ = ps.communicate(timeout=120)
     assert "WORKER_OK" in wk_out, wk_out[-2000:]
     assert "SERVER_OK" in ps_out, ps_out[-2000:]
+
+
+class TestPSStrategies:
+    """Missing r2 #6: async/geo PS strategies + dense table replication
+    (reference: the_one_ps sync/async/geo modes, ps/service)."""
+
+    def test_async_client_applies_in_order_and_flushes(self):
+        from paddle_tpu.distributed.ps import PSClient, PSServer
+        from paddle_tpu.distributed.ps.strategies import AsyncPSClient
+
+        server = PSServer()
+        client = PSClient([server])
+        client.create_dense_table("w", (4,), init=np.zeros(4), lr=1.0)
+        a = AsyncPSClient(client)
+        for _ in range(10):
+            a.push_dense("w", np.ones(4))
+        a.flush()
+        # sgd with lr=1: w -= sum of 10 unit grads
+        np.testing.assert_allclose(client.pull_dense("w"), -10 * np.ones(4))
+        a.shutdown()
+
+    def test_geo_sgd_two_workers_merge_deltas(self):
+        from paddle_tpu.distributed.ps import PSClient, PSServer
+        from paddle_tpu.distributed.ps.strategies import GeoSGDWorker
+
+        server = PSServer()
+        c1, c2 = PSClient([server]), PSClient([server])
+        w0 = np.zeros(3, np.float32)
+        wk1 = GeoSGDWorker(c1, {"w": w0}, geo_step=2)
+        wk2 = GeoSGDWorker(c2, {"w": w0}, geo_step=2, create_tables=False)
+
+        # worker 1 moves +1 per step, worker 2 moves -0.5 per step
+        for _ in range(2):
+            wk1.params["w"] += 1.0
+            wk1.step()
+        for _ in range(2):
+            wk2.params["w"] -= 0.5
+            wk2.step()
+        # server saw +2 then -1 -> global = +1; both workers rebased
+        np.testing.assert_allclose(c1.pull_dense("w"), np.ones(3))
+        np.testing.assert_allclose(wk2.params["w"], np.ones(3))
+        # deltas accumulate ACROSS workers (not last-write-wins)
+        wk1.sync()  # no local change since rebase -> zero delta, fresh pull
+        np.testing.assert_allclose(wk1.params["w"], np.ones(3))
+
+    def test_dense_replication_failover(self):
+        from paddle_tpu.distributed.ps import PSClient, PSServer
+
+        class DeadServer(PSServer):
+            def pull_dense(self, name):
+                raise ConnectionError("replica down")
+
+            def push_dense(self, name, grad):
+                raise ConnectionError("replica down")
+
+        s0, s1, s2 = PSServer(), DeadServer(), PSServer()
+        client = PSClient([s0, s1, s2], replication=3)
+        client.create_dense_table("w", (2,), init=np.zeros(2), lr=1.0)
+        client.push_dense("w", np.ones(2))        # fans out, skips the dead
+        out = client.pull_dense("w")              # fails over to a live one
+        np.testing.assert_allclose(out, -np.ones(2))
+        # all LIVE replicas converged to the same value
+        np.testing.assert_allclose(s0.pull_dense("w"), s2.pull_dense("w"))
+
+    def test_async_push_after_shutdown_raises(self):
+        from paddle_tpu.distributed.ps import PSClient, PSServer
+        from paddle_tpu.distributed.ps.strategies import AsyncPSClient
+        import pytest as _pytest
+
+        a = AsyncPSClient(PSClient([PSServer()]))
+        a.shutdown()
+        with _pytest.raises(RuntimeError, match="shut down"):
+            a.push_dense("w", np.ones(2))
+
+    def test_create_dense_table_tolerates_dead_replica(self):
+        from paddle_tpu.distributed.ps import PSClient, PSServer
+
+        class DeadServer(PSServer):
+            def create_dense_table(self, *a, **k):
+                raise ConnectionError("down")
+
+        client = PSClient([PSServer(), DeadServer()], replication=2)
+        client.create_dense_table("w", (2,), init=np.zeros(2))
+        assert client.pull_dense("w") is not None

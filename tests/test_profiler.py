@@ -53,3 +53,30 @@ def test_host_event_statistics():
     assert stats["op"]["calls"] == 2
     np.testing.assert_allclose(stats["op"]["avg"], 0.003)
     np.testing.assert_allclose(stats["op"]["max"], 0.004)
+
+
+def test_device_summary_from_xplane(tmp_path):
+    """Missing r2 #8: per-op device-time tables without XPlane spelunking
+    (reference: profiler_statistic.py device-kernel summary)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler as prof
+
+    p = prof.Profiler(targets=[prof.ProfilerTarget.CPU])
+    p._export_dir = str(tmp_path)
+    p.start()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(64, 64).astype(np.float32))
+    for _ in range(3):
+        x = paddle.matmul(x, x)
+    _ = x.numpy()
+    p.stop()
+
+    table = p.device_summary()
+    assert table, "no device ops decoded from the XPlane trace"
+    assert "total_us" in table.splitlines()[0]
+    fam = p.device_summary(by_family=True)
+    assert fam and any(k in fam for k in ("matmul", "fusion", "other"))
+    # the combined summary() includes the device table
+    out = p.summary()
+    assert "device ops" in out

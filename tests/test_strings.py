@@ -1,0 +1,38 @@
+import numpy as np
+
+def test_string_tensor_ops():
+    import paddle_tpu as paddle
+
+    st = paddle.strings.to_string_tensor([["Hello", "WORLD"], ["Grüße", "ÅØ"]])
+    assert st.shape == [2, 2]
+    lo = paddle.strings.lower(st)
+    up = paddle.strings.upper(st)
+    assert lo.tolist() == [["hello", "world"], ["grüße", "åø"]]
+    assert up.tolist() == [["HELLO", "WORLD"], ["GRÜSSE", "ÅØ"]]
+    # ascii-only mode leaves non-ascii untouched
+    lo_a = paddle.strings.lower(st, use_utf8_encoding=False)
+    assert lo_a.tolist()[1][0] == "grüße"[:2] + "üße" or lo_a.tolist()[1][0] == "grüße"
+
+def test_string_utf8_roundtrip():
+    import paddle_tpu as paddle
+
+    st = paddle.strings.to_string_tensor(["abc", "Grüße", ""])
+    codes, lens = paddle.strings.encode_utf8(st)
+    assert codes.shape[0] == 3
+    back = paddle.strings.decode_utf8(codes, lens)
+    assert back.tolist() == ["abc", "Grüße", ""]
+
+def test_strings_empty():
+    import paddle_tpu as paddle
+
+    e = paddle.strings.empty((2, 3))
+    assert e.shape == [2, 3] and e[0, 0] == ""
+
+def test_encode_truncation_respects_codepoint_boundaries():
+    import paddle_tpu as paddle
+
+    st = paddle.strings.to_string_tensor(["Grüße"])
+    codes, lens = paddle.strings.encode_utf8(st, max_bytes=3)
+    back = paddle.strings.decode_utf8(codes, lens)
+    # 'ü' is 2 bytes; a cut at 3 would split it — must back off to "Gr"
+    assert back.tolist() == ["Gr"]

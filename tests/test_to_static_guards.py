@@ -249,3 +249,42 @@ class TestSegmentCapture:
                 ref = layer(x)
                 np.testing.assert_allclose(got.numpy(), ref.numpy(),
                                            atol=1e-5)
+
+
+def test_bucketing_supports_named_kwargs():
+    """Weak r2 #9: dynamic-dim bucketing now covers keyword tensors via
+    NAMED InputSpecs."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.static import InputSpec
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x, mask=None):
+            out = self.fc(x)
+            if mask is not None:
+                out = out * mask
+            return out
+
+    model = paddle.jit.to_static(
+        Net(),
+        input_spec=[InputSpec([None, 4], "float32", name="x"),
+                    InputSpec([None, 4], "float32", name="mask")],
+        bucket_dynamic_shapes=True)
+    rng = np.random.RandomState(0)
+    outs = []
+    for n in (5, 7, 8, 6):
+        x = paddle.to_tensor(rng.randn(n, 4).astype(np.float32))
+        m = paddle.to_tensor(np.ones((n, 4), np.float32))
+        outs.append(model(x, mask=m))
+    # all lengths 5..8 share the SAME bucket-8 compilation
+    assert len(model._static._compiled) == 1, model._static._compiled.keys()
+    # unnamed tensor kwarg still raises loudly
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="NAMED InputSpec"):
+        model(paddle.to_tensor(rng.randn(4, 4).astype(np.float32)),
+              other=paddle.to_tensor(np.ones((4, 4), np.float32)))
