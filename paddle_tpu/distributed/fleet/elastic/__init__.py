@@ -15,6 +15,14 @@ drives the process side: on worker death it re-launches the survivors
 with the shrunken world size (scale-in) as long as it stays >= the
 `--nnodes lo` bound; recovery of state is checkpoint-resume, same model
 as the reference (SURVEY §5 failure detection).
+
+Why restart-based (investigated r3): IN-PROCESS mesh rebuild — survivors
+re-running `jax.distributed.initialize` with the new world — is blocked
+by jax itself: `initialize()` refuses to run once the XLA backend has
+been touched (distributed.py guard), and `jax.clear_backends()` does not
+reset that guard. Until jax supports re-initialisation, process restart
++ checkpoint resume is the only supported recovery, which is also the
+reference's model (`fleet/elastic/manager.py` restarts training).
 """
 from __future__ import annotations
 

@@ -119,19 +119,35 @@ class PipelineLayer(Layer):
                 x = layer(x)
         return x
 
-    def _segments_uniform(self, x):
-        """True when the compiled ring schedule can serve this layer: every
-        stage maps the activation to the same aval AND no stage mutates a
-        buffer (the schedule's scan cannot thread per-tick buffer writes
-        back out — BatchNorm-style layers take the straight-line path)."""
+    def _segments_uniform(self, x, n_micro):
+        """Pipeline-compatibility probe. The compiled ring needs every
+        INTER-STAGE boundary aval identical (the rotating carry is one
+        SPMD value) — but stage 0's INPUT and the last stage's OUTPUT may
+        differ freely: branch 0 of the lax.switch consumes the raw input
+        (e.g. token ids), and only the last branch fills the (separately
+        typed) output buffer. That serves the real embed->blocks->head
+        shape. Also rejects buffer-mutating stages (the scan cannot
+        thread per-tick buffer writes back out).
+
+        Probes at MICROBATCH granularity (leading dim / n_micro) so the
+        returned avals are exactly the ring's carry/output types — stages
+        that fold the batch axis into another dim stay consistent, and a
+        later call with a different input shape re-probes instead of
+        reusing stale avals. Returns (mid_aval, out_aval) when
+        pipelinable, None otherwise; cached per (input aval, n_micro)."""
         import jax
 
         from ...core.tensor import Tensor
 
-        if self._uniform_cache is not None:
-            return self._uniform_cache
+        key = (tuple(x.shape), str(x._data.dtype), n_micro)
+        if self._uniform_cache is None:
+            self._uniform_cache = {}
+        if key in self._uniform_cache:
+            return self._uniform_cache[key] or None
         try:
-            aval = jax.ShapeDtypeStruct(tuple(x.shape), x._data.dtype)
+            aval = jax.ShapeDtypeStruct(
+                (x.shape[0] // n_micro,) + tuple(x.shape[1:]),
+                x._data.dtype)
             state = self.state_dict()
             names = sorted(state)
             state_avals = [
@@ -139,16 +155,22 @@ class PipelineLayer(Layer):
                                      state[n]._data.dtype) for n in names]
             # every probe runs under _swap_state so a stage that writes its
             # buffers only ever touches trace-local tracers (restored on exit)
+            cur = aval
+            boundary = []           # aval AFTER stage s, s = 0..n-1
             for s in range(self._num_stages):
                 def seg_probe(flat, a, s=s):
                     with self._swap_state(dict(zip(names, flat))):
                         return self._run_segment(s, Tensor(a))._data
 
-                out = jax.eval_shape(seg_probe, state_avals, aval)
-                if (tuple(out.shape) != tuple(aval.shape)
-                        or out.dtype != aval.dtype):
-                    self._uniform_cache = False
-                    return False
+                cur = jax.eval_shape(seg_probe, state_avals, cur)
+                boundary.append(
+                    jax.ShapeDtypeStruct(tuple(cur.shape), cur.dtype))
+            mids = boundary[:-1]    # the rotating-carry avals
+            if mids and any((tuple(m.shape), m.dtype)
+                            != (tuple(mids[0].shape), mids[0].dtype)
+                            for m in mids):
+                self._uniform_cache[key] = False
+                return None
 
             # buffer-mutation probe: run the whole forward once abstractly
             # and see whether any state entry was reassigned
@@ -165,19 +187,24 @@ class PipelineLayer(Layer):
                 return t._data
 
             jax.eval_shape(probe, state_avals, aval)
-            self._uniform_cache = not flag[0]
-            return self._uniform_cache
+            if flag[0]:
+                self._uniform_cache[key] = False
+                return None
+            mid = mids[0] if mids else boundary[-1]
+            self._uniform_cache[key] = (mid, boundary[-1])
+            return self._uniform_cache[key]
         except Exception:
-            self._uniform_cache = False
-            return False
+            self._uniform_cache[key] = False
+            return None
 
     def forward(self, x):
         mesh, pp = self._mesh_pp()
         n_micro = self._num_micro or pp
-        if (pp > 1 and self._num_stages == pp
-                and n_micro >= pp and x.shape[0] % n_micro == 0
-                and self._segments_uniform(x)):
-            return self._forward_pipelined(x, mesh, pp)
+        avals = (self._segments_uniform(x, n_micro)
+                 if (pp > 1 and self._num_stages == pp and n_micro >= pp
+                     and x.shape[0] % n_micro == 0) else None)
+        if avals:
+            return self._forward_pipelined(x, mesh, pp, *avals)
         for s in range(self._num_stages):
             x = self._run_segment(s, x)
         return x
@@ -240,8 +267,14 @@ class PipelineLayer(Layer):
                 t._data, NamedSharding(mesh.jax_mesh, P(*spec)))
         return self
 
-    def _forward_pipelined(self, x, mesh, pp):
-        """Compiled ring schedule for arbitrary (shape-uniform) stages.
+    def _forward_pipelined(self, x, mesh, pp, mid_aval, out_aval):
+        """Compiled ring schedule for arbitrary stages with uniform
+        INTER-STAGE avals; stage 0's input type (token ids) and the last
+        stage's output type (logits) may differ — branch 0 of the switch
+        consumes the raw microbatch and every branch returns a
+        (mid_carry, final_out) pair of which exactly one is real, so the
+        rotating carry stays one SPMD type while the embed->blocks->head
+        pattern pipelines (round-2 Weak #4).
 
         Heterogeneous stage programs are selected per device with
         ``lax.switch`` on the pp axis index. Stage-owned parameters are
@@ -258,7 +291,7 @@ class PipelineLayer(Layer):
         from jax.sharding import PartitionSpec as P
 
         from ...core.tensor import Tensor
-        from ..pipeline import microbatch, pipeline_schedule, unmicrobatch
+        from ..pipeline import microbatch, unmicrobatch
 
         state = self.state_dict()
         names = sorted(state)
@@ -307,13 +340,16 @@ class PipelineLayer(Layer):
         flat_all = {n: state[n]._data for n in names}
         shared_flat = [flat_all[n] for n in shared_names]
 
+        mid_mb, out_mb = mid_aval, out_aval   # probe returns mb-sized
+
         def body(packed, shared, x_mb):
             # shared params consumed by several branches: pcast-varying so
             # the switch transpose psums their cotangents home
             shared = [jax.lax.pcast(a, "pp", to="varying") for a in shared]
+            idx = jax.lax.axis_index("pp")
 
             def make_branch(s):
-                def branch(packed_local, shared_ops, a):
+                def branch(packed_local, shared_ops, x_in, state):
                     params = {}
                     for dt in dtypes:
                         row = packed_local[dt][0]      # local [1, L] row
@@ -321,18 +357,33 @@ class PipelineLayer(Layer):
                             params[n] = jax.lax.dynamic_slice_in_dim(
                                 row, off, size).reshape(shape)
                     params.update(zip(shared_names, shared_ops))
+                    seg_in = x_in if s == 0 else state
                     with self._swap_state(params):
-                        return self._run_segment(s, Tensor(a))._data
+                        out = self._run_segment(s, Tensor(seg_in))._data
+                    # exactly one of (mid, final) is real per branch; the
+                    # placeholder zeros must carry the same pp-varying
+                    # annotation as the real outputs (shard_map vma)
+                    if s == pp - 1:
+                        z = jax.lax.pcast(
+                            jnp.zeros(mid_mb.shape, mid_mb.dtype),
+                            "pp", to="varying")
+                        return (z, out)
+                    z = jax.lax.pcast(
+                        jnp.zeros(out_mb.shape, out_mb.dtype),
+                        "pp", to="varying")
+                    return (out, z)
                 return branch
 
             branches = [make_branch(s) for s in range(pp)]
 
-            def stage_fn(a):
-                idx = jax.lax.axis_index("pp")
-                return jax.lax.switch(idx, branches, packed, tuple(shared),
-                                      a)
+            def stage_fn2(x_in, state):
+                return jax.lax.switch(
+                    idx, branches, packed, tuple(shared), x_in, state)
 
-            return pipeline_schedule(stage_fn, x_mb, pp)
+            from ..pipeline import pipeline_schedule_hetero
+
+            return pipeline_schedule_hetero(
+                stage_fn2, x_mb, pp, mid_mb, out_mb)
 
         out = jax.shard_map(
             body, mesh=mesh.jax_mesh,

@@ -25,12 +25,24 @@ from jax.sharding import PartitionSpec
 P = PartitionSpec
 
 
-def pipeline_schedule(stage_fn, x_mb, n_stages, axis_name="pp"):
-    """Run inside shard_map over `axis_name`.
+def pipeline_schedule_hetero(stage_fn2, x_mb, n_stages, mid_aval, out_aval,
+                             axis_name="pp"):
+    """The generalised compiled ring, run inside shard_map over
+    `axis_name`: stage 0's input type and the LAST stage's output type may
+    differ from the rotating carry.
 
-    stage_fn: activation -> activation (this device's layer shard applied).
-    x_mb: [n_micro, ...] microbatched stage-0 input (replicated over pp).
-    Returns [n_micro, ...] last-stage outputs, replicated over pp.
+    stage_fn2(x_in, state) -> (mid, final): consumes the raw microbatch
+    on stage 0 and the rotated carry elsewhere (the callee selects — with
+    a lax.switch over stages, branch 0 simply uses x_in); returns the
+    carry to rotate (`mid`, aval `mid_aval`) and the final output
+    (`final`, aval `out_aval`, real only on the last stage).
+
+    Cost note: the final-output buffer lives (zero-filled) on every pp
+    device and the closing psum replicates it — (pp-1)/pp of that
+    traffic moves zeros. For a vocab-sized head output this is the
+    dominant ring cost at large pp; if the caller can consume a
+    last-stage-sharded result instead of a replicated one, emit with a
+    sharded out_spec and skip the psum (docs/ROUND4_IDEAS.md).
 
     Schedule: n_micro + n_stages - 1 ticks. Tick t: stage 0 ingests
     microbatch t, stage s processes the activation that entered at tick
@@ -41,31 +53,26 @@ def pipeline_schedule(stage_fn, x_mb, n_stages, axis_name="pp"):
     total = n_micro + n_stages - 1
     perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
 
-    out_aval = jax.eval_shape(
-        lambda x: stage_fn(jax.lax.pcast(x, axis_name, to="varying")),
-        jax.ShapeDtypeStruct(x_mb.shape[1:], x_mb.dtype),
-    )
-    state0 = jax.lax.pcast(
-        jnp.zeros(out_aval.shape, out_aval.dtype), axis_name, to="varying"
-    )
-    out_buf0 = jax.lax.pcast(
-        jnp.zeros((n_micro,) + tuple(out_aval.shape), out_aval.dtype),
-        axis_name, to="varying",
-    )
+    def _z(aval, extra=()):
+        return jax.lax.pcast(
+            jnp.zeros(tuple(extra) + tuple(aval.shape), aval.dtype),
+            axis_name, to="varying")
+
+    state0 = _z(mid_aval)
+    out_buf0 = _z(out_aval, (n_micro,))
 
     def tick(carry, t):
         state, out_buf = carry
         mb_idx = jnp.clip(t, 0, n_micro - 1)
         x_in = jax.lax.dynamic_index_in_dim(x_mb, mb_idx, 0, keepdims=False)
-        inp = jnp.where(idx == 0, x_in, state)
-        out = stage_fn(inp)
+        mid, fin = stage_fn2(x_in, state)
         o_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         valid = (t >= n_stages - 1) & (idx == n_stages - 1)
         cur = jax.lax.dynamic_index_in_dim(out_buf, o_idx, 0, keepdims=False)
         out_buf = jax.lax.dynamic_update_index_in_dim(
-            out_buf, jnp.where(valid, out, cur), o_idx, 0
+            out_buf, jnp.where(valid, fin, cur), o_idx, 0
         )
-        state = jax.lax.ppermute(out, axis_name, perm)
+        state = jax.lax.ppermute(mid, axis_name, perm)
         return (state, out_buf), None
 
     (state, out_buf), _ = jax.lax.scan(tick, (state0, out_buf0), jnp.arange(total))
@@ -73,6 +80,24 @@ def pipeline_schedule(stage_fn, x_mb, n_stages, axis_name="pp"):
         jnp.where(idx == n_stages - 1, out_buf, jnp.zeros_like(out_buf)),
         axis_name,
     )
+
+
+def pipeline_schedule(stage_fn, x_mb, n_stages, axis_name="pp"):
+    """Uniform-aval ring (stage_fn: activation -> activation) — a thin
+    wrapper over `pipeline_schedule_hetero` where input, carry and output
+    share one aval."""
+    idx = jax.lax.axis_index(axis_name)
+    out_aval = jax.eval_shape(
+        lambda x: stage_fn(jax.lax.pcast(x, axis_name, to="varying")),
+        jax.ShapeDtypeStruct(x_mb.shape[1:], x_mb.dtype),
+    )
+
+    def stage_fn2(x_in, state):
+        out = stage_fn(jnp.where(idx == 0, x_in, state))
+        return out, out
+
+    return pipeline_schedule_hetero(stage_fn2, x_mb, n_stages,
+                                    out_aval, out_aval, axis_name)
 
 
 def spmd_pipeline(stage_fn, mesh, n_stages, axis_name="pp",

@@ -160,6 +160,66 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
     return apply_op(_fl, x, weight, bias, _op_name="fused_linear")
 
 
+def _quantize_rows_int8(a):
+    """Per-row absmax int8 quantisation: a [R, H] -> (q int8, scale [R,1])."""
+    s = jnp.maximum(jnp.max(jnp.abs(a.astype(jnp.float32)), -1,
+                            keepdims=True) / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(a.astype(jnp.float32) / s),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
+
+@jax.custom_vjp
+def _int8_head_core(hc, w2, qw, sw):
+    """int8 x int8 LM-head matmul: per-token-row scales on h, per-vocab-
+    row scales on w — on int8-capable MXUs (v5e: 2x the bf16 rate) this
+    halves the head's forward cost. VERDICT r3 slot: the optional int8
+    weight-only LM-head, behind PTPU_INT8_HEAD with a parity test.
+
+    The weight quantisation (qw, sw) is computed ONCE by the caller and
+    passed in — re-quantising the [V, H] matrix inside every CE chunk
+    (and again in each chunk's checkpointed backward) was a measured
+    share of the flag's regression. ``w2`` rides along only so the
+    straight-through backward can use the REAL weights."""
+    qh, sh = _quantize_rows_int8(hc)
+    acc = jnp.einsum("ch,vh->cv", qh, qw,
+                     preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * sh * sw.T
+
+
+def _int8_head_fwd(hc, w2, qw, sw):
+    return _int8_head_core(hc, w2, qw, sw), (hc, w2)
+
+
+def _int8_head_bwd(res, g):
+    # wide backward: the quantised forward approximates the loss surface,
+    # but gradients flow through the REAL weights (straight-through) —
+    # the standard weight-quantised-training recipe
+    import numpy as _np
+
+    hc, w2 = res
+    gf = g.astype(jnp.float32)
+    dh = (gf @ w2.astype(jnp.float32)).astype(hc.dtype)
+    dw = jnp.einsum("cv,ch->vh", gf,
+                    hc.astype(jnp.float32)).astype(w2.dtype)
+    # the quantised operands are derived values: int8 qw gets the float0
+    # cotangent integers require; sw gets zeros (w2's dw is the real
+    # grad). Shapes derive from w2 — qw matches it, sw is [V, 1] f32.
+    dqw = _np.zeros(w2.shape, jax.dtypes.float0)
+    dsw = jnp.zeros((w2.shape[0], 1), jnp.float32)
+    return dh, dw, dqw, dsw
+
+
+_int8_head_core.defvjp(_int8_head_fwd, _int8_head_bwd)
+
+
+def _int8_head_logits(hc, w, transpose_y, qw=None, sw=None):
+    w2 = w if transpose_y else w.T          # [V, H]
+    if qw is None:
+        qw, sw = _quantize_rows_int8(w2)
+    return _int8_head_core(hc, w2, qw, sw)
+
+
 def fused_linear_cross_entropy(x, weight, labels, transpose_y=True,
                                chunk_size=512, ignore_index=-100, name=None):
     """LM-head matmul + softmax cross entropy WITHOUT materializing the
@@ -194,11 +254,22 @@ def fused_linear_cross_entropy(x, weight, labels, transpose_y=True,
         ms = valid.astype(jnp.float32).reshape(-1, c)
 
         spec = "ch,vh->cv" if transpose_y else "ch,hv->cv"
+        int8_head = bool(_os.environ.get("PTPU_INT8_HEAD"))
+        if int8_head:
+            # quantise the [V, H] weight ONCE for all chunks (and their
+            # checkpointed backward recomputes)
+            w2_full = w if transpose_y else w.T
+            qw_full, sw_full = _quantize_rows_int8(
+                jax.lax.stop_gradient(w2_full))
 
         def chunk_fn(args):
             hc, yc, mc = args
-            logits = jnp.einsum(spec, hc, w,
-                                preferred_element_type=jnp.float32)
+            if int8_head:
+                logits = _int8_head_logits(hc, w, transpose_y,
+                                           qw=qw_full, sw=sw_full)
+            else:
+                logits = jnp.einsum(spec, hc, w,
+                                    preferred_element_type=jnp.float32)
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
             gold = jnp.take_along_axis(logits, yc[:, None], axis=-1)[:, 0]
             return ((lse - gold) * mc).sum()

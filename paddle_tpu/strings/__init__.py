@@ -120,8 +120,11 @@ def encode_utf8(x, max_bytes=None, pad=0):
             Tensor(jnp.asarray(lens.reshape(t._data.shape))))
 
 
-def decode_utf8(codes, lengths=None):
-    """(uint8 tensor [*, W], lengths) -> StringTensor (inverse bridge)."""
+def decode_utf8(codes, lengths=None, pad=0):
+    """(uint8 tensor [*, W], lengths) -> StringTensor (inverse bridge).
+
+    Without ``lengths``, trailing ``pad`` bytes are stripped — rows
+    shorter than the widest would otherwise come back NUL-polluted."""
     from ..core.tensor import Tensor
 
     arr = np.asarray(codes._data if isinstance(codes, Tensor) else codes,
@@ -134,7 +137,10 @@ def decode_utf8(codes, lengths=None):
     flat = arr.reshape(-1, arr.shape[-1])
     out = []
     for i, row in enumerate(flat):
-        n = int(lens[i]) if lens is not None else len(row)
-        out.append(bytes(row[:n]).decode("utf-8", "replace"))
+        if lens is not None:
+            b = bytes(row[: int(lens[i])])
+        else:
+            b = bytes(row).rstrip(bytes([pad]))
+        out.append(b.decode("utf-8", "replace"))
     return StringTensor(
         np.asarray(out, object).reshape(arr.shape[:-1]))
