@@ -204,6 +204,7 @@ from . import sysconfig  # noqa: F401
 from . import hub  # noqa: F401
 from . import api_tracer  # noqa: F401
 from . import cost_model  # noqa: F401
+from . import ir  # noqa: F401
 from . import tensorrt  # noqa: F401
 
 __version__ = version.full_version

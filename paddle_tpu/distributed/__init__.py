@@ -571,3 +571,5 @@ def all_gather_into_tensor(output, input, group=None, sync_op=True):
 
 from . import passes  # noqa: F401,E402
 from . import sharding  # noqa: F401,E402
+
+from . import op_cost  # noqa: F401  (per-op cost + planner)

@@ -1,0 +1,127 @@
+"""Per-op cost model + placement planner (parity slot: auto_parallel
+static/cost per-op classes + static/tuner planner — VERDICT r2 Missing #5)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.distributed.op_cost import (OpCostModel, jaxpr_op_costs,
+                                            plan_matmul_shardings)
+
+
+def test_dot_flops_exact():
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((64, 128), jnp.float32)
+    b = jnp.ones((128, 32), jnp.float32)
+    rows, totals = jaxpr_op_costs(f, a, b)
+    dots = [r for r in rows if r["prim"] == "dot_general"]
+    assert len(dots) == 1
+    assert dots[0]["flops"] == 2 * 64 * 128 * 32
+    assert totals["flops"] >= dots[0]["flops"]
+
+
+def test_scan_multiplies_body_cost():
+    def f(x, w):
+        def step(c, _):
+            return jnp.tanh(c @ w), None
+        out, _ = jax.lax.scan(step, x, None, length=5)
+        return out
+
+    x = jnp.ones((16, 16), jnp.float32)
+    w = jnp.ones((16, 16), jnp.float32)
+    rows, totals = jaxpr_op_costs(f, x, w)
+    # 5 iterations x (2*16^3 matmul flops) folded into the scan row
+    assert totals["flops"] >= 5 * 2 * 16 ** 3
+
+
+def test_conv_flops_formula():
+    def f(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    x = jnp.ones((1, 8, 8, 4), jnp.float32)
+    w = jnp.ones((3, 3, 4, 16), jnp.float32)
+    rows, _ = jaxpr_op_costs(f, x, w)
+    conv = [r for r in rows if r["prim"] == "conv_general_dilated"][0]
+    assert conv["flops"] == 2 * (1 * 8 * 8 * 16) * (3 * 3) * 4
+
+
+class TestPlanner:
+    def test_row_sharded_inputs_prefer_split_m(self):
+        # lhs already row-split: split_m has zero reshard cost and must win
+        def f(a, b):
+            return a @ b
+
+        a = jnp.ones((4096, 4096), jnp.bfloat16)
+        b = jnp.ones((4096, 4096), jnp.bfloat16)
+        (plan,) = plan_matmul_shardings(f, a, b, axis_size=8,
+                                        in_sharded="rows")
+        assert plan.choice == "split_m", plan.est_ms
+        # and every parallel choice beats full replication
+        assert plan.est_ms["split_m"] < plan.est_ms["replicate"]
+
+    def test_replicated_inputs_prefer_weight_split(self):
+        # replicated activations: split_n shards only the (already-placed)
+        # weight -> no reshard, no collective; split_m would move the lhs
+        def f(a, b):
+            return a @ b
+
+        a = jnp.ones((4096, 4096), jnp.bfloat16)
+        b = jnp.ones((4096, 4096), jnp.bfloat16)
+        (plan,) = plan_matmul_shardings(f, a, b, axis_size=8,
+                                        in_sharded="replicated")
+        assert plan.choice in ("split_n", "split_k"), plan.est_ms
+        assert plan.est_ms["split_n"] <= plan.est_ms["split_m"]
+
+    def test_every_dot_gets_a_plan(self):
+        def f(x, w1, w2):
+            return jnp.tanh(x @ w1) @ w2
+
+        x = jnp.ones((128, 256), jnp.float32)
+        w1 = jnp.ones((256, 512), jnp.float32)
+        w2 = jnp.ones((512, 64), jnp.float32)
+        plans = plan_matmul_shardings(f, x, w1, w2, axis_size=4)
+        assert len(plans) == 2
+        assert {p.m for p in plans} == {128}
+        assert all(set(p.est_ms) == {"split_m", "split_n", "split_k",
+                                     "replicate"} for p in plans)
+
+
+def test_cost_model_roofline():
+    m = OpCostModel(peak_tflops=100.0, hbm_gbps=1000.0)
+    # compute-bound: 1e12 flops over tiny bytes -> 0.01s
+    assert abs(m.eqn_seconds(1e12, 1e6) - 0.01) < 1e-6
+    # bandwidth-bound: 1e9 flops over 1e10 bytes -> 0.01s
+    assert abs(m.eqn_seconds(1e9, 1e10) - 0.01) < 1e-6
+
+
+def test_remat_and_jit_bodies_are_costed():
+    # code-review r3: jax 0.9 names these eqns "remat2" / "jit"
+    def body(x):
+        return jnp.sin(x) @ x
+
+    x = jnp.ones((64, 64), jnp.float32)
+    _, t_plain = jaxpr_op_costs(body, x)
+    _, t_remat = jaxpr_op_costs(jax.checkpoint(body), x)
+    _, t_jit = jaxpr_op_costs(jax.jit(body), x)
+    assert t_remat["flops"] >= t_plain["flops"] > 2 * 64 ** 3 - 1
+    assert t_jit["flops"] == t_plain["flops"]
+
+
+def test_planner_counts_batch_dims():
+    # code-review r3: batched dot_generals must include b in flops/psum
+    def f(a, b):
+        return jnp.einsum("bmk,bkn->bmn", a, b)
+
+    a = jnp.ones((32, 64, 128), jnp.float32)
+    b = jnp.ones((32, 128, 16), jnp.float32)
+    (plan,) = plan_matmul_shardings(f, a, b, axis_size=4)
+    rows, totals = jaxpr_op_costs(f, a, b)
+    want = 2 * 32 * 64 * 128 * 16
+    assert totals["flops"] >= want
+    # replicate estimate must reflect the full batched compute: at the
+    # model's peak it is >= want / peak seconds
+    m = OpCostModel()
+    assert plan.est_ms["replicate"] >= want / (m.peak_tflops * 1e12) * 1e3
