@@ -61,7 +61,8 @@ class PagePool:
 
 class _Request:
     __slots__ = ("rid", "prompt", "generated", "length", "pages",
-                 "temperature", "top_k", "top_p", "on_token")
+                 "temperature", "top_k", "top_p", "on_token",
+                 "prefill_pos")
 
     def __init__(self, rid, prompt, temperature=0.0, top_k=0, top_p=1.0,
                  on_token=None):
@@ -74,6 +75,7 @@ class _Request:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.on_token = on_token
+        self.prefill_pos = 0     # tokens already written to kv (chunked)
 
 
 def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
@@ -104,7 +106,7 @@ def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
 class ContinuousBatchingEngine:
     def __init__(self, model, max_slots=4, page_size=64, num_pages=None,
                  max_seq_len=None, max_new_tokens=32, eos_token_id=None,
-                 seed=0):
+                 seed=0, prefill_chunk=None):
         import jax
         import jax.numpy as jnp
 
@@ -143,6 +145,14 @@ class ContinuousBatchingEngine:
         self._decode_jit = jax.jit(self._decode_step, donate_argnums=(4, 5),
                                    static_argnums=(10,))
         self.prefill_batches = 0      # observability: admission group count
+        # chunked prefill (vLLM-style): admit immediately, write the
+        # prompt's KV `prefill_chunk` tokens per TICK so long prompts
+        # don't stall the decode latency of running requests
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
+        self.prefills_completed = 0   # per-request (both prefill modes)
 
     @staticmethod
     def _pack_weights(model):
@@ -171,6 +181,43 @@ class ContinuousBatchingEngine:
 
         return _rope_at_positions(x, pos)
 
+    def _layer_forward(self, li, lp, x, pos0, attend):
+        """One decoder layer of the EAGER prefill paths: projections +
+        rope + `attend(li, q, k, v)` (which owns cache writes and the
+        attention math) + MLP. Shared by group and chunked prefill so
+        their numerics can never diverge."""
+        jax, jnp = self._jax, self._jnp
+        from ..models.gpt import _rms_pure
+
+        ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
+        B, S = x.shape[:2]
+        h = _rms_pure(x, ln1)
+        q = (h @ wq).reshape(B, S, self.cfg.num_heads, self.hd)
+        k = (h @ wk).reshape(B, S, self.hkv, self.hd)
+        v = (h @ wv).reshape(B, S, self.hkv, self.hd)
+        q, k = self._rope(q, pos0), self._rope(k, pos0)
+        o = attend(li, q, k, v)                       # [B, S, Hq, D]
+        x = x + o.reshape(B, S, -1) @ wo
+        h2 = _rms_pure(x, ln2)
+        return x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+
+    def _head_tokens(self, last, reqs):
+        """final-norm'd last hidden rows [B, H] -> first token per req."""
+        jax, jnp = self._jax, self._jnp
+        w = self._weights
+        lg = (last @ w["head"] if w["head"] is not None
+              else last @ w["embed"].T)
+        self._key, sub = jax.random.split(self._key)
+        if any(r.temperature > 0.0 for r in reqs):
+            toks = _sample_rows(
+                jax, jnp, lg,
+                jnp.asarray([r.temperature for r in reqs], jnp.float32),
+                jnp.asarray([r.top_k for r in reqs], jnp.int32),
+                jnp.asarray([r.top_p for r in reqs], jnp.float32), sub)
+        else:
+            toks = jnp.argmax(lg.astype(jnp.float32), -1)
+        return [int(t) for t in np.asarray(toks)]
+
     def _prefill_group(self, reqs):
         """Run ALL newly admitted prompts as ONE padded batch: write each
         prompt's KV into its pages, return the first generated token per
@@ -185,6 +232,7 @@ class ContinuousBatchingEngine:
         from ..models.gpt import _rms_pure
 
         self.prefill_batches += 1
+        self.prefills_completed += len(reqs)
         w = self._weights
         B = len(reqs)
         lens = np.asarray([len(r.prompt) for r in reqs])
@@ -208,13 +256,7 @@ class ContinuousBatchingEngine:
         offs = jnp.asarray(poss % self.page)
         rows_j, poss_j = jnp.asarray(rows), jnp.asarray(poss)
 
-        for li, lp in enumerate(w["layers"]):
-            ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
-            h = _rms_pure(x, ln1)
-            q = (h @ wq).reshape(B, S, self.cfg.num_heads, self.hd)
-            k = (h @ wk).reshape(B, S, self.hkv, self.hd)
-            v = (h @ wv).reshape(B, S, self.hkv, self.hd)
-            q, k = self._rope(q, pos0), self._rope(k, pos0)
+        def attend(li, q, k, v):
             ck = jnp.repeat(k, rep, 2) if rep > 1 else k
             cv = jnp.repeat(v, rep, 2) if rep > 1 else v
             logits = jnp.einsum("bthd,bshd->bhts",
@@ -223,10 +265,7 @@ class ContinuousBatchingEngine:
             logits = jnp.where(mask[None, None], logits, -1e30)
             probs = jax.nn.softmax(logits, -1)
             o = jnp.einsum("bhts,bshd->bthd", probs,
-                           cv.astype(jnp.float32)).astype(x.dtype)
-            x = x + o.reshape(B, S, -1) @ wo
-            h2 = _rms_pure(x, ln2)
-            x = x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+                           cv.astype(jnp.float32)).astype(q.dtype)
             # scatter the group's valid k/v into the owned pages; ADJACENT
             # advanced indices (axes 1,2) stay in place -> [Hkv, N, D]
             kvals = jnp.swapaxes(k[rows_j, poss_j], 0, 1)
@@ -235,23 +274,16 @@ class ContinuousBatchingEngine:
                 kvals.astype(self.kc[li].dtype))
             self.vc[li] = self.vc[li].at[:, tok_pages, offs, :].set(
                 vvals.astype(self.vc[li].dtype))
+            return o
+
+        for li, lp in enumerate(w["layers"]):
+            x = self._layer_forward(li, lp, x, pos0, attend)
         x = _rms_pure(x, w["fnorm"])
         last = x[jnp.arange(B), jnp.asarray(lens - 1)]       # [B, H]
-        lg = (last @ w["head"] if w["head"] is not None
-              else last @ w["embed"].T)
-        self._key, sub = jax.random.split(self._key)
-        if any(r.temperature > 0.0 for r in reqs):
-            toks = _sample_rows(
-                jax, jnp, lg,
-                jnp.asarray([r.temperature for r in reqs], jnp.float32),
-                jnp.asarray([r.top_k for r in reqs], jnp.int32),
-                jnp.asarray([r.top_p for r in reqs], jnp.float32), sub)
-        else:
-            toks = jnp.argmax(lg.astype(jnp.float32), -1)
-        toks = np.asarray(toks)
+        toks = self._head_tokens(last, reqs)
         for i, r in enumerate(reqs):
             r.length = int(lens[i])
-        return [int(t) for t in toks]
+        return toks
 
     def _decode_step(self, weights, tokens, lens, tables, kc, vc,
                      temps, top_ks, top_ps, key, do_sample=False):
@@ -298,6 +330,9 @@ class ContinuousBatchingEngine:
         """Queue a request. ``temperature=0`` decodes greedily; otherwise
         softmax sampling with optional top_k / top_p truncation.
         ``on_token(rid, token_id)`` streams each generated token."""
+        if len(prompt_ids) == 0:
+            raise ValueError("empty prompt: a request needs at least one "
+                             "token to prefill")
         total = len(prompt_ids) + self.max_new_tokens
         if total > self.max_seq:
             raise ValueError(
@@ -335,10 +370,84 @@ class ContinuousBatchingEngine:
             req.pages = self.pool.alloc(need)
             self._slots[i] = req
             group.append(req)
-        if group:
+        if not group:
+            return
+        if self.prefill_chunk is None:
             first = self._prefill_group(group)
             for req, tok in zip(group, first):
                 self._emit(req, tok)
+        # chunked mode: KV fills incrementally in step()
+
+    def _prefill_tick(self):
+        """Chunked prefill: advance ONE prefilling request by up to
+        `prefill_chunk` prompt tokens (writing their KV into its pages),
+        so running requests keep decoding every tick while long prompts
+        fill incrementally (the reference serving stack's chunked-prefill
+        /mixed-batch scheduling over block_multihead_attention)."""
+        jax, jnp = self._jax, self._jnp
+        from ..models.gpt import _rms_pure
+
+        req = next((r for r in self._slots
+                    if r is not None and r.prefill_pos < len(r.prompt)),
+                   None)
+        if req is None:
+            return
+        w = self._weights
+        pos = req.prefill_pos
+        c = min(self.prefill_chunk, len(req.prompt) - pos)
+        ids = jnp.asarray(np.asarray(req.prompt[pos:pos + c])[None, :])
+        x = w["embed"][ids]                                  # [1, c, H]
+        pos0 = jnp.full((1,), pos, jnp.int32)
+        scale = 1.0 / math.sqrt(self.hd)
+        rep = self.cfg.num_heads // self.hkv
+        total = pos + c
+        # chunk rows attend to [cached prefix + chunk] causally
+        rows = jax.lax.broadcasted_iota(jnp.int32, (c, total), 0) + pos
+        cols = jax.lax.broadcasted_iota(jnp.int32, (c, total), 1)
+        mask = cols <= rows
+
+        page_ids_np = np.asarray(req.pages, np.int64)
+        tok_pages = jnp.asarray(page_ids_np[np.arange(pos, total)
+                                            // self.page])
+        offs = jnp.asarray(np.arange(pos, total) % self.page)
+        n_hist_pages = (total + self.page - 1) // self.page
+        hist_pages = jnp.asarray(page_ids_np[:n_hist_pages])
+
+        def attend(li, q, k, v):
+            # write the chunk's kv FIRST, then gather the full prefix back
+            # (keeps one source of truth for the attention operands)
+            self.kc[li] = self.kc[li].at[:, tok_pages, offs, :].set(
+                jnp.swapaxes(k[0], 0, 1).astype(self.kc[li].dtype))
+            self.vc[li] = self.vc[li].at[:, tok_pages, offs, :].set(
+                jnp.swapaxes(v[0], 0, 1).astype(self.vc[li].dtype))
+            # cached keys/values for this request: [Hkv, total, D]
+            ck = self.kc[li][:, hist_pages].reshape(
+                self.hkv, -1, self.hd)[:, :total]
+            cv = self.vc[li][:, hist_pages].reshape(
+                self.hkv, -1, self.hd)[:, :total]
+            if rep > 1:
+                ck = jnp.repeat(ck, rep, 0)
+                cv = jnp.repeat(cv, rep, 0)
+            logits = jnp.einsum(
+                "hcd,htd->hct",
+                jnp.swapaxes(q[0] * scale, 0, 1).astype(jnp.float32),
+                ck.astype(jnp.float32))
+            logits = jnp.where(mask[None], logits, -1e30)
+            probs = jax.nn.softmax(logits, -1)
+            o = jnp.einsum("hct,htd->chd", probs,
+                           cv.astype(jnp.float32)).astype(q.dtype)
+            return o[None]                              # [1, c, Hq, D]
+
+        for li, lp in enumerate(w["layers"]):
+            x = self._layer_forward(li, lp, x, pos0, attend)
+
+        req.prefill_pos = total
+        if req.prefill_pos == len(req.prompt):
+            self.prefills_completed += 1
+            last = _rms_pure(x, w["fnorm"])[:, -1]
+            (tok,) = self._head_tokens(last, [req])
+            req.length = len(req.prompt)
+            self._emit(req, tok)
 
     def _retire(self, req: _Request):
         self.pool.free(req.pages)
@@ -360,7 +469,10 @@ class ContinuousBatchingEngine:
                 newly[r.rid] = self._retire(r)
                 self._slots[i] = None
         self._admit()
-        live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
+        if self.prefill_chunk is not None:
+            self._prefill_tick()
+        live = [(i, r) for i, r in enumerate(self._slots)
+                if r is not None and r.generated]
         if not live:
             return newly
         # fixed-width batch: pad with slot 0's state (results discarded)
