@@ -192,6 +192,7 @@ from . import quantization  # noqa: F401
 from . import autograd  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 from .framework_io import save, load  # noqa: F401
+from .framework_io import async_save, clear_async_save_task_queue  # noqa: F401
 from .ops.compat import to_dlpack, from_dlpack  # noqa: F401
 from .distributed.data_parallel import DataParallel  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401

@@ -89,3 +89,43 @@ def load(path, return_numpy=False, **configs):
         with open(str(path), "rb") as f:
             obj = pickle.load(f)
     return _decode(obj, return_numpy=return_numpy)
+
+
+# -- async save (parity: framework/io.py:94 async_save — serialization
+# offloaded to a background worker so the train loop isn't blocked on
+# host pickling/IO; device->host copies happen on the caller thread to
+# keep a consistent snapshot) ------------------------------------------
+_ASYNC_TASKS = []
+
+
+def async_save(obj, path, protocol=4, sync_other_task=False, **configs):
+    """paddle.async_save: snapshot now (device->host copy), pickle+write
+    in a background thread. Call `clear_async_save_task_queue()` (or the
+    next async_save with sync_other_task=True) to join outstanding
+    writes before relying on the files."""
+    import threading
+
+    if sync_other_task:
+        clear_async_save_task_queue()
+    snapshot = _encode(obj)   # materialise host copies on THIS thread
+
+    def _write():
+        if hasattr(path, "write"):
+            pickle.dump(snapshot, path, protocol=protocol)
+            return
+        p = str(path)
+        d = os.path.dirname(p)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(p, "wb") as f:
+            pickle.dump(snapshot, f, protocol=protocol)
+
+    t = threading.Thread(target=_write, daemon=True)
+    t.start()
+    _ASYNC_TASKS.append(t)
+
+
+def clear_async_save_task_queue():
+    """Join every outstanding async_save writer (framework/io.py parity)."""
+    while _ASYNC_TASKS:
+        _ASYNC_TASKS.pop().join()
