@@ -75,6 +75,17 @@ class GradNode:
         return f"<GradNode {self.name} n_out={len(self.out_avals)}>"
 
 
+def _cast_leaf(a, target):
+    """AMP leaf-cast rule, shared by eager autocast and segment capture
+    (jit/lazy._amp_cast_wrap): cast float arrays to ``target``; pass
+    through non-arrays, non-floats and float64."""
+    if hasattr(a, "dtype") and hasattr(a, "astype") and jnp.issubdtype(
+        getattr(a, "dtype", None), jnp.floating
+    ) and a.dtype != target and a.dtype != np.float64:
+        return a.astype(target)
+    return a
+
+
 def _maybe_autocast(op_name, arrays):
     from .. import amp as _amp
 
@@ -88,15 +99,7 @@ def _maybe_autocast(op_name, arrays):
         target = np.float32
     else:
         return arrays
-    out = []
-    for a in arrays:
-        if hasattr(a, "dtype") and hasattr(a, "astype") and jnp.issubdtype(
-            getattr(a, "dtype", None), jnp.floating
-        ) and a.dtype != target and a.dtype != np.float64:
-            out.append(a.astype(target))
-        else:
-            out.append(a)
-    return out
+    return [_cast_leaf(a, target) for a in arrays]
 
 
 def _differentiable(leaf):
@@ -167,14 +170,25 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
     # Segment capture (jit/lazy.py): record the op into the current
     # segment instead of dispatching — graph-broken to_static calls
     # compile op RUNS, not single ops. No-grad only (the eager autograd
-    # engine needs concrete per-op arrays); AMP casting is skipped in
-    # capture mode (inference-grade fallback).
+    # engine needs concrete per-op arrays). AMP casts are folded INTO the
+    # recorded op (amp_target) so a captured segment under auto_cast
+    # computes in the same dtypes as the per-op eager fallback.
     if not framework.is_grad_enabled():
         from ..jit.lazy import current_trace
 
         _trace = current_trace()
         if _trace is not None:
-            out = _trace.record(fn, arrays, treedef, name_for_amp)
+            from .. import amp as _amp
+
+            state = _amp.amp_state()
+            amp_target = None
+            if state.enabled:
+                if name_for_amp in _amp.WHITE_LIST:
+                    amp_target = state.dtype.np_dtype
+                elif name_for_amp in _amp.BLACK_LIST:
+                    amp_target = np.float32
+            out = _trace.record(fn, arrays, treedef, name_for_amp,
+                                amp_target=amp_target)
             return _wrap_outputs(out, node=None)
 
     # AMP autocast: per-op white/black list casting (reference analogue:

@@ -96,18 +96,42 @@ def load(path, return_numpy=False, **configs):
 # host pickling/IO; device->host copies happen on the caller thread to
 # keep a consistent snapshot) ------------------------------------------
 _ASYNC_TASKS = []
+_ATEXIT_REGISTERED = False
+# per-path write sequence: a stalled older writer must not os.replace()
+# over a newer completed save to the same destination
+_ASYNC_SEQ: dict = {}
+_ASYNC_DONE: dict = {}
+_ASYNC_LOCK = None
 
 
 def async_save(obj, path, protocol=4, sync_other_task=False, **configs):
     """paddle.async_save: snapshot now (device->host copy), pickle+write
     in a background thread. Call `clear_async_save_task_queue()` (or the
     next async_save with sync_other_task=True) to join outstanding
-    writes before relying on the files."""
+    writes before relying on the files.
+
+    Crash-safe: the writer targets a temp file in the destination
+    directory and os.replace()s it into place, so the final path never
+    holds a truncated checkpoint; an atexit hook joins outstanding
+    writers on normal interpreter exit."""
     import threading
 
+    global _ATEXIT_REGISTERED, _ASYNC_LOCK
+    if _ASYNC_LOCK is None:
+        _ASYNC_LOCK = threading.Lock()
+    if not _ATEXIT_REGISTERED:
+        import atexit
+
+        atexit.register(clear_async_save_task_queue)
+        _ATEXIT_REGISTERED = True
     if sync_other_task:
         clear_async_save_task_queue()
     snapshot = _encode(obj)   # materialise host copies on THIS thread
+    seq = None
+    if not hasattr(path, "write"):
+        with _ASYNC_LOCK:
+            seq = _ASYNC_SEQ.get(str(path), 0) + 1
+            _ASYNC_SEQ[str(path)] = seq
 
     def _write():
         if hasattr(path, "write"):
@@ -117,8 +141,18 @@ def async_save(obj, path, protocol=4, sync_other_task=False, **configs):
         d = os.path.dirname(p)
         if d:
             os.makedirs(d, exist_ok=True)
-        with open(p, "wb") as f:
-            pickle.dump(snapshot, f, protocol=protocol)
+        tmp = p + f".tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(snapshot, f, protocol=protocol)
+            with _ASYNC_LOCK:
+                if _ASYNC_DONE.get(p, 0) > seq:
+                    return        # a NEWER save already landed: don't clobber
+                _ASYNC_DONE[p] = seq
+                os.replace(tmp, p)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
 
     t = threading.Thread(target=_write, daemon=True)
     t.start()

@@ -83,8 +83,12 @@ def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
     argmax. Runs inside the jitted decode step."""
     f32 = logits.astype(jnp.float32)
     greedy = jnp.argmax(f32, -1).astype(jnp.int32)
-    V = f32.shape[-1]
-    srt = jnp.flip(jnp.sort(f32, -1), -1)                     # desc [B, V]
+    # temperature scales BEFORE the filters (HF/vLLM order): the nucleus is
+    # computed on the distribution actually sampled from, so high
+    # temperature widens it and low temperature narrows it
+    scaled = f32 / jnp.maximum(temps[:, None], 1e-6)
+    V = scaled.shape[-1]
+    srt = jnp.flip(jnp.sort(scaled, -1), -1)                  # desc [B, V]
     k_eff = jnp.where(top_ks > 0, top_ks, V)
     kth = jnp.take_along_axis(
         srt, jnp.clip(k_eff - 1, 0, V - 1)[:, None], 1)       # [B, 1]
@@ -97,9 +101,8 @@ def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
     thr = jnp.min(jnp.where(keep, topk_sorted, jnp.inf), -1, keepdims=True)
     # a logit survives only if it passes BOTH filters (max of thresholds);
     # keep[:, 0] is always True so thr is finite
-    masked = jnp.where(f32 < jnp.maximum(kth, thr), -jnp.inf, f32)
-    scaled = masked / jnp.maximum(temps[:, None], 1e-6)
-    sampled = jax.random.categorical(key, scaled, -1).astype(jnp.int32)
+    masked = jnp.where(scaled < jnp.maximum(kth, thr), -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, masked, -1).astype(jnp.int32)
     return jnp.where(temps <= 0.0, greedy, sampled)
 
 

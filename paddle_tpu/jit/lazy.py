@@ -113,7 +113,15 @@ class SegmentTrace:
         self.recorded_ops = 0
 
     # -- recording ----------------------------------------------------------
-    def record(self, fn, leaf_arrays, treedef, op_name):
+    def record(self, fn, leaf_arrays, treedef, op_name, amp_target=None):
+        orig_fn = fn
+        if amp_target is not None:
+            # fold the AMP cast into the recorded op: the cast then runs
+            # both under eval_shape and in the compiled segment, matching
+            # the per-op eager fallback's autocast dtypes. Memo key stays
+            # derived from the ORIGINAL fn (+ the target) so wrapper
+            # identity doesn't defeat segment caching.
+            fn = _amp_cast_wrap(fn, amp_target)
         plan, statics, dyn = [], [], []
         for a in leaf_arrays:
             if isinstance(a, LazyValue):
@@ -147,8 +155,10 @@ class SegmentTrace:
         out_shape = jax.eval_shape(shaped_call, *dyn)
         out_leaves, out_tree = tree_util.tree_flatten(out_shape)
         out_lazy = [LazyValue(self, o.shape, o.dtype) for o in out_leaves]
-        self.ops.append(_Op(fn, plan, treedef,
-                            out_lazy, _op_key(fn, tuple(statics))))
+        key = _op_key(orig_fn, tuple(statics))
+        if amp_target is not None:
+            key = key + (("amp", str(amp_target)),)
+        self.ops.append(_Op(fn, plan, treedef, out_lazy, key))
         self.recorded_ops += 1
         return tree_util.tree_unflatten(out_tree, out_lazy)
 
@@ -198,6 +208,23 @@ class SegmentTrace:
         flat_lazy = [lz for op in ops for lz in op.out_lazy]
         for lz, val in zip(flat_lazy, results):
             lz._concrete = val
+
+
+def _amp_cast_wrap(fn, target):
+    """Wrap an op fn so float array args are cast to ``target`` first —
+    the in-graph form of dispatch._maybe_autocast (the leaf rule is the
+    SHARED dispatch._cast_leaf, so capture-mode numerics track eager)."""
+    from ..core.dispatch import _cast_leaf
+
+    target = np.dtype(target)
+
+    def casted(*a2, **k2):
+        leaves, td = tree_util.tree_flatten((a2, k2))
+        out = [_cast_leaf(a, target) for a in leaves]
+        aa, kk = tree_util.tree_unflatten(td, out)
+        return fn(*aa, **kk)
+
+    return casted
 
 
 def _hashable(v):

@@ -12,6 +12,9 @@ is a single MXU dot_general.
 from __future__ import annotations
 
 import math
+import os
+
+import jax
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
@@ -291,6 +294,68 @@ def _rms_pure(x, w, eps=1e-6):
     return ((x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(x.dtype)) * w
 
 
+@jax.custom_vjp
+def _ffn_i8(h2, wg, wu, wd):
+    """Whole swiglu FFN (down(silu(h2@wg) * (h2@wu))) whose backward reads
+    int8-saved gate/up instead of re-running the two big matmuls.
+
+    Forward numerics are EXACT (the real bf16 gate/up feed silu/mul/down);
+    the int8 round-trip only enters the BACKWARD — inside the silu'/mul
+    factors and the wd weight-grad contraction — the same wide-backward
+    discipline as the int8 LM head
+    (incubate/nn/functional/__init__.py:_int8_head_core). Residuals are
+    tagged (ffn_gate_q8 etc.) so a save_only_these_names remat policy
+    keeps the int8 copies at HALF the HBM of bf16 saves (which OOM at
+    1.3B/b4, docs/ROUND4_IDEAS.md:7-13). The down-proj lives INSIDE the
+    vjp so its wgrad reconstructs silu(gate)*up from the saved int8 —
+    nothing in this block's backward re-runs a forward matmul.
+
+    Capability slot: the reference's recompute pass offers no middle
+    ground between save-full and re-run
+    (distributed/passes/auto_parallel_recompute.py); TPU-native extension."""
+    return (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+
+
+def _ffn_i8_fwd(h2, wg, wu, wd):
+    from jax.ad_checkpoint import checkpoint_name
+
+    from paddle_tpu.incubate.nn.functional import _quantize_rows_int8
+
+    gate = h2 @ wg
+    up = h2 @ wu
+    qg, sg = _quantize_rows_int8(gate)
+    qu, su = _quantize_rows_int8(up)
+    qg = checkpoint_name(qg, "ffn_gate_q8")
+    sg = checkpoint_name(sg, "ffn_gate_q8_s")
+    qu = checkpoint_name(qu, "ffn_up_q8")
+    su = checkpoint_name(su, "ffn_up_q8_s")
+    return (jax.nn.silu(gate) * up) @ wd, (h2, wg, wu, wd, qg, sg, qu, su)
+
+
+def _ffn_i8_bwd(res, g):
+    import jax.numpy as jnp
+
+    h2, wg, wu, wd, qg, sg, qu, su = res
+    gate = (qg.astype(jnp.float32) * sg)
+    up = (qu.astype(jnp.float32) * su)
+    sig = jax.nn.sigmoid(gate)
+    silu = gate * sig
+    dsilu = sig * (1.0 + gate * (1.0 - sig))
+    ffn = (silu * up).astype(h2.dtype)
+    dffn = g @ wd.T
+    dwd = jnp.einsum("bsm,bsh->mh", ffn, g).astype(wd.dtype)
+    gf = dffn.astype(jnp.float32)
+    dgate = (gf * up * dsilu).astype(h2.dtype)
+    dup = (gf * silu).astype(h2.dtype)
+    dh2 = dgate @ wg.T + dup @ wu.T
+    dwg = jnp.einsum("bsh,bsm->hm", h2, dgate).astype(wg.dtype)
+    dwu = jnp.einsum("bsh,bsm->hm", h2, dup).astype(wu.dtype)
+    return dh2, dwg, dwu, dwd
+
+
+_ffn_i8.defvjp(_ffn_i8_fwd, _ffn_i8_bwd)
+
+
 def _sdpa_pure(q, k, v, causal=True):
     """Flagship attention dispatch. Calls the pallas kernel DIRECTLY when
     `_use_pallas` holds (no silent try/except fallback: a kernel failure
@@ -345,8 +410,6 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
 
     if not _use_pallas(q.shape):
         o = checkpoint_name(o, "attn_out")
-    import os
-
     if os.environ.get("PTPU_FUSED_ADDRMS") and _use_pallas(q.shape):
         # fused residual-add + rms in one Pallas pass (named residuals
         # addrms_y/rms_rstd make the backward reuse, not re-run, it)
@@ -358,6 +421,10 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
         # gate/up recompute without re-running rms2
         x = checkpoint_name(x + o @ wo, "resid_mid")
         h2 = checkpoint_name(_rms_pure(x, ln2), "ln2_out")
+    if os.environ.get("PTPU_INT8_FFN"):
+        # int8-saved gate/up: exact forward, backward dequantises instead
+        # of re-running the two matmuls (~9 TFLOP/step at 1.3B/b4)
+        return x + _ffn_i8(h2, wg, wu, wd)
     # per-projection anchors: saving gate/up outputs individually lets a
     # policy trade ~67MB/layer (b4) for skipping that matmul's re-run
     gate = checkpoint_name(h2 @ wg, "ffn_gate")

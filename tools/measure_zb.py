@@ -1,0 +1,108 @@
+"""Wall-clock A/B of the compiled pipeline schedules on the 8-CPU mesh.
+
+VERDICT r3 item 6: the zero-bubble advantage was cost-model-validated only
+(`zero_bubble_cost()` tick arithmetic). This measures the actual schedules
+— plain AD 1F1B ring, interleaved, ZB, ZB-interleaved — at pp=4 with
+cb-heavy stages, and prints measured ratios next to the model's
+predictions. Results are recorded in docs/ZB_WALLCLOCK.md.
+
+Run:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/measure_zb.py
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def measure(fn, *args, iters=8, warmup=2):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from paddle_tpu.distributed.pipeline import (
+        interleaved_cost, microbatch, plain_cost, spmd_pipeline,
+        spmd_pipeline_interleaved, spmd_pipeline_zero_bubble,
+        spmd_pipeline_zero_bubble_interleaved, unmicrobatch,
+        zero_bubble_cost)
+
+    pp, v, n_micro = 4, 2, 4
+    # cb-heavy stages: deep matmul chains make backward ~2x forward and
+    # keep per-tick compute >> ppermute/threading overhead on CPU
+    L, H, rows = 16, 384, 512
+    layers_per_stage = L // pp
+
+    devs = np.array(jax.devices()[:pp]).reshape(pp)
+    mesh = Mesh(devs, ("pp",))
+
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(L, H, H) * (1.0 / np.sqrt(H)), jnp.float32)
+    x = jnp.asarray(rng.randn(rows, H), jnp.float32)
+    xm = microbatch(x, n_micro)
+
+    def stage_fn(w_local, xx):
+        def step(xx, w1):
+            return jnp.tanh(xx @ w1), None
+        out, _ = jax.lax.scan(step, xx, w_local)
+        return out
+
+    builders = {
+        "1f1b (AD ring)": lambda: spmd_pipeline(
+            stage_fn, mesh, pp, params_spec=P("pp")),
+        "interleaved v2": lambda: spmd_pipeline_interleaved(
+            stage_fn, mesh, pp, v),
+        "zero-bubble": lambda: spmd_pipeline_zero_bubble(
+            stage_fn, mesh, pp, params_spec=P("pp")),
+        "zb-interleaved v2": lambda: spmd_pipeline_zero_bubble_interleaved(
+            stage_fn, mesh, pp, v),
+    }
+    predictions = {
+        "1f1b (AD ring)": plain_cost(n_micro, pp),
+        "interleaved v2": interleaved_cost(n_micro, pp, v),
+        "zero-bubble": zero_bubble_cost(n_micro, pp),
+        "zb-interleaved v2": zero_bubble_cost(n_micro, pp, v=v),
+    }
+
+    results = {}
+    for name, mk in builders.items():
+        pipe = mk()
+
+        def loss(w, xm, _pipe=pipe):
+            return jnp.sum(unmicrobatch(_pipe(w, xm)) ** 2)
+
+        g = jax.jit(jax.grad(loss))
+        ws = jax.device_put(w, NamedSharding(mesh, P("pp")))
+        dt = measure(g, ws, xm)
+        results[name] = dt
+        print(f"{name:20s}  {dt * 1e3:8.2f} ms/step "
+              f"(predicted {predictions[name]:.2f} ticks)")
+
+    base = results["1f1b (AD ring)"]
+    pbase = predictions["1f1b (AD ring)"]
+    print(f"\n{'schedule':20s} {'measured ratio':>15s} {'predicted ratio':>16s}")
+    for name in builders:
+        print(f"{name:20s} {results[name] / base:15.3f} "
+              f"{predictions[name] / pbase:16.3f}")
+
+    # config note: grad-step wall clock includes the post-ring batched
+    # wgrad (ZB) vs in-ring wgrad (AD) — exactly the tradeoff the cost
+    # model arbitrates
+    print(f"\nconfig: pp={pp} v={v} n_micro={n_micro} "
+          f"L={L} H={H} rows={rows} ({layers_per_stage} layers/stage)")
+
+
+if __name__ == "__main__":
+    main()
