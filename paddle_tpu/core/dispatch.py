@@ -169,27 +169,34 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
 
     # Segment capture (jit/lazy.py): record the op into the current
     # segment instead of dispatching — graph-broken to_static calls
-    # compile op RUNS, not single ops. No-grad only (the eager autograd
-    # engine needs concrete per-op arrays). AMP casts are folded INTO the
+    # compile op RUNS, not single ops. AMP casts are folded INTO the
     # recorded op (amp_target) so a captured segment under auto_cast
-    # computes in the same dtypes as the per-op eager fallback.
-    if not framework.is_grad_enabled():
-        from ..jit.lazy import current_trace
+    # computes in the same dtypes as the per-op eager fallback. In
+    # grad_mode traces (training fallback), each flushed segment becomes
+    # ONE GradNode (lazy._attach_grad) — staged autograd.
+    from ..jit.lazy import current_trace
 
-        _trace = current_trace()
-        if _trace is not None:
-            from .. import amp as _amp
+    _trace = current_trace()
+    if _trace is not None and (
+            _trace.grad_mode or not framework.is_grad_enabled()):
+        from .. import amp as _amp
 
-            state = _amp.amp_state()
-            amp_target = None
-            if state.enabled:
-                if name_for_amp in _amp.WHITE_LIST:
-                    amp_target = state.dtype.np_dtype
-                elif name_for_amp in _amp.BLACK_LIST:
-                    amp_target = np.float32
-            out = _trace.record(fn, arrays, treedef, name_for_amp,
-                                amp_target=amp_target)
-            return _wrap_outputs(out, node=None)
+        state = _amp.amp_state()
+        amp_target = None
+        if state.enabled:
+            if name_for_amp in _amp.WHITE_LIST:
+                amp_target = state.dtype.np_dtype
+            elif name_for_amp in _amp.BLACK_LIST:
+                amp_target = np.float32
+        grad_on = _trace.grad_mode and framework.is_grad_enabled()
+        out = _trace.record(fn, arrays, treedef, name_for_amp,
+                            amp_target=amp_target,
+                            leaves=leaves if grad_on else None)
+        wrapped = _wrap_outputs(out, node=None)
+        if grad_on:
+            _trace.note_out_tensors(tree_util.tree_flatten(
+                wrapped, is_leaf=_is_tensor)[0])
+        return wrapped
 
     # AMP autocast: per-op white/black list casting (reference analogue:
     # AMP logic injected per-op by eager codegen, eager_gen.py:1996-2055).

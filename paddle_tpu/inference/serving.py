@@ -124,6 +124,9 @@ class ContinuousBatchingEngine:
         self.eos = eos_token_id
         num_pages = num_pages or (max_slots * self.pages_per_seq + 2)
         self.pool = PagePool(num_pages)
+        # one extra non-allocable scratch page: the BATCHED chunked
+        # prefill routes padded rows' cache writes there
+        self._trash_page = num_pages
 
         hd = cfg.hidden_size // cfg.num_heads
         self.hd, self.hkv = hd, cfg.num_kv_heads
@@ -135,9 +138,9 @@ class ContinuousBatchingEngine:
         # paged caches per layer, KERNEL layout [Hkv, num_pages, page, D]
         # (what paged_attention consumes — no per-step transposes)
         dt = self._weights["embed"].dtype
-        self.kc = [jnp.zeros((self.hkv, num_pages, page_size, hd), dt)
+        self.kc = [jnp.zeros((self.hkv, num_pages + 1, page_size, hd), dt)
                    for _ in range(cfg.num_layers)]
-        self.vc = [jnp.zeros((self.hkv, num_pages, page_size, hd), dt)
+        self.vc = [jnp.zeros((self.hkv, num_pages + 1, page_size, hd), dt)
                    for _ in range(cfg.num_layers)]
 
         self._slots: list[_Request | None] = [None] * max_slots
@@ -156,6 +159,13 @@ class ContinuousBatchingEngine:
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
         self.prefill_chunk = prefill_chunk
         self.prefills_completed = 0   # per-request (both prefill modes)
+        # batched chunked prefill: ONE jitted fixed-shape pass advances
+        # every prefilling slot by up to prefill_chunk tokens per tick
+        # (VERDICT r3 item 7 — the eager per-request chunk loop paid the
+        # ~2.5ms/dispatch host cost per layer per request)
+        self._prefill_jit = jax.jit(self._prefill_chunk_step,
+                                    donate_argnums=(7, 8))
+        self.prefill_chunk_steps = 0  # observability: jitted pass count
 
     @staticmethod
     def _pack_weights(model):
@@ -381,76 +391,104 @@ class ContinuousBatchingEngine:
                 self._emit(req, tok)
         # chunked mode: KV fills incrementally in step()
 
-    def _prefill_tick(self):
-        """Chunked prefill: advance ONE prefilling request by up to
-        `prefill_chunk` prompt tokens (writing their KV into its pages),
-        so running requests keep decoding every tick while long prompts
-        fill incrementally (the reference serving stack's chunked-prefill
-        /mixed-batch scheduling over block_multihead_attention)."""
+    def _prefill_chunk_step(self, weights, ids, pos0, nvalid, tok_pages,
+                            offs, hist, kc, vc):
+        """ONE jitted fixed-shape chunk pass over ALL prefilling slots:
+        ids [B, c] chunk tokens (zero-padded), pos0 [B] absolute start,
+        nvalid [B] real tokens this chunk, tok_pages/offs [B, c] scatter
+        targets (padded rows -> the scratch page), hist [B, pages_per_seq]
+        page tables. Returns (final-normed last-valid hidden [B, H],
+        new kc, new vc). Shapes are engine constants (max_slots x
+        prefill_chunk x pages_per_seq), so this compiles ONCE."""
         jax, jnp = self._jax, self._jnp
         from ..models.gpt import _rms_pure
 
-        req = next((r for r in self._slots
-                    if r is not None and r.prefill_pos < len(r.prompt)),
-                   None)
-        if req is None:
-            return
-        w = self._weights
-        pos = req.prefill_pos
-        c = min(self.prefill_chunk, len(req.prompt) - pos)
-        ids = jnp.asarray(np.asarray(req.prompt[pos:pos + c])[None, :])
-        x = w["embed"][ids]                                  # [1, c, H]
-        pos0 = jnp.full((1,), pos, jnp.int32)
+        B, c = ids.shape
+        S = self.pages_per_seq * self.page
         scale = 1.0 / math.sqrt(self.hd)
         rep = self.cfg.num_heads // self.hkv
-        total = pos + c
-        # chunk rows attend to [cached prefix + chunk] causally
-        rows = jax.lax.broadcasted_iota(jnp.int32, (c, total), 0) + pos
-        cols = jax.lax.broadcasted_iota(jnp.int32, (c, total), 1)
-        mask = cols <= rows
-
-        page_ids_np = np.asarray(req.pages, np.int64)
-        tok_pages = jnp.asarray(page_ids_np[np.arange(pos, total)
-                                            // self.page])
-        offs = jnp.asarray(np.arange(pos, total) % self.page)
-        n_hist_pages = (total + self.page - 1) // self.page
-        hist_pages = jnp.asarray(page_ids_np[:n_hist_pages])
+        x = weights["embed"][ids]                            # [B, c, H]
+        row_pos = pos0[:, None] + jnp.arange(c)[None, :]     # [B, c]
+        cols = jnp.arange(S)
+        # chunk rows attend to [cached prefix + own chunk] causally
+        mask = cols[None, None, :] <= row_pos[:, :, None]    # [B, c, S]
+        tp = tok_pages.reshape(-1)
+        of = offs.reshape(-1)
 
         def attend(li, q, k, v):
-            # write the chunk's kv FIRST, then gather the full prefix back
-            # (keeps one source of truth for the attention operands)
-            self.kc[li] = self.kc[li].at[:, tok_pages, offs, :].set(
-                jnp.swapaxes(k[0], 0, 1).astype(self.kc[li].dtype))
-            self.vc[li] = self.vc[li].at[:, tok_pages, offs, :].set(
-                jnp.swapaxes(v[0], 0, 1).astype(self.vc[li].dtype))
-            # cached keys/values for this request: [Hkv, total, D]
-            ck = self.kc[li][:, hist_pages].reshape(
-                self.hkv, -1, self.hd)[:, :total]
-            cv = self.vc[li][:, hist_pages].reshape(
-                self.hkv, -1, self.hd)[:, :total]
+            # write the chunk's kv FIRST, then gather the prefix back
+            # (one source of truth for the attention operands)
+            kv = jnp.swapaxes(k.reshape(B * c, self.hkv, self.hd), 0, 1)
+            vv = jnp.swapaxes(v.reshape(B * c, self.hkv, self.hd), 0, 1)
+            kc[li] = kc[li].at[:, tp, of, :].set(kv.astype(kc[li].dtype))
+            vc[li] = vc[li].at[:, tp, of, :].set(vv.astype(vc[li].dtype))
+            ck = kc[li][:, hist].reshape(self.hkv, B, S, self.hd)
+            cv = vc[li][:, hist].reshape(self.hkv, B, S, self.hd)
             if rep > 1:
                 ck = jnp.repeat(ck, rep, 0)
                 cv = jnp.repeat(cv, rep, 0)
-            logits = jnp.einsum(
-                "hcd,htd->hct",
-                jnp.swapaxes(q[0] * scale, 0, 1).astype(jnp.float32),
-                ck.astype(jnp.float32))
-            logits = jnp.where(mask[None], logits, -1e30)
+            logits = jnp.einsum("bchd,hbsd->bhcs",
+                                (q * scale).astype(jnp.float32),
+                                ck.astype(jnp.float32))
+            logits = jnp.where(mask[:, None], logits, -1e30)
             probs = jax.nn.softmax(logits, -1)
-            o = jnp.einsum("hct,htd->chd", probs,
-                           cv.astype(jnp.float32)).astype(q.dtype)
-            return o[None]                              # [1, c, Hq, D]
+            o = jnp.einsum("bhcs,hbsd->bchd", probs,
+                           cv.astype(jnp.float32))
+            return o.astype(q.dtype)                     # [B, c, Hq, D]
 
-        for li, lp in enumerate(w["layers"]):
+        for li, lp in enumerate(weights["layers"]):
             x = self._layer_forward(li, lp, x, pos0, attend)
+        last_rows = jnp.clip(nvalid - 1, 0, c - 1)
+        last = x[jnp.arange(B), last_rows]                   # [B, H]
+        return _rms_pure(last, weights["fnorm"]), kc, vc
 
-        req.prefill_pos = total
-        if req.prefill_pos == len(req.prompt):
-            self.prefills_completed += 1
-            last = _rms_pure(x, w["fnorm"])[:, -1]
-            (tok,) = self._head_tokens(last, [req])
-            req.length = len(req.prompt)
-            self._emit(req, tok)
+    def _prefill_tick(self):
+        """Chunked prefill: advance EVERY prefilling slot by up to
+        `prefill_chunk` prompt tokens in one jitted batched pass, so
+        running requests keep decoding every tick while long prompts fill
+        incrementally (the reference serving stack's chunked-prefill /
+        mixed-batch scheduling over block_multihead_attention; r3's
+        eager per-request loop paid the per-dispatch host cost per layer
+        per request)."""
+        jnp = self._jnp
+        reqs = [r for r in self._slots
+                if r is not None and r.prefill_pos < len(r.prompt)]
+        if not reqs:
+            return
+        B, c = self.max_slots, self.prefill_chunk
+        ids_np = np.zeros((B, c), np.int32)
+        pos0 = np.zeros(B, np.int32)
+        nvalid = np.zeros(B, np.int32)
+        tok_pages = np.full((B, c), self._trash_page, np.int32)
+        offs = np.zeros((B, c), np.int32)
+        hist = np.zeros((B, self.pages_per_seq), np.int32)
+        for i, r in enumerate(reqs):
+            pos = r.prefill_pos
+            n = min(c, len(r.prompt) - pos)
+            ids_np[i, :n] = r.prompt[pos:pos + n]
+            pos0[i], nvalid[i] = pos, n
+            pages = np.asarray(r.pages, np.int64)
+            ap = np.arange(pos, pos + n)
+            tok_pages[i, :n] = pages[ap // self.page]
+            offs[i, :n] = ap % self.page
+            hist[i, :len(r.pages)] = r.pages[:self.pages_per_seq]
+        last, self.kc, self.vc = self._prefill_jit(
+            self._weights, jnp.asarray(ids_np), jnp.asarray(pos0),
+            jnp.asarray(nvalid), jnp.asarray(tok_pages), jnp.asarray(offs),
+            jnp.asarray(hist), list(self.kc), list(self.vc))
+        self.prefill_chunk_steps += 1
+        completed = []
+        for i, r in enumerate(reqs):
+            r.prefill_pos += int(nvalid[i])
+            if r.prefill_pos == len(r.prompt):
+                completed.append((i, r))
+        if completed:
+            rows = last[jnp.asarray([i for i, _ in completed])]
+            toks = self._head_tokens(rows, [r for _, r in completed])
+            for (i, r), tok in zip(completed, toks):
+                self.prefills_completed += 1
+                r.length = len(r.prompt)
+                self._emit(r, tok)
 
     def _retire(self, req: _Request):
         self.pool.free(req.pages)

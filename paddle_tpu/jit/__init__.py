@@ -215,19 +215,20 @@ class StaticFunction:
         fn = self._fn if self._fn is not None else self._layer
         import os
 
-        if framework.is_grad_enabled() or os.environ.get("PTPU_NO_SEGMENTS"):
-            # grad-recording fallback stays per-op eager: the autograd
-            # engine needs concrete arrays at every op, and graph-broken
-            # layers must still TRAIN (test_graph_break_layer_still_trains)
+        if os.environ.get("PTPU_NO_SEGMENTS"):
             return fn(*args, **kwargs)
-        # no-grad fallback (inference): partial-graph capture — ops around
-        # the break compile as segments (prefix up to the .item()/bool(),
-        # host branch, suffix), the SOT-granularity answer
-        # (function_graph.py) without bytecode rewriting. Memoized per
-        # op-sequence, so steady-state calls reuse the compiled programs.
+        # Partial-graph capture around graph breaks — ops compile as
+        # segments (prefix up to the .item()/bool(), host branch, suffix),
+        # the SOT-granularity answer (function_graph.py) without bytecode
+        # rewriting. Memoized per op-sequence, so steady-state calls reuse
+        # the compiled programs. Under grad (training fallback), each
+        # flushed segment lands on the tape as ONE GradNode whose vjp runs
+        # through the cached jitted program — staged autograd, so a
+        # one-.item() training model keeps its FLOPs compiled.
         from .lazy import materialize_tree, segment_capture
 
-        with segment_capture() as trace:
+        with segment_capture(
+                grad_mode=framework.is_grad_enabled()) as trace:
             out = fn(*args, **kwargs)
         self._segment_stats = {"segments": trace.segments,
                                "ops": trace.recorded_ops}

@@ -546,7 +546,14 @@ class StackedDecoder(nn.Layer):
                 return block(x, p), None
 
             if pp <= 1:
-                out, _ = jax.lax.scan(step, x, tuple(params))
+                # PTPU_UNROLL_LAYERS=N statically unrolls the layer loop:
+                # the scan's per-iteration dynamic-slice of every stacked
+                # weight (a real HBM copy, ~100MB/layer/pass — profiled at
+                # >20% of device ops, r4) becomes a constant-offset slice
+                # XLA can alias. Costs compile time linear in depth.
+                unroll = int(os.environ.get("PTPU_UNROLL_LAYERS", "1"))
+                out, _ = jax.lax.scan(step, x, tuple(params),
+                                      unroll=max(1, unroll))
                 return out
 
             from paddle_tpu.distributed.pipeline import (

@@ -109,15 +109,33 @@ def matmul(x, y, name=None):
 
 
 def masked_matmul(x, y, mask, name=None):
-    """Dense x dense -> sparse, computing only `mask`'s nonzero positions."""
-    out = matmul(x, y)
+    """Dense x dense -> sparse SDDMM: computes ONLY `mask`'s nonzero
+    positions — rows of x and columns of y are gathered at the mask's
+    (row, col) pairs and dotted, so work and intermediates are
+    O(nnz * K), never the [M, N] dense product (parity:
+    phi/kernels/sparse/gpu/matmul_kernel.cu SDDMM; the O(nnz) contract
+    is asserted on the jaxpr in tests/test_domains.py)."""
     if isinstance(mask, SparseCooTensor):
         ind = mask.indices()
-        def _take(o, idx):
-            return o[tuple(idx)]
-        vals = apply_op(_take, out, ind, _op_name="masked_take")
-        return sparse_coo_tensor(ind, vals, tuple(out.shape))
-    return out * mask
+        nd = len(mask.shape)
+
+        def _sddmm(xd, yd, idx):
+            parts = [idx[i] for i in range(nd)]
+            batch, r, c = parts[:-2], parts[-2], parts[-1]
+            # flatten leading batch dims so both gathers have an adjacent
+            # (batch, coord) advanced-index pair -> uniform [nnz, K]
+            xb = xd.reshape((-1,) + tuple(xd.shape[-2:]))
+            yb = yd.reshape((-1,) + tuple(yd.shape[-2:]))
+            bkey = jnp.zeros_like(r)
+            for d, bi in enumerate(batch):
+                bkey = bkey * xd.shape[d] + bi
+            xr = xb[bkey, r, :]                           # [nnz, K]
+            yc = jnp.swapaxes(yb, -1, -2)[bkey, c, :]     # [nnz, K]
+            return jnp.einsum("nk,nk->n", xr, yc)
+
+        vals = apply_op(_sddmm, x, y, ind, _op_name="masked_matmul")
+        return sparse_coo_tensor(ind, vals, tuple(mask.shape))
+    return matmul(x, y) * mask
 
 
 def _valuewise(name, jfn):

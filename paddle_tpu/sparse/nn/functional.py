@@ -33,42 +33,87 @@ def leaky_relu(x, negative_slope=0.01):
                     _op_name="leaky_relu")
 
 
+def _row_keys(indices_np, shape):
+    """Linearised leading-dims row id per stored element + row count."""
+    nd = len(shape)
+    rows = np.zeros(indices_np.shape[1], np.int64)
+    for d in range(nd - 1):
+        rows = rows * int(shape[d]) + indices_np[d]
+    nrows = 1
+    for d in range(nd - 1):
+        nrows *= int(shape[d])
+    return rows, nrows
+
+
 def softmax(x, axis=-1):
-    """Sparse softmax over the last dense axis (on the dense view, zeros
-    excluded per-row via masking)."""
+    """Sparse softmax over the last axis, computed directly on the STORED
+    values with per-row segment max/sum — O(nnz), the dense view is never
+    materialised (parity: phi/kernels/sparse/gpu/softmax_kernel.cu; same
+    semantics — the softmax runs over the stored elements of each row)."""
     from ...core.dispatch import apply_op as _ao
 
     if isinstance(x, SparseCooTensor):
-        dense = x.to_dense()
+        if axis not in (-1, len(x.shape) - 1):
+            raise ValueError("sparse softmax supports the last axis only "
+                             "(reference kernel contract)")
+        ind_np = np.asarray(x.indices().numpy())
+        rows, nrows = _row_keys(ind_np, x.shape)
+        rows_j = jnp.asarray(rows)
 
-        def _sm(a):
-            mask = a != 0
-            lg = jnp.where(mask, a, -1e30)
-            out = jax.nn.softmax(lg, axis=axis)
-            return jnp.where(mask, out, 0.0)
+        def _sm(vals):
+            m = jax.ops.segment_max(vals, rows_j, num_segments=nrows)
+            e = jnp.exp(vals - m[rows_j])
+            s = jax.ops.segment_sum(e, rows_j, num_segments=nrows)
+            return e / s[rows_j]
 
-        out = _ao(_sm, dense, _op_name="sparse_softmax")
-        from .. import to_sparse_coo_auto
-
-        return to_sparse_coo_auto(out)
+        vals = _ao(_sm, x.values(), _op_name="sparse_softmax")
+        return sparse_coo_tensor(x.indices(), vals, tuple(x.shape))
     return _ao(lambda a: jax.nn.softmax(a, axis=axis), x, _op_name="softmax")
 
 
 def attention(query, key, value, sparse_mask, key_padding_mask=None,
               attn_mask=None, name=None):
-    """Sparse-mask attention (parity: sparse/nn/functional/transformer.py)."""
-    from ...nn.functional.flash_attention import _xla_sdpa
+    """Sparse-mask attention composed from the O(nnz) pieces: SDDMM for
+    the masked q.k^T scores, per-row segment softmax over the stored
+    scores, and a segment-sum spmm against v — the [S, S] score matrix
+    never materialises (parity:
+    phi/kernels/sparse/gpu/fused_attention_kernel.cu; q/k/v are
+    [B, H, S, D], sparse_mask is [B*H, S, S] COO as in the reference).
 
-    mask_dense = sparse_mask.to_dense() if isinstance(
-        sparse_mask, SparseCooTensor) else sparse_mask
+    key_padding_mask [B, S] / attn_mask [S, S] (additive, -inf style)
+    are applied to the gathered scores before the softmax."""
+    if not isinstance(sparse_mask, SparseCooTensor):
+        raise ValueError("sparse_mask must be a SparseCooTensor")
+    ind = sparse_mask.indices()
+    ind_np = np.asarray(ind.numpy())
+    rows, nrows = _row_keys(ind_np, sparse_mask.shape)
+    rows_j = jnp.asarray(rows)
 
-    def _attn(q, k, v, m):
-        lg_mask = jnp.where(m != 0, 0.0, -1e30)
-        qh = jnp.swapaxes(q, 1, 2) if q.ndim == 4 else q
-        return _xla_sdpa(q, k, v, mask=lg_mask)
+    def _attn(q, k, v, idx, kp, am):
+        B, H, S, D = q.shape
+        qf = q.reshape(B * H, S, D)
+        kf = k.reshape(B * H, S, D)
+        vf = v.reshape(B * H, S, D)
+        g, i, j = idx[0], idx[1], idx[2]
+        scores = jnp.einsum(
+            "nd,nd->n", qf[g, i, :], kf[g, j, :]) / np.sqrt(D)
+        if kp is not None:
+            scores = scores + kp[g // H, j]
+        if am is not None:
+            scores = scores + am[i, j]
+        # clamp the per-row max so fully-masked rows (-inf everywhere)
+        # yield 0-weight rows instead of exp(-inf - -inf) = NaN
+        m = jax.ops.segment_max(scores, rows_j, num_segments=nrows)
+        m = jnp.maximum(m, -1e30)
+        e = jnp.exp(scores - m[rows_j])
+        s = jax.ops.segment_sum(e, rows_j, num_segments=nrows)
+        p = e / jnp.maximum(s[rows_j], 1e-30)
+        out = jax.ops.segment_sum(p[:, None] * vf[g, j, :],
+                                  g * S + i, num_segments=B * H * S)
+        return out.reshape(B, H, S, D)
 
-    return apply_op(_attn, query, key, value, mask_dense,
-                    _op_name="sparse_attention")
+    return apply_op(_attn, query, key, value, ind, key_padding_mask,
+                    attn_mask, _op_name="sparse_attention")
 
 
 # -- sparse conv functionals (parity: sparse/nn/functional/conv.py) ---------

@@ -260,3 +260,173 @@ class TestRulebookSparseConv:
             rng.standard_normal((3, 3, 3, 2, 2)).astype(np.float32))
         with _pytest.raises(ValueError, match="submanifold"):
             F.subm_conv3d(x, w, stride=2, padding=1)
+
+
+class TestSparseOnnz:
+    """VERDICT r3 item 4: the O(nnz) sparse family — SDDMM masked_matmul,
+    segment softmax, composed sparse attention (reference:
+    phi/kernels/sparse/gpu/matmul_kernel.cu, softmax_kernel.cu,
+    fused_attention_kernel.cu). Each test checks parity vs the dense
+    path AND (for sddmm) that intermediates stay O(nnz)."""
+
+    def _mask(self, rng, shape, nnz):
+        import paddle_tpu.sparse as psp
+
+        coords = set()
+        while len(coords) < nnz:
+            coords.add(tuple(int(rng.integers(0, s)) for s in shape))
+        idx = np.asarray(sorted(coords)).T
+        vals = np.ones(nnz, np.float32)
+        return psp.sparse_coo_tensor(idx, vals, shape), idx
+
+    def test_masked_matmul_matches_dense_2d_and_batched(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.sparse as psp
+
+        rng = np.random.default_rng(0)
+        for shape, xs, ys in [((8, 9), (8, 5), (5, 9)),
+                              ((3, 6, 7), (3, 6, 4), (3, 4, 7))]:
+            mask, idx = self._mask(rng, shape, nnz=10)
+            x = paddle.to_tensor(rng.standard_normal(xs).astype(np.float32))
+            y = paddle.to_tensor(rng.standard_normal(ys).astype(np.float32))
+            out = psp.masked_matmul(x, y, mask)
+            ref = np.matmul(np.asarray(x.numpy()), np.asarray(y.numpy()))
+            got = np.asarray(out.values().numpy())
+            want = ref[tuple(idx)]
+            np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_masked_matmul_is_onnz_in_jaxpr(self):
+        import jax
+        import paddle_tpu as paddle
+        import paddle_tpu.sparse as psp
+
+        rng = np.random.default_rng(1)
+        M = N = 256
+        K = 16
+        mask, idx = self._mask(rng, (M, N), nnz=12)
+        x = rng.standard_normal((M, K)).astype(np.float32)
+        y = rng.standard_normal((K, N)).astype(np.float32)
+        captured = {}
+
+        import paddle_tpu.core.dispatch as dispatch
+        orig = dispatch.apply_op
+
+        def spy(fn, *a, _op_name=None, **kw):
+            if _op_name == "masked_matmul":
+                captured["fn"] = fn
+            return orig(fn, *a, _op_name=_op_name, **kw)
+
+        dispatch.apply_op, psp.apply_op = spy, spy
+        try:
+            psp.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        finally:
+            dispatch.apply_op, psp.apply_op = orig, orig
+        jaxpr = jax.make_jaxpr(captured["fn"])(
+            x, y, np.asarray(mask.indices().numpy()))
+        biggest = max(int(np.prod(v.aval.shape) or 1)
+                      for eqn in jaxpr.eqns for v in eqn.outvars)
+        # every intermediate is O(nnz*K) or an input reshape — never M*N
+        assert biggest < M * N / 10, biggest
+        assert biggest <= max(12 * K, M * K, K * N), biggest
+
+    def test_sparse_softmax_segment_matches_dense(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.sparse as psp
+        from paddle_tpu.sparse.nn import functional as F
+
+        rng = np.random.default_rng(2)
+        shape = (5, 7)
+        coords = set()
+        while len(coords) < 11:
+            coords.add(tuple(int(rng.integers(0, s)) for s in shape))
+        idx = np.asarray(sorted(coords)).T
+        vals = rng.standard_normal(11).astype(np.float32)
+        sp = psp.sparse_coo_tensor(idx, vals, shape)
+        out = F.softmax(sp)
+        got = np.asarray(out.values().numpy())
+        # reference: per-row softmax over the STORED values
+        want = np.zeros_like(vals)
+        for r in np.unique(idx[0]):
+            sel = idx[0] == r
+            e = np.exp(vals[sel] - vals[sel].max())
+            want[sel] = e / e.sum()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        # grads flow through the segment ops
+        v = sp.values()
+        v.stop_gradient = False
+        sp2 = psp.sparse_coo_tensor(idx, v, shape)
+        loss = (F.softmax(sp2).values() ** 2).sum()
+        loss.backward()
+        assert v.grad is not None and np.isfinite(v.grad.numpy()).all()
+
+    def test_sparse_attention_matches_dense_masked(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.sparse as psp
+        from paddle_tpu.sparse.nn import functional as F
+
+        rng = np.random.default_rng(3)
+        B, H, S, D = 2, 2, 6, 4
+        q = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        k = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        v = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        # causal-ish random mask with every row non-empty (diag included)
+        dense_mask = (rng.random((B * H, S, S)) < 0.4)
+        dense_mask |= np.eye(S, dtype=bool)[None]
+        idx = np.stack(np.nonzero(dense_mask))
+        sp_mask = psp.sparse_coo_tensor(
+            idx, np.ones(idx.shape[1], np.float32), (B * H, S, S))
+        out = F.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                          paddle.to_tensor(v), sp_mask)
+        # dense reference
+        scores = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(D)
+        scores = scores.reshape(B * H, S, S)
+        scores[~dense_mask] = -np.inf
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("gst,gtd->gsd", p,
+                        v.reshape(B * H, S, D)).reshape(B, H, S, D)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_sparse_attention_grads_flow(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.sparse as psp
+        from paddle_tpu.sparse.nn import functional as F
+
+        rng = np.random.default_rng(4)
+        B, H, S, D = 1, 2, 5, 3
+        q = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(np.float32))
+        k = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(np.float32))
+        v = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(np.float32))
+        for t in (q, k, v):
+            t.stop_gradient = False
+        dense_mask = np.tril(np.ones((S, S), bool))
+        idx = np.stack(np.nonzero(np.broadcast_to(dense_mask, (B * H, S, S))))
+        sp_mask = psp.sparse_coo_tensor(
+            idx, np.ones(idx.shape[1], np.float32), (B * H, S, S))
+        out = F.attention(q, k, v, sp_mask)
+        (out ** 2).sum().backward()
+        for t in (q, k, v):
+            assert t.grad is not None and np.isfinite(t.grad.numpy()).all()
+
+    def test_sparse_attention_fully_masked_row_is_finite(self):
+        """code-review r4: a query row whose stored entries are all
+        -inf-masked must produce zeros, not NaN."""
+        import paddle_tpu as paddle
+        import paddle_tpu.sparse as psp
+        from paddle_tpu.sparse.nn import functional as F
+
+        B = H = 1
+        S, D = 2, 4
+        rng = np.random.default_rng(7)
+        q = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(np.float32))
+        k = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(np.float32))
+        v = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(np.float32))
+        # row 0 attends ONLY key 1; key 1 is padding-masked -> row fully dead
+        idx = np.asarray([[0, 0, 1], [0, 1, 1]]).T
+        sp_mask = psp.sparse_coo_tensor(
+            idx, np.ones(idx.shape[1], np.float32), (B * H, S, S))
+        kp = paddle.to_tensor(np.asarray([[0.0, -np.inf]], np.float32))
+        out = F.attention(q, k, v, sp_mask, key_padding_mask=kp)
+        arr = np.asarray(out.numpy())
+        assert np.isfinite(arr).all(), arr

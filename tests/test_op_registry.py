@@ -57,7 +57,9 @@ def test_registered_public_ops_exist():
             continue
         if (hasattr(paddle, target) or hasattr(paddle.Tensor, target)
                 or hasattr(F, target)
-                or hasattr(paddle.Tensor, target + "_")):  # inplace-only ops
+                or hasattr(paddle.Tensor, target + "_")  # inplace-only ops
+                or hasattr(getattr(paddle, "linalg", None), target)
+                or hasattr(getattr(paddle, "fft", None), target)):
             continue
         missing.append(name)
     assert not missing, missing
@@ -102,3 +104,64 @@ def test_inplace_contract_matches_semantics():
     assert out is t or np.allclose(out.numpy(), t.numpy())
     assert get_op_spec("add").inplace == {"x": "out"}
     np.testing.assert_allclose(t.numpy(), 2.0)
+
+
+def _battery_base_ops():
+    """Base op names covered by the numeric battery (vs torch)."""
+    import os
+    import re
+
+    here = os.path.dirname(__file__)
+    names = set()
+    for f in ("test_op_battery.py", "test_op_battery_complex.py"):
+        src = open(os.path.join(here, f)).read()
+        names |= {n.split("/")[0] for n in
+                  re.findall(r'case\(\s*"([^"]+)"', src)}
+    return sorted(names)
+
+
+# battery label -> canonical registry op (labels carry variant suffixes /
+# operator spellings / renamed callables)
+_CANON = {
+    "abs_operator": "abs", "neg_operator": "neg", "matpow_operator":
+        "matrix_power", "multiply_scalar": "multiply", "rsub": "subtract",
+    "rdiv": "divide", "cast_int": "cast", "flatten_0": "flatten",
+    "flip_ud": "flip", "squeeze_all": "squeeze", "norm_1": "norm",
+    "norm_fro": "norm", "fft_abs": "fft", "rfft_abs": "rfft",
+    "qr_r": "qr", "real_imag": "real", "getitem_bool": "getitem",
+    "getitem_ellipsis": "getitem", "getitem_slice": "getitem",
+    "F.": None,  # stray prefix-only label
+    "F.bce": "binary_cross_entropy",
+    "F.bce_with_logits": "binary_cross_entropy_with_logits",
+    "F.huber_loss": "smooth_l1_loss",
+    "F.dropout_eval": "dropout", "F.alpha_dropout_eval": "alpha_dropout",
+    "F.batch_norm_eval": "batch_norm", "F.rrelu_eval": "rrelu",
+    "F.gumbel_softmax_shape": "gumbel_softmax",
+    "F.interpolate_bilinear": "interpolate",
+    "F.interpolate_nearest": "interpolate",
+    "F.upsample_nearest": "upsample",
+    "F.unfold_im2col": "unfold", "F.square_error_cost": "mse_loss",
+}
+
+
+def test_battery_ops_have_specs():
+    """VERDICT r3 item 5: the declarative registry covers the full battery
+    surface — no numerically-tested op bypasses the contract layer that
+    feeds sharding rules and inplace semantics (ops.yaml parity:
+    paddle/phi/ops/yaml/ops.yaml as single source of truth)."""
+    missing = []
+    for label in _battery_base_ops():
+        name = _CANON.get(label, label)
+        if name is None:
+            continue
+        if name.startswith("F."):
+            name = name[2:]
+        if get_op_spec(name) is None:
+            missing.append((label, name))
+    assert not missing, (len(missing), missing)
+
+
+def test_registry_floor():
+    """Coverage gate: the registry stays at ops.yaml scale for the surface
+    this framework exposes (was 145 in r3; the battery covers >=300 ops)."""
+    assert len(registered_ops()) >= 360, len(registered_ops())

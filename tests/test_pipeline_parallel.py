@@ -729,3 +729,123 @@ def test_pipelined_layer_handles_shape_change():
     fleet._reset_for_tests()
     assert list(o1.shape) == [8, 8] and list(o2.shape) == [16, 8]
     assert len(model._uniform_cache) == 2   # one probe per input aval
+
+
+def test_hetero_ring_in_ring_loss_owner_stage():
+    """VERDICT r3 missing-item 6: last-stage-owned output. forward_loss
+    consumes the head's vocab-sized output IN-RING on the owner stage —
+    only the per-microbatch scalar loss crosses the closing psum. Checks
+    (a) loss parity with the replicated-output path, (b) training
+    trajectory parity through train_batch (which now routes through the
+    in-ring loss), and (c) that no psum in the traced program carries a
+    vocab-sized operand."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer)
+
+    V, H = 64, 16
+
+    class Embed(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(V, H)
+
+        def forward(self, ids):
+            return self.emb(ids)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(H, H)
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x))
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(H, V)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    def _strategy(pp):
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": pp,
+                            "sharding_degree": 1}
+        return s
+
+    def loss_fn(out, yy):
+        return ((out - yy) ** 2).mean()
+
+    def build():
+        paddle.seed(33)
+        descs = ([LayerDesc(Embed)] + [LayerDesc(Block) for _ in range(2)]
+                 + [LayerDesc(Head)])
+        return PipelineLayer(descs, num_stages=4)
+
+    fleet.init(is_collective=True, strategy=_strategy(4))
+    rng = np.random.RandomState(11)
+    ids = paddle.to_tensor(rng.randint(0, V, (8, 5)).astype(np.int32))
+    y = paddle.to_tensor(rng.randn(8, 5, V).astype(np.float32))
+
+    model = build()
+    # (a) forward loss parity: in-ring consumer vs replicated output
+    ref = loss_fn(model(ids), y)
+    got = model.forward_loss(ids, y, loss_fn)
+    np.testing.assert_allclose(float(got.numpy()), float(ref.numpy()),
+                               atol=1e-5, rtol=1e-5)
+
+    # (c) no psum in the ring-loss program touches a vocab-sized operand
+    import jax
+
+    from paddle_tpu.core.tensor import Tensor as T
+
+    def traced(x_arr, y_arr):
+        return model.forward_loss(T(x_arr), T(y_arr), loss_fn)._data
+
+    with paddle.no_grad():
+        jaxpr = jax.make_jaxpr(traced)(ids._data, y._data)
+
+    def all_eqns(jx):
+        for eqn in jx.eqns:
+            yield eqn
+            vals = list(eqn.params.values())
+            for v in vals:
+                if isinstance(v, (list, tuple)):
+                    vals.extend(v)
+                    continue
+                if hasattr(v, "eqns"):
+                    yield from all_eqns(v)
+                elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+                    yield from all_eqns(v.jaxpr)
+
+    psums = [e for e in all_eqns(jaxpr.jaxpr) if "psum" in str(e.primitive)]
+    assert psums, "ring-loss program must still close with a (small) psum"
+    for e in psums:
+        for v in e.invars:
+            shape = tuple(getattr(v.aval, "shape", ()))
+            assert not (len(shape) >= 3 and shape[-1] == V), (
+                "vocab-sized psum survived", shape)
+
+    # (b) training trajectory parity: train_batch (in-ring loss) vs pp=1
+    def run(pp_degree, steps=4):
+        fleet._reset_for_tests()
+        fleet.init(is_collective=True, strategy=_strategy(pp_degree))
+        m = build()
+        if pp_degree > 1:
+            m.shard_stage_parameters()
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        dmodel = fleet.distributed_model(m)
+        dopt = fleet.distributed_optimizer(opt)
+        return [float(dmodel.train_batch([ids, y], dopt, loss_fn=loss_fn))
+                for _ in range(steps)]
+
+    l_pp = run(4)
+    l_ref = run(1)
+    assert l_pp[-1] < l_pp[0], l_pp
+    np.testing.assert_allclose(l_pp, l_ref, atol=2e-4, rtol=2e-4)
+    fleet._reset_for_tests()
