@@ -72,6 +72,7 @@ class ModelCfg:
     mxu_tflops: float = 459.0        # v5p bf16 peak
     ici_gbps: float = 90.0           # per-link bidirectional-ish
     params_b: float = 0.0            # explicit param count override
+    multi_precision: bool = False    # fp32 moments + master (12 B/param)
 
     @property
     def ffn(self):
@@ -108,8 +109,16 @@ def estimate_memory_gb(cfg: TunerCfg, model: ModelCfg):
     param_shard = model_shard * (cfg.sharding if cfg.sharding_stage >= 3 else 1)
     params = P * bpp / param_shard
     grads = P * bpp / grad_shard
-    # adam: two fp32 moments (+ fp32 master in mixed precision ~ 3x4 bytes)
-    opt = P * 12 / (model_shard * cfg.sharding)
+    # adam: two moments in the PARAM dtype (the framework's default —
+    # optimizer.py _init_slots keeps moments in p.dtype; fp32 moments +
+    # master only under multi_precision). The old fixed 12-bytes/param
+    # assumption predicted >=20.6GB for EVERY single-chip 1.3B config
+    # and pruned them all, while the real bench runs at ~14.5GB — the
+    # exact class of model bug the bench-scale calibration run exists to
+    # catch (docs/TUNER_CALIBRATION.md, r4).
+    opt_bpp = (12 if getattr(model, "multi_precision", False)
+               else 2 * bpp)
+    opt = P * opt_bpp / (model_shard * cfg.sharding)
 
     # activations per layer per microbatch (bf16):
     # none: ~ s*b*h*(34 + 5*a*s/h) (Megatron formula, attn scores incl.)
@@ -284,7 +293,8 @@ def generate_candidates(world_size, model: ModelCfg = None, global_batch=None,
                     per = global_batch // max(dp * sharding, 1)
                     mbs_opts = [m for m in mbs_opts if per and per % m == 0]
                 vpps = [1] if pp <= 2 else [1, 2]
-                remats = ["none", "full"] if tune_recompute else ["none"]
+                remats = (["none", "attn", "full"] if tune_recompute
+                          else ["none"])
                 stages = [1] if sharding == 1 else [1, 2, 3]
                 for mbs, vpp, remat, stage in itertools.product(
                         mbs_opts or [1], vpps, remats, stages):

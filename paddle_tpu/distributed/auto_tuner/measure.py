@@ -92,6 +92,14 @@ def build_trial_runner(model, steps=3, seq_len=None):
             trial = GPTForCausalLMPipe(gcfg)
             if cfg.pp > 1:
                 trial.decoder.apply_pipeline_placements()
+        if model.bytes_per_param == 2:
+            # honor the declared training dtype: ModelCfg promises bf16
+            # (bytes_per_param=2) but layers initialise f32 — an f32
+            # trial of a bench-scale model carries ~2.7x the optimizer+
+            # param bytes the memory model predicts and OOMs the chip
+            # the real (bf16) config fits on (r4 calibration finding)
+            for _, p in trial.named_parameters():
+                p._data = p._data.astype(jax.numpy.bfloat16)
 
         opt = paddle.optimizer.AdamW(
             learning_rate=1e-4, parameters=trial.parameters())
@@ -113,12 +121,20 @@ def build_trial_runner(model, steps=3, seq_len=None):
         dt = (time.perf_counter() - t0) / steps
 
         mem = step.memory_stats(ids, labels)
-        return TrialResult(b * s / dt, {
+        result = TrialResult(b * s / dt, {
             "step_ms": dt * 1e3,
             "peak_bytes": mem["peak_bytes"],
             "argument_bytes": mem["argument_bytes"],
             "temp_bytes": mem["temp_bytes"],
         })
+        # free this trial's params/opt state before the NEXT candidate
+        # compiles: back-to-back bench-scale trials otherwise stack two
+        # models' HBM and OOM a config that fits alone (r4 calibration)
+        import gc
+
+        del step, opt, trial, ids, labels, loss
+        gc.collect()
+        return result
 
     return run
 

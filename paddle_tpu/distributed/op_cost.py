@@ -191,8 +191,21 @@ def plan_matmul_shardings(fn, *example_args, axis_size=8,
                 tree_util.tree_structure(example_args), a))))(*flat)
     plans = []
     d = axis_size
+    # chain propagation: a split_n matmul leaves its output COLUMN-sharded
+    # — a downstream matmul contracting that value gets split_k for free
+    # (Megatron's colwise->rowwise pair), while any other choice must pay
+    # an all-gather of the sharded operand first. Elementwise eqns pass
+    # the annotation through (same shape in->out).
+    col_sharded: set = set()
     for i, eqn in enumerate(closed.jaxpr.eqns):
         if eqn.primitive.name != "dot_general":
+            ins = [v for v in eqn.invars
+                   if hasattr(v, "aval") and id(v) in col_sharded]
+            if ins and eqn.outvars:
+                for ov in eqn.outvars:
+                    if (hasattr(ov.aval, "shape")
+                            and ov.aval.shape == ins[0].aval.shape):
+                        col_sharded.add(id(ov))
             continue
         (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
         lhs, rhs = (v.aval for v in eqn.invars[:2])
@@ -209,17 +222,25 @@ def plan_matmul_shardings(fn, *example_args, axis_size=8,
         flops = 2 * b * m * n * k
         io_bytes = b * (m * k + k * n + m * n) * itemsize
         compute = model.eqn_seconds(flops / d, io_bytes / d)
+        lhs_col = id(eqn.invars[0]) in col_sharded
+        # operand already k-sharded: gathering it back costs one
+        # all_gather of the full lhs; split_k skips that entirely
+        gather_lhs = (model.comm_seconds(
+            b * m * k * itemsize * (d - 1) / d, d) if lhs_col else 0.0)
         est = {
-            "split_m": compute + (0.0 if in_sharded == "rows"
-                                  else model.comm_seconds(
-                                      b * m * k * itemsize * (d - 1) / d,
-                                      d)),
-            "split_n": compute + (model.comm_seconds(
+            "split_m": compute + gather_lhs + (
+                0.0 if in_sharded == "rows"
+                else model.comm_seconds(
+                    b * m * k * itemsize * (d - 1) / d, d)),
+            "split_n": compute + gather_lhs + (model.comm_seconds(
                 b * m * k * itemsize, d) if in_sharded == "rows" else 0.0),
             "split_k": compute + model.comm_seconds(b * m * n * 4, d),
-            "replicate": model.eqn_seconds(flops, io_bytes),
+            "replicate": model.eqn_seconds(flops, io_bytes) + gather_lhs,
         }
         est_ms = {c: t * 1e3 for c, t in est.items()}
         choice = min(est_ms, key=est_ms.get)
+        if choice == "split_n":
+            for ov in eqn.outvars:
+                col_sharded.add(id(ov))
         plans.append(MatmulPlan(i, m, n, k, choice, est_ms))
     return plans

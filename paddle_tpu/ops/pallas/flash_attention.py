@@ -158,7 +158,14 @@ def _kv_index(b_idx, hq, hk):
 def _fwd(q, k, v, scale, causal, interpret, hq, hk):
     bhq, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk = _block_for(sq), _block_for(sk)
+    # PTPU_FA_KBLOCK decouples the streamed k/v tile from the q tile
+    # (with a full-seq q block, a smaller k block keeps the DMA pipeline
+    # ahead of the MXU; falls back to PTPU_FA_BLOCK when unset)
+    import os as _os
+
+    bq = _block_for(sq)
+    bk = _block_for(sk, env="PTPU_FA_KBLOCK",
+                    default=int(_os.environ.get("PTPU_FA_BLOCK", "1024")))
     if bq is None or bk is None:
         raise ValueError(
             f"flash_attention: seq lens ({sq}, {sk}) not tileable — pad to a "
@@ -347,7 +354,14 @@ def _bwd(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret, hq, hk):
     bhq, sq, d = q.shape
     bhk, sk, _ = k.shape
-    bq, bk = _bwd_block_for(sq), _bwd_block_for(sk)
+    # PTPU_FA_BWD_KBLOCK decouples the bwd k tile (uniform 2048 holds too
+    # many live blocks and compile-OOMs; mixed tiles may fit)
+    import os as _os
+
+    bq = _bwd_block_for(sq)
+    bk = _block_for(sk, env="PTPU_FA_BWD_KBLOCK",
+                    default=int(_os.environ.get("PTPU_FA_BWD_BLOCK",
+                                                "1024")))
     nq, nk = sq // bq, sk // bk
     rep = hq // hk
     offset = sk - sq

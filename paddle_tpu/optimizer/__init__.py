@@ -53,17 +53,79 @@ class Adagrad(Optimizer):
         return new_p, {"moment": m}
 
 
+_Q8_BLOCK = 2048
+
+
+def _q8_signed(x, block=_Q8_BLOCK):
+    """Blockwise absmax int8 over the flattened array -> (q [nb, B],
+    scale [nb]). Dettmers-style 8-bit optimizer-state storage (published
+    8-bit Adam recipe), TPU-native: pure elementwise, jit-fusable."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    xb = flat.reshape(-1, block)
+    s = jnp.maximum(jnp.max(jnp.abs(xb), 1, keepdims=True), 1e-20) / 127.0
+    q = jnp.clip(jnp.round(xb / s), -127, 127).astype(jnp.int8)
+    return q, s[:, 0]
+
+
+def _dq8_signed(q, s, shape, size):
+    flat = (q.astype(jnp.float32) * s[:, None]).reshape(-1)
+    return flat[:size].reshape(shape)
+
+
+_dq8_unsigned = _dq8_signed  # dequant is quantizer-agnostic
+
+
+def _q8_unsigned(x, block=_Q8_BLOCK):
+    """uint8 variant for non-negative values (sqrt of the second moment —
+    the sqrt compresses its dynamic range before linear quantisation)."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    xb = flat.reshape(-1, block)
+    s = jnp.maximum(jnp.max(xb, 1, keepdims=True), 1e-20) / 255.0
+    q = jnp.clip(jnp.round(xb / s), 0, 255).astype(jnp.uint8)
+    return q, s[:, 0]
+
+
 class Adam(Optimizer):
-    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, use_multi_tensor=False, amsgrad=False, name=None):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, use_multi_tensor=False, amsgrad=False, moment_dtype=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
         self._multi_precision = multi_precision
+        # moment_dtype="int8": blockwise-quantised moments (8-bit Adam) —
+        # m stored signed int8, sqrt(v) stored uint8, per-2048-block f32
+        # scales. Optimizer HBM drops 4x vs fp32 / 2x vs bf16 moments
+        # (1.3B bf16: 5.4G -> 1.35G), buying remat headroom on a 16G
+        # chip. Parity bounded by tests/test_optimizer.py.
+        if moment_dtype not in (None, "int8"):
+            raise ValueError("moment_dtype must be None or 'int8'")
+        if moment_dtype == "int8" and (amsgrad or multi_precision):
+            raise ValueError("moment_dtype='int8' does not compose with "
+                             "amsgrad/multi_precision")
+        self._moment_dtype = moment_dtype
 
     def _init_slots(self, p):
         f32 = jnp.float32
+        if self._moment_dtype == "int8":
+            size = 1
+            for s in p.shape:
+                size *= int(s)
+            nb = (size + _Q8_BLOCK - 1) // _Q8_BLOCK
+            return {
+                "moment1_q": jnp.zeros((nb, _Q8_BLOCK), jnp.int8),
+                "moment1_s": jnp.zeros((nb,), f32),
+                "moment2_q": jnp.zeros((nb, _Q8_BLOCK), jnp.uint8),
+                "moment2_s": jnp.zeros((nb,), f32),
+                "beta1_pow": jnp.ones((), f32),
+                "beta2_pow": jnp.ones((), f32),
+            }
         # reference semantics (optimizer.py _add_accumulator): moments live in
         # the PARAM dtype; fp32 moments + master weights only under
         # multi_precision. At 1.3B bf16 this halves optimizer HBM (10.8G→5.4G).
@@ -82,16 +144,36 @@ class Adam(Optimizer):
 
     def _update(self, p, g, slots, lr):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
-        mdt = slots["moment1"].dtype
         gf = g.astype(jnp.float32)
-        m1 = b1 * slots["moment1"].astype(jnp.float32) + (1 - b1) * gf
-        m2 = b2 * slots["moment2"].astype(jnp.float32) + (1 - b2) * gf * gf
+        if self._moment_dtype == "int8":
+            size = 1
+            for s in p.shape:
+                size *= int(s)
+            m1_prev = _dq8_signed(slots["moment1_q"], slots["moment1_s"],
+                                  p.shape, size)
+            sq_prev = _dq8_unsigned(slots["moment2_q"], slots["moment2_s"],
+                                    p.shape, size)
+            m2_prev = sq_prev * sq_prev
+        else:
+            mdt = slots["moment1"].dtype
+            m1_prev = slots["moment1"].astype(jnp.float32)
+            m2_prev = slots["moment2"].astype(jnp.float32)
+        m1 = b1 * m1_prev + (1 - b1) * gf
+        m2 = b2 * m2_prev + (1 - b2) * gf * gf
         b1p = slots["beta1_pow"] * b1
         b2p = slots["beta2_pow"] * b2
         m1_hat = m1 / (1 - b1p)
         denom_m2 = m2
-        new_slots = {"moment1": m1.astype(mdt), "moment2": m2.astype(mdt),
-                     "beta1_pow": b1p, "beta2_pow": b2p}
+        if self._moment_dtype == "int8":
+            q1, s1 = _q8_signed(m1)
+            q2, s2 = _q8_unsigned(jnp.sqrt(m2))
+            new_slots = {"moment1_q": q1, "moment1_s": s1,
+                         "moment2_q": q2, "moment2_s": s2,
+                         "beta1_pow": b1p, "beta2_pow": b2p}
+        else:
+            new_slots = {"moment1": m1.astype(mdt),
+                         "moment2": m2.astype(mdt),
+                         "beta1_pow": b1p, "beta2_pow": b2p}
         if self._amsgrad:
             m2max = jnp.maximum(slots["moment2_max"].astype(jnp.float32), m2)
             denom_m2 = m2max
@@ -111,8 +193,8 @@ class Adam(Optimizer):
 class AdamW(Adam):
     """Decoupled weight decay (parity: python/paddle/optimizer/adamw.py)."""
 
-    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=False, amsgrad=False, name=None):
-        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, amsgrad=amsgrad, name=name)
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=False, amsgrad=False, moment_dtype=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, amsgrad=amsgrad, moment_dtype=moment_dtype, name=name)
         self._wd = float(weight_decay) if not callable(weight_decay) else weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
         self._current_param_name = None

@@ -21,13 +21,20 @@ LLAMA7B = ModelCfg(hidden_size=4096, num_layers=32, num_attention_heads=32,
 
 class TestMemoryModel:
     def test_7b_single_chip_oom_but_sharded_fits(self):
-        # 7B adam fp32 moments alone ~84GB: one v5p chip can't hold it
-        # unsharded with activations, 8-way sharding must fit easily
-        dense = estimate_memory_gb(TunerCfg(dp=1, mp=1, micro_batch=1), LLAMA7B)
+        # 7B with fp32 adam moments + master (multi_precision, 12B/param)
+        # can't fit one v5p chip unsharded with activations; 8-way
+        # sharding must fit easily. (r4: the default model now matches
+        # the framework's param-dtype moments — bf16 7B at ~83GB does
+        # squeeze onto a 95GB chip, which is correct.)
+        import dataclasses
+
+        mp32 = dataclasses.replace(LLAMA7B, multi_precision=True)
+        dense = estimate_memory_gb(TunerCfg(dp=1, mp=1, micro_batch=1),
+                                   mp32)
         assert dense > 95
         sharded = estimate_memory_gb(
             TunerCfg(dp=1, mp=1, sharding=8, sharding_stage=3,
-                     micro_batch=1, recompute="full"), LLAMA7B)
+                     micro_batch=1, recompute="full"), mp32)
         assert sharded < 40
 
     def test_param_count_close_to_7b(self):

@@ -166,3 +166,77 @@ class TestLRSchedulers:
         s.step(metrics=1.0)
         s.step(metrics=1.0)
         assert s() == pytest.approx(0.1)
+
+
+def test_adamw_int8_moments_track_bf16_adamw():
+    """8-bit Adam (blockwise-quantised moments, Dettmers recipe as a
+    TPU-native extension): training trajectory must track the full-
+    precision optimizer closely, and the stored state must actually be
+    int8 (the memory claim)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    def build():
+        paddle.seed(7)
+        return nn.Sequential(nn.Linear(32, 64), nn.Tanh(),
+                             nn.Linear(64, 8))
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(16, 32)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+
+    def run(moment_dtype):
+        m = build()
+        opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                     parameters=m.parameters(),
+                                     moment_dtype=moment_dtype)
+        losses = []
+        for _ in range(25):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses, opt
+
+    ref, _ = run(None)
+    q, opt_q = run("int8")
+    # convergence-quality bound (how the 8-bit optimizer literature
+    # evaluates): the quantised run reaches a final loss within 20% of
+    # full precision, and both converge hard. Per-step relative bounds
+    # are the wrong criterion — tiny absolute noise compounds into a
+    # growing RELATIVE gap as the loss shrinks (measured: 0.006 abs at
+    # loss 0.11 by step 25).
+    assert q[-1] < q[0] * 0.5
+    assert ref[-1] < ref[0] * 0.5
+    assert q[-1] <= ref[-1] * 1.2 + 1e-3, (q[-1], ref[-1])
+    # state really is 8-bit
+    slots = next(iter(opt_q._slots.values()))
+    assert slots["moment1_q"].dtype == np.int8
+    assert slots["moment2_q"].dtype == np.uint8
+
+
+def test_adamw_int8_moments_under_trainstep():
+    """The quantise/dequantise pair must live INSIDE the jitted whole-
+    step program (TrainStep) — same compiled-path contract as bf16."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(1)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters(),
+                                 moment_dtype="int8")
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.normal(size=(8, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (8,)).astype(np.int64))
+
+    step = TrainStep(m, lambda a, b: paddle.nn.functional.cross_entropy(
+        m(a), b), opt)
+    losses = [float(step(x, y).numpy()) for _ in range(12)]
+    assert losses[-1] < losses[0] - 0.05, losses

@@ -284,3 +284,81 @@ class TestPSStrategies:
         client = PSClient([PSServer(), DeadServer()], replication=2)
         client.create_dense_table("w", (2,), init=np.zeros(2))
         assert client.pull_dense("w") is not None
+
+
+def test_replica_anti_entropy_converges_after_transient_down():
+    """VERDICT r3 item 8: a replica that misses a push while transiently
+    down must CONVERGE after it rejoins (version-counter anti-entropy on
+    the next push round), not silently serve stale state on failover."""
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    s0, s1 = PSServer(0), PSServer(1)
+    client = PSClient([s0, s1], replication=2)
+    client.create_dense_table("w", (4,), init=np.zeros(4), lr=1.0)
+
+    # healthy push reaches both replicas
+    client.push_dense("w", np.ones(4))
+
+    # replica 1 goes down for one push (simulate by breaking dispatch)
+    import paddle_tpu.distributed.ps as psmod
+
+    real_call = client._call
+
+    def flaky(idx, fn, *args):
+        if idx == 1 and fn is psmod._rpc_push_dense:
+            raise ConnectionError("replica down")
+        return real_call(idx, fn, *args)
+
+    client._call = flaky
+    client.push_dense("w", np.ones(4))      # replica 1 misses this
+    client._call = real_call
+
+    v0 = s0.tables["w"].value.copy()
+    v1 = s1.tables["w"].value.copy()
+    assert not np.allclose(v0, v1)          # diverged while down
+
+    # replica back: the NEXT push round detects the version gap and
+    # resyncs the stale copy before applying... (push applies, then
+    # anti-entropy copies the longest history over)
+    client.push_dense("w", np.ones(4))
+    t0, t1 = s0.tables["w"], s1.tables["w"]
+    assert t0.version == t1.version, (t0.version, t1.version)
+    np.testing.assert_allclose(t0.value, t1.value)
+    # and failover pulls now serve the SAME state from either replica
+    np.testing.assert_allclose(client.pull_dense("w"), t0.value)
+
+
+def test_replica_anti_entropy_equal_counters_divergent_values():
+    """code-review r4: replicas that each missed a DIFFERENT push tie on
+    the applied-update counter with divergent values — the value digest
+    must still trigger resync (deterministic lowest-index winner)."""
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+    import paddle_tpu.distributed.ps as psmod
+
+    s0, s1 = PSServer(0), PSServer(1)
+    client = PSClient([s0, s1], replication=2)
+    client.create_dense_table("w", (3,), init=np.zeros(3), lr=1.0)
+    real_call = client._call
+
+    def down(which):
+        def flaky(idx, fn, *args):
+            if idx == which and fn is psmod._rpc_push_dense:
+                raise ConnectionError("down")
+            return real_call(idx, fn, *args)
+        return flaky
+
+    # replica 1 misses push A; replica 0 misses push B -> equal counters,
+    # divergent values
+    client._call = down(1)
+    client.push_dense("w", np.asarray([1.0, 0.0, 0.0]))
+    client._call = down(0)
+    client.push_dense("w", np.asarray([0.0, 1.0, 0.0]))
+    client._call = real_call
+    t0, t1 = s0.tables["w"], s1.tables["w"]
+    assert t0.version == t1.version
+    assert not np.allclose(t0.value, t1.value)
+
+    # next healthy push: digests differ -> resync fires, replicas agree
+    client.push_dense("w", np.asarray([0.0, 0.0, 1.0]))
+    np.testing.assert_allclose(t0.value, t1.value)
+    assert t0.version == t1.version

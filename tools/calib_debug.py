@@ -1,0 +1,27 @@
+import os, sys
+sys.path.insert(0, os.getcwd())
+from paddle_tpu.distributed.auto_tuner import AutoTuner
+from paddle_tpu.distributed.auto_tuner.measure import build_trial_runner
+
+t = AutoTuner({
+    "world_size": 1,
+    "model_cfg": dict(
+        hidden_size=2048, num_layers=24, num_attention_heads=16,
+        vocab_size=32000, seq_length=2048, global_batch_size=4,
+        bytes_per_param=2, hbm_gb=15.75, mxu_tflops=197.0,
+        ici_gbps=100.0),
+    "max_mp_degree": 1,
+    "max_pp_degree": 1,
+    "tune_recompute": True,
+})
+run_fn = build_trial_runner(t.model, steps=2)
+for _ in range(3):
+    cfg = t.search_once()
+    if cfg is None:
+        print("no more cfgs"); break
+    print("cfg:", cfg)
+    try:
+        m = run_fn(cfg)
+        print("  ok:", float(m), getattr(m, "details", None))
+    except Exception as e:
+        print("  FAIL:", type(e).__name__, str(e)[:300])

@@ -1,0 +1,18 @@
+import os, sys
+sys.path.insert(0, os.getcwd())
+from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerCfg
+from paddle_tpu.distributed.auto_tuner.measure import build_trial_runner
+t = AutoTuner({
+    "world_size": 1,
+    "model_cfg": dict(hidden_size=2048, num_layers=24,
+                      num_attention_heads=16, vocab_size=32000,
+                      seq_length=2048, global_batch_size=4,
+                      bytes_per_param=2, hbm_gb=15.75, mxu_tflops=197.0,
+                      ici_gbps=100.0),
+    "max_mp_degree": 1, "max_pp_degree": 1, "tune_recompute": True,
+})
+run_fn = build_trial_runner(t.model, steps=2)
+cfg = TunerCfg(dp=1, mp=1, pp=1, sharding=1, micro_batch=1,
+               vpp=1, sharding_stage=1, recompute="full")
+m = run_fn(cfg)
+print("ok:", float(m), m.details)
