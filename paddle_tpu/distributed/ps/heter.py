@@ -1,0 +1,109 @@
+"""Heterogeneous PS: device-cached hot rows over host sparse tables.
+
+Capability slot: the reference's heter parameter server keeps hot
+embedding rows on the accelerator while cold rows live in host/PS memory
+(`fluid/framework/fleet/ps_gpu_wrapper.cc`, heter_ps/ — GPU-cached
+tables; mixed CPU/GPU training). The TPU-native shape: a worker-side
+cache whose storage is ONE jax device array (rows resident in HBM,
+gathered by slot index inside the training step), backed by the
+replicated/sharded host PSClient for misses.
+
+Coherence: pushes go to the PS (the single source of truth) and
+INVALIDATE touched cached rows — the next pull re-fetches the
+server-updated values (correct under any server-side optimizer, unlike
+applying a local shadow update). Eviction is least-recently-used via an
+OrderedDict (O(1) per id); freed slots recycle through a free list.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["HeterSparseCache"]
+
+
+class HeterSparseCache:
+    """Device-resident LRU row cache over a PSClient sparse table."""
+
+    def __init__(self, client, table_name, dim, cache_rows=4096,
+                 dtype=np.float32):
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.client = client
+        self.table = table_name
+        self.dim = int(dim)
+        self.capacity = int(cache_rows)
+        # id -> slot; OrderedDict order IS the recency order (oldest
+        # first); freed slots (push invalidation) recycle via _free
+        self._slot_of: OrderedDict[int, int] = OrderedDict()
+        self._free = list(range(self.capacity))
+        self._store = jnp.zeros((self.capacity, self.dim), dtype)
+        self.hits = 0
+        self.misses = 0
+
+    # -- internals ---------------------------------------------------------
+    def _alloc_slot(self):
+        if self._free:
+            return self._free.pop()
+        _, slot = self._slot_of.popitem(last=False)   # evict LRU
+        return slot
+
+    # -- worker API --------------------------------------------------------
+    def pull(self, ids):
+        """Gather rows for `ids` ([N] int) -> device array [N, dim].
+
+        The output is assembled BEFORE cache insertion (hit rows gathered
+        from the device store, miss rows patched from the batched host
+        pull), so same-batch evictions can never corrupt the result."""
+        jnp = self._jnp
+        ids = np.asarray(ids).reshape(-1)
+        hit_mask = np.asarray([int(i) in self._slot_of for i in ids])
+        self.hits += int(hit_mask.sum())
+        self.misses += int((~hit_mask).sum())
+
+        # 1) gather the hits from the device store (slots still valid)
+        slots = np.asarray([self._slot_of.get(int(i), 0) for i in ids])
+        out = self._store[jnp.asarray(slots)]
+
+        # 2) batched host pull for the misses; patch them into the output
+        missing = list(dict.fromkeys(
+            int(i) for i, h in zip(ids, hit_mask) if not h))
+        if missing:
+            pulled = np.asarray(
+                self.client.pull_sparse(self.table, np.asarray(missing)))
+            row_of = dict(zip(missing, pulled))
+            idxs = np.nonzero(~hit_mask)[0]
+            patch = np.stack([row_of[int(ids[i])] for i in idxs])
+            out = out.at[jnp.asarray(idxs)].set(jnp.asarray(patch))
+            # 3) NOW insert the fresh rows (may evict, incl. this batch's
+            # hits — harmless, output is already built)
+            new_slots, new_rows = [], []
+            for rid in missing:
+                slot = self._alloc_slot()
+                self._slot_of[rid] = slot
+                new_slots.append(slot)
+                new_rows.append(row_of[rid])
+            self._store = self._store.at[jnp.asarray(new_slots)].set(
+                jnp.asarray(np.stack(new_rows)))
+
+        # 4) refresh recency for surviving hit ids (O(1) each)
+        for rid in dict.fromkeys(int(i) for i in ids):
+            if rid in self._slot_of:
+                self._slot_of.move_to_end(rid)
+        return out
+
+    def push(self, ids, grads):
+        """Push row grads to the PS and invalidate the touched cache
+        rows (source of truth stays server-side); their slots recycle."""
+        ids = np.asarray(ids).reshape(-1)
+        self.client.push_sparse(self.table, ids, np.asarray(grads))
+        for i in dict.fromkeys(int(x) for x in ids):
+            slot = self._slot_of.pop(i, None)
+            if slot is not None:
+                self._free.append(slot)
+
+    def hit_rate(self):
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0

@@ -85,6 +85,106 @@ class Program:
     def num_blocks(self):
         return 1
 
+    def ir_module(self, fetch_list):
+        """The program's IR form (N20 closure, r4): a pure traced
+        function over (params, feeds) exposing jaxpr inspection,
+        paddle.ir pass application, and StableHLO serialization — the
+        capability triplet of `pir::Program` + PassManager +
+        serialize_deserialize (reference: paddle/pir/include/core,
+        fluid/pir/serialize_deserialize) on the jaxpr/StableHLO IR this
+        framework standardises on."""
+        return IrProgram(self, fetch_list)
+
+
+class IrProgram:
+    """IR view of a recorded static Program (see Program.ir_module)."""
+
+    def __init__(self, program, fetch_list):
+        from jax import tree_util
+
+        self._feed_names = sorted(program.feeds.keys())
+        feed_tensors = [program.feeds[n] for n in self._feed_names]
+        params = program.trainable_params()
+        self._params = params
+        self._fetch_list = list(fetch_list)
+
+        def pure(param_arrays, feed_arrays):
+            env = {}
+            for t, a in zip(feed_tensors, feed_arrays):
+                env[id(t)] = a
+            for t, a in zip(params, param_arrays):
+                env[id(t)] = a
+            for replay_fn, ins, outs in program.records:
+                ins_a = [env.get(id(t), t._data) for t in ins]
+                out = replay_fn(ins_a)
+                for t, a in zip(outs, tree_util.tree_flatten(out)[0]):
+                    env[id(t)] = a
+            return [env.get(id(f), getattr(f, "_data", None))
+                    for f in fetch_list]
+
+        self._pure = pure
+        self._jit = None
+
+    def _args(self, feed):
+        param_arrays = [p._data for p in self._params]
+        feed_arrays = [Tensor(np.asarray(feed[n]))._data
+                       for n in self._feed_names]
+        return param_arrays, feed_arrays
+
+    def jaxpr(self, feed):
+        """ClosedJaxpr of the program over this feed signature — the
+        inspectable SSA IR (pir::Program::Print analogue)."""
+        import jax
+
+        return jax.make_jaxpr(self._pure)(*self._args(feed))
+
+    def apply(self, *patterns, dce=True):
+        """Run paddle.ir rewrite patterns (+DCE) over the program — the
+        PassManager slot. Returns self; subsequent run()/jaxpr()/
+        serialize() see the rewritten program."""
+        from ..ir import PatternRewriter
+
+        rw = PatternRewriter(list(patterns), dce=dce)
+        self._pure = rw.rewrite(self._pure)
+        self._jit = None
+        return self
+
+    def run(self, feed, return_numpy=True):
+        import jax
+
+        if self._jit is None:
+            self._jit = jax.jit(self._pure)
+        outs = self._jit(*self._args(feed))
+        if return_numpy:
+            return [np.asarray(o) if o is not None else None for o in outs]
+        return [Tensor(o) if o is not None else None for o in outs]
+
+    def serialize(self, path, feed):
+        """Portable artifact: StableHLO bytes (jax.export, weights
+        embedded as constants) — loadable without the Python program."""
+        import jax
+        from jax import export as jax_export
+
+        param_arrays, feed_arrays = self._args(feed)
+
+        def with_weights(*feeds):
+            return self._pure(param_arrays, list(feeds))
+
+        exported = jax_export.export(jax.jit(with_weights))(
+            *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in feed_arrays])
+        with open(path, "wb") as f:
+            f.write(exported.serialize())
+        return path
+
+    @staticmethod
+    def deserialize(path):
+        """Load a serialized program as a callable(feed_arrays...)."""
+        from jax import export as jax_export
+
+        with open(path, "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        return exported.call
+
 
 _default_main = Program()
 _default_startup = Program()

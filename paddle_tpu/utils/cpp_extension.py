@@ -2,15 +2,21 @@
 
 Capability parity: `python/paddle/utils/cpp_extension/` (`load` :895,
 `setup` :92) + the custom-operator runtime (`fluid/framework/
-custom_operator.cc`). TPU-native contract: device compute belongs in
-Pallas kernels; custom C++ runs on the HOST and is bridged into jit
-programs with ``jax.pure_callback`` — the same host-compute seam the
-reference's CPU custom kernels occupy. Binding is ctypes (no pybind11 in
-this environment).
+custom_operator.cc`, which compiles REAL device kernels). Two tiers:
 
-C ABI for ops (elementwise/flat, float32):
-    extern "C" void <op>(const float* x, float* y, int64_t n);
-Richer signatures can be called directly via ``module.lib.<symbol>``.
+- **Device-kernel path** (`get_ffi_op`, r4): the C++ source implements an
+  XLA FFI handler (`xla/ffi/api/ffi.h`, headers shipped with jaxlib —
+  compile with ``load(..., with_ffi=True)``). The handler registers as a
+  custom-call target and executes INSIDE the compiled XLA program on the
+  CPU backend — jit-compatible, no host round-trip, the N38 parity slot
+  (`fluid/framework/custom_operator.cc` kernels inside the executor).
+  TPU device kernels route through Pallas (`paddle_tpu.ops.pallas`) —
+  the chip's only user-programmable kernel surface.
+- **Host path** (`get_op`): plain C ABI bridged with ``jax.pure_callback``
+  (host compute seam). C ABI: ``extern "C" void <op>(const float* x,
+  float* y, int64_t n)``; richer signatures via ``module.lib.<symbol>``.
+
+Binding is ctypes (no pybind11 in this environment).
 """
 from __future__ import annotations
 
@@ -76,11 +82,46 @@ class CppExtensionModule:
         op.__name__ = symbol
         return op
 
+    def get_ffi_op(self, symbol, dtype=np.float32):
+        """Wrap an XLA FFI handler symbol as a framework op whose kernel
+        runs INSIDE the compiled program (custom-call, not host
+        callback) — the device-kernel custom-op path on the CPU backend
+        (N38: fluid/framework/custom_operator.cc executes user kernels
+        in the executor; here the executor is XLA)."""
+        import jax
+        import jax.ffi as jffi
+
+        from ..core.dispatch import apply_op
+
+        target = f"ptpu_{self.name}_{symbol}"
+        if target not in _FFI_REGISTERED:
+            handler = getattr(self.lib, symbol)
+            jffi.register_ffi_target(target, jffi.pycapsule(handler),
+                                     platform="cpu")
+            _FFI_REGISTERED.add(target)
+
+        def op(x):
+            def _f(xa):
+                call = jax.ffi.ffi_call(
+                    target, jax.ShapeDtypeStruct(xa.shape, dtype))
+                return call(xa)
+
+            return apply_op(_f, x, _op_name=symbol)
+
+        op.__name__ = symbol
+        return op
+
+
+_FFI_REGISTERED: set = set()
+
 
 def load(name, sources, extra_cxx_cflags=None, extra_cflags=None,
          extra_ldflags=None, build_directory=None, verbose=False,
-         **kwargs):
-    """Compile `sources` and load the library (cpp_extension.py:895)."""
+         with_ffi=False, **kwargs):
+    """Compile `sources` and load the library (cpp_extension.py:895).
+
+    ``with_ffi=True`` adds jaxlib's XLA FFI include root so sources can
+    implement custom-call handlers (see get_ffi_op)."""
     build_dir = build_directory or os.path.join(
         tempfile.gettempdir(), "paddle_tpu_extensions")
     os.makedirs(build_dir, exist_ok=True)
@@ -88,7 +129,12 @@ def load(name, sources, extra_cxx_cflags=None, extra_cflags=None,
     srcs = [str(s) for s in sources]
     newest = max(os.path.getmtime(s) for s in srcs)
     if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < newest:
-        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+        inc = []
+        if with_ffi:
+            import jax.ffi as jffi
+
+            inc = ["-I", jffi.include_dir()]
+        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"] + inc
                + (extra_cxx_cflags or extra_cflags or [])
                + ["-o", lib_path] + srcs + (extra_ldflags or []))
         if verbose:

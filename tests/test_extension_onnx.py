@@ -63,3 +63,66 @@ def test_elastic_manager_heartbeat():
     assert m.alive_ranks() == [0]
     assert not m.should_restart()
     m.exit()
+
+
+def test_ffi_device_kernel_custom_op():
+    """N38 device-kernel path (r4): a runtime-compiled C++ XLA FFI
+    handler executes INSIDE the jitted program as a custom-call — no
+    pure_callback host round-trip (parity:
+    fluid/framework/custom_operator.cc kernels run in the executor)."""
+    import os
+    import tempfile
+    import textwrap
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.utils import cpp_extension
+
+    src = textwrap.dedent("""
+        #include "xla/ffi/api/ffi.h"
+        namespace ffi = xla::ffi;
+
+        static ffi::Error CubeImpl(ffi::Buffer<ffi::F32> x,
+                                   ffi::ResultBuffer<ffi::F32> y) {
+          size_t n = x.element_count();
+          const float* in = x.typed_data();
+          float* out = y->typed_data();
+          for (size_t i = 0; i < n; ++i) out[i] = in[i] * in[i] * in[i];
+          return ffi::Error::Success();
+        }
+
+        XLA_FFI_DEFINE_HANDLER_SYMBOL(
+            Cube, CubeImpl,
+            ffi::Ffi::Bind()
+                .Arg<ffi::Buffer<ffi::F32>>()
+                .Ret<ffi::Buffer<ffi::F32>>());
+    """)
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "cube_ffi.cc")
+    with open(path, "w") as f:
+        f.write(src)
+    mod = cpp_extension.load("cube_ffi", [path], with_ffi=True,
+                             build_directory=d)
+    cube = mod.get_ffi_op("Cube")
+
+    x = paddle.to_tensor(np.arange(-3, 3, dtype=np.float32))
+    out = cube(x)
+    np.testing.assert_allclose(
+        out.numpy(), np.arange(-3, 3, dtype=np.float32) ** 3)
+
+    # runs INSIDE jit as a custom call (not pure_callback)
+    def f(xa):
+        call = jax.ffi.ffi_call(
+            "ptpu_cube_ffi_Cube", jax.ShapeDtypeStruct(xa.shape,
+                                                       np.float32))
+        return call(xa) + 1.0
+
+    jaxpr = str(jax.make_jaxpr(f)(x._data))
+    assert "ffi_call" in jaxpr or "custom_call" in jaxpr, jaxpr
+    assert "pure_callback" not in jaxpr
+    got = jax.jit(f)(x._data)
+    np.testing.assert_allclose(
+        np.asarray(got), np.arange(-3, 3, dtype=np.float32) ** 3 + 1.0)

@@ -362,3 +362,67 @@ def test_replica_anti_entropy_equal_counters_divergent_values():
     client.push_dense("w", np.asarray([0.0, 0.0, 1.0]))
     np.testing.assert_allclose(t0.value, t1.value)
     assert t0.version == t1.version
+
+
+def test_heter_sparse_cache_hot_rows_on_device():
+    """N40 heter-PS slot (r4): hot embedding rows live in ONE device
+    array gathered by slot; misses batch-pull from the PS; pushes
+    invalidate (server stays source of truth) — the TPU-native shape of
+    the reference's GPU-cached tables (ps_gpu_wrapper.cc)."""
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+    from paddle_tpu.distributed.ps.heter import HeterSparseCache
+
+    server = PSServer(0)
+    client = PSClient([server])
+    client.create_sparse_table("emb", dim=4, initializer="uniform",
+                               init_scale=0.5, seed=3)
+    cache = HeterSparseCache(client, "emb", dim=4, cache_rows=8)
+
+    # skewed access: hot ids repeat -> high hit rate after warmup
+    hot = [1, 2, 3]
+    for _ in range(10):
+        rows = cache.pull(hot)
+        assert rows.shape == (3, 4)
+    assert cache.hit_rate() > 0.8, cache.hit_rate()
+
+    # values match a direct PS pull exactly
+    direct = client.pull_sparse("emb", np.asarray(hot))
+    np.testing.assert_allclose(np.asarray(cache.pull(hot)),
+                               np.asarray(direct))
+
+    # push invalidates: the next pull sees the server-side SGD update
+    before = np.asarray(cache.pull([1]))[0].copy()
+    cache.push([1], np.ones((1, 4)))
+    after = np.asarray(cache.pull([1]))[0]
+    assert not np.allclose(before, after)
+    np.testing.assert_allclose(
+        after, np.asarray(client.pull_sparse("emb", np.asarray([1])))[0])
+
+    # eviction: touching > capacity distinct ids keeps size bounded
+    cache.pull(list(range(100, 120)))
+    assert len(cache._slot_of) <= 8
+
+
+def test_heter_cache_invalidate_then_insert_no_slot_alias():
+    """code-review r4: a push-freed slot must not alias a later insert
+    while below capacity, and a batch whose misses evict its own hits
+    must still return correct rows (output built before insertion)."""
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+    from paddle_tpu.distributed.ps.heter import HeterSparseCache
+
+    server = PSServer(0)
+    client = PSClient([server])
+    client.create_sparse_table("emb", dim=4, seed=5)
+    cache = HeterSparseCache(client, "emb", dim=4, cache_rows=2)
+
+    cache.pull([1, 2])                       # slots filled
+    cache.push([1], np.ones((1, 4)))         # frees id1's slot
+    cache.pull([1])                          # must NOT take id2's slot
+    r2 = np.asarray(cache.pull([2]))[0]
+    want2 = np.asarray(client.pull_sparse("emb", np.asarray([2])))[0]
+    np.testing.assert_allclose(r2, want2)
+
+    # same-batch eviction of a hit: cache={1,2}, pull([1, 10, 11])
+    out = np.asarray(cache.pull([1, 10, 11]))
+    want = np.asarray(client.pull_sparse("emb", np.asarray([1, 10, 11])))
+    np.testing.assert_allclose(out, want)

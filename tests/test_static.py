@@ -164,3 +164,56 @@ def test_save_inference_model_multi_dynamic_inputs_and_executor_run(tmp_path):
                        fetch_list=fetches)
         np.testing.assert_allclose(outs[0], 5.0)
         assert outs[0].shape == (batch, 4)
+
+
+class TestIrProgram:
+    """N20 closure (r4): the static Program has a real IR form — jaxpr
+    inspection, paddle.ir pass application, StableHLO serialization
+    (reference capability: pir::Program + PassManager +
+    fluid/pir/serialize_deserialize)."""
+
+    def _program(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.static as static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            # transpose pair: the rewrite pass must eliminate it
+            y = paddle.transpose(paddle.transpose(x, [1, 0]), [1, 0])
+            z = paddle.exp(y) * 2.0
+        return main, z
+
+    def test_jaxpr_inspection(self):
+        main, z = self._program()
+        ir = main.ir_module([z])
+        feed = {"x": np.ones((4, 3), np.float32)}
+        prims = [e.primitive.name for e in ir.jaxpr(feed).jaxpr.eqns]
+        assert "exp" in prims, prims
+        assert "transpose" in prims, prims
+
+    def test_pass_application_changes_ir_and_keeps_values(self):
+        from paddle_tpu.ir import TransposePairPattern
+
+        main, z = self._program()
+        ir = main.ir_module([z])
+        feed = {"x": np.random.RandomState(0).randn(4, 3).astype(np.float32)}
+        before = ir.run(feed)[0]
+        ir.apply(TransposePairPattern())
+        prims = [e.primitive.name for e in ir.jaxpr(feed).jaxpr.eqns]
+        assert "transpose" not in prims, prims
+        after = ir.run(feed)[0]
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+
+    def test_serialize_roundtrip(self, tmp_path):
+        import paddle_tpu.static as static
+
+        main, z = self._program()
+        ir = main.ir_module([z])
+        feed = {"x": np.random.RandomState(1).randn(4, 3).astype(np.float32)}
+        want = ir.run(feed)[0]
+        p = str(tmp_path / "prog.stablehlo")
+        ir.serialize(p, feed)
+        call = static.IrProgram.deserialize(p)
+        got = call(feed["x"])[0]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)

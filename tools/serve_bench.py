@@ -1,0 +1,62 @@
+"""Continuous-batching serving throughput on the real chip.
+
+r3 weak #9 / r4: the serving stack (batched chunked prefill + paged
+decode) had no recorded on-chip throughput. Run from /root/repo:
+    python tools/serve_bench.py
+Prints tok/s at several concurrency levels for a 1.3B-class decoder.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.getcwd())
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          num_layers=16, num_heads=16, max_seq_len=1024,
+                          dropout=0.0)
+        new_tokens, prompt_len = 64, 128
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, max_seq_len=128, dropout=0.0)
+        new_tokens, prompt_len = 8, 16
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        for _, p in model.named_parameters():
+            p._data = p._data.astype(jax.numpy.bfloat16)
+    rng = np.random.default_rng(0)
+
+    for slots in (2, 4, 8):
+        eng = ContinuousBatchingEngine(
+            model, max_slots=slots, page_size=64,
+            max_new_tokens=new_tokens, prefill_chunk=64)
+        n_req = slots * 2
+        for _ in range(n_req):
+            eng.submit(list(rng.integers(1, cfg.vocab_size,
+                                         prompt_len)))
+        t0 = time.perf_counter()
+        done = eng.run_until_complete(max_ticks=100000)
+        dt = time.perf_counter() - t0
+        gen = sum(len(v) - prompt_len for v in done.values())
+        print(f"slots={slots}: {n_req} reqs x {prompt_len}p+{new_tokens}g"
+              f" -> {gen} generated in {dt:.1f}s = {gen / dt:.1f} tok/s"
+              f" (prefill passes: {eng.prefill_chunk_steps})", flush=True)
+
+
+if __name__ == "__main__":
+    main()
