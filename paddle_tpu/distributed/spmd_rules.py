@@ -694,3 +694,163 @@ def spec_for(op_name, mesh, *specs, **attrs) -> PartitionSpec:
     """Rule-inferred PartitionSpec of the first output (resolved)."""
     _, outs = get_spmd_rule(op_name).infer_forward(*specs, **attrs)
     return outs[0].partition_spec(mesh.dim_names)
+
+
+# -- round-4 breadth: the remaining high-traffic yaml-keyed rules ------------
+# (reference: phi/infermeta/spmd_rules/ — 60 yaml-keyed ops; these close
+# the most-used gap on top of GSPMD-propagation-by-default)
+@register_spmd_rule("bmm")
+def _bmm_rule(x: DistTensorSpec, y: DistTensorSpec):
+    return einsum_infer("bmk,bkn->bmn", [x, y])
+
+
+def _identity_rule_factory(name):
+    @register_spmd_rule(name)
+    def _rule(x: DistTensorSpec, **attrs):
+        sub = _letters(x.ndim)
+        return einsum_infer(f"{sub}->{sub}", [x])
+    _rule.__name__ = f"_{name}_rule"
+    return _rule
+
+
+# layout-preserving unaries: sharding flows straight through
+for _n in ("flip", "roll", "tril", "scale", "clip", "pad"):
+    _identity_rule_factory(_n)
+
+
+@register_spmd_rule("fused_rotary_position_embedding")
+def _fused_rope_rule(*specs):
+    """Rope is elementwise-per-position over each of q/k/v (sin/cos
+    broadcast): every tensor input keeps its own sharding; one output
+    per input."""
+    subs = _broadcast_subs(specs).split("->")[0].split(",")
+    notation = ",".join(subs) + "->" + ",".join(subs)
+    return einsum_infer(notation, list(specs))
+
+
+def _axis_replicated_rule_factory(name, n_out=1):
+    @register_spmd_rule(name)
+    def _rule(x: DistTensorSpec, axis=-1, **attrs):
+        nd = x.ndim
+        axis %= nd
+        letters = _letters(nd)
+        sub = "".join("*" if i == axis else c
+                      for i, c in enumerate(letters))
+        outs = ",".join([sub] * n_out)
+        return einsum_infer(f"{sub}->{outs}", [x])
+    _rule.__name__ = f"_{name}_rule"
+    return _rule
+
+
+# ops whose working axis must be whole per device
+for _n in ("sort", "argsort", "cummax", "cummin", "logcumsumexp",
+           "kthvalue"):
+    _axis_replicated_rule_factory(
+        _n, n_out=2 if _n in ("cummax", "cummin", "kthvalue",
+                              "argsort") else 1)
+
+
+@register_spmd_rule("index_select")
+def _index_select_rule(x: DistTensorSpec, index: DistTensorSpec, axis=0):
+    nd = x.ndim
+    axis %= nd
+    letters = _letters(nd, skip="i")
+    x_sub = "".join("*" if i == axis else c
+                    for i, c in enumerate(letters))
+    out = "".join("i" if i == axis else c for i, c in enumerate(letters))
+    return einsum_infer(f"{x_sub},i->{out}", [x, index])
+
+
+def _along_axis_subs(specs, axis):
+    """Shared letters with the working axis replicated AND size-1 dims
+    broadcast-marked (the _broadcast_subs contract — a broadcast index
+    must not inherit a sharding its size-1 dim cannot carry)."""
+    nd = max(s.ndim for s in specs)
+    axis %= nd
+    letters = _letters(nd)
+    base = "".join("*" if i == axis else c for i, c in enumerate(letters))
+    subs = []
+    for s in specs:
+        sub = base[nd - s.ndim:]
+        sub = "".join("1" if s.shape[i] == 1 and c not in "*" else c
+                      for i, c in enumerate(sub))
+        subs.append(sub)
+    return subs, base
+
+
+@register_spmd_rule("take_along_axis")
+def _take_along_axis_rule(x: DistTensorSpec, index: DistTensorSpec,
+                          axis=0):
+    (x_sub, i_sub), out = _along_axis_subs([x, index], axis)
+    return einsum_infer(f"{x_sub},{i_sub}->{out}", [x, index])
+
+
+@register_spmd_rule("put_along_axis")
+def _put_along_axis_rule(x: DistTensorSpec, index: DistTensorSpec,
+                         value: DistTensorSpec, axis=0):
+    (x_sub, i_sub, v_sub), out = _along_axis_subs([x, index, value], axis)
+    return einsum_infer(f"{x_sub},{i_sub},{v_sub}->{out}", [x, index, value])
+
+
+@register_spmd_rule("one_hot")
+def _one_hot_rule(x: DistTensorSpec, num_classes=-1):
+    sub = _letters(x.ndim, skip="c")
+    return einsum_infer(f"{sub}->{sub}c", [x])
+
+
+@register_spmd_rule("conv")
+def _conv_rule(x: DistTensorSpec, w: DistTensorSpec, **attrs):
+    """NCHW conv: batch stays sharded, channels/spatial replicated
+    per-device (spatial sharding needs halo exchange — not expressed;
+    reference conv2d rule keeps the same contract). Weight layout
+    [C_out, C_in, *k]."""
+    nsp = x.ndim - 2
+    sp = "*" * nsp
+    return einsum_infer(f"bc{sp},oc{sp}->bo{sp}", [x, w])
+
+
+@register_spmd_rule("conv_transpose")
+def _conv_transpose_rule(x: DistTensorSpec, w: DistTensorSpec, **attrs):
+    """Transposed conv: weight layout [C_in, C_out, *k] — the CONTRACTED
+    channel comes first."""
+    nsp = x.ndim - 2
+    sp = "*" * nsp
+    return einsum_infer(f"bc{sp},co{sp}->bo{sp}", [x, w])
+
+
+@register_spmd_rule("pool")
+def _pool_rule(x: DistTensorSpec, **attrs):
+    nsp = x.ndim - 2
+    sub = "bc" + "".join("*" for _ in range(nsp))
+    return einsum_infer(f"{sub}->{sub}", [x])
+
+
+@register_spmd_rule("batched_linalg")
+def _batched_linalg_rule(*specs, out_ranks=None, **attrs):
+    """Batched dense linalg (cholesky/inv/solve/qr/svd...): batch dims
+    keep their sharding, trailing matrix dims compute whole per device.
+
+    ``out_ranks``: rank per output (default: one output ranked like the
+    FIRST input). Multi-output ops (qr/svd/lu/slogdet) and rank-reducing
+    ops (det) pass their true output ranks; every output carries the
+    merged batch sharding with its non-batch dims replicated."""
+    nb = max(specs[0].ndim - 2, 0)
+    in_subs = []
+    for s in specs:
+        b = max(s.ndim - 2, 0)
+        in_subs.append(_letters(nb)[nb - b:] + "*" * (s.ndim - b))
+    if out_ranks is None:
+        out_ranks = [specs[0].ndim]
+    out_subs = [_letters(nb)[: min(nb, r)] + "*" * (r - min(nb, r))
+                for r in out_ranks]
+    return einsum_infer(
+        ",".join(in_subs) + "->" + ",".join(out_subs), list(specs))
+
+
+@register_spmd_rule("group_norm")
+def _group_norm_rule(x: DistTensorSpec, scale=None, bias=None, **attrs):
+    # batch sharded; channel/spatial normalise per device
+    sub = "b" + "*" * (x.ndim - 1)
+    specs = [x] + [s for s in (scale, bias) if s is not None]
+    subs = [sub] + ["*" for s in (scale, bias) if s is not None]
+    return einsum_infer(",".join(subs) + f"->{sub}", specs)

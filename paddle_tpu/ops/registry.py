@@ -279,3 +279,36 @@ for _n in ("logsumexp", "nansum", "nanmean", "amax", "amin", "all", "any",
     _spec = _REGISTRY[_n]
     _REGISTRY[_n] = OpSpec(_spec.name, _spec.inplace, "reduction",
                            _spec.backward, _spec.tags)
+
+# r4: resolve the new explicit SPMD rules onto their registry entries
+# (reference: the `spmd_rule:` yaml key — ops.yaml:8-17)
+_SPMD_WIRING = {
+    "bmm": "bmm", "sort": "sort", "argsort": "argsort",
+    "cummax": "cummax", "cummin": "cummin",
+    "logcumsumexp": "logcumsumexp", "kthvalue": "kthvalue",
+    "index_select": "index_select",
+    "take_along_axis": "take_along_axis",
+    "put_along_axis": "put_along_axis", "one_hot": "one_hot",
+    "flip": "flip", "roll": "roll", "pad": "pad", "tril": "tril",
+    "scale": "scale", "clip": "clip", "group_norm": "group_norm",
+    "conv1d": "conv", "conv2d": "conv", "conv3d": "conv",
+    "conv1d_transpose": "conv_transpose",
+    "conv2d_transpose": "conv_transpose",
+    "conv3d_transpose": "conv_transpose",
+    "avg_pool1d": "pool", "avg_pool2d": "pool", "avg_pool3d": "pool",
+    "max_pool1d": "pool", "max_pool2d": "pool", "max_pool3d": "pool",
+    "adaptive_avg_pool2d": "pool", "adaptive_max_pool2d": "pool",
+    "cholesky": "batched_linalg", "inv": "batched_linalg",
+    "det": "batched_linalg", "slogdet": "batched_linalg",
+    "solve": "batched_linalg", "triangular_solve": "batched_linalg",
+    "cholesky_solve": "batched_linalg", "lu": "batched_linalg",
+    "qr": "batched_linalg", "svd": "batched_linalg",
+    "svdvals": "batched_linalg", "eigh": "batched_linalg",
+    "eigvalsh": "batched_linalg", "matrix_power": "batched_linalg",
+    "pinv": "batched_linalg", "matrix_rank": "batched_linalg",
+}
+for _n, _r in _SPMD_WIRING.items():
+    _spec = _REGISTRY.get(_n)
+    if _spec is not None and _spec.spmd_rule is None:
+        _REGISTRY[_n] = OpSpec(_spec.name, _spec.inplace, _r,
+                               _spec.backward, _spec.tags)

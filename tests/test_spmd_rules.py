@@ -339,3 +339,108 @@ class TestPartitionSpecExport:
     def test_unknown_rule_raises(self):
         with pytest.raises(KeyError):
             get_spmd_rule("definitely_not_an_op")
+
+
+class TestRound4Rules:
+    """r4 breadth rules (reference: phi/infermeta/spmd_rules/ — the
+    yaml-keyed surface): pure rule-level checks, no devices."""
+
+    def _spec(self, shape, mapping):
+        return DistTensorSpec(shape, list(mapping))
+
+    def test_bmm_batch_and_partial(self):
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+        r = get_spmd_rule("bmm")
+        ins, outs = r.infer_forward(self._spec([8, 16, 32], [0, -1, 1]),
+                                    self._spec([8, 32, 64], [0, 1, -1]))
+        # batch sharding flows; contracted k sharded -> partial output
+        assert outs[0].dims_mapping[0] == 0
+        assert 1 in getattr(outs[0], "partial_dims", set()) or \
+            outs[0].dims_mapping[1:] == [-1, -1]
+
+    def test_sort_axis_forced_replicated(self):
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+        r = get_spmd_rule("sort")
+        ins, outs = r.infer_forward(self._spec([16, 64], [0, 1]), axis=-1)
+        assert ins[0].dims_mapping == [0, -1]   # sort axis gathered
+        assert outs[0].dims_mapping == [0, -1]
+
+    def test_conv_keeps_batch_sharding(self):
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+        r = get_spmd_rule("conv")
+        ins, outs = r.infer_forward(
+            self._spec([32, 3, 28, 28], [0, -1, -1, -1]),
+            self._spec([16, 3, 3, 3], [-1, -1, -1, -1]))
+        assert outs[0].dims_mapping[0] == 0
+        assert outs[0].dims_mapping[2:] == [-1, -1]
+
+    def test_batched_linalg_keeps_batch_drops_matrix(self):
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+        r = get_spmd_rule("batched_linalg")
+        ins, outs = r.infer_forward(self._spec([4, 8, 8], [0, 1, -1]))
+        assert ins[0].dims_mapping == [0, -1, -1]
+        assert outs[0].dims_mapping == [0, -1, -1]
+
+    def test_one_hot_appends_replicated_class_dim(self):
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+        r = get_spmd_rule("one_hot")
+        ins, outs = r.infer_forward(self._spec([16, 32], [0, 1]))
+        assert outs[0].dims_mapping == [0, 1, -1]
+
+    def test_registry_wiring_resolves(self):
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+        from paddle_tpu.ops.registry import registered_ops
+
+        wired = [s for s in registered_ops().values()
+                 if s.spmd_rule is not None]
+        assert len(wired) >= 90, len(wired)
+        for s in wired:
+            get_spmd_rule(s.spmd_rule)  # raises if unresolvable
+
+    def test_conv_transpose_weight_layout(self):
+        """code-review r4: transposed conv weights are [C_in, C_out, *k]
+        — the contracted channel comes FIRST."""
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+        r = get_spmd_rule("conv_transpose")
+        ins, outs = r.infer_forward(
+            self._spec([8, 3, 10, 10], [0, -1, -1, -1]),
+            self._spec([3, 16, 3, 3], [-1, 1, -1, -1]))
+        # out channels (w dim 1) sharding flows to output dim 1
+        assert outs[0].dims_mapping[0] == 0
+        assert outs[0].dims_mapping[1] == 1
+
+    def test_fused_rope_multi_arity(self):
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+        r = get_spmd_rule("fused_rotary_position_embedding")
+        q = self._spec([2, 8, 4, 16], [0, -1, 1, -1])
+        k = self._spec([2, 8, 4, 16], [0, -1, 1, -1])
+        ins, outs = r.infer_forward(q, k)
+        assert len(outs) == 2
+        assert outs[0].dims_mapping == [0, -1, 1, -1]
+
+    def test_take_along_axis_broadcast_index(self):
+        """A size-1 index dim must not inherit a sharding it can't carry."""
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+        r = get_spmd_rule("take_along_axis")
+        ins, outs = r.infer_forward(self._spec([32, 64], [0, -1]),
+                                    self._spec([1, 64], [-1, -1]), axis=1)
+        assert ins[1].dims_mapping[0] == -1   # broadcast dim replicated
+        assert outs[0].dims_mapping[0] == 0
+
+    def test_batched_linalg_multi_output_ranks(self):
+        from paddle_tpu.distributed.spmd_rules import get_spmd_rule
+
+        r = get_spmd_rule("batched_linalg")
+        # slogdet-style: two outputs of rank nb (sign, logdet)
+        ins, outs = r.infer_forward(self._spec([4, 8, 8], [0, -1, -1]),
+                                    out_ranks=[1, 1])
+        assert len(outs) == 2
+        assert outs[0].dims_mapping == [0]
