@@ -1,0 +1,32 @@
+"""Quickstart 1: train LeNet on MNIST-shaped data with Model.fit
+(BASELINE.md config 1). Runs anywhere:
+    JAX_PLATFORMS=cpu python examples/01_train_mnist.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.vision.models import LeNet
+
+
+def main():
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    # synthetic MNIST-shaped data (swap in paddle.vision.datasets.MNIST)
+    x = rng.standard_normal((256, 1, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, (256,)).astype(np.int64)
+    loader = DataLoader(TensorDataset([x, y]), batch_size=64, shuffle=True)
+
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    model.fit(loader, epochs=2, verbose=1)
+    print("final eval:", model.evaluate(loader, verbose=0))
+
+
+if __name__ == "__main__":
+    main()

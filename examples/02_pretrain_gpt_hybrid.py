@@ -1,0 +1,48 @@
+"""Quickstart 2: decoder-only pretraining on a hybrid-parallel mesh
+(fleet dp x mp, BASELINE.md config 4 shape). On one host:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/02_pretrain_gpt_hybrid.py
+On a pod, launch one process per host with
+`python -m paddle_tpu.distributed.launch` and the same body.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
+
+
+def main():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=4,
+                    num_heads=4, max_seq_len=256, dropout=0.0)
+    model = GPTForCausalLMPipe(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+
+    dmodel = fleet.distributed_model(model)
+    dopt = fleet.distributed_optimizer(opt)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (8, 128)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (8, 128)).astype(np.int64))
+
+    def lm_loss(logits, y):
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), y.reshape([-1]))
+
+    for step in range(5):
+        loss = dmodel.train_batch([ids, labels], dopt, loss_fn=lm_loss)
+        print(f"step {step}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""The examples/ quickstarts must stay runnable (they are the first
+thing a reference user tries)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EX = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+@pytest.mark.parametrize("script", [
+    "01_train_mnist.py",
+    "02_pretrain_gpt_hybrid.py",
+    "03_serve_llm.py",
+])
+def test_example_runs(script):
+    env = dict(os.environ)
+    # prepend (don't clobber) so machines relying on PYTHONPATH keep it;
+    # JAX_PLATFORMS/XLA_FLAGS are inherited from conftest.py's setup
+    env["PYTHONPATH"] = os.pathsep.join(filter(None, [
+        os.path.abspath(os.path.join(_EX, "..")),
+        os.environ.get("PYTHONPATH", "")]))
+    r = subprocess.run([sys.executable, os.path.join(_EX, script)],
+                       capture_output=True, text=True, timeout=280,
+                       env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
