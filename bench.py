@@ -78,10 +78,14 @@ def run_model(model_kind):
             p._data = p._data.astype(jax.numpy.bfloat16)
 
     # PTPU_ADAM8=1: blockwise-int8 moments (8-bit Adam) — frees ~4GB of
-    # optimizer HBM at 1.3B, buying remat headroom (r4)
+    # optimizer HBM at 1.3B, buying remat headroom (r4; measured LOSING
+    # on this chip, defaults off — docs/ROUND4_RESPONSE.md)
+    # PTPU_ADAM_FACTORED=1: Adafactor-style factored second moment —
+    # frees ~2.6GB (m2) with fp32 math, no quant round-trips (r5)
     opt = paddle.optimizer.AdamW(
         learning_rate=3e-4, parameters=model.parameters(),
-        moment_dtype="int8" if os.environ.get("PTPU_ADAM8") else None)
+        moment_dtype="int8" if os.environ.get("PTPU_ADAM8") else None,
+        factored=bool(os.environ.get("PTPU_ADAM_FACTORED")))
 
     def train_fn(ids, labels):
         # fused chunked head+CE: full logits never materialize (models/gpt.py)

@@ -135,7 +135,7 @@ class ShardedTrainStep(TrainStep):
         if self._opt_state is None:
             entries = self.model.state_dict()
             params = {n: entries[n]._data for n in self._param_names}
-            self._opt_state = self.optimizer.functional_state(params)
+            self._opt_state = self._init_opt_state(params)
             self._place_opt_state(params)
         return self._place_batch(raw_batch)
 
@@ -149,7 +149,7 @@ class ShardedTrainStep(TrainStep):
         entries = self.model.state_dict()
         params = {n: entries[n]._data for n in self._param_names}
         if first_state:
-            self._opt_state = self.optimizer.functional_state(params)
+            self._opt_state = self._init_opt_state(params)
             self._place_opt_state(params)
         raw_batch = self._place_batch(_unwrap_tensors(batch))
         buffers = {n: entries[n]._data for n in self._buffer_names}

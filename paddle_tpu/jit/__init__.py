@@ -438,7 +438,7 @@ class TrainStep:
         params = {n: entries[n]._data for n in self._param_names}
         buffers = {n: entries[n]._data for n in self._buffer_names}
         if self._opt_state is None:
-            self._opt_state = self.optimizer.functional_state(params)
+            self._opt_state = self._init_opt_state(params)
         lr = self.optimizer.get_lr()
         key_arr = framework.next_rng_key()
         raw_batch = _unwrap_tensors(batch)
@@ -474,7 +474,7 @@ class TrainStep:
         entries = self.model.state_dict()
         params = {n: entries[n]._data for n in self._param_names}
         buffers = {n: entries[n]._data for n in self._buffer_names}
-        opt_state = self._opt_state or self.optimizer.functional_state(params)
+        opt_state = self._opt_state or self._init_opt_state(params)
         lr = self.optimizer.get_lr()
         key_arr = framework.next_rng_key()
         ma = self._compiled.lower(
@@ -493,6 +493,30 @@ class TrainStep:
         """Hook: sharded subclasses place batch arrays on the mesh so the
         lowered program sees the same input shardings as a real step."""
         return raw_batch
+
+    def _init_opt_state(self, params):
+        """Fresh functional slots, seeded from any eager slots already on
+        the optimizer — the checkpoint-restore path: set_state_dict fills
+        optimizer._slots, and a resumed compiled step must continue from
+        those moments, not from zeros (reference resume semantics:
+        opt.set_state_dict before the next train_batch)."""
+        state = self.optimizer.functional_state(params)
+        entries = self.model.state_dict()
+        for n in self._param_names:
+            slots = self.optimizer._slots.get(id(entries[n]))
+            if slots:
+                st = dict(state[n])
+                for k, v in slots.items():
+                    if k in st:
+                        # COPY: the compiled step donates opt state
+                        # (donate_argnums) — seeding by reference would let
+                        # the first step delete the eager slot buffers and
+                        # the checkpoint arrays they share
+                        st[k] = jnp.array(
+                            v._data if isinstance(v, Tensor) else v,
+                            copy=True)
+                state[n] = st
+        return state
 
     def sync_optimizer_state(self):
         """Push functional opt state back into the eager optimizer slots."""

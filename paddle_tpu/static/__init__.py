@@ -295,8 +295,24 @@ class Executor:
                 return new_params, new_state, fetches
 
             if not hasattr(program, "_opt_state"):
+                import jax.numpy as jnp
+
                 named = {str(i): a for i, a in enumerate(param_arrays)}
-                program._opt_state = opt.functional_state(named)
+                state = opt.functional_state(named)
+                # seed from eager slots (set_state_dict resume path) —
+                # same contract as jit.TrainStep._init_opt_state; COPY so
+                # later donation/deletion can't reach the restored arrays
+                for i, p in enumerate(params):
+                    slots = opt._slots.get(id(p))
+                    if slots:
+                        st = dict(state[str(i)])
+                        for k, v in slots.items():
+                            if k in st:
+                                st[k] = jnp.array(
+                                    v._data if isinstance(v, Tensor) else v,
+                                    copy=True)
+                        state[str(i)] = st
+                program._opt_state = state
                 program._compiled = jax.jit(train_step)
             new_params, program._opt_state, fetches = program._compiled(
                 param_arrays, feed_arrays, opt.get_lr(), program._opt_state)

@@ -1,0 +1,90 @@
+"""Hybrid (dp×mp) ShardedTrainStep worker for multi-controller parity.
+
+Dual-mode: `single` runs 1 process × 8 devices (the reference run);
+`dist` is spawned twice by the launch CLI (2 controllers × 4 devices =
+the same 8-device global mesh). Both modes execute the IDENTICAL model /
+seed / batch / step code, so step-for-step loss parity proves the
+multi-controller TRAINING path end to end — the reference's dominant
+distributed test discipline (test/legacy_test/test_dist_base.py:957
+loss-parity across spawned trainers; hybrid LLaMA in
+test/auto_parallel/hybrid_strategy/).
+"""
+import json
+import os
+import sys
+
+MODE = sys.argv[1] if len(sys.argv) > 1 else "single"
+n_local = "8" if MODE == "single" else "4"
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={n_local}"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+
+
+def main():
+    if MODE == "dist":
+        dist.init_parallel_env()
+        assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    from paddle_tpu.distributed import fleet, ShardedTrainStep
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4,
+                               "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = fleet.get_fleet_mesh()
+
+    paddle.seed(7)
+
+    class TinyTP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = fleet.VocabParallelEmbedding(64, 32)
+            self.col = fleet.ColumnParallelLinear(32, 64,
+                                                  gather_output=False)
+            self.row = fleet.RowParallelLinear(64, 32,
+                                               input_is_parallel=True)
+
+        def forward(self, x):
+            h = self.embed(x)
+            h = self.col(h)
+            h = paddle.nn.functional.relu(h)
+            return self.row(h)
+
+    model = TinyTP()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.integers(0, 64, (16, 8)).astype(np.int32))
+    y = paddle.to_tensor(rng.normal(size=(16, 8, 32)).astype(np.float32))
+
+    def fn(xb, yb):
+        return ((model(xb) - yb) ** 2).mean()
+
+    step = ShardedTrainStep(model, fn, opt, mesh=mesh)
+    losses = [float(step(x, y).numpy()) for _ in range(10)]
+
+    rank = dist.get_rank() if MODE == "dist" else 0
+    out = os.environ.get("PTPU_PARITY_OUT")
+    if rank == 0 and out:
+        with open(out, "w") as f:
+            json.dump(losses, f)
+    if MODE == "dist":
+        dist.barrier()
+    print(f"TRAIN_WORKER_OK rank={rank} mode={MODE}")
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

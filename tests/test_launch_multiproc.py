@@ -50,3 +50,58 @@ def test_launch_two_process_collectives(tmp_path):
     assert proc.returncode == 0, (proc.stdout[-1000:], proc.stderr[-1000:],
                                   logs[-4000:])
     assert logs.count("WORKER_OK") == 2, logs[-4000:]
+
+
+@pytest.mark.slow
+def test_two_process_hybrid_train_loss_parity(tmp_path):
+    """VERDICT r4 item 2: the multi-controller TRAINING path. 2 OS
+    processes x 4 devices run a dp2 x mp4 ShardedTrainStep for 10 steps;
+    losses must match the 1-process x 8-device run step for step
+    (reference discipline: test/legacy_test/test_dist_base.py:957)."""
+    worker = os.path.join(os.path.dirname(__file__), "launch_assets",
+                          "hybrid_train_worker.py")
+    base_env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "JAX_PLATFORMS": "cpu",
+    }
+
+    ref_out = tmp_path / "ref.json"
+    proc = subprocess.run(
+        [sys.executable, worker, "single"],
+        capture_output=True, text=True, timeout=600,
+        env={**base_env, "PTPU_PARITY_OUT": str(ref_out)},
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    ref = __import__("json").loads(ref_out.read_text())
+
+    port = _free_port()
+    dist_out = tmp_path / "dist.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}",
+         "--nnodes", "1", "--nproc_per_node", "2",
+         "--log_dir", str(tmp_path / "logs"),
+         worker, "dist"],
+        capture_output=True, text=True, timeout=600,
+        env={**base_env, "PTPU_PARITY_OUT": str(dist_out)},
+        cwd=str(tmp_path),
+    )
+    logs = ""
+    log_dir = tmp_path / "logs"
+    if log_dir.exists():
+        for f in sorted(log_dir.iterdir()):
+            logs += f"\n--- {f.name} ---\n" + f.read_text()[-2000:]
+    assert proc.returncode == 0, (proc.stdout[-1000:], proc.stderr[-1000:],
+                                  logs[-4000:])
+    assert logs.count("TRAIN_WORKER_OK") == 2, logs[-4000:]
+    got = __import__("json").loads(dist_out.read_text())
+    assert len(ref) == len(got) == 10
+    # identical global mesh, devices, and program -> near-bitwise parity;
+    # tolerance covers CPU collective reduction-order noise only
+    import numpy as np
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # and it actually trained
+    assert ref[-1] < ref[0] * 0.9

@@ -217,3 +217,63 @@ class TestIrProgram:
         call = static.IrProgram.deserialize(p)
         got = call(feed["x"])[0]
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_static_executor_resumes_from_restored_slots():
+    """Optimizer slots restored via set_state_dict must seed the static
+    Executor's compiled opt state (same resume contract as TrainStep)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    def build(opt_factory):
+        paddle.seed(3)
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 4])
+            label = static.data("label", [8, 1])
+            net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(),
+                                nn.Linear(16, 1))
+            pred = net(x)
+            loss = ((pred - label) ** 2).mean()
+            opt = opt_factory(net.parameters())
+            opt.minimize(loss)
+        return main, startup, loss, net, opt
+
+    rng = np.random.RandomState(1)
+    xs = rng.randn(8, 4).astype(np.float32)
+    ys = (xs.sum(1, keepdims=True) * 0.5).astype(np.float32)
+
+    def adam(ps):
+        return paddle.optimizer.Adam(learning_rate=0.05, parameters=ps)
+
+    # uninterrupted: 8 steps
+    main, startup, loss, _, _ = build(adam)
+    exe = static.Executor()
+    exe.run(startup)
+    straight = [float(exe.run(main, feed={"x": xs, "label": ys},
+                              fetch_list=[loss])[0]) for _ in range(8)]
+
+    # interrupted at 4: fresh optimizer restored from state_dict, fresh
+    # Program compile (drop _opt_state), resume 4 more
+    main2, startup2, loss2, net2, opt2 = build(adam)
+    exe2 = static.Executor()
+    exe2.run(startup2)
+    first = [float(exe2.run(main2, feed={"x": xs, "label": ys},
+                            fetch_list=[loss2])[0]) for _ in range(4)]
+    # static path keeps slots in program._opt_state; pull them back out
+    params = [p for p in net2.parameters()]
+    for i, p in enumerate(params):
+        if str(i) in getattr(main2, "_opt_state", {}):
+            opt2._slots[id(p)] = main2._opt_state[str(i)]
+    sd = opt2.state_dict()
+    opt3 = adam(net2.parameters())
+    opt3.set_state_dict(sd)
+    main2._minimize = (opt3, main2._minimize[1])
+    for attr in ("_opt_state", "_compiled"):
+        if hasattr(main2, attr):
+            delattr(main2, attr)
+    resumed = first + [float(exe2.run(main2, feed={"x": xs, "label": ys},
+                                      fetch_list=[loss2])[0])
+                       for _ in range(4)]
+    np.testing.assert_allclose(resumed, straight, rtol=1e-5, atol=1e-6)

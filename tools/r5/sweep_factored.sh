@@ -1,0 +1,18 @@
+#!/bin/bash
+# r5 factored-AdamW A/B sweep — GPT headline arms, sequential.
+cd /root/repo
+NAMES_BASE="names:attn_res,attn_lse,attn_q,attn_k,attn_v,resid_mid,rms_rstd"
+NAMES_GATE="${NAMES_BASE},ffn_gate"
+NAMES_GU="${NAMES_BASE},ffn_gate,ffn_up"
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PTPU_BENCH_MODEL=gpt timeout 900 python bench.py 2>&1 | tail -4
+  echo "=== END $label ==="
+}
+run base_ctrl
+run A_fact PTPU_ADAM_FACTORED=1
+run B_fact_gate PTPU_ADAM_FACTORED=1 PTPU_BENCH_REMAT="$NAMES_GATE"
+run C_fact_b5 PTPU_ADAM_FACTORED=1 PTPU_BENCH_BATCH=5
+run D_fact_gu PTPU_ADAM_FACTORED=1 PTPU_BENCH_REMAT="$NAMES_GU"
+run E_fact_gate_b5 PTPU_ADAM_FACTORED=1 PTPU_BENCH_BATCH=5 PTPU_BENCH_REMAT="$NAMES_GATE"

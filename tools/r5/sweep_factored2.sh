@@ -1,0 +1,19 @@
+#!/bin/bash
+# r5 follow-up: bwd-block probe on winning GPT config + llama factored arms.
+# Runs from a frozen snapshot so repo edits can't race arm transitions.
+cd /root/repo
+SNAP=/tmp/snap_r5
+NAMES_BASE="names:attn_res,attn_lse,attn_q,attn_k,attn_v,resid_mid,rms_rstd"
+NAMES_GATE="${NAMES_BASE},ffn_gate"
+NAMES_GU="${NAMES_BASE},ffn_gate,ffn_up"
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP timeout 1200 python $SNAP/bench.py 2>&1 | tail -12
+  echo "=== END $label ==="
+}
+run F_gpt_gate_bwd2048 PTPU_BENCH_MODEL=gpt PTPU_ADAM_FACTORED=1 PTPU_BENCH_REMAT="$NAMES_GATE" PTPU_FA_BWD_BLOCK=2048
+run L_llama_fact PTPU_BENCH_MODEL=llama PTPU_ADAM_FACTORED=1
+run L_llama_fact_gate PTPU_BENCH_MODEL=llama PTPU_ADAM_FACTORED=1 PTPU_BENCH_REMAT="$NAMES_GATE"
+run L_llama_fact_b4 PTPU_BENCH_MODEL=llama PTPU_ADAM_FACTORED=1 PTPU_BENCH_BATCH=4
+run L_llama_fact_gate_b4 PTPU_BENCH_MODEL=llama PTPU_ADAM_FACTORED=1 PTPU_BENCH_REMAT="$NAMES_GATE" PTPU_BENCH_BATCH=4
