@@ -40,10 +40,16 @@ def run_model(model_kind):
         os.environ.setdefault("PTPU_PALLAS_RMS", "1")
         os.environ.setdefault("PTPU_INT8_HEAD", "1")
         os.environ.setdefault("PTPU_FA_BLOCK", "2048")
+        # r5: factored second-moment AdamW frees the m2 state (~2.6GB at
+        # 1.3B); the headroom buys BOTH ffn saves at batch 3 — the
+        # backward re-runs no FFN matmuls at all. Measured (tools/r5
+        # sweeps): GPT 0.5468 -> 0.5629, LLaMA 0.5806 -> 0.638.
+        # bwd-block-2048 stays dead (scoped-VMEM OOM, not HBM).
+        os.environ.setdefault("PTPU_ADAM_FACTORED", "1")
         policy = os.environ.get(
             "PTPU_BENCH_REMAT",
             "names:attn_res,attn_lse,attn_q,attn_k,attn_v,resid_mid,"
-            "rms_rstd")
+            "rms_rstd,ffn_gate,ffn_up")
         if model_kind == "llama":
             # BASELINE.md config-5 variant: LLaMA-7B architecture
             # (h=4096, GQA, swiglu, rope) depth-scaled to 8 layers so
@@ -62,7 +68,7 @@ def run_model(model_kind):
                             dropout=0.0, dtype="bfloat16",
                             recompute=policy != "none",
                             recompute_policy=policy)
-            batch = int(os.environ.get("PTPU_BENCH_BATCH", "4"))
+            batch = int(os.environ.get("PTPU_BENCH_BATCH", "3"))
         seq, steps = 2048, 10
     else:  # smoke path for CPU dev runs
         cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
@@ -84,8 +90,9 @@ def run_model(model_kind):
     # frees ~2.6GB (m2) with fp32 math, no quant round-trips (r5)
     opt = paddle.optimizer.AdamW(
         learning_rate=3e-4, parameters=model.parameters(),
-        moment_dtype="int8" if os.environ.get("PTPU_ADAM8") else None,
-        factored=bool(os.environ.get("PTPU_ADAM_FACTORED")))
+        moment_dtype=("int8" if os.environ.get("PTPU_ADAM8", "")
+                      not in ("", "0") else None),
+        factored=os.environ.get("PTPU_ADAM_FACTORED", "") not in ("", "0"))
 
     def train_fn(ids, labels):
         # fused chunked head+CE: full logits never materialize (models/gpt.py)

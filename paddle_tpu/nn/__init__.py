@@ -1,6 +1,7 @@
 """paddle.nn namespace (parity: python/paddle/nn/__init__.py)."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
 from .layer.layers import Layer  # noqa: F401
 from .layer.common import (  # noqa: F401
     Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding,

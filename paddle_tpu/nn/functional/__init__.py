@@ -14,6 +14,7 @@ from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .flash_attention import (  # noqa: F401
     flash_attention,
+    flash_attn_unpadded,
     flashmask_attention,
     scaled_dot_product_attention,
     sdp_kernel,

@@ -216,3 +216,47 @@ def sdp_kernel(*a, **k):  # compat context manager
     import contextlib
 
     return contextlib.nullcontext()
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen attention over packed sequences (parity:
+    nn/functional/flash_attention.py:756 flash_attn_unpadded).
+
+    query/key/value: [total_tokens, num_heads, head_dim] with sequences
+    packed back to back; cu_seqlens_*: [batch+1] int32 cumulative
+    offsets. TPU-native form: one dense segment-masked attention — the
+    segment-id mask keeps cross-sequence scores at -inf and XLA fuses the
+    mask into the softmax; per-sequence dynamic shapes would defeat the
+    compiler, so the packed layout IS the fast path on TPU."""
+    def _varlen(q, k, v, cq, ck):
+        tq, h, d = q.shape
+        tk = k.shape[0]
+        # segment id per token: index of the sequence it belongs to
+        seg_q = jnp.searchsorted(cq, jnp.arange(tq), side="right") - 1
+        seg_k = jnp.searchsorted(ck, jnp.arange(tk), side="right") - 1
+        # position within the sequence (for causal masking)
+        pos_q = jnp.arange(tq) - cq[seg_q]
+        pos_k = jnp.arange(tk) - ck[seg_k]
+        qf = q.astype(jnp.float32) * scale
+        logits = jnp.einsum("qhd,khd->hqk", qf, k.astype(jnp.float32))
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            mask = mask & (pos_k[None, :] <= pos_q[:, None])
+        logits = jnp.where(mask[None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if dropout > 0.0 and training:
+            from ... import framework
+
+            keep = jax.random.bernoulli(
+                framework.next_rng_key(), 1.0 - dropout, probs.shape)
+            probs = probs * keep / (1.0 - dropout)
+        out = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    out = apply_op(_varlen, query, key, value, cu_seqlens_q, cu_seqlens_k,
+                   _op_name="flash_attn_unpadded")
+    return out, None

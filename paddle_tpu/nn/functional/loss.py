@@ -309,3 +309,45 @@ def soft_margin_loss(input, label, reduction="mean", name=None):
         lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction),
         input, label, _op_name="soft_margin_loss",
     )
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance between token sequences (parity:
+    nn/functional/loss.py edit_distance). Host-side DP — a metric over
+    int sequences, not a differentiable op. Returns (distances [B, 1]
+    float32, sequence_num [1] int64)."""
+    import numpy as np
+
+    from ...core.tensor import Tensor as _T
+
+    a = np.asarray(input.numpy() if hasattr(input, "numpy") else input)
+    b = np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    a_len = (np.asarray(input_length.numpy()).reshape(-1)
+             if input_length is not None else
+             np.full((a.shape[0],), a.shape[1], np.int64))
+    b_len = (np.asarray(label_length.numpy()).reshape(-1)
+             if label_length is not None else
+             np.full((b.shape[0],), b.shape[1], np.int64))
+    ignored = set(ignored_tokens or ())
+
+    def _dist(x, y):
+        x = [t for t in x if t not in ignored]
+        y = [t for t in y if t not in ignored]
+        prev = list(range(len(y) + 1))
+        for i, xi in enumerate(x, 1):
+            cur = [i] + [0] * len(y)
+            for j, yj in enumerate(y, 1):
+                cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                             prev[j - 1] + (xi != yj))
+            prev = cur
+        return prev[-1], len(y)
+
+    out = np.zeros((a.shape[0], 1), np.float32)
+    for r in range(a.shape[0]):
+        d, ly = _dist(a[r, :a_len[r]].tolist(), b[r, :b_len[r]].tolist())
+        out[r, 0] = d / max(ly, 1) if normalized else d
+    import jax.numpy as _jnp
+
+    return (_T(_jnp.asarray(out)),
+            _T(_jnp.asarray([a.shape[0]], _jnp.int64)))

@@ -1,9 +1,14 @@
-"""paddle.nn.quant (parity: nn/quant/qat + weight-only linear ops).
+"""paddle.nn.quant — weight-only / LLM.int8 quantized linear surface.
 
-weight_quantize/weight_only_linear implement real int8 weight-only
-quantization in jnp (per-channel absmax scales, int8 storage, dequant
-fused into the matmul) — the TPU form of the reference's CUDA
-weight-only kernels."""
+Parity: python/paddle/nn/quant/quantized_linear.py (weight_quantize:64,
+weight_dequantize:130, weight_only_linear:230, llm_int8_linear:285,
+apply_per_channel_scale:351). TPU-native form: int8 storage with
+per-out-channel (or grouped) fp32 absmax scales; the dequant fuses into
+the matmul under XLA, and the LLM.int8 inlier path runs a REAL
+int8 x int8 matmul (v5e MXU runs int8 at 2x the bf16 rate) with the
+fp outlier columns handled densely — the TPU analogue of the CUDA
+cutlass int8 kernels the reference dispatches to.
+"""
 from __future__ import annotations
 
 import jax.numpy as jnp
@@ -12,7 +17,11 @@ from ...core.dispatch import apply_op
 from ...nn.layer.layers import Layer
 
 __all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
-           "weight_quantize", "weight_dequantize"]
+           "weight_quantize", "weight_dequantize",
+           "apply_per_channel_scale"]
+
+_QMAX = {"weight_only_int8": 127.0, "llm.int8": 127.0,
+         "weight_only_int4": 7.0}
 
 
 class Stub(Layer):
@@ -27,34 +36,75 @@ class Stub(Layer):
         return x
 
 
+def _check_group(group_size):
+    if group_size not in (-1, 64, 128):
+        raise ValueError(f"group_size must be -1/64/128, got {group_size}")
+
+
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
-    """weight [in, out] -> (int8 weight, per-out-channel fp scales)."""
-    if algo not in ("weight_only_int8", "llm.int8"):
-        raise NotImplementedError(f"algo {algo!r}: int8 weight-only is the "
-                                  "TPU path (int4 needs packing support)")
+    """x [k, n] -> (int8 weight [n, k] (transposed, reference layout),
+    scale [n] fp32) — per-out-channel absmax; group_size 64/128 gives
+    grouped scales [n, k/g]. int4 quantizes to the +/-7 range (stored
+    int8: TPU has no packed-int4 compute; the memory claim is halved not
+    quartered, stated honestly)."""
+    _check_group(group_size)
+    if algo not in _QMAX:
+        raise NotImplementedError(f"algo {algo!r}")
+    qmax = _QMAX[algo]
 
     def _q(w):
-        scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
-        scale = jnp.maximum(scale, 1e-10)
-        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
-        return q.astype(jnp.int8), scale.astype(jnp.float32)
+        wt = w.astype(jnp.float32).T  # [n, k]
+        if group_size == -1:
+            s = jnp.maximum(jnp.max(jnp.abs(wt), axis=1), 1e-10) / qmax
+            q = jnp.clip(jnp.round(wt / s[:, None]), -qmax, qmax)
+            return q.astype(jnp.int8), s.astype(jnp.float32)
+        n, k = wt.shape
+        g = wt.reshape(n, k // group_size, group_size)
+        s = jnp.maximum(jnp.max(jnp.abs(g), axis=2), 1e-10) / qmax
+        q = jnp.clip(jnp.round(g / s[:, :, None]), -qmax, qmax)
+        return (q.reshape(n, k).astype(jnp.int8), s.astype(jnp.float32))
 
     return apply_op(_q, x, _op_name="weight_quantize")
 
 
-def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float16", group_size=-1):
+    """int8 [n, k] + scale -> fp [k, n] (transposed back)."""
+    _check_group(group_size)
+
     def _dq(q, s):
-        return (q.astype(jnp.float32) * s).astype(jnp.bfloat16)
+        qf = q.astype(jnp.float32)
+        if s.ndim == 1:
+            w = qf * s[:, None]
+        else:  # grouped [n, k/g]
+            n, k = qf.shape
+            w = (qf.reshape(n, -1, k // s.shape[1]) * s[:, :, None]
+                 ).reshape(n, k)
+        return w.T.astype(jnp.dtype(out_dtype))
 
     return apply_op(_dq, x, scale, _op_name="weight_dequantize")
 
 
+def _dequant_nk(q, s):
+    """[n,k] int8 + per-channel/grouped scale -> fp32 [n,k]."""
+    qf = q.astype(jnp.float32)
+    if s is None:
+        return qf
+    if s.ndim == 1:
+        return qf * s[:, None]
+    n, k = qf.shape
+    return (qf.reshape(n, s.shape[1], k // s.shape[1]) * s[:, :, None]
+            ).reshape(n, k)
+
+
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
-    """y = x @ dequant(weight) + bias with int8-stored weights."""
+    """y[.., n] = x[.., k] @ dequant(weight[n, k]).T + bias."""
+    _check_group(group_size)
+
     def _wol(a, q, s, b):
-        w = q.astype(jnp.float32) * s
-        out = a.astype(jnp.float32) @ w
+        w = _dequant_nk(q, s)  # [n, k]
+        out = a.astype(jnp.float32) @ w.T
         if b is not None:
             out = out + b
         return out.astype(a.dtype)
@@ -65,18 +115,42 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
 
 def llm_int8_linear(x, weight, bias=None, weight_scale=None,
                     threshold=6.0):
-    """LLM.int8(): outlier activation columns in fp, the rest int8."""
+    """LLM.int8() mixed decomposition (Dettmers et al.): activation
+    columns whose absmax exceeds `threshold` go through the fp path;
+    the inlier columns run int8(act) x int8(weight) on the MXU."""
     def _l8(a, q, s, b):
+        from jax import lax
+
+        if s is not None and s.ndim != 1:
+            raise ValueError("llm_int8_linear requires per-channel scales")
         af = a.astype(jnp.float32)
-        outlier = jnp.max(jnp.abs(af), axis=tuple(range(af.ndim - 1))) \
-            > threshold
-        w = q.astype(jnp.float32) * s
-        dense = af * (~outlier)   # int8-quantized columns
-        sparse = af * outlier     # fp outlier columns (LLM.int8 split)
-        out = dense @ w + sparse @ w
+        flat = af.reshape(-1, af.shape[-1])
+        outlier = jnp.max(jnp.abs(flat), axis=0) > threshold  # [k]
+        inl = jnp.where(outlier[None, :], 0.0, flat)
+        # per-row absmax int8 activations on the inlier columns
+        a_s = jnp.maximum(jnp.max(jnp.abs(inl), axis=1), 1e-10) / 127.0
+        a_q = jnp.clip(jnp.round(inl / a_s[:, None]), -127, 127
+                       ).astype(jnp.int8)
+        q_in = jnp.where(outlier[None, :], 0, q).astype(jnp.int8)
+        acc = lax.dot_general(
+            a_q, q_in, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)  # [rows, n] int32
+        dense = acc.astype(jnp.float32) * a_s[:, None]
+        if s is not None:
+            dense = dense * s[None, :]
+        sp = jnp.where(outlier[None, :], flat, 0.0)
+        w_out = _dequant_nk(q, s) * outlier[None, :]
+        out = dense + sp @ w_out.T
         if b is not None:
             out = out + b
-        return out.astype(a.dtype)
+        return out.reshape(*af.shape[:-1], -1).astype(a.dtype)
 
     return apply_op(_l8, x, weight, weight_scale, bias,
                     _op_name="llm_int8_linear")
+
+
+def apply_per_channel_scale(x, scales):
+    """Pre-quant smoothing: divide activations by per-channel scales
+    (SmoothQuant-style; the matching weight absorb happens offline)."""
+    return apply_op(lambda a, s: (a / s).astype(a.dtype), x, scales,
+                    _op_name="apply_per_channel_scale")

@@ -162,6 +162,10 @@ _METHODS = dict(
     kthvalue=manipulation.kthvalue, mode=manipulation.mode,
     as_strided=manipulation.as_strided, unfold=manipulation.unfold,
     tensor_split=manipulation.tensor_split, bucketize=manipulation.bucketize,
+    view=manipulation.view,
+    fill_diagonal_tensor=manipulation.fill_diagonal_tensor,
+    fill_diagonal_tensor_=manipulation.fill_diagonal_tensor_,
+    top_p_sampling=manipulation.top_p_sampling,
     # logic
     equal=logic.equal, not_equal=logic.not_equal, less_than=logic.less_than,
     less_equal=logic.less_equal, greater_than=logic.greater_than,

@@ -716,3 +716,70 @@ def flatten_(x, start_axis=0, stop_axis=-1, name=None):
 
 def tolist(x):
     return x.tolist()
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Fill the (dim1, dim2) diagonal band of x with tensor y (parity:
+    tensor/manipulation.py fill_diagonal_tensor). y's shape is x's shape
+    with dim1/dim2 removed and the diagonal length appended."""
+    def _fd(a, b):
+        a2 = jnp.moveaxis(a, (dim1, dim2), (-2, -1))
+        n, m = a2.shape[-2:]
+        i0, j0 = (0, offset) if offset >= 0 else (-offset, 0)
+        ln = min(n - i0, m - j0)
+        if ln <= 0:
+            raise ValueError(f"offset {offset} leaves no diagonal "
+                             f"for dims ({n}, {m})")
+        ii = jnp.arange(ln) + i0
+        jj = jnp.arange(ln) + j0
+        a2 = a2.at[..., ii, jj].set(b.astype(a.dtype))
+        return jnp.moveaxis(a2, (-2, -1), (dim1, dim2))
+
+    return apply_op(_fd, x, y, _op_name="fill_diagonal_tensor")
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    return x._assign_result_(fill_diagonal_tensor(x, y, offset, dim1, dim2))
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """Nucleus sampling over probability rows (parity: tensor/search.py
+    top_p_sampling). x [b, vocab] probabilities, ps [b] per-row top-p.
+    Returns (scores [b, 1], ids [b, 1]) of the sampled token."""
+    from .. import framework
+
+    def _tps(probs, p_row, thr):
+        srt = jnp.sort(probs, axis=-1)[:, ::-1]
+        idx = jnp.argsort(probs, axis=-1)[:, ::-1]
+        csum = jnp.cumsum(srt, axis=-1)
+        # keep the smallest prefix with mass >= p (first token always kept)
+        keep = (csum - srt) < p_row[:, None]
+        if thr is not None:
+            keep = keep & (srt >= thr[:, None])
+        keep = keep.at[:, 0].set(True)  # prefix guarantee: top-1 always
+        if mode == "non-truncated":
+            # no truncation: sample the full (threshold-filtered)
+            # distribution; top_p only gates which rows get truncated in
+            # the reference kernel's two-pass scheme
+            masked = srt if thr is None else jnp.where(
+                srt >= thr[:, None], srt, 0.0)
+        else:
+            masked = jnp.where(keep, srt, 0.0)
+        norm = masked / jnp.maximum(
+            jnp.sum(masked, axis=-1, keepdims=True), 1e-20)
+        # explicit seed must not consume the global RNG stream
+        key = (jax.random.PRNGKey(seed) if seed >= 0
+               else framework.next_rng_key())
+        choice = jax.random.categorical(key, jnp.log(norm + 1e-20), axis=-1)
+        rows = jnp.arange(probs.shape[0])
+        out_ids = idx[rows, choice]
+        out_scores = probs[rows, out_ids]
+        return out_scores[:, None], out_ids[:, None].astype(jnp.int64)
+
+    scores, ids = apply_op(
+        _tps, x, ps, threshold, _op_name="top_p_sampling")
+    if return_top and k:
+        tk_scores, tk_ids = topk(x, k, axis=-1)
+        return scores, ids, tk_scores, tk_ids
+    return scores, ids

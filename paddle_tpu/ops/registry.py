@@ -84,7 +84,8 @@ _RANDOM_INPLACE = [
 ]
 _MANIP_INPLACE = [
     "reshape", "squeeze", "unsqueeze", "flatten", "t", "tril", "triu",
-    "clip", "scale", "cast", "fill", "zero", "fill_diagonal", "index_add",
+    "clip", "scale", "cast", "fill", "zero", "fill_diagonal",
+    "fill_diagonal_tensor", "index_add",
     "index_fill", "index_put", "masked_fill", "masked_scatter", "scatter",
     "where", "cumsum", "cumprod", "renorm", "addmm", "gcd", "lcm",
     "detach", "copy", "grad",
@@ -129,6 +130,7 @@ register_op("stack", spmd_rule="stack", tags=("manipulation",))
 register_op("tile", spmd_rule="tile", tags=("manipulation",))
 register_op("gather", spmd_rule="gather", tags=("indexing",))
 register_op("topk", spmd_rule="topk", tags=("search",))
+register_op("top_p_sampling", backward=False, tags=("search",))
 register_op("argmax", spmd_rule="argmax", backward=False, tags=("search",))
 register_op("sum", spmd_rule="reduction", tags=("math", "reduce"))
 register_op("mean", spmd_rule="reduction", tags=("math", "reduce"))

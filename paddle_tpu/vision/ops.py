@@ -390,3 +390,217 @@ def generate_proposals(*args, **kwargs):
     raise NotImplementedError(
         "generate_proposals: compose box decoding + nms; end-to-end RPN "
         "proposals land with the detection model family")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (parity: vision/ops.py:438 prior_box). Pure box
+    math from the two feature-map/image shapes — vectorised jnp over the
+    (H, W, num_priors) grid."""
+    min_sizes = [float(s) for s in (min_sizes if isinstance(
+        min_sizes, (list, tuple)) else [min_sizes])]
+    if max_sizes is None:
+        max_sizes = []
+    elif not isinstance(max_sizes, (list, tuple)):
+        max_sizes = [float(max_sizes)]
+    else:
+        max_sizes = [float(s) for s in max_sizes]
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError("max_sizes must match min_sizes length")
+    ars = [float(a) for a in (aspect_ratios if isinstance(
+        aspect_ratios, (list, tuple)) else [aspect_ratios])]
+    # expand aspect ratios (reference ExpandAspectRatios + flip)
+    out_ars = [1.0]
+    for ar in ars:
+        if all(abs(ar - e) > 1e-6 for e in out_ars):
+            out_ars.append(ar)
+            if flip:
+                out_ars.append(1.0 / ar)
+    var = [float(v) for v in (variance if isinstance(
+        variance, (list, tuple)) else [variance] * 4)]
+
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = float(steps[0]) or iw / fw
+    step_h = float(steps[1]) or ih / fh
+
+    # per-cell prior (w, h) list in the reference's emission order
+    whs = []
+    for mi, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                whs.append((math.sqrt(ms * max_sizes[mi]),) * 2)
+            for ar in out_ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        else:
+            for ar in out_ars:
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                whs.append((math.sqrt(ms * max_sizes[mi]),) * 2)
+    wh = jnp.asarray(whs, jnp.float32)  # [P, 2]
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]  # [H, W, 1, 2]
+    half = wh[None, None, :, :] / 2.0
+    mins = (c - half) / jnp.asarray([iw, ih], jnp.float32)
+    maxs = (c + half) / jnp.asarray([iw, ih], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], -1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(jnp.asarray(var, jnp.float32), boxes.shape)
+    return Tensor(boxes), Tensor(variances)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode detection boxes against priors (parity:
+    vision/ops.py box_coder)."""
+    def _coder(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2.0
+        pcy = pb[:, 1] + ph / 2.0
+        if pbv is None:
+            vx = vy = vw = vh = 1.0
+        elif pbv.ndim == 1:
+            vx, vy, vw, vh = pbv[0], pbv[1], pbv[2], pbv[3]
+        else:
+            vx, vy, vw, vh = pbv[:, 0], pbv[:, 1], pbv[:, 2], pbv[:, 3]
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2.0
+            tcy = tb[:, 1] + th / 2.0
+            # [T, P, 4]: every target encoded against every prior
+            ox = ((tcx[:, None] - pcx[None, :]) / pw[None, :]) / vx
+            oy = ((tcy[:, None] - pcy[None, :]) / ph[None, :]) / vy
+            ow = jnp.log(tw[:, None] / pw[None, :]) / vw
+            oh = jnp.log(th[:, None] / ph[None, :]) / vh
+            return jnp.stack([ox, oy, ow, oh], -1)
+        # decode: target_box [P, C, 4] deltas against priors along `axis`
+        t = tb
+        if t.ndim == 2:
+            t = t[:, None, :]
+        pw_, ph_, pcx_, pcy_ = (x[:, None] if axis == 0 else x[None, :]
+                                for x in (pw, ph, pcx, pcy))
+        if pbv is not None and pbv.ndim == 2:
+            # per-prior variances follow the prior axis
+            vx, vy, vw, vh = (v[:, None] if axis == 0 else v[None, :]
+                              for v in (vx, vy, vw, vh))
+        dcx = vx * t[..., 0] * pw_ + pcx_
+        dcy = vy * t[..., 1] * ph_ + pcy_
+        dw = jnp.exp(vw * t[..., 2]) * pw_
+        dh = jnp.exp(vh * t[..., 3]) * ph_
+        out = jnp.stack([dcx - dw / 2.0, dcy - dh / 2.0,
+                         dcx + dw / 2.0 - norm, dcy + dh / 2.0 - norm], -1)
+        return out if tb.ndim == 3 else out[:, 0, :]
+
+    return apply_op(_coder, prior_box, prior_box_var, target_box,
+                    _op_name="box_coder")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): soft suppression via the pairwise-IoU decay
+    matrix instead of sequential greedy passes (parity: vision/ops.py
+    matrix_nms). Host-side numpy like the reference CPU kernel."""
+    bb = np.asarray(bboxes.numpy() if hasattr(bboxes, "numpy") else bboxes)
+    sc = np.asarray(scores.numpy() if hasattr(scores, "numpy") else scores)
+    norm = 0.0 if normalized else 1.0
+    outs, inds, nums = [], [], []
+    for b in range(bb.shape[0]):
+        dets = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            keep = np.where(sc[b, c] > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            s = sc[b, c][keep]
+            order = np.argsort(-s)
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            s = s[order]
+            boxes = bb[b][keep[order]]
+            x1, y1, x2, y2 = boxes.T
+            area = (x2 - x1 + norm) * (y2 - y1 + norm)
+            ix1 = np.maximum(x1[:, None], x1[None, :])
+            iy1 = np.maximum(y1[:, None], y1[None, :])
+            ix2 = np.minimum(x2[:, None], x2[None, :])
+            iy2 = np.minimum(y2[:, None], y2[None, :])
+            iw = np.clip(ix2 - ix1 + norm, 0, None)
+            ih = np.clip(iy2 - iy1 + norm, 0, None)
+            iou = iw * ih / (area[:, None] + area[None, :] - iw * ih + 1e-10)
+            iou = np.triu(iou, 1)  # iou[i, j]: i higher-scored than j
+            # compensation: each suppressor i is itself suppressed by its
+            # own max-IoU with higher-scored boxes (SOLO matrix NMS)
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
+                               * gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - iou_cmax[:, None],
+                                                1e-10)).min(0)
+            ds = s * decay
+            for i, sv in enumerate(ds):
+                if sv > post_threshold:
+                    dets.append((c, sv, *boxes[i], keep[order][i]))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        outs.append(np.asarray([d[:6] for d in dets], np.float32).reshape(
+            -1, 6))
+        inds.append(np.asarray([d[6] for d in dets], np.int32))
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0) if outs
+                             else np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.concatenate(inds, 0))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 1-D tensor (parity: vision/ops.py
+    read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (parity: vision/ops.py
+    decode_jpeg; host-side like the reference CPU path — image IO is not
+    a device op)."""
+    import io
+
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg requires Pillow") from e
+    raw = bytes(np.asarray(x.numpy() if hasattr(x, "numpy") else x,
+                           np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

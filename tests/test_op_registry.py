@@ -165,3 +165,63 @@ def test_registry_floor():
     """Coverage gate: the registry stays at ops.yaml scale for the surface
     this framework exposes (was 145 in r3; the battery covers >=300 ops)."""
     assert len(registered_ops()) >= 360, len(registered_ops())
+
+
+def test_ops_yaml_classification_total():
+    """VERDICT r4 item 6: audit the 370-vs-470 delta. Every op in the
+    reference's ops.yaml (paddle/phi/ops/yaml/ops.yaml) is classified —
+    registered / api (public surface elsewhere) / subsumed (capability
+    lives in a subsystem) / na (with reason) — and the classification is
+    checked against reality: registered names resolve in the registry,
+    api/subsumed targets resolve as attributes, na entries carry a
+    non-empty reason. The checked-in file makes the delta auditable."""
+    import json
+    import os
+    import re
+
+    here = os.path.dirname(__file__)
+    cls = json.load(open(os.path.join(here, "data",
+                                      "ops_yaml_classification.json")))
+    yaml_path = "/root/reference/paddle/phi/ops/yaml/ops.yaml"
+    if not os.path.exists(yaml_path):
+        # classification still enforced standalone when the reference
+        # checkout is absent (CI without /root/reference)
+        yaml_ops = set(cls)
+    else:
+        yaml_ops = {
+            m.group(1) for line in open(yaml_path)
+            if (m := re.match(r"- op : (\S+)", line))
+        }
+    assert set(cls) == yaml_ops, (
+        "classification out of sync with ops.yaml: "
+        f"missing={sorted(yaml_ops - set(cls))[:10]} "
+        f"stale={sorted(set(cls) - yaml_ops)[:10]}")
+
+    reg = set(registered_ops())
+    import paddle_tpu
+
+    def resolve(target):
+        assert target.startswith("paddle")
+        obj = paddle_tpu
+        for part in target.split(".")[1:]:
+            obj = getattr(obj, part, None)
+            if obj is None:
+                return False
+        return True
+
+    bad = []
+    for op, entry in sorted(cls.items()):
+        st = entry["status"]
+        if st == "registered":
+            if op not in reg:
+                bad.append((op, "not in registry"))
+        elif st in ("api", "subsumed"):
+            tgt = entry.get("target")
+            if not tgt or not resolve(tgt):
+                bad.append((op, f"target missing: {tgt}"))
+        elif st == "na":
+            if not entry.get("reason"):
+                bad.append((op, "na without reason"))
+        else:
+            bad.append((op, f"unknown status {st}"))
+    assert not bad, f"{len(bad)} misclassified: {bad[:20]}"

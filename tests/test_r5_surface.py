@@ -1,0 +1,175 @@
+"""r5 public-surface additions (VERDICT r4 item 6 forcing function):
+nn.quant weight-only/LLM.int8 linear, top_p_sampling,
+fill_diagonal_tensor, edit_distance, flash_attn_unpadded, detection
+utilities (prior_box/box_coder/matrix_nms/read_file/decode_jpeg)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.vision.ops as V
+from paddle_tpu.nn import quant
+
+
+class TestNNQuant:
+    def setup_method(self, _):
+        rng = np.random.default_rng(0)
+        self.w = paddle.to_tensor(rng.normal(size=(64, 32)).astype(np.float32))
+        self.x = paddle.to_tensor(rng.normal(size=(4, 64)).astype(np.float32))
+
+    @pytest.mark.parametrize("gs", [-1, 64])
+    def test_quantize_roundtrip_and_linear(self, gs):
+        q, s = quant.weight_quantize(self.w, group_size=gs)
+        assert tuple(q.shape) == (32, 64)  # reference: transposed layout
+        assert "int8" in str(q.dtype)
+        wd = quant.weight_dequantize(q, s, out_dtype="float32", group_size=gs)
+        assert np.abs(wd.numpy() - self.w.numpy()).max() < 0.05
+        y = quant.weight_only_linear(self.x, q, weight_scale=s, group_size=gs)
+        ref = self.x.numpy() @ self.w.numpy()
+        assert np.abs(y.numpy() - ref).max() / np.abs(ref).max() < 0.03
+
+    def test_int4_range(self):
+        q, _ = quant.weight_quantize(self.w, algo="weight_only_int4")
+        assert int(np.abs(q.numpy()).max()) <= 7
+
+    def test_llm_int8_outlier_decomposition(self):
+        xo = self.x.numpy().copy()
+        xo[:, 7] *= 40.0  # outlier channel
+        q, s = quant.weight_quantize(self.w, algo="llm.int8")
+        y = quant.llm_int8_linear(paddle.to_tensor(xo), q, weight_scale=s,
+                                  threshold=6.0)
+        ref = xo @ self.w.numpy()
+        assert np.abs(y.numpy() - ref).max() / np.abs(ref).max() < 0.03
+
+    def test_apply_per_channel_scale(self):
+        s = paddle.to_tensor(np.full((64,), 2.0, np.float32))
+        out = quant.apply_per_channel_scale(self.x, s)
+        np.testing.assert_allclose(out.numpy(), self.x.numpy() / 2.0,
+                                   rtol=1e-6)
+
+
+def test_top_p_sampling_respects_nucleus():
+    probs = paddle.to_tensor(np.tile(
+        np.array([[0.5, 0.3, 0.15, 0.05]], np.float32), (64, 1)))
+    ps = paddle.to_tensor(np.full((64,), 0.7, np.float32))
+    paddle.seed(0)
+    scores, ids = paddle.top_p_sampling(probs, ps)
+    assert tuple(ids.shape) == (64, 1)
+    got = set(int(v) for v in ids.numpy().ravel())
+    assert got <= {0, 1}, got  # p=0.7 keeps only the top-2 tokens
+    assert len(got) == 2  # and it actually samples, not argmax
+
+
+def test_fill_diagonal_tensor():
+    x = paddle.to_tensor(np.zeros((4, 5), np.float32))
+    y = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    out = paddle.fill_diagonal_tensor(x, y)
+    np.testing.assert_allclose(np.diag(out.numpy()), np.arange(4))
+    off = paddle.fill_diagonal_tensor(
+        x, paddle.to_tensor(np.ones(4, np.float32)), offset=1)
+    np.testing.assert_allclose(np.diag(off.numpy(), k=1), np.ones(4))
+    # Tensor method + inplace variant
+    x.fill_diagonal_tensor_(y)
+    np.testing.assert_allclose(np.diag(x.numpy()), np.arange(4))
+    # inplace keeps the autograd graph (gradient flows to y)
+    yg = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    xg = paddle.to_tensor(np.zeros((4, 5), np.float32))
+    xg.fill_diagonal_tensor_(yg * 3.0)
+    xg.sum().backward()
+    np.testing.assert_allclose(yg.grad.numpy(), np.full(4, 3.0))
+
+
+def test_edit_distance():
+    a = paddle.to_tensor(np.array([[1, 2, 3, 4], [5, 6, 7, 0]], np.int64))
+    b = paddle.to_tensor(np.array([[1, 2, 4, 4], [5, 6, 7, 8]], np.int64))
+    d, n = F.edit_distance(a, b, normalized=False)
+    assert d.numpy().ravel().tolist() == [1.0, 1.0]
+    assert int(n.numpy()[0]) == 2
+    dn, _ = F.edit_distance(a, b, normalized=True)
+    np.testing.assert_allclose(dn.numpy().ravel(), [0.25, 0.25])
+
+
+def test_flash_attn_unpadded_matches_per_sequence_sdpa():
+    rng = np.random.default_rng(0)
+    tq, h, dh = 8, 2, 4
+    q = rng.normal(size=(tq, h, dh)).astype(np.float32)
+    k = rng.normal(size=(tq, h, dh)).astype(np.float32)
+    v = rng.normal(size=(tq, h, dh)).astype(np.float32)
+    cu = np.array([0, 3, 8], np.int32)
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), 5, 5, scale=0.5,
+        causal=True)
+
+    def ref_one(qs, ks, vs):
+        lg = np.einsum("qhd,khd->hqk", (qs * 0.5).astype(np.float64),
+                       ks.astype(np.float64))
+        mask = np.tril(np.ones((qs.shape[0], ks.shape[0])))
+        lg = np.where(mask[None], lg, -np.inf)
+        p = np.exp(lg - lg.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("hqk,khd->qhd", p, vs.astype(np.float64))
+
+    ref = np.concatenate([ref_one(q[0:3], k[0:3], v[0:3]),
+                          ref_one(q[3:8], k[3:8], v[3:8])])
+    # default matmul precision (bf16-class mantissa, the framework-wide
+    # attention default) bounds the tolerance
+    assert np.abs(out.numpy() - ref).max() < 2e-2
+
+
+class TestDetectionUtilities:
+    def test_prior_box_shapes_and_range(self):
+        inp = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, variances = V.prior_box(
+            inp, img, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+        # priors: ar1 + ar2 + flipped + max-size interpolation
+        assert tuple(boxes.shape) == (4, 4, 4, 4)
+        assert tuple(variances.shape) == (4, 4, 4, 4)
+        b = boxes.numpy()
+        assert b.min() >= 0.0 and b.max() <= 1.0  # clip
+        assert np.all(b[..., 2:] >= b[..., :2])
+
+    def test_box_coder_encode_decode_roundtrip(self):
+        pb = np.array([[0., 0., 10., 10.], [5., 5., 20., 20.]], np.float32)
+        tb = np.array([[1., 1., 8., 8.]], np.float32)
+        ones = paddle.to_tensor(np.ones(4, np.float32))
+        enc = V.box_coder(paddle.to_tensor(pb), ones, paddle.to_tensor(tb))
+        assert tuple(enc.shape) == (1, 2, 4)
+        dec = V.box_coder(paddle.to_tensor(pb), ones,
+                          paddle.to_tensor(enc.numpy().transpose(1, 0, 2)),
+                          code_type="decode_center_size", axis=0)
+        d = dec.numpy()
+        assert np.abs(d[0, 0] - tb[0]).max() < 1e-3
+        assert np.abs(d[1, 0] - tb[0]).max() < 1e-3
+
+    def test_matrix_nms_suppresses_duplicates(self):
+        bb = paddle.to_tensor(np.array(
+            [[[0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30]]],
+            np.float32))
+        sc = paddle.to_tensor(np.array([[[0.9, 0.85, 0.8]]], np.float32))
+        out, num = V.matrix_nms(bb, sc, score_threshold=0.1,
+                                post_threshold=0.3, background_label=-1)
+        o = out.numpy()
+        assert int(num.numpy()[0]) == o.shape[0]
+        # SOLO decay math: the duplicate (IoU=1 with the 0.9 box) is
+        # crushed to ~0 and filtered by post_threshold; the disjoint box
+        # survives undecayed
+        kept = sorted(o[:, 1].tolist(), reverse=True)
+        assert kept[0] == pytest.approx(0.9, abs=1e-6)
+        assert 0.8 in [pytest.approx(s, abs=1e-6) for s in kept]
+        assert all(abs(s - 0.85) > 1e-3 for s in kept), kept
+
+    def test_read_file_decode_jpeg(self, tmp_path):
+        from PIL import Image
+
+        arr = (np.random.RandomState(0).rand(6, 5, 3) * 255).astype(np.uint8)
+        p = tmp_path / "t.jpg"
+        Image.fromarray(arr).save(str(p), format="JPEG")
+        raw = V.read_file(str(p))
+        assert "uint8" in str(raw.dtype) and raw.ndim == 1
+        dec = V.decode_jpeg(raw)
+        assert tuple(dec.shape) == (3, 6, 5)
+        gray = V.decode_jpeg(raw, mode="gray")
+        assert tuple(gray.shape) == (1, 6, 5)

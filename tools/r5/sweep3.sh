@@ -1,0 +1,14 @@
+#!/bin/bash
+# r5 sweep 3: confirm new defaults (full driver-style run) + gate+up@b3 probes
+cd /root/repo
+SNAP=/tmp/snap_r5
+NAMES_GU="names:attn_res,attn_lse,attn_q,attn_k,attn_v,resid_mid,rms_rstd,ffn_gate,ffn_up"
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1800 python $SNAP/bench.py 2>&1 | tail -6
+  echo "=== END $label ==="
+}
+run DEFAULTS_CONFIRM
+run G2_gpt_gu_b3 PTPU_BENCH_MODEL=gpt PTPU_BENCH_REMAT="$NAMES_GU" PTPU_BENCH_BATCH=3
+run L5_llama_gu_b3 PTPU_BENCH_MODEL=llama PTPU_BENCH_REMAT="$NAMES_GU" PTPU_BENCH_BATCH=3

@@ -1,0 +1,15 @@
+#!/bin/bash
+# r5 sweep 4: confirm gate+up b3 defaults + attn_out save probe
+cd /root/repo
+SNAP=/tmp/snap_r5
+NAMES_AO="names:attn_res,attn_lse,attn_q,attn_k,attn_v,resid_mid,rms_rstd,ffn_gate,ffn_up,attn_out"
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1800 python $SNAP/bench.py 2>&1 | tail -6
+  echo "=== END $label ==="
+}
+run DEFAULTS_CONFIRM2
+run GA_gpt_attnout PTPU_BENCH_MODEL=gpt PTPU_BENCH_REMAT="$NAMES_AO"
+run LA_llama_attnout PTPU_BENCH_MODEL=llama PTPU_BENCH_REMAT="$NAMES_AO"
+run GB_gpt_b4_gu PTPU_BENCH_MODEL=gpt PTPU_BENCH_BATCH=4

@@ -9,7 +9,7 @@ NAMES_GU="${NAMES_BASE},ffn_gate,ffn_up"
 run() {
   label="$1"; shift
   echo "=== ARM $label: $* ==="
-  env "$@" PYTHONPATH=$SNAP timeout 1200 python $SNAP/bench.py 2>&1 | tail -12
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1200 python $SNAP/bench.py 2>&1 | tail -12
   echo "=== END $label ==="
 }
 run F_gpt_gate_bwd2048 PTPU_BENCH_MODEL=gpt PTPU_ADAM_FACTORED=1 PTPU_BENCH_REMAT="$NAMES_GATE" PTPU_FA_BWD_BLOCK=2048
