@@ -32,8 +32,12 @@ def hz_to_mel(freq, htk=False):
     min_log_hz = 1000.0
     min_log_mel = (min_log_hz - f_min) / f_sp
     logstep = np.log(6.4) / 27.0
+    # clamp before the log: np.where evaluates BOTH branches, so hz=0
+    # would emit a divide-by-zero warning from the (unselected) log arm
+    f_log = np.maximum(f, min_log_hz)
     return np.where(f >= min_log_hz,
-                    min_log_mel + np.log(f / min_log_hz) / logstep, mels)
+                    min_log_mel + np.log(f_log / min_log_hz) / logstep,
+                    mels)
 
 
 def mel_to_hz(mel, htk=False):

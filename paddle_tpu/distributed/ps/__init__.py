@@ -63,18 +63,29 @@ class DenseTable:
         with self._lock:
             return self.value.copy()
 
-    def digest(self):
+    def _digest_locked(self):
         """Cheap position-sensitive content fingerprint: detects
         replicas whose COUNTERS agree but whose histories diverged (each
         missed a different push). Projection onto a fixed name-seeded
-        random vector — a plain sum is blind to permuted updates."""
-        if self._digest_vec is None or                 self._digest_vec.size != self.value.size:
+        random vector — a plain sum is blind to permuted updates.
+        Caller must hold self._lock."""
+        if (self._digest_vec is None
+                or self._digest_vec.size != self.value.size):
             rng = np.random.default_rng(zlib.crc32(self.name.encode()))
             self._digest_vec = rng.standard_normal(self.value.size)
         return float(np.dot(self.value.reshape(-1).astype(np.float64),
                             self._digest_vec))
 
-    def push(self, grad):
+    def digest(self):
+        with self._lock:
+            return self._digest_locked()
+
+    def push(self, grad, want_digest=False):
+        """Apply the update; return (version, digest|None) ATOMICALLY
+        under the table lock — a concurrent pusher can never observe a
+        mismatched pair (which would trigger spurious anti-entropy
+        resyncs overwriting a healthy replica). The O(N) digest is
+        computed only when the caller replicates (want_digest)."""
         grad = np.asarray(grad, np.float32).reshape(self.value.shape)
         with self._lock:
             if self.optimizer == "adagrad":
@@ -83,8 +94,10 @@ class DenseTable:
             else:
                 self.value -= self.lr * grad
             self.version += 1
+            return (self.version,
+                    self._digest_locked() if want_digest else None)
 
-    def add_delta(self, delta):
+    def add_delta(self, delta, want_digest=False):
         """Geo-SGD accumulation: the server SUMS worker deltas (the
         reference's geo strategy applies raw parameter diffs, not
         optimizer steps — ps/service geo mode)."""
@@ -92,6 +105,8 @@ class DenseTable:
         with self._lock:
             self.value += delta
             self.version += 1
+            return (self.version,
+                    self._digest_locked() if want_digest else None)
 
 
 class SparseTable:
@@ -179,15 +194,11 @@ class PSServer:
     def pull_dense(self, name):
         return self.tables[name].pull()
 
-    def push_dense(self, name, grad):
-        t = self.tables[name]
-        t.push(grad)
-        return (t.version, t.digest())
+    def push_dense(self, name, grad, want_digest=False):
+        return self.tables[name].push(grad, want_digest=want_digest)
 
-    def push_dense_delta(self, name, delta):
-        t = self.tables[name]
-        t.add_delta(delta)
-        return (t.version, t.digest())
+    def push_dense_delta(self, name, delta, want_digest=False):
+        return self.tables[name].add_delta(delta, want_digest=want_digest)
 
     def dense_state(self, name):
         """(value, accum, version) snapshot for anti-entropy resync."""
@@ -290,12 +301,14 @@ def _rpc_pull_dense(name):
     return get_global_server().pull_dense(name)
 
 
-def _rpc_push_dense(name, grad):
-    return get_global_server().push_dense(name, grad)
+def _rpc_push_dense(name, grad, want_digest=False):
+    return get_global_server().push_dense(name, grad,
+                                          want_digest=want_digest)
 
 
-def _rpc_push_dense_delta(name, delta):
-    return get_global_server().push_dense_delta(name, delta)
+def _rpc_push_dense_delta(name, delta, want_digest=False):
+    return get_global_server().push_dense_delta(name, delta,
+                                                want_digest=want_digest)
 
 
 def _rpc_pull_sparse(name, ids):
@@ -460,13 +473,16 @@ class PSClient:
                            version)
 
     def push_dense(self, name, grad):
+        # the O(N) digest is requested only when replication needs it
         return self._push_replicated(name, _rpc_push_dense,
-                                     np.asarray(grad))
+                                     np.asarray(grad),
+                                     self.replication > 1)
 
     def push_dense_delta(self, name, delta):
         """Geo-SGD verb: server ADDS the raw parameter delta."""
         return self._push_replicated(name, _rpc_push_dense_delta,
-                                     np.asarray(delta))
+                                     np.asarray(delta),
+                                     self.replication > 1)
 
     # sparse ------------------------------------------------------------
     def create_sparse_table(self, name, dim, **kw):

@@ -78,15 +78,20 @@ class HeterSparseCache:
             patch = np.stack([row_of[int(ids[i])] for i in idxs])
             out = out.at[jnp.asarray(idxs)].set(jnp.asarray(patch))
             # 3) NOW insert the fresh rows (may evict, incl. this batch's
-            # hits — harmless, output is already built)
-            new_slots, new_rows = [], []
+            # hits — harmless, output is already built). When distinct
+            # misses exceed capacity, _alloc_slot recycles slots handed
+            # out earlier in this same loop — dedupe keeping the LAST
+            # write per slot so the scatter has unique indices (duplicate
+            # scatter-index ordering is unspecified in XLA) and _store
+            # agrees with _slot_of (the earlier id was evicted from it).
+            slot_row: dict[int, np.ndarray] = {}
             for rid in missing:
                 slot = self._alloc_slot()
                 self._slot_of[rid] = slot
-                new_slots.append(slot)
-                new_rows.append(row_of[rid])
-            self._store = self._store.at[jnp.asarray(new_slots)].set(
-                jnp.asarray(np.stack(new_rows)))
+                slot_row[slot] = row_of[rid]
+            slots_u = list(slot_row)
+            self._store = self._store.at[jnp.asarray(slots_u)].set(
+                jnp.asarray(np.stack([slot_row[s] for s in slots_u])))
 
         # 4) refresh recency for surviving hit ids (O(1) each)
         for rid in dict.fromkeys(int(i) for i in ids):

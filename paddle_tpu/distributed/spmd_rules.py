@@ -714,8 +714,63 @@ def _identity_rule_factory(name):
 
 
 # layout-preserving unaries: sharding flows straight through
-for _n in ("flip", "roll", "tril", "scale", "clip", "pad"):
+for _n in ("tril", "scale", "clip"):
     _identity_rule_factory(_n)
+
+
+def _axes_replicated_rule_factory(name, axes_of):
+    """Reversing/rotating/padding a sharded axis is not locally
+    computable (ADVICE r4): the operated axes must be whole per device —
+    mark them replicated so the planner prices the reshard instead of
+    GSPMD silently inserting it."""
+    @register_spmd_rule(name)
+    def _rule(x: DistTensorSpec, **attrs):
+        nd = x.ndim
+        axes = axes_of(nd, attrs)
+        letters = _letters(nd)
+        sub = "".join("*" if i in axes else c
+                      for i, c in enumerate(letters))
+        return einsum_infer(f"{sub}->{sub}", [x])
+    _rule.__name__ = f"_{name}_rule"
+    return _rule
+
+
+def _flip_axes(nd, attrs):
+    ax = attrs.get("axis", attrs.get("axes"))
+    if ax is None:
+        return set(range(nd))
+    ax = [ax] if isinstance(ax, int) else list(ax)
+    return {int(a) % nd for a in ax}
+
+
+def _roll_axes(nd, attrs):
+    ax = attrs.get("axis")
+    if ax is None:          # axis=None rolls the flattened array
+        return set(range(nd))
+    ax = [ax] if isinstance(ax, int) else list(ax)
+    return {int(a) % nd for a in ax}
+
+
+def _pad_axes(nd, attrs):
+    # NOTE: must mirror the pad-spec layout in ops/manipulation.py pad()
+    # (full-rank leading-first pairs vs torch-style trailing reversed);
+    # if that convention changes, change this with it
+    pad = attrs.get("pad", attrs.get("paddings"))
+    if pad is None:
+        return set(range(nd))  # unknown spec: be conservative
+    pad = list(pad)
+    if len(pad) == 2 * nd:     # per-dim (lo, hi) pairs, leading-dim first
+        return {i for i in range(nd)
+                if pad[2 * i] or pad[2 * i + 1]}
+    # torch-style trailing-dims-first pairs
+    n_dims = len(pad) // 2
+    return {nd - 1 - i for i in range(n_dims)
+            if pad[2 * i] or pad[2 * i + 1]}
+
+
+_axes_replicated_rule_factory("flip", _flip_axes)
+_axes_replicated_rule_factory("roll", _roll_axes)
+_axes_replicated_rule_factory("pad", _pad_axes)
 
 
 @register_spmd_rule("fused_rotary_position_embedding")

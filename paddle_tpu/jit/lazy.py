@@ -75,15 +75,17 @@ class LazyValue:
 
 class _Op:
     __slots__ = ("fn", "arg_plan", "treedef", "out_lazy", "key",
-                 "out_tensors")
+                 "out_tensors", "nograd")
 
-    def __init__(self, fn, arg_plan, treedef, out_lazy, key):
+    def __init__(self, fn, arg_plan, treedef, out_lazy, key, nograd=False):
         self.fn = fn
         self.arg_plan = arg_plan      # per leaf: ("lazy", LazyValue) |
         self.treedef = treedef        #           ("in", input_index)
         self.out_lazy = out_lazy      # flat list of LazyValue outputs
         self.key = key                # hashable op identity for memoizing
         self.out_tensors = None       # grad mode: Tensor wrappers (or None)
+        self.nograd = nograd          # recorded under no_grad: outputs
+                                      # are constants for the segment vjp
 
 
 def _op_key(fn, statics):
@@ -185,7 +187,8 @@ class SegmentTrace:
             key = key + (("amp", str(amp_target)),)
         if nograd_in_train:
             key = key + (("nograd",),)
-        self.ops.append(_Op(fn, plan, treedef, out_lazy, key))
+        self.ops.append(_Op(fn, plan, treedef, out_lazy, key,
+                            nograd=nograd_in_train))
         self.recorded_ops += 1
         return tree_util.tree_unflatten(out_tree, out_lazy)
 
@@ -288,11 +291,29 @@ class SegmentTrace:
                         [inputs[i] for i in diff_pos],
                         [input_tensors[i] for i in diff_pos],
                         edges, out_avals, out_treedef)
+        # Per-op differentiable-input reachability: eager dispatch leaves
+        # outputs of all-stop_gradient ops at stop_gradient=True; the
+        # segment attach must match (ADVICE r4) — attach the node / flip
+        # stop_gradient ONLY for outputs downstream of a differentiable
+        # input, and never through no_grad-recorded ops.
+        diff_in = set(diff_pos)
+        reachable: set[int] = set()
+        for op in ops:
+            if op.nograd:
+                continue
+            hit = any(
+                (p[0] == "in" and p[1] in diff_in)
+                or (p[0] == "lazy" and id(p[1]) in reachable)
+                for p in op.arg_plan)
+            if hit:
+                for lz in op.out_lazy:
+                    reachable.add(id(lz))
         idx = 0
         for op in ops:
             touts = op.out_tensors or [None] * len(op.out_lazy)
-            for t in touts:
-                if isinstance(t, Tensor) and _inexact(t):
+            for t, lz in zip(touts, op.out_lazy):
+                if (isinstance(t, Tensor) and _inexact(t)
+                        and id(lz) in reachable):
                     t._grad_node = node
                     t._out_index = idx
                     t.stop_gradient = False

@@ -426,3 +426,55 @@ def test_heter_cache_invalidate_then_insert_no_slot_alias():
     out = np.asarray(cache.pull([1, 10, 11]))
     want = np.asarray(client.pull_sparse("emb", np.asarray([1, 10, 11])))
     np.testing.assert_allclose(out, want)
+
+
+def test_heter_cache_overflow_no_slot_aliasing():
+    """ADVICE r4 (medium): when one pull's distinct misses exceed cache
+    capacity, same-loop evictions recycle slots — the store scatter must
+    keep unique indices and _slot_of must agree with what each slot
+    actually holds (no silently-wrong embeddings on later hits)."""
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+    from paddle_tpu.distributed.ps.heter import HeterSparseCache
+
+    server = PSServer(0)
+    client = PSClient([server])
+    client.create_sparse_table("emb", dim=4, initializer="uniform",
+                               init_scale=0.5, seed=11)
+    cache = HeterSparseCache(client, "emb", dim=4, cache_rows=3)
+
+    ids = list(range(8))  # 8 distinct misses > 3 slots
+    rows = np.asarray(cache.pull(ids))
+    direct = np.asarray(client.pull_sparse("emb", np.asarray(ids)))
+    np.testing.assert_allclose(rows, direct)
+
+    # internal consistency: every cached id's slot holds ITS row
+    assert len(cache._slot_of) <= cache.capacity
+    slots = list(cache._slot_of.values())
+    assert len(slots) == len(set(slots)), "slot aliasing"
+    for rid, slot in cache._slot_of.items():
+        np.testing.assert_allclose(
+            np.asarray(cache._store)[slot],
+            direct[ids.index(rid)], err_msg=f"id {rid} slot {slot}")
+
+    # and subsequent HITS on cached ids serve the right rows
+    cached_ids = list(cache._slot_of)
+    again = np.asarray(cache.pull(cached_ids))
+    np.testing.assert_allclose(
+        again, np.asarray(client.pull_sparse("emb",
+                                             np.asarray(cached_ids))))
+
+
+def test_push_dense_skips_digest_without_replication():
+    """ADVICE r4: the O(N) digest is computed only when replication
+    needs it (replication=1 must not pay 2x table memory + O(N) dot per
+    push)."""
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    server = PSServer(0)
+    client = PSClient([server], replication=1)
+    client.create_dense_table("w", (4, 4))
+    client.push_dense("w", np.ones((4, 4), np.float32))
+    t = server.tables["w"]
+    assert t._digest_vec is None, "digest computed despite replication=1"
+    # digest-on-demand still works (and replication>1 paths use it)
+    assert isinstance(t.digest(), float)

@@ -444,3 +444,29 @@ class TestRound4Rules:
                                     out_ranks=[1, 1])
         assert len(outs) == 2
         assert outs[0].dims_mapping == [0]
+
+
+class TestOperatedAxisReplication:
+    """ADVICE r4: flip/roll/pad are not locally computable on the
+    operated axis — the rule must replicate it (not propagate the
+    sharding and force GSPMD to reshard mid-program)."""
+
+    def test_flip_replicates_flipped_axis_only(self):
+        rule = get_spmd_rule("flip")
+        x = DistTensorSpec((8, 16), [0, 1])
+        ins, outs = rule.infer_forward(x, axis=0)
+        assert dm(ins[0]) == [-1, 1]   # flipped axis forced whole
+        assert dm(outs[0]) == [-1, 1]
+
+    def test_roll_axis_none_replicates_all(self):
+        rule = get_spmd_rule("roll")
+        x = DistTensorSpec((8, 16), [0, 1])
+        ins, _ = rule.infer_forward(x, shifts=3)
+        assert dm(ins[0]) == [-1, -1]
+
+    def test_pad_replicates_padded_dims(self):
+        rule = get_spmd_rule("pad")
+        x = DistTensorSpec((8, 16), [0, 1])
+        # per-dim (lo, hi) pairs: pad only dim 1
+        ins, _ = rule.infer_forward(x, paddings=[0, 0, 1, 1])
+        assert dm(ins[0]) == [0, -1]

@@ -439,3 +439,39 @@ class TestSegmentCaptureTraining:
                 opt.clear_grad()
                 losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_segment_capture_stop_gradient_parity():
+    """ADVICE r4: an op whose inputs are ALL stop_gradient must leave its
+    outputs stop_gradient=True under graph-broken to_static capture —
+    exactly like eager dispatch — while downstream-of-param outputs get
+    the segment GradNode."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class Probe(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(8, 8)
+
+        def forward(self, x, const):
+            h = paddle.nn.functional.relu(self.lin(x))  # diff path
+            c = const * 2.0 + 1.0                       # pure-const path
+            if float(h.mean()) > -1e9:                  # host graph break
+                h = h + 0.0
+            h2 = paddle.nn.functional.relu(h)
+            return h2, c
+
+    paddle.seed(1)
+    m = Probe()
+    m.train()
+    st = paddle.jit.to_static(m)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    const = paddle.to_tensor(np.ones((4, 8), np.float32))  # stop_gradient
+    h2, c = st(x, const)
+    assert c.stop_gradient is True, "const-only op must stay stop_gradient"
+    assert h2.stop_gradient is False, "param-downstream must carry the node"
+    loss = (h2 ** 2).sum()
+    loss.backward()
+    assert m.lin.weight.grad is not None
