@@ -168,7 +168,8 @@ class MatmulPlan:
 
 
 def plan_matmul_shardings(fn, *example_args, axis_size=8,
-                          in_sharded="replicated", model=None):
+                          in_sharded="replicated", model=None,
+                          out_mappings=None):
     """Score the classical per-matmul placements and pick the cheapest.
 
     in_sharded: how operands currently live — "replicated" (both full on
@@ -184,11 +185,22 @@ def plan_matmul_shardings(fn, *example_args, axis_size=8,
     (auto_parallel/static/cost + tuner).
     """
     model = model or OpCostModel()
+    # reverse completion: an output-side annotation (the loss, a
+    # col-sharded downstream consumer) flows backward through
+    # reshape/transpose/elementwise chains and FORCES the reached
+    # matmuls' output placements (split_m / split_n) before costing
     flat = tree_util.tree_leaves(example_args)
     closed = jax.make_jaxpr(
         lambda *a: tree_util.tree_leaves(
             fn(*tree_util.tree_unflatten(
                 tree_util.tree_structure(example_args), a))))(*flat)
+    # one trace: the completion pass walks the SAME jaxpr the costing
+    # loop enumerates, so forced eqn indices can never misalign
+    forced = {}
+    if out_mappings is not None:
+        forced = complete_output_annotation(
+            fn, *example_args, out_mappings=out_mappings,
+            axis_size=axis_size, _closed=closed)
     plans = []
     d = axis_size
     # chain propagation: a split_n matmul leaves its output COLUMN-sharded
@@ -239,8 +251,112 @@ def plan_matmul_shardings(fn, *example_args, axis_size=8,
         }
         est_ms = {c: t * 1e3 for c, t in est.items()}
         choice = min(est_ms, key=est_ms.get)
+        dm = forced.get(i)
+        if dm is not None:
+            # the annotation binds: n-dim sharded -> split_n, m-dim
+            # sharded -> split_m; a fully-replicated output does NOT
+            # exclude split_k (its psum result is replicated). Output
+            # dims are [batch..., m?, n?] — matvec (no n) must map the
+            # trailing dim to m, not n.
+            has_m = len(lhs.shape) - len(lc) - len(lb) > 0
+            has_n = len(rhs.shape) - len(rc) - len(rb) > 0
+            if has_n and dm[-1] >= 0:
+                choice = "split_n"
+            elif has_m and len(dm) >= (2 if has_n else 1) and \
+                    dm[-2 if has_n else -1] >= 0:
+                choice = "split_m"
         if choice == "split_n":
             for ov in eqn.outvars:
                 col_sharded.add(id(ov))
         plans.append(MatmulPlan(i, m, n, k, choice, est_ms))
     return plans
+
+
+# ---------------------------------------------------------------------------
+# Reverse completion: flow an OUTPUT-side annotation backward to the
+# producing matmuls (parity: the reference planner's InferSpmdReverse
+# completion pass — phi/infermeta/spmd_rules/matmul.h:30 registers
+# forward AND reverse per op; completion uses reverse so an annotation
+# on the loss / a downstream value reaches producers through
+# reshape/transpose/elementwise chains).
+# ---------------------------------------------------------------------------
+_ELTWISE_PRIMS = frozenset((
+    "add", "sub", "mul", "div", "max", "min", "pow", "integer_pow",
+    "exp", "log", "tanh", "logistic", "neg", "abs", "sqrt", "rsqrt",
+    "convert_element_type", "stop_gradient", "select_n", "sign",
+    "erf", "floor", "ceil", "round", "clamp", "custom_jvp_call",
+))
+
+
+def complete_output_annotation(fn, *example_args, out_mappings,
+                               axis_size=8, _closed=None):
+    """Backward pass over the traced jaxpr: seed the function outputs
+    with `out_mappings` (one dims_mapping per output leaf, or one list
+    for a single output) and run the registered infer_reverse rules
+    through transpose/reshape/elementwise/reduction eqns. Returns
+    {top_level_eqn_index: output_dims_mapping} for every equation the
+    annotation reached. Unknown primitives stop the flow (conservative,
+    same as the reference's fallback)."""
+    from .spmd_rules import DistTensorSpec, get_spmd_rule
+
+    if _closed is None:
+        flat = tree_util.tree_leaves(example_args)
+        _closed = jax.make_jaxpr(
+            lambda *a: tree_util.tree_leaves(
+                fn(*tree_util.tree_unflatten(
+                    tree_util.tree_structure(example_args), a))))(*flat)
+    jx = _closed.jaxpr
+    if out_mappings and not isinstance(out_mappings[0], (list, tuple)):
+        out_mappings = [out_mappings]
+    known = {}
+    for v, dm in zip(jx.outvars, out_mappings):
+        if not hasattr(v, "aval"):
+            continue
+        if len(dm) != len(v.aval.shape):
+            raise ValueError(
+                f"out_mappings entry {dm} has rank {len(dm)} but the "
+                f"output leaf has shape {tuple(v.aval.shape)} — one "
+                "dims_mapping per output leaf, matching its rank")
+        known[id(v)] = list(dm)
+    reached = {}
+    for i in reversed(range(len(jx.eqns))):
+        eqn = jx.eqns[i]
+        dm = None
+        for ov in eqn.outvars:
+            if id(ov) in known:
+                dm = known[id(ov)]
+                break
+        if dm is None:
+            continue
+        reached[i] = list(dm)
+        name = eqn.primitive.name
+        out_spec = DistTensorSpec(list(eqn.outvars[0].aval.shape), dm)
+        ivars = [v for v in eqn.invars if hasattr(v, "aval")]
+        in_shapes = [list(v.aval.shape) for v in ivars]
+        try:
+            if name == "transpose":
+                ins, _ = get_spmd_rule("transpose").infer_reverse(
+                    [in_shapes[0]], [out_spec],
+                    perm=list(eqn.params["permutation"]))
+                known[id(ivars[0])] = ins[0].dims_mapping
+            elif name == "reshape":
+                ins, _ = get_spmd_rule("reshape").infer_reverse(
+                    [in_shapes[0]], [out_spec])
+                known[id(ivars[0])] = ins[0].dims_mapping
+            elif name in ("reduce_sum", "reduce_max", "reduce_min",
+                          "reduce_prod"):
+                ins, _ = get_spmd_rule("reduction").infer_reverse(
+                    [in_shapes[0]], [out_spec],
+                    axis=list(eqn.params["axes"]))
+                known[id(ivars[0])] = ins[0].dims_mapping
+            elif name in _ELTWISE_PRIMS:
+                ins, _ = get_spmd_rule("elementwise").infer_reverse(
+                    in_shapes, [out_spec])
+                for v, spec in zip(ivars, ins):
+                    known.setdefault(id(v), spec.dims_mapping)
+            # dot_general: record (done above) but don't flow through —
+            # the contracted dim is undetermined by the output and the
+            # planner owns the operand-side decision
+        except Exception:
+            continue
+    return reached

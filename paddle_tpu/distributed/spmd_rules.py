@@ -208,6 +208,132 @@ class SpmdRule:
         """Returns ([inferred input specs], [inferred output specs])."""
         return self._fn(*specs, **attrs)
 
+    def infer_reverse(self, in_shapes, out_specs, **attrs):
+        """Completion in the reverse direction (parity:
+        MatmulInferSpmdReverse, phi/infermeta/spmd_rules/matmul.h:30):
+        given the OUTPUT dist specs and the input shapes, infer input
+        specs consistent with them. Dims not determined by any output
+        (e.g. a matmul's contracted k) stay replicated. Returns
+        ([in specs], [corrected out specs])."""
+        fn = _REVERSE_RULES.get(self.name)
+        if fn is None:
+            raise NotImplementedError(
+                f"no reverse SPMD rule for {self.name!r}")
+        outs = (list(out_specs) if isinstance(out_specs, (list, tuple))
+                else [out_specs])
+        return fn(list(in_shapes), outs, **attrs)
+
+
+_REVERSE_RULES = {}
+
+
+def register_spmd_reverse(name):
+    def deco(fn):
+        _REVERSE_RULES[name] = fn
+        return fn
+
+    return deco
+
+
+def einsum_infer_reverse(notation, in_shapes, out_specs):
+    """Reverse of einsum_infer: letters take their mapping from the
+    outputs; letters absent from every output (contracted) replicate."""
+    lhs, rhs = notation.split("->")
+    in_subs = lhs.split(",")
+    out_subs = rhs.split(",") if rhs else []
+    letter_map = {}
+    order = []
+    for sub, spec in zip(out_subs, out_specs):
+        if len(sub) != spec.ndim:
+            raise ValueError(
+                f"output {sub!r} rank != spec rank {spec.ndim}")
+        for letter, m in zip(sub, spec.dims_mapping):
+            if letter in "1*":
+                continue
+            if letter not in letter_map:
+                letter_map[letter] = []
+                order.append(letter)
+            letter_map[letter].append(m)
+    merged = {lt: _merge_axis(ms) for lt, ms in letter_map.items()}
+    used = {}
+    for lt in order:
+        m = merged[lt]
+        if m < 0:
+            continue
+        if m in used:
+            merged[lt] = -1
+        else:
+            used[m] = lt
+    in_specs = [
+        DistTensorSpec(shape, [merged.get(letter, -1)
+                               if letter not in "1*" else -1
+                               for letter in sub])
+        for sub, shape in zip(in_subs, in_shapes)
+    ]
+    new_outs = [
+        DistTensorSpec(spec.shape, [merged.get(letter, -1)
+                                    if letter not in "1*" else -1
+                                    for letter in sub])
+        for sub, spec in zip(out_subs, out_specs)
+    ]
+    return in_specs, new_outs
+
+
+@register_spmd_reverse("matmul")
+def _matmul_reverse(in_shapes, out_specs, trans_x=False, trans_y=False):
+    xd, yd = len(in_shapes[0]), len(in_shapes[1])
+    return einsum_infer_reverse(
+        _matmul_notation(xd, yd, trans_x, trans_y), in_shapes, out_specs)
+
+
+@register_spmd_reverse("elementwise")
+def _elementwise_reverse(in_shapes, out_specs):
+    fake = [DistTensorSpec(sh) for sh in in_shapes]
+    return einsum_infer_reverse(_broadcast_subs(fake), in_shapes, out_specs)
+
+
+@register_spmd_reverse("transpose")
+def _transpose_reverse(in_shapes, out_specs, perm=None):
+    nd = len(in_shapes[0])
+    perm = list(range(nd))[::-1] if perm is None else [p % nd for p in perm]
+    letters = _letters(nd)
+    out = "".join(letters[p] for p in perm)
+    return einsum_infer_reverse(f"{letters}->{out}", in_shapes, out_specs)
+
+
+@register_spmd_reverse("reshape")
+def _reshape_reverse(in_shapes, out_specs, shape=None):
+    # reshape reverse IS the forward rule applied out-shape -> in-shape
+    out = out_specs[0]
+    ins, outs = _reshape_rule(
+        DistTensorSpec(out.shape, out.dims_mapping), shape=in_shapes[0])
+    return [outs[0]], [ins[0]]
+
+
+@register_spmd_reverse("reduction")
+def _reduction_reverse(in_shapes, out_specs, axis=None, keepdim=False,
+                       reduce_type="sum"):
+    nd = len(in_shapes[0])
+    if axis is None:
+        axes = list(range(nd))
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        axes = [a % nd for a in axes]
+    letters = _letters(nd)
+    if keepdim:
+        out = "".join("*" if i in axes else c
+                      for i, c in enumerate(letters))
+    else:
+        out = "".join(c for i, c in enumerate(letters) if i not in axes)
+    return einsum_infer_reverse(f"{letters}->{out}", in_shapes, out_specs)
+
+
+@register_spmd_reverse("embedding")
+def _embedding_reverse(in_shapes, out_specs, padding_idx=-1, sparse=False):
+    ids_nd = len(in_shapes[0])
+    ids = _letters(ids_nd, skip="vh")
+    return einsum_infer_reverse(f"{ids},vh->{ids}h", in_shapes, out_specs)
+
 
 def get_spmd_rule(name) -> SpmdRule:
     try:
@@ -219,10 +345,7 @@ def get_spmd_rule(name) -> SpmdRule:
 
 
 # -- matmul ------------------------------------------------------------------
-@register_spmd_rule("matmul")
-def _matmul_rule(x: DistTensorSpec, y: DistTensorSpec, trans_x=False, trans_y=False):
-    """`matmul.cc:42-80`. Batched, broadcast-aware."""
-    xd, yd = x.ndim, y.ndim
+def _matmul_notation(xd, yd, trans_x, trans_y):
     x_mat = "mk" if not trans_x else "km"
     y_mat = "kn" if not trans_y else "nk"
     if xd == 1:
@@ -235,7 +358,14 @@ def _matmul_rule(x: DistTensorSpec, y: DistTensorSpec, trans_x=False, trans_y=Fa
     x_sub = batch[nb - xb:] + x_mat
     y_sub = batch[nb - yb:] + y_mat
     out = batch + ("m" if "m" in x_mat else "") + ("n" if "n" in y_mat else "")
-    return einsum_infer(f"{x_sub},{y_sub}->{out}", [x, y])
+    return f"{x_sub},{y_sub}->{out}"
+
+
+@register_spmd_rule("matmul")
+def _matmul_rule(x: DistTensorSpec, y: DistTensorSpec, trans_x=False, trans_y=False):
+    """`matmul.cc:42-80`. Batched, broadcast-aware."""
+    return einsum_infer(
+        _matmul_notation(x.ndim, y.ndim, trans_x, trans_y), [x, y])
 
 
 @register_spmd_rule("einsum")

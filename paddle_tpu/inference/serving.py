@@ -62,7 +62,7 @@ class PagePool:
 class _Request:
     __slots__ = ("rid", "prompt", "generated", "length", "pages",
                  "temperature", "top_k", "top_p", "on_token",
-                 "prefill_pos")
+                 "prefill_pos", "seq_tokens", "admit_seq")
 
     def __init__(self, rid, prompt, temperature=0.0, top_k=0, top_p=1.0,
                  on_token=None):
@@ -76,6 +76,11 @@ class _Request:
         self.top_p = float(top_p)
         self.on_token = on_token
         self.prefill_pos = 0     # tokens already written to kv (chunked)
+        # the tokens prefill must (re)build KV for: the prompt initially;
+        # after a preemption, prompt + generated-so-far (the resume prefix)
+        self.seq_tokens = self.prompt
+        self.admit_seq = -1      # admission order (preemption victims =
+                                 # youngest first, vLLM recompute policy)
 
 
 def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
@@ -151,6 +156,8 @@ class ContinuousBatchingEngine:
         self._decode_jit = jax.jit(self._decode_step, donate_argnums=(4, 5),
                                    static_argnums=(10,))
         self.prefill_batches = 0      # observability: admission group count
+        self.preemptions = 0          # pages reclaimed from the youngest
+        self._admit_counter = 0
         # chunked prefill (vLLM-style): admit immediately, write the
         # prompt's KV `prefill_chunk` tokens per TICK so long prompts
         # don't stall the decode latency of running requests
@@ -248,11 +255,11 @@ class ContinuousBatchingEngine:
         self.prefills_completed += len(reqs)
         w = self._weights
         B = len(reqs)
-        lens = np.asarray([len(r.prompt) for r in reqs])
+        lens = np.asarray([len(r.seq_tokens) for r in reqs])
         S = int(lens.max())
         ids_np = np.zeros((B, S), np.int32)
         for i, r in enumerate(reqs):
-            ids_np[i, : lens[i]] = r.prompt
+            ids_np[i, : lens[i]] = r.seq_tokens
         ids = jnp.asarray(ids_np)
         x = w["embed"][ids]                                  # [B, S, H]
         pos0 = jnp.zeros((B,), jnp.int32)
@@ -375,12 +382,19 @@ class ContinuousBatchingEngine:
             if self._slots[i] is not None or not self._waiting:
                 continue
             req = self._waiting[0]
-            need = (len(req.prompt) + self.max_new_tokens
-                    + self.page - 1) // self.page
+            # reserve only what PREFILL writes (the resume prefix); decode
+            # pages are allocated as the sequence grows, with preemption
+            # under pressure — block-table growth semantics of the
+            # reference's block_multi_head_attention serving path (vs the
+            # r4 worst-case prompt+max_new reservation that capped batch
+            # width at a fraction of pool capacity)
+            need = (len(req.seq_tokens) + self.page - 1) // self.page
             if need > self.pool.available:
                 break  # head-of-line waits for pages
             self._waiting.popleft()
             req.pages = self.pool.alloc(need)
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
             self._slots[i] = req
             group.append(req)
         if not group:
@@ -452,7 +466,7 @@ class ContinuousBatchingEngine:
         per request)."""
         jnp = self._jnp
         reqs = [r for r in self._slots
-                if r is not None and r.prefill_pos < len(r.prompt)]
+                if r is not None and r.prefill_pos < len(r.seq_tokens)]
         if not reqs:
             return
         B, c = self.max_slots, self.prefill_chunk
@@ -464,8 +478,8 @@ class ContinuousBatchingEngine:
         hist = np.zeros((B, self.pages_per_seq), np.int32)
         for i, r in enumerate(reqs):
             pos = r.prefill_pos
-            n = min(c, len(r.prompt) - pos)
-            ids_np[i, :n] = r.prompt[pos:pos + n]
+            n = min(c, len(r.seq_tokens) - pos)
+            ids_np[i, :n] = r.seq_tokens[pos:pos + n]
             pos0[i], nvalid[i] = pos, n
             pages = np.asarray(r.pages, np.int64)
             ap = np.arange(pos, pos + n)
@@ -480,15 +494,66 @@ class ContinuousBatchingEngine:
         completed = []
         for i, r in enumerate(reqs):
             r.prefill_pos += int(nvalid[i])
-            if r.prefill_pos == len(r.prompt):
+            if r.prefill_pos == len(r.seq_tokens):
                 completed.append((i, r))
         if completed:
             rows = last[jnp.asarray([i for i, _ in completed])]
             toks = self._head_tokens(rows, [r for _, r in completed])
             for (i, r), tok in zip(completed, toks):
                 self.prefills_completed += 1
-                r.length = len(r.prompt)
+                r.length = len(r.seq_tokens)
                 self._emit(r, tok)
+
+    def _preempt(self, slot_idx):
+        """Free a running request's pages and requeue it at the FRONT of
+        the waiting queue with its generated prefix folded into the
+        resume tokens — re-admission rebuilds the KV by prefilling
+        prompt+generated (recompute policy; correctness is bitwise for
+        greedy decodes, asserted by tests)."""
+        r = self._slots[slot_idx]
+        self.pool.free(r.pages)
+        r.pages = []
+        r.seq_tokens = r.prompt + r.generated
+        r.prefill_pos = 0
+        r.length = 0
+        self._slots[slot_idx] = None
+        self._waiting.appendleft(r)
+        self.preemptions += 1
+
+    def _grow_pages(self):
+        """Ensure every decoding slot owns pages for this tick's token.
+        On pool exhaustion, preempt the YOUNGEST running request (its
+        oldest peers keep their pages and finish first — guaranteed
+        progress, no deadlock: a lone request always fits by the submit()
+        feasibility check)."""
+        while True:
+            # oldest-first service order
+            live = sorted(
+                ((i, r) for i, r in enumerate(self._slots)
+                 if r is not None and r.length > 0),
+                key=lambda ir: ir[1].admit_seq)
+            short = None
+            for i, r in live:
+                need = (r.length + 1 + self.page - 1) // self.page
+                grow = need - len(r.pages)
+                if grow <= 0:
+                    continue
+                if grow <= self.pool.available:
+                    r.pages.extend(self.pool.alloc(grow))
+                else:
+                    short = (i, r)
+                    break
+            if short is None:
+                return
+            # youngest victim across ALL occupied slots — a just-admitted
+            # mid-prefill request is younger than any decoding one, so
+            # the oldest running requests keep their pages and finish
+            # first; only if the starved request IS the youngest does it
+            # preempt itself (re-runs when pages free up)
+            occupied = [(i, r) for i, r in enumerate(self._slots)
+                        if r is not None]
+            victim = max(occupied, key=lambda ir: ir[1].admit_seq)
+            self._preempt(victim[0])
 
     def _retire(self, req: _Request):
         self.pool.free(req.pages)
@@ -512,8 +577,9 @@ class ContinuousBatchingEngine:
         self._admit()
         if self.prefill_chunk is not None:
             self._prefill_tick()
+        self._grow_pages()
         live = [(i, r) for i, r in enumerate(self._slots)
-                if r is not None and r.generated]
+                if r is not None and r.generated and r.length > 0]
         if not live:
             return newly
         # fixed-width batch: pad with slot 0's state (results discarded)

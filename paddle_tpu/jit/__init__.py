@@ -68,7 +68,16 @@ class StaticFunction:
             self._layer = getattr(function, "__self__", None)
             self._fn = function
         self._input_spec = input_spec
-        self._compiled = {}
+        # LRU-bounded program cache: value guards key on python scalars
+        # (below), so a Layer that mutates a fresh scalar every call
+        # (self.calls += 1 in forward) would otherwise grow this dict
+        # without bound while retracing per call — correct (the old
+        # behavior silently reused a stale program) but it must not
+        # leak. 32 programs covers shape buckets x a few guard states.
+        import collections
+
+        self._compiled = collections.OrderedDict()
+        self._compiled_cap = 32
         self._fallback_warned = False
         # dynamic-dim bucketing (SURVEY hard-part 6): dims declared
         # None/-1 in input_spec are padded up to the next power of two, so
@@ -161,6 +170,41 @@ class StaticFunction:
             out[k] = v
         return out
 
+    _GUARD_SCALARS = (bool, int, float, str, bytes, type(None))
+
+    def _value_guard_sig(self):
+        """Python-state value guards (reference: jit/sot guard.py —
+        guards on object attributes and closure cells read by the traced
+        frame). A trace bakes python scalars into the program
+        (`if self.use_cache:`, a closed-over scale float), so the cache
+        key must carry them: the cheap 90% is every scalar attribute on
+        the Layer tree plus the function's scalar closure cells —
+        mutating one maps to a NEW key (retrace); restoring it reuses
+        the old compiled program."""
+        parts = []
+        if self._layer is not None:
+            it = self._layer.named_sublayers(include_self=True)
+            for path, layer in it:
+                for k, v in layer.__dict__.items():
+                    if k.startswith("_") or k == "training":
+                        continue
+                    if isinstance(v, self._GUARD_SCALARS):
+                        parts.append((path, k, v))
+        fn = self._fn
+        if fn is not None:
+            try:
+                closure = fn.__closure__ or ()
+            except AttributeError:
+                closure = ()
+            for i, cell in enumerate(closure):
+                try:
+                    v = cell.cell_contents
+                except ValueError:
+                    continue
+                if isinstance(v, self._GUARD_SCALARS):
+                    parts.append(("<closure>", i, v))
+        return tuple(parts)
+
     def _trace_key(self, raw_args, raw_kwargs):
         training = self._layer.training if self._layer is not None else False
 
@@ -175,9 +219,14 @@ class StaticFunction:
 
         sig = tuple(leaf_sig(a)
                     for a in tree_util.tree_leaves((raw_args, raw_kwargs)))
-        return (training, sig)
+        return (training, sig, self._value_guard_sig())
 
     def _get_compiled(self, key):
+        if key in self._compiled:
+            self._compiled.move_to_end(key)
+        else:
+            while len(self._compiled) >= self._compiled_cap:
+                self._compiled.popitem(last=False)
         if key not in self._compiled:
             layer = self._layer
             fn = self._fn

@@ -1,4 +1,5 @@
 """paddle.utils (parity subset: flags, unique_name, deprecated helpers)."""
+from . import download  # noqa: F401
 from . import flags  # noqa: F401
 from . import unique_name  # noqa: F401
 

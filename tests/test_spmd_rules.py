@@ -470,3 +470,63 @@ class TestOperatedAxisReplication:
         # per-dim (lo, hi) pairs: pad only dim 1
         ins, _ = rule.infer_forward(x, paddings=[0, 0, 1, 1])
         assert dm(ins[0]) == [0, -1]
+
+
+class TestReverseRules:
+    """VERDICT r4 item 4: infer_reverse for the structural family
+    (parity: MatmulInferSpmdReverse, phi spmd_rules/matmul.h:30)."""
+
+    def test_matmul_reverse_out_to_operands(self):
+        rule = get_spmd_rule("matmul")
+        out = DistTensorSpec((64, 48), [1, 0])          # mn[1, 0]
+        ins, outs = rule.infer_reverse([(64, 32), (32, 48)], [out])
+        assert dm(ins[0]) == [1, -1]   # x mk: m from out, k undetermined
+        assert dm(ins[1]) == [-1, 0]   # y kn: n from out
+        assert dm(outs[0]) == [1, 0]
+
+    def test_matmul_reverse_transposed_weight(self):
+        rule = get_spmd_rule("matmul")
+        out = DistTensorSpec((64, 48), [-1, 0])
+        ins, _ = rule.infer_reverse([(64, 32), (48, 32)], [out],
+                                    trans_y=True)
+        assert dm(ins[1]) == [0, -1]   # y nk: n gets the out col sharding
+
+    def test_transpose_reverse_inverts_perm(self):
+        rule = get_spmd_rule("transpose")
+        out = DistTensorSpec((16, 8, 4), [0, -1, 1])
+        ins, _ = rule.infer_reverse([(8, 4, 16)], [out], perm=[2, 0, 1])
+        # out dim i = in dim perm[i]: in[2]=out[0], in[0]=out[1], in[1]=out[2]
+        assert dm(ins[0]) == [-1, 1, 0]
+
+    def test_reshape_reverse_through_merge(self):
+        rule = get_spmd_rule("reshape")
+        out = DistTensorSpec((128, 32), [0, 1])   # merged (16,8) -> 128
+        ins, _ = rule.infer_reverse([(16, 8, 32)], [out])
+        assert dm(ins[0]) == [0, -1, 1]  # leading dim of the group
+
+    def test_reduction_reverse_lifts_kept_dims(self):
+        rule = get_spmd_rule("reduction")
+        out = DistTensorSpec((16,), [0])
+        ins, _ = rule.infer_reverse([(16, 32)], [out], axis=1)
+        assert dm(ins[0]) == [0, -1]
+
+    def test_elementwise_reverse_broadcast(self):
+        rule = get_spmd_rule("elementwise")
+        out = DistTensorSpec((8, 16), [0, 1])
+        ins, _ = rule.infer_reverse([(8, 16), (16,)], [out])
+        assert dm(ins[0]) == [0, 1]
+        assert dm(ins[1]) == [1]
+
+    def test_embedding_reverse(self):
+        rule = get_spmd_rule("embedding")
+        out = DistTensorSpec((4, 16, 64), [0, -1, 1])
+        ins, _ = rule.infer_reverse([(4, 16), (1000, 64)], [out])
+        assert dm(ins[0]) == [0, -1]
+        assert dm(ins[1]) == [-1, 1]
+
+    def test_unregistered_reverse_raises(self):
+        import pytest
+
+        with pytest.raises(NotImplementedError):
+            get_spmd_rule("softmax").infer_reverse(
+                [(4, 4)], [DistTensorSpec((4, 4))])

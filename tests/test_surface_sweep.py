@@ -174,7 +174,8 @@ def test_nested_namespace_all_closure():
         if rel == "." or rel.count(os.sep) > 2:
             continue
         try:
-            tree = ast.parse(open(os.path.join(root, "__init__.py")).read())
+            with open(os.path.join(root, "__init__.py")) as f:
+                tree = ast.parse(f.read())
         except SyntaxError:
             continue
         ref_all = None

@@ -41,9 +41,18 @@ def main():
             p._data = p._data.astype(jax.numpy.bfloat16)
     rng = np.random.default_rng(0)
 
-    for slots in (2, 4, 8):
+    for slots in (8, 16, 32) if on_tpu else (2, 4):
+        # r5: pool sized BELOW worst-case — prompt pages for every slot
+        # plus ~half the decode growth — so incremental allocation +
+        # preemption carry the load instead of head-of-line blocking on
+        # worst-case reservations
+        per_seq_worst = -(-(prompt_len + new_tokens) // 64)
+        prompt_pages = -(-prompt_len // 64)
+        grow = per_seq_worst - prompt_pages
+        tight = max(slots * prompt_pages + (slots * grow) // 2,
+                    per_seq_worst) + 1
         eng = ContinuousBatchingEngine(
-            model, max_slots=slots, page_size=64,
+            model, max_slots=slots, page_size=64, num_pages=tight,
             max_new_tokens=new_tokens, prefill_chunk=64)
         n_req = slots * 2
         for _ in range(n_req):
@@ -55,7 +64,9 @@ def main():
         gen = sum(len(v) - prompt_len for v in done.values())
         print(f"slots={slots}: {n_req} reqs x {prompt_len}p+{new_tokens}g"
               f" -> {gen} generated in {dt:.1f}s = {gen / dt:.1f} tok/s"
-              f" (prefill passes: {eng.prefill_chunk_steps})", flush=True)
+              f" (prefill passes: {eng.prefill_chunk_steps},"
+              f" preemptions: {eng.preemptions},"
+              f" pool: {tight} pages)", flush=True)
 
 
 if __name__ == "__main__":
