@@ -308,6 +308,10 @@ def complete_output_annotation(fn, *example_args, out_mappings,
     jx = _closed.jaxpr
     if out_mappings and not isinstance(out_mappings[0], (list, tuple)):
         out_mappings = [out_mappings]
+    if len(out_mappings) != len(jx.outvars):
+        raise ValueError(
+            f"{len(out_mappings)} out_mappings for {len(jx.outvars)} "
+            "output leaves — one dims_mapping per flattened output")
     known = {}
     for v, dm in zip(jx.outvars, out_mappings):
         if not hasattr(v, "aval"):
@@ -354,6 +358,41 @@ def complete_output_annotation(fn, *example_args, out_mappings,
                     in_shapes, [out_spec])
                 for v, spec in zip(ivars, ins):
                     known.setdefault(id(v), spec.dims_mapping)
+            elif name == "concatenate":
+                ins, _ = get_spmd_rule("concat").infer_reverse(
+                    in_shapes, [out_spec],
+                    axis=int(eqn.params["dimension"]))
+                for v, spec in zip(ivars, ins):
+                    known.setdefault(id(v), spec.dims_mapping)
+            elif name == "rev":
+                ins, _ = get_spmd_rule("flip").infer_reverse(
+                    [in_shapes[0]], [out_spec],
+                    axis=list(eqn.params["dimensions"]))
+                known[id(ivars[0])] = ins[0].dims_mapping
+            elif name == "pad":
+                cfg = eqn.params["padding_config"]
+                padded = [i for i, (lo, hi, it) in enumerate(cfg)
+                          if lo or hi or it]
+                in_dm = [(-1 if i in padded else m)
+                         for i, m in enumerate(dm)]
+                known[id(ivars[0])] = in_dm
+            elif name == "squeeze":
+                dims = set(eqn.params["dimensions"])
+                in_dm, j = [], 0
+                for i in range(len(in_shapes[0])):
+                    if i in dims:
+                        in_dm.append(-1)
+                    else:
+                        in_dm.append(dm[j])
+                        j += 1
+                known[id(ivars[0])] = in_dm
+            elif name == "broadcast_in_dim":
+                bd = eqn.params["broadcast_dimensions"]
+                in_shape = in_shapes[0]
+                in_dm = [(dm[od] if in_shape[j] ==
+                          eqn.outvars[0].aval.shape[od] else -1)
+                         for j, od in enumerate(bd)]
+                known[id(ivars[0])] = in_dm
             # dot_general: record (done above) but don't flow through —
             # the contracted dim is undetermined by the output and the
             # planner owns the operand-side decision

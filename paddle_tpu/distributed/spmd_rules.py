@@ -1039,3 +1039,247 @@ def _group_norm_rule(x: DistTensorSpec, scale=None, bias=None, **attrs):
     specs = [x] + [s for s in (scale, bias) if s is not None]
     subs = [sub] + ["*" for s in (scale, bias) if s is not None]
     return einsum_infer(",".join(subs) + f"->{sub}", specs)
+
+
+# ---------------------------------------------------------------------------
+# Reverse-rule breadth (beyond the six structural families the planner
+# completion uses): every notation-based rule gets its reverse through
+# einsum_infer_reverse — the reference registers Infer...SpmdReverse for
+# nearly every rule file (phi/infermeta/spmd_rules/*.h), and completion
+# quality degrades wherever a reverse is missing.
+# ---------------------------------------------------------------------------
+def _register_notation_reverse(name, notation_of):
+    """notation_of(in_shapes, attrs) -> einsum notation (same one the
+    forward rule would build)."""
+    @register_spmd_reverse(name)
+    def _rev(in_shapes, out_specs, **attrs):
+        return einsum_infer_reverse(
+            notation_of(in_shapes, attrs), in_shapes, out_specs)
+    _rev.__name__ = f"_{name}_reverse"
+    return _rev
+
+
+def _axis_star_sub(nd, axes):
+    letters = _letters(nd)
+    return "".join("*" if i in axes else c for i, c in enumerate(letters))
+
+
+def _ident_notation(shapes, attrs):
+    sub = _letters(len(shapes[0]))
+    return f"{sub}->{sub}"
+
+
+for _n in ("cast", "dropout", "clip", "scale", "tril", "triu"):
+    _register_notation_reverse(_n, _ident_notation)
+
+_register_notation_reverse(
+    "softmax", lambda sh, at: (lambda sub: f"{sub}->{sub}")(
+        _axis_star_sub(len(sh[0]), {at.get("axis", -1) % len(sh[0])})))
+_register_notation_reverse(
+    "cumsum", lambda sh, at: (lambda sub: f"{sub}->{sub}")(
+        _axis_star_sub(len(sh[0]), {at.get("axis", -1) % len(sh[0])})))
+_register_notation_reverse(
+    "slice", lambda sh, at: (lambda sub: f"{sub}->{sub}")(
+        _axis_star_sub(len(sh[0]),
+                       {a % len(sh[0]) for a in at.get("axes", ())})))
+_register_notation_reverse(
+    "tile", lambda sh, at: _tile_notation(sh, at))
+
+
+def _tile_notation(sh, at):
+    nd = len(sh[0])
+    rep = list(at.get("repeat_times", ()))
+    rep = [1] * (nd - len(rep)) + rep[-nd:] if len(rep) <= nd else rep[-nd:]
+    return (lambda sub: f"{sub}->{sub}")(
+        _axis_star_sub(nd, {i for i in range(nd) if rep[i] != 1}))
+
+
+_register_notation_reverse(
+    "concat", lambda sh, at: (lambda sub: ",".join([sub] * len(sh))
+                              + f"->{sub}")(
+        _axis_star_sub(len(sh[0]), {at.get("axis", 0) % len(sh[0])})))
+
+
+@register_spmd_reverse("split")
+def _split_reverse(in_shapes, out_specs, num_or_sections=2, axis=0):
+    nd = len(in_shapes[0])
+    sub = _axis_star_sub(nd, {axis % nd})
+    notation = sub + "->" + ",".join([sub] * len(out_specs))
+    return einsum_infer_reverse(notation, in_shapes, out_specs)
+
+
+@register_spmd_reverse("stack")
+def _stack_reverse(in_shapes, out_specs, axis=0):
+    nd = len(in_shapes[0])
+    axis %= nd + 1
+    letters = _letters(nd)
+    notation = (",".join([letters] * len(in_shapes)) + "->"
+                + letters[:axis] + "1" + letters[axis:])
+    return einsum_infer_reverse(notation, in_shapes, out_specs)
+
+
+@register_spmd_reverse("squeeze")
+def _squeeze_reverse(in_shapes, out_specs, axis=None):
+    shape = in_shapes[0]
+    nd = len(shape)
+    if axis is None:
+        axes = [i for i, s in enumerate(shape) if s == 1]
+    else:
+        axes = [a % nd
+                for a in (axis if isinstance(axis, (list, tuple))
+                          else [axis])]
+    letters = _letters(nd)
+    sub = "".join("1" if i in axes else c for i, c in enumerate(letters))
+    out = "".join(c for i, c in enumerate(letters) if i not in axes)
+    return einsum_infer_reverse(f"{sub}->{out}", in_shapes, out_specs)
+
+
+@register_spmd_reverse("unsqueeze")
+def _unsqueeze_reverse(in_shapes, out_specs, axis=0):
+    shape = in_shapes[0]
+    axes = sorted(a % (len(shape) + 1)
+                  for a in (axis if isinstance(axis, (list, tuple))
+                            else [axis]))
+    out = out_specs[0]
+    in_dm = [m for d, m in enumerate(out.dims_mapping) if d not in axes]
+    return ([DistTensorSpec(shape, in_dm)],
+            [DistTensorSpec(out.shape, out.dims_mapping)])
+
+
+_register_notation_reverse(
+    "one_hot", lambda sh, at: (lambda sub: f"{sub}->{sub}c")(
+        _letters(len(sh[0]), skip="c")))
+_register_notation_reverse(
+    "topk", lambda sh, at: (lambda sub: f"{sub}->{sub},{sub}")(
+        _axis_star_sub(len(sh[0]), {at.get("axis", -1) % len(sh[0])})))
+_register_notation_reverse(
+    "where", lambda sh, at: _broadcast_subs(
+        [DistTensorSpec(s) for s in sh]))
+_register_notation_reverse(
+    "bmm", lambda sh, at: "bmk,bkn->bmn")
+_register_notation_reverse(
+    "einsum", lambda sh, at: at["equation"])
+_register_notation_reverse(
+    "conv", lambda sh, at: (lambda nsp: f"bc{'*' * nsp},oc{'*' * nsp}"
+                            f"->bo{'*' * nsp}")(len(sh[0]) - 2))
+
+
+@register_spmd_reverse("layer_norm")
+def _layer_norm_reverse(in_shapes, out_specs, begin_norm_axis=-1, **_):
+    nd = len(in_shapes[0])
+    begin_norm_axis %= nd
+    letters = _letters(nd)
+    sub = "".join("*" if i >= begin_norm_axis else c
+                  for i, c in enumerate(letters))
+    lead = sub[:begin_norm_axis]
+    subs = [sub] + ["*" * len(s) for s in in_shapes[1:]]
+    notation = ",".join(subs) + f"->{sub},{lead},{lead}"
+    # out_specs may carry only `out` (mean/var letters then stay unseeded
+    # — zip truncation is the intended partial-reverse contract)
+    return einsum_infer_reverse(notation, in_shapes, out_specs)
+
+
+@register_spmd_reverse("rms_norm")
+def _rms_norm_reverse(in_shapes, out_specs, begin_norm_axis=-1, **_):
+    ins, outs = _layer_norm_reverse(
+        in_shapes, out_specs, begin_norm_axis=begin_norm_axis)
+    return ins, outs[:1]
+
+
+@register_spmd_reverse("flip")
+def _flip_reverse(in_shapes, out_specs, **attrs):
+    nd = len(in_shapes[0])
+    sub = _axis_star_sub(nd, _flip_axes(nd, attrs))
+    return einsum_infer_reverse(f"{sub}->{sub}", in_shapes, out_specs)
+
+
+@register_spmd_reverse("roll")
+def _roll_reverse(in_shapes, out_specs, **attrs):
+    nd = len(in_shapes[0])
+    sub = _axis_star_sub(nd, _roll_axes(nd, attrs))
+    return einsum_infer_reverse(f"{sub}->{sub}", in_shapes, out_specs)
+
+
+@register_spmd_reverse("pad")
+def _pad_reverse(in_shapes, out_specs, **attrs):
+    nd = len(in_shapes[0])
+    sub = _axis_star_sub(nd, _pad_axes(nd, attrs))
+    return einsum_infer_reverse(f"{sub}->{sub}", in_shapes, out_specs)
+
+
+def _register_axis_replicated_reverse(name, n_out=1):
+    @register_spmd_reverse(name)
+    def _rev(in_shapes, out_specs, axis=-1, **attrs):
+        nd = len(in_shapes[0])
+        sub = _axis_star_sub(nd, {axis % nd})
+        notation = f"{sub}->" + ",".join([sub] * n_out)
+        return einsum_infer_reverse(notation, in_shapes, out_specs)
+    _rev.__name__ = f"_{name}_reverse"
+    return _rev
+
+
+for _n in ("sort", "cummax", "cummin", "logcumsumexp", "kthvalue",
+           "argsort"):
+    _register_axis_replicated_reverse(
+        _n, n_out=2 if _n in ("cummax", "cummin", "kthvalue",
+                              "argsort") else 1)
+
+
+@register_spmd_reverse("argmax")
+def _argmax_reverse(in_shapes, out_specs, axis=-1, keepdim=False):
+    nd = len(in_shapes[0])
+    if axis is None:
+        axes = set(range(nd))
+    else:
+        axes = {axis % nd}
+    letters = _letters(nd)
+    if keepdim:
+        out = "".join("*" if i in axes else c
+                      for i, c in enumerate(letters))
+    else:
+        out = "".join(c for i, c in enumerate(letters) if i not in axes)
+    sub = "".join("*" if i in axes else c for i, c in enumerate(letters))
+    return einsum_infer_reverse(f"{sub}->{out}", in_shapes, out_specs)
+
+
+@register_spmd_reverse("gather")
+def _gather_reverse(in_shapes, out_specs, axis=0):
+    # out takes index's shape on the gathered axis; x's axis replicated
+    nd = len(in_shapes[0])
+    axis %= nd
+    letters = _letters(nd, skip="i")
+    x_sub = "".join("*" if i == axis else c
+                    for i, c in enumerate(letters))
+    idx_nd = len(in_shapes[1])
+    idx_sub = _letters(idx_nd, skip=letters)  # distinct letters
+    out_sub = (x_sub[:axis] + idx_sub + x_sub[axis + 1:])
+    return einsum_infer_reverse(f"{x_sub},{idx_sub}->{out_sub}",
+                                in_shapes, out_specs)
+
+
+@register_spmd_reverse("index_select")
+def _index_select_reverse(in_shapes, out_specs, axis=0):
+    nd = len(in_shapes[0])
+    axis %= nd
+    letters = _letters(nd, skip="i")
+    x_sub = "".join("*" if i == axis else c
+                    for i, c in enumerate(letters))
+    out_sub = "".join("i" if i == axis else c
+                      for i, c in enumerate(letters))
+    return einsum_infer_reverse(f"{x_sub},i->{out_sub}",
+                                in_shapes, out_specs)
+
+
+@register_spmd_reverse("take_along_axis")
+def _take_along_axis_reverse(in_shapes, out_specs, axis=0):
+    nd = len(in_shapes[0])
+    sub = _axis_star_sub(nd, {axis % nd})
+    return einsum_infer_reverse(f"{sub},{sub}->{sub}",
+                                in_shapes, out_specs)
+
+
+@register_spmd_reverse("c_embedding")
+def _c_embedding_reverse(in_shapes, out_specs, start_index=0):
+    # arg order (w, x); reuse the embedding reverse and swap back
+    ins, outs = _embedding_reverse([in_shapes[1], in_shapes[0]], out_specs)
+    return [ins[1], ins[0]], outs

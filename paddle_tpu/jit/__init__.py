@@ -183,8 +183,17 @@ class StaticFunction:
         the old compiled program."""
         parts = []
         if self._layer is not None:
-            it = self._layer.named_sublayers(include_self=True)
-            for path, layer in it:
+            # layer-list snapshot cached once: the expensive part of the
+            # per-call walk is re-enumerating the tree, not reading the
+            # dicts (sublayer sets are static after __init__ in practice;
+            # a NEW sublayer implies new params, which already retraces
+            # via the state shapes)
+            layers = getattr(self, "_guard_layers", None)
+            if layers is None:
+                layers = list(
+                    self._layer.named_sublayers(include_self=True))
+                self._guard_layers = layers
+            for path, layer in layers:
                 for k, v in layer.__dict__.items():
                     if k.startswith("_") or k == "training":
                         continue

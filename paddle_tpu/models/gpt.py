@@ -423,7 +423,12 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
         h2 = checkpoint_name(_rms_pure(x, ln2), "ln2_out")
     if os.environ.get("PTPU_INT8_FFN"):
         # int8-saved gate/up: exact forward, backward dequantises instead
-        # of re-running the two matmuls (~9 TFLOP/step at 1.3B/b4)
+        # of re-running the two matmuls (~9 TFLOP/step at 1.3B/b4).
+        # MEASURED LOSING on v5e-16G (0.523-0.528 vs 0.547 baseline, r4:
+        # quant bandwidth + fusion breakage > the FLOPs saved) and
+        # SUPERSEDED in r5 by factored-AdamW freeing enough HBM to save
+        # gate/up in bf16 outright (the ffn_gate/ffn_up names below).
+        # Kept for memory-floor configs only.
         return x + _ffn_i8(h2, wg, wu, wd)
     # per-projection anchors: saving gate/up outputs individually lets a
     # policy trade ~67MB/layer (b4) for skipping that matmul's re-run

@@ -116,8 +116,12 @@ class Adam(Optimizer):
         # moment_dtype="int8": blockwise-quantised moments (8-bit Adam) —
         # m stored signed int8, sqrt(v) stored uint8, per-2048-block f32
         # scales. Optimizer HBM drops 4x vs fp32 / 2x vs bf16 moments
-        # (1.3B bf16: 5.4G -> 1.35G), buying remat headroom on a 16G
-        # chip. Parity bounded by tests/test_optimizer.py.
+        # (1.3B bf16: 5.4G -> 1.35G). MEASURED SLOWER on v5e-16G pretrain
+        # (-13% MFU: the quant/dequant round-trips break XLA fusion —
+        # docs/ROUND4_RESPONSE.md) — use only for memory-bound
+        # fine-tuning where the state simply must fit; for pretrain
+        # headroom prefer factored=True, which measured FASTER (r5).
+        # Parity bounded by tests/test_optimizer.py.
         if moment_dtype not in (None, "int8"):
             raise ValueError("moment_dtype must be None or 'int8'")
         if moment_dtype == "int8" and (amsgrad or multi_precision):

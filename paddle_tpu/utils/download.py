@@ -35,11 +35,22 @@ def _md5check(fullpath, md5sum=None):
     return h.hexdigest() == md5sum
 
 
+def _cache_name(url):
+    """Cache key: basename + a short url hash — two sources sharing a
+    filename must not alias to one cache entry (a stale-read trap when
+    no md5 is given)."""
+    base = osp.basename(url.split("?")[0]) or "weights"
+    if "://" not in url:
+        url = osp.abspath(url)
+    tag = hashlib.sha1(url.encode()).hexdigest()[:10]
+    root, ext = osp.splitext(base)
+    return f"{root}.{tag}{ext}"
+
+
 def _download(url, root_dir):
     """Fetch `url` into root_dir atomically (tmp file + rename)."""
     os.makedirs(root_dir, exist_ok=True)
-    fname = osp.basename(url.split("?")[0]) or "weights"
-    fullpath = osp.join(root_dir, fname)
+    fullpath = osp.join(root_dir, _cache_name(url))
     src = None
     if url.startswith("file://"):
         src = url[len("file://"):]
@@ -68,8 +79,7 @@ def _download(url, root_dir):
 def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
     """Cache-or-fetch: return the local path for `url` under root_dir,
     verifying the md5 when given (re-fetches on mismatch)."""
-    fname = osp.basename(url.split("?")[0]) or "weights"
-    fullpath = osp.join(root_dir, fname)
+    fullpath = osp.join(root_dir, _cache_name(url))
     if check_exist and osp.exists(fullpath) and _md5check(fullpath, md5sum):
         return fullpath
     fullpath = _download(url, root_dir)

@@ -19,6 +19,13 @@ def _load_pretrained(model, arch, pretrained):
     from ..utils.download import get_weights_path_from_url
 
     if isinstance(pretrained, str):
+        import os.path as _osp
+
+        if "://" not in pretrained and _osp.exists(pretrained):
+            # direct local checkpoint: load in place — no multi-GB copy
+            # into WEIGHTS_HOME, no basename-keyed cache aliasing
+            model.set_state_dict(load(pretrained))
+            return model
         url, md5 = pretrained, None
     elif arch in model_urls:
         url, md5 = model_urls[arch]

