@@ -20,7 +20,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-__all__ = ["HeterSparseCache"]
+__all__ = ["HeterSparseCache", "HeterPSWorker"]
 
 
 class HeterSparseCache:
@@ -112,3 +112,88 @@ class HeterSparseCache:
     def hit_rate(self):
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
+
+
+class HeterPSWorker:
+    """Worker-side heter-PS orchestrator: one device row-cache per sparse
+    table plus a background prefetch pipeline.
+
+    Capability slot: the reference's ps_gpu_wrapper training pipeline
+    (fluid/framework/fleet/ps_gpu_wrapper.cc — BuildPull prefetches the
+    next pass's rows into GPU memory while the current pass computes,
+    PushSparseGrad merges duplicate keys in the sender). TPU-native
+    shape: `prefetch(batch)` runs the host-side PS pulls for the NEXT
+    batch on a worker thread so they overlap the device step; `get()`
+    joins and returns device arrays; `push` merges duplicate ids before
+    one RPC per table.
+    """
+
+    def __init__(self, client, tables, cache_rows=4096):
+        """tables: {name: dim} for every sparse table this worker uses."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.caches = {name: HeterSparseCache(client, name, dim,
+                                              cache_rows=cache_rows)
+                       for name, dim in tables.items()}
+        # ONE worker thread: cache state is not thread-safe; a single
+        # pipeline stage is exactly the reference's pass-ahead depth
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._pending = None
+
+    def prefetch(self, batch_ids):
+        """batch_ids: {table: ids}. Issues the pulls on the worker
+        thread; returns immediately."""
+        if self._pending is not None:
+            self._pending.result()  # keep the single-stage discipline
+
+        def _run(snapshot):
+            return {t: self.caches[t].pull(ids)
+                    for t, ids in snapshot.items()}
+
+        self._pending = self._pool.submit(
+            _run, {t: list(ids) for t, ids in batch_ids.items()})
+
+    def get(self):
+        """Join the pending prefetch -> {table: device rows}."""
+        if self._pending is None:
+            raise RuntimeError("get() without a prefetch() in flight")
+        out = self._pending.result()
+        self._pending = None
+        return out
+
+    def _quiesce(self):
+        """Caches are single-threaded: any main-thread cache access must
+        first join an in-flight prefetch (it stays available to get())."""
+        if self._pending is not None:
+            self._pending.result()
+
+    def pull(self, table, ids):
+        """Synchronous pull (no pipeline)."""
+        self._quiesce()
+        return self.caches[table].pull(ids)
+
+    def push(self, table, ids, grads):
+        """Merge duplicate ids worker-side (one summed row per id, the
+        reference's sender-side merge), then one PS push + cache
+        invalidation."""
+        self._quiesce()
+        ids = np.asarray(ids).reshape(-1)
+        if ids.size == 0:
+            return
+        grads = np.asarray(grads)
+        order = {}
+        for i, rid in enumerate(ids):
+            order.setdefault(int(rid), []).append(i)
+        uniq = list(order)
+        merged = np.stack([grads[rows].sum(axis=0)
+                           for rows in order.values()])
+        self.caches[table].push(np.asarray(uniq), merged)
+
+    def hit_rates(self):
+        return {t: c.hit_rate() for t, c in self.caches.items()}
+
+    def shutdown(self):
+        if self._pending is not None:
+            self._pending.result()
+            self._pending = None
+        self._pool.shutdown()

@@ -183,17 +183,12 @@ class StaticFunction:
         the old compiled program."""
         parts = []
         if self._layer is not None:
-            # layer-list snapshot cached once: the expensive part of the
-            # per-call walk is re-enumerating the tree, not reading the
-            # dicts (sublayer sets are static after __init__ in practice;
-            # a NEW sublayer implies new params, which already retraces
-            # via the state shapes)
-            layers = getattr(self, "_guard_layers", None)
-            if layers is None:
-                layers = list(
-                    self._layer.named_sublayers(include_self=True))
-                self._guard_layers = layers
-            for path, layer in layers:
+            # per-call tree walk, deliberately uncached: a sublayer
+            # attached AFTER the first call must still be guarded on its
+            # scalar mutations (a snapshot would silently reuse stale
+            # programs). The generator walk is cheap next to jit dispatch.
+            for path, layer in self._layer.named_sublayers(
+                    include_self=True):
                 for k, v in layer.__dict__.items():
                     if k.startswith("_") or k == "training":
                         continue

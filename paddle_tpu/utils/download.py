@@ -78,7 +78,16 @@ def _download(url, root_dir):
 
 def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
     """Cache-or-fetch: return the local path for `url` under root_dir,
-    verifying the md5 when given (re-fetches on mismatch)."""
+    verifying the md5 when given (re-fetches on mismatch).
+
+    Lookup order: (1) a PLAIN-basename file in root_dir — the air-gapped
+    pre-population contract ("drop resnet18.pdparams into WEIGHTS_HOME");
+    (2) the url-hash-keyed cache entry this module writes on fetch (two
+    sources sharing a basename must not alias); (3) fetch."""
+    base = osp.basename(url.split("?")[0]) or "weights"
+    prepop = osp.join(root_dir, base)
+    if check_exist and osp.exists(prepop) and _md5check(prepop, md5sum):
+        return prepop
     fullpath = osp.join(root_dir, _cache_name(url))
     if check_exist and osp.exists(fullpath) and _md5check(fullpath, md5sum):
         return fullpath

@@ -238,3 +238,30 @@ class TestPretrainedHub:
         src.write_bytes(b"payload")
         with pytest.raises(RuntimeError, match="md5 mismatch"):
             download.get_weights_path_from_url(f"file://{src}", "0" * 32)
+
+    def test_airgapped_prepopulation_by_basename(self, tmp_path,
+                                                 monkeypatch):
+        """The documented air-gapped flow: drop the file named by the
+        URL BASENAME into WEIGHTS_HOME out of band; pretrained=True with
+        a registered http URL must resolve locally, never fetch."""
+        import os
+
+        import paddle_tpu as paddle
+        from paddle_tpu.utils import download
+        from paddle_tpu.vision import models
+
+        home = tmp_path / "home"
+        os.makedirs(home)
+        monkeypatch.setattr(download, "WEIGHTS_HOME", str(home))
+        paddle.seed(1)
+        donor = models.resnet18(num_classes=3)
+        paddle.save(donor.state_dict(), str(home / "resnet18.pdparams"))
+        models.model_urls["resnet18"] = (
+            "http://unreachable.invalid/resnet18.pdparams", None)
+        try:
+            m = models.resnet18(pretrained=True, num_classes=3)
+            np.testing.assert_allclose(
+                np.asarray(m.fc.weight.numpy()),
+                np.asarray(donor.fc.weight.numpy()))
+        finally:
+            models.model_urls.pop("resnet18", None)
