@@ -40,8 +40,9 @@ def main():
     if on_tpu:
         for _, p in model.named_parameters():
             p._data = p._data.astype(jax.numpy.bfloat16)
-    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
-                                 parameters=model.parameters())
+    opt = paddle.optimizer.AdamW(
+        learning_rate=3e-4, parameters=model.parameters(),
+        factored=os.environ.get("PTPU_ADAM_FACTORED", "1") not in ("", "0"))
     step = TrainStep(model, lambda i, l: model.loss(i, l), opt)
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(

@@ -222,3 +222,36 @@ def test_fused_rms_norm_residual_tuple_contract():
     out_ln, res_ln = FF.fused_layer_norm(x, w, None, residual=res)
     np.testing.assert_allclose(
         res_ln.numpy(), x.numpy() + res.numpy(), atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq,block", [(256, None), (1024, 128)])
+def test_flash_fused_bwd_matches_split(causal, seq, block, monkeypatch):
+    """PTPU_FA_FUSED_BWD=1: the single-pass dq+dk+dv kernel must match
+    the split kernels (forced =0). The (1024, block 128) case drives the
+    MULTI-BLOCK machinery — cross-ki dq-scratch accumulation, dynamic
+    row0 slicing, final-step flush, causal clamp — with nq=nk=8; the
+    256 case covers the full-sequence-block degenerate."""
+    from paddle_tpu.ops.pallas import flash_attention
+
+    if block is not None:
+        monkeypatch.setenv("PTPU_FA_BWD_BLOCK", str(block))
+        monkeypatch.setenv("PTPU_FA_BWD_KBLOCK", str(block))
+    rng = np.random.default_rng(0)
+    for hq, hk in ((4, 4), (4, 2)):
+        q = jnp.asarray(rng.normal(size=(1, seq, hq, 16)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, seq, hk, 16)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, seq, hk, 16)), jnp.float32)
+
+        def loss(q_, k_, v_):
+            return jnp.sum(jnp.sin(flash_attention(
+                q_, k_, v_, causal=causal, interpret=True)))
+
+        monkeypatch.setenv("PTPU_FA_FUSED_BWD", "0")  # force SPLIT
+        g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        monkeypatch.setenv("PTPU_FA_FUSED_BWD", "1")  # force FUSED
+        g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        monkeypatch.delenv("PTPU_FA_FUSED_BWD", raising=False)
+        for a, b in zip(g_fused, g_split):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)

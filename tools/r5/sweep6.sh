@@ -1,0 +1,11 @@
+#!/bin/bash
+cd /root/repo
+SNAP=/tmp/snap_r5
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1500 python $SNAP/bench.py 2>&1 | tail -4
+  echo "=== END $label ==="
+}
+run K_gpt_fusedbwd PTPU_BENCH_MODEL=gpt PTPU_FA_FUSED_BWD=1
+run K_llama_fusedbwd PTPU_BENCH_MODEL=llama PTPU_FA_FUSED_BWD=1

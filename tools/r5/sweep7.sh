@@ -1,0 +1,6 @@
+#!/bin/bash
+cd /root/repo
+SNAP=/tmp/snap_r5
+echo "=== FINAL DEFAULTS (fused bwd auto) ==="
+env PYTHONPATH=$SNAP:/root/.axon_site timeout 1800 python $SNAP/bench.py 2>&1 | tail -4
+echo "=== END ==="

@@ -1,0 +1,13 @@
+#!/bin/bash
+cd /root/repo
+SNAP=/tmp/snap_r5
+NAMES_GATE="names:attn_res,attn_lse,attn_q,attn_k,attn_v,resid_mid,rms_rstd,ffn_gate"
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1500 python $SNAP/bench.py 2>&1 | tail -4
+  echo "=== END $label ==="
+}
+run L1_gpt_b4_gate_fused PTPU_BENCH_MODEL=gpt PTPU_BENCH_BATCH=4 PTPU_BENCH_REMAT="$NAMES_GATE"
+run L2_gpt_bwd2048_fused PTPU_BENCH_MODEL=gpt PTPU_FA_BWD_BLOCK=2048
+run L3_llama_b4_gate_fused PTPU_BENCH_MODEL=llama PTPU_BENCH_BATCH=4 PTPU_BENCH_REMAT="$NAMES_GATE"
