@@ -1,0 +1,12 @@
+#!/bin/bash
+cd /root/repo
+SNAP=/tmp/snap_r5
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1500 python $SNAP/bench.py 2>&1 | tail -4
+  echo "=== END $label ==="
+}
+run N_gpt_default PTPU_BENCH_MODEL=gpt
+run N_gpt_kb512_b PTPU_BENCH_MODEL=gpt PTPU_FA_BWD_KBLOCK=512
+run N_llama_kb512 PTPU_BENCH_MODEL=llama PTPU_FA_BWD_KBLOCK=512

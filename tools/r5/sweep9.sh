@@ -1,0 +1,12 @@
+#!/bin/bash
+cd /root/repo
+SNAP=/tmp/snap_r5
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1500 python $SNAP/bench.py 2>&1 | tail -4
+  echo "=== END $label ==="
+}
+run M_gpt_bwd512 PTPU_BENCH_MODEL=gpt PTPU_FA_BWD_BLOCK=512
+run M_llama_bwd512 PTPU_BENCH_MODEL=llama PTPU_FA_BWD_BLOCK=512
+run M_gpt_kb512 PTPU_BENCH_MODEL=gpt PTPU_FA_BWD_KBLOCK=512
