@@ -1,0 +1,13 @@
+#!/bin/bash
+cd /root/repo
+SNAP=/tmp/snap_r5
+NAMES_NOQKV="names:attn_res,attn_lse,resid_mid,rms_rstd,ffn_gate,ffn_up"
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1500 python $SNAP/bench.py 2>&1 | tail -4
+  echo "=== END $label ==="
+}
+run O1_gpt_b4_noqkv PTPU_BENCH_MODEL=gpt PTPU_BENCH_BATCH=4 PTPU_BENCH_REMAT="$NAMES_NOQKV"
+run O2_llama_b4_noqkv PTPU_BENCH_MODEL=llama PTPU_BENCH_BATCH=4 PTPU_BENCH_REMAT="$NAMES_NOQKV"
+run O3_gpt_b3_noqkv PTPU_BENCH_MODEL=gpt PTPU_BENCH_REMAT="$NAMES_NOQKV"
