@@ -672,15 +672,19 @@ def _gather_rule(x: DistTensorSpec, index: DistTensorSpec, axis=0):
     return einsum_infer(f"{x_sub},{idx_letters}->{out}", [x, index])
 
 
-@register_spmd_rule("scatter")
-def _scatter_rule(x: DistTensorSpec, index: DistTensorSpec, updates: DistTensorSpec, axis=0):
-    nd = x.ndim
+def _scatter_notation(in_shapes, axis):
+    nd = len(in_shapes[0])
     axis %= nd
     letters = _letters(nd)
     x_sub = "".join("*" if i == axis else c for i, c in enumerate(letters))
-    upd_sub = x_sub
-    idx_sub = "*" * index.ndim
-    return einsum_infer(f"{x_sub},{idx_sub},{upd_sub}->{x_sub}", [x, index, updates])
+    idx_sub = "*" * len(in_shapes[1])
+    return f"{x_sub},{idx_sub},{x_sub}->{x_sub}"
+
+
+@register_spmd_rule("scatter")
+def _scatter_rule(x: DistTensorSpec, index: DistTensorSpec, updates: DistTensorSpec, axis=0):
+    notation = _scatter_notation([x.shape, index.shape, updates.shape], axis)
+    return einsum_infer(notation, [x, index, updates])
 
 
 # -- losses ------------------------------------------------------------------
@@ -1010,6 +1014,19 @@ def _pool_rule(x: DistTensorSpec, **attrs):
     return einsum_infer(f"{sub}->{sub}", [x])
 
 
+def _batched_linalg_notation(in_shapes, out_ranks):
+    nb = max(len(in_shapes[0]) - 2, 0)
+    in_subs = []
+    for sh in in_shapes:
+        b = max(len(sh) - 2, 0)
+        in_subs.append(_letters(nb)[nb - b:] + "*" * (len(sh) - b))
+    if out_ranks is None:
+        out_ranks = [len(in_shapes[0])]
+    out_subs = [_letters(nb)[: min(nb, r)] + "*" * (r - min(nb, r))
+                for r in out_ranks]
+    return ",".join(in_subs) + "->" + ",".join(out_subs)
+
+
 @register_spmd_rule("batched_linalg")
 def _batched_linalg_rule(*specs, out_ranks=None, **attrs):
     """Batched dense linalg (cholesky/inv/solve/qr/svd...): batch dims
@@ -1019,17 +1036,9 @@ def _batched_linalg_rule(*specs, out_ranks=None, **attrs):
     FIRST input). Multi-output ops (qr/svd/lu/slogdet) and rank-reducing
     ops (det) pass their true output ranks; every output carries the
     merged batch sharding with its non-batch dims replicated."""
-    nb = max(specs[0].ndim - 2, 0)
-    in_subs = []
-    for s in specs:
-        b = max(s.ndim - 2, 0)
-        in_subs.append(_letters(nb)[nb - b:] + "*" * (s.ndim - b))
-    if out_ranks is None:
-        out_ranks = [specs[0].ndim]
-    out_subs = [_letters(nb)[: min(nb, r)] + "*" * (r - min(nb, r))
-                for r in out_ranks]
     return einsum_infer(
-        ",".join(in_subs) + "->" + ",".join(out_subs), list(specs))
+        _batched_linalg_notation([s.shape for s in specs], out_ranks),
+        list(specs))
 
 
 @register_spmd_rule("group_norm")
@@ -1283,3 +1292,96 @@ def _c_embedding_reverse(in_shapes, out_specs, start_index=0):
     # arg order (w, x); reuse the embedding reverse and swap back
     ins, outs = _embedding_reverse([in_shapes[1], in_shapes[0]], out_specs)
     return [ins[1], ins[0]], outs
+
+
+# final reverse batch: the structurally-reversible remainder. moe_gate /
+# moe_dispatch stay forward-only (the a2a layout is a semantic decision
+# with no output-determined inverse), as in the reference.
+def _pool_notation(sh, at):
+    sub = "bc" + "*" * (len(sh[0]) - 2)
+    return f"{sub}->{sub}"
+
+
+def _conv_transpose_notation(sh, at):
+    sp = "*" * (len(sh[0]) - 2)
+    return f"bc{sp},co{sp}->bo{sp}"
+
+
+_register_notation_reverse("pool", _pool_notation)
+_register_notation_reverse("conv_transpose", _conv_transpose_notation)
+
+
+@register_spmd_reverse("group_norm")
+def _group_norm_reverse(in_shapes, out_specs, **attrs):
+    sub = "b" + "*" * (len(in_shapes[0]) - 1)
+    subs = [sub] + ["*"] * (len(in_shapes) - 1)
+    return einsum_infer_reverse(",".join(subs) + f"->{sub}",
+                                in_shapes, out_specs)
+
+
+@register_spmd_reverse("scatter")
+def _scatter_reverse(in_shapes, out_specs, axis=0):
+    return einsum_infer_reverse(_scatter_notation(in_shapes, axis),
+                                in_shapes, out_specs)
+
+
+@register_spmd_reverse("put_along_axis")
+def _put_along_axis_reverse(in_shapes, out_specs, axis=0):
+    fake = [DistTensorSpec(s) for s in in_shapes]
+    (x_sub, i_sub, v_sub), out = _along_axis_subs(fake, axis)
+    return einsum_infer_reverse(f"{x_sub},{i_sub},{v_sub}->{out}",
+                                in_shapes, out_specs)
+
+
+@register_spmd_reverse("fused_rotary_position_embedding")
+def _fused_rope_reverse(in_shapes, out_specs, **attrs):
+    fake = [DistTensorSpec(s) for s in in_shapes]
+    subs = _broadcast_subs(fake).split("->")[0].split(",")
+    notation = ",".join(subs) + "->" + ",".join(subs)
+    return einsum_infer_reverse(notation, in_shapes, out_specs)
+
+
+@register_spmd_reverse("flash_attention")
+def _flash_attention_reverse(in_shapes, out_specs, causal=True,
+                             context_parallel=False):
+    """Out [b, s, n, d] -> q gets its batch/seq/head sharding; k/v get
+    batch + head (kv-seq whole unless ring attention); head_dim always
+    replicated — the forward contract mirrored."""
+    q_sub = "bsn*"
+    kv_sub = "bsn*" if context_parallel else "b*n*"
+    return einsum_infer_reverse(
+        f"{q_sub},{kv_sub},{kv_sub}->{q_sub}", in_shapes, out_specs)
+
+
+@register_spmd_reverse("cross_entropy_with_softmax")
+def _ce_reverse(in_shapes, out_specs, axis=-1):
+    """Reverse from (softmax_out, loss) or from the LOSS alone (a
+    rank-(nd-1) single spec): leading dims flow to logits and labels;
+    the vocab axis takes softmax_out's sharding when supplied. A
+    vocab-sharded placement re-marks the corrected loss partial over
+    that mesh dim — the forward ParallelCrossEntropy contract."""
+    nd = len(in_shapes[0])
+    axis %= nd
+    letters = _letters(nd, skip="v")
+    lg = letters[:axis] + "v" + letters[axis:nd - 1]
+    lead = lg.replace("v", "")
+    lbl = lead if len(in_shapes[1]) == nd - 1 else lead + "1"
+    outs = list(out_specs)
+    if len(outs) == 1 and outs[0].ndim == nd - 1:
+        # loss-only completion: align the lone spec with the loss sub
+        notation = f"{lg},{lbl}->{lead}"
+        ins, new_outs = einsum_infer_reverse(notation, in_shapes, outs)
+        return ins, new_outs
+    ins, new_outs = einsum_infer_reverse(f"{lg},{lbl}->{lg},{lead}",
+                                         in_shapes, outs)
+    v_mesh = ins[0].dims_mapping[axis]
+    if v_mesh >= 0 and len(new_outs) > 1:
+        new_outs[1].partial_dims.add(v_mesh)
+    return ins, new_outs
+
+
+@register_spmd_reverse("batched_linalg")
+def _batched_linalg_reverse(in_shapes, out_specs, out_ranks=None, **attrs):
+    return einsum_infer_reverse(
+        _batched_linalg_notation(in_shapes, out_ranks),
+        in_shapes, out_specs)

@@ -604,3 +604,53 @@ def test_reference_rule_files_classification_total():
         else:
             bad.append((f, f"unknown status {entry['status']}"))
     assert not bad, bad
+
+
+class TestReverseRuleFinalBatch:
+    def test_flash_attention_reverse(self):
+        rule = get_spmd_rule("flash_attention")
+        out = DistTensorSpec((4, 2048, 16, 128), [0, -1, 1, -1])
+        ins, _ = rule.infer_reverse(
+            [(4, 2048, 16, 128)] * 3, [out])
+        assert dm(ins[0]) == [0, -1, 1, -1]     # q: batch+head flow
+        assert dm(ins[1]) == [0, -1, 1, -1]     # kv: seq forced whole
+        ins_cp, _ = rule.infer_reverse(
+            [(4, 2048, 16, 128)] * 3,
+            [DistTensorSpec((4, 2048, 16, 128), [0, 2, 1, -1])],
+            context_parallel=True)
+        assert dm(ins_cp[1]) == [0, 2, 1, -1]   # ring: kv-seq keeps sep
+
+    def test_cross_entropy_reverse_from_loss_only(self):
+        rule = get_spmd_rule("cross_entropy_with_softmax")
+        # loss-only: the lone rank-(nd-1) spec seeds the leading dims
+        loss = DistTensorSpec((8,), [0])
+        ins, _ = rule.infer_reverse([(8, 32000), (8,)], [loss])
+        assert dm(ins[0]) == [0, -1]
+        assert dm(ins[1]) == [0]
+        # full (softmax_out, loss): vocab sharding flows to logits and
+        # the corrected loss comes back PARTIAL over the vocab mesh dim
+        sm = DistTensorSpec((8, 32000), [0, 1])
+        ins2, outs2 = rule.infer_reverse(
+            [(8, 32000), (8,)], [sm, DistTensorSpec((8,), [0])])
+        assert dm(ins2[0]) == [0, 1]
+        assert dm(ins2[1]) == [0]
+        assert outs2[1]._partial_dims() == {1}
+
+    def test_scatter_pool_groupnorm_reverses(self):
+        out = DistTensorSpec((16, 8), [0, -1])
+        ins, _ = get_spmd_rule("scatter").infer_reverse(
+            [(16, 8), (4,), (16, 8)], [out], axis=1)
+        assert dm(ins[0]) == [0, -1]
+        outp = DistTensorSpec((4, 8, 16, 16), [0, -1, -1, -1])
+        insp, _ = get_spmd_rule("pool").infer_reverse(
+            [(4, 8, 32, 32)], [outp])
+        assert dm(insp[0]) == [0, -1, -1, -1]
+        insg, _ = get_spmd_rule("group_norm").infer_reverse(
+            [(4, 8, 16, 16), (8,), (8,)], [outp])
+        assert dm(insg[0]) == [0, -1, -1, -1]
+
+    def test_batched_linalg_reverse_batch_flow(self):
+        rule = get_spmd_rule("batched_linalg")
+        out = DistTensorSpec((6, 4, 4), [1, -1, -1])
+        ins, _ = rule.infer_reverse([(6, 4, 4)], [out])
+        assert dm(ins[0]) == [1, -1, -1]
