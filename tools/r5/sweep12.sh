@@ -1,0 +1,11 @@
+#!/bin/bash
+cd /root/repo
+SNAP=/tmp/snap_r5
+run() {
+  label="$1"; shift
+  echo "=== ARM $label: $* ==="
+  env "$@" PYTHONPATH=$SNAP:/root/.axon_site timeout 1500 python $SNAP/bench.py 2>&1 | tail -4
+  echo "=== END $label ==="
+}
+run P_llama_b4_gu PTPU_BENCH_MODEL=llama PTPU_BENCH_BATCH=4
+run P_llama_b2_gu PTPU_BENCH_MODEL=llama PTPU_BENCH_BATCH=2
