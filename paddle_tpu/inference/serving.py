@@ -62,7 +62,7 @@ class PagePool:
 class _Request:
     __slots__ = ("rid", "prompt", "generated", "length", "pages",
                  "temperature", "top_k", "top_p", "on_token",
-                 "prefill_pos", "seq_tokens", "admit_seq")
+                 "prefill_pos", "seq_tokens", "admit_seq", "swapped")
 
     def __init__(self, rid, prompt, temperature=0.0, top_k=0, top_p=1.0,
                  on_token=None):
@@ -81,6 +81,7 @@ class _Request:
         self.seq_tokens = self.prompt
         self.admit_seq = -1      # admission order (preemption victims =
                                  # youngest first, vLLM recompute policy)
+        self.swapped = None      # host-side KV snapshot (swap policy)
 
 
 def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
@@ -114,7 +115,7 @@ def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
 class ContinuousBatchingEngine:
     def __init__(self, model, max_slots=4, page_size=64, num_pages=None,
                  max_seq_len=None, max_new_tokens=32, eos_token_id=None,
-                 seed=0, prefill_chunk=None):
+                 seed=0, prefill_chunk=None, preempt_policy="recompute"):
         import jax
         import jax.numpy as jnp
 
@@ -158,6 +159,30 @@ class ContinuousBatchingEngine:
         self.prefill_batches = 0      # observability: admission group count
         self.preemptions = 0          # pages reclaimed from the youngest
         self._admit_counter = 0
+        # preempt_policy: what happens to a victim's KV state.
+        #   "recompute" — drop pages, fold generated tokens into the resume
+        #     prompt, rebuild KV by re-prefilling on re-admission (vLLM
+        #     recompute; the r5 default).
+        #   "swap" — copy the victim's pages to HOST memory, free the
+        #     device pages, and scatter the snapshot back on re-admission
+        #     (vLLM swap / the reference block-table cache-offload shape):
+        #     no prefill FLOPs are re-paid, at the price of two
+        #     host<->device transfers of the live KV. Greedy outputs are
+        #     bitwise identical either way (bf16 round-trips exactly
+        #     through the host copy); tests assert both.
+        if preempt_policy not in ("recompute", "swap"):
+            raise ValueError(
+                f"preempt_policy must be 'recompute' or 'swap', "
+                f"got {preempt_policy!r}")
+        self.preempt_policy = preempt_policy
+        self.swaps_out = 0            # victims snapshotted to host
+        self.swaps_in = 0             # snapshots restored to device
+        self._swap_staging = None     # reused host pair for swap-in
+        # fixed-shape ([pages_per_seq] page vector, trash-padded) so each
+        # compiles ONCE; swap-in donates the caches (no double buffering)
+        self._swap_out_jit = jax.jit(self._swap_gather)
+        self._swap_in_jit = jax.jit(self._swap_scatter,
+                                    donate_argnums=(0, 1))
         # chunked prefill (vLLM-style): admit immediately, write the
         # prompt's KV `prefill_chunk` tokens per TICK so long prompts
         # don't stall the decode latency of running requests
@@ -382,6 +407,51 @@ class ContinuousBatchingEngine:
             if self._slots[i] is not None or not self._waiting:
                 continue
             req = self._waiting[0]
+            if req.swapped is not None:
+                # swap policy re-admission: restore the host KV snapshot
+                # into freshly allocated pages — no prefill re-run. For a
+                # decode-phase snapshot, also reserve THIS tick's growth
+                # page up front: restoring with exactly n pages when
+                # length is page-aligned would hand _grow_pages a starved
+                # youngest request and swap it straight back out (a full
+                # round-trip per tick with zero progress).
+                snap = req.swapped
+                n = snap["n"]
+                # restore the FULL reservation, not just the snapshot
+                # pages: a mid-prefill victim needs its whole prompt's
+                # pages back for _prefill_tick's scatter targets, and a
+                # decode-phase one needs this tick's growth page (without
+                # it a page-aligned restoree would be the starved
+                # youngest and swap straight back out)
+                if snap["prefill_pos"] < len(req.seq_tokens):
+                    need = max(n, (len(req.seq_tokens) + self.page - 1)
+                               // self.page)
+                else:
+                    need = max(n, (snap["length"] + self.page) // self.page)
+                if need > self.pool.available:
+                    break  # head-of-line waits for pages
+                self._waiting.popleft()
+                req.pages = self.pool.alloc(need)
+                # stage the n-page snapshot into the engine's fixed-shape
+                # host buffer (reused across restores, no zeroing — the
+                # padded rows scatter into the scratch page, so their
+                # stale contents are irrelevant; the padded h2d volume is
+                # the price of the compile-once scatter)
+                kh, vh = self._swap_stage(snap["k"].shape, snap["k"].dtype)
+                kh[:, :, :n] = snap["k"]
+                vh[:, :, :n] = snap["v"]
+                self.kc, self.vc = self._swap_in_jit(
+                    list(self.kc), list(self.vc),
+                    self._padded_page_vec(req.pages[:n]),
+                    self._jnp.asarray(kh), self._jnp.asarray(vh))
+                req.prefill_pos = snap["prefill_pos"]
+                req.length = snap["length"]
+                req.swapped = None
+                self.swaps_in += 1
+                req.admit_seq = self._admit_counter
+                self._admit_counter += 1
+                self._slots[i] = req
+                continue  # not part of any prefill group
             # reserve only what PREFILL writes (the resume prefix); decode
             # pages are allocated as the sequence grows, with preemption
             # under pressure — block-table growth semantics of the
@@ -504,18 +574,75 @@ class ContinuousBatchingEngine:
                 r.length = len(r.seq_tokens)
                 self._emit(r, tok)
 
+    def _swap_gather(self, kc, vc, pages):
+        """Stack every layer's rows for `pages` -> [L, Hkv, P, page, D]
+        (P = pages_per_seq, trash-padded). One jitted dispatch per
+        swap-out, then a single host transfer."""
+        jnp = self._jnp
+        k = jnp.stack([c[:, pages] for c in kc])
+        v = jnp.stack([c[:, pages] for c in vc])
+        return k, v
+
+    def _swap_scatter(self, kc, vc, pages, k, v):
+        """Scatter a host snapshot back into the caches at `pages`
+        (trash-padded rows land in the scratch page — harmless by
+        definition). Donates kc/vc."""
+        kc = [c.at[:, pages].set(k[li]) for li, c in enumerate(kc)]
+        vc = [c.at[:, pages].set(v[li]) for li, c in enumerate(vc)]
+        return kc, vc
+
+    def _padded_page_vec(self, pages):
+        pad = np.full(self.pages_per_seq, self._trash_page, np.int32)
+        pad[: len(pages)] = pages
+        return self._jnp.asarray(pad)
+
+    def _swap_stage(self, snap_shape, dtype):
+        """Reusable host staging pair at the fixed [L, Hkv, P, page, D]
+        scatter shape (jax copies numpy args into XLA buffers at dispatch,
+        so reuse across restores cannot race the transfer)."""
+        shape = snap_shape[:2] + (self.pages_per_seq,) + snap_shape[3:]
+        st = self._swap_staging
+        if st is None or st[0].shape != shape or st[0].dtype != dtype:
+            st = (np.empty(shape, dtype), np.empty(shape, dtype))
+            self._swap_staging = st
+        return st
+
     def _preempt(self, slot_idx):
-        """Free a running request's pages and requeue it at the FRONT of
-        the waiting queue with its generated prefix folded into the
-        resume tokens — re-admission rebuilds the KV by prefilling
-        prompt+generated (recompute policy; correctness is bitwise for
-        greedy decodes, asserted by tests)."""
+        """Evict a running request and requeue it at the FRONT of the
+        waiting queue. Policy "recompute": free the pages and fold the
+        generated tokens into the resume prompt — re-admission rebuilds
+        the KV by prefilling prompt+generated. Policy "swap": snapshot
+        the pages to host first — re-admission restores the KV with zero
+        recompute. Correctness is bitwise for greedy decodes under both
+        policies (asserted by tests)."""
         r = self._slots[slot_idx]
+        if self.preempt_policy == "swap" and r.pages:
+            # NOTE: the gather materialises [L, Hkv, P, page, D] on device
+            # before the host copy. Pool exhaustion here is a logical
+            # page-budget limit, not physical HBM exhaustion, so the
+            # transient is safe; a deployment sized to true HBM capacity
+            # would gather layer-by-layer instead.
+            k, v = self._swap_out_jit(list(self.kc), list(self.vc),
+                                      self._padded_page_vec(r.pages))
+            # slice to pages holding LIVE tokens device-side before the
+            # host copy: the retained snapshot and the d2h transfer scale
+            # with written KV, not the page reservation (a mid-prefill
+            # victim's untouched prompt pages and grown-but-empty decode
+            # pages never leave the device; restore re-allocates the full
+            # reservation from prefill_pos/length bookkeeping)
+            written = max(r.length, r.prefill_pos)
+            n = min((written + self.page - 1) // self.page, len(r.pages))
+            r.swapped = {"k": np.asarray(k[:, :, :n]),
+                         "v": np.asarray(v[:, :, :n]),
+                         "n": n, "prefill_pos": r.prefill_pos,
+                         "length": r.length}
+            self.swaps_out += 1
+        else:
+            r.seq_tokens = r.prompt + r.generated
+            r.prefill_pos = 0
+            r.length = 0
         self.pool.free(r.pages)
         r.pages = []
-        r.seq_tokens = r.prompt + r.generated
-        r.prefill_pos = 0
-        r.length = 0
         self._slots[slot_idx] = None
         self._waiting.appendleft(r)
         self.preemptions += 1

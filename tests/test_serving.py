@@ -423,3 +423,71 @@ class TestPageEconomics:
         assert eng.preemptions > 0
         for rid in done:
             assert done[rid] == want[rid], (rid, done[rid], want[rid])
+
+    def test_swap_policy_bitwise_and_no_recompute(self):
+        """preempt_policy="swap": victims' KV pages round-trip through
+        host memory instead of being recomputed — greedy outputs stay
+        bitwise identical to a roomy pool AND each request prefills
+        exactly once (no FLOPs re-paid)."""
+        model = _tiny_model()
+        new_tokens = 12
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 96, (n,)).tolist()
+                   for n in (10, 9, 11, 8)]
+
+        roomy = ContinuousBatchingEngine(model, max_slots=4, page_size=4,
+                                         max_seq_len=48,
+                                         max_new_tokens=new_tokens)
+        for pr in prompts:
+            roomy.submit(pr)
+        want = roomy.run_until_complete()
+
+        eng = ContinuousBatchingEngine(model, max_slots=4, page_size=4,
+                                       max_seq_len=48, num_pages=13,
+                                       max_new_tokens=new_tokens,
+                                       preempt_policy="swap")
+        for pr in prompts:
+            eng.submit(pr)
+        done = eng.run_until_complete()
+        assert sorted(done) == [0, 1, 2, 3]
+        assert eng.preemptions > 0, "pool pressure must trigger preemption"
+        assert eng.swaps_out > 0 and eng.swaps_in == eng.swaps_out
+        # the swap path restores KV instead of re-prefilling
+        assert eng.prefills_completed == len(prompts), (
+            eng.prefills_completed, eng.preemptions)
+        for rid in done:
+            assert done[rid] == want[rid], (
+                rid, eng.preemptions, done[rid], want[rid])
+
+    def test_swap_policy_with_chunked_prefill(self):
+        model = _tiny_model()
+        new_tokens = 10
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 96, (n,)).tolist() for n in (12, 10, 9)]
+        roomy = ContinuousBatchingEngine(model, max_slots=3, page_size=4,
+                                         max_seq_len=48,
+                                         max_new_tokens=new_tokens,
+                                         prefill_chunk=5)
+        for pr in prompts:
+            roomy.submit(pr)
+        want = roomy.run_until_complete()
+
+        eng = ContinuousBatchingEngine(model, max_slots=3, page_size=4,
+                                       max_seq_len=48, num_pages=11,
+                                       max_new_tokens=new_tokens,
+                                       prefill_chunk=5,
+                                       preempt_policy="swap")
+        for pr in prompts:
+            eng.submit(pr)
+        done = eng.run_until_complete()
+        assert sorted(done) == [0, 1, 2]
+        assert eng.preemptions > 0
+        assert eng.swaps_in == eng.swaps_out > 0
+        assert eng.prefills_completed == len(prompts)
+        for rid in done:
+            assert done[rid] == want[rid], (rid, done[rid], want[rid])
+
+    def test_swap_policy_rejects_bad_value(self):
+        model = _tiny_model()
+        with pytest.raises(ValueError):
+            ContinuousBatchingEngine(model, preempt_policy="drop")

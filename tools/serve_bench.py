@@ -2,8 +2,10 @@
 
 r3 weak #9 / r4: the serving stack (batched chunked prefill + paged
 decode) had no recorded on-chip throughput. Run from /root/repo:
-    python tools/serve_bench.py
+    python tools/serve_bench.py [--policy recompute|swap] [--roomy]
 Prints tok/s at several concurrency levels for a 1.3B-class decoder.
+--policy picks the preemption strategy for the tight-pool regime;
+--roomy sizes the pool at worst case (no preemption) instead.
 """
 from __future__ import annotations
 
@@ -22,6 +24,15 @@ def main():
     import paddle_tpu as paddle
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    policy = "recompute"
+    if "--policy" in sys.argv:
+        i = sys.argv.index("--policy")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1] not in (
+                "recompute", "swap"):
+            sys.exit("--policy requires a value: recompute | swap")
+        policy = sys.argv[i + 1]
+    roomy = "--roomy" in sys.argv
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
@@ -51,9 +62,12 @@ def main():
         grow = per_seq_worst - prompt_pages
         tight = max(slots * prompt_pages + (slots * grow) // 2,
                     per_seq_worst) + 1
+        if roomy:
+            tight = slots * per_seq_worst + 2
         eng = ContinuousBatchingEngine(
             model, max_slots=slots, page_size=64, num_pages=tight,
-            max_new_tokens=new_tokens, prefill_chunk=64)
+            max_new_tokens=new_tokens, prefill_chunk=64,
+            preempt_policy=policy)
         n_req = slots * 2
         for _ in range(n_req):
             eng.submit(list(rng.integers(1, cfg.vocab_size,
@@ -66,7 +80,8 @@ def main():
               f" -> {gen} generated in {dt:.1f}s = {gen / dt:.1f} tok/s"
               f" (prefill passes: {eng.prefill_chunk_steps},"
               f" preemptions: {eng.preemptions},"
-              f" pool: {tight} pages)", flush=True)
+              f" swaps: {eng.swaps_out},"
+              f" policy: {policy}, pool: {tight} pages)", flush=True)
 
 
 if __name__ == "__main__":
