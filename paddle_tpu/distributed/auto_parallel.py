@@ -362,7 +362,14 @@ def dtensor_from_local(local_tensor, mesh, placements):
 
 def shard_activation(x, placements=None, mesh=None, spec=None):
     """Constrain an intermediate's sharding inside jit (GSPMD override hook —
-    the explicit analogue of a per-op spmd_rule from ops.yaml)."""
+    the explicit analogue of a per-op spmd_rule from ops.yaml).
+
+    Works inside partial-manual shard_map regions too (e.g. the compiled
+    pipeline keeps 'pp' manual while mp/dp stay auto): the constraint is
+    then built over the tracing context's abstract mesh with any
+    manual-axis entries stripped from the spec — constraining a manual
+    axis there is meaningless (the program already IS per-shard in it)
+    and a concrete-mesh constraint would reject the value's vma."""
     mesh = mesh or get_mesh()
     if mesh is None:
         return x
@@ -370,7 +377,25 @@ def shard_activation(x, placements=None, mesh=None, spec=None):
         spec = placements_to_spec(mesh, placements)
     is_tensor = isinstance(x, Tensor)
     arr = x._data if is_tensor else x
-    arr = jax.lax.with_sharding_constraint(arr, NamedSharding(mesh.jax_mesh, spec))
+    use_mesh = mesh.jax_mesh
+    abstract = jax.sharding.get_abstract_mesh()
+    manual = (set() if abstract.empty else {
+        n for n, t in zip(abstract.axis_names, abstract.axis_types)
+        if t == jax.sharding.AxisType.Manual})
+    if manual:
+        U = PartitionSpec.UNCONSTRAINED
+
+        def _strip(e):
+            if e is None or e is U:
+                return e
+            if isinstance(e, (tuple, list)):
+                kept = tuple(a for a in e if a not in manual)
+                return kept or None
+            return None if e in manual else e
+
+        spec = PartitionSpec(*[_strip(e) for e in spec])
+        use_mesh = abstract
+    arr = jax.lax.with_sharding_constraint(arr, NamedSharding(use_mesh, spec))
     if is_tensor:
         out = Tensor(arr, stop_gradient=x.stop_gradient)
         out._grad_node = x._grad_node

@@ -328,6 +328,10 @@ class ContinuousBatchingEngine:
         toks = self._head_tokens(last, reqs)
         for i, r in enumerate(reqs):
             r.length = int(lens[i])
+            # group prefill wrote the whole prompt: keep prefill_pos in
+            # lockstep so a later swap snapshot is classified decode-phase
+            # (its restore must reserve the growth page, not the prompt)
+            r.prefill_pos = int(lens[i])
         return toks
 
     def _decode_step(self, weights, tokens, lens, tables, kc, vc,

@@ -486,8 +486,21 @@ class StackedDecoder(nn.Layer):
             return None, 1
         return mesh, mesh.get_dim_size("pp")
 
-    def apply_pipeline_placements(self, mesh=None):
-        """Mark every stacked param Shard(0) over the 'pp' mesh axis."""
+    # Megatron TP dims of the stacked weights: column-parallel projections
+    # shard their OUTPUT dim, row-parallel ones their INPUT dim (the mp
+    # collectives are GSPMD-inserted: the pipeline shard_map keeps only
+    # 'pp' manual, every other mesh axis stays auto)
+    _TP_DIMS = {"wq": 2, "wk": 2, "wv": 2, "wg": 2, "wu": 2,
+                "wo": 1, "wd": 1}
+
+    def apply_pipeline_placements(self, mesh=None, tp_axis=None):
+        """Mark every stacked param Shard(0) over the 'pp' mesh axis.
+
+        tp_axis="mp" additionally shards the projection weights over the
+        tensor-parallel axis (column/row-parallel dims per _TP_DIMS), so
+        one placement pass yields the full pp x mp hybrid — the
+        fleet 3-axis composition (reference: pp->mp->dp group nesting,
+        fleet/base/topology.py:298) expressed as GSPMD placements."""
         from paddle_tpu.distributed.auto_parallel import (
             Replicate, Shard, TensorDistAttr)
 
@@ -496,9 +509,25 @@ class StackedDecoder(nn.Layer):
             if mesh is None:
                 return self
         ax = mesh.dim_names.index("pp")
-        for _, p in self.named_parameters():
+        tp_ax = None
+        if (tp_axis is not None and tp_axis in mesh.dim_names
+                and mesh.get_dim_size(tp_axis) > 1):
+            tp_ax = mesh.dim_names.index(tp_axis)
+            cfg = self.config
+            tp = mesh.get_dim_size(tp_axis)
+            for what, n in (("num_heads", cfg.num_heads),
+                            ("num_kv_heads", cfg.num_kv_heads),
+                            ("intermediate_size", cfg.intermediate_size)):
+                if n % tp != 0:
+                    raise ValueError(
+                        f"tp_axis={tp_axis!r} (size {tp}) must divide "
+                        f"{what} ({n})")
+        for name, p in self.named_parameters():
             placements = [Replicate() for _ in mesh.dim_names]
             placements[ax] = Shard(0)
+            leaf = name.rsplit(".", 1)[-1]
+            if tp_ax is not None and leaf in self._TP_DIMS:
+                placements[tp_ax] = Shard(self._TP_DIMS[leaf])
             p._dist_attr = TensorDistAttr(mesh, placements)
         return self
 

@@ -99,6 +99,25 @@ def main():
     pp_losses = [float(pstep(ids, lab).numpy()) for _ in range(5)]
     losses = losses + pp_losses
 
+    # phase 3: FULL 3-axis hybrid (pp2 x mp2 x dp2) across the same 2
+    # controllers — stage sharding + Megatron TP placements + batch dp on
+    # one cross-process mesh (reference: 3D hybrid LLaMA parity,
+    # test/auto_parallel/hybrid_strategy/test_parallel_api_with_llama_3d.py)
+    strategy_3d = fleet.DistributedStrategy()
+    strategy_3d.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                  "pp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy_3d)
+    paddle.seed(13)
+    cfg3 = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                     num_heads=2, max_seq_len=16, dropout=0.0)
+    hmodel = GPTForCausalLMPipe(cfg3)
+    hmodel.decoder.apply_pipeline_placements(tp_axis="mp")
+    hopt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                  parameters=hmodel.parameters())
+    hstep = ShardedTrainStep(hmodel, lambda a, b: hmodel.loss(a, b), hopt,
+                             fleet.get_fleet_mesh())
+    losses += [float(hstep(ids, lab).numpy()) for _ in range(5)]
+
     rank = dist.get_rank() if MODE == "dist" else 0
     out = os.environ.get("PTPU_PARITY_OUT")
     if rank == 0 and out:

@@ -56,10 +56,13 @@ def test_launch_two_process_collectives(tmp_path):
 def test_two_process_hybrid_train_loss_parity(tmp_path):
     """VERDICT r4 item 2 (incl. the "ideally pp" clause): 2 OS
     processes x 4 devices run (a) a dp2 x mp4 ShardedTrainStep for 10
-    steps and (b) a pp2 x dp4 compiled-pipeline (scan + ppermute over
-    the cross-process mesh) GPT train step for 5 steps; every loss must
-    match the 1-process x 8-device run step for step (reference
-    discipline: test/legacy_test/test_dist_base.py:957)."""
+    steps, (b) a pp2 x dp4 compiled-pipeline (scan + ppermute over
+    the cross-process mesh) GPT train step for 5 steps, and (c) the
+    FULL 3-axis pp2 x mp2 x dp2 hybrid (stage sharding + Megatron TP
+    placements + batch dp) for 5 steps; every loss must match the
+    1-process x 8-device run step for step (reference discipline:
+    test/legacy_test/test_dist_base.py:957; 3D hybrid parity:
+    test/auto_parallel/hybrid_strategy/)."""
     worker = os.path.join(os.path.dirname(__file__), "launch_assets",
                           "hybrid_train_worker.py")
     base_env = {
@@ -100,12 +103,14 @@ def test_two_process_hybrid_train_loss_parity(tmp_path):
                                   logs[-4000:])
     assert logs.count("TRAIN_WORKER_OK") == 2, logs[-4000:]
     got = __import__("json").loads(dist_out.read_text())
-    # 10 dp2 x mp4 steps + 5 pp2 x dp4 compiled-pipeline steps
-    assert len(ref) == len(got) == 15
+    # 10 dp2 x mp4 steps + 5 pp2 x dp4 pipeline steps + 5 pp2 x mp2 x dp2
+    # 3-axis hybrid steps
+    assert len(ref) == len(got) == 20
     # identical global mesh, devices, and program -> near-bitwise parity;
     # tolerance covers CPU collective reduction-order noise only
     import numpy as np
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
-    # and both phases actually trained
+    # and all three phases actually trained
     assert ref[9] < ref[0] * 0.9          # dp x mp phase
     assert ref[14] < ref[10] * 0.9        # pp pipeline phase
+    assert ref[19] < ref[15] * 0.9        # 3-axis hybrid phase

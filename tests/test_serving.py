@@ -491,3 +491,33 @@ class TestPageEconomics:
         model = _tiny_model()
         with pytest.raises(ValueError):
             ContinuousBatchingEngine(model, preempt_policy="drop")
+
+    def test_swap_group_prefill_no_thrash(self):
+        """A decode-phase victim under GROUP (non-chunked) prefill must
+        restore with its growth page reserved — the regression was
+        prefill_pos lagging length after _prefill_group, misclassifying
+        the snapshot as mid-prefill and looping restore->starve->swap
+        (one full host KV round-trip per tick, zero progress)."""
+        model = _tiny_model()
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, 96, (6,)).tolist() for _ in range(2)]
+
+        roomy = ContinuousBatchingEngine(model, max_slots=2, page_size=4,
+                                         max_seq_len=48,
+                                         max_new_tokens=14)
+        for p in prompts:
+            roomy.submit(p)
+        want = roomy.run_until_complete()
+
+        eng = ContinuousBatchingEngine(model, max_slots=2, page_size=4,
+                                       max_seq_len=48, num_pages=7,
+                                       max_new_tokens=14,
+                                       preempt_policy="swap")
+        for p in prompts:
+            eng.submit(p)
+        done = eng.run_until_complete()
+        assert sorted(done) == [0, 1]
+        assert eng.swaps_out <= 2, (
+            f"swap thrash: {eng.swaps_out} round-trips")
+        for rid in done:
+            assert done[rid] == want[rid], (rid, done[rid], want[rid])
