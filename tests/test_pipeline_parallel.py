@@ -367,11 +367,11 @@ def test_hybrid_pp_mp_dp_train():
     ids_np = rng.randint(0, 64, (8, 16))
     lab_np = rng.randint(0, 64, (8, 16))
 
-    def run(pp, mp, dp, steps=4):
+    def run(pp, mp, dp, sharding=1, steps=4):
         paddle.seed(7)
         s = fleet.DistributedStrategy()
         s.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
-                            "pp_degree": pp, "sharding_degree": 1}
+                            "pp_degree": pp, "sharding_degree": sharding}
         fleet.init(is_collective=True, strategy=s)
         mesh = fleet.get_fleet_mesh()
         model = GPTForCausalLMPipe(cfg)
@@ -381,7 +381,7 @@ def test_hybrid_pp_mp_dp_train():
         opt = paddle.optimizer.AdamW(learning_rate=1e-2,
                                      parameters=model.parameters())
         step = ShardedTrainStep(model, lambda a, b: model.loss(a, b),
-                                opt, mesh)
+                                opt, mesh, shard_opt_states=sharding > 1)
         ids = paddle.to_tensor(ids_np.astype(np.int32))
         lab = paddle.to_tensor(lab_np.astype(np.int64))
         losses = [float(step(ids, lab).numpy()) for _ in range(steps)]
@@ -392,6 +392,13 @@ def test_hybrid_pp_mp_dp_train():
     l_ref = run(1, 1, 1)
     assert l_hyb[-1] < l_hyb[0], l_hyb
     np.testing.assert_allclose(l_hyb, l_ref, atol=2e-3, rtol=2e-3)
+    # 4-axis composition: swap the batch axis for ZeRO sharding —
+    # pp2 x sharding2 x mp2 with optimizer slots sharded over the
+    # 'sharding' axis on top of the pp x mp param placements (the fleet
+    # sharding-stage-1 + 3D composition, reference:
+    # dygraph_sharding_optimizer.py + topology.py nesting)
+    l_zero = run(2, 2, 1, sharding=2)
+    np.testing.assert_allclose(l_zero, l_ref, atol=2e-3, rtol=2e-3)
     # the TP placements must actually shard: a column-parallel stacked
     # weight's addressable shard is 1/(pp*mp) of the full tensor
     paddle.seed(7)
@@ -412,6 +419,51 @@ def test_hybrid_pp_mp_dp_train():
     shard = wq.addressable_shards[0].data
     assert shard.size == wq.size // 4, (shard.shape, wq.shape)
     fleet._reset_for_tests()
+
+
+def test_hybrid_vpp_tp_dp_train():
+    """TP composes with the INTERLEAVED (virtual-stage) schedule too:
+    vpp2 x mp2 x dp2 over 8 layers matches the unsharded run step for
+    step (reference: PipelineParallelWithInterleave under hybrid
+    configs, fleet/meta_parallel/pipeline_parallel.py:1308)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
+
+    rng = np.random.RandomState(9)
+    ids_np = rng.randint(0, 64, (8, 16))
+    lab_np = rng.randint(0, 64, (8, 16))
+
+    def run(pp, mp, dp, v=1, steps=3):
+        paddle.seed(7)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=8,
+                        num_heads=2, max_seq_len=16, dropout=0.0)
+        if v > 1:
+            cfg.pp_interleave = v
+            cfg.pp_microbatches = 4
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                            "pp_degree": pp, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=s)
+        model = GPTForCausalLMPipe(cfg)
+        if pp > 1:
+            model.decoder.apply_pipeline_placements(
+                tp_axis="mp" if mp > 1 else None)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        step = ShardedTrainStep(model, lambda a, b: model.loss(a, b),
+                                opt, fleet.get_fleet_mesh())
+        ids = paddle.to_tensor(ids_np.astype(np.int32))
+        lab = paddle.to_tensor(lab_np.astype(np.int64))
+        losses = [float(step(ids, lab).numpy()) for _ in range(steps)]
+        fleet._reset_for_tests()
+        return losses
+
+    l_vpp = run(2, 2, 2, v=2)
+    l_ref = run(1, 1, 1)
+    assert l_vpp[-1] < l_vpp[0], l_vpp
+    np.testing.assert_allclose(l_vpp, l_ref, atol=2e-3, rtol=2e-3)
 
 
 @pytest.mark.slow
