@@ -115,7 +115,8 @@ def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
 class ContinuousBatchingEngine:
     def __init__(self, model, max_slots=4, page_size=64, num_pages=None,
                  max_seq_len=None, max_new_tokens=32, eos_token_id=None,
-                 seed=0, prefill_chunk=None, preempt_policy="recompute"):
+                 seed=0, prefill_chunk=None, preempt_policy="recompute",
+                 enable_prefix_cache=False):
         import jax
         import jax.numpy as jnp
 
@@ -175,6 +176,40 @@ class ContinuousBatchingEngine:
                 f"preempt_policy must be 'recompute' or 'swap', "
                 f"got {preempt_policy!r}")
         self.preempt_policy = preempt_policy
+        # enable_prefix_cache=True: automatic prefix caching (vLLM APC /
+        # SGLang radix-cache shape). KV pages are content-addressed by
+        # their token-prefix chain; a new request whose prompt shares a
+        # full-page-aligned prefix with any previously computed sequence
+        # REUSES those pages (read-only, refcounted) and prefills only
+        # the tail. Released pages are retained "free-but-cached": they
+        # are reclaimed lazily (cache eviction, FIFO over ref-0 entries)
+        # only when the pool runs short. Matching is capped one token
+        # below the prompt end so a fully-cached prompt still computes
+        # its first-token logits. Sound because KV at position i is a
+        # pure function of tokens[0..i]; writes only ever target
+        # positions past the matched prefix (page-granular match), so
+        # shared pages are never written. Requires chunked prefill (the
+        # tail prefill starts mid-prompt) and the recompute preemption
+        # policy (swap restore scatters into pages, which must stay
+        # exclusive).
+        if enable_prefix_cache:
+            if prefill_chunk is None:
+                raise ValueError("enable_prefix_cache requires chunked "
+                                 "prefill (prefill_chunk=...)")
+            if preempt_policy != "recompute":
+                raise ValueError("enable_prefix_cache composes only with "
+                                 "preempt_policy='recompute'")
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        self._prefix_cache = {}       # token-chain digest -> page id
+        self._cached_pages = set()    # page ids held by the cache (O(1)
+                                      # membership on the release path)
+        self._page_ref = {}           # page id -> live-request refcount
+        self.prefix_cache_hits = 0    # pages reused instead of prefilled
+        self.prefix_cache_evictions = 0
+        self.prefix_tokens_skipped = 0
+        self._cache_admit_floor = 0   # requests admitted before a
+                                      # reload_weights hold stale KV and
+                                      # must not register pages
         self.swaps_out = 0            # victims snapshotted to host
         self.swaps_in = 0             # snapshots restored to device
         self._swap_staging = None     # reused host pair for swap-in
@@ -214,8 +249,20 @@ class ContinuousBatchingEngine:
 
     def reload_weights(self, model=None):
         """Re-read weights from the model (e.g. after an in-place update);
-        the compiled decode step picks them up on the next tick."""
+        the compiled decode step picks them up on the next tick. Any
+        cached prefix KV is invalidated (it was computed under the old
+        weights): ref-0 cached pages are freed now, in-use ones when
+        their readers release them; requests already admitted are barred
+        from registering their (stale) pages."""
         self._weights = self._pack_weights(model or self._model)
+        if self.enable_prefix_cache:
+            for key in list(self._prefix_cache):
+                pg = self._prefix_cache.pop(key)
+                self._cached_pages.discard(pg)
+                if self._page_ref.get(pg, 0) == 0:
+                    self._page_ref.pop(pg, None)
+                    self.pool.free([pg])
+            self._cache_admit_floor = self._admit_counter
 
     # -- model math ---------------------------------------------------------
     @staticmethod
@@ -461,12 +508,36 @@ class ContinuousBatchingEngine:
             # under pressure — block-table growth semantics of the
             # reference's block_multi_head_attention serving path (vs the
             # r4 worst-case prompt+max_new reservation that capped batch
-            # width at a fraction of pool capacity)
-            need = (len(req.seq_tokens) + self.page - 1) // self.page
-            if need > self.pool.available:
+            # width at a fraction of pool capacity). With the prefix
+            # cache on, pages holding an already-computed prefix of this
+            # prompt are REUSED (read-only) and only the tail is
+            # reserved + prefilled.
+            shared = self._match_prefix(req.seq_tokens)
+            need = ((len(req.seq_tokens) + self.page - 1) // self.page
+                    - len(shared))
+            if self.enable_prefix_cache:
+                # PIN the matched pages before any eviction runs: a ref-0
+                # free-but-cached prefix page is otherwise a legal FIFO
+                # eviction victim, and reclaiming it here would alias one
+                # physical page into prefix-read and tail-write roles
+                for pg in shared:
+                    self._page_ref[pg] = self._page_ref.get(pg, 0) + 1
+                if not self._free_pages_for(need):
+                    for pg in shared:  # unpin; retry next tick
+                        self._page_ref[pg] -= 1
+                    break  # head-of-line waits for pages
+            elif need > self.pool.available:
                 break  # head-of-line waits for pages
             self._waiting.popleft()
-            req.pages = self.pool.alloc(need)
+            if self.enable_prefix_cache:
+                req.pages = shared + self._alloc_ref(need)
+                if shared:
+                    req.prefill_pos = max(req.prefill_pos,
+                                          len(shared) * self.page)
+                    self.prefix_cache_hits += len(shared)
+                    self.prefix_tokens_skipped += len(shared) * self.page
+            else:
+                req.pages = self.pool.alloc(need)
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
             self._slots[i] = req
@@ -600,6 +671,97 @@ class ContinuousBatchingEngine:
         pad[: len(pages)] = pages
         return self._jnp.asarray(pad)
 
+    # -- prefix cache (content-addressed KV pages) --------------------------
+    def _chain_keys(self, tokens, n_pages):
+        """Chain digests of pages 0..n_pages-1: key_i =
+        sha1(key_{i-1} || tokens of page i) — O(1) bytes per cache
+        entry regardless of prefix depth (the vLLM block-hash-chain
+        discipline; 160-bit collision space is identity in practice)."""
+        import hashlib
+
+        keys, prev = [], b""
+        for i in range(n_pages):
+            block = np.asarray(
+                tokens[i * self.page: (i + 1) * self.page],
+                np.int64).tobytes()
+            prev = hashlib.sha1(prev + block).digest()
+            keys.append(prev)
+        return keys
+
+    def _evictable(self):
+        return [k for k, pg in self._prefix_cache.items()
+                if self._page_ref.get(pg, 0) == 0]
+
+    def _free_pages_for(self, n):
+        """True if n pages can be allocated, evicting ref-0 cached pages
+        (FIFO) as needed. Callers must PIN (incref) any matched shared
+        pages before calling, or eviction could reclaim them."""
+        while self.pool.available < n:
+            victims = self._evictable()
+            if not victims:
+                return False
+            key = victims[0]
+            page = self._prefix_cache.pop(key)
+            self._cached_pages.discard(page)
+            self._page_ref.pop(page, None)
+            self.pool.free([page])
+            self.prefix_cache_evictions += 1
+        return True
+
+    def _alloc_ref(self, n):
+        pages = self.pool.alloc(n)
+        for pg in pages:
+            self._page_ref[pg] = self._page_ref.get(pg, 0) + 1
+        return pages
+
+    def _release_pages(self, req, register):
+        """Drop req's claim on its pages. Own pages whose content is a
+        complete, deterministic token-prefix page are REGISTERED into the
+        prefix cache (retained, lazily evictable) instead of freed; the
+        rest return to the pool. Without the cache enabled this is
+        exactly pool.free."""
+        if not self.enable_prefix_cache:
+            self.pool.free(req.pages)
+            req.pages = []
+            return
+        register = register and req.admit_seq >= self._cache_admit_floor
+        written = max(req.length, req.prefill_pos)
+        full = req.prompt + req.generated
+        n_complete = min(written // self.page, len(req.pages))
+        keys = (self._chain_keys(full, n_complete)
+                if register and n_complete else [])
+        freed = []
+        for i, pg in enumerate(req.pages):
+            ref = self._page_ref.get(pg, 0) - 1
+            self._page_ref[pg] = max(ref, 0)
+            if ref > 0:
+                continue  # another live request still reads it
+            if pg in self._cached_pages:
+                continue  # retained by the cache (free-but-cached)
+            if i < len(keys) and keys[i] not in self._prefix_cache:
+                self._prefix_cache[keys[i]] = pg
+                self._cached_pages.add(pg)
+                continue
+            freed.append(pg)
+            self._page_ref.pop(pg, None)
+        self.pool.free(freed)
+        req.pages = []
+
+    def _match_prefix(self, tokens):
+        """Longest cached full-page chain strictly shorter than the
+        prompt (>=1 token always left to prefill). Returns the shared
+        page list."""
+        if not self.enable_prefix_cache:
+            return []
+        max_pages = (len(tokens) - 1) // self.page
+        shared = []
+        for key in self._chain_keys(tokens, max_pages):
+            pg = self._prefix_cache.get(key)
+            if pg is None:
+                break
+            shared.append(pg)
+        return shared
+
     def _swap_stage(self, snap_shape, dtype):
         """Reusable host staging pair at the fixed [L, Hkv, P, page, D]
         scatter shape (jax copies numpy args into XLA buffers at dispatch,
@@ -641,12 +803,17 @@ class ContinuousBatchingEngine:
                          "n": n, "prefill_pos": r.prefill_pos,
                          "length": r.length}
             self.swaps_out += 1
+            self.pool.free(r.pages)
+            r.pages = []
         else:
+            # release BEFORE resetting the bookkeeping: registration
+            # needs the written-token count, and caching the victim's
+            # completed pages makes the recompute resume nearly free
+            # (re-admission matches its own prefix)
+            self._release_pages(r, register=True)
             r.seq_tokens = r.prompt + r.generated
             r.prefill_pos = 0
             r.length = 0
-        self.pool.free(r.pages)
-        r.pages = []
         self._slots[slot_idx] = None
         self._waiting.appendleft(r)
         self.preemptions += 1
@@ -669,8 +836,13 @@ class ContinuousBatchingEngine:
                 grow = need - len(r.pages)
                 if grow <= 0:
                     continue
-                if grow <= self.pool.available:
-                    r.pages.extend(self.pool.alloc(grow))
+                ok = (self._free_pages_for(grow)
+                      if self.enable_prefix_cache
+                      else grow <= self.pool.available)
+                if ok:
+                    r.pages.extend(self._alloc_ref(grow)
+                                   if self.enable_prefix_cache
+                                   else self.pool.alloc(grow))
                 else:
                     short = (i, r)
                     break
@@ -687,8 +859,7 @@ class ContinuousBatchingEngine:
             self._preempt(victim[0])
 
     def _retire(self, req: _Request):
-        self.pool.free(req.pages)
-        req.pages = []
+        self._release_pages(req, register=True)
         return req.prompt + req.generated
 
     def step(self):

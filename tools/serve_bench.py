@@ -3,9 +3,13 @@
 r3 weak #9 / r4: the serving stack (batched chunked prefill + paged
 decode) had no recorded on-chip throughput. Run from /root/repo:
     python tools/serve_bench.py [--policy recompute|swap] [--roomy]
+        [--prefix-cache] [--shared-prefix N] [--prompt-len M]
 Prints tok/s at several concurrency levels for a 1.3B-class decoder.
 --policy picks the preemption strategy for the tight-pool regime;
---roomy sizes the pool at worst case (no preemption) instead.
+--roomy sizes the pool at worst case (no preemption) instead;
+--shared-prefix N makes every prompt share its first N tokens (a system
+prompt), the workload where --prefix-cache (automatic prefix caching)
+skips the shared prefill.
 """
 from __future__ import annotations
 
@@ -33,6 +37,13 @@ def main():
             sys.exit("--policy requires a value: recompute | swap")
         policy = sys.argv[i + 1]
     roomy = "--roomy" in sys.argv
+    prefix_cache = "--prefix-cache" in sys.argv
+    shared_prefix = 0
+    if "--shared-prefix" in sys.argv:
+        shared_prefix = int(sys.argv[sys.argv.index("--shared-prefix") + 1])
+    prompt_len_arg = 0
+    if "--prompt-len" in sys.argv:
+        prompt_len_arg = int(sys.argv[sys.argv.index("--prompt-len") + 1])
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
@@ -44,6 +55,10 @@ def main():
         cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
                           num_heads=4, max_seq_len=128, dropout=0.0)
         new_tokens, prompt_len = 8, 16
+    if prompt_len_arg:
+        prompt_len = prompt_len_arg
+        if prompt_len + new_tokens > cfg.max_seq_len:
+            cfg.max_seq_len = prompt_len + new_tokens
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
@@ -67,11 +82,13 @@ def main():
         eng = ContinuousBatchingEngine(
             model, max_slots=slots, page_size=64, num_pages=tight,
             max_new_tokens=new_tokens, prefill_chunk=64,
-            preempt_policy=policy)
+            preempt_policy=policy, enable_prefix_cache=prefix_cache)
         n_req = slots * 2
+        sys_prompt = list(rng.integers(1, cfg.vocab_size, shared_prefix))
         for _ in range(n_req):
-            eng.submit(list(rng.integers(1, cfg.vocab_size,
-                                         prompt_len)))
+            tail = list(rng.integers(1, cfg.vocab_size,
+                                     prompt_len - shared_prefix))
+            eng.submit(sys_prompt + tail)
         t0 = time.perf_counter()
         done = eng.run_until_complete(max_ticks=100000)
         dt = time.perf_counter() - t0
@@ -81,6 +98,7 @@ def main():
               f" (prefill passes: {eng.prefill_chunk_steps},"
               f" preemptions: {eng.preemptions},"
               f" swaps: {eng.swaps_out},"
+              f" cache hits: {eng.prefix_cache_hits} pages,"
               f" policy: {policy}, pool: {tight} pages)", flush=True)
 
 
