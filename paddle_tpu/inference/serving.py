@@ -20,6 +20,15 @@ drive it). TPU-native design:
 - Sampling runs inside the jitted decode step: per-request temperature /
   top-k / top-p (temperature 0 = greedy, the default). Per-token
   streaming callbacks fire as tokens are emitted.
+- Admission reserves only prefill pages; decode pages are allocated as
+  sequences grow. On pool exhaustion the youngest request is preempted:
+  policy "recompute" (default) folds its tokens into the resume prompt,
+  "swap" round-trips its KV through host memory (measured tradeoffs in
+  docs/ROUND5_RESPONSE.md).
+- `enable_prefix_cache=True` adds automatic prefix caching: pages are
+  content-addressed by sha1 block-hash chains and reused read-only
+  across requests sharing a prompt prefix (~2x TTFT on long shared
+  system prompts, measured).
 
 Weights are packed into an explicit pytree passed to the jitted step (not
 closed-over constants), so `reload_weights()` on a live engine takes

@@ -595,6 +595,26 @@ class TestPageEconomics:
         assert len(eng._cached_pages) == len(
             set(eng._prefix_cache.values()))
 
+    def test_prefix_cache_with_sampling_completes(self):
+        """Prefix reuse is orthogonal to the sampling mode: sampled
+        (temperature>0) requests sharing a prefix complete, reuse
+        pages, and drain refcounts — outputs are stochastic so only
+        liveness + accounting are asserted."""
+        model = _tiny_model()
+        system = list(range(1, 13))
+        eng = ContinuousBatchingEngine(model, max_slots=2, page_size=4,
+                                       max_seq_len=48, max_new_tokens=6,
+                                       prefill_chunk=4,
+                                       enable_prefix_cache=True)
+        for tail in ([20, 21], [30], [40, 41, 42]):
+            eng.submit(system + tail, temperature=0.8, top_k=10,
+                       top_p=0.9)
+        done = eng.run_until_complete()
+        assert sorted(done) == [0, 1, 2]
+        assert all(len(v) > len(system) for v in done.values())
+        assert eng.prefix_cache_hits > 0
+        assert all(v == 0 for v in eng._page_ref.values())
+
     def test_prefix_cache_fully_aligned_prompt_still_decodes(self):
         """A prompt whose pages are ALL cached must still compute its
         first token: matching is capped one token short, so the last
