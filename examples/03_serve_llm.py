@@ -19,14 +19,16 @@ def main():
     # KV pages instead of re-prefilling (~2x TTFT on long shared
     # prefixes, measured on-chip). Pool pressure is survivable too:
     # pages grow as sequences do, and on exhaustion the youngest
-    # request is preempted (preempt_policy="recompute" default; "swap"
-    # round-trips its KV through host memory instead).
+    # request is preempted and recomputed on re-admission. (Without the
+    # prefix cache, preempt_policy="swap" is an alternative that
+    # round-trips the victim's KV through host memory instead.)
     engine = ContinuousBatchingEngine(
         model, max_slots=4, page_size=16, max_new_tokens=12,
         prefill_chunk=8, enable_prefix_cache=True)
-    system = list(rng_tokens(16))   # a shared "system prompt"
-    rids = [engine.submit(system + list(rng_tokens(n)),
-                          temperature=t, top_p=0.9)
+    rng = np.random.default_rng(0)
+    tok = lambda n: list(rng.integers(1, 250, n))
+    system = tok(16)                # a shared "system prompt"
+    rids = [engine.submit(system + tok(n), temperature=t, top_p=0.9)
             for n, t in ((20, 0.0), (9, 0.8), (33, 1.0))]
     done = engine.run_until_complete()
     for rid in rids:
@@ -35,18 +37,11 @@ def main():
 
     # a follow-up request with the same system prompt: its prefix pages
     # are already cached, so only the tail prefills (fast first token)
-    rid = engine.submit(system + list(rng_tokens(7)))
+    rid = engine.submit(system + tok(7))
     done = engine.run_until_complete()
     print(f"follow-up {rid}: {len(done[rid])} tokens; prefix cache "
           f"reused {engine.prefix_cache_hits} pages "
           f"({engine.prefix_tokens_skipped} prompt tokens not re-prefilled)")
-
-
-_rng = np.random.default_rng(0)
-
-
-def rng_tokens(n):
-    return _rng.integers(1, 250, n)
 
 
 if __name__ == "__main__":

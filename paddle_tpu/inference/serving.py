@@ -245,15 +245,19 @@ class ContinuousBatchingEngine:
 
     @staticmethod
     def _pack_weights(model):
+        # the decode contract: `_decode_params()` (per-layer weight dicts,
+        # llama.py:66 / gpt.py GPTForCausalLMPipe) + embed/final_norm on
+        # the model or its `.model` core + optional untied `lm_head`
         params = model._decode_params()
+        core = model.model if hasattr(model, "model") else model
+        head = getattr(model, "lm_head", None)
         return {
             "layers": [tuple(lp[k]._data for k in
                              ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg",
                               "wu", "wd")) for lp in params],
-            "embed": model.model.embed_tokens.weight._data,
-            "fnorm": model.model.final_norm.weight._data,
-            "head": (model.lm_head.weight._data
-                     if model.lm_head is not None else None),
+            "embed": core.embed_tokens.weight._data,
+            "fnorm": core.final_norm.weight._data,
+            "head": head.weight._data if head is not None else None,
         }
 
     def reload_weights(self, model=None):

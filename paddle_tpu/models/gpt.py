@@ -660,6 +660,28 @@ class GPTForCausalLMPipe(nn.Layer):
         return FF.fused_linear_cross_entropy(
             x, self.embed_tokens.weight, labels, transpose_y=True)
 
+    def _decode_params(self):
+        """Per-layer slices of the stacked decoder weights — the serving/
+        decode contract shared with LlamaForCausalLM (llama.py:66), so
+        the flagship pipelined model serves through
+        inference.ContinuousBatchingEngine unchanged.
+
+        NOTE: jnp indexing COPIES, so a live engine holds a second,
+        layer-sliced copy of the weights (~2x HBM while the stacked
+        model object is also alive — unlike LlamaForCausalLM, whose
+        per-layer params are returned by reference). For serving at
+        flagship sizes, drop the training model after engine
+        construction, or load weights into a LlamaForCausalLM."""
+        from types import SimpleNamespace
+
+        d = self.decoder
+        names = ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd")
+        stacked = {n: getattr(d, n)._data for n in names}
+        return [
+            {n: SimpleNamespace(_data=stacked[n][i]) for n in names}
+            for i in range(self.config.num_layers)
+        ]
+
 
 # ---------------------------------------------------------------------------
 # MoE variant (parity slot: PaddleNLP MoE GPT over incubate MoELayer)
