@@ -64,6 +64,16 @@ def get_fleet_mesh():
     return _fleet_state["mesh"]
 
 
+def active_mesh():
+    """The mesh governing compilation right now: the fleet topology if
+    fleet.init built one, else the auto-parallel global mesh. The ONE
+    definition of that precedence — model/functional/hapi sites all
+    consult this instead of re-encoding it."""
+    from ..auto_parallel import get_mesh
+
+    return _fleet_state["mesh"] or get_mesh()
+
+
 def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
     """fleet.init — build the hybrid topology mesh (fleet.py:218)."""
     from .. import init_parallel_env

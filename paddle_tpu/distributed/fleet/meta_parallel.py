@@ -101,10 +101,9 @@ class PipelineLayer(Layer):
 
     # -- compiled pipeline execution ------------------------------------
     def _mesh_pp(self):
-        from ...distributed.auto_parallel import get_mesh
-        from . import get_fleet_mesh
+        from . import active_mesh
 
-        mesh = get_fleet_mesh() or get_mesh()
+        mesh = active_mesh()
         if mesh is None or "pp" not in mesh.dim_names:
             return None, 1
         return mesh, mesh.get_dim_size("pp")

@@ -24,6 +24,7 @@ class Model:
         self._metrics = []
         self._use_jit = True
         self._train_step = None
+        self._step_mesh = None
         self.stop_training = False
 
     # ------------------------------------------------------------------
@@ -38,8 +39,15 @@ class Model:
                 raise TypeError("metrics must be paddle_tpu.metric.Metric")
         self._use_jit = use_jit
         self._train_step = None
+        self._step_mesh = None
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _active_mesh():
+        from ..distributed.fleet import active_mesh
+
+        return active_mesh()
+
     def _compute_loss(self, outputs, labels):
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
         labs = labels if isinstance(labels, (list, tuple)) else [labels]
@@ -69,9 +77,14 @@ class Model:
         labels = labels if labels is not None else []
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         if self._use_jit:
+            # the mesh is part of the compiled step's identity: if
+            # fleet.init (or a mesh teardown) happened after the step was
+            # built, rebuild it — otherwise a later fit() would silently
+            # train unsharded (or vice versa) on call-order accidents
+            if (self._train_step is not None
+                    and self._step_mesh is not self._active_mesh()):
+                self._train_step = None
             if self._train_step is None:
-                from ..jit import TrainStep
-
                 n_inputs = len(inputs)
 
                 def step_fn(*batch):
@@ -79,7 +92,23 @@ class Model:
                     outputs = self.network(*ins)
                     return self._compute_loss(outputs, labs)
 
-                self._train_step = TrainStep(self.network, step_fn, self._optimizer)
+                # under an active fleet/auto-parallel mesh, Model.fit
+                # scales with zero user code change: the whole step is
+                # compiled over the mesh (batch sharded over dp, params
+                # by their placements — reference: hapi Model under
+                # fleet.distributed_model, hapi/model.py)
+                mesh = self._active_mesh()
+                if mesh is not None:
+                    from ..distributed.parallel_step import ShardedTrainStep
+
+                    self._train_step = ShardedTrainStep(
+                        self.network, step_fn, self._optimizer, mesh)
+                else:
+                    from ..jit import TrainStep
+
+                    self._train_step = TrainStep(self.network, step_fn,
+                                                 self._optimizer)
+                self._step_mesh = mesh
             loss = self._train_step(*(list(inputs) + list(labels)))
             metrics_out = self._eval_metrics_on_batch(inputs, labels) if self._metrics else []
             return [float(loss.item())] + metrics_out

@@ -478,10 +478,9 @@ class StackedDecoder(nn.Layer):
         self.wd = w(L, m, h)
 
     def _mesh_pp(self):
-        from paddle_tpu.distributed.auto_parallel import get_mesh
-        from paddle_tpu.distributed.fleet import get_fleet_mesh
+        from paddle_tpu.distributed.fleet import active_mesh
 
-        mesh = get_fleet_mesh() or get_mesh()
+        mesh = active_mesh()
         if mesh is None or "pp" not in mesh.dim_names:
             return None, 1
         return mesh, mesh.get_dim_size("pp")

@@ -62,11 +62,10 @@ def _constrain_heads_over_mp(q, k, v):
     heads dim over "mp", never seq_kv or head_dim. Binds the Megatron
     attention layout inside jit instead of trusting propagation (the
     explicit analogue of `flash_attn_spmd_rule`)."""
-    from ...distributed.auto_parallel import get_mesh
-    from ...distributed.fleet import get_fleet_mesh
+    from ...distributed.fleet import active_mesh
     from ...distributed.spmd_rules import constraints_enabled
 
-    mesh = get_fleet_mesh() or get_mesh()
+    mesh = active_mesh()
     mp_size = (
         mesh.get_dim_size("mp")
         if mesh is not None and "mp" in mesh.dim_names
