@@ -353,6 +353,86 @@ def test_batched_prefill_advances_all_slots_together():
     assert eng.prefill_chunk_steps <= 5, eng.prefill_chunk_steps
 
 
+class TestServingSoak:
+    @staticmethod
+    def _check_invariants(eng):
+        """Page-accounting invariants that must hold after EVERY tick:
+        no leaks, no double-ownership, refcounts consistent."""
+        live_pages = []
+        for r in eng._slots:
+            if r is not None:
+                assert len(set(r.pages)) == len(r.pages), (
+                    "request holds a duplicate page", r.rid, r.pages)
+                live_pages.extend(r.pages)
+        cached = set(eng._prefix_cache.values())
+        assert cached == eng._cached_pages
+        from collections import Counter
+
+        holders = Counter(live_pages)
+        # a page held by >1 request must be cache-shared; refcounts match
+        for pg, n in holders.items():
+            if n > 1:
+                assert pg in cached, (pg, n)
+            assert eng._page_ref.get(pg, 0) == n, (
+                pg, n, eng._page_ref.get(pg, 0))
+        # cache-held pages with no live holder carry ref 0
+        for pg in cached - set(holders):
+            assert eng._page_ref.get(pg, 0) == 0, pg
+        # conservation: allocated == live ∪ cached (no leak, no alias)
+        allocated = eng.pool.num_pages - eng.pool.available
+        assert allocated == len(set(live_pages) | cached), (
+            allocated, len(set(live_pages) | cached))
+
+    @pytest.mark.slow
+    def test_randomized_soak_accounting(self):
+        """40 requests with random lengths/arrival times/sampling modes,
+        half sharing a system prompt, through a starved pool with prefix
+        caching on — the full feature interaction surface (growth,
+        preemption-recompute, cache register/hit/evict, mixed
+        greedy/sampled ticks). Invariants checked after every tick;
+        everything must drain."""
+        model = _tiny_model()
+        rng = np.random.default_rng(17)
+        system = list(range(1, 13))  # 3 full pages @4
+        eng = ContinuousBatchingEngine(model, max_slots=3, page_size=4,
+                                       max_seq_len=64, num_pages=17,
+                                       max_new_tokens=6, prefill_chunk=5,
+                                       enable_prefix_cache=True)
+        pending = []
+        for i in range(40):
+            if rng.random() < 0.5:
+                prompt = system + rng.integers(1, 96, (
+                    int(rng.integers(1, 8)),)).tolist()
+            else:
+                prompt = rng.integers(1, 96, (
+                    int(rng.integers(4, 20)),)).tolist()
+            temp = 0.0 if rng.random() < 0.5 else 0.7
+            pending.append((int(rng.integers(0, 120)), prompt, temp))
+        pending.sort(key=lambda t: t[0])
+
+        done = {}
+        for tick in range(4000):
+            while pending and pending[0][0] <= tick:
+                _, prompt, temp = pending.pop(0)
+                eng.submit(prompt, temperature=temp, top_k=8, top_p=0.95)
+            done.update(eng.step())
+            self._check_invariants(eng)
+            if (not pending and not eng._waiting
+                    and all(s is None for s in eng._slots)):
+                break
+        else:
+            raise AssertionError("soak did not drain")
+        assert len(done) == 40
+        assert all(len(v) > 0 for v in done.values())
+        # steady state: every refcount at zero, pool fully accounted
+        assert all(v == 0 for v in eng._page_ref.values())
+        assert (eng.pool.available + len(eng._cached_pages)
+                == eng.pool.num_pages)
+        # the workload exercised the interesting paths
+        assert eng.prefix_cache_hits > 0
+        assert eng.preemptions > 0 or eng.prefix_cache_evictions > 0
+
+
 class TestGPTPipeServing:
     def test_gpt_pipe_model_serves_identically(self):
         """The flagship stacked/pipelined GPT family serves through the
