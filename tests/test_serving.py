@@ -433,6 +433,47 @@ class TestServingSoak:
         assert eng.preemptions > 0 or eng.prefix_cache_evictions > 0
 
 
+    @pytest.mark.slow
+    def test_randomized_soak_swap_policy(self):
+        """Same soak shape under preempt_policy='swap' (prefix cache
+        off — the policies are exclusive): swapped-out requests hold no
+        pages while their snapshots wait, restores rebuild exactly, and
+        the pool conserves."""
+        model = _tiny_model()
+        rng = np.random.default_rng(23)
+        eng = ContinuousBatchingEngine(model, max_slots=3, page_size=4,
+                                       max_seq_len=64, num_pages=13,
+                                       max_new_tokens=6, prefill_chunk=5,
+                                       preempt_policy="swap")
+        pending = []
+        for i in range(30):
+            prompt = rng.integers(1, 96, (
+                int(rng.integers(4, 18)),)).tolist()
+            pending.append((int(rng.integers(0, 90)), prompt))
+        pending.sort(key=lambda t: t[0])
+
+        done = {}
+        for tick in range(4000):
+            while pending and pending[0][0] <= tick:
+                eng.submit(pending.pop(0)[1])
+            done.update(eng.step())
+            live = [r for r in eng._slots if r is not None]
+            held = [pg for r in live for pg in r.pages]
+            assert len(set(held)) == len(held), "double ownership"
+            assert (eng.pool.num_pages - eng.pool.available
+                    == len(held)), "pool leak"
+            for r in eng._waiting:
+                assert not r.pages, "waiting request holds pages"
+            if (not pending and not eng._waiting
+                    and all(s is None for s in eng._slots)):
+                break
+        else:
+            raise AssertionError("swap soak did not drain")
+        assert len(done) == 30
+        assert eng.swaps_in == eng.swaps_out
+        assert eng.pool.available == eng.pool.num_pages
+
+
 class TestGPTPipeServing:
     def test_gpt_pipe_model_serves_identically(self):
         """The flagship stacked/pipelined GPT family serves through the
