@@ -1,5 +1,6 @@
 """Quickstart 2: decoder-only pretraining on a hybrid-parallel mesh
-(fleet dp x mp, BASELINE.md config 4 shape). On one host:
+(fleet dp x mp, BASELINE.md config 4 shape), then the FULL 3-axis
+pp x mp x dp composition as one compiled step. On one host:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/02_pretrain_gpt_hybrid.py
 On a pod, launch one process per host with
@@ -42,6 +43,29 @@ def main():
     for step in range(5):
         loss = dmodel.train_batch([ids, labels], dopt, loss_fn=lm_loss)
         print(f"step {step}: loss {float(loss):.4f}")
+
+    # -- full 3-axis hybrid: pipeline stages x Megatron TP x data -------
+    # parallel, ONE compiled program. Stage sharding comes from the
+    # 'pp' placements; tp_axis="mp" adds column/row TP placements on
+    # the stacked weights; the batch shards over dp. (Swap the dp axis
+    # for sharding_degree=2 + shard_opt_states=True to get ZeRO-1 on
+    # top — the 4-axis composition.)
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+    strategy3 = fleet.DistributedStrategy()
+    strategy3.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                "pp_degree": 2, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy3)
+    paddle.seed(0)
+    model3 = GPTForCausalLMPipe(cfg)
+    model3.decoder.apply_pipeline_placements(tp_axis="mp")
+    opt3 = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                  parameters=model3.parameters())
+    step3 = ShardedTrainStep(model3, lambda a, b: model3.loss(a, b),
+                             opt3, fleet.get_fleet_mesh())
+    for step in range(3):
+        loss = step3(ids, labels)
+        print(f"3-axis step {step}: loss {float(loss.numpy()):.4f}")
 
 
 if __name__ == "__main__":
