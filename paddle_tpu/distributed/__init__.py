@@ -541,15 +541,34 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
     """parity: auto_parallel/intermediate/parallelize.py:51.
 
     Applies a plan dict {"mp_config": {"parallelize_plan": {name: marker}}}
-    by marking matched Linear/Embedding weights with mp placements; dp and
-    pp config keys shard batch/stages via the fleet mesh machinery.
+    by marking matched Linear/Embedding weights with mp placements.
     With {"mp_config": {"auto": True, "example_inputs": [...]}} the plan
     is DERIVED from the per-op cost planner instead of written by hand.
+    When the mesh has a pp axis > 1 and the model (or a submodule)
+    exposes ``apply_pipeline_placements`` (the stacked-decoder family),
+    stage placements are applied automatically — including TP over the
+    "mp" axis when present — so ``parallelize(model)`` alone wires the
+    full pp x mp x dp hybrid from the mesh shape (reference pp_config:
+    intermediate/parallelize.py split_spec). dp needs no marking: the
+    batch shards at the compiled step.
     """
-    from .auto_parallel import Replicate, Shard, TensorDistAttr, get_mesh
+    from .auto_parallel import Replicate, Shard, TensorDistAttr
+    from .fleet import active_mesh
 
-    mesh = mesh or get_mesh()
+    mesh = mesh or active_mesh()
     config = config or {}
+    pp_cfg = config.get("pp_config") or {}
+    if (mesh is not None and "pp" in mesh.dim_names
+            and mesh.get_dim_size("pp") > 1
+            and pp_cfg.get("enable", True)):
+        tp_axis = pp_cfg.get("tp_axis")
+        if tp_axis is None and ("mp" in mesh.dim_names
+                                and mesh.get_dim_size("mp") > 1):
+            tp_axis = "mp"
+        for _, sub in [("", model)] + list(model.named_sublayers()):
+            if hasattr(sub, "apply_pipeline_placements"):
+                sub.apply_pipeline_placements(mesh, tp_axis=tp_axis)
+                break
     mp_cfg = config.get("mp_config") or {}
     plan = mp_cfg.get("parallelize_plan") or {}
     if (not plan and mp_cfg.get("auto") and mesh is not None

@@ -421,6 +421,52 @@ def test_hybrid_pp_mp_dp_train():
     fleet._reset_for_tests()
 
 
+def test_parallelize_wires_pipeline_and_tp():
+    """dist.parallelize(model) with NO config derives the stage + TP
+    placements from the mesh shape alone (pp axis -> Shard(0), mp axis
+    -> Megatron column/row dims) and trains identically to the manual
+    apply_pipeline_placements(tp_axis='mp') call."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                    num_heads=2, max_seq_len=16, dropout=0.0)
+    rng = np.random.RandomState(6)
+    ids_np = rng.randint(0, 64, (8, 16))
+    lab_np = rng.randint(0, 64, (8, 16))
+
+    def run(wire):
+        paddle.seed(5)
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                            "pp_degree": 2, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=s)
+        model = GPTForCausalLMPipe(cfg)
+        if wire == "parallelize":
+            model, _ = dist.parallelize(model)
+        else:
+            model.decoder.apply_pipeline_placements(tp_axis="mp")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        step = ShardedTrainStep(model, lambda a, b: model.loss(a, b),
+                                opt, fleet.get_fleet_mesh())
+        ids = paddle.to_tensor(ids_np.astype(np.int32))
+        lab = paddle.to_tensor(lab_np.astype(np.int64))
+        losses = [float(step(ids, lab).numpy()) for _ in range(3)]
+        wq = model.decoder.wq._data
+        shard_frac = wq.addressable_shards[0].data.size / wq.size
+        fleet._reset_for_tests()
+        return losses, shard_frac
+
+    l_auto, frac_auto = run("parallelize")
+    l_manual, frac_manual = run("manual")
+    assert frac_auto == frac_manual == 0.25  # pp2 x mp2 sharded
+    np.testing.assert_allclose(l_auto, l_manual, rtol=1e-6, atol=1e-7)
+
+
 def test_hybrid_vpp_tp_dp_train():
     """TP composes with the INTERLEAVED (virtual-stage) schedule too:
     vpp2 x mp2 x dp2 over 8 layers matches the unsharded run step for
