@@ -552,22 +552,36 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
     intermediate/parallelize.py split_spec). dp needs no marking: the
     batch shards at the compiled step.
     """
-    from .auto_parallel import Replicate, Shard, TensorDistAttr
-    from .fleet import active_mesh
+    from .auto_parallel import Replicate, Shard, TensorDistAttr, get_mesh
+    from .fleet import get_fleet_mesh
 
-    mesh = mesh or active_mesh()
+    # this is the auto-parallel intermediate API: an explicit set_mesh()
+    # is ITS configuration surface and keeps precedence; the fleet mesh
+    # is the fallback so a fleet-only init still wires pp below
+    mesh = mesh or get_mesh() or get_fleet_mesh()
     config = config or {}
     pp_cfg = config.get("pp_config") or {}
     if (mesh is not None and "pp" in mesh.dim_names
             and mesh.get_dim_size("pp") > 1
             and pp_cfg.get("enable", True)):
-        tp_axis = pp_cfg.get("tp_axis")
-        if tp_axis is None and ("mp" in mesh.dim_names
-                                and mesh.get_dim_size("mp") > 1):
-            tp_axis = "mp"
+        # tp_axis: "auto" (default) picks "mp" when present AND the
+        # model's head/ffn dims divide it — falling back to stage-only
+        # placements otherwise; an explicit None means stage-only
+        tp_axis = pp_cfg.get("tp_axis", "auto")
+        if tp_axis == "auto":
+            tp_axis = ("mp" if "mp" in mesh.dim_names
+                       and mesh.get_dim_size("mp") > 1 else None)
+            tp_fallback = True
+        else:
+            tp_fallback = False
         for _, sub in [("", model)] + list(model.named_sublayers()):
             if hasattr(sub, "apply_pipeline_placements"):
-                sub.apply_pipeline_placements(mesh, tp_axis=tp_axis)
+                try:
+                    sub.apply_pipeline_placements(mesh, tp_axis=tp_axis)
+                except ValueError:
+                    if not (tp_fallback and tp_axis is not None):
+                        raise
+                    sub.apply_pipeline_placements(mesh, tp_axis=None)
                 break
     mp_cfg = config.get("mp_config") or {}
     plan = mp_cfg.get("parallelize_plan") or {}
@@ -584,7 +598,14 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
                 w = getattr(layer, "weight", None)
                 if w is None:
                     continue
-                placements = [Replicate() for _ in mesh.dim_names]
+                # MERGE with any placements already on the weight (e.g.
+                # the pp Shard(0) applied above) — rebuilding from
+                # all-Replicate would silently erase them
+                if (w._dist_attr is not None
+                        and w._dist_attr.process_mesh is mesh):
+                    placements = list(w._dist_attr.placements)
+                else:
+                    placements = [Replicate() for _ in mesh.dim_names]
                 if isinstance(marker, ColWiseParallel):
                     placements[ax] = Shard(w._data.ndim - 1)
                 elif isinstance(marker, RowWiseParallel):

@@ -466,6 +466,29 @@ def test_parallelize_wires_pipeline_and_tp():
     assert frac_auto == frac_manual == 0.25  # pp2 x mp2 sharded
     np.testing.assert_allclose(l_auto, l_manual, rtol=1e-6, atol=1e-7)
 
+    # explicit tp_axis=None opts out of TP (stage-only placements) ...
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                        "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    m2 = GPTForCausalLMPipe(cfg)
+    m2, _ = dist.parallelize(m2, config={"pp_config": {"tp_axis": None}})
+    from paddle_tpu.distributed.auto_parallel import Shard
+    placements = m2.decoder.wq._dist_attr.placements
+    assert sum(isinstance(p, Shard) for p in placements) == 1  # pp only
+    fleet._reset_for_tests()
+    # ... and the auto-pick falls back to stage-only when mp does not
+    # divide the heads, instead of raising on a previously-valid combo
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 4, "pp_degree": 2,
+                        "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    m3 = GPTForCausalLMPipe(cfg)  # num_heads=2 not divisible by mp=4
+    m3, _ = dist.parallelize(m3)
+    placements = m3.decoder.wq._dist_attr.placements
+    assert sum(isinstance(p, Shard) for p in placements) == 1  # pp only
+    fleet._reset_for_tests()
+
 
 def test_hybrid_vpp_tp_dp_train():
     """TP composes with the INTERLEAVED (virtual-stage) schedule too:
