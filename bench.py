@@ -21,9 +21,16 @@ def run_model(model_kind):
     on_tpu = backend not in ("cpu",)
 
     import paddle_tpu as paddle
+    import paddle_tpu.telemetry as telemetry
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
     import paddle_tpu.nn.functional as F
+
+    # full-run telemetry: op dispatch, collectives, compile events, and
+    # step timing all land in the snapshot attached to the bench JSON, so
+    # a BENCH_r*.json regression explains itself (docs/TELEMETRY.md)
+    telemetry.enable()
+    telemetry.reset()
 
     if on_tpu:
         # Tuned defaults (measured on v5e; r3 sweep + r4 sweep):
@@ -108,11 +115,27 @@ def run_model(model_kind):
     loss = step(ids, labels)
     _ = float(loss.numpy())
 
+    bench_step = telemetry.histogram(
+        "bench_step_seconds", "bench timed-loop per-step dispatch wall "
+        "time (async: the device sync runs after the loop, so trailing "
+        "device work shows up only in the tokens/sec line)")
     t0 = time.perf_counter()
+    t_prev = t0
     for _ in range(steps):
         loss = step(ids, labels)
+        t_now = time.perf_counter()
+        bench_step.observe(t_now - t_prev)
+        t_prev = t_now
     _ = float(loss.numpy())  # sync
     dt = time.perf_counter() - t0
+
+    # dp-style loss sync over the default group: single-chip it degrades
+    # to a no-op copy, but the collective call/byte counters it ticks are
+    # exactly what a multi-chip run reports — the telemetry block always
+    # carries the comms dimension
+    import paddle_tpu.distributed as dist
+
+    dist.all_reduce(loss, op=dist.ReduceOp.AVG)
 
     tokens_per_sec = batch * seq * steps / dt
 
@@ -138,6 +161,7 @@ def run_model(model_kind):
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu, 4),
+        "telemetry": telemetry.snapshot(),
     }), flush=True)
 
 

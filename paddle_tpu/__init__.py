@@ -9,6 +9,10 @@ from __future__ import annotations
 
 import jax as _jax
 
+from . import _jax_compat as _jax_compat_module
+
+_jax_compat_module.install()
+
 # float64 capability parity with the reference (x64 must be on before tracing)
 _jax.config.update("jax_enable_x64", True)
 # keep python-float default at float32 (paddle semantics) via weak types.
@@ -177,6 +181,7 @@ from . import device  # noqa: F401
 from . import utils  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
+from . import telemetry  # noqa: F401
 from . import static  # noqa: F401
 from . import sparse  # noqa: F401
 from . import strings  # noqa: F401

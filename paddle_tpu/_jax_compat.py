@@ -1,0 +1,111 @@
+"""Compatibility shims for older jax releases.
+
+The framework targets the current jax API (``jax.shard_map`` with
+``check_vma``/``axis_names``); on older runtimes (0.4.x) that surface
+lives in ``jax.experimental.shard_map`` with different keyword names.
+``install()`` runs once at package import and patches the missing
+attributes onto the ``jax`` module so every call site (framework and
+tests alike) can use the modern spelling unconditionally.
+
+Mapping for the legacy signature
+``shard_map(f, mesh, in_specs, out_specs, check_rep=True, auto=frozenset())``:
+
+- ``check_vma=X``    -> ``check_rep=X`` (same meaning, renamed)
+- ``axis_names={a}`` -> ``auto = mesh.axis_names - {a}`` (modern jax lists
+  the MANUAL axes; legacy jax lists the AUTO complement)
+"""
+from __future__ import annotations
+
+import functools
+
+
+def install():
+    import jax
+
+    _install_enable_x64(jax)
+    _install_pallas_names(jax)
+    _install_abstract_mesh(jax)
+    _install_pcast(jax)
+    if hasattr(jax, "shard_map"):
+        return
+
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, check_rep=None, axis_names=None):
+        if f is None:  # partial application: shard_map(mesh=..., ...)(f)
+            return functools.partial(
+                shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma, check_rep=check_rep,
+                axis_names=axis_names)
+        if check_rep is None:
+            # default OFF: call sites written for the modern vma checker
+            # trip false positives in the stricter legacy rep checker
+            # (e.g. cond branches with mismatched replication types)
+            check_rep = bool(check_vma) if check_vma is not None else False
+        auto = frozenset()
+        if axis_names is not None and mesh is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_rep,
+                                 auto=auto)
+
+    jax.shard_map = shard_map
+
+
+def _install_enable_x64(jax):
+    """``jax.enable_x64(bool)`` context manager.
+
+    On legacy jax this is deliberately a NO-OP rather than
+    jax.experimental.enable_x64/disable_x64: flipping x64 in the middle
+    of an outer trace is buggy there (literals staged at lowering time
+    revert to the global setting, producing mixed-width MLIR that the
+    verifier rejects). The framework only uses ``enable_x64(False)`` to
+    keep int64 literals away from Mosaic, and Mosaic never runs where
+    this shim is active (legacy jax drives the pallas INTERPRET path,
+    which tolerates 64-bit types)."""
+    if hasattr(jax, "enable_x64"):
+        return
+
+    import contextlib
+
+    jax.enable_x64 = lambda enabled=True: contextlib.nullcontext()
+
+
+def _install_pcast(jax):
+    """``jax.lax.pcast`` adjusts the varying/invariant manual-axis type
+    annotation consumed by the modern vma checker. Legacy jax has no vma
+    tracking (we always pass check_rep=False through the shard_map shim),
+    so the cast is semantically an identity."""
+    if hasattr(jax.lax, "pcast"):
+        return
+    jax.lax.pcast = lambda x, axis_name=None, to=None: x
+
+
+def _install_abstract_mesh(jax):
+    """``jax.sharding.get_abstract_mesh()`` — legacy jax has no
+    abstract-mesh tracking, so report a permanently EMPTY mesh: callers
+    branch to their no-manual-axes path, which matches legacy shard_map
+    semantics (fully manual regions never reach with_sharding_constraint
+    with hybrid specs there)."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return
+
+    class _EmptyAbstractMesh:
+        empty = True
+        axis_names = ()
+        axis_types = ()
+
+    _singleton = _EmptyAbstractMesh()
+    jax.sharding.get_abstract_mesh = lambda: _singleton
+
+
+def _install_pallas_names(jax):
+    """``pltpu.CompilerParams`` was called ``TPUCompilerParams`` on 0.4.x."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # pallas not importable on this backend: nothing to do
+        return
+    if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams

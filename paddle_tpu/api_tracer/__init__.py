@@ -1,15 +1,26 @@
 """paddle.api_tracer (parity: python/paddle/api_tracer) — record which
-public APIs a workload calls (used for coverage/compat audits)."""
+public APIs a workload calls (used for coverage/compat audits).
+
+The tracer is also a thin client of ``paddle_tpu.telemetry``: when both
+are active, every counted call lands in the shared registry as
+``api_calls_total{api=...}`` so coverage audits and perf snapshots read
+from one export."""
 from __future__ import annotations
 
 import atexit
 import functools
 import json
 
+from .. import telemetry as _telemetry
+
 __all__ = ["api_tracer", "start_api_tracer"]
 
 _CALLS: dict[str, int] = {}
 _ACTIVE = False
+
+_API_CALLS = _telemetry.counter(
+    "api_calls_total", "public API calls seen by api_tracer",
+    labelnames=("api",), max_series=4096)
 
 
 def api_tracer(fn):
@@ -20,6 +31,7 @@ def api_tracer(fn):
         if _ACTIVE:
             key = f"{fn.__module__}.{fn.__qualname__}"
             _CALLS[key] = _CALLS.get(key, 0) + 1
+            _API_CALLS.inc(labels=(key,))
         return fn(*args, **kwargs)
 
     return wrapper

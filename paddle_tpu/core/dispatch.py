@@ -23,6 +23,14 @@ import jax.numpy as jnp
 from jax import tree_util
 
 from .. import framework
+from .. import telemetry as _telemetry
+
+# module-level handles: the disabled path must cost one attribute check,
+# not a registry lookup per op
+_TELEMETRY_REG = _telemetry.get_registry()
+_OP_DISPATCH = _telemetry.counter(
+    "op_dispatch_total", "eager ops dispatched through apply_op",
+    labelnames=("op",), max_series=2048)
 
 
 def _is_tensor(x):
@@ -166,6 +174,9 @@ def apply_op(fn, *args, _op_name=None, **kwargs):
     arrays = [l._data if _is_tensor(l) else l for l in leaves]
 
     name_for_amp = _op_name or getattr(fn, "__name__", "op")
+
+    if _TELEMETRY_REG.enabled:
+        _OP_DISPATCH.inc(labels=(name_for_amp,))
 
     # Segment capture (jit/lazy.py): record the op into the current
     # segment instead of dispatching — graph-broken to_static calls

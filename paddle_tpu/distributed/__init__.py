@@ -600,9 +600,12 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
                     continue
                 # MERGE with any placements already on the weight (e.g.
                 # the pp Shard(0) applied above) — rebuilding from
-                # all-Replicate would silently erase them
+                # all-Replicate would silently erase them. Compare meshes
+                # by VALUE (shape + dim_names + device ids, ProcessMesh
+                # __eq__): an equal-but-distinct mesh object must not
+                # silently drop prior pp/TP placements (ADVICE round 5)
                 if (w._dist_attr is not None
-                        and w._dist_attr.process_mesh is mesh):
+                        and w._dist_attr.process_mesh == mesh):
                     placements = list(w._dist_attr.placements)
                 else:
                     placements = [Replicate() for _ in mesh.dim_names]

@@ -32,6 +32,17 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
+from .. import telemetry as _telemetry
+
+# same metric families as distributed/communication (one registry: the
+# names/labelnames must stay in sync — registry rejects a mismatch)
+_TELEMETRY_REG = _telemetry.get_registry()
+_COLL_CALLS = _telemetry.counter(
+    "collective_calls_total", "eager collective API calls",
+    labelnames=("op", "axis", "nranks"))
+_COLL_BYTES = _telemetry.counter(
+    "collective_bytes_total", "payload bytes entering eager collectives",
+    labelnames=("op", "axis", "nranks"))
 
 
 # ---------------------------------------------------------------------------
@@ -328,6 +339,13 @@ def _resolve_partial(arr, dist_attr):
     ]
     if not axes:
         return arr
+    if _TELEMETRY_REG.enabled:
+        # the reshard psum, labeled by the REAL mesh axes it reduces over
+        nranks = int(np.prod([dist_attr.process_mesh.get_dim_size(a)
+                              for a in axes]))
+        labels = ("reshard_psum", "+".join(axes), str(nranks))
+        _COLL_CALLS.inc(labels=labels)
+        _COLL_BYTES.inc(int(getattr(arr, "nbytes", 0) or 0), labels=labels)
     mesh = dist_attr.process_mesh.jax_mesh
     from jax import shard_map
 

@@ -33,8 +33,37 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from jax import shard_map
 
 from ...core.tensor import Tensor
+from ... import telemetry as _telemetry
 
 P = PartitionSpec
+
+# collective call/byte counters, labeled by op and the mesh axis the
+# collective runs over (the eager group collectives all ride the group's
+# 1-D "g" axis; compiled-step collectives are XLA-internal and show up in
+# the profiler's device table instead). Calls are counted at API entry so
+# degenerate single-rank calls are visible too — a dp=1 run that still
+# pays per-step all_reduce python overhead is a real finding.
+_TELEMETRY_REG = _telemetry.get_registry()
+_COLL_CALLS = _telemetry.counter(
+    "collective_calls_total", "eager collective API calls",
+    labelnames=("op", "axis", "nranks"))
+_COLL_BYTES = _telemetry.counter(
+    "collective_bytes_total", "payload bytes entering eager collectives",
+    labelnames=("op", "axis", "nranks"))
+
+
+def _note_collective(op, group, *tensors):
+    if not _TELEMETRY_REG.enabled:
+        return
+    nranks = group.nranks if group is not None else 1
+    labels = (op, "g", str(nranks))
+    _COLL_CALLS.inc(labels=labels)
+    nbytes = 0
+    for t in tensors:
+        data = getattr(t, "_data", t)
+        nbytes += int(getattr(data, "nbytes", 0) or 0)
+    if nbytes:
+        _COLL_BYTES.inc(nbytes, labels=labels)
 
 
 # ---------------------------------------------------------------------------
@@ -208,6 +237,7 @@ def _is_dist_multiprocess():
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all_reduce of this rank's tensor across the group."""
     group = group or _get_default_group()
+    _note_collective("all_reduce", group, tensor)
     if group.nranks <= 1:
         return tensor
     red = _REDUCERS[op]
@@ -237,6 +267,7 @@ def _global_stack(tensor, group):
 
 def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True, axis=0):
     group = group or _get_default_group()
+    _note_collective("all_gather", group, tensor)
     if group.nranks <= 1:
         tensor_list.append(Tensor(tensor._data))
         return tensor_list
@@ -290,6 +321,7 @@ def reduce(tensor: Tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def broadcast(tensor: Tensor, src, group=None, sync_op=True):
     group = group or _get_default_group()
+    _note_collective("broadcast", group, tensor)
     if group.nranks <= 1:
         return tensor
     if _is_dist_multiprocess():
@@ -309,6 +341,7 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None, syn
     mirrors all_reduce: every "rank" holds the same inputs, so slot r sums
     to n * tensor_list[r]."""
     group = group or _get_default_group()
+    _note_collective("reduce_scatter", group, *tensor_list)
     if group.nranks <= 1:
         tensor._data = tensor_list[0]._data
         return tensor
@@ -333,6 +366,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     inputs, so rank r's output list is [in[r]] * n — consistent with the
     degenerate all_reduce/reduce_scatter semantics above."""
     group = group or _get_default_group()
+    _note_collective("all_to_all", group, *in_tensor_list)
     n = group.nranks
     if n <= 1 or not _is_dist_multiprocess():
         r = max(group.rank, 0)
@@ -470,6 +504,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     mismatched programs (the same contract as NCCL send/recv in the
     reference, process_group_nccl.cc). The single-controller and store
     tiers cast to the recv buffer's dtype as a convenience."""
+    _note_collective("send", group or _get_default_group(), tensor)
     src = get_rank()
     # role-scoped sequence counters: in the single-controller simulation
     # the sending and receiving "ranks" share this process, so one shared
@@ -497,6 +532,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    _note_collective("recv", group or _get_default_group(), tensor)
     dst = get_rank()
     seq = _p2p_seq.setdefault(("recv", src, dst), [0])
     n = seq[0]

@@ -40,11 +40,42 @@ Works with the GPT/LLaMA stacked-weights families (anything exposing
 from __future__ import annotations
 
 import math
+import time
 from collections import deque
 
 import numpy as np
 
+from .. import telemetry as _telemetry
+
 __all__ = ["PagePool", "ContinuousBatchingEngine"]
+
+# serving metrics (names/labels contract: docs/TELEMETRY.md). Gauges are
+# refreshed once per step(); counters tick at the event sites.
+_TELEMETRY_REG = _telemetry.get_registry()
+_QUEUE_DEPTH = _telemetry.gauge(
+    "serving_queue_depth", "requests waiting for admission")
+_SLOTS_OCCUPIED = _telemetry.gauge(
+    "serving_slots_occupied", "engine slots holding a live request")
+_BATCH_OCCUPANCY = _telemetry.histogram(
+    "serving_batch_occupancy", "live slots / max_slots per decode tick",
+    buckets=tuple(i / 8 for i in range(1, 9)))
+_KV_UTIL = _telemetry.gauge(
+    "serving_kv_page_utilization", "fraction of KV pages allocated")
+_ADMISSIONS = _telemetry.counter(
+    "serving_admissions_total", "requests admitted into slots",
+    labelnames=("kind",))
+_PREEMPTIONS = _telemetry.counter(
+    "serving_preemptions_total", "requests evicted under page pressure",
+    labelnames=("policy",))
+_STEPS = _telemetry.counter(
+    "serving_steps_total", "engine decode ticks")
+_REQ_LATENCY = _telemetry.histogram(
+    "serving_request_latency_seconds", "submit-to-completion wall time")
+_TTFT = _telemetry.histogram(
+    "serving_ttft_seconds", "submit-to-first-token wall time")
+_REF_UNDERFLOWS = _telemetry.counter(
+    "serving_page_ref_underflows_total",
+    "KV page refcount decremented below zero (double-release bug)")
 
 
 class PagePool:
@@ -71,7 +102,8 @@ class PagePool:
 class _Request:
     __slots__ = ("rid", "prompt", "generated", "length", "pages",
                  "temperature", "top_k", "top_p", "on_token",
-                 "prefill_pos", "seq_tokens", "admit_seq", "swapped")
+                 "prefill_pos", "seq_tokens", "admit_seq", "swapped",
+                 "submit_t", "first_token_t")
 
     def __init__(self, rid, prompt, temperature=0.0, top_k=0, top_p=1.0,
                  on_token=None):
@@ -91,6 +123,8 @@ class _Request:
         self.admit_seq = -1      # admission order (preemption victims =
                                  # youngest first, vLLM recompute policy)
         self.swapped = None      # host-side KV snapshot (swap policy)
+        self.submit_t = time.perf_counter()   # latency telemetry anchors
+        self.first_token_t = None
 
 
 def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
@@ -221,7 +255,6 @@ class ContinuousBatchingEngine:
                                       # must not register pages
         self.swaps_out = 0            # victims snapshotted to host
         self.swaps_in = 0             # snapshots restored to device
-        self._swap_staging = None     # reused host pair for swap-in
         # fixed-shape ([pages_per_seq] page vector, trash-padded) so each
         # compiles ONCE; swap-in donates the caches (no double buffering)
         self._swap_out_jit = jax.jit(self._swap_gather)
@@ -461,6 +494,9 @@ class ContinuousBatchingEngine:
         return rid
 
     def _emit(self, req, tok):
+        if req.first_token_t is None:
+            req.first_token_t = time.perf_counter()
+            _TTFT.observe(req.first_token_t - req.submit_t)
         req.generated.append(tok)
         if req.on_token is not None:
             req.on_token(req.rid, tok)
@@ -496,11 +532,11 @@ class ContinuousBatchingEngine:
                     break  # head-of-line waits for pages
                 self._waiting.popleft()
                 req.pages = self.pool.alloc(need)
-                # stage the n-page snapshot into the engine's fixed-shape
-                # host buffer (reused across restores, no zeroing — the
-                # padded rows scatter into the scratch page, so their
-                # stale contents are irrelevant; the padded h2d volume is
-                # the price of the compile-once scatter)
+                # stage the n-page snapshot into a fresh fixed-shape host
+                # pair (no zeroing — the padded rows scatter into the
+                # scratch page, so their uninitialized contents are
+                # irrelevant; the padded h2d volume is the price of the
+                # compile-once scatter)
                 kh, vh = self._swap_stage(snap["k"].shape, snap["k"].dtype)
                 kh[:, :, :n] = snap["k"]
                 vh[:, :, :n] = snap["v"]
@@ -515,6 +551,7 @@ class ContinuousBatchingEngine:
                 req.admit_seq = self._admit_counter
                 self._admit_counter += 1
                 self._slots[i] = req
+                _ADMISSIONS.inc(labels=("swap_restore",))
                 continue  # not part of any prefill group
             # reserve only what PREFILL writes (the resume prefix); decode
             # pages are allocated as the sequence grows, with preemption
@@ -554,6 +591,7 @@ class ContinuousBatchingEngine:
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
             self._slots[i] = req
+            _ADMISSIONS.inc(labels=("prefill",))
             group.append(req)
         if not group:
             return
@@ -746,7 +784,16 @@ class ContinuousBatchingEngine:
         freed = []
         for i, pg in enumerate(req.pages):
             ref = self._page_ref.get(pg, 0) - 1
-            self._page_ref[pg] = max(ref, 0)
+            if ref < 0:
+                # a page released more times than it was claimed is a
+                # double-release: silently clamping to zero masked the bug
+                # (ADVICE r5) — count it and fail loudly
+                _REF_UNDERFLOWS.inc()
+                raise RuntimeError(
+                    f"PagePool refcount underflow: page {pg} released by "
+                    f"request {req.rid} but holds no claim — double "
+                    "release (see serving_page_ref_underflows_total)")
+            self._page_ref[pg] = ref
             if ref > 0:
                 continue  # another live request still reads it
             if pg in self._cached_pages:
@@ -776,15 +823,16 @@ class ContinuousBatchingEngine:
         return shared
 
     def _swap_stage(self, snap_shape, dtype):
-        """Reusable host staging pair at the fixed [L, Hkv, P, page, D]
-        scatter shape (jax copies numpy args into XLA buffers at dispatch,
-        so reuse across restores cannot race the transfer)."""
+        """FRESH host staging pair per restore at the fixed
+        [L, Hkv, P, page, D] scatter shape. A reused buffer is unsound:
+        on backends that zero-copy host arrays into the program
+        (jax CPU aliases numpy memory instead of copying at dispatch),
+        overwriting the staging pair for restore N+1 races the still
+        in-flight transfer of restore N. Fresh arrays make each restore's
+        payload immutable for the lifetime of its dispatch; allocation
+        cost is noise next to the h2d transfer itself."""
         shape = snap_shape[:2] + (self.pages_per_seq,) + snap_shape[3:]
-        st = self._swap_staging
-        if st is None or st[0].shape != shape or st[0].dtype != dtype:
-            st = (np.empty(shape, dtype), np.empty(shape, dtype))
-            self._swap_staging = st
-        return st
+        return (np.empty(shape, dtype), np.empty(shape, dtype))
 
     def _preempt(self, slot_idx):
         """Evict a running request and requeue it at the FRONT of the
@@ -830,6 +878,7 @@ class ContinuousBatchingEngine:
         self._slots[slot_idx] = None
         self._waiting.appendleft(r)
         self.preemptions += 1
+        _PREEMPTIONS.inc(labels=(self.preempt_policy,))
 
     def _grow_pages(self):
         """Ensure every decoding slot owns pages for this tick's token.
@@ -872,6 +921,7 @@ class ContinuousBatchingEngine:
             self._preempt(victim[0])
 
     def _retire(self, req: _Request):
+        _REQ_LATENCY.observe(time.perf_counter() - req.submit_t)
         self._release_pages(req, register=True)
         return req.prompt + req.generated
 
@@ -895,6 +945,14 @@ class ContinuousBatchingEngine:
         self._grow_pages()
         live = [(i, r) for i, r in enumerate(self._slots)
                 if r is not None and r.generated and r.length > 0]
+        if _TELEMETRY_REG.enabled:
+            _STEPS.inc()
+            _QUEUE_DEPTH.set(len(self._waiting))
+            occupied = sum(1 for s in self._slots if s is not None)
+            _SLOTS_OCCUPIED.set(occupied)
+            _KV_UTIL.set(1.0 - self.pool.available / self.pool.num_pages)
+            if live:
+                _BATCH_OCCUPANCY.observe(len(live) / self.max_slots)
         if not live:
             return newly
         # fixed-width batch: pad with slot 0's state (results discarded)

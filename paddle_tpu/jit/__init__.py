@@ -19,8 +19,16 @@ import jax.numpy as jnp
 from jax import tree_util
 
 from .. import framework
+from .. import telemetry as _telemetry
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
+
+_TRAIN_STEP_SECONDS = _telemetry.histogram(
+    "train_step_seconds",
+    "TrainStep dispatch wall time (async under jit: device sync excluded)",
+    labelnames=("model",))
+_TRAIN_STEPS = _telemetry.counter(
+    "train_steps_total", "TrainStep invocations", labelnames=("model",))
 
 
 def _wrap_arrays(tree):
@@ -234,6 +242,13 @@ class StaticFunction:
         if key not in self._compiled:
             layer = self._layer
             fn = self._fn
+            # jit-cache miss: every new (training, shapes, guards) key is
+            # a fresh trace+compile — feed the recompile watchdog with the
+            # function identity and the signature it missed on
+            target = fn if fn is not None else layer
+            _telemetry.record_compile(
+                getattr(target, "__qualname__", None)
+                or type(target).__name__, key)
 
             if layer is not None:
                 def pure(state, key_arr, args, kwargs):
@@ -415,6 +430,11 @@ class TrainStep:
 
     def _build(self):
         model, train_fn, opt = self.model, self.train_fn, self.optimizer
+        from ..utils.flags import get_flags as _gf
+
+        _telemetry.record_compile(
+            f"TrainStep[{type(self.model).__name__}]",
+            ("build", bool(_gf("check_nan_inf")["check_nan_inf"])))
         entries = model.state_dict()
         from ..core.tensor import Parameter
 
@@ -481,6 +501,12 @@ class TrainStep:
             self._compiled = jax.jit(step, donate_argnums=(0, 2))
 
     def __call__(self, *batch):
+        model_label = (type(self.model).__name__,)
+        _TRAIN_STEPS.inc(labels=model_label)
+        with _telemetry.timer(_TRAIN_STEP_SECONDS, labels=model_label):
+            return self._call_impl(*batch)
+
+    def _call_impl(self, *batch):
         from ..utils.flags import get_flags
 
         want_check = bool(get_flags("check_nan_inf")["check_nan_inf"])

@@ -26,12 +26,17 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
+# f32-typed constants: weak python floats promote to f64 under x64 on
+# old-jax interpret-mode lowering, which rejects the mixed-width where()
+NEG_INF = np.float32(-1e30)
+ONE_F32 = np.float32(1.0)
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
@@ -72,7 +77,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
     @pl.when(j == nk - 1)
     def _():
         l = l_scr[:, 0:1]
-        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, ONE_F32, l)).astype(o_ref.dtype)
 
 
 def decode_attention(q, k_cache, v_cache, lengths, *, scale=None,
@@ -169,7 +174,7 @@ def _paged_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j == npages - 1)
     def _():
         l = l_scr[:, 0:1]
-        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, ONE_F32, l)).astype(o_ref.dtype)
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
@@ -194,8 +199,11 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
 
     def _page_index(bi, h, j, tables, lens):
         # clamp so garbage table entries past `lengths` stay in-bounds
+        # (i32 bounds: python-int literals weak-type to i64 under x64 and
+        # old-jax lowering rejects the mixed-width clip call)
         t = tables[bi, j]
-        return (h, jnp.clip(t, 0, num_pages - 1), 0, 0)
+        return (h, jnp.clip(t, jnp.int32(0), jnp.int32(num_pages - 1)),
+                0, 0)
 
     qg = q.reshape(b, hkv, rep, d)
     kern = functools.partial(_paged_kernel, scale=scale, page=page,

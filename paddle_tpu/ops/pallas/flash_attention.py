@@ -25,13 +25,18 @@ from __future__ import annotations
 import functools
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() exact zero
-                 # without nan from (-inf) - (-inf) in the rescale path
+# f32-typed constants: weak python floats promote to f64 under x64 on
+# old-jax interpret-mode lowering, which rejects the mixed-width where()
+NEG_INF = np.float32(-1e30)  # large-negative instead of -inf: keeps exp()
+                 # exact zero without nan from (-inf) - (-inf) in rescale
+ONE_F32 = np.float32(1.0)
 
 
 def _block_for(s: int, env="PTPU_FA_BLOCK", default=1024):
@@ -131,9 +136,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
     @pl.when(ki == nk - 1)
     def _():
         l = l_scr[:, 0:1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
+        l_safe = jnp.where(l == 0.0, ONE_F32, l)
         o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
-        lse_row = m_scr[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0]))
+        lse_row = m_scr[:, 0] + jnp.log(
+            jnp.where(l[:, 0] == 0.0, ONE_F32, l[:, 0]))
         # [8, bq] sublane-padded block: Mosaic needs >=8 sublanes per block
         lse_ref[0] = jnp.broadcast_to(lse_row[None, :], (8, lse_row.shape[0]))
 

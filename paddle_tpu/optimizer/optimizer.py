@@ -14,8 +14,13 @@ import numpy as np
 import jax.numpy as jnp
 
 from .. import framework
+from .. import telemetry as _telemetry
 from ..core.tensor import Tensor, Parameter
 from .lr import LRScheduler
+
+_OPT_STEP_SECONDS = _telemetry.histogram(
+    "optimizer_step_seconds", "eager Optimizer.step wall time",
+    labelnames=("optimizer",))
 
 
 class Optimizer:
@@ -90,6 +95,11 @@ class Optimizer:
     # -- eager step --------------------------------------------------------
     @framework.no_grad()
     def step(self):
+        with _telemetry.timer(_OPT_STEP_SECONDS,
+                              labels=(type(self).__name__,)):
+            self._step_impl()
+
+    def _step_impl(self):
         params = self._parameter_list
         if params is None:
             raise RuntimeError("Optimizer created without parameters")

@@ -11,6 +11,16 @@ import enum
 import os
 import time
 
+from .. import telemetry as _telemetry
+
+# the Profiler is a thin client of the shared registry: step timings and
+# trace-window counts land next to the framework's own metrics so one
+# telemetry snapshot explains a run (docs/TELEMETRY.md)
+_PROF_STEP_SECONDS = _telemetry.histogram(
+    "profiler_step_seconds", "wall time between Profiler.step() calls")
+_PROF_TRACE_WINDOWS = _telemetry.counter(
+    "profiler_trace_windows_total", "device trace windows captured")
+
 
 class ProfilerState(enum.Enum):
     CLOSED = 0
@@ -94,6 +104,7 @@ class Profiler:
         now = time.perf_counter()
         if self._last_step_t is not None:
             self._step_times.append(now - self._last_step_t)
+            _PROF_STEP_SECONDS.observe(now - self._last_step_t)
         self._last_step_t = now
         self._step += 1
         self._transition()
@@ -130,6 +141,7 @@ class Profiler:
 
             jax.profiler.stop_trace()
             self._trace_written = True   # a trace from THIS session exists
+            _PROF_TRACE_WINDOWS.inc()
         except Exception:
             pass
         self._jax_active = False

@@ -1,0 +1,151 @@
+"""paddle_tpu.telemetry — framework-wide metrics and events.
+
+One process-local registry collects everything the runtime knows about
+itself: op-dispatch counts (core/dispatch), collective calls and bytes
+(distributed/communication), jit compile events and the recompile
+watchdog (jit, telemetry.watchdog), optimizer/train-step timing, and the
+serving engine's queue/occupancy/KV-page/latency metrics
+(inference/serving). ``paddle_tpu.profiler`` and ``paddle_tpu.api_tracer``
+are thin clients: their step timings and call counts land in the same
+registry, so one snapshot explains a run.
+
+Usage::
+
+    import paddle_tpu.telemetry as telemetry
+
+    telemetry.enable()
+    ...                               # run the workload
+    snap = telemetry.snapshot()       # JSON-able dict
+    print(telemetry.export_prometheus())
+    telemetry.dump_jsonl("metrics.jsonl")
+
+Disabled (the default) every instrument is a single attribute check;
+``enable()`` also arms the recompile watchdog and mirrors jax's own
+compile-duration events into the registry. The metric-name/label
+contract is documented in docs/TELEMETRY.md.
+"""
+from __future__ import annotations
+
+import time
+
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Metric,
+    MetricRegistry,
+    DEFAULT_BUCKETS,
+    DEFAULT_MAX_SERIES,
+)
+from . import export as _export
+from .watchdog import (  # noqa: F401
+    RecompileWarning,
+    RecompileWatchdog,
+    install_jax_compile_listener,
+)
+
+__all__ = [
+    "enable", "disable", "enabled", "snapshot", "reset",
+    "export_prometheus", "dump_jsonl", "load_jsonl",
+    "counter", "gauge", "histogram", "timer",
+    "get_registry", "recompile_watchdog", "record_compile",
+    "RecompileWarning", "MetricRegistry",
+]
+
+_REGISTRY = MetricRegistry()
+_WATCHDOG = RecompileWatchdog(_REGISTRY)
+
+
+def get_registry() -> MetricRegistry:
+    return _REGISTRY
+
+
+def enable():
+    """Turn collection on (idempotent). Also arms the jax compile-event
+    mirror the first time."""
+    _REGISTRY.enabled = True
+    install_jax_compile_listener(_REGISTRY)
+    return _REGISTRY
+
+
+def disable():
+    _REGISTRY.enabled = False
+    return _REGISTRY
+
+
+def enabled() -> bool:
+    return _REGISTRY.enabled
+
+
+def reset():
+    """Zero every series and the watchdog's signature history."""
+    _REGISTRY.reset()
+    _WATCHDOG.reset()
+
+
+def snapshot() -> dict:
+    return _REGISTRY.snapshot()
+
+
+def export_prometheus(path=None) -> str:
+    text = _export.export_prometheus(_REGISTRY)
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def dump_jsonl(path, mode="a", extra=None) -> int:
+    return _export.dump_jsonl(_REGISTRY, path, mode=mode, extra=extra)
+
+
+def load_jsonl(path):
+    return _export.load_jsonl(path)
+
+
+def counter(name, help="", labelnames=(), **kw) -> Counter:
+    return _REGISTRY.counter(name, help, labelnames, **kw)
+
+
+def gauge(name, help="", labelnames=(), **kw) -> Gauge:
+    return _REGISTRY.gauge(name, help, labelnames, **kw)
+
+
+def histogram(name, help="", labelnames=(), **kw) -> Histogram:
+    return _REGISTRY.histogram(name, help, labelnames, **kw)
+
+
+def recompile_watchdog() -> RecompileWatchdog:
+    return _WATCHDOG
+
+
+def record_compile(fn_name, signature):
+    """Report a jit-cache miss to the recompile watchdog."""
+    _WATCHDOG.record(fn_name, signature)
+
+
+class timer:
+    """Context manager observing elapsed seconds into a histogram::
+
+        with telemetry.timer(step_hist, labels=("train",)):
+            run_step()
+
+    A no-op (no clock reads) while telemetry is disabled."""
+
+    __slots__ = ("_hist", "_labels", "_t0")
+
+    def __init__(self, hist: Histogram, labels=()):
+        self._hist = hist
+        self._labels = labels
+        self._t0 = None
+
+    def __enter__(self):
+        if _REGISTRY.enabled:
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            self._hist.observe(time.perf_counter() - self._t0,
+                               labels=self._labels)
+        return False

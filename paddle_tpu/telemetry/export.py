@@ -1,0 +1,95 @@
+"""Prometheus-text and JSONL exporters over a MetricRegistry.
+
+Both exporters read live metric objects (not a snapshot) so bucket
+layouts are exact; both are pure stdlib.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+def _escape_label_value(v):
+    """Prometheus exposition escaping: backslash, double-quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(labelnames, labels, extra=()):
+    pairs = [f'{k}="{_escape_label_value(v)}"'
+             for k, v in zip(labelnames, labels)]
+    pairs.extend(f'{k}="{v}"' for k, v in extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _fmt_bucket_bound(b):
+    return repr(float(b))
+
+
+def export_prometheus(registry) -> str:
+    """Render every series in the Prometheus text exposition format."""
+    lines = []
+    for m in registry.metrics():
+        series = m.series()
+        if not series:
+            continue
+        if m.help:
+            lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        for labels, value in sorted(series.items()):
+            if m.kind == "histogram":
+                cum = 0
+                for bound, count in zip(
+                        m.buckets,
+                        (value["buckets"][repr(b)] for b in m.buckets)):
+                    cum += count
+                    lbl = _fmt_labels(m.labelnames, labels,
+                                      [("le", _fmt_bucket_bound(bound))])
+                    lines.append(f"{m.name}_bucket{lbl} {cum}")
+                lbl = _fmt_labels(m.labelnames, labels, [("le", "+Inf")])
+                lines.append(f"{m.name}_bucket{lbl} {value['count']}")
+                base = _fmt_labels(m.labelnames, labels)
+                lines.append(f"{m.name}_sum{base} {value['sum']}")
+                lines.append(f"{m.name}_count{base} {value['count']}")
+            else:
+                lbl = _fmt_labels(m.labelnames, labels)
+                lines.append(f"{m.name}{lbl} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def dump_jsonl(registry, path, mode="a", extra=None) -> int:
+    """Append one JSON line per live series to `path`.
+
+    Line shape: {"ts", "metric", "kind", "labels": {name: value}, and
+    either "value" (counter/gauge) or the histogram stats dict}. Returns
+    the number of lines written. `extra` (a dict) is merged into every
+    line — callers tag runs (bench round, step number) that way."""
+    ts = time.time()
+    n = 0
+    with open(path, mode) as f:
+        for m in registry.metrics():
+            for labels, value in sorted(m.series().items()):
+                rec = {"ts": ts, "metric": m.name, "kind": m.kind,
+                       "labels": dict(zip(m.labelnames, labels))}
+                if m.kind == "histogram":
+                    rec.update({k: v for k, v in value.items()
+                                if k != "buckets"})
+                    rec["buckets"] = value["buckets"]
+                else:
+                    rec["value"] = value
+                if extra:
+                    rec.update(extra)
+                f.write(json.dumps(rec) + "\n")
+                n += 1
+    return n
+
+
+def load_jsonl(path):
+    """Parse a dump_jsonl file back into a list of record dicts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,378 @@
+"""Telemetry registry, exporters, instrumentation, recompile watchdog."""
+import json
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.telemetry as telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Each test starts zeroed and leaves collection off."""
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+def test_counter_gauge_histogram_semantics():
+    c = telemetry.counter("t_requests_total", "test", labelnames=("route",))
+    c.inc(labels=("a",))
+    c.inc(2, labels=("a",))
+    c.inc(labels=("b",))
+    assert c.value(labels=("a",)) == 3
+    assert c.value(labels=("b",)) == 1
+    assert c.value(labels=("missing",)) == 0
+
+    g = telemetry.gauge("t_depth")
+    g.set(7)
+    g.inc(3)
+    g.dec()
+    assert g.value() == 9
+
+    h = telemetry.histogram("t_latency_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5):
+        h.observe(v)
+    snap = h.series()[()]
+    assert snap["count"] == 4
+    np.testing.assert_allclose(snap["sum"], 0.605)
+    assert snap["min"] == 0.005 and snap["max"] == 0.5
+    assert snap["buckets"]["0.01"] == 1
+    assert snap["buckets"]["0.1"] == 2
+    assert snap["buckets"]["1.0"] == 1
+    # quantiles are bucket-interpolated and must be ordered and bounded
+    assert snap["min"] <= snap["p50"] <= snap["p95"] <= snap["p99"] <= snap["max"]
+
+
+def test_metric_registration_conflicts():
+    telemetry.counter("t_conflict", labelnames=("x",))
+    # same name+kind+labels returns the same object
+    again = telemetry.counter("t_conflict", labelnames=("x",))
+    assert again is telemetry.get_registry().get("t_conflict")
+    with pytest.raises(ValueError):
+        telemetry.gauge("t_conflict")
+    with pytest.raises(ValueError):
+        telemetry.counter("t_conflict", labelnames=("y",))
+
+
+def test_label_cardinality_cap():
+    c = telemetry.counter("t_capped", labelnames=("k",), max_series=4)
+    for i in range(10):
+        c.inc(labels=(f"v{i}",))
+    assert len(c.series()) == 4
+    snap = telemetry.snapshot()
+    # overflow is visible, not silent
+    assert snap["dropped_series"]["t_capped"] == 6
+
+
+def test_disabled_mode_records_nothing():
+    c = telemetry.counter("t_off_counter")
+    h = telemetry.histogram("t_off_hist")
+    telemetry.disable()
+    c.inc()
+    h.observe(1.0)
+    with telemetry.timer(h):
+        pass
+    assert c.value() == 0
+    assert h.series() == {}
+    telemetry.enable()
+    c.inc()
+    assert c.value() == 1
+
+
+def test_thread_safety_under_contention():
+    c = telemetry.counter("t_mt", labelnames=("w",))
+
+    def work(tag):
+        for _ in range(500):
+            c.inc(labels=(tag,))
+
+    threads = [threading.Thread(target=work, args=(f"w{i % 2}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(labels=("w0",)) + c.value(labels=("w1",)) == 2000
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+def test_prometheus_export_format():
+    c = telemetry.counter("t_prom_total", "help text", labelnames=("op",))
+    c.inc(5, labels=("mul",))
+    h = telemetry.histogram("t_prom_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = telemetry.export_prometheus()
+    assert "# TYPE t_prom_total counter" in text
+    assert 't_prom_total{op="mul"} 5' in text
+    assert "# TYPE t_prom_seconds histogram" in text
+    # cumulative buckets + +Inf + sum/count
+    assert 't_prom_seconds_bucket{le="0.1"} 1' in text
+    assert 't_prom_seconds_bucket{le="1.0"} 2' in text
+    assert 't_prom_seconds_bucket{le="+Inf"} 2' in text
+    assert "t_prom_seconds_count 2" in text
+
+
+def test_jsonl_export_round_trip(tmp_path):
+    c = telemetry.counter("t_jsonl_total", labelnames=("op",))
+    c.inc(3, labels=("add",))
+    h = telemetry.histogram("t_jsonl_seconds", buckets=(1.0,))
+    h.observe(0.25)
+    path = str(tmp_path / "metrics.jsonl")
+    n = telemetry.dump_jsonl(path, extra={"round": 6})
+    assert n == 2
+    records = telemetry.load_jsonl(path)
+    by_name = {r["metric"]: r for r in records}
+    assert by_name["t_jsonl_total"]["value"] == 3
+    assert by_name["t_jsonl_total"]["labels"] == {"op": "add"}
+    assert by_name["t_jsonl_total"]["round"] == 6
+    hr = by_name["t_jsonl_seconds"]
+    assert hr["count"] == 1 and hr["sum"] == 0.25
+    # appending a second dump keeps prior lines (JSONL contract)
+    telemetry.dump_jsonl(path)
+    assert len(telemetry.load_jsonl(path)) == 4
+
+
+def test_snapshot_is_json_serializable():
+    telemetry.counter("t_snap", labelnames=("a",)).inc(labels=("x",))
+    telemetry.histogram("t_snap_h").observe(0.1)
+    telemetry.gauge("t_snap_g").set(2)
+    snap = telemetry.snapshot()
+    again = json.loads(json.dumps(snap))
+    assert again["counters"]["t_snap"]["a=x"] == 1
+    assert again["histograms"]["t_snap_h"][""]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# framework instrumentation
+# ---------------------------------------------------------------------------
+def test_op_dispatch_counter():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    _ = paddle.matmul(x, x).numpy()
+    snap = telemetry.snapshot()
+    assert snap["counters"]["op_dispatch_total"].get("op=matmul", 0) >= 1
+
+
+def test_collective_call_and_byte_counters():
+    import paddle_tpu.distributed as dist
+
+    t = paddle.to_tensor(np.ones(16, np.float32))
+    dist.all_reduce(t)
+    parts = []
+    dist.all_gather(parts, t)
+    snap = telemetry.snapshot()
+    calls = snap["counters"]["collective_calls_total"]
+    assert any(k.startswith("op=all_reduce") for k in calls)
+    assert any(k.startswith("op=all_gather") for k in calls)
+    bytes_ = snap["counters"]["collective_bytes_total"]
+    ar_key = next(k for k in bytes_ if k.startswith("op=all_reduce"))
+    assert bytes_[ar_key] == 64  # 16 * float32
+
+
+def _tiny_serving_model():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=128,
+                      dropout=0.0)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def test_serving_metrics():
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    model = _tiny_serving_model()
+    eng = ContinuousBatchingEngine(model, max_slots=2, page_size=16,
+                                   max_new_tokens=4)
+    eng.submit([1, 2, 3])
+    eng.submit([4, 5])
+    done = eng.run_until_complete()
+    assert len(done) == 2
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving_admissions_total"]["kind=prefill"] == 2
+    assert snap["counters"]["serving_steps_total"][""] >= 4
+    lat = snap["histograms"]["serving_request_latency_seconds"][""]
+    assert lat["count"] == 2 and lat["p99"] >= lat["p50"] > 0
+    ttft = snap["histograms"]["serving_ttft_seconds"][""]
+    assert ttft["count"] == 2
+    assert snap["gauges"]["serving_kv_page_utilization"][""] >= 0
+
+
+def test_release_pages_underflow_fails_loudly():
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    model = _tiny_serving_model()
+    eng = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
+                                   max_new_tokens=2, prefill_chunk=8,
+                                   enable_prefix_cache=True)
+    eng.submit(list(range(1, 10)))
+    done = eng.run_until_complete()
+    (full,) = done.values()
+    # forge a double release: a request claiming a page it no longer owns
+    req = type("R", (), {})()
+    req.rid = 99
+    req.pages = [0]
+    req.admit_seq = 0
+    req.length = 0
+    req.prefill_pos = 0
+    req.prompt, req.generated = [], []
+    eng._page_ref[0] = 0  # page 0 has no outstanding claim
+    with pytest.raises(RuntimeError, match="underflow"):
+        eng._release_pages(req, register=False)
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving_page_ref_underflows_total"][""] == 1
+
+
+def test_optimizer_step_timing():
+    lin = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    snap = telemetry.snapshot()
+    h = snap["histograms"]["optimizer_step_seconds"]["optimizer=SGD"]
+    assert h["count"] == 1 and h["sum"] > 0
+
+
+def test_profiler_feeds_registry():
+    from paddle_tpu.profiler import Profiler
+
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        p.step()
+    p.stop()
+    snap = telemetry.snapshot()
+    assert snap["histograms"]["profiler_step_seconds"][""]["count"] == 3
+
+
+def test_api_tracer_feeds_registry(tmp_path):
+    from paddle_tpu import api_tracer
+
+    calls = api_tracer.start_api_tracer(str(tmp_path / "trace.json"))
+
+    @api_tracer.api_tracer
+    def public_api():
+        return 1
+
+    public_api()
+    public_api()
+    snap = telemetry.snapshot()
+    series = snap["counters"]["api_calls_total"]
+    key = next(k for k in series if "public_api" in k)
+    assert series[key] == 2
+    assert any("public_api" in k for k in calls)
+
+
+# ---------------------------------------------------------------------------
+# recompile watchdog
+# ---------------------------------------------------------------------------
+def test_recompile_watchdog_warns_on_shape_churn():
+    wd = telemetry.recompile_watchdog()
+    old_threshold = wd.threshold
+    wd.configure(3)
+    try:
+        @paddle.jit.to_static
+        def f(a):
+            return a * 2 + 1
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            # each new shape is a jit-cache miss -> a distinct program
+            for n in (2, 3, 4, 5):
+                _ = f(paddle.to_tensor(np.zeros((n,), np.float32)))
+        msgs = [w for w in caught
+                if issubclass(w.category, telemetry.RecompileWarning)]
+        assert len(msgs) == 1, "watchdog must warn exactly once per function"
+        text = str(msgs[0].message)
+        assert ".f" in text and "3 distinct programs" in text
+        # the function label is the qualname (test_....<locals>.f)
+        snap = telemetry.snapshot()
+        series = snap["counters"]["jit_recompiles_total"]
+        key = next(k for k in series if k.endswith(".f"))
+        assert series[key] == 4
+        stats = wd.stats()
+        assert stats[next(k for k in stats if k.endswith(".f"))] == 4
+    finally:
+        wd.configure(old_threshold)
+
+
+def test_watchdog_stable_shapes_do_not_warn():
+    wd = telemetry.recompile_watchdog()
+    old_threshold = wd.threshold
+    wd.configure(2)
+    try:
+        @paddle.jit.to_static
+        def g(a):
+            return a + 1
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for _ in range(6):  # same shape: ONE compile, five cache hits
+                _ = g(paddle.to_tensor(np.zeros((3,), np.float32)))
+        assert not [w for w in caught
+                    if issubclass(w.category, telemetry.RecompileWarning)]
+        stats = wd.stats()
+        assert stats[next(k for k in stats if k.endswith(".g"))] == 1
+    finally:
+        wd.configure(old_threshold)
+
+
+def test_watchdog_disabled_mode():
+    telemetry.disable()
+    telemetry.record_compile("h", ("sig", 1))
+    telemetry.record_compile("h", ("sig", 2))
+    assert telemetry.recompile_watchdog().stats().get("h", 0) == 0
+    telemetry.enable()
+
+
+# ---------------------------------------------------------------------------
+# tools/telemetry_report.py
+# ---------------------------------------------------------------------------
+def test_telemetry_report_print_and_diff(tmp_path, capsys):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    telemetry.counter("t_rep_total", labelnames=("op",)).inc(2, labels=("a",))
+    telemetry.histogram("t_rep_seconds").observe(0.1)
+    old = str(tmp_path / "old.json")
+    with open(old, "w") as f:
+        json.dump({"telemetry": telemetry.snapshot()}, f)
+    telemetry.counter("t_rep_total", labelnames=("op",)).inc(6, labels=("a",))
+    for _ in range(3):
+        telemetry.histogram("t_rep_seconds").observe(0.4)
+    new = str(tmp_path / "new.json")
+    with open(new, "w") as f:
+        json.dump({"telemetry": telemetry.snapshot()}, f)
+
+    assert mod.main([old]) == 0
+    out = capsys.readouterr().out
+    assert "t_rep_total{op=a}: 2" in out
+
+    rows = mod.diff_snapshots(mod.load_snapshot(old),
+                              mod.load_snapshot(new), top=5)
+    out = capsys.readouterr().out
+    assert "t_rep_seconds" in out and "t_rep_total" in out
+    # the histogram mean regressed 0.1 -> 0.325: must rank as a regression
+    assert any(r[2] == "t_rep_seconds" and r[0] > 0 for r in rows)

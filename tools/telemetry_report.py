@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Pretty-print a telemetry snapshot, or diff two bench telemetry blocks.
+
+Usage:
+    python tools/telemetry_report.py RUN.json
+    python tools/telemetry_report.py OLD.json NEW.json [--top N]
+
+Accepts either a raw ``paddle_tpu.telemetry.snapshot()`` dict or a bench
+JSON record carrying the snapshot under its ``"telemetry"`` key
+(BENCH_r*.json rounds). The diff mode ranks the top-N regressed metrics —
+histogram series by mean-time increase, counters by relative growth — so
+"why is this round slower" starts from data instead of a re-profile.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _is_snapshot(d):
+    return isinstance(d, dict) and any(
+        k in d for k in ("counters", "gauges", "histograms"))
+
+
+def _scan_lines(text):
+    """LAST JSON-object line carrying telemetry (bench stdout prints log
+    lines and, on TPU, TWO metric lines — the headline one is last)."""
+    best = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and ("telemetry" in d or _is_snapshot(d)):
+            best = d
+    return best
+
+
+def _extract(data):
+    """Pull the snapshot out of any of the shapes we meet in the wild:
+    a raw snapshot, a bench JSON line ({"metric", ..., "telemetry"}), or
+    a BENCH_r*.json round record ({"n", "cmd", "tail", "parsed"})."""
+    if not isinstance(data, dict):
+        return None
+    if _is_snapshot(data):
+        return data
+    if _is_snapshot(data.get("telemetry")):
+        return data["telemetry"]
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and _is_snapshot(parsed.get("telemetry")):
+        return parsed["telemetry"]
+    tail = data.get("tail")
+    if isinstance(tail, str):
+        return _extract(_scan_lines(tail))
+    return None
+
+
+def load_snapshot(path):
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        # stdout capture: log lines + one JSON record per bench model
+        data = _scan_lines(text)
+        if data is None:
+            raise ValueError(f"{path}: no JSON object found")
+    snap = _extract(data)
+    if snap is None:
+        raise ValueError(
+            f"{path}: no telemetry snapshot found (expected 'counters'/"
+            "'gauges'/'histograms' keys, a bench JSON line with a "
+            "'telemetry' block, or a BENCH_r*.json round record)")
+    return snap
+
+
+def _hist_line(name, labels, h):
+    lbl = f"{{{labels}}}" if labels else ""
+    return (f"  {name}{lbl}: n={h['count']} mean={h['mean']:.6f}s "
+            f"p50={h['p50']:.6f} p95={h['p95']:.6f} p99={h['p99']:.6f} "
+            f"max={h['max']:.6f}")
+
+
+def print_snapshot(snap, out=sys.stdout):
+    w = out.write
+    for kind in ("counters", "gauges"):
+        group = snap.get(kind) or {}
+        if group:
+            w(f"-- {kind} --\n")
+            for name in sorted(group):
+                series = group[name]
+                for labels, v in sorted(series.items(),
+                                        key=lambda kv: -_num(kv[1])):
+                    lbl = f"{{{labels}}}" if labels else ""
+                    w(f"  {name}{lbl}: {v}\n")
+    hists = snap.get("histograms") or {}
+    if hists:
+        w("-- histograms --\n")
+        for name in sorted(hists):
+            for labels, h in sorted(hists[name].items()):
+                w(_hist_line(name, labels, h) + "\n")
+    dropped = snap.get("dropped_series")
+    if dropped:
+        w(f"-- dropped series (label-cardinality cap) --\n")
+        for name, n in sorted(dropped.items()):
+            w(f"  {name}: {n}\n")
+
+
+def _num(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def diff_snapshots(old, new, top=15, out=sys.stdout):
+    """Rank series by regression: histogram relative mean growth and
+    counter relative growth. Series absent from the old snapshot rank at
+    0 (flagged "new series") so they cannot crowd real regressions out
+    of the top-N window."""
+    rows = []
+    old_h = old.get("histograms") or {}
+    for name, series in (new.get("histograms") or {}).items():
+        for labels, h in series.items():
+            prev = (old_h.get(name) or {}).get(labels)
+            if not prev or not prev["count"] or not h["count"]:
+                continue
+            delta = h["mean"] - prev["mean"]
+            rel = delta / prev["mean"] if prev["mean"] else 0.0
+            rows.append((rel, "hist", name, labels,
+                         f"mean {prev['mean']:.6f}s -> {h['mean']:.6f}s "
+                         f"({rel:+.1%}), p99 {prev['p99']:.6f} -> "
+                         f"{h['p99']:.6f}"))
+    old_c = old.get("counters") or {}
+    for name, series in (new.get("counters") or {}).items():
+        for labels, v in series.items():
+            pv = _num((old_c.get(name) or {}).get(labels, 0))
+            nv = _num(v)
+            if pv == 0 and nv == 0:
+                continue
+            rel = (nv - pv) / pv if pv else 0.0
+            tag = "new series" if pv == 0 else format(rel, "+.1%")
+            rows.append((rel, "counter", name, labels,
+                         f"{pv:g} -> {nv:g} ({tag})"))
+    rows.sort(key=lambda r: -r[0])
+    out.write(f"top {top} regressed metrics (new vs old):\n")
+    for rel, kind, name, labels, desc in rows[:top]:
+        lbl = f"{{{labels}}}" if labels else ""
+        out.write(f"  [{kind}] {name}{lbl}: {desc}\n")
+    if not rows:
+        out.write("  (no comparable series)\n")
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("snapshot", help="telemetry snapshot or bench JSON")
+    ap.add_argument("other", nargs="?",
+                    help="second snapshot: diff mode (old=first, new=second)")
+    ap.add_argument("--top", type=int, default=15,
+                    help="diff mode: how many regressed metrics to show")
+    args = ap.parse_args(argv)
+    if args.other is None:
+        print_snapshot(load_snapshot(args.snapshot))
+    else:
+        diff_snapshots(load_snapshot(args.snapshot),
+                       load_snapshot(args.other), top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
