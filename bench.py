@@ -1,9 +1,12 @@
 """Headline benchmark: decoder-only (GPT/LLaMA-style) pretrain throughput.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference publishes no absolute numbers (BASELINE.md), so vs_baseline
-reports achieved model FLOPs utilisation (MFU) against the chip peak —
-a hardware-normalised stand-in the driver can track across rounds.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"memory", "telemetry"}. The reference publishes no absolute numbers
+(BASELINE.md), so vs_baseline reports achieved model FLOPs utilisation
+(MFU) against the chip peak — a hardware-normalised stand-in the driver
+can track across rounds. "memory" is the batch/remat planner decision +
+XLA peak bytes (docs/MEMORY.md); "telemetry" the runtime metric snapshot
+(docs/TELEMETRY.md).
 """
 from __future__ import annotations
 
@@ -35,15 +38,10 @@ def run_model(model_kind):
     if on_tpu:
         # Tuned defaults (measured on v5e; r3 sweep + r4 sweep):
         # - Pallas rms kernel with saved rstd residual (+3.1% MFU, r3)
-        # - selective remat keeping post-rope q/k/v + the post-attention
-        #   residual: the backward re-runs only the gate/up matmuls
-        #   (0.5269 vs 0.5074 at the old "attn" policy, r3)
-        # - batch 4 (b6 can't afford the q/k/v saves; b5 OOMs)
         # - int8 weight-only LM head (+0.8-1.1%, r4; parity test bounds
         #   the loss shift <2%, tests/test_incubate_functional.py)
         # - flash fwd block 2048 (+0.6%, r4; bwd stays 1024 — uniform
         #   2048 bwd compile-OOMs, decoupled q/k blocks measured worse)
-        # Env overrides let perf sweeps reuse this exact harness.
         os.environ.setdefault("PTPU_PALLAS_RMS", "1")
         os.environ.setdefault("PTPU_INT8_HEAD", "1")
         os.environ.setdefault("PTPU_FA_BLOCK", "2048")
@@ -53,10 +51,6 @@ def run_model(model_kind):
         # sweeps): GPT 0.5468 -> 0.5629, LLaMA 0.5806 -> 0.638.
         # bwd-block-2048 stays dead (scoped-VMEM OOM, not HBM).
         os.environ.setdefault("PTPU_ADAM_FACTORED", "1")
-        policy = os.environ.get(
-            "PTPU_BENCH_REMAT",
-            "names:attn_res,attn_lse,attn_q,attn_k,attn_v,resid_mid,"
-            "rms_rstd,ffn_gate,ffn_up")
         if model_kind == "llama":
             # BASELINE.md config-5 variant: LLaMA-7B architecture
             # (h=4096, GQA, swiglu, rope) depth-scaled to 8 layers so
@@ -65,22 +59,44 @@ def run_model(model_kind):
             cfg = GPTConfig(vocab_size=32000, hidden_size=4096,
                             num_layers=8, num_heads=32, num_kv_heads=8,
                             intermediate_size=11008, max_seq_len=2048,
-                            dropout=0.0, dtype="bfloat16", recompute=True,
-                            recompute_policy=policy)
-            batch = int(os.environ.get("PTPU_BENCH_BATCH", "3"))
+                            dropout=0.0, dtype="bfloat16", recompute=True)
         else:
             # GPT-3 1.3B (BASELINE.md config 4) — the headline metric
             cfg = GPTConfig(vocab_size=32000, hidden_size=2048,
                             num_layers=24, num_heads=16, max_seq_len=2048,
-                            dropout=0.0, dtype="bfloat16",
-                            recompute=policy != "none",
-                            recompute_policy=policy)
-            batch = int(os.environ.get("PTPU_BENCH_BATCH", "3"))
+                            dropout=0.0, dtype="bfloat16", recompute=True)
         seq, steps = 2048, 10
+        batch_grid = (3, 4, 5)
     else:  # smoke path for CPU dev runs
         cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=256, dropout=0.0)
-        batch, seq, steps = 2, 128, 3
+        seq, steps = 128, 3
+        batch_grid = (2,)
+
+    # batch/remat chosen by the memory planner (paddle_tpu.memory): each
+    # candidate is lowered+compiled unexecuted and priced by XLA's
+    # memory_analysis against the chip HBM budget — no more hand-set
+    # "b5 OOMs" caps. The grid pairs the r5 bf16 save list with int8
+    # activation-checkpointing variants (int8:<name> saves the residual
+    # blockwise-int8 at ~half the bytes, docs/MEMORY.md). Decisions are
+    # cached per (config, chip); PTPU_BENCH_BATCH / PTPU_BENCH_REMAT
+    # remain as overrides for perf sweeps (both set = planning skipped,
+    # the override is still priced + recorded in the JSON).
+    base_saves = "attn_res,attn_lse,attn_q,attn_k,attn_v,rms_rstd"
+    if on_tpu:
+        policy_grid = (
+            f"names:{base_saves},resid_mid,ffn_gate,ffn_up",      # r5 default
+            f"names:{base_saves},resid_mid,int8:ffn_gate,int8:ffn_up",
+            f"names:{base_saves},int8:resid_mid,int8:ffn_gate,int8:ffn_up",
+        )
+    else:
+        # CPU smoke pins the all-int8 policy so one tier-1 bench run
+        # exercises planner + quantized save/restore end to end
+        policy_grid = (
+            f"names:{base_saves},int8:resid_mid,int8:ffn_gate,int8:ffn_up",
+        )
+    env_batch = os.environ.get("PTPU_BENCH_BATCH")
+    env_remat = os.environ.get("PTPU_BENCH_REMAT")
 
     # stacked-decoder flagship: lax.scan over layers keeps compile time
     # constant in depth; recompute = jax.checkpoint per block
@@ -105,6 +121,62 @@ def run_model(model_kind):
         # fused chunked head+CE: full logits never materialize (models/gpt.py)
         return model.loss(ids, labels)
 
+    from paddle_tpu import memory as pmem
+
+    if env_batch and env_remat:
+        candidates = [pmem.Candidate(int(env_batch), env_remat)]
+        require_fit = False  # trust the sweep; still price + record it
+    else:
+        candidates = [
+            pmem.Candidate(b, p)
+            for b in ((int(env_batch),) if env_batch else batch_grid)
+            for p in ((env_remat,) if env_remat else policy_grid)
+        ]
+        require_fit = True
+
+    def step_factory(cand):
+        cfg.recompute = cand.policy != "none"
+        cfg.recompute_policy = cand.policy
+        s = TrainStep(model, train_fn, opt)
+        return s, (jax.ShapeDtypeStruct((cand.batch, seq), jax.numpy.int32),
+                   jax.ShapeDtypeStruct((cand.batch, seq), jax.numpy.int64))
+
+    def act_bytes(cand):
+        return pmem.estimate_stacked_activation_bytes(
+            cand.policy, num_layers=cfg.num_layers, batch=cand.batch,
+            seq=seq, hidden=cfg.hidden_size, num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            intermediate=cfg.intermediate_size,
+            act_bytes=2 if on_tpu else 4)
+
+    # cache key must carry every knob that changes the lowered program's
+    # memory profile — a decision priced under factored Adam reused for a
+    # full-moment sweep would hand back a config that OOMs (the exact
+    # failure class the planner exists to prevent)
+    mem_envs = tuple(
+        (k, os.environ.get(k, ""))
+        for k in ("PTPU_ADAM_FACTORED", "PTPU_ADAM8", "PTPU_INT8_HEAD",
+                  "PTPU_PALLAS_RMS", "PTPU_FUSED_ADDRMS", "PTPU_INT8_FFN",
+                  "PTPU_FA_BLOCK", "PTPU_FA_BWD_BLOCK",
+                  "PTPU_UNROLL_LAYERS", "PTPU_CE_CHUNK", "PTPU_ROPE_HOIST"))
+    decision = pmem.plan_train_step(
+        step_factory, candidates, require_fit=require_fit,
+        act_bytes_fn=act_bytes,
+        opt_state_bytes=opt.slot_nbytes(
+            {n: p._data for n, p in model.named_parameters()}),
+        cache_extra=(model_kind, cfg.vocab_size, cfg.hidden_size,
+                     cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+                     cfg.intermediate_size, seq,
+                     "bf16" if on_tpu else "f32", mem_envs))
+    batch = decision.batch
+    cfg.recompute = decision.policy != "none"
+    cfg.recompute_policy = decision.policy
+
+    # NOTE: on a plan-cache miss the winning program compiles twice (once
+    # AOT in the planner, once here at warmup — jit's dispatch cache is
+    # not fed by the AOT path). The disk cache makes every later run of
+    # the same config skip planning entirely, so the cost is first-run-
+    # per-config only.
     step = TrainStep(model, train_fn, opt)
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
@@ -161,6 +233,11 @@ def run_model(model_kind):
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu, 4),
+        # planner decision + XLA memory_analysis peak: a BENCH_r*.json
+        # regression explains its memory state the same way the
+        # "telemetry" key explains its time (tools/hbm_report.py diffs
+        # two rounds' blocks; contract in docs/MEMORY.md)
+        "memory": decision.as_json(),
         "telemetry": telemetry.snapshot(),
     }), flush=True)
 

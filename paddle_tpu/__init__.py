@@ -182,6 +182,7 @@ from . import utils  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import telemetry  # noqa: F401
+from . import memory  # noqa: F401
 from . import static  # noqa: F401
 from . import sparse  # noqa: F401
 from . import strings  # noqa: F401

@@ -120,7 +120,14 @@ class ShardedTrainStep(TrainStep):
     def _place_batch(self, raw_batch):
         placed = []
         for arr in raw_batch:
-            if hasattr(arr, "ndim") and arr.ndim >= 1:
+            if isinstance(arr, jax.ShapeDtypeStruct):
+                # planner path (aot_compile over avals): device_put would
+                # reject an abstract value — carry the same sharding a
+                # real batch would get so the lowered program matches
+                placed.append(jax.ShapeDtypeStruct(
+                    tuple(arr.shape), arr.dtype,
+                    sharding=_batch_spec(self.mesh, arr)))
+            elif hasattr(arr, "ndim") and arr.ndim >= 1:
                 placed.append(jax.device_put(arr, _batch_spec(self.mesh, arr)))
             else:
                 placed.append(arr)
@@ -141,6 +148,19 @@ class ShardedTrainStep(TrainStep):
 
     # -- step --------------------------------------------------------------
     def __call__(self, *batch):
+        # same instrumentation contract as TrainStep.__call__ (docs/
+        # TELEMETRY.md train_step_seconds/train_steps_total) — the
+        # override must not drop it for exactly the multi-chip runs
+        # where step timing matters most
+        from ..jit import _TRAIN_STEP_SECONDS, _TRAIN_STEPS
+        from .. import telemetry as _telemetry
+
+        model_label = (type(self.model).__name__,)
+        _TRAIN_STEPS.inc(labels=model_label)
+        with _telemetry.timer(_TRAIN_STEP_SECONDS, labels=model_label):
+            return self._sharded_call(*batch)
+
+    def _sharded_call(self, *batch):
         if not self._placed:
             self._place_model()
         first_state = self._opt_state is None

@@ -299,8 +299,25 @@ class ContinuousBatchingEngine:
         cached prefix KV is invalidated (it was computed under the old
         weights): ref-0 cached pages are freed now, in-use ones when
         their readers release them; requests already admitted are barred
-        from registering their (stale) pages."""
-        self._weights = self._pack_weights(model or self._model)
+        from registering their (stale) pages.
+
+        The old packed weights are released BEFORE repacking: with the
+        lazy per-layer slicing of the stacked models (gpt.py
+        _decode_params), a live-engine reload peaks at stacked + new
+        slices + one in-flight layer instead of holding old and new
+        sliced copies side by side (ADVICE r5). The release is what buys
+        the headroom, so a mid-pack failure cannot fall back to the old
+        weights — it raises loudly and the engine stays weightless until
+        a reload succeeds (serving on half-reloaded state would be
+        worse)."""
+        self._weights = None
+        try:
+            self._weights = self._pack_weights(model or self._model)
+        except Exception as e:
+            raise RuntimeError(
+                "reload_weights failed mid-pack; the old weights were "
+                "already released (HBM headroom), so the engine has no "
+                "weights until a reload_weights() succeeds") from e
         if self.enable_prefix_cache:
             for key in list(self._prefix_cache):
                 pg = self._prefix_cache.pop(key)

@@ -432,8 +432,13 @@ class TrainStep:
         model, train_fn, opt = self.model, self.train_fn, self.optimizer
         from ..utils.flags import get_flags as _gf
 
+        # planner-driven AOT builds are labeled apart from real training
+        # compiles: a cold-cache plan lowers up to a full candidate grid,
+        # which would false-positive the watchdog's ">1 recompile per
+        # function means shape churn" triage rule (docs/TELEMETRY.md)
         _telemetry.record_compile(
-            f"TrainStep[{type(self.model).__name__}]",
+            f"TrainStep[{type(self.model).__name__}]"
+            + ("[plan]" if getattr(self, "_planning", False) else ""),
             ("build", bool(_gf("check_nan_inf")["check_nan_inf"])))
         entries = model.state_dict()
         from ..core.tensor import Parameter
@@ -542,23 +547,57 @@ class TrainStep:
         self.optimizer._step_count += 1
         return Tensor(loss)
 
-    def memory_stats(self, *batch):
-        """XLA buffer-assignment stats for this step's program: dict of
-        argument/output/temp bytes (CompiledMemoryStats). Lowers and
-        compiles ahead-of-time — meant for small trial programs (the
-        auto_tuner's measure mode), not the training hot path."""
+    def aot_compile(self, *batch):
+        """Lower + compile this step WITHOUT executing it (the memory
+        planner's entry point, paddle_tpu.memory.plan_train_step):
+        returns the jax Compiled object, whose ``memory_analysis()``
+        prices the program's HBM before anything runs.
+
+        Every operand is passed as an aval (ShapeDtypeStruct) — params
+        and buffers from the live model's shapes, optimizer state via
+        ``eval_shape`` over ``functional_state`` — so candidate configs
+        can be compiled back to back without allocating a single device
+        buffer. ``batch`` may be Tensors, arrays, or ShapeDtypeStructs.
+        (ShardedTrainStep's ``_prepare_batch`` hook still places model +
+        opt state on the mesh so the lowered program matches a real
+        step's shardings — the zero-allocation guarantee is for the
+        single-program TrainStep the planner drives.)"""
         if self._compiled is None:
             self._build()
         raw_batch = self._prepare_batch(_unwrap_tensors(batch))
+
+        def aval(a):
+            # keep the array's sharding (ShardedTrainStep places batch/
+            # state with NamedShardings via _prepare_batch — the lowered
+            # program must see the same placements a real step would)
+            sh = getattr(a, "sharding", None)
+            if sh is not None:
+                return jax.ShapeDtypeStruct(tuple(a.shape),
+                                            jnp.dtype(a.dtype), sharding=sh)
+            return jax.ShapeDtypeStruct(tuple(a.shape), jnp.dtype(a.dtype))
+
         entries = self.model.state_dict()
-        params = {n: entries[n]._data for n in self._param_names}
-        buffers = {n: entries[n]._data for n in self._buffer_names}
-        opt_state = self._opt_state or self._init_opt_state(params)
+        params = {n: aval(entries[n]._data) for n in self._param_names}
+        buffers = {n: aval(entries[n]._data) for n in self._buffer_names}
+        if self._opt_state is not None:
+            opt_state = tree_util.tree_map(aval, self._opt_state)
+        else:
+            opt_state = jax.eval_shape(self.optimizer.functional_state,
+                                       params)
         lr = self.optimizer.get_lr()
-        key_arr = framework.next_rng_key()
-        ma = self._compiled.lower(
-            params, buffers, opt_state, lr, key_arr, raw_batch
-        ).compile().memory_analysis()
+        key_arr = aval(framework.next_rng_key())
+        batch_avals = tree_util.tree_map(aval, raw_batch)
+        return self._compiled.lower(
+            params, buffers, opt_state, lr, key_arr, batch_avals
+        ).compile()
+
+    def memory_stats(self, *batch):
+        """XLA buffer-assignment stats for this step's program: dict of
+        argument/output/temp bytes (CompiledMemoryStats). Lowers and
+        compiles ahead-of-time without executing (aot_compile) — meant
+        for small trial programs (the auto_tuner's measure mode) and the
+        memory planner, not the training hot path."""
+        ma = self.aot_compile(*batch).memory_analysis()
         return {
             "argument_bytes": int(ma.argument_size_in_bytes),
             "output_bytes": int(ma.output_size_in_bytes),

@@ -1,0 +1,46 @@
+"""paddle_tpu.memory — HBM-aware training memory management.
+
+Two halves (ISSUE 2, TPU-native extension — the reference's recompute
+pass offers only save-full vs re-run and its auto-tuner measures by
+RUNNING candidates; here XLA's buffer assignment prices them unexecuted):
+
+1. **Int8 activation checkpointing** (:mod:`.int8_ckpt`): blockwise-int8
+   save points for selective remat, exposed through the existing
+   ``recompute_policy`` name syntax as ``int8:<anchor>``.
+2. **Memory planner** (:mod:`.planner`): lowers (batch x remat-policy)
+   TrainStep candidates via ``lower().compile().memory_analysis()``
+   without executing them, picks the best throughput estimate that fits
+   the HBM budget, caches decisions per (config, chip), and records the
+   outcome in telemetry gauges + the bench JSON ``"memory"`` block.
+
+See docs/MEMORY.md for the policy syntax, knobs, and JSON contract.
+"""
+from .int8_ckpt import (  # noqa: F401
+    INT8_BLOCK,
+    KERNEL_ANCHORS,
+    dequantize_blockwise_int8,
+    int8_checkpoint,
+    int8_saved_nbytes,
+    parse_save_names,
+    quantize_blockwise_int8,
+)
+from .planner import (  # noqa: F401
+    Candidate,
+    MemoryPlanError,
+    PlanDecision,
+    chip_kind,
+    estimate_stacked_activation_bytes,
+    hbm_budget_bytes,
+    plan_train_step,
+    policy_coverage,
+    throughput_score,
+)
+
+__all__ = [
+    "INT8_BLOCK", "KERNEL_ANCHORS",
+    "quantize_blockwise_int8", "dequantize_blockwise_int8",
+    "int8_checkpoint", "int8_saved_nbytes", "parse_save_names",
+    "Candidate", "PlanDecision", "MemoryPlanError", "plan_train_step",
+    "hbm_budget_bytes", "chip_kind", "throughput_score", "policy_coverage",
+    "estimate_stacked_activation_bytes",
+]

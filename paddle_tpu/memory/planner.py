@@ -1,0 +1,361 @@
+"""XLA-memory-driven batch/remat auto-planner.
+
+Every round since r3 hand-tuned the bench batch and remat name list
+against OOMs ("b5 OOMs" comments in bench.py). But
+``jit(...).lower().compile().memory_analysis()`` tells us the exact HBM
+budget of any candidate (batch, remat-policy) TrainStep WITHOUT executing
+it — the same buffer-assignment numbers the XLA weight-update-sharding
+work (arXiv:2004.13336) converts into throughput. The planner lowers the
+candidate grid ahead of time, rejects configs whose peak exceeds the chip
+budget, and picks the best fit by a throughput estimate — so bench.py
+stops carrying hand-set caps and a chip upgrade re-plans itself.
+
+Planning cost is compile time (one AOT compile per candidate evaluated,
+highest-score first, stopping at the first fit); decisions are cached on
+disk keyed by (config hash, chip, device count, budget, grid), so only
+the first run per configuration pays.
+
+Knobs (docs/MEMORY.md):
+- ``PTPU_HBM_BUDGET``: override the per-chip budget (GB when < 1024,
+  bytes otherwise).
+- ``PTPU_PLAN_CACHE``: decision-cache path; ``0`` disables caching.
+
+Telemetry gauges set on every decision: ``hbm_peak_bytes``,
+``act_saved_bytes``, ``act_int8_bytes``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+from .. import telemetry as _telemetry
+from .int8_ckpt import int8_saved_nbytes, parse_save_names
+
+_HBM_PEAK = _telemetry.gauge(
+    "hbm_peak_bytes",
+    "planner-chosen train-step peak HBM (XLA buffer assignment: "
+    "argument + temp bytes)")
+_ACT_SAVED = _telemetry.gauge(
+    "act_saved_bytes",
+    "estimated bytes of remat-saved activations per step under the "
+    "chosen policy (all layers)")
+_ACT_INT8 = _telemetry.gauge(
+    "act_int8_bytes",
+    "estimated bytes of int8-saved activations (+fp32 scales) within "
+    "act_saved_bytes")
+_PLAN_EVALS = _telemetry.counter(
+    "memory_plan_lowerings_total",
+    "candidate TrainStep programs lowered+compiled by the planner",
+    labelnames=("outcome",))  # fit | over_budget | error | cache_hit
+
+
+class MemoryPlanError(RuntimeError):
+    """No candidate fits the HBM budget."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the batch x remat grid. ``score`` overrides the
+    default throughput estimate (higher = preferred)."""
+    batch: int
+    policy: str
+    score: float | None = None
+
+
+@dataclasses.dataclass
+class PlanDecision:
+    batch: int
+    policy: str
+    peak_bytes: int
+    budget_bytes: int
+    fits: bool
+    score: float
+    source: str          # "planner" | "cache" | "env-override"
+    chip: str
+    key: str
+    act_saved_bytes: int | None = None
+    act_int8_bytes: int | None = None
+    opt_state_bytes: int | None = None
+    candidates: list = dataclasses.field(default_factory=list)
+
+    def as_json(self):
+        """The bench JSON ``"memory"`` block (docs/MEMORY.md contract)."""
+        return dataclasses.asdict(self)
+
+
+# -- budget -----------------------------------------------------------------
+#: per-chip HBM when the backend doesn't report bytes_limit
+_CHIP_HBM = (("v5p", 95e9), ("v5 lite", 16e9), ("v5e", 16e9),
+             ("trillium", 32e9), ("v6", 32e9), ("v4", 32e9))
+
+
+def chip_kind():
+    import jax
+
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
+
+def hbm_budget_bytes(budget=None):
+    """Resolve the HBM budget: PTPU_HBM_BUDGET env (GB if < 1024, bytes
+    otherwise) > explicit arg > backend bytes_limit > chip table > 16GB."""
+    env = os.environ.get("PTPU_HBM_BUDGET")
+    if env:
+        v = float(env)
+        return int(v * 2**30) if v < 1024 else int(v)
+    if budget is not None:
+        return int(budget)
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        if stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    kind = chip_kind().lower()
+    for k, v in _CHIP_HBM:
+        if k in kind:
+            return int(v)
+    return int(16e9)
+
+
+# -- throughput estimate ----------------------------------------------------
+# Fraction of one decoder block's forward FLOPs the backward replay SKIPS
+# when the anchor is saved (models/gpt.py _block_pure tags). Heuristic
+# weights fit to the r3-r5 sweeps (attention kernel ~ a fifth of the
+# block, gate+up ~ a third); they only need to rank policies, not predict
+# absolute MFU.
+_ANCHOR_COVERAGE = {
+    "attn_res": 0.18, "attn_lse": 0.02, "attn_out": 0.20,
+    "attn_q": 0.07, "attn_k": 0.055, "attn_v": 0.055,
+    "resid_mid": 0.09, "ln2_out": 0.01, "rms_rstd": 0.01,
+    "ffn_gate": 0.17, "ffn_up": 0.17, "ffn_out": 0.04,
+}
+#: int8 saves skip the same recompute but pay quant/dequant bandwidth
+_INT8_DISCOUNT = 0.9
+_POLICY_COVERAGE = {"none": 1.0, "full": 0.0, "dots": 0.6,
+                    "attn": 0.22, "attn_ffn": 0.26}
+
+
+def policy_coverage(policy):
+    """~fraction of forward FLOPs the backward replay skips under
+    ``policy`` (a recompute_policy string)."""
+    pol = str(policy)
+    if pol in _POLICY_COVERAGE:
+        return _POLICY_COVERAGE[pol]
+    if pol.startswith("names:"):
+        _, int8_names = parse_save_names(pol[len("names:"):])
+        cov = 0.0
+        for raw in pol[len("names:"):].split(","):
+            nm = raw.strip()
+            base = nm[len("int8:"):] if nm.startswith("int8:") else nm
+            w = _ANCHOR_COVERAGE.get(base, 0.0)
+            cov += w * (_INT8_DISCOUNT if base in int8_names else 1.0)
+        return min(cov, 0.95)
+    return 0.0
+
+
+def throughput_score(batch, policy):
+    """MFU-shaped estimate: useful FLOPs per token are 3F (fwd+bwd), the
+    replay re-runs (1 - coverage)F of them, and larger batches buy mildly
+    better MXU efficiency. Calibrated on r4/r5: b3 + full ffn saves must
+    outrank b4 without them (measured 0.5629 vs 0.5468)."""
+    cov = policy_coverage(policy)
+    return 3.0 / (4.0 - cov) * (1.0 + 0.03 * int(batch))
+
+
+# -- activation-byte estimate (telemetry + bench JSON) ----------------------
+def estimate_stacked_activation_bytes(policy, *, num_layers, batch, seq,
+                                      hidden, num_heads, num_kv_heads,
+                                      intermediate, act_bytes=2,
+                                      block=None):
+    """(saved_bytes, int8_bytes) the stacked decoder's remat policy pins
+    in HBM across all layers — the analytic counterpart of
+    ``memory_analysis`` that attributes bytes to NAMES. Unknown anchors
+    count 0 (custom-kernel residual shapes vary); non-``names:`` policies
+    return (0, 0)."""
+    from .int8_ckpt import INT8_BLOCK
+
+    block = block or INT8_BLOCK
+    pol = str(policy)
+    if not pol.startswith("names:"):
+        return 0, 0
+    _, int8_names = parse_save_names(pol[len("names:"):])
+    hd = hidden // num_heads
+    kv = num_kv_heads * hd
+    tok = batch * seq
+    # elements per layer, with the dtype each anchor is saved in
+    elems = {
+        "attn_q": (tok * hidden, act_bytes),
+        "attn_k": (tok * kv, act_bytes),
+        "attn_v": (tok * kv, act_bytes),
+        "attn_out": (tok * hidden, act_bytes),
+        "attn_res": (tok * hidden, act_bytes),
+        "attn_lse": (tok * num_heads, 4),
+        "resid_mid": (tok * hidden, act_bytes),
+        "ln2_out": (tok * hidden, act_bytes),
+        "ffn_gate": (tok * intermediate, act_bytes),
+        "ffn_up": (tok * intermediate, act_bytes),
+        "ffn_out": (tok * intermediate, act_bytes),
+        "rms_rstd": (tok * 2, 4),  # one rstd row-vector per rms (2/block)
+    }
+    saved = int8 = 0
+    for raw in pol[len("names:"):].split(","):
+        nm = raw.strip()
+        base = nm[len("int8:"):] if nm.startswith("int8:") else nm
+        if base not in elems:
+            continue
+        n, nbytes = elems[base]
+        if base in int8_names:
+            b = int8_saved_nbytes(n, block)
+            int8 += b
+            saved += b
+        else:
+            saved += n * nbytes
+    return saved * num_layers, int8 * num_layers
+
+
+# -- decision cache ---------------------------------------------------------
+def _cache_path(path=None):
+    if path is not None:
+        return path or None
+    env = os.environ.get("PTPU_PLAN_CACHE")
+    if env == "0":
+        return None
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "memory_plan.json")
+
+
+def _cache_load(path):
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _cache_store(path, key, decision):
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        d = _cache_load(path)
+        d[key] = decision.as_json()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(d, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # cache is an optimization; planning already succeeded
+
+
+# -- the planner ------------------------------------------------------------
+def plan_train_step(step_factory, candidates, *, budget_bytes=None,
+                    cache_path=None, cache_extra=(), act_bytes_fn=None,
+                    opt_state_bytes=None, require_fit=True):
+    """Pick the best (batch, policy) that fits the HBM budget.
+
+    ``step_factory(candidate) -> (TrainStep, batch_avals)`` builds a step
+    for the candidate; the planner lowers+compiles it WITHOUT executing
+    (``TrainStep.memory_stats`` over abstract avals — no buffers are
+    allocated) and reads the XLA buffer-assignment peak. Candidates are
+    tried highest :func:`throughput_score` first; the first fit wins, so
+    the common case compiles one program. ``require_fit=False`` accepts
+    the top candidate even over budget (the env-override path — trust the
+    human, but still record ``fits``).
+
+    ``act_bytes_fn(candidate) -> (saved, int8)`` optionally attributes
+    saved-activation bytes for telemetry/the bench JSON.
+
+    Decisions are cached at ``cache_path`` (default
+    ``~/.cache/paddle_tpu/memory_plan.json``, env ``PTPU_PLAN_CACHE``,
+    ``0`` disables) keyed by (chip, device count, budget, grid,
+    ``cache_extra``); a hit returns without lowering anything.
+    """
+    import jax
+
+    budget = hbm_budget_bytes(budget_bytes)
+    chip = chip_kind()
+    try:
+        ndev = len(jax.devices())
+    except Exception:
+        ndev = 1
+    order = sorted(
+        candidates,
+        key=lambda c: (c.score if c.score is not None
+                       else throughput_score(c.batch, c.policy)),
+        reverse=True)
+    grid = [(c.batch, c.policy) for c in order]
+    key = hashlib.sha1(repr(
+        (chip, ndev, budget, tuple(cache_extra), grid, require_fit)
+    ).encode()).hexdigest()[:16]
+
+    cpath = _cache_path(cache_path)
+    if cpath:
+        hit = _cache_load(cpath).get(key)
+        if hit:
+            hit = dict(hit, source="cache")
+            decision = PlanDecision(**hit)
+            _PLAN_EVALS.inc(labels=("cache_hit",))
+            _set_gauges(decision)
+            return decision
+
+    evaluated = []
+    chosen = None
+    for cand in order:
+        score = (cand.score if cand.score is not None
+                 else throughput_score(cand.batch, cand.policy))
+        step, batch_avals = step_factory(cand)
+        # label this step's build as a planning compile so the recompile
+        # watchdog's per-function counts stay meaningful (jit._build)
+        step._planning = True
+        try:
+            mem = step.memory_stats(*batch_avals)
+        except Exception as e:  # lowering/compile failure = not plannable
+            _PLAN_EVALS.inc(labels=("error",))
+            evaluated.append({"batch": cand.batch, "policy": cand.policy,
+                              "score": score, "error": str(e)[:200]})
+            continue
+        fits = mem["peak_bytes"] <= budget
+        _PLAN_EVALS.inc(labels=("fit" if fits else "over_budget",))
+        evaluated.append({"batch": cand.batch, "policy": cand.policy,
+                          "score": score, "peak_bytes": mem["peak_bytes"],
+                          "fits": fits})
+        if fits or not require_fit:
+            chosen = (cand, mem, score, fits)
+            break
+    if chosen is None:
+        raise MemoryPlanError(
+            f"no candidate fits the HBM budget ({budget} bytes on {chip}); "
+            f"evaluated: {evaluated}")
+
+    cand, mem, score, fits = chosen
+    decision = PlanDecision(
+        batch=cand.batch, policy=cand.policy,
+        peak_bytes=int(mem["peak_bytes"]), budget_bytes=int(budget),
+        fits=bool(fits), score=float(score),
+        source="planner" if require_fit else "env-override",
+        chip=chip, key=key, opt_state_bytes=opt_state_bytes,
+        candidates=evaluated)
+    if act_bytes_fn is not None:
+        saved, i8 = act_bytes_fn(cand)
+        decision.act_saved_bytes = int(saved)
+        decision.act_int8_bytes = int(i8)
+    _set_gauges(decision)
+    if cpath:
+        _cache_store(cpath, key, decision)
+    return decision
+
+
+def _set_gauges(decision):
+    _HBM_PEAK.set(decision.peak_bytes)
+    if decision.act_saved_bytes is not None:
+        _ACT_SAVED.set(decision.act_saved_bytes)
+    if decision.act_int8_bytes is not None:
+        _ACT_INT8.set(decision.act_int8_bytes)

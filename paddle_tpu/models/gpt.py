@@ -377,12 +377,25 @@ def _sdpa_pure(q, k, v, causal=True):
 
 
 def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
-                rope_tables=None):
-    """One decoder block on arrays. p = (ln1, wq, wk, wv, wo, ln2, wg, wu, wd)."""
+                rope_tables=None, int8_names=frozenset()):
+    """One decoder block on arrays. p = (ln1, wq, wk, wv, wo, ln2, wg, wu, wd).
+
+    ``int8_names``: anchors whose save point is routed through
+    ``memory.int8_checkpoint`` (blockwise-int8 + fp32 scales) instead of
+    a bf16 ``checkpoint_name`` — what an ``int8:<anchor>`` entry in a
+    ``names:`` recompute_policy requests. Each int8-saved tensor holds
+    ~half the HBM of its bf16 save, buying batch or more saves."""
     import jax
     import jax.numpy as jnp
 
     from jax.ad_checkpoint import checkpoint_name
+
+    def _save(t, name):
+        if name in int8_names:
+            from paddle_tpu.memory import int8_checkpoint
+
+            return int8_checkpoint(t, name)
+        return checkpoint_name(t, name)
 
     ln1, wq, wk, wv, wo, ln2, wg, wu, wd = p
     b, s, hdim = x.shape
@@ -397,9 +410,9 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     # remat anchors (inert under policies that don't name them): saving
     # post-rope q/k/v lets the flash backward skip re-running rms1 + the
     # three projections + rope
-    q = checkpoint_name(q, "attn_q")
-    k = checkpoint_name(k, "attn_k")
-    v = checkpoint_name(v, "attn_v")
+    q = _save(q, "attn_q")
+    k = _save(k, "attn_k")
+    v = _save(v, "attn_v")
     o = _sdpa_pure(q, k, v, causal=True).reshape(b, s, num_heads * hd)
     # selective-remat anchor for the XLA-fallback path: with
     # recompute_policy="attn" the backward reuses this tensor instead of
@@ -409,7 +422,7 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     from paddle_tpu.nn.functional.flash_attention import _use_pallas
 
     if not _use_pallas(q.shape):
-        o = checkpoint_name(o, "attn_out")
+        o = _save(o, "attn_out")
     if os.environ.get("PTPU_FUSED_ADDRMS") and _use_pallas(q.shape):
         # fused residual-add + rms in one Pallas pass (named residuals
         # addrms_y/rms_rstd make the backward reuse, not re-run, it)
@@ -419,8 +432,8 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     else:
         # anchors: resid_mid skips the o-proj re-run; ln2_out feeds the
         # gate/up recompute without re-running rms2
-        x = checkpoint_name(x + o @ wo, "resid_mid")
-        h2 = checkpoint_name(_rms_pure(x, ln2), "ln2_out")
+        x = _save(x + o @ wo, "resid_mid")
+        h2 = _save(_rms_pure(x, ln2), "ln2_out")
     if os.environ.get("PTPU_INT8_FFN"):
         # int8-saved gate/up: exact forward, backward dequantises instead
         # of re-running the two matmuls (~9 TFLOP/step at 1.3B/b4).
@@ -432,9 +445,9 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
         return x + _ffn_i8(h2, wg, wu, wd)
     # per-projection anchors: saving gate/up outputs individually lets a
     # policy trade ~67MB/layer (b4) for skipping that matmul's re-run
-    gate = checkpoint_name(h2 @ wg, "ffn_gate")
-    up = checkpoint_name(h2 @ wu, "ffn_up")
-    ffn = checkpoint_name(jax.nn.silu(gate) * up, "ffn_out")
+    gate = _save(h2 @ wg, "ffn_gate")
+    up = _save(h2 @ wu, "ffn_up")
+    ffn = _save(jax.nn.silu(gate) * up, "ffn_out")
     return x + ffn @ wd
 
 
@@ -550,12 +563,28 @@ class StackedDecoder(nn.Layer):
                       if cfg.rope and os.environ.get("PTPU_ROPE_HOIST")
                       else None)
 
-            def block(x, p):
-                return _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
-                                   cfg.rope, rope_tables=tables)
-
+            int8_names = frozenset()
             if cfg.recompute:
                 pol = getattr(cfg, "recompute_policy", "full")
+                if isinstance(pol, str) and pol.startswith("names:"):
+                    # free-form selective remat: comma-separated
+                    # checkpoint_name tags (perf-sweep surface; the
+                    # available anchors are tagged in _block_pure). An
+                    # int8:<anchor> entry saves that anchor as blockwise
+                    # int8 + fp32 scales (memory.int8_checkpoint) — the
+                    # policy then keeps the quantized pair, ~half the
+                    # bf16 bytes (docs/MEMORY.md).
+                    from paddle_tpu.memory import parse_save_names
+
+                    save_names, int8_names = parse_save_names(
+                        pol[len("names:"):])
+
+            def block(x, p):
+                return _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
+                                   cfg.rope, rope_tables=tables,
+                                   int8_names=int8_names)
+
+            if cfg.recompute:
                 if pol == "dots":
                     policy = (jax.checkpoint_policies
                               .dots_with_no_batch_dims_saveable)
@@ -566,11 +595,8 @@ class StackedDecoder(nn.Layer):
                     policy = jax.checkpoint_policies.save_only_these_names(
                         "attn_out", "attn_res", "attn_lse", "ffn_out")
                 elif isinstance(pol, str) and pol.startswith("names:"):
-                    # free-form selective remat: comma-separated
-                    # checkpoint_name tags (perf-sweep surface; the
-                    # available anchors are tagged in _block_pure)
                     policy = jax.checkpoint_policies.save_only_these_names(
-                        *[n for n in pol[len("names:"):].split(",") if n])
+                        *save_names)
                 else:
                     policy = None
                 block = jax.checkpoint(block, policy=policy)
@@ -665,21 +691,50 @@ class GPTForCausalLMPipe(nn.Layer):
         the flagship pipelined model serves through
         inference.ContinuousBatchingEngine unchanged.
 
-        NOTE: jnp indexing COPIES, so a live engine holds a second,
-        layer-sliced copy of the weights (~2x HBM while the stacked
-        model object is also alive — unlike LlamaForCausalLM, whose
-        per-layer params are returned by reference). For serving at
-        flagship sizes, drop the training model after engine
-        construction, or load weights into a LlamaForCausalLM."""
+        jnp indexing COPIES, so materializing every layer up front held a
+        second full copy of the decoder for as long as the returned list
+        lived — and a reload_weights() on a live engine transiently held
+        THREE (stacked + old slices + new slices, ADVICE r5). Returns a
+        lazy sequence instead: each layer is sliced on access and nothing
+        is retained here, so consumers that process layers one at a time
+        (the engine's _pack_weights) peak at stacked + one layer + their
+        own copy. The engine's packed copy itself is inherent while the
+        training model stays alive; for serving at flagship sizes, drop
+        the training model after engine construction."""
+        names = ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd")
+        return _LazyLayerSlices(self.decoder, names, self.config.num_layers)
+
+
+class _LazyLayerSlices:
+    """Sequence of per-layer weight dicts over a StackedDecoder, sliced on
+    access (each ``__getitem__`` copies ONE layer's weights; nothing is
+    cached). Satisfies the ``_decode_params`` contract: len(), indexing,
+    and iteration yield ``{name: obj-with-._data}`` per layer."""
+
+    def __init__(self, decoder, names, num_layers):
+        self._decoder = decoder
+        self._names = names
+        self._num_layers = num_layers
+
+    def __len__(self):
+        return self._num_layers
+
+    def __getitem__(self, i):
         from types import SimpleNamespace
 
-        d = self.decoder
-        names = ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd")
-        stacked = {n: getattr(d, n)._data for n in names}
-        return [
-            {n: SimpleNamespace(_data=stacked[n][i]) for n in names}
-            for i in range(self.config.num_layers)
-        ]
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._num_layers))]
+        if i < 0:
+            i += self._num_layers
+        if not 0 <= i < self._num_layers:
+            raise IndexError(i)
+        d = self._decoder
+        return {n: SimpleNamespace(_data=getattr(d, n)._data[i])
+                for n in self._names}
+
+    def __iter__(self):
+        for i in range(self._num_layers):
+            yield self[i]
 
 
 # ---------------------------------------------------------------------------

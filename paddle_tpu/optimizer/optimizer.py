@@ -189,6 +189,27 @@ class Optimizer:
         """Initial slot pytree for a dict of name->array."""
         return {name: self._init_slots(arr) for name, arr in named_params.items()}
 
+    def slot_nbytes(self, named_params):
+        """Total bytes of this optimizer's functional slot state for the
+        given name->array (or name->aval) dict — what the memory planner
+        charges against the HBM budget for optimizer state. Computed via
+        ``eval_shape`` over ``_init_slots``: no arrays are materialized,
+        so pricing a flagship config costs nothing. Factored/int8-moment
+        variants are priced exactly (their _init_slots shapes differ)."""
+        import jax
+
+        total = 0
+        for arr in named_params.values():
+            shapes = jax.eval_shape(
+                self._init_slots,
+                jax.ShapeDtypeStruct(tuple(arr.shape), jnp.dtype(arr.dtype)))
+            for leaf in jax.tree_util.tree_leaves(shapes):
+                n = 1
+                for d in leaf.shape:
+                    n *= int(d)
+                total += n * jnp.dtype(leaf.dtype).itemsize
+        return total
+
     def functional_update(self, params, grads, state, lr):
         """Pure pytree update usable inside jax.jit. Returns (params, state)."""
         new_params, new_state = {}, {}

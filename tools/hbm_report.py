@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Pretty-print a bench "memory" block, or diff two rounds' blocks.
+
+Usage:
+    python tools/hbm_report.py RUN.json
+    python tools/hbm_report.py OLD.json NEW.json
+
+The sibling of tools/telemetry_report.py for the memory dimension:
+accepts a raw planner decision dict (``paddle_tpu.memory.PlanDecision
+.as_json()``), a bench JSON line carrying it under ``"memory"``, or a
+BENCH_r*.json round record ({"n", "cmd", "tail", "parsed"}). Diff mode
+explains "why did this round's memory state change" — chosen batch/
+policy, peak vs budget, and the byte deltas — from data instead of a
+re-profile. Contract: docs/MEMORY.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_BYTE_FIELDS = ("peak_bytes", "budget_bytes", "act_saved_bytes",
+                "act_int8_bytes", "opt_state_bytes")
+
+
+def _is_memory(d):
+    return isinstance(d, dict) and "peak_bytes" in d and "policy" in d
+
+
+def _scan_lines(text):
+    """LAST JSON-object line carrying a memory block (bench stdout prints
+    log lines and, on TPU, TWO metric lines — the headline one is last)."""
+    best = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and ("memory" in d or _is_memory(d)):
+            best = d
+    return best
+
+
+def _extract(data):
+    if not isinstance(data, dict):
+        return None
+    if _is_memory(data):
+        return data
+    if _is_memory(data.get("memory")):
+        return data["memory"]
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and _is_memory(parsed.get("memory")):
+        return parsed["memory"]
+    tail = data.get("tail")
+    if isinstance(tail, str):
+        return _extract(_scan_lines(tail))
+    return None
+
+
+def load_memory(path):
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        data = _scan_lines(text)
+        if data is None:
+            raise ValueError(f"{path}: no JSON object found")
+    mem = _extract(data)
+    if mem is None:
+        raise ValueError(
+            f"{path}: no memory block found (expected a planner decision "
+            "dict, a bench JSON line with a 'memory' key, or a "
+            "BENCH_r*.json round record — rounds before the memory "
+            "planner don't carry one)")
+    return mem
+
+
+def _fmt_bytes(v):
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(v) < 1024 or unit == "GB":
+            return (f"{v:.2f}{unit}" if unit != "B" else f"{int(v)}B")
+        v /= 1024
+    return f"{v:.2f}GB"
+
+
+def print_memory(mem, out=None):
+    # resolve stdout at call time (a def-time default would pin whatever
+    # stream was active at first import — e.g. a pytest capture buffer)
+    w = (out or sys.stdout).write
+    w(f"plan: batch={mem.get('batch')} source={mem.get('source')} "
+      f"chip={mem.get('chip')} fits={mem.get('fits')}\n")
+    w(f"policy: {mem.get('policy')}\n")
+    for k in _BYTE_FIELDS:
+        if mem.get(k) is not None:
+            w(f"  {k}: {_fmt_bytes(mem[k])}\n")
+    pk, bd = mem.get("peak_bytes"), mem.get("budget_bytes")
+    if pk and bd:
+        w(f"  headroom: {_fmt_bytes(bd - pk)} ({pk / bd:.1%} of budget used)\n")
+    cands = mem.get("candidates") or []
+    if cands:
+        w(f"-- candidates evaluated ({len(cands)}) --\n")
+        for c in cands:
+            if "error" in c:
+                w(f"  b{c.get('batch')} {c.get('policy')}: "
+                  f"ERROR {c['error']}\n")
+            else:
+                tag = "fits" if c.get("fits") else "over budget"
+                w(f"  b{c.get('batch')} {c.get('policy')}: "
+                  f"peak={_fmt_bytes(c.get('peak_bytes'))} "
+                  f"score={c.get('score', 0):.3f} [{tag}]\n")
+
+
+def diff_memory(old, new, out=None):
+    w = (out or sys.stdout).write
+    changed = []
+    for k in ("batch", "policy", "source", "chip", "fits"):
+        if old.get(k) != new.get(k):
+            changed.append(f"  {k}: {old.get(k)} -> {new.get(k)}")
+    w("plan changes (new vs old):\n")
+    w(("\n".join(changed) + "\n") if changed
+      else "  (same batch/policy/source)\n")
+    w("byte deltas:\n")
+    any_delta = False
+    for k in _BYTE_FIELDS:
+        ov, nv = old.get(k), new.get(k)
+        if ov is None and nv is None:
+            continue
+        if ov == nv:
+            continue
+        any_delta = True
+        delta = (nv or 0) - (ov or 0)
+        rel = f" ({delta / ov:+.1%})" if ov else ""
+        w(f"  {k}: {_fmt_bytes(ov)} -> {_fmt_bytes(nv)} "
+          f"[{'+' if delta >= 0 else ''}{_fmt_bytes(delta)}{rel}]\n")
+    if not any_delta:
+        w("  (no byte-field changes)\n")
+    return changed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run", help="bench JSON / memory block")
+    ap.add_argument("other", nargs="?",
+                    help="second run: diff mode (old=first, new=second)")
+    args = ap.parse_args(argv)
+    if args.other is None:
+        print_memory(load_memory(args.run))
+    else:
+        diff_memory(load_memory(args.run), load_memory(args.other))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
