@@ -17,7 +17,7 @@ import time
 import numpy as np
 
 
-def run_model(model_kind):
+def run_model(model_kind, ckpt=None):
     import jax
 
     backend = jax.default_backend()
@@ -178,6 +178,38 @@ def run_model(model_kind):
     # the same config skip planning entirely, so the cost is first-run-
     # per-config only.
     step = TrainStep(model, train_fn, opt)
+
+    # Crash-safe checkpointing (--ckpt-dir): per-step committed saves via
+    # CheckpointManager, --resume auto restore of the newest committed
+    # step BEFORE warmup (the compiled step seeds its optimizer state
+    # from the restored slots), and a PreemptionGuard that turns
+    # SIGTERM/SIGINT into one final synchronous save + clean exit
+    # (docs/CHECKPOINT.md). Default driver runs pass no flags: inactive.
+    manager = guard = None
+    start_step = 0
+    if ckpt is not None and ckpt.ckpt_dir:
+        from paddle_tpu.distributed.checkpoint.manager import (
+            CheckpointManager, PreemptionGuard)
+
+        # per-model subroot: the default TPU driver run trains BOTH
+        # tracked configs, whose state dicts must not share a step dir
+        manager = CheckpointManager(
+            os.path.join(ckpt.ckpt_dir, model_kind), keep=ckpt.ckpt_keep)
+        latest = manager.latest_step()
+        if ckpt.resume == "auto" and latest is not None:
+            if latest < steps:
+                start_step = manager.restore_training_state(model, opt)
+            else:
+                # a finished run's checkpoint would leave ZERO timed
+                # steps and fabricate an absurd tokens/sec headline —
+                # measure fresh instead (the committed steps remain)
+                import sys
+
+                print(f"# ckpt: latest committed step {latest} >= bench "
+                      f"steps {steps}; measuring fresh (not resuming)",
+                      file=sys.stderr)
+        guard = PreemptionGuard(manager).install()
+
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
     labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
@@ -191,15 +223,28 @@ def run_model(model_kind):
         "bench_step_seconds", "bench timed-loop per-step dispatch wall "
         "time (async: the device sync runs after the loop, so trailing "
         "device work shows up only in the tokens/sec line)")
+    n_ran = 0
     t0 = time.perf_counter()
     t_prev = t0
-    for _ in range(steps):
+    for gstep in range(start_step + 1, steps + 1):
         loss = step(ids, labels)
         t_now = time.perf_counter()
         bench_step.observe(t_now - t_prev)
         t_prev = t_now
+        n_ran += 1
+        if manager is not None and gstep % ckpt.ckpt_every == 0:
+            manager.save_training_state(gstep, model, opt, train_step=step,
+                                        async_save=True)
+        if guard is not None and guard.should_stop():
+            manager.wait()
+            manager.save_training_state(gstep, model, opt, train_step=step)
+            break
     _ = float(loss.numpy())  # sync
     dt = time.perf_counter() - t0
+    if manager is not None:
+        manager.wait()  # surface any async writer failure before reporting
+    if guard is not None:
+        guard.uninstall()
 
     # dp-style loss sync over the default group: single-chip it degrades
     # to a no-op copy, but the collective call/byte counters it ticks are
@@ -209,7 +254,7 @@ def run_model(model_kind):
 
     dist.all_reduce(loss, op=dist.ReduceOp.AVG)
 
-    tokens_per_sec = batch * seq * steps / dt
+    tokens_per_sec = batch * seq * max(n_ran, 1) / dt
 
     # MFU: 6 * params * tokens/sec / peak_flops
     n_params = sum(int(np.prod(p.shape)) for _, p in model.named_parameters())
@@ -243,10 +288,24 @@ def run_model(model_kind):
 
 
 def main():
+    import argparse
     import gc
     import logging
 
     import jax
+
+    ap = argparse.ArgumentParser(
+        description="paddle_tpu headline pretrain benchmark")
+    ap.add_argument("--ckpt-dir", default=os.environ.get("PTPU_BENCH_CKPT")
+                    or None, help="enable crash-safe checkpointing under "
+                    "this root (docs/CHECKPOINT.md)")
+    ap.add_argument("--ckpt-every", type=int, default=5,
+                    help="async committed save every N steps")
+    ap.add_argument("--ckpt-keep", type=int, default=3,
+                    help="retention: newest N committed steps")
+    ap.add_argument("--resume", choices=("auto", "none"), default="auto",
+                    help="auto = restore the newest committed step")
+    args = ap.parse_args()
 
     # surface which attention path ran (proof the Pallas kernel engaged)
     logging.basicConfig()
@@ -255,13 +314,13 @@ def main():
     on_tpu = jax.default_backend() not in ("cpu",)
     kind = os.environ.get("PTPU_BENCH_MODEL")
     if kind is not None or not on_tpu:
-        run_model(kind or "gpt")
+        run_model(kind or "gpt", ckpt=args)
         return
     # default driver run: BOTH tracked lines — config-5 (LLaMA-arch)
     # FIRST, the headline GPT line LAST so the parsed metric stays stable
-    run_model("llama")
+    run_model("llama", ckpt=args)
     gc.collect()
-    run_model("gpt")
+    run_model("gpt", ckpt=args)
 
 
 if __name__ == "__main__":

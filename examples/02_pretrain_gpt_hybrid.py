@@ -5,16 +5,32 @@ pp x mp x dp composition as one compiled step. On one host:
         python examples/02_pretrain_gpt_hybrid.py
 On a pod, launch one process per host with
 `python -m paddle_tpu.distributed.launch` and the same body.
+
+Crash safety: pass ``--ckpt-dir DIR`` to save every step as a committed
+CheckpointManager checkpoint and auto-resume from the newest committed
+step after a kill/preemption (``--resume auto``, the default) — SIGTERM
+mid-run triggers one final synchronous save and a clean exit
+(docs/CHECKPOINT.md).
 """
+import argparse
+
 import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.checkpoint.manager import (CheckpointManager,
+                                                       PreemptionGuard)
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="crash-safe checkpoint root (off when unset)")
+    ap.add_argument("--resume", choices=("auto", "none"), default="auto")
+    args = ap.parse_args()
+
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2,
                                "pp_degree": 1, "sharding_degree": 1}
@@ -40,9 +56,35 @@ def main():
         return F.cross_entropy(
             logits.reshape([-1, cfg.vocab_size]), y.reshape([-1]))
 
-    for step in range(5):
-        loss = dmodel.train_batch([ids, labels], dopt, loss_fn=lm_loss)
-        print(f"step {step}: loss {float(loss):.4f}")
+    # crash-safe training state: committed per-step saves + auto-resume
+    manager = None
+    start = 0
+    if args.ckpt_dir:
+        manager = CheckpointManager(args.ckpt_dir, keep=3)
+        if args.resume == "auto" and manager.latest_step() is not None:
+            start = manager.restore_training_state(model, opt)
+            print(f"resumed from committed step {start}")
+
+    with PreemptionGuard(manager) as guard:
+        for step in range(start, 5):
+            loss = dmodel.train_batch([ids, labels], dopt, loss_fn=lm_loss)
+            print(f"step {step}: loss {float(loss):.4f}")
+            if manager is not None:
+                # train_step= syncs the compiled step's optimizer slots
+                # back into `opt` before the state is snapshotted
+                manager.save_training_state(
+                    step + 1, model, opt, train_step=dmodel._train_step,
+                    async_save=True)
+            if guard.preempted:
+                if manager is not None:
+                    manager.wait()
+                    manager.save_training_state(
+                        step + 1, model, opt,
+                        train_step=dmodel._train_step)
+                    print(f"preempted: committed final step {step + 1}")
+                return
+    if manager is not None:
+        manager.wait()
 
     # -- full 3-axis hybrid: pipeline stages x Megatron TP x data -------
     # parallel, ONE compiled program. Stage sharding comes from the

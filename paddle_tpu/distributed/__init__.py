@@ -71,6 +71,10 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import (load_checkpoint, load_state_dict,  # noqa: F401
                          save_checkpoint, save_state_dict)
+from .checkpoint.manager import (  # noqa: F401
+    CheckpointManager,
+    PreemptionGuard,
+)
 from .context_parallel import (  # noqa: F401
     context_parallel_attention,
     ring_attention,

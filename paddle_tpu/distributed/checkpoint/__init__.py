@@ -11,17 +11,106 @@ TPU-native: the "local shards" are a `jax.Array`'s addressable shards —
 their `.index` IS the global-offset box the reference tracks by hand.
 Reshard-on-load places loaded values with the target array's sharding via
 `device_put`; XLA moves bytes over ICI as needed.
+
+Crash safety (docs/CHECKPOINT.md): every file lands via tmp-file +
+``os.replace`` so a reader can never observe a torn write; payloads are
+serialized (host-snapshotted) in the CALLER's thread before any async
+hand-off; per-file CRC32C checksums ride in the metadata; transient
+``OSError`` from the filesystem is retried with exponential backoff.
+``CheckpointManager`` (manager.py) builds the per-step commit protocol,
+retention and auto-resume on top of these primitives.
 """
 from __future__ import annotations
 
+import atexit
 import dataclasses
 import os
 import pickle
 import threading
+import time
+import zlib
 
 import numpy as np
 
 from ...core.tensor import Tensor
+
+try:  # hardware CRC32C when available; zlib CRC32 otherwise
+    import google_crc32c as _crc32c
+
+    CHECKSUM_ALGO = "crc32c"
+except ImportError:  # pragma: no cover - depends on container image
+    _crc32c = None
+    CHECKSUM_ALGO = "crc32"
+
+
+def checksum_bytes(data: bytes, algo: str = None) -> int:
+    """Checksum `data` with `algo` (default: this host's best). Returns
+    None for an algo this host cannot compute — the validator then falls
+    back to size-only rather than reporting false corruption on a
+    machine without the hardware-CRC wheel."""
+    algo = CHECKSUM_ALGO if algo is None else algo
+    if algo == "crc32c":
+        return int(_crc32c.value(data)) if _crc32c is not None else None
+    if algo == "crc32":
+        return zlib.crc32(data) & 0xFFFFFFFF
+    return None
+
+
+# Retry policy for transient filesystem errors (preempted-VM NFS blips,
+# ENOSPC races with the retention GC on another host, ...).
+DEFAULT_WRITE_RETRIES = 3
+DEFAULT_RETRY_BACKOFF = 0.05
+
+# Fault-injection seam: paddle_tpu.testing.chaos installs a callable
+# ``hook(path, attempt)`` here that may raise; called once per write
+# attempt BEFORE any bytes land, so an injected OSError exercises the
+# retry path and a non-OSError kills the save with no partial file.
+_WRITE_FAULT_HOOK = None
+
+
+class MissingKeysError(KeyError):
+    """A strict load found target keys with no (valid) saved payload."""
+
+    def __init__(self, missing, path):
+        super().__init__(sorted(missing))
+        self.missing = sorted(missing)
+        self.path = path
+
+    def __str__(self):
+        return (f"checkpoint at {self.path!r} is missing payload for "
+                f"{len(self.missing)} key(s): {self.missing} "
+                f"(pass strict=False to keep the live values)")
+
+
+_METRICS = None
+
+
+def _metrics():
+    """Lazy telemetry families (docs/CHECKPOINT.md metric contract)."""
+    global _METRICS
+    if _METRICS is None:
+        from ... import telemetry
+
+        _METRICS = {
+            "save_seconds": telemetry.histogram(
+                "checkpoint_save_seconds",
+                "wall time of one checkpoint save (serialize + write + "
+                "commit)", labelnames=("mode",)),
+            "bytes": telemetry.counter(
+                "checkpoint_bytes_total",
+                "bytes written to checkpoint storage"),
+            "restores": telemetry.counter(
+                "checkpoint_restores_total",
+                "successful checkpoint restores"),
+            "validation_failures": telemetry.counter(
+                "checkpoint_validation_failures_total",
+                "steps rejected at restore time (missing COMMIT, checksum "
+                "mismatch, unreadable shard/metadata)"),
+            "missing_keys": telemetry.counter(
+                "checkpoint_missing_keys_total",
+                "target keys a strict=False load left at their live values"),
+        }
+    return _METRICS
 
 
 @dataclasses.dataclass
@@ -46,6 +135,14 @@ class Metadata:
     state_dict_metadata: dict = dataclasses.field(default_factory=dict)
     storage_metadata: dict = dataclasses.field(default_factory=dict)
     flat_mapping: dict = dataclasses.field(default_factory=dict)
+    # filename -> {"algo", "value", "nbytes"}; absent on pre-checksum
+    # checkpoints (pickle restores the old __dict__ as-is), so readers go
+    # through file_checksums_of().
+    file_checksums: dict = dataclasses.field(default_factory=dict)
+
+
+def file_checksums_of(meta) -> dict:
+    return getattr(meta, "file_checksums", {}) or {}
 
 
 def _to_array(v):
@@ -60,12 +157,16 @@ def _rank():
     return get_rank()
 
 
-def _shard_boxes(arr):
+def _shard_boxes(arr, is_coordinator=True):
     """[(global_offset, local_np_array)] for the shards this process owns,
-    deduped across replicas."""
-    import jax
-
+    deduped across replicas. Fully-replicated values with no addressable
+    replica-0 shard fall back to the full array on the COORDINATOR only —
+    every rank writing the fallback box would land world-size copies of
+    the same bytes on disk (the metadata dedup hides the waste but not
+    the I/O)."""
     if not hasattr(arr, "addressable_shards"):
+        if not is_coordinator:
+            return []
         a = np.asarray(arr)
         return [((0,) * a.ndim, a)]
     boxes = []
@@ -77,17 +178,52 @@ def _shard_boxes(arr):
             (s.start or 0) if isinstance(s, slice) else 0 for s in idx
         )
         boxes.append((offset, np.asarray(sh.data)))
-    if not boxes:  # fully replicated elsewhere; rank 0 fallback
+    if not boxes and is_coordinator:  # fully replicated elsewhere
         a = np.asarray(arr)
         boxes = [((0,) * a.ndim, a)]
     return boxes
 
 
-def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    unique_id=None, async_save=False):
-    """Write this rank's shards + (on the coordinator) the global metadata."""
-    os.makedirs(path, exist_ok=True)
+def _atomic_write_bytes(path, data, retries=None, backoff=None, fsync=True):
+    """Write `data` to `path` via tmp + os.replace: readers see the old
+    file or the new one, never a prefix. Transient OSError retries with
+    exponential backoff. Returns bytes written."""
+    retries = DEFAULT_WRITE_RETRIES if retries is None else int(retries)
+    backoff = DEFAULT_RETRY_BACKOFF if backoff is None else float(backoff)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    attempt = 0
+    while True:
+        try:
+            hook = _WRITE_FAULT_HOOK
+            if hook is not None:
+                hook(path, attempt)
+            with open(tmp, "wb") as f:
+                f.write(data)
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return len(data)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            attempt += 1
+            if attempt > retries:
+                raise
+            time.sleep(backoff * (2 ** (attempt - 1)))
+
+
+# ---------------------------------------------------------------------------
+# save: prepare (host snapshot, caller thread) / execute (any thread)
+# ---------------------------------------------------------------------------
+def _prepare_save(state_dict, path, coordinator_rank=0, unique_id=None):
+    """Serialize this rank's shards + (coordinator) global metadata into a
+    write plan. Runs in the CALLER's thread: after it returns, the live
+    state may mutate freely — the plan holds host copies only."""
     rank = _rank()
+    is_coord = rank == coordinator_rank
     if unique_id is None:
         unique_id = 0
     data_file = f"{rank}_{unique_id}.distcp"
@@ -101,13 +237,20 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         dtype_name = str(np.dtype(arr.dtype).name) if not hasattr(
             arr.dtype, "name") else arr.dtype.name
         metas = []
-        for offset, block in _shard_boxes(arr):
+        for offset, block in _shard_boxes(arr, is_coordinator=is_coord):
             metas.append(LocalTensorMetadata(offset, tuple(block.shape),
                                              dtype_name))
             meta.storage_metadata[LocalTensorIndex(key, offset)] = data_file
             payload[f"{key}|{','.join(map(str, offset))}"] = block
         meta.state_dict_metadata[key] = metas
         meta.flat_mapping[key] = tuple(getattr(arr, "shape", ()))
+
+    payload_bytes = pickle.dumps(payload, protocol=4)
+    meta.file_checksums[data_file] = {
+        "algo": CHECKSUM_ALGO,
+        "value": checksum_bytes(payload_bytes),
+        "nbytes": len(payload_bytes),
+    }
 
     # In a multi-controller run each process only sees its own addressable
     # shards, so the coordinator must merge every rank's metadata before
@@ -120,11 +263,12 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         gathered = []
         all_gather_object(
             gathered,
-            (meta.state_dict_metadata, meta.storage_metadata, meta.flat_mapping),
+            (meta.state_dict_metadata, meta.storage_metadata,
+             meta.flat_mapping, meta.file_checksums),
         )
-        if rank == coordinator_rank:
+        if is_coord:
             merged = Metadata()
-            for sd_meta, st_meta, flat in gathered:
+            for sd_meta, st_meta, flat, sums in gathered:
                 for key, metas in sd_meta.items():
                     have = merged.state_dict_metadata.setdefault(key, [])
                     seen = {(tuple(m.global_offset), tuple(m.local_shape))
@@ -139,30 +283,122 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     # saved by every rank; reference only one file per box
                     merged.storage_metadata.setdefault(idx, fn)
                 merged.flat_mapping.update(flat)
+                merged.file_checksums.update(sums)
             meta = merged
 
+    meta_file = meta_bytes = None
+    file_checksums = dict(meta.file_checksums)
+    if is_coord:
+        meta_file = f"{unique_id}.metadata"
+        meta_bytes = pickle.dumps(meta, protocol=4)
+        file_checksums[meta_file] = {
+            "algo": CHECKSUM_ALGO,
+            "value": checksum_bytes(meta_bytes),
+            "nbytes": len(meta_bytes),
+        }
+
+    return {
+        "path": path,
+        "rank": rank,
+        "is_coordinator": is_coord,
+        "data_file": data_file,
+        "data_bytes": payload_bytes,
+        "meta_file": meta_file,
+        "meta_bytes": meta_bytes,
+        # every file THIS process knows the checksum of (on the
+        # coordinator after the gather: all ranks' shard files + the
+        # metadata file — exactly the COMMIT manifest)
+        "file_checksums": file_checksums,
+    }
+
+
+def _execute_save(plan, write_retries=None, retry_backoff=None):
+    """Write a `_prepare_save` plan to disk. Thread-safe; returns bytes."""
+    nbytes = _atomic_write_bytes(
+        os.path.join(plan["path"], plan["data_file"]), plan["data_bytes"],
+        retries=write_retries, backoff=retry_backoff)
+    if plan["meta_bytes"] is not None:
+        nbytes += _atomic_write_bytes(
+            os.path.join(plan["path"], plan["meta_file"]), plan["meta_bytes"],
+            retries=write_retries, backoff=retry_backoff)
+    _metrics()["bytes"].inc(nbytes)
+    return nbytes
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, async_save=False, write_retries=None,
+                    retry_backoff=None):
+    """Write this rank's shards + (on the coordinator) the global metadata."""
+    os.makedirs(path, exist_ok=True)
+    t0 = time.perf_counter()
+    plan = _prepare_save(state_dict, path, coordinator_rank, unique_id)
+
     def _write():
-        with open(os.path.join(path, data_file), "wb") as f:
-            pickle.dump(payload, f, protocol=4)
-        if rank == coordinator_rank:
-            with open(os.path.join(path, f"{unique_id}.metadata"), "wb") as f:
-                pickle.dump(meta, f, protocol=4)
+        _execute_save(plan, write_retries, retry_backoff)
+        _metrics()["save_seconds"].observe(
+            time.perf_counter() - t0,
+            labels=("async" if async_save else "sync",))
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=True)
-        t.start()
-        _PENDING.append(t)
+        pend = _PendingSave(path)
+        pend.thread = threading.Thread(
+            target=pend.run, args=(_write,), daemon=True,
+            name="ptpu-ckpt-save")
+        pend.thread.start()
+        _PENDING.append(pend)
     else:
         _write()
+    return plan
+
+
+class _PendingSave:
+    """An in-flight async save: its thread + the exception it died with.
+    Daemon threads so a hung filesystem cannot wedge interpreter exit —
+    the atexit drain below is what guarantees completed-or-reported."""
+
+    __slots__ = ("thread", "error", "path")
+
+    def __init__(self, path):
+        self.thread = None
+        self.error = None
+        self.path = path
+
+    def run(self, fn):
+        try:
+            fn()
+        except BaseException as e:  # held for wait_async_save to re-raise
+            self.error = e
 
 
 _PENDING = []
 
 
 def wait_async_save():
-    for t in _PENDING:
-        t.join()
-    _PENDING.clear()
+    """Join every pending async save; re-raise the FIRST writer exception
+    (a failed async save must not report success by silence)."""
+    pending, _PENDING[:] = list(_PENDING), []
+    first = None
+    for p in pending:
+        p.thread.join()
+        if first is None and p.error is not None:
+            first = p.error
+    if first is not None:
+        raise first
+
+
+def _drain_at_exit():
+    """Interpreter exit must not truncate an in-flight save: atexit runs
+    before daemon threads are killed, so joining here rides out the last
+    writes; a held exception is reported, not raised into shutdown."""
+    try:
+        wait_async_save()
+    except BaseException:
+        import traceback
+
+        traceback.print_exc()
+
+
+atexit.register(_drain_at_exit)
 
 
 def _load_metadata(path):
@@ -177,12 +413,18 @@ def _load_metadata(path):
 
 
 def load_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None, offload=False):
+                    coordinator_rank=0, unique_id=None, offload=False,
+                    strict=True):
     """Fill `state_dict`'s tensors from a checkpoint, resharding on load.
 
     Every key present in both the checkpoint and `state_dict` is assembled
     from its saved shard boxes and placed with the TARGET tensor's current
     sharding — the save-time and load-time meshes are independent.
+
+    strict=True (default): raise `MissingKeysError` listing every target
+    key the checkpoint holds no payload for (after filling all the keys it
+    does hold). strict=False keeps the live value for missing keys and
+    counts them on ``checkpoint_missing_keys_total``.
     """
     import jax
 
@@ -241,13 +483,16 @@ def load_state_dict(state_dict, path, process_group=None,
             hits += 1
         return hits
 
+    missing = []
     for key, target in state_dict.items():
         if key not in shard_meta:
+            missing.append(key)
             continue
         tarr = _to_array(target)
         global_shape = tuple(tarr.shape)
         boxes = _boxes_for(key)
         if not boxes:
+            missing.append(key)
             continue
 
         # 0-d tensors: single box, no slicing
@@ -255,6 +500,7 @@ def load_state_dict(state_dict, path, process_group=None,
             block = _payload(boxes[0][2]).get(
                 f"{key}|{','.join(map(str, boxes[0][0]))}")
             if block is None:
+                missing.append(key)
                 continue
             if isinstance(target, Tensor):
                 import jax.numpy as jnp
@@ -286,7 +532,8 @@ def load_state_dict(state_dict, path, process_group=None,
                 total_hits += _fill(buf, off, key, boxes)
                 bufs.append(jax.device_put(buf, sh.device))
             if total_hits == 0:
-                continue  # payload missing/mismatched: keep the live value
+                missing.append(key)  # payload missing: keep the live value
+                continue
             target._data = jax.make_array_from_single_device_arrays(
                 global_shape, sharding, bufs)
             continue
@@ -295,6 +542,7 @@ def load_state_dict(state_dict, path, process_group=None,
         out = np.zeros(global_shape,
                        tarr.dtype if hasattr(tarr, "dtype") else np.float32)
         if _fill(out, (0,) * len(global_shape), key, boxes) == 0:
+            missing.append(key)
             continue
         if isinstance(target, Tensor):
             import jax.numpy as jnp
@@ -305,6 +553,11 @@ def load_state_dict(state_dict, path, process_group=None,
             target._data = new
         else:
             np.copyto(state_dict[key], out)
+
+    if missing:
+        if strict:
+            raise MissingKeysError(missing, path)
+        _metrics()["missing_keys"].inc(len(missing))
     return state_dict
 
 
@@ -328,26 +581,21 @@ def optimizer_state_dict(model, optimizer):
     return out
 
 
-def save_checkpoint(path, model, optimizer=None, train_step=None,
-                    async_save=False):
-    """Sharded save of model (+ optimizer) training state.
-
-    Pass the live TrainStep/ShardedTrainStep as `train_step` so its
-    compiled-state slots are synced into the optimizer first."""
+def training_state_dict(model, optimizer=None, train_step=None):
+    """Model + optimizer state as one flat state_dict (the unit
+    CheckpointManager saves per step). Pass the live TrainStep/
+    ShardedTrainStep so its compiled-state slots are synced first."""
     if train_step is not None:
         train_step.sync_optimizer_state()
     state = dict(model.state_dict())
     if optimizer is not None:
         state.update(optimizer_state_dict(model, optimizer))
-    save_state_dict(state, path, async_save=async_save)
+    return state
 
 
-def load_checkpoint(path, model, optimizer=None):
-    """Reshard-on-load restore of model (+ optimizer) training state.
-
-    Works across topology changes: every target tensor's CURRENT sharding
-    decides which saved shards each rank reads. A subsequent TrainStep
-    seeds its compiled state from the restored slots (jit._init_opt_state)."""
+def _training_state_target(model, optimizer=None):
+    """(target state_dict, finalize) for restoring model + optimizer:
+    `finalize()` writes restored slot tensors back into the optimizer."""
     target = dict(model.state_dict())
     placeholders = {}
     if optimizer is not None:
@@ -360,7 +608,39 @@ def load_checkpoint(path, model, optimizer=None):
                 t = Tensor(_to_array(v))
                 target[f"opt.{n}.{k}"] = t
                 placeholders[(n, k, id(p))] = t
-    load_state_dict(target, path)
-    if optimizer is not None:
-        for (n, k, pid), t in placeholders.items():
-            optimizer._slots[pid][k] = t._data
+
+    def finalize():
+        if optimizer is not None:
+            for (n, k, pid), t in placeholders.items():
+                optimizer._slots[pid][k] = t._data
+
+    return target, finalize
+
+
+def save_checkpoint(path, model, optimizer=None, train_step=None,
+                    async_save=False):
+    """Sharded save of model (+ optimizer) training state.
+
+    Pass the live TrainStep/ShardedTrainStep as `train_step` so its
+    compiled-state slots are synced into the optimizer first."""
+    state = training_state_dict(model, optimizer, train_step)
+    save_state_dict(state, path, async_save=async_save)
+
+
+def load_checkpoint(path, model, optimizer=None, strict=True):
+    """Reshard-on-load restore of model (+ optimizer) training state.
+
+    Works across topology changes: every target tensor's CURRENT sharding
+    decides which saved shards each rank reads. A subsequent TrainStep
+    seeds its compiled state from the restored slots (jit._init_opt_state)."""
+    target, finalize = _training_state_target(model, optimizer)
+    load_state_dict(target, path, strict=strict)
+    finalize()
+
+
+from .manager import (  # noqa: E402,F401
+    CheckpointManager,
+    CheckpointValidationError,
+    NoCheckpointError,
+    PreemptionGuard,
+)

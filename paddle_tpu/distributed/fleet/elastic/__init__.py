@@ -40,6 +40,41 @@ def _store_int(raw: bytes) -> int:
         return int.from_bytes(raw, "little")
 
 
+# ---------------------------------------------------------------------------
+# Checkpoint auto-resume: the recovery half of restart-based elasticity.
+# A relaunched member (new generation, possibly new rank/world) calls
+# `auto_resume` with the job's checkpoint root; only COMMITTED steps are
+# considered (CheckpointManager's COMMIT/checksum contract), so a member
+# killed mid-save resumes from the previous good step instead of loading
+# the partial one — the failure mode this subsystem exists to survive.
+# ---------------------------------------------------------------------------
+def latest_checkpoint_step(ckpt_root):
+    """Newest committed step under `ckpt_root`, or None (fresh start)."""
+    from ...checkpoint.manager import CheckpointManager
+
+    return CheckpointManager(ckpt_root).latest_step()
+
+
+def auto_resume(ckpt_root, model=None, optimizer=None, strict=True):
+    """Resolve ``--resume auto`` after an elastic restart: restore the
+    newest committed-and-valid step into `model` (+ `optimizer`) and
+    return it, or None when no committed checkpoint exists. Validation
+    failures fall back to older committed steps (restore() semantics);
+    with `model=None` only the resume step is resolved."""
+    from ...checkpoint.manager import CheckpointManager, NoCheckpointError
+
+    mgr = CheckpointManager(ckpt_root)
+    if mgr.latest_step() is None:
+        return None
+    try:
+        if model is None:
+            return mgr.latest_step()
+        return mgr.restore_training_state(model, optimizer=optimizer,
+                                          strict=strict)
+    except NoCheckpointError:
+        return None
+
+
 class ElasticLevel:
     FAULT_TOLERANCE = 1
     ELASTIC = 2

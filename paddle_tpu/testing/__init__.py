@@ -1,0 +1,8 @@
+"""paddle_tpu.testing — fault-injection and test harness utilities.
+
+`chaos` is the fault-injection harness for the crash-safe checkpoint
+stack (docs/CHECKPOINT.md): kill training subprocesses at chosen steps,
+truncate/corrupt shard files, abort or delay checkpoint writes through
+the writer's fault seam.
+"""
+from . import chaos  # noqa: F401

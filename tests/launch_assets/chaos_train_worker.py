@@ -1,0 +1,92 @@
+"""Deterministic tiny training loop under crash-safe checkpointing.
+
+Driven by tests/test_chaos_resume.py through paddle_tpu.testing.chaos:
+prints one ``STEP <n> LOSS <hex>`` line per step where <hex> is the
+float32 loss bytes — string equality between runs IS bit-for-bit loss
+equality. Every step's state is saved through CheckpointManager (async
+by default); ``--resume auto`` restores the newest committed step via
+fleet.elastic.auto_resume, so a SIGKILLed run relaunched with the same
+arguments must reproduce the uninterrupted run's trajectory exactly.
+
+Chaos flags:
+  --die-during-save N   hard-exit (os._exit) the first checkpoint write
+                        of step N — a preemption landing mid-(async)save.
+  --sync-save           synchronous saves instead of the async writer.
+"""
+import argparse
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # before paddle_tpu/jax import
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.checkpoint.manager import (CheckpointManager,
+                                                       PreemptionGuard)
+from paddle_tpu.distributed.fleet.elastic import auto_resume
+
+
+def batch(step):
+    """Per-step data keyed by GLOBAL step number — identical whether the
+    step runs in the original process or after a resume."""
+    rng = np.random.default_rng(1000 + step)
+    x = rng.standard_normal((16, 8)).astype(np.float32)
+    y = rng.standard_normal((16, 4)).astype(np.float32)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--resume", choices=("auto", "none"), default="auto")
+    ap.add_argument("--keep", type=int, default=3)
+    ap.add_argument("--sync-save", action="store_true")
+    ap.add_argument("--die-during-save", type=int, default=None)
+    args = ap.parse_args()
+
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    manager = CheckpointManager(args.ckpt_dir, keep=args.keep)
+
+    start = 0
+    if args.resume == "auto":
+        start = auto_resume(args.ckpt_dir, model, opt) or 0
+        if start:
+            print(f"RESUMED {start}", flush=True)
+
+    with PreemptionGuard(manager) as guard:
+        for step in range(start + 1, args.steps + 1):
+            x, y = batch(step)
+            loss = nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+            if args.die_during_save == step:
+                from paddle_tpu.testing import chaos
+
+                ctx = chaos.die_during_write(match=".distcp")
+                ctx.__enter__()  # never exits: the next write hard-kills us
+
+            manager.save_training_state(step, model, opt,
+                                        async_save=not args.sync_save)
+            lhex = np.asarray(loss.numpy(), np.float32).tobytes().hex()
+            print(f"STEP {step} LOSS {lhex}", flush=True)
+
+            if guard.preempted:
+                # final synchronous save, then exit cleanly (rc 0)
+                manager.wait()
+                manager.save_training_state(step, model, opt)
+                print(f"PREEMPTED {step}", flush=True)
+                return
+
+    manager.wait()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

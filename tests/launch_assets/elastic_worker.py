@@ -1,32 +1,43 @@
 """Elastic scale-in worker: trains (simulated) to step 6 with
-checkpoint-resume; the last rank of generation 0 dies at step 3 to force
-the launcher's elastic re-rendezvous.
+crash-safe checkpoint-resume through CheckpointManager; the last rank of
+generation 0 dies at step 3 to force the launcher's elastic
+re-rendezvous. Recovery is the real subsystem path: only COMMITTED steps
+resume (a member killed mid-save falls back to the previous good step).
 """
-import json
 import os
 import sys
 import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # before paddle_tpu/jax import
+
+import numpy as np
+
+from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+from paddle_tpu.distributed.fleet.elastic import latest_checkpoint_step
 
 rank = int(os.environ["PADDLE_TRAINER_ID"])
 world = int(os.environ["PADDLE_TRAINERS_NUM"])
 gen = int(os.environ.get("PADDLE_ELASTIC_GENERATION", 0))
 
-CKPT = "ckpt.json"
+CKPT_ROOT = "ckpt_root"
 TARGET = 6
 
-start = 0
-if os.path.exists(CKPT):
-    with open(CKPT) as f:
-        start = json.load(f)["step"]
+start = latest_checkpoint_step(CKPT_ROOT) or 0
+manager = CheckpointManager(CKPT_ROOT, keep=3) if rank == 0 else None
 
 for step in range(start + 1, TARGET + 1):
     time.sleep(0.05)  # a "training step"
-    if rank == 0:  # coordinator checkpoints
-        tmp = CKPT + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"step": step, "gen": gen, "world": world}, f)
-        os.replace(tmp, CKPT)
+    if rank == 0:  # coordinator checkpoints (committed = resumable)
+        manager.save(step, {"progress": np.array([step, gen, world],
+                                                 np.int64)})
     if gen == 0 and rank == world - 1 and step == 3:
+        # die only once the coordinator has COMMITTED step 3, so the
+        # relaunch deterministically resumes from >= 3 (the commit
+        # marker is the readable signal — polling it IS the contract)
+        deadline = time.time() + 30
+        while (latest_checkpoint_step(CKPT_ROOT) or 0) < 3 \
+                and time.time() < deadline:
+            time.sleep(0.05)
         sys.stderr.write(f"rank {rank} simulating member death at step {step}\n")
         sys.exit(1)
 

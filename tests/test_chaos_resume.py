@@ -1,0 +1,119 @@
+"""Chaos-harness acceptance: a training run killed at an arbitrary step
+and resumed via CheckpointManager reproduces the uninterrupted run's
+loss trajectory BIT-FOR-BIT (losses compare as float32-byte hex), and no
+injected fault sequence ever loads a partial/corrupt checkpoint
+(docs/CHECKPOINT.md "Chaos harness")."""
+import os
+import random
+import signal
+import sys
+
+import pytest
+
+from paddle_tpu.testing import chaos
+
+WORKER = os.path.join(os.path.dirname(__file__), "launch_assets",
+                      "chaos_train_worker.py")
+STEPS = 6
+
+
+def _argv(ckpt_dir, *extra):
+    return [sys.executable, WORKER, "--ckpt-dir", str(ckpt_dir),
+            "--steps", str(STEPS), *extra]
+
+
+def _env():
+    env = chaos.subprocess_env()
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    return env
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """Loss trajectory of one uninterrupted run: {step: loss_hex}."""
+    d = tmp_path_factory.mktemp("chaos_ref")
+    lines, rc = chaos.run_to_completion(_argv(d / "ckpt"), env=_env())
+    assert rc == 0, lines[-10:]
+    assert "DONE" in lines
+    ref = chaos.step_losses(lines)
+    assert sorted(ref) == list(range(1, STEPS + 1)), lines
+    return ref
+
+
+def _resumed_start(lines):
+    for line in lines:
+        if line.startswith("RESUMED "):
+            return int(line.split()[1])
+    return 0
+
+
+def test_sigkill_at_random_step_then_resume_matches(tmp_path, reference):
+    """The headline acceptance: SIGKILL at a (seeded-)random step, resume
+    with --resume auto, and every step of both runs matches the
+    uninterrupted trajectory exactly."""
+    kill_step = random.Random(2024).randint(3, STEPS - 2)
+    ckpt = tmp_path / "ckpt"
+    killed_at, pre_lines, rc = chaos.run_until_step(
+        _argv(ckpt), kill_step=kill_step, sig=signal.SIGKILL, env=_env())
+    assert killed_at == kill_step
+    assert rc != 0  # SIGKILL: no cleanup, no atexit — the hard case
+    pre = chaos.step_losses(pre_lines)
+    assert pre, pre_lines
+    for s, v in pre.items():
+        assert reference[s] == v, f"pre-kill step {s} diverged"
+
+    lines, rc = chaos.run_to_completion(_argv(ckpt), env=_env())
+    assert rc == 0, lines[-10:]
+    start = _resumed_start(lines)
+    assert 1 <= start <= kill_step  # resumed from a committed step
+    post = chaos.step_losses(lines)
+    assert sorted(post) == list(range(start + 1, STEPS + 1))
+    for s, v in post.items():
+        assert reference[s] == v, f"resumed step {s} diverged"
+    # the two runs jointly reproduce the full trajectory
+    assert set(pre) | set(post) == set(range(1, STEPS + 1))
+
+
+@pytest.mark.slow  # subprocess-heavy; the guard's signal->final-save path
+                   # is tier-1-covered in-process (TestPreemptionGuard)
+def test_sigterm_preemption_saves_and_resumes(tmp_path, reference):
+    """SIGTERM = preemption notice: PreemptionGuard performs a final
+    synchronous save and the worker exits CLEANLY; the resumed run picks
+    up exactly at the preempted step."""
+    ckpt = tmp_path / "ckpt"
+    killed_at, pre_lines, rc = chaos.run_until_step(
+        _argv(ckpt), kill_step=3, sig=signal.SIGTERM, env=_env())
+    assert killed_at == 3
+    assert rc == 0, pre_lines[-10:]  # clean exit, not a crash
+    preempted = [ln for ln in pre_lines if ln.startswith("PREEMPTED ")]
+    assert preempted, pre_lines
+
+    lines, rc = chaos.run_to_completion(_argv(ckpt), env=_env())
+    assert rc == 0, lines[-10:]
+    start = _resumed_start(lines)
+    assert start == int(preempted[0].split()[1])  # nothing lost
+    for s, v in chaos.step_losses(lines).items():
+        assert reference[s] == v, f"resumed step {s} diverged"
+
+
+@pytest.mark.slow  # subprocess-heavy; interrupted-async-save commit
+                   # safety is tier-1-covered in-process (TestAsyncWriter)
+def test_death_mid_async_save_resumes_from_committed(tmp_path, reference):
+    """os._exit in the middle of an async shard write (interpreter gone,
+    no atexit): the torn step must stay invisible and the resumed run
+    must fall back to the last committed step, trajectory intact."""
+    kill_step = 4
+    ckpt = tmp_path / "ckpt"
+    lines, rc = chaos.run_to_completion(
+        _argv(ckpt, "--die-during-save", str(kill_step)), env=_env())
+    assert rc == 57, lines[-10:]  # chaos.die_during_write exit code
+
+    lines, rc = chaos.run_to_completion(_argv(ckpt), env=_env())
+    assert rc == 0, lines[-10:]
+    start = _resumed_start(lines)
+    assert start < kill_step  # the dying step never committed
+    post = chaos.step_losses(lines)
+    assert sorted(post) == list(range(start + 1, STEPS + 1))
+    for s, v in post.items():
+        assert reference[s] == v, f"resumed step {s} diverged"

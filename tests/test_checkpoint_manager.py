@@ -1,0 +1,371 @@
+"""Crash-safe CheckpointManager: commit protocol, checksums, retention,
+fallback restore, async-writer error propagation, preemption guard
+(docs/CHECKPOINT.md). Fault injection via paddle_tpu.testing.chaos."""
+import json
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.telemetry as telemetry
+from paddle_tpu import nn
+from paddle_tpu.distributed import checkpoint
+from paddle_tpu.distributed.checkpoint import (MissingKeysError,
+                                               checksum_bytes,
+                                               optimizer_state_dict)
+from paddle_tpu.distributed.checkpoint.manager import (
+    CheckpointManager, CheckpointValidationError, NoCheckpointError,
+    PreemptionGuard)
+from paddle_tpu.testing import chaos
+
+
+@pytest.fixture
+def metrics():
+    telemetry.enable()
+    telemetry.reset()
+    yield telemetry.get_registry()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _tensor(value, shape=(2, 3)):
+    return paddle.to_tensor(np.full(shape, value, np.float32))
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+class TestCommitProtocol:
+    def test_layout_commit_marker_and_checksums(self, tmp_path):
+        root = str(tmp_path / "root")
+        mgr = CheckpointManager(root)
+        mgr.save(7, {"w": _tensor(1.0)})
+        step_dir = mgr.step_dir(7)
+        assert os.path.isdir(step_dir)
+        assert sorted(os.listdir(step_dir)) == [
+            "0.metadata", "0_0.distcp", "COMMIT"]
+        with open(os.path.join(step_dir, "COMMIT")) as f:
+            manifest = json.load(f)
+        assert manifest["step"] == 7
+        # every file listed with a checksum that matches the bytes on disk
+        assert set(manifest["files"]) == {"0.metadata", "0_0.distcp"}
+        for fn, info in manifest["files"].items():
+            with open(os.path.join(step_dir, fn), "rb") as f:
+                data = f.read()
+            assert len(data) == info["nbytes"]
+            assert checksum_bytes(data) == info["value"]
+        assert mgr.validate_step(7) == []
+        # metadata itself records the shard file's checksum
+        metas = checkpoint._load_metadata(step_dir)
+        assert "0_0.distcp" in checkpoint.file_checksums_of(metas[0])
+
+    def test_uncommitted_step_is_invisible(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        mgr.save(1, {"w": _tensor(1.0)})
+        mgr.save(2, {"w": _tensor(2.0)})
+        os.unlink(os.path.join(mgr.step_dir(2), "COMMIT"))
+        assert mgr.latest_step() == 1
+        t = _tensor(0.0)
+        assert mgr.restore({"w": t}) == 1
+        np.testing.assert_array_equal(np.asarray(t._data),
+                                      np.full((2, 3), 1.0))
+
+    def test_no_committed_step_raises(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        with pytest.raises(NoCheckpointError):
+            mgr.restore({"w": _tensor(0.0)})
+        assert mgr.latest_step() is None
+
+
+class TestValidationFallback:
+    def _two_steps(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        mgr.save(1, {"w": _tensor(1.0)})
+        mgr.save(2, {"w": _tensor(2.0)})
+        return mgr
+
+    def test_truncated_newest_shard_falls_back(self, tmp_path, metrics):
+        """Satellite: crash-mid-save coverage — a torn newest shard must
+        never load; restore() refuses it and loads the previous step."""
+        mgr = self._two_steps(tmp_path)
+        chaos.truncate_file(chaos.newest_step_file(str(tmp_path / "root")))
+        t = _tensor(0.0)
+        assert mgr.restore({"w": t}) == 1
+        np.testing.assert_array_equal(np.asarray(t._data),
+                                      np.full((2, 3), 1.0))
+        fails = metrics.get("checkpoint_validation_failures_total")
+        assert fails.value() == 1
+        assert metrics.get("checkpoint_restores_total").value() == 1
+
+    def test_corrupted_shard_same_size_falls_back(self, tmp_path, metrics):
+        mgr = self._two_steps(tmp_path)
+        # size-preserving bit rot: only the checksum can catch this
+        chaos.corrupt_file(chaos.newest_step_file(str(tmp_path / "root")))
+        t = _tensor(0.0)
+        assert mgr.restore({"w": t}) == 1
+        problems = mgr.validate_step(2)
+        assert problems and "mismatch" in problems[0]
+
+    def test_corrupted_metadata_falls_back(self, tmp_path):
+        mgr = self._two_steps(tmp_path)
+        chaos.corrupt_file(
+            chaos.newest_step_file(str(tmp_path / "root"), ".metadata"))
+        assert mgr.restore({"w": _tensor(0.0)}) == 2 - 1
+
+    def test_explicit_invalid_step_raises(self, tmp_path):
+        mgr = self._two_steps(tmp_path)
+        chaos.truncate_file(chaos.newest_step_file(str(tmp_path / "root")))
+        with pytest.raises(CheckpointValidationError):
+            mgr.restore({"w": _tensor(0.0)}, step=2)
+
+
+class TestRetention:
+    def test_keep_and_keep_period(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "root"), keep=2, keep_period=4)
+        for s in range(1, 7):
+            mgr.save(s, {"w": _tensor(float(s))})
+        # newest 2 (5, 6) plus the period anchor (4) survive
+        assert mgr.all_steps() == [4, 5, 6]
+
+    def test_gc_removes_stale_uncommitted_debris(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "root"), keep=10)
+        mgr.save(1, {"w": _tensor(1.0)})
+        os.unlink(os.path.join(mgr.step_dir(1), "COMMIT"))  # crashed save
+        mgr.save(2, {"w": _tensor(2.0)})  # commit triggers gc
+        assert not os.path.isdir(mgr.step_dir(1))
+        assert mgr.all_steps() == [2]
+
+
+class TestAsyncWriter:
+    def test_async_save_then_restore(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        mgr.save(1, {"w": _tensor(3.0)}, async_save=True)
+        mgr.wait()
+        t = _tensor(0.0)
+        assert mgr.restore({"w": t}) == 1
+        np.testing.assert_array_equal(np.asarray(t._data),
+                                      np.full((2, 3), 3.0))
+
+    def test_async_failure_reraises_and_never_commits(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "root"),
+                                write_retries=0, retry_backoff=0.0)
+        with chaos.failing_writes(match=".distcp") as ctr:
+            mgr.save(1, {"w": _tensor(1.0)}, async_save=True)
+            with pytest.raises(OSError, match="chaos"):
+                mgr.wait()
+        assert ctr.fired >= 1
+        assert mgr.latest_step() is None  # no partial commit
+        mgr.save(2, {"w": _tensor(2.0)}, async_save=True)  # writer recovers
+        mgr.wait()
+        assert mgr.latest_step() == 2
+
+    def test_transient_oserror_retried(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "root"), retry_backoff=0.001)
+        with chaos.transient_write_errors(2) as ctr:
+            mgr.save(1, {"w": _tensor(1.0)})
+        assert ctr.fired == 2
+        assert mgr.validate_step(1) == []
+
+    def test_host_snapshot_before_async_write(self, tmp_path):
+        """Mutating the live tensor after save() returns must not leak
+        into the checkpoint: the state was snapshotted in save()."""
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        t = _tensor(1.0)
+        mgr.save(1, {"w": t}, async_save=True)
+        t._data = t._data + 100.0  # training continues immediately
+        mgr.wait()
+        out = _tensor(0.0)
+        mgr.restore({"w": out})
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.full((2, 3), 1.0))
+
+    def test_module_level_wait_async_save_reraises(self, tmp_path):
+        """Satellite: wait_async_save must re-raise the writer's
+        exception, not report success by silence."""
+        t = _tensor(1.0)
+        with chaos.failing_writes() as ctr:
+            checkpoint.save_state_dict({"t": t}, str(tmp_path / "flat"),
+                                       async_save=True, write_retries=0,
+                                       retry_backoff=0.0)
+            with pytest.raises(OSError, match="chaos"):
+                checkpoint.wait_async_save()
+        assert ctr.fired >= 1
+        assert checkpoint._PENDING == []  # drained, not stuck
+        # subsequent saves are healthy again
+        checkpoint.save_state_dict({"t": t}, str(tmp_path / "flat"),
+                                   async_save=True)
+        checkpoint.wait_async_save()
+
+
+class TestStrictLoad:
+    def test_strict_raises_listing_missing_keys(self, tmp_path):
+        path = str(tmp_path / "flat")
+        checkpoint.save_state_dict({"present": _tensor(5.0)}, path)
+        present, extra = _tensor(0.0), _tensor(7.0)
+        with pytest.raises(MissingKeysError) as ei:
+            checkpoint.load_state_dict(
+                {"present": present, "extra": extra}, path)
+        assert ei.value.missing == ["extra"]
+        # keys the checkpoint DOES hold were filled before the raise
+        np.testing.assert_array_equal(np.asarray(present._data),
+                                      np.full((2, 3), 5.0))
+
+    def test_non_strict_counts_and_keeps_live_value(self, tmp_path, metrics):
+        path = str(tmp_path / "flat")
+        checkpoint.save_state_dict({"present": _tensor(5.0)}, path)
+        extra = _tensor(7.0)
+        checkpoint.load_state_dict({"extra": extra}, path, strict=False)
+        np.testing.assert_array_equal(np.asarray(extra._data),
+                                      np.full((2, 3), 7.0))
+        assert metrics.get("checkpoint_missing_keys_total").value() == 1
+
+
+class TestReshardViaManager:
+    def test_roundtrip_across_changed_mesh(self, tmp_path):
+        """Satellite: reshard-on-load through the manager — save under
+        one mesh, restore into a different topology, exact bytes."""
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        w = np.arange(64 * 16, dtype=np.float32).reshape(64, 16)
+        t = paddle.to_tensor(w)
+        t._data = jax.device_put(
+            t._data, NamedSharding(_mesh((8,), ("dp",)), P("dp", None)))
+        mgr.save(3, {"w": t})
+
+        t2 = paddle.to_tensor(np.zeros_like(w))
+        t2._data = jax.device_put(
+            t2._data, NamedSharding(_mesh((4, 2), ("x", "y")), P("y", "x")))
+        assert mgr.restore({"w": t2}) == 3
+        np.testing.assert_array_equal(np.asarray(t2._data), w)
+        assert "y" in str(t2._data.sharding.spec)  # target sharding kept
+
+
+class TestTrainingState:
+    def test_model_and_optimizer_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=model.parameters())
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        y = paddle.to_tensor(np.zeros((4, 2), np.float32))
+        for _ in range(2):
+            loss = nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        mgr.save_training_state(5, model, opt)
+
+        paddle.seed(99)  # different init: must be fully overwritten
+        model2 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        opt2 = paddle.optimizer.Adam(learning_rate=0.01,
+                                     parameters=model2.parameters())
+        assert mgr.restore_training_state(model2, opt2) == 5
+        for (k1, v1), (k2, v2) in zip(model.state_dict().items(),
+                                      model2.state_dict().items()):
+            np.testing.assert_array_equal(np.asarray(v1._data),
+                                          np.asarray(v2._data), err_msg=k1)
+        slots1 = optimizer_state_dict(model, opt)
+        slots2 = optimizer_state_dict(model2, opt2)
+        assert slots1.keys() == slots2.keys() and slots1
+        for k in slots1:
+            np.testing.assert_array_equal(np.asarray(slots1[k]._data),
+                                          np.asarray(slots2[k]._data),
+                                          err_msg=k)
+
+
+class TestPreemptionGuard:
+    def test_sigterm_triggers_final_sync_save(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        t = _tensor(1.0)
+        with PreemptionGuard(mgr, signals=(signal.SIGTERM,)) as guard:
+            assert not guard.checkpoint_and_stop(1, {"w": t})
+            signal.raise_signal(signal.SIGTERM)
+            assert guard.preempted
+            assert guard.checkpoint_and_stop(2, {"w": t})
+        assert mgr.latest_step() == 2
+        assert mgr.validate_step(2) == []
+
+    def test_deadline_budget_stops_before_expiry(self):
+        guard = PreemptionGuard(max_seconds=0.05, margin=0.0)
+        assert not guard.should_stop()
+        time.sleep(0.08)
+        assert guard.should_stop()
+
+    def test_handlers_restored_on_exit(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with PreemptionGuard(signals=(signal.SIGTERM,)):
+            assert signal.getsignal(signal.SIGTERM) != before
+        assert signal.getsignal(signal.SIGTERM) == before
+
+
+class TestCkptInspect:
+    def _tool(self):
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "ckpt_inspect.py")
+        spec = importlib.util.spec_from_file_location("ckpt_inspect", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_validate_diff_and_corruption_gate(self, tmp_path, capsys):
+        tool = self._tool()
+        root = str(tmp_path / "root")
+        mgr = CheckpointManager(root)
+        mgr.save(1, {"w": _tensor(1.0), "b": _tensor(9.0, (4,))})
+        mgr.save(2, {"w": _tensor(2.0), "b": _tensor(9.0, (4,))})
+        assert tool.main([root]) == 0
+
+        report = tool.diff(root, 1, 2)
+        assert report["changed"] == ["w: content"]
+        assert report["identical"] == ["b"]
+        assert not report["added"] and not report["removed"]
+
+        # corruption gates CI: non-zero exit + the file named
+        chaos.corrupt_file(chaos.newest_step_file(root))
+        assert tool.main([root]) == 1
+        out = capsys.readouterr().out
+        assert "CORRUPT" in out and "0_0.distcp" in out
+
+    def test_uncommitted_reported_not_fatal(self, tmp_path, capsys):
+        tool = self._tool()
+        root = str(tmp_path / "root")
+        mgr = CheckpointManager(root)
+        mgr.save(1, {"w": _tensor(1.0)})
+        mgr.save(2, {"w": _tensor(2.0)})
+        os.unlink(os.path.join(mgr.step_dir(2), "COMMIT"))
+        assert tool.main([root]) == 0
+        assert "UNCOMMITTED" in capsys.readouterr().out
+
+    def test_explicit_step_gate_fails_on_missing_or_uncommitted(
+            self, tmp_path, capsys):
+        """--step N is a gate: 'that step does not exist' must not pass."""
+        tool = self._tool()
+        root = str(tmp_path / "root")
+        mgr = CheckpointManager(root)
+        mgr.save(1, {"w": _tensor(1.0)})
+        assert tool.main([root, "--step", "1"]) == 0
+        assert tool.main([root, "--step", "42"]) == 1  # never existed
+        os.unlink(os.path.join(mgr.step_dir(1), "COMMIT"))
+        assert tool.main([root, "--step", "1"]) == 1  # uncommitted
+        assert "INVALID" in capsys.readouterr().out
+
+
+class TestTelemetry:
+    def test_save_and_restore_metrics(self, tmp_path, metrics):
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        mgr.save(1, {"w": _tensor(1.0)})
+        mgr.restore({"w": _tensor(0.0)})
+        snap = telemetry.snapshot()
+        hist = snap["histograms"]["checkpoint_save_seconds"]["mode=sync"]
+        assert hist["count"] == 1
+        assert metrics.get("checkpoint_bytes_total").value() > 0
+        assert metrics.get("checkpoint_restores_total").value() == 1

@@ -77,19 +77,39 @@ def test_async_save(tmp_path):
     np.testing.assert_array_equal(np.asarray(t2._data), np.ones((4, 4)))
 
 
-def test_missing_key_is_skipped(tmp_path):
+def test_missing_key_strict_raises_lax_skips(tmp_path):
     import paddle_tpu as paddle
     from paddle_tpu.distributed import load_state_dict, save_state_dict
+    from paddle_tpu.distributed.checkpoint import MissingKeysError
 
     t = paddle.to_tensor(np.ones((2, 2), np.float32))
     path = str(tmp_path / "skip_ckpt")
     save_state_dict({"present": t}, path)
     extra = paddle.to_tensor(np.full((3,), 7.0, np.float32))
+    # default is strict: a key with no saved payload is an error that
+    # NAMES the missing keys
+    with pytest.raises(MissingKeysError) as ei:
+        load_state_dict({"present": paddle.zeros([2, 2]), "extra": extra},
+                        path)
+    assert ei.value.missing == ["extra"]
+    # strict=False keeps the live value (the old silent-continue behavior)
     out = load_state_dict({"present": paddle.zeros([2, 2]), "extra": extra},
-                          path)
+                          path, strict=False)
     np.testing.assert_array_equal(np.asarray(out["present"]._data),
                                   np.ones((2, 2)))
     np.testing.assert_array_equal(np.asarray(extra._data), np.full((3,), 7.0))
+
+
+def test_replicated_fallback_only_on_coordinator():
+    """Satellite: a fully-replicated value with no addressable replica-0
+    shard must be written by the coordinator rank only — every rank
+    writing it would land world-size copies of the bytes on disk."""
+    from paddle_tpu.distributed.checkpoint import _shard_boxes
+
+    a = np.ones((2, 2), np.float32)  # no .addressable_shards: fallback path
+    boxes = _shard_boxes(a, is_coordinator=True)
+    assert len(boxes) == 1 and boxes[0][0] == (0, 0)
+    assert _shard_boxes(a, is_coordinator=False) == []
 
 
 def test_cross_topology_model_checkpoint(tmp_path):

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Validate a CheckpointManager root offline, or diff two steps.
+
+Usage:
+    python tools/ckpt_inspect.py ROOT                # validate every step
+    python tools/ckpt_inspect.py ROOT --step 42      # one step
+    python tools/ckpt_inspect.py ROOT --diff 40 42   # what changed
+    python tools/ckpt_inspect.py ROOT --json         # machine-readable
+
+Validation goes one level deeper than the runtime's restore-time check
+(manager.validate_step): on top of COMMIT manifest presence, per-file
+size + CRC32C, and metadata unpicklability, it verifies
+metadata <-> shard-file COMPLETENESS — every shard box the metadata
+records must exist as a payload entry in its .distcp file, and every
+referenced shard file must be listed in the COMMIT manifest. Exit code
+is non-zero when any committed step fails validation, so this gates CI
+and ops runbooks (docs/CHECKPOINT.md). Uncommitted step directories are
+reported but are NOT failures — readers ignore them by contract (they
+are in-flight saves or crash debris awaiting GC).
+
+Diff mode compares two committed steps' metadata + payload bytes per
+key: added/removed keys, shape/dtype changes, and content changes
+(per-box checksums — no full-tensor assembly).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _manager(root):
+    from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+
+    return CheckpointManager(root)
+
+
+def _load_step(step_dir):
+    """(metadata list, {filename: payload dict}) for one step dir."""
+    from paddle_tpu.distributed.checkpoint import _load_metadata
+
+    metas = _load_metadata(step_dir)
+    payloads = {}
+    for fn in sorted(os.listdir(step_dir)):
+        if fn.endswith(".distcp"):
+            with open(os.path.join(step_dir, fn), "rb") as f:
+                payloads[fn] = pickle.load(f)
+    return metas, payloads
+
+
+def _completeness_problems(step_dir):
+    """metadata <-> shard-file cross-check (beyond checksums)."""
+    problems = []
+    try:
+        metas, payloads = _load_step(step_dir)
+    except Exception as e:
+        return [f"unreadable metadata/payload: {e!r}"]
+    for meta in metas:
+        for idx, fn in meta.storage_metadata.items():
+            payload = payloads.get(fn)
+            if payload is None:
+                problems.append(
+                    f"{idx.tensor_key!r}: shard file {fn} missing")
+                continue
+            pkey = f"{idx.tensor_key}|{','.join(map(str, idx.global_offset))}"
+            if pkey not in payload:
+                problems.append(
+                    f"{idx.tensor_key!r}: payload entry {pkey!r} missing "
+                    f"from {fn}")
+    return problems
+
+
+def validate(root, step=None):
+    """[{step, committed, problems}] for every (or one) step directory.
+
+    Walking the root, uncommitted directories are benign (in-flight or
+    debris readers ignore). An EXPLICITLY requested --step is a gate:
+    missing or uncommitted is a failure — the operator asked for THAT
+    step to be valid, and 'it does not exist' must not exit 0."""
+    mgr = _manager(root)
+    results = []
+    if step is not None:
+        problems = mgr.validate_step(step)
+        if not problems:
+            problems = _completeness_problems(mgr.step_dir(step))
+        results.append({"step": step, "committed": mgr.is_committed(step),
+                        "problems": problems})
+        return results
+    for s in mgr.all_steps(committed_only=False):
+        committed = mgr.is_committed(s)
+        if not committed:
+            results.append({"step": s, "committed": False, "problems": []})
+            continue
+        problems = mgr.validate_step(s)
+        if not problems:
+            problems = _completeness_problems(mgr.step_dir(s))
+        results.append({"step": s, "committed": True, "problems": problems})
+    return results
+
+
+def diff(root, step_a, step_b):
+    """Per-key comparison of two steps: added/removed/changed/identical."""
+    from paddle_tpu.distributed.checkpoint import checksum_bytes
+
+    mgr = _manager(root)
+
+    def _keys(step):
+        metas, payloads = _load_step(mgr.step_dir(step))
+        out = {}
+        for meta in metas:
+            for key, boxes in meta.state_dict_metadata.items():
+                digest = []
+                for m in boxes:
+                    idx_key = f"{key}|{','.join(map(str, m.global_offset))}"
+                    for payload in payloads.values():
+                        block = payload.get(idx_key)
+                        if block is not None:
+                            digest.append(
+                                (tuple(m.global_offset),
+                                 checksum_bytes(block.tobytes())))
+                            break
+                out[key] = {
+                    "shape": tuple(meta.flat_mapping.get(key, ())),
+                    "dtype": boxes[0].dtype if boxes else None,
+                    "digest": tuple(sorted(digest)),
+                }
+        return out
+
+    a, b = _keys(step_a), _keys(step_b)
+    report = {"added": sorted(set(b) - set(a)),
+              "removed": sorted(set(a) - set(b)),
+              "changed": [], "identical": []}
+    for key in sorted(set(a) & set(b)):
+        if a[key]["shape"] != b[key]["shape"]:
+            report["changed"].append(
+                f"{key}: shape {a[key]['shape']} -> {b[key]['shape']}")
+        elif a[key]["dtype"] != b[key]["dtype"]:
+            report["changed"].append(
+                f"{key}: dtype {a[key]['dtype']} -> {b[key]['dtype']}")
+        elif a[key]["digest"] != b[key]["digest"]:
+            report["changed"].append(f"{key}: content")
+        else:
+            report["identical"].append(key)
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="validate/diff a crash-safe checkpoint root")
+    ap.add_argument("root")
+    ap.add_argument("--step", type=int, default=None)
+    ap.add_argument("--diff", nargs=2, type=int, metavar=("A", "B"))
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.root):
+        print(f"ckpt_inspect: no such directory: {args.root}",
+              file=sys.stderr)
+        return 2
+
+    if args.diff:
+        report = diff(args.root, args.diff[0], args.diff[1])
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(f"diff step {args.diff[0]} -> step {args.diff[1]}:")
+            for k in ("added", "removed", "changed"):
+                for item in report[k]:
+                    print(f"  {k}: {item}")
+            print(f"  identical: {len(report['identical'])} key(s)")
+        return 0
+
+    results = validate(args.root, step=args.step)
+    if args.json:
+        print(json.dumps(results, indent=1))
+    else:
+        if not results:
+            print(f"{args.root}: no step directories")
+        for r in results:
+            if r["problems"]:
+                print(f"step {r['step']}: "
+                      f"{'CORRUPT' if r['committed'] else 'INVALID'}")
+                for p in r["problems"]:
+                    print(f"  - {p}")
+            elif not r["committed"]:
+                print(f"step {r['step']}: UNCOMMITTED "
+                      f"(invisible to readers; in-flight or crash debris)")
+            else:
+                print(f"step {r['step']}: OK")
+    return 1 if any(r["problems"] for r in results) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
