@@ -38,12 +38,13 @@ def run_model(model_kind, ckpt=None):
     if on_tpu:
         # Tuned defaults (measured on v5e; r3 sweep + r4 sweep):
         # - Pallas rms kernel with saved rstd residual (+3.1% MFU, r3)
-        # - int8 weight-only LM head (+0.8-1.1%, r4; parity test bounds
-        #   the loss shift <2%, tests/test_incubate_functional.py)
+        # - int8 weight-only LM head: no longer force-set here — the
+        #   chunked-CE head turns it on by default WHEN the numeric
+        #   parity gate passes (fused_cross_entropy.int8_head_enabled;
+        #   PTPU_INT8_HEAD still forces either way)
         # - flash fwd block 2048 (+0.6%, r4; bwd stays 1024 — uniform
         #   2048 bwd compile-OOMs, decoupled q/k blocks measured worse)
         os.environ.setdefault("PTPU_PALLAS_RMS", "1")
-        os.environ.setdefault("PTPU_INT8_HEAD", "1")
         os.environ.setdefault("PTPU_FA_BLOCK", "2048")
         # r5: factored second-moment AdamW frees the m2 state (~2.6GB at
         # 1.3B); the headroom buys BOTH ffn saves at batch 3 — the
@@ -97,6 +98,16 @@ def run_model(model_kind, ckpt=None):
         )
     env_batch = os.environ.get("PTPU_BENCH_BATCH")
     env_remat = os.environ.get("PTPU_BENCH_REMAT")
+    env_hchunk = os.environ.get("PTPU_BENCH_HEAD_CHUNK")
+    # fused-CE head chunk: a third plan dimension. Bigger chunks = fewer
+    # serialized LSE scan steps; the resident [tokens, chunk] fp32 block
+    # is what memory_analysis prices against batch/remat headroom.
+    if env_hchunk:
+        hchunk_grid = (int(env_hchunk),)
+    elif on_tpu:
+        hchunk_grid = (16384, 8192)
+    else:
+        hchunk_grid = (256,)  # CPU smoke: multiple chunks over vocab 512
 
     # stacked-decoder flagship: lax.scan over layers keeps compile time
     # constant in depth; recompute = jax.checkpoint per block
@@ -124,19 +135,25 @@ def run_model(model_kind, ckpt=None):
     from paddle_tpu import memory as pmem
 
     if env_batch and env_remat:
-        candidates = [pmem.Candidate(int(env_batch), env_remat)]
+        # reproduce path: only pin the head chunk when the sweep pinned it
+        # too — otherwise keep the kernel default the recorded round used
+        candidates = [pmem.Candidate(
+            int(env_batch), env_remat,
+            head_chunk=int(env_hchunk) if env_hchunk else None)]
         require_fit = False  # trust the sweep; still price + record it
     else:
         candidates = [
-            pmem.Candidate(b, p)
+            pmem.Candidate(b, p, head_chunk=hc)
             for b in ((int(env_batch),) if env_batch else batch_grid)
             for p in ((env_remat,) if env_remat else policy_grid)
+            for hc in hchunk_grid
         ]
         require_fit = True
 
     def step_factory(cand):
         cfg.recompute = cand.policy != "none"
         cfg.recompute_policy = cand.policy
+        cfg.head_chunk = cand.head_chunk
         s = TrainStep(model, train_fn, opt)
         return s, (jax.ShapeDtypeStruct((cand.batch, seq), jax.numpy.int32),
                    jax.ShapeDtypeStruct((cand.batch, seq), jax.numpy.int64))
@@ -158,7 +175,9 @@ def run_model(model_kind, ckpt=None):
         for k in ("PTPU_ADAM_FACTORED", "PTPU_ADAM8", "PTPU_INT8_HEAD",
                   "PTPU_PALLAS_RMS", "PTPU_FUSED_ADDRMS", "PTPU_INT8_FFN",
                   "PTPU_FA_BLOCK", "PTPU_FA_BWD_BLOCK",
-                  "PTPU_UNROLL_LAYERS", "PTPU_CE_CHUNK", "PTPU_ROPE_HOIST"))
+                  "PTPU_UNROLL_LAYERS", "PTPU_CE_CHUNK", "PTPU_CE_VCHUNK",
+                  "PTPU_LOSS_HEAD", "PTPU_ROPE_HOIST")
+    ) + (("int8_head", F.int8_head_enabled()),)  # gate outcome, not just env
     decision = pmem.plan_train_step(
         step_factory, candidates, require_fit=require_fit,
         act_bytes_fn=act_bytes,
@@ -171,6 +190,7 @@ def run_model(model_kind, ckpt=None):
     batch = decision.batch
     cfg.recompute = decision.policy != "none"
     cfg.recompute_policy = decision.policy
+    cfg.head_chunk = decision.head_chunk
 
     # NOTE: on a plan-cache miss the winning program compiles twice (once
     # AOT in the planner, once here at warmup — jit's dispatch cache is
@@ -278,6 +298,10 @@ def run_model(model_kind, ckpt=None):
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu, 4),
+        # explicit MFU field (same value as vs_baseline, which predates
+        # it): model FLOPs 6*params*tokens/sec over the chip's bf16 peak
+        # from the small chip table above — the driver-tracked headline
+        "mfu": round(mfu, 4),
         # planner decision + XLA memory_analysis peak: a BENCH_r*.json
         # regression explains its memory state the same way the
         # "telemetry" key explains its time (tools/hbm_report.py diffs

@@ -65,14 +65,32 @@ class ShardedTrainStep(TrainStep):
     """
 
     def __init__(self, model, train_fn, optimizer, mesh: ProcessMesh,
-                 scaler=None, shard_opt_states=False):
+                 scaler=None, shard_opt_states=False, shard_vocab_head=None):
         super().__init__(model, train_fn, optimizer, scaler)
         self.mesh = mesh
         self.shard_opt_states = shard_opt_states
+        # vocab-sharded LM head ("last-stage-sharded pipeline output"):
+        # an axis name places the tied head's vocab dim over that tp axis
+        # via model.shard_lm_head, routing the loss through the
+        # scalars-per-token sharded CE (models/gpt.py compute_loss). None
+        # defers to PTPU_SHARDED_HEAD=<axis|1> (1 -> "mp"); default off so
+        # existing mp meshes keep their lowered programs bit-stable.
+        if shard_vocab_head is None:
+            import os
+
+            env = os.environ.get("PTPU_SHARDED_HEAD", "")
+            shard_vocab_head = ("mp" if env == "1"
+                                else env if env not in ("", "0") else None)
+        self.shard_vocab_head = shard_vocab_head
         self._placed = False
 
     # -- placement ---------------------------------------------------------
     def _place_model(self):
+        ax = self.shard_vocab_head
+        if (ax and ax in self.mesh.dim_names
+                and self.mesh.get_dim_size(ax) > 1
+                and hasattr(self.model, "shard_lm_head")):
+            self.model.shard_lm_head(self.mesh, axis=ax)
         entries = self.model.state_dict()
         for name, t in entries.items():
             sh = _param_sharding(self.mesh, t)

@@ -161,12 +161,13 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
 
 
 def _quantize_rows_int8(a):
-    """Per-row absmax int8 quantisation: a [R, H] -> (q int8, scale [R,1])."""
-    s = jnp.maximum(jnp.max(jnp.abs(a.astype(jnp.float32)), -1,
-                            keepdims=True) / 127.0, 1e-12)
-    q = jnp.clip(jnp.round(a.astype(jnp.float32) / s),
-                 -127, 127).astype(jnp.int8)
-    return q, s
+    """Per-row absmax int8 quantisation: a [R, H] -> (q int8, scale [R,1]).
+    ONE implementation, shared with the chunked-CE head — the int8 parity
+    gate probes the same quantizer every int8 path runs (lazy import: the
+    fused-CE module is a leaf, but this package loads early)."""
+    from ....nn.functional.fused_cross_entropy import _quantize_rows
+
+    return _quantize_rows(a)
 
 
 @jax.custom_vjp
@@ -254,7 +255,11 @@ def fused_linear_cross_entropy(x, weight, labels, transpose_y=True,
         ms = valid.astype(jnp.float32).reshape(-1, c)
 
         spec = "ch,vh->cv" if transpose_y else "ch,hv->cv"
-        int8_head = bool(_os.environ.get("PTPU_INT8_HEAD"))
+        # parity-gated default (PTPU_INT8_HEAD forces either way) — the
+        # same resolver as the chunked-CE head, docs/PERF.md
+        from ....nn.functional.fused_cross_entropy import int8_head_enabled
+
+        int8_head = int8_head_enabled()
         if int8_head:
             # quantise the [V, H] weight ONCE for all chunks (and their
             # checkpointed backward recomputes)

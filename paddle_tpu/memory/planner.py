@@ -57,11 +57,16 @@ class MemoryPlanError(RuntimeError):
 
 @dataclasses.dataclass(frozen=True)
 class Candidate:
-    """One point of the batch x remat grid. ``score`` overrides the
-    default throughput estimate (higher = preferred)."""
+    """One point of the batch x remat x head-chunk grid. ``score``
+    overrides the default throughput estimate (higher = preferred).
+    ``head_chunk`` is the fused-CE vocab-chunk size (None = the kernel
+    default) — larger chunks mean fewer serialized LSE scan steps but a
+    bigger resident [tokens, chunk] fp32 block, so it trades against
+    batch/remat inside the same HBM budget."""
     batch: int
     policy: str
     score: float | None = None
+    head_chunk: int | None = None
 
 
 @dataclasses.dataclass
@@ -79,6 +84,7 @@ class PlanDecision:
     act_int8_bytes: int | None = None
     opt_state_bytes: int | None = None
     candidates: list = dataclasses.field(default_factory=list)
+    head_chunk: int | None = None
 
     def as_json(self):
         """The bench JSON ``"memory"`` block (docs/MEMORY.md contract)."""
@@ -160,13 +166,21 @@ def policy_coverage(policy):
     return 0.0
 
 
-def throughput_score(batch, policy):
+def throughput_score(batch, policy, head_chunk=None):
     """MFU-shaped estimate: useful FLOPs per token are 3F (fwd+bwd), the
     replay re-runs (1 - coverage)F of them, and larger batches buy mildly
     better MXU efficiency. Calibrated on r4/r5: b3 + full ffn saves must
-    outrank b4 without them (measured 0.5629 vs 0.5468)."""
+    outrank b4 without them (measured 0.5629 vs 0.5468). A larger CE
+    head chunk nudges the score up (fewer serialized LSE scan steps —
+    only a ranking tiebreak, the HBM cost is what memory_analysis
+    prices)."""
+    import math
+
     cov = policy_coverage(policy)
-    return 3.0 / (4.0 - cov) * (1.0 + 0.03 * int(batch))
+    score = 3.0 / (4.0 - cov) * (1.0 + 0.03 * int(batch))
+    if head_chunk:
+        score *= 1.0 + 0.004 * math.log2(max(int(head_chunk), 1) / 1024.0)
+    return score
 
 
 # -- activation-byte estimate (telemetry + bench JSON) ----------------------
@@ -289,9 +303,11 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
     order = sorted(
         candidates,
         key=lambda c: (c.score if c.score is not None
-                       else throughput_score(c.batch, c.policy)),
+                       else throughput_score(c.batch, c.policy,
+                                             getattr(c, "head_chunk", None))),
         reverse=True)
-    grid = [(c.batch, c.policy) for c in order]
+    grid = [(c.batch, c.policy, getattr(c, "head_chunk", None))
+            for c in order]
     key = hashlib.sha1(repr(
         (chip, ndev, budget, tuple(cache_extra), grid, require_fit)
     ).encode()).hexdigest()[:16]
@@ -310,7 +326,8 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
     chosen = None
     for cand in order:
         score = (cand.score if cand.score is not None
-                 else throughput_score(cand.batch, cand.policy))
+                 else throughput_score(cand.batch, cand.policy,
+                                       getattr(cand, "head_chunk", None)))
         step, batch_avals = step_factory(cand)
         # label this step's build as a planning compile so the recompile
         # watchdog's per-function counts stay meaningful (jit._build)
@@ -320,11 +337,13 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
         except Exception as e:  # lowering/compile failure = not plannable
             _PLAN_EVALS.inc(labels=("error",))
             evaluated.append({"batch": cand.batch, "policy": cand.policy,
+                              "head_chunk": getattr(cand, "head_chunk", None),
                               "score": score, "error": str(e)[:200]})
             continue
         fits = mem["peak_bytes"] <= budget
         _PLAN_EVALS.inc(labels=("fit" if fits else "over_budget",))
         evaluated.append({"batch": cand.batch, "policy": cand.policy,
+                          "head_chunk": getattr(cand, "head_chunk", None),
                           "score": score, "peak_bytes": mem["peak_bytes"],
                           "fits": fits})
         if fits or not require_fit:
@@ -338,6 +357,7 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
     cand, mem, score, fits = chosen
     decision = PlanDecision(
         batch=cand.batch, policy=cand.policy,
+        head_chunk=getattr(cand, "head_chunk", None),
         peak_bytes=int(mem["peak_bytes"]), budget_bytes=int(budget),
         fits=bool(fits), score=float(score),
         source="planner" if require_fit else "env-override",

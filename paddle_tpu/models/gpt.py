@@ -42,6 +42,7 @@ class GPTConfig:
         recompute_policy="full",
         pp_interleave=1,
         pp_schedule="1f1b",
+        head_chunk=None,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -70,6 +71,10 @@ class GPTConfig:
         # weight grads batched bubble-free after it — ZB-H1 analogue,
         # reference passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62)
         self.pp_schedule = pp_schedule
+        # vocab-chunk size of the fused CE head (None = PTPU_CE_VCHUNK or
+        # the module default; a memory-planner plan dimension alongside
+        # batch x remat — docs/PERF.md)
+        self.head_chunk = head_chunk
 
 
 def llama_config(size="7b", **overrides):
@@ -83,6 +88,76 @@ def llama_config(size="7b", **overrides):
     cfg = presets[size]
     cfg.update(overrides)
     return GPTConfig(**cfg)
+
+
+def compute_loss(hidden, weight, labels, *, config=None, transpose_y=True,
+                 ignore_index=-100):
+    """LM-head matmul + CE dispatch — the ONE loss-head entry every GPT
+    variant shares. Paths (telemetry gauge ``loss_head_mode``):
+
+    - **chunked** (default): blockwise-LSE fused head
+      (`nn.functional.fused_cross_entropy`) — neither the fp32 logits nor
+      the grad-logits ``[tokens, vocab]`` tensor ever exists in HBM.
+    - **sharded**: the vocab-sharded variant, selected when the head
+      weight carries a ``_vocab_shard_axis`` marker
+      (:meth:`GPTForCausalLMPipe.shard_lm_head`) over a live mesh axis —
+      each tp shard reduces (max, lse, gold) scalars per token, never a
+      logits all-gather.
+    - **dense**: the reference path (full logits + ``F.cross_entropy``),
+      kept for A/B and as the parity oracle.
+
+    ``PTPU_LOSS_HEAD`` forces a path; the int8 head rides on the chunked/
+    sharded kernels via the parity-gated default
+    (``fused_cross_entropy.int8_head_enabled``). The chunk size comes
+    from ``config.head_chunk`` (a planner dimension) or ``PTPU_CE_VCHUNK``.
+    """
+    from paddle_tpu.nn.functional import fused_cross_entropy as FCE
+
+    mode = os.environ.get("PTPU_LOSS_HEAD", "").strip().lower()
+    if mode not in ("", "dense", "chunked", "sharded"):
+        raise ValueError(
+            f"PTPU_LOSS_HEAD={mode!r}: expected dense|chunked|sharded")
+    chunk = getattr(config, "head_chunk", None) if config is not None else None
+    vocab = weight.shape[0] if transpose_y else weight.shape[-1]
+
+    axis = getattr(weight, "_vocab_shard_axis", None)
+    mesh = None
+    if axis is not None and mode in ("", "sharded"):
+        # the mesh the head was SHARDED over (shard_lm_head records it in
+        # the weight's dist_attr) — not the ambient global mesh, which can
+        # be absent or a different object under an explicit
+        # ShardedTrainStep(mesh=...)
+        da = getattr(weight, "_dist_attr", None)
+        mesh = da.process_mesh if da is not None else None
+        if mesh is None:
+            from paddle_tpu.distributed.fleet import active_mesh
+
+            mesh = active_mesh()
+        if (mesh is None or axis not in mesh.dim_names
+                or mesh.get_dim_size(axis) <= 1):
+            axis, mesh = None, None
+    if mode == "sharded" and axis is None:
+        raise ValueError(
+            "PTPU_LOSS_HEAD=sharded but the head weight carries no live "
+            "_vocab_shard_axis marker — call shard_lm_head(mesh, axis) "
+            "(or ShardedTrainStep(shard_vocab_head=...)) first")
+    if mode == "chunked":
+        axis, mesh = None, None
+
+    if mode == "dense":
+        n_tokens = 1
+        for s in labels.shape:
+            n_tokens *= int(s)
+        FCE.record_head_mode("dense", False, n_tokens, vocab)
+        logits = (paddle.matmul(hidden, weight, transpose_y=True)
+                  if transpose_y else paddle.matmul(hidden, weight))
+        return F.cross_entropy(
+            logits.reshape([-1, vocab]), labels.reshape([-1]),
+            ignore_index=ignore_index)
+
+    return FCE.fused_chunked_cross_entropy(
+        hidden, weight, labels, transpose_y=transpose_y, vocab_chunk=chunk,
+        ignore_index=ignore_index, mesh=mesh, tp_axis=axis)
 
 
 class Attention(nn.Layer):
@@ -197,11 +272,15 @@ class GPTForCausalLM(nn.Layer):
         return self.lm_head(hidden)
 
     def loss(self, input_ids, labels):
-        logits = self(input_ids)
-        return F.cross_entropy(
-            logits.reshape([-1, self.config.vocab_size]),
-            labels.reshape([-1]),
-        )
+        """Fused chunked-head LM loss: the [N, vocab] logits tensor never
+        materializes (compute_loss dispatch; PTPU_LOSS_HEAD=dense restores
+        the reference full-logits path)."""
+        hidden = self.model(input_ids)
+        if self.lm_head is None:
+            return compute_loss(hidden, self.model.embed_tokens.weight,
+                                labels, config=self.config, transpose_y=True)
+        return compute_loss(hidden, self.lm_head.weight, labels,
+                            config=self.config, transpose_y=False)
 
 
 def causal_lm_loss(model, batch):
@@ -676,14 +755,44 @@ class GPTForCausalLMPipe(nn.Layer):
         return paddle.matmul(x, self.embed_tokens.weight, transpose_y=True)
 
     def loss(self, input_ids, labels):
-        """Fused tied-head LM loss: hidden @ embed^T + CE computed in row
-        chunks so the full [N, vocab] logits never hit HBM (the fp32 logits
-        copy alone is ~1GB at 1.3B/seq2048/batch4)."""
+        """Fused tied-head LM loss: hidden @ embed^T + CE computed
+        blockwise over VOCAB chunks (custom_vjp recomputes per-chunk
+        logits in backward), so neither the fp32 logits nor the
+        grad-logits [N, vocab] tensor ever hits HBM — ~1GB+1GB per
+        microbatch at 1.3B/seq2048/batch4. With a vocab-sharded head
+        (shard_lm_head) each tp shard reduces scalars per token instead
+        of all-gathering logits."""
         x = self.embed_tokens(input_ids)
         x = self.decoder(x)
         x = self.final_norm(x)
-        return FF.fused_linear_cross_entropy(
-            x, self.embed_tokens.weight, labels, transpose_y=True)
+        return compute_loss(x, self.embed_tokens.weight, labels,
+                            config=self.config, transpose_y=True)
+
+    def shard_lm_head(self, mesh, axis="mp"):
+        """Last-stage-sharded pipeline output: place the tied
+        head/embedding's VOCAB dim over the tensor-parallel axis instead
+        of replicating it. The loss path (compute_loss) sees the marker
+        and switches to the vocab-sharded CE — partial per-shard
+        (max, lse, gold) combined with psum of scalars per token; on a
+        pp mesh the last stage then holds 1/tp of the head instead of a
+        full replica. Embedding lookups against the sharded table lower
+        to GSPMD's gather+collective (the Megatron parallel-vocab
+        recipe)."""
+        from paddle_tpu.distributed.auto_parallel import (
+            Replicate, Shard, TensorDistAttr)
+
+        if axis not in mesh.dim_names or mesh.get_dim_size(axis) <= 1:
+            return self
+        if self.config.vocab_size % mesh.get_dim_size(axis) != 0:
+            raise ValueError(
+                f"the {axis!r} mesh axis (size {mesh.get_dim_size(axis)}) "
+                f"must divide vocab_size ({self.config.vocab_size})")
+        w = self.embed_tokens.weight
+        placements = [Replicate() for _ in mesh.dim_names]
+        placements[mesh.dim_names.index(axis)] = Shard(0)
+        w._dist_attr = TensorDistAttr(mesh, placements)
+        w._vocab_shard_axis = axis
+        return self
 
     def _decode_params(self):
         """Per-layer slices of the stacked decoder weights — the serving/

@@ -19,6 +19,13 @@ from .flash_attention import (  # noqa: F401
     scaled_dot_product_attention,
     sdp_kernel,
 )
+from .fused_cross_entropy import (  # noqa: F401
+    chunked_lm_loss_arrays,
+    fused_chunked_cross_entropy,
+    int8_head_enabled,
+    int8_head_gate,
+    sharded_lm_loss_arrays,
+)
 
 from ...ops.manipulation import pad as _ops_pad  # noqa: F401
 from .compat import *  # noqa: F401,F403

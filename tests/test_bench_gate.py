@@ -1,0 +1,62 @@
+"""tools/bench_gate.py — the CI tokens/sec regression gate (ISSUE 4)."""
+import json
+
+import tools.bench_gate as bg
+
+
+def _round(tmp_path, name, metrics):
+    tail = "log noise\n" + "\n".join(
+        json.dumps({"metric": m, "value": v, "unit": "tokens/sec/chip",
+                    "mfu": 0.5}) for m, v in metrics.items())
+    p = tmp_path / name
+    p.write_text(json.dumps({"n": 5, "cmd": "python bench.py", "rc": 0,
+                             "tail": tail, "parsed": {}}))
+    return str(p)
+
+
+def test_loads_driver_round_and_raw_formats(tmp_path):
+    p = _round(tmp_path, "BENCH_r07.json", {"m": 100.0})
+    assert bg.load_metrics(p)["m"]["value"] == 100.0
+    raw = tmp_path / "raw.json"
+    raw.write_text('junk\n{"metric": "m", "value": 7.5}\n')
+    assert bg.load_metrics(str(raw))["m"]["value"] == 7.5
+
+
+def test_pass_within_threshold(tmp_path, capsys):
+    old = _round(tmp_path, "BENCH_r01.json", {"m": 100.0, "k": 50.0})
+    new = _round(tmp_path, "BENCH_r02.json", {"m": 96.0, "k": 55.0})
+    assert bg.main([new, "--against", old]) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out or "DOWN" in out
+
+
+def test_fails_on_regression_over_threshold(tmp_path, capsys):
+    old = _round(tmp_path, "BENCH_r01.json", {"m": 100.0})
+    new = _round(tmp_path, "BENCH_r02.json", {"m": 90.0})
+    assert bg.main([new, "--against", old]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    # a looser threshold lets the same pair pass
+    assert bg.main([new, "--against", old, "--threshold", "0.15"]) == 0
+
+
+def test_new_metric_is_not_gated(tmp_path):
+    old = _round(tmp_path, "BENCH_r01.json", {"m": 100.0})
+    new = _round(tmp_path, "BENCH_r02.json", {"m": 101.0, "fresh": 10.0})
+    assert bg.main([new, "--against", old]) == 0
+
+
+def test_discovers_latest_round_in_root(tmp_path):
+    _round(tmp_path, "BENCH_r01.json", {"m": 100.0})
+    _round(tmp_path, "BENCH_r02.json", {"m": 99.0})   # -1%: inside 5%
+    assert bg.main(["--root", str(tmp_path)]) == 0
+    _round(tmp_path, "BENCH_r03.json", {"m": 80.0})   # -19.2% vs r02
+    assert bg.main(["--root", str(tmp_path)]) == 1
+
+
+def test_baseline_without_numbers_is_skipped(tmp_path, capsys):
+    new = _round(tmp_path, "BENCH_r02.json", {"m": 100.0})
+    base = tmp_path / "BASELINE.json"
+    base.write_text(json.dumps({"metric": "description only",
+                                "published": {}}))
+    assert bg.main([new, "--against", str(base)]) == 0
+    assert "skipped" in capsys.readouterr().out

@@ -48,6 +48,7 @@ def _resumed_start(lines):
     return 0
 
 
+@pytest.mark.slow  # subprocess chaos; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_sigkill_at_random_step_then_resume_matches(tmp_path, reference):
     """The headline acceptance: SIGKILL at a (seeded-)random step, resume
     with --resume auto, and every step of both runs matches the

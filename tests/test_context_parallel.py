@@ -204,6 +204,7 @@ def test_ulysses_with_pallas_flash_kernel(causal):
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow  # ring-attention soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_attention_matches_dense(causal):
     """The flash-kernel ring (custom fwd lse-merge + custom ring backward)
@@ -250,6 +251,7 @@ def test_ring_flash_attention_matches_dense(causal):
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow  # ring-attention soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_ring_flash_gqa():
     """GQA (kv heads < q heads) through the flash ring."""
     from paddle_tpu.distributed.context_parallel import ring_flash_attention

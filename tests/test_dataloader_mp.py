@@ -59,6 +59,7 @@ def test_worker_info_and_init_fn():
     assert ids.issubset({0, 1}), ids
 
 
+@pytest.mark.slow  # subprocess worker; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_worker_death_raises_instead_of_hanging():
     import paddle_tpu  # noqa: F401
     from paddle_tpu.io import Dataset

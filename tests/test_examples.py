@@ -10,9 +10,11 @@ _EX = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 
 @pytest.mark.parametrize("script", [
-    "01_train_mnist.py",
+    # 01/03 are slow-marked subprocess runs (tier-1 time budget, ISSUE 4);
+    # 02 stays tier-1 so the driver keeps eyes on its known 3-axis failure
+    pytest.param("01_train_mnist.py", marks=pytest.mark.slow),
     "02_pretrain_gpt_hybrid.py",
-    "03_serve_llm.py",
+    pytest.param("03_serve_llm.py", marks=pytest.mark.slow),
 ])
 def test_example_runs(script):
     env = dict(os.environ)

@@ -39,6 +39,7 @@ def test_launch_two_procs_env(tmp_path):
     assert (tmp_path / "log" / "default.0.log").exists()
 
 
+@pytest.mark.slow  # subprocess launch; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_launch_restart_on_failure(tmp_path):
     body = """
 import os, pathlib
@@ -59,6 +60,7 @@ def test_launch_failure_reports_log(tmp_path):
     assert "boom-marker" in r.stderr
 
 
+@pytest.mark.slow  # subprocess launch; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_launch_master_rank_autoassign(tmp_path):
     # nnodes=2 simulated locally: two launchers share one master store
     import threading

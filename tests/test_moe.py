@@ -154,6 +154,7 @@ def test_moe_expert_parallel_train_step():
     fleet._reset_for_tests()
 
 
+@pytest.mark.slow  # EP train soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_moe_gpt_trains_with_expert_parallel():
     import paddle_tpu as paddle
     from paddle_tpu.distributed import fleet

@@ -67,6 +67,7 @@ def test_max_unpool2d_roundtrip():
     assert r.sum() == float(pooled.numpy().sum())
 
 
+@pytest.mark.slow  # rnnt dp soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_rnnt_loss_finite_and_grad():
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F

@@ -39,6 +39,7 @@ def test_flash_attention_forward(shape, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # interpret-mode kernel grads; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_grads(causal):
     from paddle_tpu.ops.pallas import flash_attention
@@ -144,6 +145,7 @@ def test_flash_attention_causal_decode_offset():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # interpret-mode kernel grads; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_flash_attention_gqa_grads():
     from paddle_tpu.ops.pallas import flash_attention
 
@@ -224,6 +226,7 @@ def test_fused_rms_norm_residual_tuple_contract():
         res_ln.numpy(), x.numpy() + res.numpy(), atol=1e-6)
 
 
+@pytest.mark.slow  # interpret-mode kernel grads; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 @pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("seq,block", [(256, None), (1024, 128)])
 def test_flash_fused_bwd_matches_split(causal, seq, block, monkeypatch):

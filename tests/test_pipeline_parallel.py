@@ -703,6 +703,7 @@ class TestZeroBubble:
         np.testing.assert_allclose(np.asarray(gx_zb), np.asarray(gx_ad),
                                    atol=1e-5)
 
+    @pytest.mark.slow  # pp soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
     def test_interleaved_zb_matches_ad_interleaved(self):
         from paddle_tpu.distributed.pipeline import (
             microbatch, spmd_pipeline_interleaved,
@@ -780,6 +781,7 @@ class TestZeroBubble:
         np.testing.assert_allclose(l_zb, l_ad, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # pp soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_flagship_zb_interleaved_config_path():
     """zb composes with VPP through the GPTConfig path (code-review r3:
     the mk(..., remat=...) call needs the remat kwarg)."""
@@ -813,6 +815,7 @@ def test_flagship_zb_interleaved_config_path():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # pp soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_user_pipeline_layer_hetero_boundaries():
     """Weak r2 #4: the real embed->blocks->head shape pipelines — stage 0
     consumes token ids, the last stage emits logits, only the INTER-stage

@@ -26,6 +26,7 @@ class TestRecompute:
             for n, p in m.named_parameters() if p.grad is not None
         }
 
+    @pytest.mark.slow  # remat parity soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
     def test_eager_grad_parity(self):
         l0, g0 = self._grads(False)
         l1, g1 = self._grads(True)

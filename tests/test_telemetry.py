@@ -190,6 +190,7 @@ def _tiny_serving_model():
     return LlamaForCausalLM(cfg)
 
 
+@pytest.mark.slow  # serving soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
 def test_serving_metrics():
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
 

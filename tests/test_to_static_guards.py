@@ -3,6 +3,7 @@
 import warnings
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 
@@ -417,6 +418,7 @@ class TestSegmentCaptureTraining:
         g_ref = np.asarray(layer.fc.weight.grad.numpy())
         np.testing.assert_allclose(g, g_ref, atol=1e-5)
 
+    @pytest.mark.slow  # capture train soak; tier-1 time budget (ISSUE 4): ~1110s suite vs 870s timeout
     def test_graph_broken_layer_trains_to_lower_loss(self):
         import warnings
 

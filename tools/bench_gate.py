@@ -1,0 +1,193 @@
+"""bench_gate: fail CI on a tokens/sec regression between bench rounds.
+
+Usage::
+
+    python tools/bench_gate.py                 # newest BENCH_r*.json vs
+                                               # the previous round
+    python tools/bench_gate.py NEW.json        # explicit candidate
+    python tools/bench_gate.py NEW.json --against OLD.json [OLD2.json ...]
+    python tools/bench_gate.py --threshold 0.08   # allow 8%
+
+Accepts every bench artifact shape this repo produces:
+
+- raw ``bench.py`` stdout (one JSON object per line, log lines ignored),
+- driver round files ``BENCH_r*.json`` (``{"tail": "...", "parsed":
+  ...}`` — metric lines are re-parsed out of ``tail``),
+- a bare ``{"metric": ..., "value": ...}`` object or a list of them.
+
+For every metric name shared between the candidate and a reference file,
+the gate compares ``value`` (tokens/sec/chip) and **exits 1 if the
+candidate is more than ``--threshold`` (default 5%) below the
+reference**. Metrics present on only one side are reported but don't
+gate (a new bench line must not fail the round that introduces it).
+``mfu`` is printed alongside when present. BASELINE.json carries no
+absolute numbers (the reference publishes none) — it is accepted and
+skipped with a note, so ``--against BASELINE.json BENCH_rNN.json`` works
+as a documented CI line.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _records_from_obj(obj):
+    if isinstance(obj, list):
+        out = []
+        for o in obj:
+            out.extend(_records_from_obj(o))
+        return out
+    if not isinstance(obj, dict):
+        return []
+    recs = []
+    if "tail" in obj and isinstance(obj["tail"], str):
+        recs.extend(_records_from_text(obj["tail"]))
+    if not recs and isinstance(obj.get("parsed"), dict):
+        recs.extend(_records_from_obj(obj["parsed"]))
+    if "metric" in obj and "value" in obj:
+        recs.append(obj)
+    return recs
+
+
+def _records_from_text(text):
+    recs = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj and "value" in obj:
+            recs.append(obj)
+    return recs
+
+
+def load_metrics(path):
+    """path -> {metric: record} (last line per metric wins, like the
+    driver's parse). Records without a numeric value are dropped."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        recs = _records_from_obj(json.loads(text))
+    except ValueError:
+        recs = _records_from_text(text)
+    out = {}
+    for r in recs:
+        try:
+            float(r["value"])
+        except (TypeError, ValueError):
+            continue
+        out[str(r["metric"])] = r
+    return out
+
+
+def _round_files(root):
+    files = glob.glob(os.path.join(root, "BENCH_r*.json"))
+
+    def key(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    return sorted((p for p in files if key(p) >= 0), key=key)
+
+
+def compare(new_metrics, ref_metrics, threshold):
+    """-> (rows, regressions). Each row: (metric, old, new, ratio|None)."""
+    rows, regressions = [], []
+    for metric, rec in sorted(new_metrics.items()):
+        ref = ref_metrics.get(metric)
+        if ref is None:
+            rows.append((metric, None, float(rec["value"]), None))
+            continue
+        old, new = float(ref["value"]), float(rec["value"])
+        ratio = new / old if old else float("inf")
+        rows.append((metric, old, new, ratio))
+        if old > 0 and ratio < 1.0 - threshold:
+            regressions.append((metric, old, new, ratio))
+    for metric in sorted(set(ref_metrics) - set(new_metrics)):
+        rows.append((metric, float(ref_metrics[metric]["value"]), None,
+                     None))
+    return rows, regressions
+
+
+def _fmt(metric, old, new, ratio, rec):
+    mfu = rec.get("mfu") if rec else None
+    mfu_s = f"  mfu={mfu}" if mfu is not None else ""
+    if old is None:
+        return f"  NEW   {metric}: {new}{mfu_s} (no reference — not gated)"
+    if new is None:
+        return f"  GONE  {metric}: was {old} (missing from candidate)"
+    arrow = f"{old} -> {new} ({(ratio - 1) * 100:+.1f}%)"
+    return f"  {'OK  ' if ratio >= 1.0 else 'DOWN'}  {metric}: {arrow}{mfu_s}"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="exit non-zero on a >threshold tokens/sec regression "
+                    "between bench JSON artifacts (docs/PERF.md)")
+    ap.add_argument("candidate", nargs="?", default=None,
+                    help="bench JSON to gate (default: newest BENCH_r*.json)")
+    ap.add_argument("--against", nargs="+", default=None,
+                    help="reference artifacts (default: the previous "
+                    "BENCH_r*.json round)")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="allowed fractional drop (default 0.05)")
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root for BENCH_r*.json discovery")
+    args = ap.parse_args(argv)
+
+    candidate = args.candidate
+    refs = args.against
+    if candidate is None or refs is None:
+        rounds = _round_files(args.root)
+        if candidate is None:
+            if not rounds:
+                print("bench_gate: no BENCH_r*.json rounds found", flush=True)
+                return 2
+            candidate = rounds[-1]
+            rounds = rounds[:-1]
+        else:
+            rounds = [r for r in rounds
+                      if os.path.abspath(r) != os.path.abspath(candidate)]
+        if refs is None:
+            if not rounds:
+                print(f"bench_gate: {candidate}: no earlier round to gate "
+                      "against — pass", flush=True)
+                return 0
+            refs = [rounds[-1]]
+
+    new_metrics = load_metrics(candidate)
+    if not new_metrics:
+        print(f"bench_gate: no metric lines in {candidate}", flush=True)
+        return 2
+
+    failed = False
+    for ref_path in refs:
+        ref_metrics = load_metrics(ref_path)
+        print(f"bench_gate: {os.path.basename(candidate)} vs "
+              f"{os.path.basename(ref_path)} "
+              f"(threshold {args.threshold:.0%})")
+        if not ref_metrics:
+            print("  (no metric lines — reference skipped; BASELINE.json "
+                  "publishes no absolute numbers)")
+            continue
+        rows, regressions = compare(new_metrics, ref_metrics,
+                                    args.threshold)
+        for metric, old, new, ratio in rows:
+            print(_fmt(metric, old, new, ratio, new_metrics.get(metric)))
+        for metric, old, new, ratio in regressions:
+            print(f"  REGRESSION {metric}: {old} -> {new} "
+                  f"({(ratio - 1) * 100:+.1f}% < -{args.threshold:.0%})")
+            failed = True
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
