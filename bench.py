@@ -215,7 +215,11 @@ def run_model(model_kind, ckpt=None):
         # tracked configs, whose state dicts must not share a step dir
         manager = CheckpointManager(
             os.path.join(ckpt.ckpt_dir, model_kind), keep=ckpt.ckpt_keep)
-        latest = manager.latest_step()
+        # gate on the newest GOOD step, not latest_step(): after a
+        # guard-aborted run every committed step can carry a BAD marker,
+        # and restore only walks good steps — gating on a BAD latest
+        # would crash with NoCheckpointError instead of measuring fresh
+        latest = manager.last_good_step()
         if ckpt.resume == "auto" and latest is not None:
             if latest < steps:
                 start_step = manager.restore_training_state(model, opt)
@@ -229,6 +233,35 @@ def run_model(model_kind, ckpt=None):
                       f"steps {steps}; measuring fresh (not resuming)",
                       file=sys.stderr)
         guard = PreemptionGuard(manager).install()
+
+    # Resilience (--guard, docs/RESILIENCE.md): StepGuard wraps the
+    # compiled step with the skip/rewind anomaly policy (the rewind is
+    # CheckpointManager-backed when --ckpt-dir is set) and a HangWatchdog
+    # heartbeats the timed loop, dumping debris under the checkpoint
+    # root on a wedged step. The guard decision totals land in the
+    # "resilience" block of the JSON line; tools/bench_gate.py fails a
+    # clean run that reports any anomaly or rollback.
+    step_guard = watchdog = None
+    if ckpt is not None and getattr(ckpt, "guard", False):
+        from paddle_tpu.resilience import HangWatchdog, StepGuard
+
+        step_guard = StepGuard(step, manager=manager)
+        # the watchdog always runs with --guard (the flag promises hang
+        # protection): debris lands under the checkpoint root when one
+        # exists, else in a temp dir named on stderr
+        if manager is not None:
+            debris_dir = os.path.join(manager.root, "debris")
+        else:
+            import sys
+            import tempfile
+
+            debris_dir = tempfile.mkdtemp(prefix="ptpu_bench_debris_")
+            print(f"# --guard without --ckpt-dir: hang debris -> "
+                  f"{debris_dir}", file=sys.stderr)
+        watchdog = HangWatchdog(
+            debris_dir,
+            min_hang_seconds=float(
+                os.environ.get("PTPU_HANG_SECONDS", "120"))).start()
 
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
@@ -246,21 +279,47 @@ def run_model(model_kind, ckpt=None):
     n_ran = 0
     t0 = time.perf_counter()
     t_prev = t0
-    for gstep in range(start_step + 1, steps + 1):
-        loss = step(ids, labels)
+    gstep = start_step + 1
+    while gstep <= steps:
+        if watchdog is not None:
+            watchdog.step_started(gstep)
+        if step_guard is not None:
+            out = step_guard(gstep, ids, labels)
+            accepted, next_step = out.accepted, out.next_step
+            if accepted:
+                loss = out.loss
+        else:
+            loss = step(ids, labels)
+            accepted, next_step = True, gstep + 1
+        if watchdog is not None:
+            watchdog.step_finished()
         t_now = time.perf_counter()
         bench_step.observe(t_now - t_prev)
         t_prev = t_now
-        n_ran += 1
-        if manager is not None and gstep % ckpt.ckpt_every == 0:
-            manager.save_training_state(gstep, model, opt, train_step=step,
-                                        async_save=True)
+        if accepted:
+            n_ran += 1
+            if manager is not None and gstep % ckpt.ckpt_every == 0:
+                manager.save_training_state(gstep, model, opt,
+                                            train_step=step,
+                                            async_save=True)
+        # poll preemption on EVERY iteration, not only accepted ones: a
+        # SIGTERM landing mid anomaly-retry storm must still commit the
+        # (pre-anomaly, still-good) live state before the ladder can
+        # abort. next_step-1 names the step the live trees correspond
+        # to on every path (accept: gstep; skip: the last accepted
+        # step; rollback: the restored step).
         if guard is not None and guard.should_stop():
+            save_at = next_step - 1
             manager.wait()
-            manager.save_training_state(gstep, model, opt, train_step=step)
+            if save_at > start_step:
+                manager.save_training_state(save_at, model, opt,
+                                            train_step=step)
             break
+        gstep = next_step
     _ = float(loss.numpy())  # sync
     dt = time.perf_counter() - t0
+    if watchdog is not None:
+        watchdog.stop()
     if manager is not None:
         manager.wait()  # surface any async writer failure before reporting
     if guard is not None:
@@ -307,6 +366,13 @@ def run_model(model_kind, ckpt=None):
         # "telemetry" key explains its time (tools/hbm_report.py diffs
         # two rounds' blocks; contract in docs/MEMORY.md)
         "memory": decision.as_json(),
+        # guard decision totals (docs/RESILIENCE.md): a CLEAN bench run
+        # must report zero anomalies and zero rollbacks — bench_gate
+        # exits 1 otherwise. {"enabled": false} when --guard is off.
+        "resilience": (dict(step_guard.summary(),
+                            watchdog_fires=(len(watchdog.debris_files)
+                                            if watchdog is not None else 0))
+                       if step_guard is not None else {"enabled": False}),
         "telemetry": telemetry.snapshot(),
     }), flush=True)
 
@@ -329,6 +395,12 @@ def main():
                     help="retention: newest N committed steps")
     ap.add_argument("--resume", choices=("auto", "none"), default="auto",
                     help="auto = restore the newest committed step")
+    ap.add_argument("--guard", action="store_true",
+                    default=os.environ.get("PTPU_BENCH_GUARD", "")
+                    not in ("", "0"),
+                    help="StepGuard anomaly policy + hang watchdog around "
+                    "the timed loop (docs/RESILIENCE.md); decision totals "
+                    "land in the JSON 'resilience' block")
     args = ap.parse_args()
 
     # surface which attention path ran (proof the Pallas kernel engaged)

@@ -29,6 +29,12 @@ def main():
     ap.add_argument("--ckpt-dir", default=None,
                     help="crash-safe checkpoint root (off when unset)")
     ap.add_argument("--resume", choices=("auto", "none"), default="auto")
+    ap.add_argument("--guard", action="store_true",
+                    help="resilience StepGuard around the 3-axis compiled "
+                    "step: nonfinite/spike updates are discarded in-graph "
+                    "(skip-only here — attach a per-model CheckpointManager "
+                    "as bench.py does to get the rewind rung; the eager "
+                    "train_batch loop is not guarded, docs/RESILIENCE.md)")
     args = ap.parse_args()
 
     strategy = fleet.DistributedStrategy()
@@ -61,7 +67,9 @@ def main():
     start = 0
     if args.ckpt_dir:
         manager = CheckpointManager(args.ckpt_dir, keep=3)
-        if args.resume == "auto" and manager.latest_step() is not None:
+        # newest GOOD step: restore only walks good steps, so a
+        # BAD-inclusive latest_step() gate could crash post-abort
+        if args.resume == "auto" and manager.last_good_step() is not None:
             start = manager.restore_training_state(model, opt)
             print(f"resumed from committed step {start}")
 
@@ -105,9 +113,32 @@ def main():
                                   parameters=model3.parameters())
     step3 = ShardedTrainStep(model3, lambda a, b: model3.loss(a, b),
                              opt3, fleet.get_fleet_mesh())
-    for step in range(3):
-        loss = step3(ids, labels)
-        print(f"3-axis step {step}: loss {float(loss.numpy()):.4f}")
+    if args.guard:
+        # StepGuard over the hybrid compiled step: a nonfinite or
+        # loss-spike update is discarded IN-GRAPH (pre-step state kept,
+        # the loop retries), escalating to a committed-checkpoint rewind
+        # when a manager is attached (docs/RESILIENCE.md)
+        from paddle_tpu.resilience import StepGuard
+
+        # skip-only policy here: `manager` holds the FIRST model's steps,
+        # which must not be restored into model3 — attach a per-model
+        # CheckpointManager (like bench.py's per-model subroot) to get
+        # the rollback rung of the escalation ladder
+        guard3 = StepGuard(step3, manager=None)
+        gstep = 1
+        while gstep <= 3:
+            out = guard3(gstep, ids, labels)
+            if out.accepted:
+                print(f"3-axis step {gstep - 1}: "
+                      f"loss {float(out.loss.numpy()):.4f}")
+            else:
+                print(f"3-axis step {gstep - 1}: {out.action} "
+                      f"({out.health.kind})")
+            gstep = out.next_step
+    else:
+        for step in range(3):
+            loss = step3(ids, labels)
+            print(f"3-axis step {step}: loss {float(loss.numpy()):.4f}")
 
 
 if __name__ == "__main__":

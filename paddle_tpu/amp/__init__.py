@@ -124,6 +124,22 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weigh
     return (models if single_model else model_list), optimizers
 
 
+_FOUND_INF = None
+
+
+def _found_inf_counter():
+    """Lazy `amp_found_inf_total` family (docs/TELEMETRY.md)."""
+    global _FOUND_INF
+    if _FOUND_INF is None:
+        from .. import telemetry
+
+        _FOUND_INF = telemetry.counter(
+            "amp_found_inf_total",
+            "GradScaler.unscale_ detections of nonfinite grads (the "
+            "optimizer step is skipped and the loss scale decays)")
+    return _FOUND_INF
+
+
 class GradScaler:
     """parity: amp/grad_scaler.py:657.
 
@@ -151,17 +167,21 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        import numpy as np
-
         inv = 1.0 / self._scale
-        self._found_inf = False
+        flags = []
         for p in optimizer._parameter_list or []:
             if p.grad is None:
                 continue
             g = p.grad._data * inv
-            if not bool(jnp.all(jnp.isfinite(g))):
-                self._found_inf = True
+            flags.append(jnp.all(jnp.isfinite(g)))
             p.grad._data = g
+        # ONE fused device reduction + ONE host sync for the whole
+        # parameter list (the old loop synced per tensor: with N params
+        # that is N round-trips blocking the dispatch pipeline)
+        self._found_inf = bool(flags) and not bool(
+            jnp.all(jnp.stack(flags)))
+        if self._found_inf:
+            _found_inf_counter().inc()
 
     def step(self, optimizer):
         if not self._enable:

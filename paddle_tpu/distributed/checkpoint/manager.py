@@ -177,6 +177,7 @@ class CheckpointManager:
     """
 
     COMMIT_FILE = "COMMIT"
+    BAD_FILE = "BAD"
     STEP_PREFIX = "step_"
     STEP_DIGITS = 8
 
@@ -192,6 +193,7 @@ class CheckpointManager:
         self._writer = _AsyncWriter(max_pending)
         self._inflight = set()  # steps being written (never GC'd)
         self._inflight_lock = threading.Lock()
+        self._bad_steps = set()  # guard-marked; also persisted as BAD files
         _LIVE_MANAGERS.add(self)
         _arm_atexit()
 
@@ -235,6 +237,58 @@ class CheckpointManager:
         crashed) step directories are invisible here by construction."""
         steps = self.all_steps(committed_only=True)
         return steps[-1] if steps else None
+
+    # -- guard-marked bad steps ----------------------------------------------
+    def _bad_path(self, step) -> str:
+        return os.path.join(self.step_dir(step), self.BAD_FILE)
+
+    def is_bad(self, step) -> bool:
+        return int(step) in self._bad_steps or os.path.exists(
+            self._bad_path(step))
+
+    def mark_bad(self, step, reason=""):
+        """Exclude a committed step from `restore_last_good` (and from
+        `restore`'s fallback walk): the resilience guard calls this when
+        a rewind target did not cure a recurring anomaly — the
+        checkpoint itself is suspect. Persisted as a BAD marker file in
+        the step dir, so a restarted process skips it too."""
+        step = int(step)
+        self._bad_steps.add(step)
+        if os.path.isdir(self.step_dir(step)):
+            try:
+                _atomic_write_marker = json.dumps(
+                    {"step": step, "ts": time.time(),
+                     "reason": str(reason)}).encode()
+                from . import _atomic_write_bytes
+
+                _atomic_write_bytes(self._bad_path(step),
+                                    _atomic_write_marker, fsync=False)
+            except OSError:
+                pass  # the in-memory mark still applies this process
+        return step
+
+    def _clear_bad(self, step):
+        """Forget a BAD verdict once a NEW commit lands at `step`: the
+        marker described the state that commit just replaced. Called
+        after the commit fence only — clearing earlier could resurrect
+        the suspect old checkpoint if the overwrite died half-way."""
+        step = int(step)
+        self._bad_steps.discard(step)
+        try:
+            os.remove(self._bad_path(step))
+        except OSError:
+            pass
+
+    def good_steps(self, before_step=None):
+        """Committed steps not marked bad, oldest first; `before_step`
+        keeps only steps strictly below it."""
+        return [s for s in self.all_steps(committed_only=True)
+                if not self.is_bad(s)
+                and (before_step is None or s < int(before_step))]
+
+    def last_good_step(self, before_step=None):
+        good = self.good_steps(before_step)
+        return good[-1] if good else None
 
     # -- save ----------------------------------------------------------------
     def save(self, step, state_dict, async_save=False):
@@ -290,6 +344,11 @@ class CheckpointManager:
             all_gather_object(fence, ("ckpt_commit", step))
         if plan["is_coordinator"]:
             self._write_commit(step, plan)
+        # a guard rollback replay can legitimately re-save a step number
+        # that was marked BAD: the fresh commit IS the cure, so the stale
+        # verdict must not keep hiding it from restore/rollback/retention
+        self._clear_bad(step)
+        if plan["is_coordinator"]:
             self.gc()
 
     def _write_commit(self, step, plan):
@@ -406,17 +465,31 @@ class CheckpointManager:
         if step is not None:
             candidates = [int(step)]
         else:
-            candidates = list(reversed(self.all_steps(committed_only=True)))
+            # fallback walk skips guard-marked-bad steps: auto-resuming
+            # into a state the guard rewound away from would replay the
+            # poisoning (restore_last_good below is the guard's entry)
+            candidates = list(reversed(self.good_steps()))
         if not candidates:
             raise NoCheckpointError(
                 f"no committed checkpoint step under {self.root!r}")
+        return self._restore_candidates(
+            state_dict, candidates, strict=strict,
+            fallback=fallback and step is None)
+
+    def _restore_candidates(self, state_dict, candidates, strict=True,
+                            fallback=True):
+        """Walk `candidates` (newest first) validating + loading; with
+        `fallback` a failing step counts a validation failure and the
+        walk continues, else it raises."""
+        from . import MissingKeysError, _metrics, load_state_dict
+
         last_err = None
         for s in candidates:
             problems = self.validate_step(s)
             if problems:
                 _metrics()["validation_failures"].inc()
                 last_err = CheckpointValidationError(s, problems)
-                if step is not None or not fallback:
+                if not fallback:
                     raise last_err
                 continue
             try:
@@ -430,7 +503,7 @@ class CheckpointManager:
                 # error — treat as validation failure and fall back
                 _metrics()["validation_failures"].inc()
                 last_err = CheckpointValidationError(s, [repr(e)])
-                if step is not None or not fallback:
+                if not fallback:
                     raise last_err
                 continue
             _metrics()["restores"].inc()
@@ -438,6 +511,26 @@ class CheckpointManager:
         raise NoCheckpointError(
             f"no committed step under {self.root!r} passed validation "
             f"(last error: {last_err})")
+
+    def restore_last_good(self, model, optimizer=None, before_step=None,
+                          strict=True):
+        """Restore model (+ optimizer) from the newest committed step the
+        guard has NOT marked bad — optionally strictly before
+        `before_step` (the anomalous step a rewind must land under).
+        Corrupt steps fall back like `restore`; returns the step
+        restored. The resilience guard's escalation entry point."""
+        from . import _training_state_target
+
+        candidates = list(reversed(self.good_steps(before_step)))
+        if not candidates:
+            raise NoCheckpointError(
+                f"no good committed step under {self.root!r}"
+                + ("" if before_step is None
+                   else f" before step {int(before_step)}"))
+        target, finalize = _training_state_target(model, optimizer)
+        s = self._restore_candidates(target, candidates, strict=strict)
+        finalize()
+        return s
 
     def restore_training_state(self, model, optimizer=None, step=None,
                                strict=True):
@@ -455,14 +548,23 @@ class CheckpointManager:
     def gc(self):
         """Apply retention: drop committed steps beyond `keep` (modulo
         `keep_period` anchors) and uncommitted debris older than the
-        newest committed step. In-flight saves are never collected."""
+        newest committed step. The `keep` window counts only GOOD steps —
+        a guard-marked BAD step must not crowd a rollback target out of
+        retention (with `keep` set, BAD steps beyond the window are
+        collected like any excess step; `keep=None` keeps everything).
+        In-flight saves are never collected."""
         committed = self.all_steps(committed_only=True)
         if not committed:
             return []
         newest = committed[-1]
-        keep = set(committed if self.keep is None else committed[-self.keep:])
+        if self.keep is None:
+            keep = set(committed)
+        else:
+            good = [s for s in committed if not self.is_bad(s)]
+            keep = set(good[-self.keep:])
         if self.keep_period:
-            keep.update(s for s in committed if s % self.keep_period == 0)
+            keep.update(s for s in committed
+                        if s % self.keep_period == 0 and not self.is_bad(s))
         with self._inflight_lock:
             keep.update(self._inflight)
         removed = []

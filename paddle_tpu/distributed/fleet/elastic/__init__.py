@@ -49,26 +49,33 @@ def _store_int(raw: bytes) -> int:
 # the partial one — the failure mode this subsystem exists to survive.
 # ---------------------------------------------------------------------------
 def latest_checkpoint_step(ckpt_root):
-    """Newest committed step under `ckpt_root`, or None (fresh start)."""
+    """Newest committed step under `ckpt_root` a resume may land on, or
+    None (fresh start). Steps the resilience guard marked BAD
+    (docs/RESILIENCE.md) are skipped — resuming into a state the guard
+    rewound away from would replay the poisoning."""
     from ...checkpoint.manager import CheckpointManager
 
-    return CheckpointManager(ckpt_root).latest_step()
+    return CheckpointManager(ckpt_root).last_good_step()
 
 
 def auto_resume(ckpt_root, model=None, optimizer=None, strict=True):
     """Resolve ``--resume auto`` after an elastic restart: restore the
     newest committed-and-valid step into `model` (+ `optimizer`) and
     return it, or None when no committed checkpoint exists. Validation
-    failures fall back to older committed steps (restore() semantics);
-    with `model=None` only the resume step is resolved."""
+    failures fall back to older committed steps and guard-marked BAD
+    steps are skipped (restore() semantics); with `model=None` only the
+    resume step is resolved, through the SAME good-and-valid walk a
+    restoring worker performs — supervisor and worker agree on the
+    resume point even when the newest good step is corrupt."""
     from ...checkpoint.manager import CheckpointManager, NoCheckpointError
 
     mgr = CheckpointManager(ckpt_root)
-    if mgr.latest_step() is None:
-        return None
     try:
         if model is None:
-            return mgr.latest_step()
+            for s in reversed(mgr.good_steps()):
+                if not mgr.validate_step(s):
+                    return s
+            return None
         return mgr.restore_training_state(model, optimizer=optimizer,
                                           strict=strict)
     except NoCheckpointError:

@@ -192,14 +192,18 @@ class ShardedTrainStep(TrainStep):
         raw_batch = self._place_batch(_unwrap_tensors(batch))
         buffers = {n: entries[n]._data for n in self._buffer_names}
         lr = self.optimizer.get_lr()
+        guard_arr = self._guard_operand()
         from .. import framework
 
         key_arr = framework.next_rng_key()
         # no ambient mesh context needed: every input carries an explicit
         # NamedSharding, and constraints inside the program name their mesh.
-        loss, new_params, new_buffers, self._opt_state = self._compiled(
-            params, buffers, self._opt_state, lr, key_arr, raw_batch
-        )
+        loss, new_params, new_buffers, self._opt_state, health = \
+            self._compiled(
+                params, buffers, self._opt_state, lr, guard_arr, key_arr,
+                raw_batch
+            )
+        self._last_health = health
         for n, arr in new_params.items():
             entries[n]._data = arr
         for n, arr in new_buffers.items():

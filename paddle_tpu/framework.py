@@ -170,6 +170,21 @@ def next_rng_key():
     return default_generator().next_key()
 
 
+def _rng_key_state():
+    """Raw O(1) snapshot of the default generator's key chain.
+    (`get_rng_state` is the paddle-parity surface, but `set_rng_state`
+    REPLAYS `offset` splits to rebuild the key — O(steps). The
+    resilience guard snapshots/restores per step, so it needs the raw
+    triple.)"""
+    g = default_generator()
+    return (g._seed, g._offset, g._key)
+
+
+def _set_rng_key_state(state):
+    g = default_generator()
+    g._seed, g._offset, g._key = state
+
+
 # -- traced-RNG scope -------------------------------------------------------
 class _RngScope(threading.local):
     def __init__(self):

@@ -400,12 +400,22 @@ def ignore_module(modules):
 # ---------------------------------------------------------------------------
 # TrainStep: compiled forward+backward+update (the perf path)
 # ---------------------------------------------------------------------------
-def _functional_clip_global_norm(grads, clip_norm):
+def _global_grad_sumsq(grads):
+    """One fused reduction: sum of squares over the flattened grad tree
+    (float32). Shared by the in-graph StepHealth bundle and global-norm
+    clipping — the norm is computed once per step, never twice."""
+    leaves = [g for g in tree_util.tree_leaves(grads) if g is not None]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+
+
+def _functional_clip_global_norm(grads, clip_norm, gnorm=None):
     leaves = [g for g in tree_util.tree_leaves(grads) if g is not None]
     if not leaves:
         return grads
-    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
-    gnorm = jnp.sqrt(sq)
+    if gnorm is None:
+        gnorm = jnp.sqrt(_global_grad_sumsq(grads))
     clip = jnp.asarray(clip_norm, jnp.float32)
     scale = clip / jnp.maximum(gnorm, clip)
     return tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
@@ -427,6 +437,12 @@ class TrainStep:
         self._param_names = None
         self._buffer_names = None
         self._opt_state = None
+        # resilience guard inputs (docs/RESILIENCE.md): the spike
+        # threshold rides into the compiled step as an OPERAND, so the
+        # guard never causes a recompile. None = +inf = never skip.
+        self._guard_threshold = None
+        self._call_index = 0      # 1-based invocation count (chaos seam)
+        self._last_health = None  # device f32[4], fetched lazily
 
     def _build(self):
         model, train_fn, opt = self.model, self.train_fn, self.optimizer
@@ -451,7 +467,14 @@ class TrainStep:
         clip = opt._grad_clip
         reg = opt.regularization
 
-        def step(params, buffers, opt_state, lr, key_arr, batch):
+        def step(params, buffers, opt_state, lr, guard, key_arr, batch):
+            # guard: f32[4] operand = [spike_threshold, grad_inject,
+            # loss_inject, armed]. Thresholds/injections are VALUES, not
+            # shapes — guarded and unguarded runs execute this same
+            # program. `armed` gates the skip select: only an attached
+            # StepGuard discards anomalous updates; an unguarded step
+            # adopts them exactly as it always did (a silent drop would
+            # hide real divergence from users who never opted in).
             def loss_of(params):
                 state = dict(params)
                 state.update(buffers)
@@ -462,14 +485,32 @@ class TrainStep:
                 return loss_t._data, new_buffers
 
             (loss, new_buffers), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+            # chaos anomaly seam (resilience, testing.chaos): a zero
+            # injection selects the original bytes — the select with a
+            # false predicate is the identity, so clean runs are
+            # bit-identical with or without a hook installed
+            ginj, linj = guard[1], guard[2]
+            do_g = ginj != 0.0  # nan != 0 and inf != 0 are both True
+            grads = tree_util.tree_map(
+                lambda g: jnp.where(do_g, jnp.full_like(g, ginj.astype(g.dtype)), g),
+                grads)
+            loss = jnp.where(linj != 0.0, linj.astype(loss.dtype), loss)
             if reg is not None:
                 grads = {
                     n: reg._apply_arr(params[n], g) for n, g in grads.items()
                 }
+            # StepHealth: ONE reduction over the flattened grad tree,
+            # shared with global-norm clipping below — no second pass,
+            # no extra HBM arrays (4 scalars ride out with the step)
+            gsumsq = _global_grad_sumsq(grads)
+            gnorm = jnp.sqrt(gsumsq)
+            loss32 = loss.astype(jnp.float32)
+            finite = jnp.isfinite(loss32) & jnp.isfinite(gsumsq)
             from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 
             if isinstance(clip, ClipGradByGlobalNorm):
-                grads = _functional_clip_global_norm(grads, clip.clip_norm)
+                grads = _functional_clip_global_norm(grads, clip.clip_norm,
+                                                     gnorm=gnorm)
             elif isinstance(clip, ClipGradByValue):
                 grads = tree_util.tree_map(
                     lambda g: jnp.clip(g, clip.min, clip.max), grads
@@ -482,7 +523,23 @@ class TrainStep:
 
                 grads = tree_util.tree_map(_clip_one, grads)
             new_params, new_opt_state = opt.functional_update(params, grads, opt_state, lr)
-            return loss, new_params, new_buffers, new_opt_state
+            # in-graph skip (StepGuard): a nonfinite or above-threshold
+            # step keeps the pre-step param/slot/buffer trees. select on
+            # a true predicate returns the update bytes unchanged, and
+            # the pre-step operands are already live inside the step, so
+            # this costs no extra HBM and composes with buffer donation.
+            ok = (guard[3] == 0.0) | (finite & (loss32 <= guard[0]))
+
+            def _keep(new, old):
+                return jnp.where(ok, new, old)
+
+            new_params = tree_util.tree_map(_keep, new_params, params)
+            new_opt_state = tree_util.tree_map(_keep, new_opt_state, opt_state)
+            new_buffers = {n: _keep(new_buffers[n], buffers[n])
+                           for n in new_buffers}
+            health = jnp.stack([finite.astype(jnp.float32), gnorm, loss32,
+                                ok.astype(jnp.float32)])
+            return loss, new_params, new_buffers, new_opt_state, health
 
         from ..utils.flags import get_flags
 
@@ -524,20 +581,24 @@ class TrainStep:
         if self._opt_state is None:
             self._opt_state = self._init_opt_state(params)
         lr = self.optimizer.get_lr()
+        guard_arr = self._guard_operand()
         key_arr = framework.next_rng_key()
         raw_batch = _unwrap_tensors(batch)
         if self._checkified:
             err, out = self._compiled(params, buffers, self._opt_state, lr,
-                                      key_arr, raw_batch)
+                                      guard_arr, key_arr, raw_batch)
             # raise BEFORE adopting any of the step's outputs: params,
             # buffers, and opt state all stay at their pre-step values so
             # the user can inspect or skip the batch
             err.throw()
-            loss, new_params, new_buffers, self._opt_state = out
+            loss, new_params, new_buffers, self._opt_state, health = out
         else:
-            loss, new_params, new_buffers, self._opt_state = self._compiled(
-                params, buffers, self._opt_state, lr, key_arr, raw_batch
-            )
+            loss, new_params, new_buffers, self._opt_state, health = \
+                self._compiled(
+                    params, buffers, self._opt_state, lr, guard_arr,
+                    key_arr, raw_batch
+                )
+        self._last_health = health
         for n, arr in new_params.items():
             entries[n]._data = arr
         for n, arr in new_buffers.items():
@@ -546,6 +607,58 @@ class TrainStep:
             pass  # stepped by the caller per paddle convention
         self.optimizer._step_count += 1
         return Tensor(loss)
+
+    def _guard_operand(self):
+        """f32[4] guard operand: [spike_threshold, grad_inject,
+        loss_inject, armed]. `armed` is 1 only while a StepGuard drives
+        the step (``_guard_threshold`` set) — unguarded steps keep their
+        legacy adopt-everything semantics. Also advances the chaos
+        anomaly seam (resilience._ANOMALY_FAULT_HOOK) by one invocation.
+        The device array is cached per value tuple: unguarded runs and
+        a guard still inside its warmup (+inf threshold) re-upload
+        nothing; once the rolling spike threshold is live it changes
+        per accepted step, costing one f32[4] (16-byte) upload."""
+        self._call_index += 1
+        thr = self._guard_threshold
+        armed = 0.0 if thr is None else 1.0
+        thr = float("inf") if thr is None else float(thr)
+        ginj = linj = 0.0
+        from .. import resilience as _resilience
+
+        hook = _resilience._ANOMALY_FAULT_HOOK
+        if hook is not None:
+            res = hook(self._call_index)
+            if res is not None:
+                site, val = res
+                if site == "grads":
+                    ginj = float(val)
+                elif site == "loss":
+                    linj = float(val)
+                else:
+                    raise ValueError(
+                        f"anomaly hook site {site!r} not in "
+                        "('grads', 'loss')")
+        key = (thr, ginj, linj, armed)
+        cached = getattr(self, "_guard_arr_cache", None)
+        if cached is None or cached[0] != key:
+            cached = (key, jnp.asarray(key, jnp.float32))
+            self._guard_arr_cache = cached
+        return cached[1]
+
+    @property
+    def last_health(self):
+        """`resilience.StepHealth` of the most recent step (None before
+        the first). This is the guard's ONE extra device fetch per step:
+        the fused 4-scalar bundle computed inside the compiled program."""
+        if self._last_health is None:
+            return None
+        import numpy as _np
+
+        from ..resilience.guard import StepHealth
+
+        v = _np.asarray(self._last_health)
+        return StepHealth(finite=bool(v[0]), grad_norm=float(v[1]),
+                          loss=float(v[2]), ok=bool(v[3]))
 
     def aot_compile(self, *batch):
         """Lower + compile this step WITHOUT executing it (the memory
@@ -585,10 +698,11 @@ class TrainStep:
             opt_state = jax.eval_shape(self.optimizer.functional_state,
                                        params)
         lr = self.optimizer.get_lr()
+        guard_aval = jax.ShapeDtypeStruct((4,), jnp.float32)
         key_arr = aval(framework.next_rng_key())
         batch_avals = tree_util.tree_map(aval, raw_batch)
         return self._compiled.lower(
-            params, buffers, opt_state, lr, key_arr, batch_avals
+            params, buffers, opt_state, lr, guard_aval, key_arr, batch_avals
         ).compile()
 
     def memory_stats(self, *batch):

@@ -45,7 +45,7 @@ from .layer.rnn import (  # noqa: F401
 )
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
-    clip_grad_norm_, clip_grad_value_,
+    clip_grad_norm_, clip_grad_value_, global_grad_norm,
 )
 
 from ..param_attr import ParamAttr  # noqa: F401

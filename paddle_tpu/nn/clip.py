@@ -76,23 +76,50 @@ class ClipGradByGlobalNorm(ClipGradBase):
         return out
 
 
-def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
-    from .layer.layers import Layer
-
+def global_grad_norm(parameters, norm_type=2.0):
+    """Total gradient norm over `parameters` (Layer or iterable) WITHOUT
+    mutating any grad — the single reduction `clip_grad_norm_` scales by
+    and `resilience.StepGuard`'s eager path reads, exposed so callers
+    never pay a second pass over the grad tree."""
     if hasattr(parameters, "parameters"):
         parameters = parameters.parameters()
     params = [p for p in parameters if p.grad is not None]
     if not params:
         return _p.zeros([])
     if norm_type == float("inf"):
-        total = _p.maximum(*[p.grad.abs().max() for p in params]) if len(params) > 1 else params[0].grad.abs().max()
-    else:
-        sq = None
-        for p in params:
-            s = (p.grad.astype("float32").abs() ** norm_type).sum()
-            sq = s if sq is None else sq + s
-        total = sq ** (1.0 / norm_type)
-    clip_coef = float(max_norm) / (float(total.item()) + 1e-6)
+        total = params[0].grad.abs().max()
+        for p in params[1:]:
+            total = _p.maximum(total, p.grad.abs().max())
+        return total
+    sq = None
+    for p in params:
+        s = (p.grad.astype("float32").abs() ** norm_type).sum()
+        sq = s if sq is None else sq + s
+    return sq ** (1.0 / norm_type)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    import math
+
+    if hasattr(parameters, "parameters"):
+        parameters = parameters.parameters()
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return _p.zeros([])
+    total = global_grad_norm(params, norm_type)
+    total_f = float(total.item())
+    if not math.isfinite(total_f):
+        if error_if_nonfinite:
+            raise RuntimeError(
+                f"the total norm of order {norm_type} for the gradients "
+                f"is non-finite ({total_f}), so it cannot be clipped. "
+                "Pass error_if_nonfinite=False to return the norm "
+                "without clipping (grads left untouched)")
+        # a nonfinite norm must never reach the scale factor:
+        # max_norm/inf would silently ZERO every grad and max_norm/nan
+        # would NaN-poison them — leave the grads unscaled instead
+        return total
+    clip_coef = float(max_norm) / (total_f + 1e-6)
     if clip_coef < 1.0:
         for p in params:
             p.grad._data = (p.grad._data * clip_coef).astype(p.grad._data.dtype)

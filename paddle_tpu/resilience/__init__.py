@@ -1,0 +1,75 @@
+"""paddle_tpu.resilience — training-step anomaly defense.
+
+The reference framework ships a production defense layer
+(`FLAGS_check_nan_inf` per-kernel nonfinite instrumentation plus
+fleet-elastic hung-worker detection); this subsystem is its TPU-native
+closing of the loop: the trainer *survives* anomalies instead of merely
+being restartable after them.
+
+Three pieces (contract in docs/RESILIENCE.md):
+
+- **In-graph step health** (`jit.TrainStep`): every compiled step emits a
+  fused 4-scalar `StepHealth` bundle — all-finite flag over loss+grads,
+  global grad norm (shared with the grad-clip reduction), the loss, and
+  the accept flag — as one tiny extra output. Zero extra HBM arrays, at
+  most ONE extra scalar device fetch per step, and no new recompiles:
+  guarded and unguarded runs execute the SAME program (guard inputs ride
+  in as one f32[4] operand; the skip select is ARMED only while a
+  StepGuard drives the step — unguarded runs adopt every update exactly
+  as they always did, anomalies merely reported in the bundle).
+- **`StepGuard`** (guard.py): policy engine around the step. A nonfinite
+  or loss-spike step (rolling median/MAD window) keeps the pre-step
+  param/slot trees (the skip happens IN-GRAPH via a select, so buffer
+  donation stays on); K consecutive anomalies escalate to a
+  `CheckpointManager.restore_last_good` rewind; R rollbacks without a
+  cure abort loudly (`GuardAbortError`). Every action is counted:
+  `guard_anomalies_total{kind}`, `guard_skips_total`,
+  `guard_rollbacks_total`, `guard_last_good_step`.
+- **`HangWatchdog`** (watchdog.py): heartbeat thread that fires when a
+  step exceeds `hang_factor ×` the rolling p50 step time, dumps
+  all-thread stacks + a telemetry snapshot to a debris file under the
+  checkpoint root, and optionally exits nonzero so a supervisor
+  (fleet elastic) restarts into checkpoint `auto_resume`.
+
+Chaos seam: `_ANOMALY_FAULT_HOOK` mirrors
+`distributed.checkpoint._WRITE_FAULT_HOOK` — a callable
+``hook(call_index) -> None | (site, value)`` consulted once per train-step
+invocation (1-based, per step instance). ``site`` is ``"grads"`` or
+``"loss"``; ``value`` is injected INSIDE the compiled step through the
+guard operand, so nonfinite grads at step k are produced by the same
+program a clean step runs. `paddle_tpu.testing.chaos.inject_nonfinite`
+installs hooks here; nothing monkeypatches jit internals.
+"""
+from __future__ import annotations
+
+import contextlib
+
+# The anomaly fault seam (see module docstring). Installed/restored by
+# paddle_tpu.testing.chaos; consulted by jit.TrainStep._guard_operand.
+_ANOMALY_FAULT_HOOK = None
+
+
+@contextlib.contextmanager
+def install_anomaly_hook(hook):
+    """Temporarily install `hook` as the train-step anomaly seam."""
+    global _ANOMALY_FAULT_HOOK
+    prev = _ANOMALY_FAULT_HOOK
+    _ANOMALY_FAULT_HOOK = hook
+    try:
+        yield hook
+    finally:
+        _ANOMALY_FAULT_HOOK = prev
+
+
+from .guard import (  # noqa: E402,F401
+    GuardAbortError,
+    StepGuard,
+    StepHealth,
+    StepOutcome,
+)
+from .watchdog import HangWatchdog  # noqa: E402,F401
+
+__all__ = [
+    "StepGuard", "StepHealth", "StepOutcome", "GuardAbortError",
+    "HangWatchdog", "install_anomaly_hook",
+]

@@ -1,0 +1,194 @@
+"""Hang/straggler watchdog: detect a wedged train step, dump debris.
+
+A stuck collective or a deadlocked host thread stalls a multi-day run
+silently — the process is alive, the accelerator is idle, and nothing
+crashes. The reference detects this fleet-side (elastic heartbeat
+leases); single-process we can do better: a daemon thread compares the
+in-flight step's age against ``hang_factor ×`` the rolling p50 step time
+and, on breach, writes a **debris file** (all-thread stacks + a
+telemetry snapshot) under the checkpoint root, then optionally exits
+nonzero so a supervisor restarts the worker into checkpoint
+``auto_resume``. Debris format and contract: docs/RESILIENCE.md.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+import traceback
+
+from .. import telemetry as _telemetry
+
+_FIRES = _telemetry.counter(
+    "hang_watchdog_fires_total",
+    "hang-watchdog firings (in-flight step exceeded hang_factor x "
+    "rolling p50 step time)")
+
+
+def thread_stacks() -> dict:
+    """{thread_name:ident -> [stack lines]} for every live thread."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        key = f"{names.get(ident, '?')}:{ident}"
+        out[key] = traceback.format_stack(frame)
+    return out
+
+
+class HangWatchdog:
+    """Heartbeat watchdog around a training loop.
+
+    The loop marks boundaries::
+
+        with HangWatchdog(os.path.join(ckpt_root, "debris")) as wd:
+            for step in ...:
+                wd.step_started(step)
+                loss = train_one(step)
+                wd.step_finished()
+
+    Until ``min_history`` step durations exist, only the
+    ``min_hang_seconds`` floor applies (the first compile+warmup step
+    must not look like a hang). After that the limit is
+    ``max(min_hang_seconds, hang_factor * rolling_p50)``. The watchdog
+    fires AT MOST ONCE per step: debris is dumped, the
+    ``hang_watchdog_fires_total`` counter ticks, ``on_hang(path)`` runs
+    if given, and with ``exit_on_hang=True`` the process hard-exits
+    ``exit_code`` (``os._exit`` — a wedged step cannot be unwound; the
+    supervisor restart into ``auto_resume`` is the recovery path).
+    """
+
+    def __init__(self, debris_dir, hang_factor=4.0, min_hang_seconds=30.0,
+                 poll_interval=0.25, window=64, min_history=3,
+                 exit_on_hang=False, exit_code=43, on_hang=None):
+        self.debris_dir = str(debris_dir)
+        self.hang_factor = float(hang_factor)
+        self.min_hang_seconds = float(min_hang_seconds)
+        self.poll_interval = float(poll_interval)
+        self.min_history = int(min_history)
+        self.exit_on_hang = bool(exit_on_hang)
+        self.exit_code = int(exit_code)
+        self.on_hang = on_hang
+        self.debris_files = []
+        self._durations = collections.deque(maxlen=int(window))
+        self._lock = threading.Lock()
+        self._current = None      # (step, t_started)
+        self._fired_for = None    # (step, t_started) attempt already
+                                  # reported — a RETRY of the same step
+                                  # number (guard skip/rollback replay)
+                                  # is a new attempt and must fire again
+        self._stop = threading.Event()
+        self._thread = None
+        self._exit = os._exit    # test seam: patched to observe the exit
+
+    # -- loop heartbeat ------------------------------------------------------
+    def step_started(self, step):
+        with self._lock:
+            self._current = (int(step), time.monotonic())
+
+    def step_finished(self):
+        with self._lock:
+            if self._current is None:
+                return
+            _, t0 = self._current
+            self._durations.append(time.monotonic() - t0)
+            self._current = None
+
+    def p50_step_seconds(self):
+        with self._lock:
+            if len(self._durations) < self.min_history:
+                return None
+            return statistics.median(self._durations)
+
+    def hang_limit_seconds(self):
+        p50 = self.p50_step_seconds()
+        if p50 is None:
+            return self.min_hang_seconds
+        return max(self.min_hang_seconds, self.hang_factor * p50)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="ptpu-hang-watchdog")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- the watchdog thread -------------------------------------------------
+    def _run(self):
+        while not self._stop.wait(self.poll_interval):
+            with self._lock:
+                current = self._current
+            if current is None:
+                continue
+            step, t0 = current
+            if self._fired_for == current:
+                continue
+            elapsed = time.monotonic() - t0
+            limit = self.hang_limit_seconds()
+            if elapsed < limit:
+                continue
+            self._fired_for = current
+            try:
+                path = self.dump_debris(step, elapsed, limit)
+            except OSError:
+                path = None  # a dead filesystem must not mask the hang
+            _FIRES.inc()
+            if self.on_hang is not None:
+                try:
+                    self.on_hang(path)
+                except Exception:
+                    pass
+            if self.exit_on_hang:
+                sys.stderr.write(
+                    f"HangWatchdog: step {step} wedged for "
+                    f"{elapsed:.1f}s (limit {limit:.1f}s); debris at "
+                    f"{path}; exiting {self.exit_code} for supervisor "
+                    "restart\n")
+                sys.stderr.flush()
+                self._exit(self.exit_code)
+
+    def dump_debris(self, step, elapsed, limit, reason="hang"):
+        """Write one debris JSON file; returns its path. Atomic (tmp +
+        os.replace via the checkpoint writer, sharing its chaos seam)."""
+        from ..distributed.checkpoint import _atomic_write_bytes
+
+        payload = {
+            "reason": reason,
+            "step": int(step),
+            "elapsed_seconds": round(float(elapsed), 3),
+            "limit_seconds": round(float(limit), 3),
+            "p50_step_seconds": self.p50_step_seconds(),
+            "hang_factor": self.hang_factor,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "threads": thread_stacks(),
+            "telemetry": _telemetry.snapshot(),
+        }
+        os.makedirs(self.debris_dir, exist_ok=True)
+        path = os.path.join(
+            self.debris_dir,
+            f"debris_{reason}_step{int(step):08d}"
+            f"_a{len(self.debris_files)}_pid{os.getpid()}.json")
+        _atomic_write_bytes(
+            path, json.dumps(payload, indent=1, sort_keys=True).encode(),
+            fsync=False)
+        self.debris_files.append(path)
+        return path

@@ -17,9 +17,15 @@ Four fault families, matching how real training jobs die
   (`os._exit`) the first time a matching file is written — the
   interpreter dies mid-save with no atexit, no cleanup, exactly like a
   preemption landing during an async flush.
+- **Training anomalies**: `inject_nonfinite` / `inject_anomaly` make a
+  chosen step invocation compute NaN/Inf grads (or a poisoned loss)
+  INSIDE the compiled train step — the one-bad-batch /
+  flaky-interconnect fault `resilience.StepGuard` exists to survive.
 
-Every injector routes through `distributed.checkpoint._WRITE_FAULT_HOOK`,
-the one seam the writer exposes; nothing here monkeypatches internals.
+Every injector routes through a seam its subsystem exposes
+(`distributed.checkpoint._WRITE_FAULT_HOOK` for writes,
+`resilience._ANOMALY_FAULT_HOOK` for step anomalies); nothing here
+monkeypatches internals.
 """
 from __future__ import annotations
 
@@ -88,6 +94,50 @@ def failing_writes(match=None):
             raise OSError(5, f"chaos: persistent write failure on {path}")
 
     with _install_hook(hook):
+        yield ctr
+
+
+@contextlib.contextmanager
+def inject_anomaly(step, value, site="grads", count=1):
+    """Inject `value` into a compiled train step's grads or loss for
+    `count` consecutive step invocations starting at 1-based invocation
+    `step` (per TrainStep instance). Routes through
+    `resilience._ANOMALY_FAULT_HOOK` — the one seam the compiled step
+    exposes, mirroring `_WRITE_FAULT_HOOK`. A finite `value` on
+    site="loss" makes a loss SPIKE; nonfinite values are what
+    `inject_nonfinite` wraps."""
+    if site not in ("grads", "loss"):
+        raise ValueError(f"site must be 'grads' or 'loss', got {site!r}")
+    step, count, value = int(step), int(count), float(value)
+    if value == 0.0:
+        raise ValueError("value=0.0 encodes 'no injection' on the guard "
+                         "operand; inject a nonzero value")
+    from .. import resilience as _resilience
+
+    ctr = FaultCounter()
+
+    def hook(call_index):
+        ctr.attempts += 1
+        if step <= call_index < step + count:
+            ctr.fired += 1
+            return (site, value)
+        return None
+
+    with _resilience.install_anomaly_hook(hook):
+        yield ctr
+
+
+@contextlib.contextmanager
+def inject_nonfinite(step, kind="nan", site="grads", count=1):
+    """The training-anomaly fault: NaN/Inf grads (or loss) produced
+    INSIDE the compiled step at step invocation `step` — the failure a
+    flaky interconnect or a bad batch injects into a real run, which
+    `resilience.StepGuard` must skip/rewind past
+    (docs/RESILIENCE.md "Chaos proof")."""
+    if kind not in ("nan", "inf"):
+        raise ValueError(f"kind must be 'nan' or 'inf', got {kind!r}")
+    val = float("nan") if kind == "nan" else float("inf")
+    with inject_anomaly(step, val, site=site, count=count) as ctr:
         yield ctr
 
 

@@ -1,0 +1,112 @@
+"""Deterministic TrainStep loop under the resilience StepGuard.
+
+Driven by tests/test_resilience.py through paddle_tpu.testing.chaos:
+prints one ``STEP <n> LOSS <hex>`` line per ACCEPTED step (float32 loss
+bytes — string equality IS bit-for-bit equality, chaos_train_worker
+style) plus ``GUARD <action> <n> <kind>`` lines for skips/rollbacks, so
+a guarded run with an injected anomaly can be compared against a clean
+run step by step. Anomalies come from ``--inject-step`` via
+``chaos.inject_nonfinite`` — NaN/Inf grads produced INSIDE the compiled
+step — and the escalation ladder (skip → checkpoint rewind → abort) is
+exercised by ``--inject-count``/``--max-consecutive``/``--max-rollbacks``.
+"""
+import argparse
+import contextlib
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # before paddle_tpu/jax import
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+from paddle_tpu.distributed.fleet.elastic import auto_resume
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.resilience import GuardAbortError, StepGuard
+from paddle_tpu.testing import chaos
+
+
+def batch(step):
+    """Per-step data keyed by GLOBAL step number — identical across
+    retries, rewound replays, and resumed processes."""
+    rng = np.random.default_rng(1000 + step)
+    x = rng.standard_normal((16, 8)).astype(np.float32)
+    y = rng.standard_normal((16, 4)).astype(np.float32)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--resume", choices=("auto", "none"), default="auto")
+    ap.add_argument("--keep", type=int, default=3)
+    ap.add_argument("--inject-step", type=int, default=None,
+                    help="1-based step invocation to poison")
+    ap.add_argument("--inject-kind", choices=("nan", "inf"), default="nan")
+    ap.add_argument("--inject-site", choices=("grads", "loss"),
+                    default="grads")
+    ap.add_argument("--inject-count", type=int, default=1,
+                    help="consecutive invocations the fault persists")
+    ap.add_argument("--max-consecutive", type=int, default=3)
+    ap.add_argument("--max-rollbacks", type=int, default=2)
+    args = ap.parse_args()
+
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+
+    def train_fn(x, y):
+        return nn.functional.mse_loss(model(x), y)
+
+    step = TrainStep(model, train_fn, opt)
+
+    manager = None
+    start = 0
+    if args.ckpt_dir:
+        manager = CheckpointManager(args.ckpt_dir, keep=args.keep)
+        if args.resume == "auto":
+            start = auto_resume(args.ckpt_dir, model, opt) or 0
+            if start:
+                print(f"RESUMED {start}", flush=True)
+
+    guard = StepGuard(step, manager=manager,
+                      max_consecutive=args.max_consecutive,
+                      max_rollbacks=args.max_rollbacks)
+
+    ctx = contextlib.nullcontext()
+    if args.inject_step is not None:
+        ctx = chaos.inject_nonfinite(args.inject_step, kind=args.inject_kind,
+                                     site=args.inject_site,
+                                     count=args.inject_count)
+    with ctx:
+        gstep = start + 1
+        while gstep <= args.steps:
+            try:
+                out = guard(gstep, *batch(gstep))
+            except GuardAbortError as e:
+                print(f"ABORTED {gstep} {e}", flush=True)
+                sys.exit(3)
+            if out.accepted:
+                if manager is not None:
+                    manager.save_training_state(gstep, model, opt,
+                                                train_step=step,
+                                                async_save=True)
+                lhex = np.asarray(out.health.loss,
+                                  np.float32).tobytes().hex()
+                print(f"STEP {gstep} LOSS {lhex}", flush=True)
+            else:
+                print(f"GUARD {out.action} {gstep} {out.health.kind}",
+                      flush=True)
+            gstep = out.next_step
+
+    if manager is not None:
+        manager.wait()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

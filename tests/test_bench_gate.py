@@ -53,6 +53,81 @@ def test_discovers_latest_round_in_root(tmp_path):
     assert bg.main(["--root", str(tmp_path)]) == 1
 
 
+def _round_with_resilience(tmp_path, name, value, resilience):
+    rec = {"metric": "m", "value": value, "unit": "tokens/sec/chip",
+           "resilience": resilience}
+    p = tmp_path / name
+    p.write_text(json.dumps(rec) + "\n")
+    return str(p)
+
+
+def test_resilience_gate_fails_on_anomalies(tmp_path, capsys):
+    """ISSUE 5: a clean bench run reporting guard anomalies must fail
+    even with no tokens/sec regression."""
+    old = _round(tmp_path, "BENCH_r01.json", {"m": 100.0})
+    new = _round_with_resilience(
+        tmp_path, "dirty.json", 100.0,
+        {"enabled": True, "anomalies": {"nonfinite": 2},
+         "anomalies_total": 2, "skips": 2, "rollbacks": 0,
+         "aborted": False})
+    assert bg.main([new, "--against", old]) == 1
+    assert "guard_anomalies_total=2" in capsys.readouterr().out
+
+
+def test_resilience_gate_fails_on_rollback_without_reference(tmp_path,
+                                                             capsys):
+    # no earlier round: tokens/sec not gated, resilience still is
+    new = _round_with_resilience(
+        tmp_path, "BENCH_r01.json", 100.0,
+        {"enabled": True, "anomalies": {}, "anomalies_total": 0,
+         "skips": 0, "rollbacks": 1, "aborted": False})
+    assert bg.main(["--root", str(tmp_path)]) == 1
+    assert "rollbacks=1" in capsys.readouterr().out
+
+
+def test_resilience_gate_passes_clean_and_disabled_blocks(tmp_path):
+    old = _round(tmp_path, "BENCH_r01.json", {"m": 100.0})
+    clean = _round_with_resilience(
+        tmp_path, "clean.json", 100.0,
+        {"enabled": True, "anomalies": {}, "anomalies_total": 0,
+         "skips": 0, "rollbacks": 0, "aborted": False})
+    assert bg.main([clean, "--against", old]) == 0
+    off = _round_with_resilience(tmp_path, "off.json", 100.0,
+                                 {"enabled": False})
+    assert bg.main([off, "--against", old]) == 0
+    # records with no block at all (older rounds) keep passing
+    assert bg.main([old, "--against", old]) == 0
+
+
+def test_resilience_block_suppresses_duplicate_counter_report(tmp_path):
+    """bench.py attaches the process-global telemetry snapshot to every
+    metric line; when an enabled guard block is present it already
+    reports those same events — the counters must not re-report them
+    (one anomaly would otherwise print up to once per source per line,
+    and model A's anomaly would land on model B's line)."""
+    rec = {"metric": "m", "value": 10.0,
+           "resilience": {"enabled": True, "anomalies": {"spike": 2},
+                          "anomalies_total": 2, "rollbacks": 1,
+                          "aborted": False},
+           "telemetry": {"counters": {
+               "guard_anomalies_total": {"kind=spike": 2},
+               "guard_rollbacks_total": {"": 1}}}}
+    v = bg.resilience_violations(rec)
+    assert v == ["guard_anomalies_total=2 ({'spike': 2})",
+                 "guard rollbacks=1"]  # block only, nothing doubled
+
+
+def test_resilience_gate_reads_telemetry_counters(tmp_path, capsys):
+    rec = {"metric": "m", "value": 10.0,
+           "telemetry": {"counters": {
+               "guard_anomalies_total": {"kind=spike": 3}}}}
+    p = tmp_path / "tel.json"
+    p.write_text(json.dumps(rec) + "\n")
+    old = _round(tmp_path, "BENCH_r01.json", {"m": 10.0})
+    assert bg.main([str(p), "--against", old]) == 1
+    assert "guard_anomalies_total=3" in capsys.readouterr().out
+
+
 def test_baseline_without_numbers_is_skipped(tmp_path, capsys):
     new = _round(tmp_path, "BENCH_r02.json", {"m": 100.0})
     base = tmp_path / "BASELINE.json"

@@ -280,6 +280,140 @@ class TestTrainingState:
                                           err_msg=k)
 
 
+class TestRestoreLastGood:
+    """Satellite (ISSUE 5): the resilience guard's rewind entry —
+    newest committed step, skipping guard-marked-bad steps and anything
+    at/after the anomalous step."""
+
+    def _trained(self, seed=0):
+        paddle.seed(seed)
+        model = nn.Linear(4, 2)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=model.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        model(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        return model, opt
+
+    def _three_steps(self, tmp_path):
+        model, opt = self._trained()
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        for s in (1, 2, 3):
+            # distinguishable per-step weights
+            for p in model.parameters():
+                p._data = p._data * 0 + float(s)
+            mgr.save_training_state(s, model, opt)
+        return mgr, model, opt
+
+    def test_picks_newest_good_below_before_step(self, tmp_path):
+        mgr, model, opt = self._three_steps(tmp_path)
+        assert mgr.restore_last_good(model, opt) == 3
+        assert mgr.restore_last_good(model, opt, before_step=3) == 2
+        w = np.asarray(list(model.parameters())[0]._data)
+        np.testing.assert_array_equal(w, np.full(w.shape, 2.0))
+
+    def test_mark_bad_skips_step_and_persists(self, tmp_path):
+        mgr, model, opt = self._three_steps(tmp_path)
+        mgr.mark_bad(3, reason="guard: anomaly recurred")
+        assert mgr.is_bad(3)
+        assert mgr.good_steps() == [1, 2]
+        assert mgr.last_good_step() == 2
+        assert mgr.restore_last_good(model, opt) == 2
+        # the BAD marker survives a process restart (fresh manager)
+        mgr2 = CheckpointManager(mgr.root)
+        assert mgr2.is_bad(3)
+        assert mgr2.restore_last_good(model, opt) == 2
+        # restore()'s fallback walk skips it too (auto_resume must not
+        # land on a state the guard rewound away from)
+        t = {k: paddle.to_tensor(np.zeros(v.shape, np.float32))
+             for k, v in model.state_dict().items()}
+        assert mgr2.restore(t) == 2
+
+    def test_resave_clears_stale_bad_marker(self, tmp_path):
+        """A rollback replay can re-save a step number the guard marked
+        BAD; the fresh commit IS the cure, so it must clear the verdict
+        (in memory AND the on-disk marker) — otherwise the replayed
+        checkpoint stays invisible to restore/rollback/gc forever."""
+        mgr, model, opt = self._three_steps(tmp_path)
+        mgr.mark_bad(3, reason="guard: anomaly recurred")
+        assert mgr.last_good_step() == 2
+        for p in model.parameters():
+            p._data = p._data * 0 + 30.0  # the replayed (cured) state
+        mgr.save_training_state(3, model, opt)
+        assert not mgr.is_bad(3)
+        assert mgr.last_good_step() == 3
+        mgr2 = CheckpointManager(mgr.root)  # marker gone on disk too
+        assert not mgr2.is_bad(3)
+        assert mgr2.restore_last_good(model, opt) == 3
+        w = np.asarray(list(model.parameters())[0]._data)
+        np.testing.assert_array_equal(w, np.full(w.shape, 30.0))
+
+    def test_all_bad_gate_is_good_aware(self, tmp_path):
+        """Post-abort disk state: every committed step BAD. A resume
+        gate must key on last_good_step() (None -> fresh start), not
+        latest_step() — restore only walks good steps and would raise
+        where the caller expected a fresh run (bench.py/examples/02)."""
+        mgr, model, opt = self._three_steps(tmp_path)
+        for s in (1, 2, 3):
+            mgr.mark_bad(s)
+        assert mgr.latest_step() == 3          # BAD-inclusive view
+        assert mgr.last_good_step() is None    # what resume gates on
+        with pytest.raises(NoCheckpointError):
+            mgr.restore_training_state(model, opt)
+
+    def test_corrupt_good_step_falls_back(self, tmp_path, metrics):
+        mgr, model, opt = self._three_steps(tmp_path)
+        mgr.mark_bad(3)
+        chaos.corrupt_file(os.path.join(mgr.step_dir(2), "0_0.distcp"))
+        assert mgr.restore_last_good(model, opt) == 1
+        snap = metrics.snapshot()
+        assert snap["counters"][
+            "checkpoint_validation_failures_total"][""] >= 1
+
+    def test_gc_keep_counts_only_good_steps(self, tmp_path):
+        """A BAD step must not crowd a rollback target out of the keep
+        window (review hardening): keep=2 over [1,2,3] with 3 BAD
+        retains good {1,2} and collects the bad step."""
+        model, opt = self._trained()
+        mgr = CheckpointManager(str(tmp_path / "root"), keep=2)
+        for s in (1, 2):
+            mgr.save_training_state(s, model, opt)
+        mgr.save_training_state(3, model, opt)
+        mgr.mark_bad(3)
+        mgr.save_training_state(4, model, opt)  # commit triggers gc
+        steps = mgr.all_steps(committed_only=True)
+        assert 3 not in steps          # bad step collected
+        assert 2 in steps and 4 in steps  # newest 2 GOOD steps retained
+        assert mgr.restore_last_good(model, opt) == 4
+
+    def test_auto_resume_resolution_matches_worker_walk(self, tmp_path):
+        """fleet.elastic.auto_resume(model=None) resolves through the
+        same good-and-valid walk a restoring worker uses: BAD and
+        corrupt newest steps are both skipped (review hardening)."""
+        from paddle_tpu.distributed.fleet.elastic import (
+            auto_resume, latest_checkpoint_step)
+
+        mgr, model, opt = self._three_steps(tmp_path)
+        mgr.mark_bad(3)
+        chaos.corrupt_file(os.path.join(mgr.step_dir(2), "0_0.distcp"))
+        assert auto_resume(mgr.root) == 1          # supervisor view
+        model2, opt2 = self._trained(seed=9)
+        assert auto_resume(mgr.root, model2, opt2) == 1  # worker view
+        assert latest_checkpoint_step(mgr.root) == 2  # newest good (raw)
+        assert auto_resume(str(tmp_path / "none")) is None
+
+    def test_no_good_step_raises(self, tmp_path):
+        mgr, model, opt = self._three_steps(tmp_path)
+        for s in (1, 2, 3):
+            mgr.mark_bad(s)
+        with pytest.raises(NoCheckpointError):
+            mgr.restore_last_good(model, opt)
+        with pytest.raises(NoCheckpointError):
+            CheckpointManager(str(tmp_path / "empty")).restore_last_good(
+                model, opt)
+
+
 class TestPreemptionGuard:
     def test_sigterm_triggers_final_sync_save(self, tmp_path):
         mgr = CheckpointManager(str(tmp_path / "root"))

@@ -1,0 +1,726 @@
+"""ISSUE 5 resilience: in-graph StepHealth, the chaos anomaly seam,
+StepGuard skip/rewind/abort, the hang watchdog, and the satellite fixes
+(clip_grad_norm_ nonfinite handling, GradScaler fused unscale_).
+
+The headline acceptance lives here IN-PROCESS (tier-1): NaN grads
+injected inside the compiled step at step k under StepGuard → the update
+is discarded, the run completes, and the final loss trajectory is
+bit-for-bit identical (float32-hex) to an UNGUARDED clean run — while
+``jit_recompiles_total`` stays at one build. Subprocess variants are
+slow-marked (tier-1 time budget, ISSUE 4/5)."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.telemetry as telemetry
+from paddle_tpu import nn
+from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.resilience import (GuardAbortError, HangWatchdog, StepGuard,
+                                   install_anomaly_hook)
+from paddle_tpu.testing import chaos
+
+WORKER = os.path.join(os.path.dirname(__file__), "launch_assets",
+                      "guard_train_worker.py")
+
+
+def _make(seed=7, lr=0.01, grad_clip=None):
+    paddle.seed(seed)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=model.parameters(),
+                                grad_clip=grad_clip)
+
+    def train_fn(x, y):
+        return nn.functional.mse_loss(model(x), y)
+
+    return model, opt, TrainStep(model, train_fn, opt)
+
+
+def _batch(step):
+    rng = np.random.default_rng(1000 + step)
+    x = rng.standard_normal((16, 8)).astype(np.float32)
+    y = rng.standard_normal((16, 4)).astype(np.float32)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+def _hex32(v):
+    return np.asarray(v, np.float32).tobytes().hex()
+
+
+def _run_clean(steps, seed=7):
+    """{step: loss_hex} of an UNGUARDED TrainStep run — the reference
+    trajectory every guarded/injected run must reproduce exactly."""
+    model, opt, step = _make(seed=seed)
+    out = {}
+    for s in range(1, steps + 1):
+        loss = step(*_batch(s))
+        out[s] = _hex32(float(loss.numpy()))
+    return out
+
+
+@pytest.fixture
+def metrics():
+    telemetry.enable()
+    telemetry.reset()
+    yield telemetry.get_registry()
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# StepHealth: the fused in-graph bundle
+# ---------------------------------------------------------------------------
+class TestStepHealth:
+    def test_none_before_first_step(self):
+        _, _, step = _make()
+        assert step.last_health is None
+
+    def test_clean_step_is_finite_and_ok(self):
+        _, _, step = _make()
+        loss = step(*_batch(1))
+        h = step.last_health
+        assert h.finite and h.ok and h.kind is None
+        assert h.loss == pytest.approx(float(loss.numpy()), rel=1e-6)
+        assert np.isfinite(h.grad_norm) and h.grad_norm > 0
+
+    def test_grad_norm_matches_eager_global_norm(self):
+        """The bundle's norm IS the global-norm reduction (shared with
+        clipping), so it must agree with the eager computation."""
+        x, y = _batch(1)
+        model, _, step = _make(seed=3)
+        step(x, y)
+        h = step.last_health
+
+        paddle.seed(3)
+        twin = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        loss = nn.functional.mse_loss(twin(x), y)
+        loss.backward()
+        eager = float(nn.global_grad_norm(twin.parameters()).numpy())
+        assert h.grad_norm == pytest.approx(eager, rel=1e-4)
+
+    def test_health_with_global_norm_clip(self):
+        """ClipGradByGlobalNorm shares the reduction: the step still
+        trains and reports the PRE-clip norm."""
+        clip = nn.ClipGradByGlobalNorm(0.001)
+        _, _, step = _make(grad_clip=clip)
+        l1 = float(step(*_batch(1)).numpy())
+        h = step.last_health
+        assert h.finite and h.grad_norm > 0.001  # pre-clip norm
+        l2 = float(step(*_batch(1)).numpy())
+        assert np.isfinite(l2) and l2 != l1
+
+
+# ---------------------------------------------------------------------------
+# The chaos anomaly seam (satellite: seam unit tests)
+# ---------------------------------------------------------------------------
+class TestAnomalySeam:
+    def test_nan_grads_detected_and_armed_update_discarded(self):
+        """Armed (StepGuard-driven) steps discard the poisoned update
+        in-graph, keeping the pre-step state bit-for-bit."""
+        model, _, step = _make()
+        step._guard_threshold = float("inf")  # what StepGuard sets
+        entries = model.state_dict()
+        with chaos.inject_nonfinite(2, kind="nan", site="grads") as ctr:
+            step(*_batch(1))
+            assert step.last_health.finite
+            before = {n: np.asarray(t._data).copy()
+                      for n, t in entries.items()}
+            step(*_batch(2))
+            h = step.last_health
+            assert not h.finite and not h.ok
+            assert np.isnan(h.grad_norm)
+            # the in-graph select kept the pre-step state bit-for-bit
+            for n, t in entries.items():
+                np.testing.assert_array_equal(before[n], np.asarray(t._data))
+            step(*_batch(3))
+            assert step.last_health.finite
+        assert ctr.fired == 1 and ctr.attempts == 3
+
+    def test_unguarded_step_keeps_legacy_adopt_semantics(self):
+        """Without a StepGuard the anomaly is REPORTED (health) but the
+        update is adopted exactly as before this subsystem existed — a
+        silent drop must be something users opt into."""
+        model, _, step = _make()
+        assert step._guard_threshold is None  # unarmed
+        entries = model.state_dict()
+        with chaos.inject_nonfinite(1, kind="nan", site="grads"):
+            step(*_batch(1))
+        h = step.last_health
+        assert not h.finite and h.ok  # detected, but adopted (unarmed)
+        assert h.kind == "nonfinite"  # monitoring still sees the anomaly
+        poisoned = any(
+            np.isnan(np.asarray(t._data)).any() for t in entries.values())
+        assert poisoned  # NaN propagated into params, like it always did
+
+    def test_inf_loss_site(self):
+        _, _, step = _make()
+        with chaos.inject_nonfinite(1, kind="inf", site="loss"):
+            loss = step(*_batch(1))
+        h = step.last_health
+        assert not h.finite and np.isinf(float(loss.numpy()))
+        assert np.isinf(h.loss)
+
+    def test_count_spans_consecutive_invocations(self):
+        _, _, step = _make()
+        step._guard_threshold = float("inf")  # armed: skips keep state clean
+        seen = []
+        with chaos.inject_nonfinite(2, count=2):
+            for s in range(1, 5):
+                step(*_batch(s))
+                seen.append(step.last_health.finite)
+        assert seen == [True, False, False, True]
+
+    def test_seam_validates_arguments(self):
+        with pytest.raises(ValueError, match="kind"):
+            with chaos.inject_nonfinite(1, kind="huge"):
+                pass
+        with pytest.raises(ValueError, match="site"):
+            with chaos.inject_nonfinite(1, site="params"):
+                pass
+        with pytest.raises(ValueError, match="value"):
+            with chaos.inject_anomaly(1, 0.0):
+                pass
+
+    def test_hook_uninstalled_on_exit(self):
+        from paddle_tpu import resilience
+
+        with chaos.inject_nonfinite(1):
+            assert resilience._ANOMALY_FAULT_HOOK is not None
+        assert resilience._ANOMALY_FAULT_HOOK is None
+
+
+# ---------------------------------------------------------------------------
+# StepGuard policy
+# ---------------------------------------------------------------------------
+class TestStepGuard:
+    def test_skip_then_retry_matches_clean_bitwise(self, metrics):
+        """THE acceptance: NaN grads at step 4 under StepGuard → skip,
+        retry, run completes, and every accepted step's loss equals the
+        unguarded clean run's float32 hex exactly."""
+        steps = 6
+        clean = _run_clean(steps)
+        model, opt, step = _make()
+        guard = StepGuard(step, max_consecutive=5)
+        got, actions = {}, []
+        with chaos.inject_nonfinite(4, kind="nan", site="grads"):
+            gstep = 1
+            while gstep <= steps:
+                out = guard(gstep, *_batch(gstep))
+                actions.append(out.action)
+                if out.accepted:
+                    got[gstep] = _hex32(out.health.loss)
+                gstep = out.next_step
+        assert actions.count("skip") == 1
+        assert got == clean  # bit-for-bit, every step
+        assert guard.skips == 1 and guard.anomalies == {"nonfinite": 1}
+        snap = metrics.snapshot()
+        assert snap["counters"]["guard_anomalies_total"][
+            "kind=nonfinite"] == 1
+        assert snap["counters"]["guard_skips_total"][""] == 1
+        assert snap["gauges"]["guard_last_good_step"][""] == steps
+
+    def test_no_recompile_from_guarding(self, metrics):
+        """Guarded, threshold-varying, injected steps all run ONE
+        compiled program: jit_recompiles_total must not grow."""
+        model, opt, step = _make()
+        guard = StepGuard(step, max_consecutive=10, min_history=2,
+                          window=4)
+        with chaos.inject_nonfinite(3, kind="nan"):
+            gstep = 1
+            while gstep <= 5:
+                out = guard(gstep, *_batch(gstep))
+                gstep = out.next_step
+        snap = metrics.snapshot()
+        recompiles = snap["counters"]["jit_recompiles_total"]
+        assert recompiles["function=TrainStep[Sequential]"] == 1
+
+    def test_guard_disarms_step_between_calls(self):
+        """Each guarded call arms the step only for its own duration: a
+        later DIRECT call on the raw TrainStep gets legacy
+        adopt-everything semantics, not a frozen stale threshold
+        silently discarding its update."""
+        model, _, step = _make()
+        guard = StepGuard(step, manager=None)
+        for s in range(1, 4):
+            assert guard(s, *_batch(s)).accepted
+        assert step._guard_threshold is None  # disarmed after the call
+        entries = model.state_dict()
+        with chaos.inject_nonfinite(step._call_index + 1, kind="nan",
+                                    site="grads"):
+            step(*_batch(5))  # direct, unguarded call
+        h = step.last_health
+        assert not h.finite and h.ok  # reported, but ADOPTED (unarmed)
+        assert any(np.isnan(np.asarray(t._data)).any()
+                   for t in entries.values())
+
+    def test_spike_detected_and_skipped(self):
+        model, opt, step = _make()
+        guard = StepGuard(step, min_history=4, window=8, zmax=4.0,
+                          max_consecutive=4)
+        gstep = 1
+        while gstep <= 5:
+            out = guard(gstep, *_batch(gstep))
+            assert out.accepted
+            gstep = out.next_step
+        # a finite but absurd loss: spike, not nonfinite
+        with chaos.inject_anomaly(step._call_index + 1, 1e6, site="loss"):
+            out = guard(6, *_batch(6))
+        assert out.action == "skip"
+        assert out.health.finite and not out.health.ok
+        assert out.health.kind == "spike"
+        out = guard(6, *_batch(6))  # retry, clean
+        assert out.accepted
+        assert guard.anomalies == {"spike": 1}
+
+    def test_rollback_restores_committed_and_matches_clean(
+            self, tmp_path, metrics):
+        """K consecutive anomalies escalate to a CheckpointManager
+        rewind; the replayed trajectory still matches the clean run
+        bit-for-bit."""
+        steps = 6
+        clean = _run_clean(steps)
+        model, opt, step = _make()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        guard = StepGuard(step, manager=mgr, max_consecutive=2,
+                          max_rollbacks=2)
+        got, actions = {}, []
+        with chaos.inject_nonfinite(4, kind="nan", count=2):
+            gstep = 1
+            while gstep <= steps:
+                out = guard(gstep, *_batch(gstep))
+                actions.append(out.action)
+                if out.accepted:
+                    mgr.save_training_state(gstep, model, opt,
+                                            train_step=step,
+                                            async_save=True)
+                    got[gstep] = _hex32(out.health.loss)
+                gstep = out.next_step
+        mgr.wait()
+        assert "skip" in actions and "rollback" in actions
+        assert guard.rollbacks == 1
+        assert got == clean  # the rewind replayed steps 4.. exactly
+        # replays must not double-count optimizer steps: the rollback
+        # restored "@step" alongside the RNG stream
+        assert opt._step_count == steps
+        snap = metrics.snapshot()
+        assert snap["counters"]["guard_rollbacks_total"][""] == 1
+
+    def test_abort_without_manager_after_k_consecutive(self):
+        model, opt, step = _make()
+        guard = StepGuard(step, max_consecutive=2)
+        with chaos.inject_nonfinite(1, count=10):
+            out = guard(1, *_batch(1))
+            assert out.action == "skip"
+            with pytest.raises(GuardAbortError, match="no CheckpointManager"):
+                guard(1, *_batch(1))
+        assert guard.aborted
+
+    def test_abort_after_max_rollbacks(self, tmp_path):
+        model, opt, step = _make()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        guard = StepGuard(step, manager=mgr, max_consecutive=1,
+                          max_rollbacks=1)
+        out = guard(1, *_batch(1))
+        assert out.accepted
+        mgr.save_training_state(1, model, opt, train_step=step)
+        with chaos.inject_nonfinite(step._call_index + 1, count=50):
+            out = guard(2, *_batch(2))
+            assert out.action == "rollback" and out.restored_step == 1
+            assert out.next_step == 2
+            with pytest.raises(GuardAbortError, match="persisted through"):
+                guard(2, *_batch(2))
+        assert guard.aborted and guard.rollbacks == 1
+
+    def test_step_count_tracks_accepted_steps_only(self):
+        """A discarded attempt must not advance optimizer._step_count:
+        the guarded run's checkpointed "@step" has to equal the clean
+        run's accepted-step count, not the attempt count."""
+        model, opt, step = _make()
+        guard = StepGuard(step, max_consecutive=5)
+        with chaos.inject_nonfinite(3, kind="nan"):
+            gstep, accepted = 1, 0
+            while accepted < 4:
+                out = guard(gstep, *_batch(gstep))
+                if out.accepted:
+                    accepted += 1
+                gstep = out.next_step
+        assert guard.skips == 1
+        assert opt._step_count == 4  # 5 attempts, 4 accepted
+
+    def test_cured_target_not_marked_bad_on_second_episode(self, tmp_path):
+        """Accepted progress after a rollback proves the target cured
+        that episode: a later, INDEPENDENT anomaly burst rewinding to
+        the same (still-newest) commit must not mark_bad it — doing so
+        would gc/hide a good checkpoint or abort a healthy run."""
+        model, opt, step = _make()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        guard = StepGuard(step, manager=mgr, max_consecutive=1,
+                          max_rollbacks=5)
+        for s in (1, 2):
+            out = guard(s, *_batch(s))
+            assert out.accepted
+        mgr.save_training_state(2, model, opt, train_step=step)
+        assert guard(3, *_batch(3)).accepted  # progress, no new commit
+        with chaos.inject_nonfinite(step._call_index + 1, kind="nan"):
+            out = guard(4, *_batch(4))
+        assert out.action == "rollback" and out.restored_step == 2
+        # replayed steps accept -> the first episode is cured
+        for s in (3, 4):
+            assert guard(s, *_batch(s)).accepted
+        with chaos.inject_nonfinite(step._call_index + 1, kind="nan"):
+            out = guard(5, *_batch(5))
+        assert out.action == "rollback" and out.restored_step == 2
+        assert not mgr.is_bad(2)  # same target, but NOT a recurrence
+        assert guard.rollbacks == 2
+
+    def test_persistent_spike_escalates_through_rollback_to_abort(
+            self, tmp_path):
+        """The loss window survives a rollback (trimmed to the restored
+        step), so the recurring spike that forced the rewind is
+        re-flagged on its first replayed attempt and the ladder reaches
+        abort. A cleared window would return +inf thresholds for
+        min_history replayed steps, ADOPT the spike, and poison the
+        rolling median with it — detection then never re-engages."""
+        model, opt, step = _make()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        guard = StepGuard(step, manager=mgr, min_history=4, window=8,
+                          zmax=4.0, max_consecutive=2, max_rollbacks=1)
+        gstep = 1
+        while gstep <= 6:
+            out = guard(gstep, *_batch(gstep))
+            assert out.accepted
+            mgr.save_training_state(gstep, model, opt, train_step=step)
+            gstep = out.next_step
+        actions = []
+        # a persistent finite spike: every attempt from here on spikes
+        with chaos.inject_anomaly(step._call_index + 1, 1e6, site="loss",
+                                  count=50):
+            with pytest.raises(GuardAbortError, match="persisted through"):
+                while True:
+                    out = guard(gstep, *_batch(gstep))
+                    actions.append(out.action)
+                    gstep = out.next_step
+        assert "rollback" in actions
+        assert "accept" not in actions  # the spike was NEVER adopted
+        assert guard.aborted and guard.rollbacks == 1
+        assert guard.anomalies.get("spike", 0) >= 3
+
+    def test_recurring_anomaly_marks_rollback_target_bad(self, tmp_path):
+        """A second rewind landing on the SAME step marks it bad and
+        reaches further back (restore_last_good skips it)."""
+        model, opt, step = _make()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        guard = StepGuard(step, manager=mgr, max_consecutive=1,
+                          max_rollbacks=5)
+        for s in (1, 2):
+            out = guard(s, *_batch(s))
+            assert out.accepted
+            mgr.save_training_state(s, model, opt, train_step=step)
+        with chaos.inject_nonfinite(step._call_index + 1, count=2):
+            out = guard(3, *_batch(3))
+            assert out.action == "rollback" and out.restored_step == 2
+            out = guard(3, *_batch(3))
+            assert out.action == "rollback" and out.restored_step == 1
+        assert mgr.is_bad(2)
+        assert mgr.last_good_step() == 1
+        out = guard(2, *_batch(2))  # replays from the rewound state
+        assert out.accepted
+
+    def test_recurrence_marks_actually_restored_step_past_corrupt(
+            self, tmp_path):
+        """When restore falls back past a CORRUPT newest-good step, the
+        recurrence mark must land on the step actually restored — keying
+        on last_good_step() would never match the fallback-restored
+        step, so the ladder would re-land on the same uncuring state
+        until abort and leave no BAD trail for auto_resume."""
+        model, opt, step = _make()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        guard = StepGuard(step, manager=mgr, max_consecutive=1,
+                          max_rollbacks=5)
+        for s in (1, 2, 3):
+            out = guard(s, *_batch(s))
+            assert out.accepted
+            mgr.save_training_state(s, model, opt, train_step=step)
+        chaos.corrupt_file(os.path.join(mgr.step_dir(3), "0_0.distcp"))
+        with chaos.inject_nonfinite(step._call_index + 1, count=2):
+            out = guard(4, *_batch(4))
+            # fell back past the corrupt newest-good step 3
+            assert out.action == "rollback" and out.restored_step == 2
+            out = guard(4, *_batch(4))
+            # no accepted progress since: the ACTUALLY restored step 2
+            # is marked bad and the rewind reaches further back
+            assert out.action == "rollback" and out.restored_step == 1
+        assert mgr.is_bad(2)
+
+    def test_skip_preserves_rng_stream_for_stochastic_models(self):
+        """Review hardening: a discarded attempt must not shift the
+        random stream — a DROPOUT model's guarded-with-injection
+        trajectory still matches the clean run bit-for-bit."""
+        def make():
+            paddle.seed(11)
+            model = nn.Sequential(nn.Linear(8, 16), nn.Dropout(0.5),
+                                  nn.Linear(16, 4))
+            model.train()
+            opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=model.parameters())
+            return model, TrainStep(
+                model, lambda x, y: nn.functional.mse_loss(model(x), y),
+                opt)
+
+        _, step = make()
+        clean = {}
+        for s in range(1, 6):
+            clean[s] = _hex32(float(step(*_batch(s)).numpy()))
+        assert len(set(clean.values())) > 1
+
+        _, step = make()
+        guard = StepGuard(step, max_consecutive=5)
+        got = {}
+        with chaos.inject_nonfinite(3, kind="nan"):
+            gstep = 1
+            while gstep <= 5:
+                out = guard(gstep, *_batch(gstep))
+                if out.accepted:
+                    got[gstep] = _hex32(out.health.loss)
+                gstep = out.next_step
+        assert guard.skips == 1
+        assert got == clean  # dropout masks drawn in clean-run order
+
+    def test_summary_block_shape(self):
+        _, _, step = _make()
+        guard = StepGuard(step)
+        out = guard(1, *_batch(1))
+        assert out.accepted
+        s = guard.summary()
+        assert s["enabled"] is True
+        assert s["anomalies_total"] == 0 and s["rollbacks"] == 0
+        assert s["last_good_step"] == 1 and s["aborted"] is False
+        json.dumps(s)  # must be JSON-able for the bench "resilience" block
+
+
+# ---------------------------------------------------------------------------
+# Hang watchdog
+# ---------------------------------------------------------------------------
+class TestHangWatchdog:
+    def test_fires_on_wedged_step_and_dumps_debris(self, tmp_path, metrics):
+        fired = []
+        wd = HangWatchdog(str(tmp_path / "debris"), hang_factor=2.0,
+                          min_hang_seconds=0.15, poll_interval=0.03,
+                          min_history=2, on_hang=fired.append)
+        with wd:
+            for s in range(1, 4):  # healthy history
+                wd.step_started(s)
+                time.sleep(0.01)
+                wd.step_finished()
+            wd.step_started(99)  # wedged: never finishes
+            deadline = time.monotonic() + 5
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert fired and fired[0] and os.path.exists(fired[0])
+        with open(fired[0]) as f:
+            debris = json.load(f)
+        assert debris["step"] == 99 and debris["reason"] == "hang"
+        assert debris["elapsed_seconds"] >= debris["limit_seconds"]
+        # all-thread stacks + a telemetry snapshot ride in the debris
+        assert any("MainThread" in k for k in debris["threads"])
+        assert "counters" in debris["telemetry"]
+        snap = metrics.snapshot()
+        assert snap["counters"]["hang_watchdog_fires_total"][""] == 1
+
+    def test_refires_for_new_attempt_of_same_step(self, tmp_path):
+        """Review hardening: a RETRY of the same step number (guard
+        skip / rollback replay) is a new attempt — a second wedge must
+        fire again, not be suppressed by the first firing."""
+        fired = []
+        wd = HangWatchdog(str(tmp_path / "debris"), min_hang_seconds=0.05,
+                          poll_interval=0.02, on_hang=fired.append)
+        with wd:
+            deadline = time.monotonic() + 5
+            wd.step_started(7)
+            while len(fired) < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            wd.step_finished()
+            wd.step_started(7)  # the retried attempt wedges too
+            while len(fired) < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert len(fired) == 2
+
+    def test_does_not_fire_on_healthy_steps(self, tmp_path):
+        fired = []
+        wd = HangWatchdog(str(tmp_path / "debris"), min_hang_seconds=5.0,
+                          poll_interval=0.02, on_hang=fired.append)
+        with wd:
+            for s in range(5):
+                wd.step_started(s)
+                time.sleep(0.01)
+                wd.step_finished()
+            time.sleep(0.1)  # idle (no in-flight step) must not fire
+        assert not fired and not wd.debris_files
+
+    def test_exit_on_hang_uses_exit_seam(self, tmp_path):
+        exits = []
+        wd = HangWatchdog(str(tmp_path / "debris"), min_hang_seconds=0.05,
+                          poll_interval=0.02, exit_on_hang=True,
+                          exit_code=43)
+        wd._exit = exits.append  # the os._exit seam
+        with wd:
+            wd.step_started(1)
+            deadline = time.monotonic() + 5
+            while not exits and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert exits == [43]
+
+    def test_limit_tracks_rolling_p50(self, tmp_path):
+        wd = HangWatchdog(str(tmp_path / "d"), hang_factor=3.0,
+                          min_hang_seconds=0.0, min_history=2)
+        assert wd.hang_limit_seconds() == 0.0  # no history: floor only
+        for dur in (0.1, 0.2, 0.3):
+            wd._durations.append(dur)
+        assert wd.p50_step_seconds() == pytest.approx(0.2)
+        assert wd.hang_limit_seconds() == pytest.approx(0.6)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: clip_grad_norm_ nonfinite handling + exposed norm
+# ---------------------------------------------------------------------------
+class TestClipGradNorm:
+    def _graded_model(self):
+        paddle.seed(0)
+        model = nn.Linear(8, 4)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        model(x).sum().backward()
+        return model
+
+    def test_error_if_nonfinite_raises(self):
+        model = self._graded_model()
+        p0 = list(model.parameters())[0]
+        p0.grad._data = p0.grad._data.at[0].set(float("nan"))
+        with pytest.raises(RuntimeError, match="non-finite"):
+            nn.clip_grad_norm_(model.parameters(), 1.0,
+                               error_if_nonfinite=True)
+
+    def test_nonfinite_norm_never_scales_grads(self):
+        """max_norm/inf == 0 would silently ZERO every grad; the fixed
+        path leaves them untouched and returns the nonfinite norm."""
+        model = self._graded_model()
+        params = list(model.parameters())
+        params[0].grad._data = params[0].grad._data.at[0].set(float("inf"))
+        before = [np.asarray(p.grad._data).copy() for p in params]
+        total = nn.clip_grad_norm_(model.parameters(), 1.0)
+        assert np.isinf(float(total.numpy()))
+        for b, p in zip(before, params):
+            np.testing.assert_array_equal(b, np.asarray(p.grad._data))
+
+    def test_finite_clip_still_scales(self):
+        model = self._graded_model()
+        total = nn.clip_grad_norm_(model.parameters(), 0.5)
+        assert float(total.numpy()) > 0.5  # returns the PRE-clip norm
+        after = float(nn.global_grad_norm(model.parameters()).numpy())
+        assert after == pytest.approx(0.5, rel=1e-4)
+
+    def test_global_grad_norm_exposed_and_pure(self):
+        model = self._graded_model()
+        params = list(model.parameters())
+        manual = np.sqrt(sum(
+            float((np.asarray(p.grad._data, np.float64) ** 2).sum())
+            for p in params))
+        before = [np.asarray(p.grad._data).copy() for p in params]
+        got = float(nn.global_grad_norm(model.parameters()).numpy())
+        assert got == pytest.approx(manual, rel=1e-5)
+        for b, p in zip(before, params):  # read-only
+            np.testing.assert_array_equal(b, np.asarray(p.grad._data))
+
+
+# ---------------------------------------------------------------------------
+# Satellite: GradScaler fused unscale_
+# ---------------------------------------------------------------------------
+class TestGradScalerUnscale:
+    def _model_with_grads(self):
+        paddle.seed(0)
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        model(x).sum().backward()
+        return model, opt
+
+    def test_unscale_divides_and_reports_finite(self, metrics):
+        model, opt = self._model_with_grads()
+        scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=4.0)
+        before = [np.asarray(p.grad._data).copy()
+                  for p in model.parameters()]
+        scaler.unscale_(opt)
+        assert scaler._found_inf is False
+        for b, p in zip(before, model.parameters()):
+            np.testing.assert_allclose(b / 4.0, np.asarray(p.grad._data),
+                                       rtol=1e-6)
+        snap = metrics.snapshot()
+        assert "amp_found_inf_total" not in snap["counters"] or \
+            snap["counters"]["amp_found_inf_total"].get("", 0) == 0
+
+    def test_found_inf_counts_and_skips_step(self, metrics):
+        model, opt = self._model_with_grads()
+        p0 = list(model.parameters())[0]
+        p0.grad._data = p0.grad._data.at[0].set(float("inf"))
+        before = {id(p): np.asarray(p._data).copy()
+                  for p in model.parameters()}
+        scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=2.0)
+        scaler.step(opt)
+        assert scaler._found_inf is True
+        for p in model.parameters():  # the update was skipped
+            np.testing.assert_array_equal(before[id(p)],
+                                          np.asarray(p._data))
+        assert scaler._scale < 2.0  # dynamic scale decayed
+        snap = metrics.snapshot()
+        assert snap["counters"]["amp_found_inf_total"][""] == 1
+
+
+# ---------------------------------------------------------------------------
+# Subprocess chaos proofs (slow: tier-1 time budget; the same guarantees
+# are covered in-process above)
+# ---------------------------------------------------------------------------
+def _worker_argv(ckpt_dir, *extra):
+    return [sys.executable, WORKER, "--ckpt-dir", str(ckpt_dir),
+            "--steps", "6", *extra]
+
+
+def _worker_env():
+    env = chaos.subprocess_env()
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    return env
+
+
+@pytest.mark.slow
+def test_guarded_worker_with_injection_matches_clean(tmp_path):
+    clean_lines, rc = chaos.run_to_completion(
+        _worker_argv(tmp_path / "a"), env=_worker_env())
+    assert rc == 0 and "DONE" in clean_lines, clean_lines[-10:]
+    ref = chaos.step_losses(clean_lines)
+
+    inj_lines, rc = chaos.run_to_completion(
+        _worker_argv(tmp_path / "b", "--inject-step", "3",
+                     "--inject-count", "2", "--max-consecutive", "2"),
+        env=_worker_env())
+    assert rc == 0 and "DONE" in inj_lines, inj_lines[-10:]
+    assert any(ln.startswith("GUARD skip") for ln in inj_lines)
+    assert any(ln.startswith("GUARD rollback") for ln in inj_lines)
+    assert chaos.step_losses(inj_lines) == ref  # bit-for-bit
+
+
+@pytest.mark.slow
+def test_guarded_worker_aborts_loudly_on_persistent_anomaly(tmp_path):
+    lines, rc = chaos.run_to_completion(
+        _worker_argv(tmp_path / "c", "--inject-step", "2",
+                     "--inject-count", "99", "--max-consecutive", "1",
+                     "--max-rollbacks", "1"),
+        env=_worker_env())
+    assert rc == 3, lines[-10:]
+    assert any(ln.startswith("ABORTED") for ln in lines)
+    assert "DONE" not in lines

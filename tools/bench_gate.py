@@ -97,6 +97,41 @@ def _round_files(root):
     return sorted((p for p in files if key(p) >= 0), key=key)
 
 
+def resilience_violations(rec):
+    """Violation strings from one bench record's "resilience" block and
+    its telemetry guard counters. A CLEAN bench run (no chaos injection)
+    must report zero anomalies and zero rollbacks — any other value
+    means the hardware/numerics misbehaved during the measurement or the
+    guard false-positived; either way the round must not land silently
+    (docs/RESILIENCE.md)."""
+    out = []
+    res = rec.get("resilience")
+    if isinstance(res, dict) and res.get("enabled"):
+        anomalies = res.get("anomalies") or {}
+        total = res.get("anomalies_total")
+        if total is None:
+            total = sum(int(v) for v in anomalies.values())
+        if int(total) > 0:
+            out.append(f"guard_anomalies_total={total} ({anomalies})")
+        if int(res.get("rollbacks") or 0) > 0:
+            out.append(f"guard rollbacks={res['rollbacks']}")
+        if res.get("aborted"):
+            out.append("guard ABORTED the run")
+        # the per-guard block is authoritative for this record; the
+        # process-global telemetry counters describe the SAME events
+        # (shared across every metric line) — reporting both would
+        # print one anomaly up to once per source per line
+        return out
+    counters = (rec.get("telemetry") or {}).get("counters") or {}
+    for name in ("guard_anomalies_total", "guard_rollbacks_total"):
+        series = counters.get(name) or {}
+        total = (sum(series.values()) if isinstance(series, dict)
+                 else int(series))
+        if total:
+            out.append(f"telemetry {name}={total}")
+    return out
+
+
 def compare(new_metrics, ref_metrics, threshold):
     """-> (rows, regressions). Each row: (metric, old, new, ratio|None)."""
     rows, regressions = [], []
@@ -159,9 +194,10 @@ def main(argv=None):
         if refs is None:
             if not rounds:
                 print(f"bench_gate: {candidate}: no earlier round to gate "
-                      "against — pass", flush=True)
-                return 0
-            refs = [rounds[-1]]
+                      "against — tokens/sec not gated", flush=True)
+                refs = []  # the resilience gate below still applies
+            else:
+                refs = [rounds[-1]]
 
     new_metrics = load_metrics(candidate)
     if not new_metrics:
@@ -169,6 +205,13 @@ def main(argv=None):
         return 2
 
     failed = False
+    # resilience gate: independent of any reference round — a clean bench
+    # run reporting guard anomalies or rollbacks fails outright
+    for metric, rec in sorted(new_metrics.items()):
+        for v in resilience_violations(rec):
+            print(f"  GUARD {metric}: {v} — clean bench runs must report "
+                  "zero anomalies/rollbacks", flush=True)
+            failed = True
     for ref_path in refs:
         ref_metrics = load_metrics(ref_path)
         print(f"bench_gate: {os.path.basename(candidate)} vs "
