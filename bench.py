@@ -176,7 +176,14 @@ def run_model(model_kind, ckpt=None):
                   "PTPU_PALLAS_RMS", "PTPU_FUSED_ADDRMS", "PTPU_INT8_FFN",
                   "PTPU_FA_BLOCK", "PTPU_FA_BWD_BLOCK",
                   "PTPU_UNROLL_LAYERS", "PTPU_CE_CHUNK", "PTPU_CE_VCHUNK",
-                  "PTPU_LOSS_HEAD", "PTPU_ROPE_HOIST")
+                  "PTPU_LOSS_HEAD", "PTPU_ROPE_HOIST",
+                  # comms knobs change the lowered program (manual-region
+                  # grad reduce, bucket layout, fused tp seams) — a plan
+                  # priced under one comm regime must not be reused under
+                  # another (docs/COMMS.md)
+                  "PTPU_QUANT_COLLECTIVES", "PTPU_QUANT_GRADS",
+                  "PTPU_COMM_BUCKET_MB", "PTPU_QUANT_MIN_NUMEL",
+                  "PTPU_QUANT_EXCLUDE", "PTPU_TP_SEAM")
     ) + (("int8_head", F.int8_head_enabled()),)  # gate outcome, not just env
     decision = pmem.plan_train_step(
         step_factory, candidates, require_fit=require_fit,
@@ -333,6 +340,18 @@ def run_model(model_kind, ckpt=None):
 
     dist.all_reduce(loss, op=dist.ReduceOp.AVG)
 
+    # "comms" block (docs/COMMS.md): bytes/calls/seconds per op+axis from
+    # the telemetry counters, the exact-vs-int8 traffic split, and the
+    # quantized-reduce parity probe tools/bench_gate.py gates on. On a
+    # single chip the probe is skipped ({"enabled": false}) but the
+    # per-op accounting still lands — the knob state is always visible.
+    from paddle_tpu.distributed import collectives as _coll
+    from paddle_tpu.distributed.fleet import active_mesh as _active_mesh
+
+    comms = _coll.comms_summary(
+        telemetry.snapshot(),
+        parity=_coll.parity_probe(_active_mesh()))
+
     tokens_per_sec = batch * seq * max(n_ran, 1) / dt
 
     # MFU: 6 * params * tokens/sec / peak_flops
@@ -369,6 +388,9 @@ def run_model(model_kind, ckpt=None):
         # guard decision totals (docs/RESILIENCE.md): a CLEAN bench run
         # must report zero anomalies and zero rollbacks — bench_gate
         # exits 1 otherwise. {"enabled": false} when --guard is off.
+        # comms traffic split + parity probe (mirrors "telemetry"/
+        # "memory"; contract in docs/COMMS.md, gated by bench_gate)
+        "comms": comms,
         "resilience": (dict(step_guard.summary(),
                             watchdog_fires=(len(watchdog.debris_files)
                                             if watchdog is not None else 0))

@@ -1,0 +1,312 @@
+"""paddle_tpu.distributed.collectives — the hot-path collectives, owned.
+
+Pre-PR, every training collective was implicit: the dp grad all-reduce
+and the tp matmul seams were exactly what XLA's GSPMD emitted,
+serialized after the backward. This subsystem makes communication a
+first-class perf axis (ROADMAP item 2):
+
+- **Quantized grad all-reduce** (:mod:`.quantized`): blockwise-int8
+  (per-256-block scales, the same grid as ``memory/int8_ckpt``) with
+  exact integer accumulation — EQuARX (PAPERS.md) reports negligible
+  quality cost for gradient traffic. Applied to the dp gradient psum
+  inside ``ShardedTrainStep`` with per-tensor opt-out (norms,
+  embeddings, small tensors stay exact).
+- **Bucketed backward-overlap** (:mod:`.overlap`): the grad tree
+  partitions into size-bounded buckets, each reduced by its own
+  collective so XLA can hide reduce time under remaining backward
+  compute instead of serializing one tree-sized fusion after it.
+- **Fused tp seams** (:mod:`.fused`): matmul+reduce-scatter and
+  all-gather+matmul shard_map kernels for the row/col-parallel layers.
+
+Knobs (docs/COMMS.md):
+
+- ``PTPU_QUANT_COLLECTIVES`` (default on): master switch. ``=0`` is the
+  bitwise-parity escape hatch — every path in this package disengages
+  and the compiled step is byte-identical to the pre-PR program.
+- ``PTPU_QUANT_GRADS`` (default on): int8 for the dp grad reduce
+  specifically (off = exact psum, still bucketed/overlapped).
+- ``PTPU_COMM_BUCKET_MB`` / ``PTPU_QUANT_MIN_NUMEL`` /
+  ``PTPU_QUANT_EXCLUDE``: bucket bound and the exact-tensor opt-out.
+- ``PTPU_TP_SEAM``: ``auto`` | ``fused`` | ``0`` (see :mod:`.fused`).
+
+Knobs are read when a step BUILDS (never per call), so toggling the env
+between calls cannot recompile — asserted by the recompile-invariance
+test.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from ... import telemetry as _telemetry
+from .quantized import (  # noqa: F401
+    QUANT_BLOCK,
+    packed_int32_psum,
+    quantize_shared_scale_int8,
+    quantized_all_reduce_rs_ag,
+    quantized_psum,
+    quantized_wire_bytes,
+)
+from .overlap import (  # noqa: F401
+    DEFAULT_BUCKET_MB,
+    DEFAULT_MIN_QUANT_NUMEL,
+    EXACT_NAME_FRAGMENTS,
+    GradBucket,
+    GradReducePlan,
+    bucket_bytes_cap,
+    is_exact_grad,
+    min_quant_numel,
+    partition_buckets,
+    reduce_grads,
+)
+from .fused import (  # noqa: F401
+    TPSeamPlan,
+    plan_tp_seams,
+    tp_seam_mode,
+)
+
+__all__ = [
+    "quant_collectives_enabled", "grads_quantized", "manual_grad_region",
+    "in_manual_grad_region", "build_grad_reduce_plan", "note_grad_reduce",
+    "quantized_psum", "quantized_all_reduce_rs_ag", "packed_int32_psum",
+    "partition_buckets", "reduce_grads", "GradReducePlan", "GradBucket",
+    "plan_tp_seams", "TPSeamPlan", "comms_summary", "parity_probe",
+    "PARITY_THRESHOLD",
+]
+
+
+def quant_collectives_enabled():
+    """Master switch (``PTPU_QUANT_COLLECTIVES``, default ON). ``=0``
+    must reproduce the pre-PR step bitwise — every consumer checks this
+    FIRST."""
+    return os.environ.get("PTPU_QUANT_COLLECTIVES", "1") not in ("0", "off")
+
+
+def grads_quantized():
+    """int8 for the dp grad reduce (``PTPU_QUANT_GRADS``, default ON;
+    master switch must also be on)."""
+    return (quant_collectives_enabled()
+            and os.environ.get("PTPU_QUANT_GRADS", "1") not in ("0", "off"))
+
+
+# -- manual-region tracing flag --------------------------------------------
+# This XLA cannot nest gather/scatter shard_map islands inside a
+# manual-subgroup region (spmd_partitioner CHECK failure), so code that
+# would open one (the fused tp seams, the sharded CE head) must know it
+# is being traced inside the quantized dp-grad region. Legacy jax's
+# get_abstract_mesh shim reports an always-empty mesh, so the region is
+# tracked explicitly here; tracing is single-threaded per call.
+_MANUAL_REGION_DEPTH = [0]
+
+
+@contextlib.contextmanager
+def manual_grad_region():
+    _MANUAL_REGION_DEPTH[0] += 1
+    try:
+        yield
+    finally:
+        _MANUAL_REGION_DEPTH[0] -= 1
+
+
+def in_manual_grad_region():
+    return _MANUAL_REGION_DEPTH[0] > 0
+
+
+# -- telemetry --------------------------------------------------------------
+# same-registry families as distributed/communication (labelnames must
+# match across definition sites — the registry rejects a mismatch)
+_COLL_CALLS = _telemetry.counter(
+    "collective_calls_total", "eager collective API calls",
+    labelnames=("op", "axis", "nranks"))
+_COLL_BYTES = _telemetry.counter(
+    "collective_bytes_total", "payload bytes entering eager collectives",
+    labelnames=("op", "axis", "nranks"))
+_COLL_SECONDS = _telemetry.histogram(
+    "collective_seconds", "wall time per collective entry",
+    labelnames=("op", "axis"))
+_COLL_QBYTES = _telemetry.counter(
+    "collective_quantized_bytes_total",
+    "payload bytes that rode an int8-quantized collective (the same "
+    "basis as collective_bytes_total: bytes ENTERING the reduce, so the "
+    "exact/quantized split sums to total traffic)",
+    labelnames=("op", "axis"))
+
+
+def note_quantized_bytes(op, axis, nbytes):
+    """Count payload bytes that rode an int8 collective (same basis as
+    collective_bytes_total, so exact = total - quantized)."""
+    if _telemetry.get_registry().enabled and nbytes:
+        _COLL_QBYTES.inc(int(nbytes), labels=(op, axis))
+
+
+def note_grad_reduce(plan):
+    """Tick the per-step comms accounting for one executed grad-reduce
+    plan (host side; the payload sizes are static per plan)."""
+    if not _telemetry.get_registry().enabled or plan is None:
+        return
+    labels3 = ("grad_reduce", plan.axis_label, str(plan.nranks))
+    _COLL_CALLS.inc(plan.calls, labels=labels3)
+    _COLL_BYTES.inc(plan.exact_bytes + plan.quantized_payload_bytes,
+                    labels=labels3)
+    if plan.quantized_payload_bytes:
+        _COLL_QBYTES.inc(plan.quantized_payload_bytes,
+                         labels=("grad_reduce", plan.axis_label))
+
+
+def build_grad_reduce_plan(named_params, mesh, *, exclude_axes=(),
+                           quantized=None, bucket_bytes=None):
+    """Build the dp-grad reduce plan for a ShardedTrainStep, or None.
+
+    ``named_params``: [(name, shape, dtype)] in reduce (state-dict)
+    order. Engages only when it is provably safe AND worthwhile on this
+    runtime:
+
+    - master knob on;
+    - the live mesh axes are a subset of {dp, sharding, mp} (pipeline /
+      context-parallel / expert meshes keep the GSPMD path — their
+      kernels open their own manual regions, which cannot nest here);
+    - at least one data axis (dp/sharding) is live, shards the batch,
+      and is named by NO parameter placement (ZeRO-3 'sharding'
+      placements stay with GSPMD);
+    - at least one gradient actually quantizes (tiny models keep the
+      exact pre-PR program byte-for-byte — nothing to win there).
+    """
+    if not quant_collectives_enabled():
+        return None
+    if quantized is None:
+        quantized = grads_quantized()
+    live = {a: mesh.get_dim_size(a) for a in mesh.dim_names
+            if mesh.get_dim_size(a) > 1}
+    if not live or not set(live) <= {"dp", "sharding", "mp"}:
+        return None
+    axes = tuple(a for a in ("dp", "sharding")
+                 if a in live and a not in exclude_axes)
+    if not axes:
+        return None
+    buckets = partition_buckets(named_params, bucket_bytes=bucket_bytes,
+                                quantized=quantized)
+    if not any(b.quantized for b in buckets):
+        return None
+    nranks = 1
+    for a in axes:
+        nranks *= live[a]
+    return GradReducePlan(axes=axes, nranks=nranks, buckets=buckets)
+
+
+# -- reporting --------------------------------------------------------------
+#: quantized-vs-exact parity gate. The probe normalizes |quant - exact|
+#: by nranks * shared_block_absmax — the quantization GRID, which is
+#: what theory bounds: each rank rounds by at most half a step
+#: (shared_absmax/254), so the summed error is <= 1/254 ~ 0.0039 of the
+#: grid. Threshold at 1/127 leaves 2x headroom; anything past it means
+#: the quantizer itself regressed.
+PARITY_THRESHOLD = 1.0 / 127
+
+
+def comms_summary(snapshot, plan=None, parity=None):
+    """Assemble the bench/dryrun ``"comms"`` block from a telemetry
+    snapshot: bytes/calls/seconds per op+axis plus the exact-vs-int8
+    traffic split (docs/COMMS.md contract)."""
+    counters = (snapshot or {}).get("counters") or {}
+    hists = (snapshot or {}).get("histograms") or {}
+
+    def _series(name):
+        return counters.get(name) or {}
+
+    def _op_axis(labels):
+        d = dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+        return f"{d.get('op', '?')}@{d.get('axis', '?')}"
+
+    per_op = {}
+    for name, field in (("collective_bytes_total", "bytes"),
+                        ("collective_calls_total", "calls")):
+        for labels, v in _series(name).items():
+            row = per_op.setdefault(_op_axis(labels), {})
+            row[field] = row.get(field, 0) + int(v)
+    for labels, h in (hists.get("collective_seconds") or {}).items():
+        row = per_op.setdefault(_op_axis(labels), {})
+        row["seconds_sum"] = float(h.get("sum", 0.0))
+        row["seconds_p50"] = float(h.get("p50", 0.0))
+    total = sum(op.get("bytes", 0) for op in per_op.values())
+    qtotal = sum(int(v)
+                 for v in _series("collective_quantized_bytes_total").values())
+    out = {
+        "enabled": quant_collectives_enabled(),
+        "per_op": per_op,
+        "bytes_total": int(total),
+        "quantized_bytes_total": int(qtotal),
+        "exact_bytes_total": int(total - qtotal),
+        "quantized_fraction": (float(qtotal) / total) if total else 0.0,
+    }
+    if plan is not None:
+        out["grad_reduce"] = plan.summary()
+    if parity is not None:
+        out["parity"] = parity
+    return out
+
+
+def parity_probe(mesh=None, axis=None, *, numel=1 << 14, seed=0):
+    """Quantized-vs-exact loss-parity probe: reduce a skewed/outlier
+    gradient surrogate over a live mesh axis with BOTH kernels and
+    report the max per-block relative error plus wall times. The bench
+    attaches the result to its "comms" block; ``tools/bench_gate.py``
+    fails the round when ``max_rel_err > threshold``."""
+    import time
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None:
+        from ..fleet import active_mesh
+
+        mesh = active_mesh()
+    if mesh is None or not quant_collectives_enabled():
+        return {"enabled": False}
+    if axis is None:
+        axis = next((a for a in ("dp", "sharding")
+                     if a in mesh.dim_names and mesh.get_dim_size(a) > 1),
+                    None)
+    if axis is None:
+        return {"enabled": False}
+    n = mesh.get_dim_size(axis)
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal((n, numel)).astype(np.float32)
+    data[:, rng.integers(0, numel, max(numel // 256, 1))] *= 1000.0  # outliers
+    sharding = NamedSharding(mesh.jax_mesh, PartitionSpec(axis))
+    arr = jax.device_put(jnp.asarray(data), sharding)
+
+    def _q(b):
+        return quantized_psum(b[0], (axis,), n)[None]
+
+    def _e(b):
+        return jax.lax.psum(b[0], (axis,))[None]
+
+    spec = PartitionSpec(axis)
+    kw = dict(mesh=mesh.jax_mesh, in_specs=(spec,), out_specs=spec,
+              check_vma=False, axis_names={axis})
+    qf = jax.jit(shard_map(_q, **kw))
+    ef = jax.jit(shard_map(_e, **kw))
+    qv = np.asarray(qf(arr))[0]          # compile + run
+    ev = np.asarray(ef(arr))[0]
+    t0 = time.perf_counter()
+    qf(arr).block_until_ready()
+    tq = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ef(arr).block_until_ready()
+    te = time.perf_counter() - t0
+    # error relative to the shared quantization GRID (nranks * the
+    # cross-rank per-block absmax) — the quantity theory bounds; the
+    # exact SUM's magnitude is not (cancellation shrinks it arbitrarily)
+    blk = QUANT_BLOCK if numel % QUANT_BLOCK == 0 else 1
+    shared_amax = np.abs(data).reshape(n, -1, blk).max(axis=(0, 2))
+    diff = np.abs(qv - ev).reshape(-1, blk).max(axis=1)
+    err = float((diff / np.maximum(n * shared_amax, 1e-6)).max())
+    return {
+        "enabled": True, "axis": axis, "nranks": n, "numel": numel,
+        "max_rel_err": err, "threshold": PARITY_THRESHOLD,
+        "ok": err <= PARITY_THRESHOLD,
+        "quantized_seconds": tq, "exact_seconds": te,
+    }

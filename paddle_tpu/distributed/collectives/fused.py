@@ -1,0 +1,236 @@
+"""Fused compute-collective TP seams: matmul+reduce-scatter and
+all-gather+matmul.
+
+The Megatron row/col-parallel seams in ``models/gpt.py::_block_pure``
+(attn ``o @ wo``, ffn ``ffn @ wd`` and their column-parallel partners)
+are pre-PR whatever GSPMD emits: matmul, then a standalone mp
+all-reduce of the full activation. These kernels make the seam explicit
+("Optimizing Distributed ML Communication with Fused
+Computation-Collective Operations", PAPERS.md):
+
+- :func:`matmul_reduce_scatter` — row-parallel ``x @ w``: each shard
+  multiplies its contraction slice, and the partial sums resolve
+  DIRECTLY into sequence shards via reduce-scatter. Output is
+  seq-sharded over the tp axis — half the wire bytes of the all-reduce,
+  and the residual-add/norm between seams runs on 1/tp of the rows
+  (Megatron sequence parallelism as an explicit kernel).
+- :func:`all_gather_matmul` — column-parallel ``x @ w`` whose input is
+  seq-sharded: the gather feeds the matmul inside one shard_map body, so
+  XLA can overlap the gather with the first output tiles.
+
+Both are ``custom_vjp``: the backward is hand-written per-shard
+(all-gather+matmul backs matmul+reduce-scatter and vice versa; weight
+grads psum over the data axes inside the body) — AD never transposes
+through a collective, which legacy shard_map gets wrong by 1/tp (the
+same discipline as the vocab-sharded CE, nn/functional/
+fused_cross_entropy.py).
+
+The islands are FULLY-manual shard_maps over the whole mesh (data axes
+partition the batch dim, the tp axis partitions contraction/seq): this
+XLA's SPMD partitioner rejects gather/scatter collectives in
+partial-auto regions, so the seams cannot nest inside the quantized
+dp-grad manual region — ``plan_tp_seams`` returns None there and the
+grad reduce wins (docs/COMMS.md documents the precedence).
+"""
+from __future__ import annotations
+
+import collections
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _data_spec(data_axes):
+    return tuple(data_axes) if data_axes else None
+
+
+#: (mm_rs, ag_mm, tp) per mesh VALUE (process ids + shape + dim names,
+#: not object identity — ProcessMesh defines no __eq__, and fleet
+#: re-inits build equal-but-distinct meshes every test/strategy change).
+#: Bounded so long-lived processes churning meshes can't grow it forever;
+#: stable function identities keep jit from retracing per call.
+_SEAM_CACHE = collections.OrderedDict()
+_SEAM_CACHE_CAP = 32
+
+
+def _seam_fns(mesh, tp_axis, data_axes):
+    """One (mm_rs, ag_mm) custom_vjp pair per (mesh value, tp_axis,
+    data_axes) — cached so jit sees stable function identities."""
+    key = (tuple(mesh.process_ids), tuple(mesh.shape),
+           tuple(mesh.dim_names), tp_axis, tuple(data_axes))
+    fns = _SEAM_CACHE.get(key)
+    if fns is not None:
+        _SEAM_CACHE.move_to_end(key)
+        return fns
+    while len(_SEAM_CACHE) >= _SEAM_CACHE_CAP:
+        _SEAM_CACHE.popitem(last=False)
+    from jax import shard_map
+
+    jmesh = mesh.jax_mesh
+    D = _data_spec(data_axes)
+    tp = mesh.get_dim_size(tp_axis)
+
+    # ---- row-parallel: y = x @ w, x [b,s,k] k-sharded, w [k,n] ----------
+    def _mm_rs_fwd_body(xl, wl):
+        part = xl @ wl
+        return jax.lax.psum_scatter(part, tp_axis, scatter_dimension=1,
+                                    tiled=True)
+
+    _mm_rs_fwd_sm = shard_map(
+        _mm_rs_fwd_body, mesh=jmesh,
+        in_specs=(P(D, None, tp_axis), P(tp_axis, None)),
+        out_specs=P(D, tp_axis, None), check_vma=False)
+
+    def _mm_rs_bwd_body(dyl, xl, wl):
+        dyg = jax.lax.all_gather(dyl, tp_axis, axis=1, tiled=True)
+        dxl = dyg @ wl.T
+        dwl = jnp.einsum("bsk,bsn->kn", xl.astype(jnp.float32),
+                         dyg.astype(jnp.float32))
+        dwl = jax.lax.psum(dwl, data_axes) if data_axes else dwl
+        return dxl.astype(xl.dtype), dwl.astype(wl.dtype)
+
+    _mm_rs_bwd_sm = shard_map(
+        _mm_rs_bwd_body, mesh=jmesh,
+        in_specs=(P(D, tp_axis, None), P(D, None, tp_axis),
+                  P(tp_axis, None)),
+        out_specs=(P(D, None, tp_axis), P(tp_axis, None)),
+        check_vma=False)
+
+    @jax.custom_vjp
+    def mm_rs(x, w):
+        return _mm_rs_fwd_sm(x, w)
+
+    def mm_rs_fwd(x, w):
+        return _mm_rs_fwd_sm(x, w), (x, w)
+
+    def mm_rs_bwd(res, dy):
+        x, w = res
+        return _mm_rs_bwd_sm(dy, x, w)
+
+    mm_rs.defvjp(mm_rs_fwd, mm_rs_bwd)
+
+    # ---- column-parallel: y = x @ w, x [b,s,h] seq-sharded, w [h,n] -----
+    def _ag_mm_fwd_body(xl, wl):
+        xg = jax.lax.all_gather(xl, tp_axis, axis=1, tiled=True)
+        return xg @ wl
+
+    _ag_mm_fwd_sm = shard_map(
+        _ag_mm_fwd_body, mesh=jmesh,
+        in_specs=(P(D, tp_axis, None), P(None, tp_axis)),
+        out_specs=P(D, None, tp_axis), check_vma=False)
+
+    def _ag_mm_bwd_body(dyl, xl, wl):
+        dxp = dyl @ wl.T                       # partial over tp
+        dxl = jax.lax.psum_scatter(dxp, tp_axis, scatter_dimension=1,
+                                   tiled=True)
+        xg = jax.lax.all_gather(xl, tp_axis, axis=1, tiled=True)
+        dwl = jnp.einsum("bsh,bsn->hn", xg.astype(jnp.float32),
+                         dyl.astype(jnp.float32))
+        dwl = jax.lax.psum(dwl, data_axes) if data_axes else dwl
+        return dxl.astype(xl.dtype), dwl.astype(wl.dtype)
+
+    _ag_mm_bwd_sm = shard_map(
+        _ag_mm_bwd_body, mesh=jmesh,
+        in_specs=(P(D, None, tp_axis), P(D, tp_axis, None),
+                  P(None, tp_axis)),
+        out_specs=(P(D, tp_axis, None), P(None, tp_axis)),
+        check_vma=False)
+
+    @jax.custom_vjp
+    def ag_mm(x, w):
+        return _ag_mm_fwd_sm(x, w)
+
+    def ag_mm_fwd(x, w):
+        # save the SEQ-SHARDED input (1/tp of the rows) and re-gather in
+        # backward — the remat-friendly choice
+        return _ag_mm_fwd_sm(x, w), (x, w)
+
+    def ag_mm_bwd(res, dy):
+        x, w = res
+        return _ag_mm_bwd_sm(dy, x, w)
+
+    ag_mm.defvjp(ag_mm_fwd, ag_mm_bwd)
+    _SEAM_CACHE[key] = (mm_rs, ag_mm, tp)
+    return _SEAM_CACHE[key]
+
+
+class TPSeamPlan:
+    """Static seam context for one (mesh, tp_axis): resolved once per
+    traced forward (StackedDecoder.forward) and threaded to every seam
+    call in ``_block_pure``."""
+
+    __slots__ = ("mesh", "tp_axis", "data_axes", "tp")
+
+    def __init__(self, mesh, tp_axis, data_axes):
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self.data_axes = tuple(data_axes)
+        self.tp = mesh.get_dim_size(tp_axis)
+
+    def _applicable(self, x, w):
+        """Shapes must split evenly: batch over the data axes, seq and
+        the tp-sharded weight dim over tp. Also requires a traced
+        context: the islands only lower under jit (legacy shard_map has
+        no eager execution path), so concrete eager calls keep the plain
+        matmul."""
+        if not isinstance(x, jax.core.Tracer):
+            return False
+        if x.ndim != 3 or w.ndim != 2:
+            return False
+        b, s, _ = x.shape
+        nd = 1
+        for a in self.data_axes:
+            nd *= self.mesh.get_dim_size(a)
+        return b % nd == 0 and s % self.tp == 0
+
+    def matmul_reduce_scatter(self, x, w):
+        """Row-parallel seam; returns the seq-sharded product, or the
+        plain matmul when shapes don't split."""
+        if not (self._applicable(x, w) and x.shape[2] % self.tp == 0):
+            return x @ w
+        mm_rs, _, _ = _seam_fns(self.mesh, self.tp_axis, self.data_axes)
+        return mm_rs(x, w)
+
+    def all_gather_matmul(self, x, w):
+        """Column-parallel seam over a (possibly) seq-sharded input."""
+        if not (self._applicable(x, w) and w.shape[1] % self.tp == 0):
+            return x @ w
+        _, ag_mm, _ = _seam_fns(self.mesh, self.tp_axis, self.data_axes)
+        return ag_mm(x, w)
+
+
+def tp_seam_mode():
+    """PTPU_TP_SEAM: "auto" (default — fuse when the mesh allows),
+    "fused" (force where structurally possible), "0" (off)."""
+    return os.environ.get("PTPU_TP_SEAM", "auto").strip().lower()
+
+
+def plan_tp_seams(mesh, tp_axis="mp"):
+    """Resolve the fused-seam plan for this trace, or None.
+
+    Engages when the master knob is on, ``PTPU_TP_SEAM`` is not "0",
+    the tp axis is live, no pipeline axis is live (the pipeline keeps
+    'pp' manual and the islands cannot nest in it), and the trace is not
+    inside the quantized dp-grad manual region (same nesting limit —
+    the grad reduce takes precedence; docs/COMMS.md)."""
+    from . import in_manual_grad_region, quant_collectives_enabled
+
+    mode = tp_seam_mode()
+    if mode in ("0", "off", "false") or not quant_collectives_enabled():
+        return None
+    if mesh is None or tp_axis not in mesh.dim_names:
+        return None
+    if mesh.get_dim_size(tp_axis) <= 1:
+        return None
+    if "pp" in mesh.dim_names and mesh.get_dim_size("pp") > 1:
+        return None
+    if "sep" in mesh.dim_names and mesh.get_dim_size("sep") > 1:
+        return None
+    if in_manual_grad_region():
+        return None
+    data_axes = tuple(
+        a for a in ("dp", "sharding")
+        if a in mesh.dim_names and mesh.get_dim_size(a) > 1)
+    return TPSeamPlan(mesh, tp_axis, data_axes)

@@ -50,11 +50,19 @@ _COLL_CALLS = _telemetry.counter(
 _COLL_BYTES = _telemetry.counter(
     "collective_bytes_total", "payload bytes entering eager collectives",
     labelnames=("op", "axis", "nranks"))
+_COLL_SECONDS = _telemetry.histogram(
+    "collective_seconds", "wall time per collective entry",
+    labelnames=("op", "axis"))
 
 
 def _note_collective(op, group, *tensors):
+    """Count the call + payload bytes AND return a timer over the whole
+    entry (``with _note_collective(...)``): the collective_seconds{op,
+    axis} histogram next to the call/byte counters, so a snapshot shows
+    where comm wall time went, not only how much traffic moved
+    (docs/TELEMETRY.md)."""
     if not _TELEMETRY_REG.enabled:
-        return
+        return _telemetry.timer(_COLL_SECONDS)  # disabled: no clock reads
     nranks = group.nranks if group is not None else 1
     labels = (op, "g", str(nranks))
     _COLL_CALLS.inc(labels=labels)
@@ -64,6 +72,7 @@ def _note_collective(op, group, *tensors):
         nbytes += int(getattr(data, "nbytes", 0) or 0)
     if nbytes:
         _COLL_BYTES.inc(nbytes, labels=labels)
+    return _telemetry.timer(_COLL_SECONDS, labels=(op, "g"))
 
 
 # ---------------------------------------------------------------------------
@@ -77,13 +86,73 @@ class ReduceOp:
     AVG = "avg"
 
 
+# MIN/MAX ride pmin/pmax (true reductions — nothing to cap); PROD has no
+# pprod primitive, so it is reduced pairwise (_prod_reducer below) instead
+# of the old jnp.prod(all_gather(...)), which materialized an n-x copy of
+# the tensor on every rank before reducing it.
 _REDUCERS = {
     ReduceOp.SUM: jax.lax.psum,
     ReduceOp.MAX: jax.lax.pmax,
     ReduceOp.MIN: jax.lax.pmin,
     ReduceOp.AVG: lambda x, ax: jax.lax.pmean(x, ax),
-    ReduceOp.PROD: lambda x, ax: jnp.prod(jax.lax.all_gather(x, ax), axis=0),
 }
+
+
+def _prod_reducer(n):
+    """O(1)-memory cross-rank product: recursive doubling over XOR
+    partners when ``n`` is a power of two (log2 n ppermutes), ring
+    rotation otherwise (n-1 ppermutes) — at most two live copies of the
+    tensor at any point, vs the gathered [n, ...] stack."""
+
+    def red(x, ax):
+        if n & (n - 1) == 0:
+            d = 1
+            while d < n:
+                perm = [(i, i ^ d) for i in range(n)]
+                x = x * jax.lax.ppermute(x, ax, perm)
+                d *= 2
+            return x
+        acc, rot = x, x
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        for _ in range(n - 1):
+            rot = jax.lax.ppermute(rot, ax, perm)
+            acc = acc * rot
+        return acc
+
+    return red
+
+
+def _resolve_reducer(op, n):
+    """The per-shard reduction body for ``op`` over an ``n``-rank axis —
+    shared by every eager collective that accepts a ReduceOp, so none of
+    them can silently fall back to SUM for the exotic ops."""
+    if op in _REDUCERS:
+        return _REDUCERS[op]
+    if op == ReduceOp.PROD:
+        return _prod_reducer(n)
+    raise ValueError(f"unknown ReduceOp {op!r}")
+
+
+# Compiled eager-collective programs, keyed (op, group, payload shape/
+# dtype): the old path rebuilt + retraced a fresh shard_map closure on
+# EVERY call (ISSUE 6 satellite — per-call Python overhead at eager
+# entry). Steady-state calls now hit jax.jit's dispatch fast path;
+# bounded LRU so churning groups can't grow it without bound.
+import collections as _collections
+
+_PROGRAM_CACHE = _collections.OrderedDict()
+_PROGRAM_CACHE_CAP = 128
+
+
+def _cached_program(key, build):
+    prog = _PROGRAM_CACHE.get(key)
+    if prog is None:
+        while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_CAP:
+            _PROGRAM_CACHE.popitem(last=False)
+        prog = _PROGRAM_CACHE[key] = build()
+    else:
+        _PROGRAM_CACHE.move_to_end(key)
+    return prog
 
 
 @dataclass
@@ -234,25 +303,53 @@ def _is_dist_multiprocess():
         return False
 
 
-def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """In-place all_reduce of this rank's tensor across the group."""
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               quantized=False):
+    """In-place all_reduce of this rank's tensor across the group.
+
+    ``quantized=True`` routes a SUM/AVG reduce through the EQuARX
+    blockwise-int8 pipeline (collectives.quantized_all_reduce_rs_ag:
+    int8 reduce-scatter with int32 accumulation + int8 all-gather, ~1
+    byte/element on the wire per phase) — the group's 1-D mesh is a
+    fully-manual region, where the gather/scatter lowering is valid."""
     group = group or _get_default_group()
-    _note_collective("all_reduce", group, tensor)
-    if group.nranks <= 1:
-        return tensor
-    red = _REDUCERS[op]
-    if _is_dist_multiprocess():
-        # multi-controller: every process holds a same-shape local tensor;
-        # reduce across the process dimension via a global-array psum.
-        stacked = _global_stack(tensor, group)
-    else:
-        stacked = jnp.broadcast_to(tensor._data, (group.nranks,) + tuple(tensor.shape))
+    with _note_collective("all_reduce_q8" if quantized else "all_reduce",
+                          group, tensor):
+        if group.nranks <= 1:
+            return tensor
+        n = group.nranks
+        if quantized and op not in (ReduceOp.SUM, ReduceOp.AVG):
+            raise ValueError("quantized all_reduce supports SUM/AVG only")
+        if _is_dist_multiprocess():
+            # multi-controller: every process holds a same-shape local
+            # tensor; reduce across the process dim via a global-array psum.
+            stacked = _global_stack(tensor, group)
+        else:
+            stacked = jnp.broadcast_to(tensor._data,
+                                       (n,) + tuple(tensor.shape))
+        key = ("all_reduce", op, bool(quantized), tuple(group.ranks),
+               tuple(stacked.shape), str(stacked.dtype))
 
-    def _ar(block):
-        return red(block, "g")
+        def build():
+            if quantized:
+                from ..collectives import quantized_all_reduce_rs_ag
 
-    out = _collective_1d(group, _ar, stacked)
-    tensor._data = _this_rank_view(group, out)
+                def red(x, ax):
+                    return quantized_all_reduce_rs_ag(
+                        x, ax, n, mean=op == ReduceOp.AVG)
+            else:
+                red = _resolve_reducer(op, n)
+            return jax.jit(shard_map(
+                lambda b: red(b, "g"), mesh=group.mesh,
+                in_specs=(P("g"),), out_specs=P("g"), check_vma=False))
+
+        out = _cached_program(key, build)(stacked)
+        if quantized:
+            from ..collectives import note_quantized_bytes
+
+            note_quantized_bytes("all_reduce_q8", "g",
+                                 int(tensor._data.nbytes))
+        tensor._data = _this_rank_view(group, out)
     return tensor
 
 
@@ -267,7 +364,11 @@ def _global_stack(tensor, group):
 
 def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True, axis=0):
     group = group or _get_default_group()
-    _note_collective("all_gather", group, tensor)
+    with _note_collective("all_gather", group, tensor):
+        return _all_gather_impl(tensor_list, tensor, group)
+
+
+def _all_gather_impl(tensor_list, tensor, group):
     if group.nranks <= 1:
         tensor_list.append(Tensor(tensor._data))
         return tensor_list
@@ -321,17 +422,17 @@ def reduce(tensor: Tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def broadcast(tensor: Tensor, src, group=None, sync_op=True):
     group = group or _get_default_group()
-    _note_collective("broadcast", group, tensor)
-    if group.nranks <= 1:
-        return tensor
-    if _is_dist_multiprocess():
-        from jax.experimental import multihost_utils
+    with _note_collective("broadcast", group, tensor):
+        if group.nranks <= 1:
+            return tensor
+        if _is_dist_multiprocess():
+            from jax.experimental import multihost_utils
 
-        root = group.get_group_rank(src)
-        val = multihost_utils.broadcast_one_to_all(
-            np.asarray(tensor._data), is_source=(group.rank == root)
-        )
-        tensor._data = jnp.asarray(val)
+            root = group.get_group_rank(src)
+            val = multihost_utils.broadcast_one_to_all(
+                np.asarray(tensor._data), is_source=(group.rank == root)
+            )
+            tensor._data = jnp.asarray(val)
     return tensor
 
 
@@ -341,23 +442,33 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None, syn
     mirrors all_reduce: every "rank" holds the same inputs, so slot r sums
     to n * tensor_list[r]."""
     group = group or _get_default_group()
-    _note_collective("reduce_scatter", group, *tensor_list)
-    if group.nranks <= 1:
-        tensor._data = tensor_list[0]._data
-        return tensor
-    cat = jnp.stack([t._data for t in tensor_list], 0)  # this rank: [n, ...]
-    if _is_dist_multiprocess():
-        g = _global_stack(Tensor(cat), group)  # [nprocs, n, ...]
-    else:
-        g = jnp.broadcast_to(cat, (group.nranks,) + tuple(cat.shape))
+    with _note_collective("reduce_scatter", group, *tensor_list):
+        if group.nranks <= 1:
+            tensor._data = tensor_list[0]._data
+            return tensor
+        cat = jnp.stack([t._data for t in tensor_list], 0)  # rank: [n, ...]
+        if _is_dist_multiprocess():
+            g = _global_stack(Tensor(cat), group)  # [nprocs, n, ...]
+        else:
+            g = jnp.broadcast_to(cat, (group.nranks,) + tuple(cat.shape))
 
-    def _rs(block):  # block: [1, n, ...] -> this rank's reduced shard
-        red = jax.lax.psum(block[0], "g")  # [n, ...]
-        idx = jax.lax.axis_index("g")
-        return jax.lax.dynamic_slice_in_dim(red, idx, 1, 0)
+        key = ("reduce_scatter", op, tuple(group.ranks),
+               tuple(g.shape), str(g.dtype))
 
-    out = _collective_1d(group, _rs, g)  # [n, ...], row r = rank r's result
-    tensor._data = _this_rank_view(group, out)
+        def build():
+            reducer = _resolve_reducer(op, group.nranks)
+
+            def _rs(block):  # [1, n, ...] -> this rank's reduced shard
+                red = reducer(block[0], "g")  # [n, ...]
+                idx = jax.lax.axis_index("g")
+                return jax.lax.dynamic_slice_in_dim(red, idx, 1, 0)
+
+            return jax.jit(shard_map(
+                _rs, mesh=group.mesh, in_specs=(P("g"),),
+                out_specs=P("g"), check_vma=False))
+
+        out = _cached_program(key, build)(g)  # [n, ...], row r = rank r
+        tensor._data = _this_rank_view(group, out)
     return tensor
 
 
@@ -366,7 +477,11 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     inputs, so rank r's output list is [in[r]] * n — consistent with the
     degenerate all_reduce/reduce_scatter semantics above."""
     group = group or _get_default_group()
-    _note_collective("all_to_all", group, *in_tensor_list)
+    with _note_collective("all_to_all", group, *in_tensor_list):
+        return _all_to_all_impl(out_tensor_list, in_tensor_list, group)
+
+
+def _all_to_all_impl(out_tensor_list, in_tensor_list, group):
     n = group.nranks
     if n <= 1 or not _is_dist_multiprocess():
         r = max(group.rank, 0)

@@ -83,6 +83,11 @@ class ShardedTrainStep(TrainStep):
                                 else env if env not in ("", "0") else None)
         self.shard_vocab_head = shard_vocab_head
         self._placed = False
+        # dp-grad reduce plan (distributed/collectives): resolved at
+        # first trace (knobs are build-time, never per call) — None
+        # keeps the pre-PR GSPMD grad psum byte-for-byte
+        self._reduce_plan = None
+        self._reduce_plan_ready = False
 
     # -- placement ---------------------------------------------------------
     def _place_model(self):
@@ -164,6 +169,140 @@ class ShardedTrainStep(TrainStep):
             self._place_opt_state(params)
         return self._place_batch(raw_batch)
 
+    # -- quantized/bucketed dp-grad reduce (distributed/collectives) -------
+    def _ensure_reduce_plan(self):
+        """Resolve (once) whether this step owns its dp grad reduce.
+
+        Falls back to the inherited GSPMD program (plan None) whenever
+        the restructure is unsafe or worthless on this runtime: master
+        knob off, checkify debug mode, a live mesh axis outside
+        {dp, sharding, mp} (pipeline/sep/ep kernels open their own
+        manual regions, which cannot nest inside ours on this XLA), a
+        param placement on a data axis (ZeRO-3), a vocab-sharded head
+        (same nesting limit), or no gradient big enough to quantize."""
+        if self._reduce_plan_ready:
+            return self._reduce_plan
+        self._reduce_plan_ready = True
+        self._reduce_plan = None
+        from ..utils.flags import get_flags
+        from . import collectives
+
+        if not collectives.quant_collectives_enabled():
+            return None
+        if get_flags("check_nan_inf")["check_nan_inf"]:
+            return None
+        mp_live = ("mp" in self.mesh.dim_names
+                   and self.mesh.get_dim_size("mp") > 1)
+        if self.shard_vocab_head and mp_live:
+            # the vocab-sharded CE opens its own mp shard_map island
+            return None
+        if collectives.tp_seam_mode() == "fused" and mp_live:
+            # explicit seam forcing: the seam islands win the one manual
+            # region this XLA allows (docs/COMMS.md precedence)
+            return None
+        entries = self.model.state_dict()
+        taken = set()
+        for n in self._param_names:
+            da = getattr(entries[n], "_dist_attr", None)
+            if da is None:
+                continue
+            for ax_name, pl in zip(da.process_mesh.dim_names, da.placements):
+                if isinstance(pl, Shard):
+                    taken.add(ax_name)
+        if taken & {"dp", "sharding"}:
+            # ZeRO-3: a param placement on a DATA axis means the forward
+            # must all-gather params inside the region, and gather with
+            # manual subgroups is exactly the lowering this XLA rejects
+            # (docs/COMMS.md runtime limits) — those placements stay
+            # with GSPMD end to end, on every data axis
+            return None
+        named = [(n, tuple(entries[n]._data.shape),
+                  entries[n]._data.dtype) for n in self._param_names]
+        self._reduce_plan = collectives.build_grad_reduce_plan(
+            named, self.mesh)
+        return self._reduce_plan
+
+    def comms_plan(self):
+        """The active grad-reduce plan (None = pre-PR GSPMD path) — the
+        bench/dryrun "comms" block embeds its summary()."""
+        return self._reduce_plan if self._reduce_plan_ready else None
+
+    def _value_and_grads(self, make_loss_of, params, buffers, key_arr,
+                         batch):
+        # checkify debug rebuilds (FLAGS_check_nan_inf flipped after the
+        # first build) must not reuse an engaged plan: checkify cannot
+        # instrument through the manual region
+        if getattr(self, "_checkified", False):
+            return super()._value_and_grads(make_loss_of, params, buffers,
+                                            key_arr, batch)
+        plan = self._ensure_reduce_plan()
+        if plan is None:
+            return super()._value_and_grads(make_loss_of, params, buffers,
+                                            key_arr, batch)
+        import jax as _jax
+        from jax import shard_map
+
+        from . import collectives
+
+        axes = plan.axes
+        total = int(np.prod([self.mesh.get_dim_size(a) for a in axes]))
+
+        def leaf_spec(arr):
+            # mirror _batch_spec: dim 0 over the data axes when it splits
+            if (hasattr(arr, "ndim") and arr.ndim >= 1
+                    and arr.shape[0] % total == 0):
+                return P(axes)
+            return P()
+
+        batch_specs = tuple(leaf_spec(a) for a in batch)
+        pspecs = {n: P() for n in params}
+        bspecs = {n: P() for n in buffers}
+        nbspecs = {n: P() for n in self._buffer_names}
+
+        def per_shard(params, buffers, key_arr, shard_id, *batch):
+            # per-shard loss over the LOCAL batch rows; grads are the
+            # per-rank partials the bucketed/quantized reduce combines.
+            # NOTE the dp-mean here averages per-shard means — identical
+            # to the global mean when shards hold equal valid-token
+            # counts (a masked-loss skew shifts weighting by at most the
+            # count imbalance; docs/COMMS.md)
+            #
+            # per-shard RNG stream: fold the shard ordinal into the step
+            # key so dropout masks are independent across data shards
+            # (the pre-PR global trace drew one mask per GLOBAL row; the
+            # same key on every shard would tile one local mask pattern
+            # across the batch). lax.axis_index lowers to PartitionId,
+            # which this XLA rejects — the ordinal rides in as a
+            # P(axes)-sharded iota instead (the sharded-CE trick).
+            key = _jax.random.fold_in(key_arr, shard_id[0])
+            loss_of = make_loss_of(buffers, key, batch)
+            (loss, new_buffers), grads = _jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            loss = _jax.lax.pmean(loss, axes)
+            # dp-consistent buffers: a batch-updated float buffer (BN-
+            # style running stats) is computed from the LOCAL shard here
+            # where the pre-PR program saw the global batch — pmean makes
+            # the stored value deterministic and exact for linear
+            # running-stat updates (mean of per-shard means). Replicated
+            # untouched buffers pass through bitwise for power-of-two
+            # shard counts; non-float buffers stay local (docs/COMMS.md).
+            new_buffers = {
+                n: (_jax.lax.pmean(v, axes)
+                    if jnp.issubdtype(v.dtype, jnp.inexact) else v)
+                for n, v in new_buffers.items()}
+            grads = collectives.reduce_grads(grads, plan, mean=True)
+            return loss, new_buffers, grads
+
+        shard_ids = jnp.arange(total, dtype=jnp.int32)
+        with collectives.manual_grad_region():
+            loss, new_buffers, grads = shard_map(
+                per_shard, mesh=self.mesh.jax_mesh,
+                in_specs=(pspecs, bspecs, P(), P(axes)) + batch_specs,
+                out_specs=(P(), nbspecs, pspecs),
+                check_vma=False, axis_names=set(axes),
+            )(params, buffers, key_arr, shard_ids, *batch)
+        return (loss, new_buffers), grads
+
     # -- step --------------------------------------------------------------
     def __call__(self, *batch):
         # same instrumentation contract as TrainStep.__call__ (docs/
@@ -209,6 +348,12 @@ class ShardedTrainStep(TrainStep):
         for n, arr in new_buffers.items():
             entries[n]._data = arr
         self.optimizer._step_count += 1
+        # comms accounting: one tick per executed step with the plan's
+        # static payload split (exact vs int8) — the counters behind the
+        # bench "comms" block (docs/COMMS.md)
+        from .collectives import note_grad_reduce
+
+        note_grad_reduce(self._reduce_plan)
         return Tensor(loss)
 
 

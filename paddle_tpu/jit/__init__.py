@@ -467,14 +467,12 @@ class TrainStep:
         clip = opt._grad_clip
         reg = opt.regularization
 
-        def step(params, buffers, opt_state, lr, guard, key_arr, batch):
-            # guard: f32[4] operand = [spike_threshold, grad_inject,
-            # loss_inject, armed]. Thresholds/injections are VALUES, not
-            # shapes — guarded and unguarded runs execute this same
-            # program. `armed` gates the skip select: only an attached
-            # StepGuard discards anomalous updates; an unguarded step
-            # adopts them exactly as it always did (a silent drop would
-            # hide real divergence from users who never opted in).
+        def make_loss_of(buffers, key_arr, batch):
+            # the (buffers, rng key, batch) closure is built through this
+            # factory so subclasses can re-close it over PER-SHARD values
+            # (ShardedTrainStep's quantized dp-grad reduce rebuilds it
+            # inside a manual shard_map region with the batch split over
+            # the data axes — distributed/collectives)
             def loss_of(params):
                 state = dict(params)
                 state.update(buffers)
@@ -484,7 +482,18 @@ class TrainStep:
                 new_buffers = {n: mutated[n] for n in self._buffer_names}
                 return loss_t._data, new_buffers
 
-            (loss, new_buffers), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+            return loss_of
+
+        def step(params, buffers, opt_state, lr, guard, key_arr, batch):
+            # guard: f32[4] operand = [spike_threshold, grad_inject,
+            # loss_inject, armed]. Thresholds/injections are VALUES, not
+            # shapes — guarded and unguarded runs execute this same
+            # program. `armed` gates the skip select: only an attached
+            # StepGuard discards anomalous updates; an unguarded step
+            # adopts them exactly as it always did (a silent drop would
+            # hide real divergence from users who never opted in).
+            (loss, new_buffers), grads = self._value_and_grads(
+                make_loss_of, params, buffers, key_arr, batch)
             # chaos anomaly seam (resilience, testing.chaos): a zero
             # injection selects the original bytes — the select with a
             # false predicate is the identity, so clean runs are
@@ -561,6 +570,18 @@ class TrainStep:
         else:
             self._checkified = False
             self._compiled = jax.jit(step, donate_argnums=(0, 2))
+
+    def _value_and_grads(self, make_loss_of, params, buffers, key_arr,
+                         batch):
+        """Differentiation seam inside the compiled step: returns
+        ``((loss, new_buffers), grads)``. The base implementation is the
+        pre-PR program verbatim; ShardedTrainStep overrides it to run
+        the backward inside a manual data-axis region with a bucketed /
+        quantized gradient reduce (distributed/collectives) when its
+        plan engages — and delegates HERE when it doesn't, which is what
+        makes ``PTPU_QUANT_COLLECTIVES=0`` byte-identical."""
+        loss_of = make_loss_of(buffers, key_arr, batch)
+        return jax.value_and_grad(loss_of, has_aux=True)(params)
 
     def __call__(self, *batch):
         model_label = (type(self.model).__name__,)

@@ -456,14 +456,22 @@ def _sdpa_pure(q, k, v, causal=True):
 
 
 def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
-                rope_tables=None, int8_names=frozenset()):
+                rope_tables=None, int8_names=frozenset(), tp_seams=None):
     """One decoder block on arrays. p = (ln1, wq, wk, wv, wo, ln2, wg, wu, wd).
 
     ``int8_names``: anchors whose save point is routed through
     ``memory.int8_checkpoint`` (blockwise-int8 + fp32 scales) instead of
     a bf16 ``checkpoint_name`` — what an ``int8:<anchor>`` entry in a
     ``names:`` recompute_policy requests. Each int8-saved tensor holds
-    ~half the HBM of its bf16 save, buying batch or more saves."""
+    ~half the HBM of its bf16 save, buying batch or more saves.
+
+    ``tp_seams``: a ``collectives.TPSeamPlan`` routing the row/col-
+    parallel matmuls through the fused compute-collective kernels —
+    ``o @ wo`` / ``ffn @ wd`` become matmul+reduce-scatter (the residual
+    stream between seams stays SEQUENCE-SHARDED over the tp axis) and
+    the q/k/v/gate/up projections become all-gather+matmul
+    (docs/COMMS.md). None (the default, and always under pp or inside
+    the quantized dp-grad region) keeps the GSPMD-emitted seams."""
     import jax
     import jax.numpy as jnp
 
@@ -476,13 +484,23 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
             return int8_checkpoint(t, name)
         return checkpoint_name(t, name)
 
+    def _col(xx, w):        # column-parallel seam (x may be seq-sharded)
+        if tp_seams is not None:
+            return tp_seams.all_gather_matmul(xx, w)
+        return xx @ w
+
+    def _row(xx, w):        # row-parallel seam (output seq-sharded)
+        if tp_seams is not None:
+            return tp_seams.matmul_reduce_scatter(xx, w)
+        return xx @ w
+
     ln1, wq, wk, wv, wo, ln2, wg, wu, wd = p
     b, s, hdim = x.shape
     hd = hdim // num_heads
     h = _rms_pure(x, ln1)
-    q = (h @ wq).reshape(b, s, num_heads, hd)
-    k = (h @ wk).reshape(b, s, num_kv_heads, hd)
-    v = (h @ wv).reshape(b, s, num_kv_heads, hd)
+    q = _col(h, wq).reshape(b, s, num_heads, hd)
+    k = _col(h, wk).reshape(b, s, num_kv_heads, hd)
+    v = _col(h, wv).reshape(b, s, num_kv_heads, hd)
     if use_rope:
         q = _rope_pure(q, tables=rope_tables)
         k = _rope_pure(k, tables=rope_tables)
@@ -502,18 +520,26 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
 
     if not _use_pallas(q.shape):
         o = _save(o, "attn_out")
-    if os.environ.get("PTPU_FUSED_ADDRMS") and _use_pallas(q.shape):
+    if (os.environ.get("PTPU_FUSED_ADDRMS") and _use_pallas(q.shape)
+            and tp_seams is None):
         # fused residual-add + rms in one Pallas pass (named residuals
-        # addrms_y/rms_rstd make the backward reuse, not re-run, it)
+        # addrms_y/rms_rstd make the backward reuse, not re-run, it).
+        # Engaged tp seams take precedence: mixing one plain-matmul
+        # all-reduce seam into a seq-sharded block forces reshards
+        # between the layouts and forfeits the seam win (docs/COMMS.md)
         from ..ops.pallas.add_rms_norm import add_rms_norm
 
         x, h2 = add_rms_norm(o @ wo, x, ln2)
     else:
         # anchors: resid_mid skips the o-proj re-run; ln2_out feeds the
-        # gate/up recompute without re-running rms2
-        x = _save(x + o @ wo, "resid_mid")
+        # gate/up recompute without re-running rms2. On the fused-seam
+        # path _row returns the attn output SEQ-SHARDED, so the
+        # residual add and rms below run on 1/tp of the rows
+        x = _save(x + _row(o, wo), "resid_mid")
         h2 = _save(_rms_pure(x, ln2), "ln2_out")
-    if os.environ.get("PTPU_INT8_FFN"):
+    if os.environ.get("PTPU_INT8_FFN") and tp_seams is None:
+        # (seam precedence as above: _ffn_i8's plain matmuls would break
+        # the seq-sharded layout mid-block)
         # int8-saved gate/up: exact forward, backward dequantises instead
         # of re-running the two matmuls (~9 TFLOP/step at 1.3B/b4).
         # MEASURED LOSING on v5e-16G (0.523-0.528 vs 0.547 baseline, r4:
@@ -524,10 +550,10 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
         return x + _ffn_i8(h2, wg, wu, wd)
     # per-projection anchors: saving gate/up outputs individually lets a
     # policy trade ~67MB/layer (b4) for skipping that matmul's re-run
-    gate = _save(h2 @ wg, "ffn_gate")
-    up = _save(h2 @ wu, "ffn_up")
+    gate = _save(_col(h2, wg), "ffn_gate")
+    up = _save(_col(h2, wu), "ffn_up")
     ffn = _save(jax.nn.silu(gate) * up, "ffn_out")
-    return x + ffn @ wd
+    return x + _row(ffn, wd)
 
 
 class StackedDecoder(nn.Layer):
@@ -583,6 +609,42 @@ class StackedDecoder(nn.Layer):
     # 'pp' manual, every other mesh axis stays auto)
     _TP_DIMS = {"wq": 2, "wk": 2, "wv": 2, "wg": 2, "wu": 2,
                 "wo": 1, "wd": 1}
+
+    def apply_tp_placements(self, mesh=None, tp_axis="mp"):
+        """Megatron TP placements on a PIPELINE-FREE mesh: shard the
+        projection weights' column/row dims (_TP_DIMS) over ``tp_axis``,
+        leaving the stacked layer dim replicated. The pp x mp hybrid
+        keeps using :meth:`apply_pipeline_placements`; this is the entry
+        for pure-TP / dp x mp meshes where the fused compute-collective
+        seams (distributed/collectives.fused, docs/COMMS.md) can own the
+        row/col-parallel matmuls."""
+        from paddle_tpu.distributed.auto_parallel import (
+            Replicate, Shard, TensorDistAttr)
+
+        if mesh is None:
+            from paddle_tpu.distributed.fleet import active_mesh
+
+            mesh = active_mesh()
+        if (mesh is None or tp_axis not in mesh.dim_names
+                or mesh.get_dim_size(tp_axis) <= 1):
+            return self
+        tp = mesh.get_dim_size(tp_axis)
+        cfg = self.config
+        for what, n in (("num_heads", cfg.num_heads),
+                        ("num_kv_heads", cfg.num_kv_heads),
+                        ("intermediate_size", cfg.intermediate_size)):
+            if n % tp != 0:
+                raise ValueError(f"tp_axis={tp_axis!r} (size {tp}) must "
+                                 f"divide {what} ({n})")
+        ax = mesh.dim_names.index(tp_axis)
+        for name, p in self.named_parameters():
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf not in self._TP_DIMS:
+                continue
+            placements = [Replicate() for _ in mesh.dim_names]
+            placements[ax] = Shard(self._TP_DIMS[leaf])
+            p._dist_attr = TensorDistAttr(mesh, placements)
+        return self
 
     def apply_pipeline_placements(self, mesh=None, tp_axis=None):
         """Mark every stacked param Shard(0) over the 'pp' mesh axis.
@@ -658,10 +720,31 @@ class StackedDecoder(nn.Layer):
                     save_names, int8_names = parse_save_names(
                         pol[len("names:"):])
 
+            # fused tp seams (docs/COMMS.md): owned matmul+reduce-scatter /
+            # all-gather+matmul kernels replace the GSPMD-emitted mp
+            # collectives at the row/col-parallel seams. Resolved per
+            # trace — plan_tp_seams returns None under pp, inside the
+            # quantized dp-grad manual region, with PTPU_TP_SEAM=0, or
+            # when no tp placement is live on the stacked weights.
+            tp_seams = None
+            if pp <= 1:
+                da = getattr(self.wq, "_dist_attr", None)
+                if da is not None:
+                    from paddle_tpu.distributed.auto_parallel import Shard
+                    from paddle_tpu.distributed import collectives
+
+                    tp_axes = [
+                        a for a, pl in zip(da.process_mesh.dim_names,
+                                           da.placements)
+                        if isinstance(pl, Shard) and pl.dim > 0]
+                    if len(tp_axes) == 1:
+                        tp_seams = collectives.plan_tp_seams(
+                            da.process_mesh, tp_axis=tp_axes[0])
+
             def block(x, p):
                 return _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
                                    cfg.rope, rope_tables=tables,
-                                   int8_names=int8_names)
+                                   int8_names=int8_names, tp_seams=tp_seams)
 
             if cfg.recompute:
                 if pol == "dots":

@@ -135,3 +135,32 @@ def test_baseline_without_numbers_is_skipped(tmp_path, capsys):
                                 "published": {}}))
     assert bg.main([new, "--against", str(base)]) == 0
     assert "skipped" in capsys.readouterr().out
+
+
+# -- comms gate (ISSUE 6: quantized-collective parity, docs/COMMS.md) -------
+def _round_with_comms(tmp_path, name, comms):
+    rec = {"metric": "m", "value": 100.0, "unit": "tokens/sec/chip",
+           "comms": comms}
+    p = tmp_path / name
+    p.write_text(json.dumps(rec) + "\n")
+    return str(p)
+
+
+def test_comms_gate_fails_on_parity_drift(tmp_path, capsys):
+    p = _round_with_comms(tmp_path, "BENCH_r08.json", {
+        "enabled": True,
+        "parity": {"enabled": True, "max_rel_err": 0.5,
+                   "threshold": 0.00787, "ok": False}})
+    assert bg.main([p, "--against", p]) == 1
+    assert "parity drift" in capsys.readouterr().out
+
+
+def test_comms_gate_passes_ok_probe_and_disabled(tmp_path):
+    ok = _round_with_comms(tmp_path, "BENCH_r08.json", {
+        "enabled": True,
+        "parity": {"enabled": True, "max_rel_err": 0.003,
+                   "threshold": 0.00787, "ok": True}})
+    assert bg.main([ok, "--against", ok]) == 0
+    off = _round_with_comms(tmp_path, "BENCH_r09.json", {
+        "enabled": False, "parity": {"enabled": False}})
+    assert bg.main([off, "--against", off]) == 0

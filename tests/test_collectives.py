@@ -1,0 +1,657 @@
+"""Quantized collectives + compute-collective overlap (docs/COMMS.md).
+
+Runs on the 8-virtual-device CPU mesh (conftest). Covers the int8
+all-reduce kernels (shared-scale psum + rs/ag), bucket partitioning,
+the ShardedTrainStep grad-reduce plan (engagement rules, quantized-vs-
+exact parity, the PTPU_QUANT_COLLECTIVES=0 bitwise escape hatch,
+recompile invariance), the fused tp seam kernels, the eager-collective
+satellites (PROD pairwise reduce, program cache, seconds histogram),
+and the comms telemetry/reporting surface.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.telemetry as telemetry
+from paddle_tpu.distributed import collectives
+from paddle_tpu.distributed.collectives import (
+    GradBucket,
+    build_grad_reduce_plan,
+    is_exact_grad,
+    partition_buckets,
+    quantized_psum,
+    reduce_grads,
+)
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _mesh2d(dp=4, mp=2, names=("dp", "mp")):
+    devs = np.array(jax.devices()[: dp * mp], dtype=object).reshape(dp, mp)
+    return Mesh(devs, names)
+
+
+def _hexes(vals):
+    return [np.asarray(v, np.float32).tobytes().hex() for v in vals]
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+class TestQuantizedKernels:
+    def _skewed(self, n, numel, seed=0):
+        rng = np.random.default_rng(seed)
+        data = rng.standard_normal((n, numel)).astype(np.float32)
+        data[:, rng.integers(0, numel, max(numel // 128, 1))] *= 1000.0
+        return data
+
+    def test_quantized_psum_grid_relative_error(self):
+        mesh = _mesh2d()
+        n, numel = 4, 4096
+        data = self._skewed(n, numel)
+        arr = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("dp")))
+
+        def q(b):
+            return quantized_psum(b[0], ("dp",), n)[None]
+
+        out = jax.jit(shard_map(q, mesh=mesh, in_specs=(P("dp"),),
+                                out_specs=P("dp"), check_vma=False,
+                                axis_names={"dp"}))(arr)
+        got, exact = np.asarray(out)[0], data.sum(0)
+        # error bounded by the shared quantization grid: half a step per
+        # rank -> n * amax / 254 per element
+        amax = np.abs(data).reshape(n, -1, collectives.QUANT_BLOCK).max(
+            axis=(0, 2))
+        bound = n * amax / 254 * 1.01 + 1e-6
+        assert (np.abs(got - exact).reshape(-1, collectives.QUANT_BLOCK)
+                .max(axis=1) <= bound).all()
+
+    def test_packed_equals_unpacked(self):
+        mesh = _mesh2d()
+        data = self._skewed(4, 1024, seed=1)
+        arr = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("dp")))
+
+        def run(pack):
+            def body(b):
+                from paddle_tpu.distributed.collectives.quantized import (
+                    _blockify, packed_int32_psum, quantize_shared_scale_int8)
+
+                q, s, meta = quantize_shared_scale_int8(b[0], ("dp",))
+                out = packed_int32_psum(q, ("dp",), 4, pack=pack)
+                return (out.astype(jnp.float32) * s).reshape(-1)[None]
+
+            return np.asarray(jax.jit(shard_map(
+                body, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"),
+                check_vma=False, axis_names={"dp"}))(arr))[0]
+
+        # integer accumulation is exact either way -> bitwise equal
+        np.testing.assert_array_equal(run(True), run(False))
+
+    def test_rs_ag_full_manual_parity(self):
+        # the EQuARX rs+ag pipeline lowers in FULLY-manual 1-D regions
+        devs = np.array(jax.devices()[:4], dtype=object)
+        mesh = Mesh(devs, ("g",))
+        n, numel = 4, 2048
+        data = self._skewed(n, numel, seed=2)
+        arr = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("g")))
+
+        def body(b):
+            return collectives.quantized_all_reduce_rs_ag(
+                b[0], "g", n)[None]
+
+        out = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("g"),),
+                                out_specs=P("g"), check_vma=False))(arr)
+        got, exact = np.asarray(out)[0], data.sum(0)
+        # two quantization phases -> 2x the single-phase grid bound
+        amax = np.abs(data).reshape(n, -1, collectives.QUANT_BLOCK).max(
+            axis=(0, 2))
+        bound = 2 * n * amax / 127 + 1e-6
+        assert (np.abs(got - exact).reshape(-1, collectives.QUANT_BLOCK)
+                .max(axis=1) <= bound).all()
+
+    def test_parity_probe_ok_on_live_mesh(self):
+        from paddle_tpu.distributed import fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2,
+                                   "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        probe = collectives.parity_probe(fleet.get_fleet_mesh())
+        assert probe["enabled"] and probe["axis"] == "dp"
+        assert probe["max_rel_err"] <= probe["threshold"], probe
+        assert probe["ok"]
+
+
+# ---------------------------------------------------------------------------
+# buckets + opt-out
+# ---------------------------------------------------------------------------
+class TestBuckets:
+    def test_exact_opt_out_rules(self, monkeypatch):
+        big = (1024, 1024)
+        assert is_exact_grad("decoder.ln1", big)          # name fragment
+        assert is_exact_grad("embed_tokens.weight", big)  # embeddings
+        assert is_exact_grad("decoder.wq", (128,))        # rank 1
+        assert is_exact_grad("decoder.wq", (8, 8))        # below min numel
+        assert not is_exact_grad("decoder.wq", big)
+        monkeypatch.setenv("PTPU_QUANT_EXCLUDE", "wq")
+        assert is_exact_grad("decoder.wq", big)
+
+    def test_partition_respects_bound_order_and_kind(self):
+        mb = 2**20
+        named = [
+            ("a.norm", (256, 600), np.float32),        # exact (name)
+            ("b", (600, 600), np.float32),             # quant, ~1.4MB
+            ("c", (600, 600), np.float32),             # quant, ~1.4MB
+            ("d", (300, 300), np.float32),             # quant, 0.36MB
+            ("e", (300, 300), np.float16),             # quant, other dtype
+            ("f.bias", (300,), np.float32),            # exact (rank 1)
+        ]
+        buckets = partition_buckets(named, bucket_bytes=2 * mb)
+        # order preserved; kind/dtype changes split buckets
+        flat = [n for b in buckets for n in b.names]
+        assert flat == ["a.norm", "b", "c", "d", "e", "f.bias"]
+        by_name = {b.names[0]: b for b in buckets}
+        assert not by_name["a.norm"].quantized
+        assert by_name["b"].quantized
+        assert by_name["e"].dtype == "float16"
+        assert not by_name["f.bias"].quantized
+        for b in buckets:
+            # oversized leaves stand alone; multi-leaf buckets obey cap
+            if len(b.names) > 1:
+                assert b.payload_bytes <= 2 * mb
+        # b+c together exceed the cap -> separate buckets
+        assert by_name["b"].names != by_name["c"].names
+
+    def test_per_tensor_mode(self):
+        named = [(f"w{i}", (300, 300), np.float32) for i in range(4)]
+        buckets = partition_buckets(named, bucket_bytes=0)
+        assert len(buckets) == 4
+
+    def test_bucketed_equals_unbucketed_exact_bitwise(self):
+        # exact psum of concatenated buckets == per-tensor psum, bitwise
+        mesh = _mesh2d()
+        rng = np.random.default_rng(3)
+        shapes = {"w1": (64, 64), "w2": (32, 96), "w3": (128,)}
+        named = [(n, s, np.float32) for n, s in shapes.items()]
+        plans = [
+            collectives.GradReducePlan(
+                axes=("dp",), nranks=4,
+                buckets=partition_buckets(named, bucket_bytes=bb,
+                                          quantized=False))
+            for bb in (0, 1 << 30)
+        ]
+        locals_ = {n: rng.standard_normal((4,) + s).astype(np.float32)
+                   for n, s in shapes.items()}
+        outs = []
+        for plan in plans:
+            def body(tree):
+                g = {n: t[0] for n, t in tree.items()}
+                return {n: t[None] for n, t in
+                        reduce_grads(g, plan, mean=True).items()}
+
+            arrs = {n: jax.device_put(jnp.asarray(v),
+                                      NamedSharding(mesh, P("dp")))
+                    for n, v in locals_.items()}
+            specs = {n: P("dp") for n in locals_}
+            out = jax.jit(shard_map(body, mesh=mesh, in_specs=(specs,),
+                                    out_specs=specs, check_vma=False,
+                                    axis_names={"dp"}))(arrs)
+            outs.append({n: np.asarray(v)[0] for n, v in out.items()})
+        for n in shapes:
+            assert (outs[0][n].tobytes() == outs[1][n].tobytes()), n
+            np.testing.assert_allclose(outs[0][n],
+                                       locals_[n].mean(0), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ShardedTrainStep integration (shared builds — the expensive part)
+# ---------------------------------------------------------------------------
+def _build_step(knob=None, seam=None, min_numel="4096", bucket_mb=None,
+                tp_placements=False, dp=4, mp=2, sharding=1, seed=11):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": 1, "sharding_degree": sharding}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = fleet.get_fleet_mesh()
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=2,
+                    num_heads=4, max_seq_len=32, dropout=0.0, recompute=True)
+    m = GPTForCausalLMPipe(cfg)
+    if tp_placements:
+        m.decoder.apply_tp_placements(mesh, tp_axis="mp")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    return m, ShardedTrainStep(m, lambda a, b: m.loss(a, b), opt, mesh)
+
+
+def _env(overrides):
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        old = {k: os.environ.get(k) for k in overrides}
+        for k, v in overrides.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            yield
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    return ctx()
+
+
+@pytest.fixture(scope="module")
+def step_runs():
+    """One shared set of 3-step trajectories: quantized default, the
+    =0 escape hatch, and the pre-PR base path (the inherited
+    TrainStep._value_and_grads, what the code ran before this PR)."""
+    from paddle_tpu.jit import TrainStep
+
+    rng = np.random.default_rng(5)
+    ids = paddle.to_tensor(rng.integers(0, 256, (8, 16)).astype(np.int32))
+    labels = paddle.to_tensor(rng.integers(0, 256, (8, 16)).astype(np.int64))
+    runs = {}
+    telemetry.enable()
+    telemetry.reset()
+
+    def trajectory(s):
+        return [float(s(ids, labels).numpy()) for _ in range(3)]
+
+    with _env({"PTPU_QUANT_MIN_NUMEL": "4096", "PTPU_QUANT_COLLECTIVES": None,
+               "PTPU_COMM_BUCKET_MB": None}):
+        m, s = _build_step()
+        runs["quant"] = {"losses": trajectory(s), "plan": s.comms_plan(),
+                         "step": s, "model": m}
+        runs["telemetry"] = telemetry.snapshot()
+        # per-tensor buckets: same quantization grid per tensor is NOT
+        # guaranteed (bucket boundaries move) — compare via the exact ref
+        with _env({"PTPU_COMM_BUCKET_MB": "0"}):
+            m0, s0 = _build_step()
+            runs["quant_pertensor"] = {"losses": trajectory(s0),
+                                       "plan": s0.comms_plan()}
+    with _env({"PTPU_QUANT_MIN_NUMEL": "4096",
+               "PTPU_QUANT_COLLECTIVES": "0"}):
+        m, s = _build_step()
+        runs["off"] = {"losses": trajectory(s), "plan": s.comms_plan()}
+    # the literal pre-PR program: force the base differentiation seam
+    with _env({"PTPU_QUANT_MIN_NUMEL": "4096"}):
+        m, s = _build_step()
+        s._value_and_grads = (
+            lambda *a, **k: TrainStep._value_and_grads(s, *a, **k))
+        runs["base"] = {"losses": trajectory(s)}
+    telemetry.disable()
+    return runs
+
+
+class TestShardedStepQuantized:
+    def test_plan_engages_by_default(self, step_runs):
+        plan = step_runs["quant"]["plan"]
+        assert plan is not None
+        assert plan.axes == ("dp",) and plan.nranks == 4
+        assert any(b.quantized for b in plan.buckets)
+        summary = plan.summary()
+        assert 0.0 < summary["quantized_fraction"] <= 1.0
+        assert summary["quantized_wire_bytes"] < summary[
+            "quantized_payload_bytes"]
+
+    def test_escape_hatch_disables_plan(self, step_runs):
+        assert step_runs["off"]["plan"] is None
+
+    def test_escape_hatch_bitwise_equals_pre_pr_step(self, step_runs):
+        # float32-hex compare: =0 must reproduce the pre-PR trajectory
+        # EXACTLY (same program, same bytes)
+        assert _hexes(step_runs["off"]["losses"]) == _hexes(
+            step_runs["base"]["losses"])
+
+    def test_quantized_tracks_exact_within_tolerance(self, step_runs):
+        for a, b in zip(step_runs["quant"]["losses"],
+                        step_runs["off"]["losses"]):
+            assert abs(a - b) / abs(b) < 2e-2, (a, b)
+        # step 0's loss is computed BEFORE any update -> quantization
+        # cannot have touched it yet
+        assert _hexes(step_runs["quant"]["losses"][:1]) == _hexes(
+            step_runs["off"]["losses"][:1])
+
+    def test_per_tensor_buckets_also_track_exact(self, step_runs):
+        assert step_runs["quant_pertensor"]["plan"].calls > step_runs[
+            "quant"]["plan"].calls
+        for a, b in zip(step_runs["quant_pertensor"]["losses"],
+                        step_runs["off"]["losses"]):
+            assert abs(a - b) / abs(b) < 2e-2, (a, b)
+
+    def test_grad_reduce_telemetry(self, step_runs):
+        snap = step_runs["telemetry"]
+        counters = snap["counters"]
+        plan = step_runs["quant"]["plan"]
+        calls = counters["collective_calls_total"]
+        key = f"op=grad_reduce,axis={plan.axis_label},nranks={plan.nranks}"
+        assert calls[key] == plan.calls * 3  # buckets x steps
+        qb = counters["collective_quantized_bytes_total"]
+        qkey = f"op=grad_reduce,axis={plan.axis_label}"
+        assert qb[qkey] == plan.quantized_payload_bytes * 3
+
+    def test_comms_summary_shapes(self, step_runs):
+        plan = step_runs["quant"]["plan"]
+        block = collectives.comms_summary(step_runs["telemetry"], plan=plan)
+        assert block["enabled"]
+        assert block["quantized_bytes_total"] > 0
+        assert (block["exact_bytes_total"]
+                == block["bytes_total"] - block["quantized_bytes_total"])
+        key = f"grad_reduce@{plan.axis_label}"
+        assert block["per_op"][key]["calls"] == plan.calls * 3
+        assert block["grad_reduce"]["buckets"] == plan.calls
+
+    def test_buffer_sync_and_per_shard_rng(self):
+        """Batch-updated FLOAT buffers (BN running stats) must come back
+        pmean-synced across the data shards — matching the single-device
+        global-batch value for linear running-stat updates — and a
+        dropout model must build and run through the manual region (the
+        per-shard fold_in key plumb; the pre-fix code handed every shard
+        the SAME key, tiling one local mask across the batch)."""
+        from paddle_tpu import nn
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+        from paddle_tpu.jit import TrainStep
+
+        class _BNDrop(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 4096)
+                self.bn = nn.BatchNorm1D(16)
+                self.drop = nn.Dropout(0.25)
+
+            def forward(self, x):
+                h = self.drop(self.bn(x))
+                return (self.fc(h) ** 2).mean()
+
+        rng = np.random.default_rng(3)
+        # per-shard row means differ: a local-stats BN would store SOME
+        # shard's update, not the global one
+        x = (rng.standard_normal((16, 16)).astype(np.float32)
+             + np.arange(16, dtype=np.float32)[:, None])
+        with _env({"PTPU_QUANT_MIN_NUMEL": "4096",
+                   "PTPU_QUANT_COLLECTIVES": None}):
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                                       "pp_degree": 1, "sharding_degree": 1}
+            fleet.init(is_collective=True, strategy=strategy)
+            mesh = fleet.get_fleet_mesh()
+            paddle.seed(17)
+            m = _BNDrop()
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=m.parameters())
+            step = ShardedTrainStep(m, lambda b: m(b), opt, mesh)
+            loss = float(step(paddle.to_tensor(x)).numpy())
+            assert step.comms_plan() is not None
+            assert np.isfinite(loss)
+            sharded_mean = np.asarray(m.bn._mean._data)
+
+            paddle.seed(17)
+            ref = _BNDrop()
+            ref_opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                             parameters=ref.parameters())
+            ref_step = TrainStep(ref, lambda b: ref(b), ref_opt)
+            ref_step(paddle.to_tensor(x))
+            ref_mean = np.asarray(ref.bn._mean._data)
+        # running-mean update is linear in the batch mean, so pmean of
+        # per-shard updates == the global-batch update
+        np.testing.assert_allclose(sharded_mean, ref_mean, rtol=1e-5,
+                                   atol=1e-6)
+        # variance is within-shard only (pmean of local vars) — an
+        # approximation, but it must stay finite and positive
+        var = np.asarray(m.bn._variance._data)
+        assert np.all(np.isfinite(var)) and np.all(var > 0)
+
+    def test_recompile_invariance_on_knob_toggle(self, step_runs):
+        # knobs are read at BUILD: flipping the env between calls must
+        # neither recompile nor change the already-built program's path
+        s = step_runs["quant"]["step"]
+        rng = np.random.default_rng(6)
+        ids = paddle.to_tensor(rng.integers(0, 256, (8, 16)).astype(np.int32))
+        labels = paddle.to_tensor(
+            rng.integers(0, 256, (8, 16)).astype(np.int64))
+        telemetry.enable()
+        before = telemetry.snapshot()["counters"].get(
+            "jit_recompiles_total", {})
+        with _env({"PTPU_QUANT_COLLECTIVES": "0"}):
+            s(ids, labels)
+        with _env({"PTPU_QUANT_COLLECTIVES": "1",
+                   "PTPU_COMM_BUCKET_MB": "1"}):
+            s(ids, labels)
+        after = telemetry.snapshot()["counters"].get(
+            "jit_recompiles_total", {})
+        telemetry.disable()
+        assert before == after
+        assert s.comms_plan() is not None  # plan unchanged by the toggle
+
+    def test_plan_declines_unsupported_meshes(self):
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+
+        named = [("w", (512, 512), np.float32)]
+        with _env({"PTPU_QUANT_MIN_NUMEL": "4096"}):
+            # pp live -> the pipeline's manual region cannot nest ours
+            mesh = ProcessMesh(shape=(2, 2, 2), dim_names=("pp", "dp", "mp"))
+            assert build_grad_reduce_plan(named, mesh) is None
+            # ep live -> expert dispatch owns its own region
+            mesh = ProcessMesh(shape=(4, 2), dim_names=("dp", "ep"))
+            assert build_grad_reduce_plan(named, mesh) is None
+            # no data axis -> nothing to reduce
+            mesh = ProcessMesh(shape=(8,), dim_names=("mp",))
+            assert build_grad_reduce_plan(named, mesh) is None
+            # healthy dp x mp -> engages
+            mesh = ProcessMesh(shape=(4, 2), dim_names=("dp", "mp"))
+            plan = build_grad_reduce_plan(named, mesh)
+            assert plan is not None and plan.axes == ("dp",)
+            # every grad below the quantization floor -> pre-PR program
+            small = [("w", (8, 8), np.float32)]
+            assert build_grad_reduce_plan(small, mesh) is None
+        with _env({"PTPU_QUANT_COLLECTIVES": "0"}):
+            mesh = ProcessMesh(shape=(4, 2), dim_names=("dp", "mp"))
+            assert build_grad_reduce_plan(named, mesh) is None
+
+    def test_plan_declines_zero3_data_axis_placement(self):
+        """A param Shard()'d over ANY data axis (ZeRO-3) must decline
+        the whole plan, not just drop that axis: the forward would have
+        to all-gather the param inside the manual region, the lowering
+        this XLA rejects (docs/COMMS.md runtime limits)."""
+        from paddle_tpu.distributed.auto_parallel import (
+            Replicate, Shard, TensorDistAttr)
+
+        with _env({"PTPU_QUANT_MIN_NUMEL": "4096",
+                   "PTPU_QUANT_COLLECTIVES": None}):
+            m, s = _build_step(dp=2, mp=2, sharding=2)
+            s._build()
+            assert s._ensure_reduce_plan() is not None  # healthy: engages
+            m2, s2 = _build_step(dp=2, mp=2, sharding=2)
+            mesh = s2.mesh
+            ax = mesh.dim_names.index("sharding")
+            name, p = next((n, p) for n, p in m2.named_parameters()
+                           if p._data.ndim >= 2)
+            placements = [Replicate() for _ in mesh.dim_names]
+            placements[ax] = Shard(0)
+            p._dist_attr = TensorDistAttr(mesh, placements)
+            s2._build()
+            assert s2._ensure_reduce_plan() is None
+
+
+# ---------------------------------------------------------------------------
+# fused tp seams
+# ---------------------------------------------------------------------------
+class TestFusedSeams:
+    def test_seam_kernels_match_dense_forward_and_grads(self):
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+        from paddle_tpu.distributed.collectives.fused import TPSeamPlan
+
+        mesh = ProcessMesh(shape=(4, 2), dim_names=("dp", "mp"))
+        plan = TPSeamPlan(mesh, "mp", ("dp",))
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.standard_normal((8, 16, 32)).astype(np.float32))
+        w_row = jnp.asarray(rng.standard_normal((32, 24)).astype(np.float32))
+        w_col = jnp.asarray(rng.standard_normal((32, 48)).astype(np.float32))
+
+        def f_fused(x, wr, wc):
+            mid = plan.matmul_reduce_scatter(x, wr)      # seq-sharded
+            back = plan.all_gather_matmul(x, wc)         # col-sharded
+            return jnp.sum(mid ** 2) + jnp.sum(back ** 2)
+
+        def f_dense(x, wr, wc):
+            return jnp.sum((x @ wr) ** 2) + jnp.sum((x @ wc) ** 2)
+
+        v1, g1 = jax.value_and_grad(f_fused, argnums=(0, 1, 2))(
+            x, w_row, w_col)
+        v2, g2 = jax.value_and_grad(f_dense, argnums=(0, 1, 2))(
+            x, w_row, w_col)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_seam_falls_back_on_indivisible_shapes(self):
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+        from paddle_tpu.distributed.collectives.fused import TPSeamPlan
+
+        mesh = ProcessMesh(shape=(4, 2), dim_names=("dp", "mp"))
+        plan = TPSeamPlan(mesh, "mp", ("dp",))
+        x = jnp.ones((8, 15, 32))                        # seq 15 % 2 != 0
+        w = jnp.ones((32, 24))
+        np.testing.assert_allclose(np.asarray(plan.matmul_reduce_scatter(
+            x, w)), np.asarray(x @ w), rtol=1e-6)
+
+    def test_fused_seams_end_to_end_exact(self):
+        rng = np.random.default_rng(8)
+        ids = paddle.to_tensor(rng.integers(0, 256, (8, 16)).astype(np.int32))
+        labels = paddle.to_tensor(
+            rng.integers(0, 256, (8, 16)).astype(np.int64))
+        with _env({"PTPU_TP_SEAM": "fused", "PTPU_QUANT_MIN_NUMEL": "4096"}):
+            m1, s1 = _build_step(tp_placements=True)
+            # seam forcing wins the manual region: grad plan yields
+            l1 = [float(s1(ids, labels).numpy()) for _ in range(2)]
+            assert s1.comms_plan() is None
+        with _env({"PTPU_QUANT_COLLECTIVES": "0", "PTPU_TP_SEAM": "0"}):
+            m2, s2 = _build_step(tp_placements=True)
+            l2 = [float(s2(ids, labels).numpy()) for _ in range(2)]
+        for a, b in zip(l1, l2):        # seams are exact math
+            assert abs(a - b) / abs(b) < 1e-3, (l1, l2)
+
+    def test_plan_tp_seams_gating(self):
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+
+        mesh = ProcessMesh(shape=(4, 2), dim_names=("dp", "mp"))
+        with _env({"PTPU_TP_SEAM": "auto"}):
+            assert collectives.plan_tp_seams(mesh) is not None
+            with collectives.manual_grad_region():
+                # inside the quantized grad region the islands cannot
+                # nest — the grad reduce has precedence
+                assert collectives.plan_tp_seams(mesh) is None
+        with _env({"PTPU_TP_SEAM": "0"}):
+            assert collectives.plan_tp_seams(mesh) is None
+        with _env({"PTPU_QUANT_COLLECTIVES": "0"}):
+            assert collectives.plan_tp_seams(mesh) is None
+        pp = ProcessMesh(shape=(2, 2, 2), dim_names=("pp", "mp", "dp"))
+        assert collectives.plan_tp_seams(pp) is None
+
+
+# ---------------------------------------------------------------------------
+# eager collective satellites
+# ---------------------------------------------------------------------------
+class TestEagerCollectives:
+    def test_prod_power_of_two_and_ring(self):
+        import paddle_tpu.distributed as dist
+
+        for nranks, seed in ((4, 0), (3, 1)):  # hypercube + ring paths
+            g = dist.new_group(list(range(nranks)))
+            vals = np.array([-2.0, 3.0, 0.5], np.float32)
+            t = paddle.to_tensor(vals.copy())
+            dist.all_reduce(t, op=dist.ReduceOp.PROD, group=g)
+            np.testing.assert_allclose(t.numpy(), vals ** nranks, rtol=1e-5)
+
+    def test_eager_program_cache_reuse(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.communication import _PROGRAM_CACHE
+
+        g = dist.new_group(list(range(4)))
+        t = paddle.to_tensor(np.ones((3, 3), np.float32))
+        dist.all_reduce(t, group=g)
+        n_after_first = len(_PROGRAM_CACHE)
+        for _ in range(3):
+            dist.all_reduce(t, group=g)
+        assert len(_PROGRAM_CACHE) == n_after_first  # steady state: hits
+        t2 = paddle.to_tensor(np.ones((5,), np.float32))
+        dist.all_reduce(t2, group=g)
+        assert len(_PROGRAM_CACHE) == n_after_first + 1
+
+    def test_eager_quantized_all_reduce(self):
+        import paddle_tpu.distributed as dist
+
+        g = dist.new_group(list(range(4)))
+        rng = np.random.default_rng(9)
+        vals = rng.standard_normal(512).astype(np.float32)
+        t = paddle.to_tensor(vals.copy())
+        dist.all_reduce(t, group=g, quantized=True)
+        exact = vals * 4  # degenerate single-controller semantics
+        err = np.abs(t.numpy() - exact)
+        # two quant phases over blocks of the (replicated) payload
+        bound = 2 * 4 * np.abs(vals).max() / 127 + 1e-6
+        assert err.max() <= bound
+        with pytest.raises(ValueError):
+            dist.all_reduce(t, op=dist.ReduceOp.MAX, group=g, quantized=True)
+
+    def test_collective_seconds_histogram(self):
+        import paddle_tpu.distributed as dist
+
+        telemetry.enable()
+        telemetry.reset()
+        g = dist.new_group(list(range(4)))
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        dist.all_reduce(t, group=g)
+        snap = telemetry.snapshot()
+        telemetry.disable()
+        hist = snap["histograms"]["collective_seconds"]
+        assert hist["op=all_reduce,axis=g"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+class TestReporting:
+    def test_telemetry_report_comms_section(self, capsys):
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                        "tools"))
+        import telemetry_report
+
+        snap = {
+            "counters": {
+                "collective_bytes_total": {
+                    "op=grad_reduce,axis=dp,nranks=4": 1000},
+                "collective_quantized_bytes_total": {
+                    "op=grad_reduce,axis=dp": 900},
+                "collective_calls_total": {
+                    "op=grad_reduce,axis=dp,nranks=4": 5},
+            },
+            "histograms": {"collective_seconds": {
+                "op=all_reduce,axis=g": {
+                    "count": 1, "sum": 0.25, "mean": 0.25, "min": 0.25,
+                    "max": 0.25, "p50": 0.25, "p95": 0.25, "p99": 0.25}}},
+        }
+        telemetry_report.print_snapshot(snap)
+        out = capsys.readouterr().out
+        assert "comms" in out and "grad_reduce@dp" in out
+        assert "90.0% int8" in out

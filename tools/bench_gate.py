@@ -132,6 +132,28 @@ def resilience_violations(rec):
     return out
 
 
+def comms_violations(rec):
+    """Violation strings from one bench record's "comms" block: a
+    quantized run whose loss-parity probe drifted past its threshold
+    must not land silently — either the quantizer regressed or the
+    gradients stopped being block-quantizable (docs/COMMS.md)."""
+    out = []
+    comms = rec.get("comms")
+    if not isinstance(comms, dict):
+        return out
+    parity = comms.get("parity")
+    if isinstance(parity, dict) and parity.get("enabled"):
+        err = parity.get("max_rel_err")
+        thr = parity.get("threshold")
+        if err is not None and thr is not None and float(err) > float(thr):
+            out.append(
+                f"quantized-collective parity drift {float(err):.4f} > "
+                f"threshold {float(thr):.4f}")
+        elif parity.get("ok") is False:
+            out.append("quantized-collective parity probe reported ok=false")
+    return out
+
+
 def compare(new_metrics, ref_metrics, threshold):
     """-> (rows, regressions). Each row: (metric, old, new, ratio|None)."""
     rows, regressions = [], []
@@ -211,6 +233,11 @@ def main(argv=None):
         for v in resilience_violations(rec):
             print(f"  GUARD {metric}: {v} — clean bench runs must report "
                   "zero anomalies/rollbacks", flush=True)
+            failed = True
+        # comms gate: also reference-free — parity is a property of the
+        # candidate run alone
+        for v in comms_violations(rec):
+            print(f"  COMMS {metric}: {v}", flush=True)
             failed = True
     for ref_path in refs:
         ref_metrics = load_metrics(ref_path)

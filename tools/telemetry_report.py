@@ -85,8 +85,54 @@ def _hist_line(name, labels, h):
             f"max={h['max']:.6f}")
 
 
+def _comms_rows(snap):
+    """Aggregate the collective_* families into per-(op, axis) rows with
+    the exact-vs-int8 traffic split (docs/COMMS.md). Standalone
+    reimplementation of collectives.comms_summary so this tool keeps
+    working on a bare snapshot file without importing paddle_tpu."""
+    counters = snap.get("counters") or {}
+    hists = snap.get("histograms") or {}
+
+    def _parse(labels):
+        d = dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+        return f"{d.get('op', '?')}@{d.get('axis', '?')}"
+
+    rows = {}
+    for name, field in (("collective_bytes_total", "bytes"),
+                        ("collective_calls_total", "calls"),
+                        ("collective_quantized_bytes_total", "q8_bytes")):
+        for labels, v in (counters.get(name) or {}).items():
+            key = _parse(labels)
+            rows.setdefault(key, {})[field] = (
+                rows.get(key, {}).get(field, 0) + int(v))
+    for labels, h in (hists.get("collective_seconds") or {}).items():
+        rows.setdefault(_parse(labels), {})["seconds"] = float(
+            h.get("sum", 0.0))
+    return rows
+
+
+def print_comms(snap, out=sys.stdout):
+    rows = _comms_rows(snap)
+    if not rows:
+        return
+    w = out.write
+    w("-- comms (exact vs int8 traffic split) --\n")
+    total = sum(r.get("bytes", 0) for r in rows.values())
+    qtotal = sum(r.get("q8_bytes", 0) for r in rows.values())
+    for key in sorted(rows):
+        r = rows[key]
+        secs = (f" seconds={r['seconds']:.4f}" if "seconds" in r else "")
+        q8 = (f" q8_bytes={r['q8_bytes']}" if r.get("q8_bytes") else "")
+        w(f"  {key}: calls={r.get('calls', 0)} bytes={r.get('bytes', 0)}"
+          f"{q8}{secs}\n")
+    if total:
+        w(f"  TOTAL: bytes={total} quantized={qtotal} "
+          f"({qtotal / total:.1%} int8, exact={total - qtotal})\n")
+
+
 def print_snapshot(snap, out=sys.stdout):
     w = out.write
+    print_comms(snap, out)
     for kind in ("counters", "gauges"):
         group = snap.get(kind) or {}
         if group:
