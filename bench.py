@@ -52,6 +52,12 @@ def run_model(model_kind, ckpt=None):
         # sweeps): GPT 0.5468 -> 0.5629, LLaMA 0.5806 -> 0.638.
         # bwd-block-2048 stays dead (scoped-VMEM OOM, not HBM).
         os.environ.setdefault("PTPU_ADAM_FACTORED", "1")
+        # r6+: norm->ffn seam megakernel — (silu(gate)*up) @ wd streamed
+        # through VMEM, the [tokens, intermediate] product never touches
+        # HBM (ops/pallas/swiglu_down, docs/SCAN.md). PTPU_FUSED_FFN=0
+        # restores the unfused seam; PTPU_FUSED_SEAMS=1 additionally
+        # engages the addrms attn->norm seam.
+        os.environ.setdefault("PTPU_FUSED_FFN", "1")
         if model_kind == "llama":
             # BASELINE.md config-5 variant: LLaMA-7B architecture
             # (h=4096, GQA, swiglu, rope) depth-scaled to 8 layers so
@@ -177,13 +183,18 @@ def run_model(model_kind, ckpt=None):
                   "PTPU_FA_BLOCK", "PTPU_FA_BWD_BLOCK",
                   "PTPU_UNROLL_LAYERS", "PTPU_CE_CHUNK", "PTPU_CE_VCHUNK",
                   "PTPU_LOSS_HEAD", "PTPU_ROPE_HOIST",
+                  # scan/seam knobs change the lowered program wholesale
+                  # (scan body vs unrolled layers, fused vs plain seams);
+                  # the planner key also carries the scan mode itself
+                  # (memory/planner.py), this is belt + suspenders
+                  "PTPU_SCAN_LAYERS", "PTPU_FUSED_FFN", "PTPU_FUSED_SEAMS",
                   # comms knobs change the lowered program (manual-region
                   # grad reduce, bucket layout, fused tp seams) — a plan
                   # priced under one comm regime must not be reused under
                   # another (docs/COMMS.md)
                   "PTPU_QUANT_COLLECTIVES", "PTPU_QUANT_GRADS",
                   "PTPU_COMM_BUCKET_MB", "PTPU_QUANT_MIN_NUMEL",
-                  "PTPU_QUANT_EXCLUDE", "PTPU_TP_SEAM")
+                  "PTPU_QUANT_EXCLUDE", "PTPU_TP_SEAM", "PTPU_COMM_SLAB")
     ) + (("int8_head", F.int8_head_enabled()),)  # gate outcome, not just env
     decision = pmem.plan_train_step(
         step_factory, candidates, require_fit=require_fit,
@@ -352,6 +363,20 @@ def run_model(model_kind, ckpt=None):
         telemetry.snapshot(),
         parity=_coll.parity_probe(_active_mesh()))
 
+    # "compile" block (docs/SCAN.md): trace/lower/compile wall seconds +
+    # serialized HLO bytes of THIS run's warmup TrainStep build, with the
+    # depth and scan mode that produced them — the measurement behind the
+    # scan-over-layers flat-compile claim. tools/bench_gate.py fails a
+    # round whose compile time regresses >25% at the same depth/mode.
+    from paddle_tpu import jit as pjit
+    from paddle_tpu.models.gpt import scan_layers_enabled
+
+    step_label = f"TrainStep[{type(model).__name__}]"
+    compile_block = dict(pjit.compile_summary(step_label) or {},
+                         function=step_label,
+                         num_layers=cfg.num_layers,
+                         scan_layers=bool(scan_layers_enabled()))
+
     tokens_per_sec = batch * seq * max(n_ran, 1) / dt
 
     # MFU: 6 * params * tokens/sec / peak_flops
@@ -391,6 +416,8 @@ def run_model(model_kind, ckpt=None):
         # comms traffic split + parity probe (mirrors "telemetry"/
         # "memory"; contract in docs/COMMS.md, gated by bench_gate)
         "comms": comms,
+        # warmup-build compile phases + HLO program size (docs/SCAN.md)
+        "compile": compile_block,
         "resilience": (dict(step_guard.summary(),
                             watchdog_fires=(len(watchdog.debris_files)
                                             if watchdog is not None else 0))

@@ -412,6 +412,31 @@ def _load_metadata(path):
     return metas
 
 
+def saved_state_template(path):
+    """{key: zero Tensor of the SAVED global shape/dtype} built from a
+    checkpoint directory's metadata alone — the load target for reading
+    a checkpoint whose layout no live model matches
+    (CheckpointManager.read_state; docs/SCAN.md layout conversion)."""
+    import jax.numpy as jnp
+    import ml_dtypes  # noqa: F401  (registers bfloat16 et al with numpy)
+
+    shapes = {}
+    for meta in _load_metadata(path):
+        for key, ms in meta.state_dict_metadata.items():
+            for m in ms:
+                end = tuple(int(o) + int(s) for o, s in
+                            zip(m.global_offset, m.local_shape))
+                cur = shapes.get(key)
+                if cur is None:
+                    shapes[key] = (end, m.dtype)
+                else:
+                    shapes[key] = (tuple(max(a, b)
+                                         for a, b in zip(cur[0], end)),
+                                   cur[1])
+    return {key: Tensor(jnp.zeros(shape, np.dtype(dtype)))
+            for key, (shape, dtype) in shapes.items()}
+
+
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, offload=False,
                     strict=True):

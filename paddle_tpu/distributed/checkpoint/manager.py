@@ -477,10 +477,12 @@ class CheckpointManager:
             fallback=fallback and step is None)
 
     def _restore_candidates(self, state_dict, candidates, strict=True,
-                            fallback=True):
+                            fallback=True, target_factory=None):
         """Walk `candidates` (newest first) validating + loading; with
         `fallback` a failing step counts a validation failure and the
-        walk continues, else it raises."""
+        walk continues, else it raises. ``target_factory(step)``
+        overrides the load target per candidate (read_state's
+        metadata-derived template; ``state_dict`` is ignored then)."""
         from . import MissingKeysError, _metrics, load_state_dict
 
         last_err = None
@@ -493,7 +495,9 @@ class CheckpointManager:
                     raise last_err
                 continue
             try:
-                load_state_dict(state_dict, self.step_dir(s), strict=strict)
+                target = (state_dict if target_factory is None
+                          else target_factory(s))
+                load_state_dict(target, self.step_dir(s), strict=strict)
             except MissingKeysError:
                 raise  # wrong state shape, not corruption: older steps
                        # would silently resurrect stale values
@@ -511,6 +515,51 @@ class CheckpointManager:
         raise NoCheckpointError(
             f"no committed step under {self.root!r} passed validation "
             f"(last error: {last_err})")
+
+    def saved_keys(self, step=None):
+        """Key set of the newest committed good step (or exactly
+        `step`), from metadata alone — no payload reads, no validation.
+        Lets callers decide HOW to restore (e.g. the cross-layout
+        detection in models/gpt.py) before paying for a load."""
+        from . import _load_metadata
+
+        s = int(step) if step is not None else self.last_good_step()
+        if s is None:
+            raise NoCheckpointError(
+                f"no committed checkpoint step under {self.root!r}")
+        keys = set()
+        for meta in _load_metadata(self.step_dir(s)):
+            keys.update(meta.state_dict_metadata)
+        return keys
+
+    def read_state(self, step=None):
+        """(state, step): the newest committed-and-valid step's raw
+        arrays keyed by their SAVED names — no target model required
+        (the metadata alone provides every key's global shape + dtype).
+        The cross-layout restore entry: models/gpt.py
+        ``restore_decoder_any_layout`` converts the result between the
+        stacked and per-layer decoder layouts (docs/SCAN.md). With
+        ``step=None`` corrupt steps fall back like ``restore``."""
+        from . import saved_state_template
+
+        if step is not None:
+            candidates = [int(step)]
+        else:
+            candidates = list(reversed(self.good_steps()))
+        if not candidates:
+            raise NoCheckpointError(
+                f"no committed checkpoint step under {self.root!r}")
+        loaded = {}
+
+        def factory(s):
+            loaded.clear()
+            loaded.update(saved_state_template(self.step_dir(s)))
+            return loaded
+
+        s = self._restore_candidates(None, candidates,
+                                     fallback=step is None,
+                                     target_factory=factory)
+        return dict(loaded), s
 
     def restore_last_good(self, model, optimizer=None, before_step=None,
                           strict=True):

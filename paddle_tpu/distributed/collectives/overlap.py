@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 
 import jax
 import jax.numpy as jnp
@@ -136,13 +137,70 @@ class GradReducePlan:
         }
 
 
-def partition_buckets(named_shapes, bucket_bytes=None, quantized=True):
+#: layer-index fragment in a per-layer parameter name
+#: ("model.layers.3.attn.q_proj.weight" -> family
+#: "model.layers.*.attn.q_proj.weight")
+_LAYER_IDX_RE = re.compile(r"(?<=\.)\d+(?=\.)")
+
+
+def slab_grouping_enabled():
+    """``PTPU_COMM_SLAB=1``: group per-layer grad leaves of the same
+    weight family into ONE bucket per slab (docs/SCAN.md). The scanned
+    eager model keeps per-layer parameter leaves while the stacked
+    flagship carries one [L, ...] leaf per weight kind — slab grouping
+    makes the per-layer tree's reduce plan match the stacked tree's
+    (one collective per slab, one per non-layer tensor) so the wire
+    behavior doesn't depend on which layout the model stores. Off by
+    default: the size-capped partition below is the measured r6 plan."""
+    return os.environ.get("PTPU_COMM_SLAB", "") not in ("", "0")
+
+
+def _slab_key(name):
+    # wildcard ONLY the first (layer) index: a second index (MoE
+    # expert ordinals, "...layers.3.mlp.experts.5.weight") stays
+    # literal — in the stacked layout each expert is its own [L, ...]
+    # leaf, so each expert must be its own slab family too
+    return _LAYER_IDX_RE.sub("*", name, count=1)
+
+
+def _partition_slabs(named_shapes, quantized):
+    """One GradBucket per (weight family, exactness, dtype), first-seen
+    order; non-layer-indexed tensors are their own single-leaf family
+    (mirroring the stacked layout, where each slab IS one leaf)."""
+    fams = {}
+    order = []
+    for name, shape, dtype in named_shapes:
+        numel = 1
+        for d in shape:
+            numel *= int(d)
+        dt = str(jnp.dtype(dtype))
+        q = quantized and not is_exact_grad(name, shape, dtype)
+        key = (_slab_key(name), q, dt)
+        if key not in fams:
+            fams[key] = []
+            order.append(key)
+        fams[key].append((name, numel))
+    return tuple(
+        GradBucket(names=tuple(n for n, _ in fams[k]),
+                   numels=tuple(m for _, m in fams[k]),
+                   dtype=k[2], quantized=k[1])
+        for k in order)
+
+
+def partition_buckets(named_shapes, bucket_bytes=None, quantized=True,
+                      slab=None):
     """Partition ``[(name, shape, dtype), ...]`` (reduce order) into
     size-bounded :class:`GradBucket`\\ s. Consecutive leaves of the same
     (exactness, dtype) share a bucket up to ``bucket_bytes``; an
     oversized leaf gets its own bucket (never split — the collective
     granularity is a whole tensor). ``bucket_bytes=0`` = one bucket per
-    tensor."""
+    tensor. ``slab`` (default: ``PTPU_COMM_SLAB``) switches to one
+    bucket per per-layer weight family — see
+    :func:`slab_grouping_enabled`."""
+    if slab is None:
+        slab = slab_grouping_enabled()
+    if slab:
+        return _partition_slabs(named_shapes, quantized)
     if bucket_bytes is None:
         bucket_bytes = bucket_bytes_cap()
     buckets, cur, cur_bytes, cur_key = [], [], 0, None

@@ -338,7 +338,7 @@ class ShardedTrainStep(TrainStep):
         # no ambient mesh context needed: every input carries an explicit
         # NamedSharding, and constraints inside the program name their mesh.
         loss, new_params, new_buffers, self._opt_state, health = \
-            self._compiled(
+            self._dispatch_compiled(
                 params, buffers, self._opt_state, lr, guard_arr, key_arr,
                 raw_batch
             )

@@ -30,6 +30,97 @@ _TRAIN_STEP_SECONDS = _telemetry.histogram(
 _TRAIN_STEPS = _telemetry.counter(
     "train_steps_total", "TrainStep invocations", labelnames=("model",))
 
+# -- compile-phase telemetry (docs/TELEMETRY.md, docs/SCAN.md) --------------
+# Wall seconds of the newest program build, split by phase, plus the
+# serialized HLO module size — the measurement behind the scan-over-layers
+# "compile time and program size flat in depth" claim (bench.py "compile"
+# block; tools/bench_gate.py gates regressions).
+_TRACE_SECONDS = _telemetry.gauge(
+    "trace_seconds", "jax tracing wall seconds of the newest program "
+    "build for this function", labelnames=("function",))
+_LOWER_SECONDS = _telemetry.gauge(
+    "lower_seconds", "StableHLO lowering wall seconds of the newest "
+    "program build for this function", labelnames=("function",))
+_COMPILE_SECONDS = _telemetry.gauge(
+    "compile_seconds", "XLA backend-compile wall seconds of the newest "
+    "program build for this function", labelnames=("function",))
+_HLO_PROGRAM_BYTES = _telemetry.gauge(
+    "hlo_program_bytes", "serialized HLO module size (bytes) of the "
+    "newest compiled program for this function", labelnames=("function",))
+
+#: newest per-function phase record: {label: {"trace_seconds": ..,
+#: "lower_seconds": .., "compile_seconds": .., "hlo_program_bytes": ..}}
+_LAST_COMPILE = {}
+
+
+def _serialized_hlo_bytes(lowered):
+    """Size of the lowered program: serialized HLO proto when this
+    jax/jaxlib exposes it, StableHLO text length otherwise (both are
+    monotone in program size, which is what the depth-sweep asserts)."""
+    try:
+        return len(lowered.compiler_ir(
+            dialect="hlo").as_serialized_hlo_module_proto())
+    except Exception:
+        try:
+            return len(lowered.as_text())
+        except Exception:
+            return 0
+
+
+def _record_compile_phases(label, trace_s, lower_s, compile_s, hlo_bytes):
+    labels = (label,)
+    _TRACE_SECONDS.set(trace_s, labels=labels)
+    _LOWER_SECONDS.set(lower_s, labels=labels)
+    _COMPILE_SECONDS.set(compile_s, labels=labels)
+    _HLO_PROGRAM_BYTES.set(hlo_bytes, labels=labels)
+    _LAST_COMPILE[label] = {
+        "trace_seconds": trace_s, "lower_seconds": lower_s,
+        "compile_seconds": compile_s, "hlo_program_bytes": hlo_bytes}
+
+
+def compile_summary(label=None):
+    """Newest compile-phase record for ``label`` (None = all labels):
+    the bench "compile" block's data source. Returns None for an
+    unknown label."""
+    if label is None:
+        return {k: dict(v) for k, v in _LAST_COMPILE.items()}
+    rec = _LAST_COMPILE.get(label)
+    return dict(rec) if rec is not None else None
+
+
+def timed_lower_compile(jitfn, label, *args, **kwargs):
+    """AOT trace -> lower -> compile of a ``jax.jit`` function, feeding
+    the per-phase gauges. Returns the Compiled executable (same program
+    jit dispatch would build — donation and shardings preserved)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    traced = None
+    if hasattr(jitfn, "trace"):
+        try:
+            traced = jitfn.trace(*args, **kwargs)
+        except TypeError as e:
+            # only a .trace() CALLING-convention mismatch falls back to
+            # .lower(); genuine trace-time errors (TracerBoolConversion
+            # et al. subclass TypeError via JAXTypeError) must propagate
+            # — re-tracing through .lower() just to re-raise them would
+            # double the trace cost of every graph-breaking call
+            if isinstance(e, jax.errors.JAXTypeError):
+                raise
+            traced = None
+    if traced is not None:
+        t1 = _time.perf_counter()
+        lowered = traced.lower()
+    else:  # older jax: .lower() fuses trace+lower; report it as lower
+        t1 = t0
+        lowered = jitfn.lower(*args, **kwargs)
+    t2 = _time.perf_counter()
+    compiled = lowered.compile()
+    t3 = _time.perf_counter()
+    _record_compile_phases(label, t1 - t0, t2 - t1, t3 - t2,
+                           _serialized_hlo_bytes(lowered))
+    return compiled
+
 
 def _wrap_arrays(tree):
     return tree_util.tree_map(lambda a: Tensor(a), tree)
@@ -262,15 +353,42 @@ class StaticFunction:
                                 out = layer(*wa, **wk)
                     return _unwrap_tensors(out), dict(mutated)
 
-                self._compiled[key] = jax.jit(pure)
+                self._compiled[key] = [jax.jit(pure), None]
             else:
                 def pure_fn(key_arr, args, kwargs):
                     with framework.no_grad(), framework.rng_key_scope(key_arr):
                         out = fn(*_wrap_arrays(args), **_wrap_arrays(kwargs))
                     return _unwrap_tensors(out)
 
-                self._compiled[key] = jax.jit(pure_fn)
+                self._compiled[key] = [jax.jit(pure_fn), None]
         return self._compiled[key]
+
+    def _run_slot(self, slot, *args):
+        """Run a compiled-program slot ([jit fn, executable|None]): the
+        first call builds the executable through timed_lower_compile so
+        the compile-phase gauges (trace/lower/compile seconds +
+        hlo_program_bytes, labeled by function) cover to_static programs
+        too. Graph-break tracer errors propagate to __call__'s eager
+        fallback; any other AOT surprise degrades to plain jit dispatch."""
+        jitfn, ex = slot
+        if ex is None:
+            target = self._fn if self._fn is not None else self._layer
+            label = (getattr(target, "__qualname__", None)
+                     or type(target).__name__)
+            try:
+                ex = timed_lower_compile(jitfn, label, *args)
+            except self._GRAPH_BREAK_ERRORS:
+                raise
+            except Exception:
+                ex = jitfn
+            slot[1] = ex
+        try:
+            return ex(*args)
+        except (TypeError, ValueError):
+            if ex is jitfn:
+                raise
+            slot[1] = jitfn
+            return jitfn(*args)
 
     _GRAPH_BREAK_ERRORS = (
         jax.errors.TracerBoolConversionError,
@@ -308,21 +426,22 @@ class StaticFunction:
         key = self._trace_key(raw_args, raw_kwargs)
         if self._compiled.get(key, False) is None:  # known graph break
             return self._eager_call(args, kwargs)
-        compiled = self._get_compiled(key)
+        slot = self._get_compiled(key)
         key_arr = framework.next_rng_key()
         try:
             if self._layer is not None:
                 state = {k: v._data
                          for k, v in self._layer.state_dict().items()}
-                out_arrays, mutated = compiled(state, key_arr, raw_args,
-                                               raw_kwargs)
+                out_arrays, mutated = self._run_slot(slot, state, key_arr,
+                                                     raw_args, raw_kwargs)
                 # write back mutated buffers (e.g. batchnorm stats)
                 entries = self._layer.state_dict()
                 for name, arr in mutated.items():
                     if name in entries:
                         entries[name]._data = arr
                 return _wrap_arrays(out_arrays)
-            return _wrap_arrays(compiled(key_arr, raw_args, raw_kwargs))
+            return _wrap_arrays(self._run_slot(slot, key_arr, raw_args,
+                                               raw_kwargs))
         except self._GRAPH_BREAK_ERRORS as e:
             # graph break: data-dependent Python control flow cannot trace;
             # run this call eagerly (SOT fallback semantics) and remember so
@@ -434,6 +553,7 @@ class TrainStep:
         self.train_fn = train_fn
         self.optimizer = optimizer
         self._compiled = None
+        self._execs = {}  # input-signature -> AOT executable (or jit fn)
         self._param_names = None
         self._buffer_names = None
         self._opt_state = None
@@ -552,6 +672,7 @@ class TrainStep:
 
         from ..utils.flags import get_flags
 
+        self._execs = {}
         if get_flags("check_nan_inf")["check_nan_inf"]:
             # FLAGS_check_nan_inf inside the COMPILED step: checkify
             # instruments every float op so the raised error names the
@@ -570,6 +691,56 @@ class TrainStep:
         else:
             self._checkified = False
             self._compiled = jax.jit(step, donate_argnums=(0, 2))
+
+    def _compile_label(self):
+        return (f"TrainStep[{type(self.model).__name__}]"
+                + ("[plan]" if getattr(self, "_planning", False) else ""))
+
+    @staticmethod
+    def _exec_sig(tree):
+        def leaf_sig(a):
+            if hasattr(a, "shape"):
+                return (tuple(a.shape), str(a.dtype))
+            # python scalars are traced as weak-typed OPERANDS (jit
+            # reuses one program across values) — key them by class,
+            # never by value, or a per-step int in the batch would force
+            # a full recompile per distinct value
+            if isinstance(a, bool):
+                return "<b>"
+            if isinstance(a, int):
+                return "<i>"
+            if isinstance(a, float):
+                return "<f>"
+            return repr(a)
+
+        return tuple(leaf_sig(l) for l in tree_util.tree_leaves(tree))
+
+    def _dispatch_compiled(self, *op_args):
+        """Run the step program through an explicitly built executable so
+        the build splits into measured trace/lower/compile phases
+        (compile-phase gauges + the bench "compile" block). Signature
+        miss -> timed AOT build; any AOT surprise falls back to plain
+        ``jax.jit`` dispatch — never worse than the pre-telemetry path."""
+        key = self._exec_sig(op_args)
+        ex = self._execs.get(key)
+        if ex is None:
+            try:
+                ex = timed_lower_compile(self._compiled,
+                                         self._compile_label(), *op_args)
+            except Exception:
+                ex = self._compiled
+            self._execs[key] = ex
+        try:
+            return ex(*op_args)
+        except (TypeError, ValueError):
+            # AOT argument check rejected the operands BEFORE execution
+            # (an aval/layout property the signature key didn't capture):
+            # jit dispatch is authoritative for this signature from now
+            # on. Execution-time errors re-raise unchanged.
+            if ex is self._compiled:
+                raise
+            self._execs[key] = self._compiled
+            return self._compiled(*op_args)
 
     def _value_and_grads(self, make_loss_of, params, buffers, key_arr,
                          batch):
@@ -606,8 +777,9 @@ class TrainStep:
         key_arr = framework.next_rng_key()
         raw_batch = _unwrap_tensors(batch)
         if self._checkified:
-            err, out = self._compiled(params, buffers, self._opt_state, lr,
-                                      guard_arr, key_arr, raw_batch)
+            err, out = self._dispatch_compiled(params, buffers,
+                                               self._opt_state, lr,
+                                               guard_arr, key_arr, raw_batch)
             # raise BEFORE adopting any of the step's outputs: params,
             # buffers, and opt state all stay at their pre-step values so
             # the user can inspect or skip the batch
@@ -615,7 +787,7 @@ class TrainStep:
             loss, new_params, new_buffers, self._opt_state, health = out
         else:
             loss, new_params, new_buffers, self._opt_state, health = \
-                self._compiled(
+                self._dispatch_compiled(
                     params, buffers, self._opt_state, lr, guard_arr,
                     key_arr, raw_batch
                 )
@@ -722,9 +894,9 @@ class TrainStep:
         guard_aval = jax.ShapeDtypeStruct((4,), jnp.float32)
         key_arr = aval(framework.next_rng_key())
         batch_avals = tree_util.tree_map(aval, raw_batch)
-        return self._compiled.lower(
-            params, buffers, opt_state, lr, guard_aval, key_arr, batch_avals
-        ).compile()
+        return timed_lower_compile(
+            self._compiled, self._compile_label(), params, buffers,
+            opt_state, lr, guard_aval, key_arr, batch_avals)
 
     def memory_stats(self, *batch):
         """XLA buffer-assignment stats for this step's program: dict of

@@ -57,16 +57,21 @@ class MemoryPlanError(RuntimeError):
 
 @dataclasses.dataclass(frozen=True)
 class Candidate:
-    """One point of the batch x remat x head-chunk grid. ``score``
-    overrides the default throughput estimate (higher = preferred).
-    ``head_chunk`` is the fused-CE vocab-chunk size (None = the kernel
-    default) — larger chunks mean fewer serialized LSE scan steps but a
-    bigger resident [tokens, chunk] fp32 block, so it trades against
-    batch/remat inside the same HBM budget."""
+    """One point of the batch x remat x head-chunk x depth grid.
+    ``score`` overrides the default throughput estimate (higher =
+    preferred). ``head_chunk`` is the fused-CE vocab-chunk size (None =
+    the kernel default) — larger chunks mean fewer serialized LSE scan
+    steps but a bigger resident [tokens, chunk] fp32 block, so it trades
+    against batch/remat inside the same HBM budget. ``depth`` is a
+    num_layers override for callers whose step_factory rebuilds the
+    model per candidate — with scan-over-layers compilation flat in
+    depth (docs/SCAN.md), depth sweeps cost one cheap AOT compile per
+    point instead of a depth-linear trace."""
     batch: int
     policy: str
     score: float | None = None
     head_chunk: int | None = None
+    depth: int | None = None
 
 
 @dataclasses.dataclass
@@ -85,6 +90,7 @@ class PlanDecision:
     opt_state_bytes: int | None = None
     candidates: list = dataclasses.field(default_factory=list)
     head_chunk: int | None = None
+    depth: int | None = None
 
     def as_json(self):
         """The bench JSON ``"memory"`` block (docs/MEMORY.md contract)."""
@@ -306,10 +312,23 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
                        else throughput_score(c.batch, c.policy,
                                              getattr(c, "head_chunk", None))),
         reverse=True)
-    grid = [(c.batch, c.policy, getattr(c, "head_chunk", None))
+    grid = [(c.batch, c.policy, getattr(c, "head_chunk", None),
+             getattr(c, "depth", None))
             for c in order]
+    # the key must carry the scan/unroll mode: a decision priced under
+    # the depth-flat scanned program replayed for an unrolled build (or
+    # vice versa) would hand back a config priced against the WRONG
+    # program — the same staleness class the mem_envs hardening closed
+    # in PR 2 (docs/SCAN.md). Depth rides in per-candidate via `grid`.
+    # The mode comes from the ONE resolver the model dispatch uses
+    # (lazy import: no cycle — models.gpt pulls memory only in-function)
+    from ..models.gpt import scan_layers_enabled
+
+    scan_mode = ("scan" if scan_layers_enabled() else "unrolled",
+                 os.environ.get("PTPU_UNROLL_LAYERS", "1"))
     key = hashlib.sha1(repr(
-        (chip, ndev, budget, tuple(cache_extra), grid, require_fit)
+        (chip, ndev, budget, tuple(cache_extra), grid, require_fit,
+         scan_mode)
     ).encode()).hexdigest()[:16]
 
     cpath = _cache_path(cache_path)
@@ -338,12 +357,14 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
             _PLAN_EVALS.inc(labels=("error",))
             evaluated.append({"batch": cand.batch, "policy": cand.policy,
                               "head_chunk": getattr(cand, "head_chunk", None),
+                              "depth": getattr(cand, "depth", None),
                               "score": score, "error": str(e)[:200]})
             continue
         fits = mem["peak_bytes"] <= budget
         _PLAN_EVALS.inc(labels=("fit" if fits else "over_budget",))
         evaluated.append({"batch": cand.batch, "policy": cand.policy,
                           "head_chunk": getattr(cand, "head_chunk", None),
+                          "depth": getattr(cand, "depth", None),
                           "score": score, "peak_bytes": mem["peak_bytes"],
                           "fits": fits})
         if fits or not require_fit:
@@ -358,6 +379,7 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
     decision = PlanDecision(
         batch=cand.batch, policy=cand.policy,
         head_chunk=getattr(cand, "head_chunk", None),
+        depth=getattr(cand, "depth", None),
         peak_bytes=int(mem["peak_bytes"]), budget_bytes=int(budget),
         fits=bool(fits), score=float(score),
         source="planner" if require_fit else "env-override",

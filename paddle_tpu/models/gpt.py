@@ -244,7 +244,15 @@ class GPTModel(nn.Layer):
         if not self.config.rope:
             pos = paddle.arange(input_ids.shape[1])
             x = x + self.embed_pos(pos)
-        if self.config.recompute:
+        if self._shared_block_eligible(attn_mask):
+            # scan-over-layers (docs/SCAN.md): the LayerList weights are
+            # stacked [L, ...] at trace time and run through the SAME
+            # _block_pure scan body as StackedDecoder — compile time and
+            # program size flat in depth, remat anchors identical, and
+            # float32-hex identical to the per-layer module loop below
+            # (PTPU_SCAN_LAYERS=0 unrolls the shared body instead).
+            x = self._run_stacked(x)
+        elif self.config.recompute:
             from paddle_tpu.distributed.fleet.utils import recompute
 
             for layer in self.layers:
@@ -253,6 +261,87 @@ class GPTModel(nn.Layer):
             for layer in self.layers:
                 x = layer(x, attn_mask)
         return self.final_norm(x)
+
+    def _shared_block_eligible(self, attn_mask):
+        """True when the stack can run through the shared _block_pure
+        scan body: plain DecoderLayers of the rmsnorm+swiglu+rope family,
+        no mask/dropout, no per-layer distributed placements (pp stage
+        assignment and parallelize() marks operate on per-layer modules,
+        which the stacked tree would silently drop)."""
+        cfg = self.config
+        if attn_mask is not None or cfg.dropout or not cfg.rope:
+            return False
+        if cfg.norm_type != "rmsnorm" or cfg.act != "swiglu":
+            return False
+        from paddle_tpu import amp as _amp
+
+        if _amp.is_auto_cast_enabled():
+            # the stack dispatches as ONE op here, which would bypass
+            # amp's per-op white/black-list casting (the matmuls would
+            # silently run fp32) — keep the module loop under autocast
+            return False
+        if any(type(l) is not DecoderLayer for l in self.layers):
+            return False
+        for l in self.layers:
+            for _, p in l.named_parameters():
+                if getattr(p, "_dist_attr", None) is not None:
+                    return False
+        from paddle_tpu.distributed.fleet import active_mesh
+
+        mesh = active_mesh()
+        if (mesh is not None and "pp" in mesh.dim_names
+                and mesh.get_dim_size("pp") > 1):
+            return False
+        return True
+
+    def _run_stacked(self, x):
+        """Eligible LayerList stack through the shared scan body.
+
+        Cost note (docs/SCAN.md): the per-layer weights are stacked
+        INSIDE the program, so each step pays a decoder-weights
+        concatenate the module loop never paid — the trade is steady-
+        state copy bandwidth for depth-flat compile time, which is the
+        right trade for the eager frontend's dev/CPU/small-model uses.
+        Flagship-scale training stores weights stacked natively
+        (StackedDecoder) and never restacks; if an eager model is
+        compile-bound AND copy-sensitive, PTPU_SCAN_LAYERS=0 restores
+        the copy-free unrolled program."""
+        import jax.numpy as jnp
+        from paddle_tpu.core.dispatch import apply_op
+
+        cfg = self.config
+        L = len(self.layers)
+        flat = []
+        for l in self.layers:
+            obj = {"input_norm.weight": l.input_norm.weight,
+                   "attn.q_proj.weight": l.attn.q_proj.weight,
+                   "attn.k_proj.weight": l.attn.k_proj.weight,
+                   "attn.v_proj.weight": l.attn.v_proj.weight,
+                   "attn.o_proj.weight": l.attn.o_proj.weight,
+                   "post_attn_norm.weight": l.post_attn_norm.weight,
+                   "mlp.gate_proj.weight": l.mlp.gate_proj.weight,
+                   "mlp.up_proj.weight": l.mlp.up_proj.weight,
+                   "mlp.down_proj.weight": l.mlp.down_proj.weight}
+            flat.extend(obj[suffix] for _, suffix in _BLOCK_PARAM_FIELDS)
+
+        def _run(x, *params):
+            tables = (_rope_tables(x.shape[1],
+                                   cfg.hidden_size // cfg.num_heads)
+                      if cfg.rope and os.environ.get("PTPU_ROPE_HOIST")
+                      else None)
+            policy, int8_names = (_resolve_remat(cfg) if cfg.recompute
+                                  else (None, frozenset()))
+            block = _make_block(cfg, tables=tables, int8_names=int8_names,
+                                policy=policy)
+            n = len(_BLOCK_PARAM_FIELDS)
+            per_layer = [params[i * n:(i + 1) * n] for i in range(L)]
+            if scan_layers_enabled():
+                stacked = tuple(jnp.stack([lp[k] for lp in per_layer])
+                                for k in range(n))
+                return _scan_blocks(block, x, stacked)
+            return _unrolled_blocks(block, x, per_layer)
+
+        return apply_op(_run, x, *flat, _op_name="gpt_layer_stack")
 
 
 class GPTForCausalLM(nn.Layer):
@@ -435,6 +524,56 @@ def _ffn_i8_bwd(res, g):
 _ffn_i8.defvjp(_ffn_i8_fwd, _ffn_i8_bwd)
 
 
+def scan_layers_enabled():
+    """``PTPU_SCAN_LAYERS`` master switch (docs/SCAN.md): the default
+    (unset/1) runs the decoder stack as ONE ``lax.scan`` body over a
+    leading-axis-stacked weight tree — trace time, XLA compile time, and
+    serialized program size stay flat in depth. ``0``/``off`` keeps the
+    python-unrolled per-layer loop: linear compile cost, but a bitwise
+    escape hatch (float32-hex-proven parity with the scanned path and
+    with the pre-scan per-layer module loop)."""
+    return os.environ.get("PTPU_SCAN_LAYERS", "").strip().lower() not in (
+        "0", "off", "false")
+
+
+def _fused_ffn_active(tp_seams):
+    """norm→ffn seam megakernel gate (``PTPU_FUSED_FFN``, or the
+    umbrella ``PTPU_FUSED_SEAMS`` that also engages the addrms attn→norm
+    seam). Precedence mirrors the PR 6 rules: engaged tp seams own the
+    row/col matmul layouts (the megakernel's plain-matmul reads would
+    force mid-block reshards against the seq-sharded residual), and
+    ``PTPU_INT8_FFN`` keeps its own whole-FFN vjp."""
+    if tp_seams is not None:
+        return False
+    if os.environ.get("PTPU_INT8_FFN"):
+        return False
+    env = (os.environ.get("PTPU_FUSED_FFN")
+           or os.environ.get("PTPU_FUSED_SEAMS") or "")
+    if env in ("", "0"):
+        return False
+    # device gate (mirrors _sdpa_pure/_addrms_active): off-TPU the
+    # kernel would run in the Pallas INTERPRETER — orders of magnitude
+    # slower than the unfused XLA seam. "interpret" opts in explicitly
+    # (parity tests drive the real kernel code on the CPU mesh).
+    from paddle_tpu.ops.pallas import on_tpu_device
+
+    return on_tpu_device() or env == "interpret"
+
+
+def _addrms_active(tp_seams, q_shape):
+    """attn→norm seam: the fused residual-add+rms Pallas pass
+    (``PTPU_FUSED_ADDRMS``, or the ``PTPU_FUSED_SEAMS`` umbrella)."""
+    if tp_seams is not None:
+        return False
+    env = (os.environ.get("PTPU_FUSED_ADDRMS")
+           or os.environ.get("PTPU_FUSED_SEAMS") or "")
+    if env in ("", "0"):
+        return False
+    from paddle_tpu.nn.functional.flash_attention import _use_pallas
+
+    return _use_pallas(q_shape)
+
+
 def _sdpa_pure(q, k, v, causal=True):
     """Flagship attention dispatch. Calls the pallas kernel DIRECTLY when
     `_use_pallas` holds (no silent try/except fallback: a kernel failure
@@ -520,8 +659,7 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
 
     if not _use_pallas(q.shape):
         o = _save(o, "attn_out")
-    if (os.environ.get("PTPU_FUSED_ADDRMS") and _use_pallas(q.shape)
-            and tp_seams is None):
+    if _addrms_active(tp_seams, q.shape):
         # fused residual-add + rms in one Pallas pass (named residuals
         # addrms_y/rms_rstd make the backward reuse, not re-run, it).
         # Engaged tp seams take precedence: mixing one plain-matmul
@@ -552,8 +690,117 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     # policy trade ~67MB/layer (b4) for skipping that matmul's re-run
     gate = _save(_col(h2, wg), "ffn_gate")
     up = _save(_col(h2, wu), "ffn_up")
+    if _fused_ffn_active(tp_seams):
+        from ..ops.pallas.swiglu_down import swiglu_down, swiglu_down_supported
+
+        if swiglu_down_supported(gate.shape, wd.shape):
+            # norm→ffn seam megakernel: (silu(gate) * up) @ wd streamed
+            # through VMEM — the [tokens, intermediate] swiglu product
+            # never round-trips HBM. No "ffn_out" anchor on this path
+            # (the custom_vjp backward rebuilds silu*up from the saved
+            # gate/up, mirroring the pallas-attention anchor rule above,
+            # so a policy naming ffn_out simply saves nothing for it —
+            # the silu*mul replay is elementwise; docs/SCAN.md).
+            return x + swiglu_down(gate, up, wd)
     ffn = _save(jax.nn.silu(gate) * up, "ffn_out")
     return x + _row(ffn, wd)
+
+
+# ---------------------------------------------------------------------------
+# Shared scan-over-layers machinery (docs/SCAN.md). The ONE block
+# implementation is _block_pure; the helpers below turn it into a remat-
+# wrapped scan body (or python-unrolled loop) shared by BOTH decoder
+# frontends — StackedDecoder (weights stored [L, ...]) and the eager
+# GPTModel LayerList (weights stacked at trace time) — so remat-anchor
+# names cannot drift between them.
+# ---------------------------------------------------------------------------
+#: _block_pure's parameter order, as (StackedDecoder attr, per-layer
+#: DecoderLayer state_dict suffix) pairs — also the stacked<->per-layer
+#: checkpoint layout contract (convert_decoder_state_dict below)
+_BLOCK_PARAM_FIELDS = (
+    ("ln1", "input_norm.weight"),
+    ("wq", "attn.q_proj.weight"),
+    ("wk", "attn.k_proj.weight"),
+    ("wv", "attn.v_proj.weight"),
+    ("wo", "attn.o_proj.weight"),
+    ("ln2", "post_attn_norm.weight"),
+    ("wg", "mlp.gate_proj.weight"),
+    ("wu", "mlp.up_proj.weight"),
+    ("wd", "mlp.down_proj.weight"),
+)
+
+
+def _resolve_remat(cfg):
+    """(checkpoint policy, int8 anchor names) for ``cfg.recompute_policy``
+    — the single parser both decoder frontends share."""
+    import jax
+
+    int8_names = frozenset()
+    pol = getattr(cfg, "recompute_policy", "full")
+    policy = None
+    if isinstance(pol, str) and pol.startswith("names:"):
+        # free-form selective remat: comma-separated checkpoint_name tags
+        # (the available anchors are tagged in _block_pure). An
+        # int8:<anchor> entry saves that anchor as blockwise int8 + fp32
+        # scales (memory.int8_checkpoint) at ~half the bf16 bytes.
+        from paddle_tpu.memory import parse_save_names
+
+        save_names, int8_names = parse_save_names(pol[len("names:"):])
+        policy = jax.checkpoint_policies.save_only_these_names(*save_names)
+    elif pol == "dots":
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif pol == "attn":
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_res", "attn_lse")
+    elif pol == "attn_ffn":
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_res", "attn_lse", "ffn_out")
+    return policy, int8_names
+
+
+def _make_block(cfg, tables=None, int8_names=frozenset(), tp_seams=None,
+                policy=None):
+    """One remat-wrapped decoder block over arrays: the scan body. With
+    ``cfg.recompute`` each body is a ``jax.checkpoint`` — the remat
+    policy (including int8:<anchor> saves) applies PER LAYER whether the
+    stack is scanned or unrolled."""
+    import jax
+
+    def block(x, p):
+        return _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
+                           cfg.rope, rope_tables=tables,
+                           int8_names=int8_names, tp_seams=tp_seams)
+
+    if cfg.recompute:
+        block = jax.checkpoint(block, policy=policy)
+    return block
+
+
+def _scan_blocks(block, x, stacked):
+    """Run ``block`` as a lax.scan over a [L, ...]-stacked weight tree —
+    compile time and program size flat in depth."""
+    import jax
+
+    def step(x, p):
+        return block(x, p), None
+
+    # PTPU_UNROLL_LAYERS=N statically unrolls the scan N-wide: the
+    # per-iteration dynamic-slice of every stacked weight (a real HBM
+    # copy — profiled at >20% of device ops, r4) becomes a
+    # constant-offset slice XLA can alias. Costs compile time linear
+    # in N.
+    unroll = int(os.environ.get("PTPU_UNROLL_LAYERS", "1"))
+    out, _ = jax.lax.scan(step, x, tuple(stacked), unroll=max(1, unroll))
+    return out
+
+
+def _unrolled_blocks(block, x, layer_params):
+    """The ``PTPU_SCAN_LAYERS=0`` escape hatch: a python loop over
+    per-layer weight tuples — program size linear in depth, float32-hex
+    identical to the scanned path (tests/test_scan_layers.py proves it)."""
+    for p in layer_params:
+        x = block(x, tuple(p))
+    return x
 
 
 class StackedDecoder(nn.Layer):
@@ -704,21 +951,8 @@ class StackedDecoder(nn.Layer):
                       if cfg.rope and os.environ.get("PTPU_ROPE_HOIST")
                       else None)
 
-            int8_names = frozenset()
-            if cfg.recompute:
-                pol = getattr(cfg, "recompute_policy", "full")
-                if isinstance(pol, str) and pol.startswith("names:"):
-                    # free-form selective remat: comma-separated
-                    # checkpoint_name tags (perf-sweep surface; the
-                    # available anchors are tagged in _block_pure). An
-                    # int8:<anchor> entry saves that anchor as blockwise
-                    # int8 + fp32 scales (memory.int8_checkpoint) — the
-                    # policy then keeps the quantized pair, ~half the
-                    # bf16 bytes (docs/MEMORY.md).
-                    from paddle_tpu.memory import parse_save_names
-
-                    save_names, int8_names = parse_save_names(
-                        pol[len("names:"):])
+            policy, int8_names = (_resolve_remat(cfg) if cfg.recompute
+                                  else (None, frozenset()))
 
             # fused tp seams (docs/COMMS.md): owned matmul+reduce-scatter /
             # all-gather+matmul kernels replace the GSPMD-emitted mp
@@ -741,41 +975,22 @@ class StackedDecoder(nn.Layer):
                         tp_seams = collectives.plan_tp_seams(
                             da.process_mesh, tp_axis=tp_axes[0])
 
-            def block(x, p):
-                return _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
-                                   cfg.rope, rope_tables=tables,
-                                   int8_names=int8_names, tp_seams=tp_seams)
+            block = _make_block(cfg, tables=tables, int8_names=int8_names,
+                                tp_seams=tp_seams, policy=policy)
 
-            if cfg.recompute:
-                if pol == "dots":
-                    policy = (jax.checkpoint_policies
-                              .dots_with_no_batch_dims_saveable)
-                elif pol == "attn":
-                    policy = jax.checkpoint_policies.save_only_these_names(
-                        "attn_out", "attn_res", "attn_lse")
-                elif pol == "attn_ffn":
-                    policy = jax.checkpoint_policies.save_only_these_names(
-                        "attn_out", "attn_res", "attn_lse", "ffn_out")
-                elif isinstance(pol, str) and pol.startswith("names:"):
-                    policy = jax.checkpoint_policies.save_only_these_names(
-                        *save_names)
-                else:
-                    policy = None
-                block = jax.checkpoint(block, policy=policy)
+            if pp <= 1:
+                if scan_layers_enabled():
+                    return _scan_blocks(block, x, params)
+                # PTPU_SCAN_LAYERS=0 escape hatch: python-unrolled loop
+                # over constant-offset slices of the stacked weights —
+                # program size linear in depth, numerics bitwise equal
+                L = int(params[0].shape[0])
+                return _unrolled_blocks(
+                    block, x,
+                    (tuple(w[i] for w in params) for i in range(L)))
 
             def step(x, p):
                 return block(x, p), None
-
-            if pp <= 1:
-                # PTPU_UNROLL_LAYERS=N statically unrolls the layer loop:
-                # the scan's per-iteration dynamic-slice of every stacked
-                # weight (a real HBM copy, ~100MB/layer/pass — profiled at
-                # >20% of device ops, r4) becomes a constant-offset slice
-                # XLA can alias. Costs compile time linear in depth.
-                unroll = int(os.environ.get("PTPU_UNROLL_LAYERS", "1"))
-                out, _ = jax.lax.scan(step, x, tuple(params),
-                                      unroll=max(1, unroll))
-                return out
 
             from paddle_tpu.distributed.pipeline import (
                 microbatch, spmd_pipeline, spmd_pipeline_interleaved,
@@ -927,6 +1142,227 @@ class _LazyLayerSlices:
     def __iter__(self):
         for i in range(self._num_layers):
             yield self[i]
+
+
+# ---------------------------------------------------------------------------
+# Stacked <-> per-layer checkpoint layout conversion (docs/SCAN.md).
+# The scanned flagship stores decoder weights [L, ...]-stacked
+# (GPTForCausalLMPipe: "decoder.wq"), the eager LayerList family stores
+# them per layer ("model.layers.{i}.attn.q_proj.weight"). A checkpoint
+# written under either layout restores into the other BIT-FOR-BIT through
+# these converters — old per-layer checkpoints keep working after a model
+# is promoted to the stacked layout, and vice versa.
+# ---------------------------------------------------------------------------
+_SUFFIX_TO_ATTR = {suffix: attr for attr, suffix in _BLOCK_PARAM_FIELDS}
+#: top-level (non-decoder) key mapping: stacked-side name -> per-layer name
+_TOP_KEY_MAP = {"embed_tokens.weight": "model.embed_tokens.weight",
+                "final_norm.weight": "model.final_norm.weight"}
+
+
+def _raw_array(v):
+    return v._data if hasattr(v, "_data") else v
+
+
+def _split_opt_key(key):
+    """("opt." or "", param-ish remainder). Optimizer entries are saved
+    as "opt.<param_name>.<slot>" (distributed.checkpoint)."""
+    return ("opt.", key[4:]) if key.startswith("opt.") else ("", key)
+
+
+def _match_layer_key(rest):
+    """per-layer decoder key -> (layer_index, attr, slot_suffix) or None.
+    rest: "model.layers.3.attn.q_proj.weight[.slot]"."""
+    prefix = "model.layers."
+    if not rest.startswith(prefix):
+        return None
+    tail = rest[len(prefix):]
+    idx, _, tail = tail.partition(".")
+    if not idx.isdigit():
+        return None
+    for suffix, attr in _SUFFIX_TO_ATTR.items():
+        if tail == suffix:
+            return int(idx), attr, ""
+        if tail.startswith(suffix + "."):
+            return int(idx), attr, tail[len(suffix):]
+    return None
+
+
+def _match_stacked_key(rest):
+    """stacked decoder key -> (attr, slot_suffix) or None.
+    rest: "decoder.wq[.slot]"."""
+    if not rest.startswith("decoder."):
+        return None
+    tail = rest[len("decoder."):]
+    attr, _, slot = tail.partition(".")
+    if attr not in _SUFFIX_TO_ATTR.values():
+        return None
+    return attr, ("." + slot if slot else "")
+
+
+def decoder_state_layout(state):
+    """"stacked" | "per_layer" | None for a LM state_dict's key set."""
+    for key in state:
+        _, rest = _split_opt_key(key)
+        if _match_stacked_key(rest) is not None:
+            return "stacked"
+        if _match_layer_key(rest) is not None:
+            return "per_layer"
+    return None
+
+
+def convert_decoder_state_dict(state, target):
+    """Convert a GPT/LLaMA LM state_dict (params + optional
+    "opt.<param>.<slot>" optimizer entries) to ``target`` ("stacked" |
+    "per_layer"). Decoder weights are stacked/sliced along the leading
+    layer axis bit-for-bit; param-shaped and factored slot entries follow
+    their parameter, scalar slots (beta power accumulators) replicate on
+    unstacking and must agree bitwise on stacking. Already-converted and
+    unknown keys pass through unchanged (a strict restore then reports
+    them). Blockwise-int8 moment slots do NOT convert exactly (their
+    quant-block grid spans the stacked axis) — restore those under the
+    layout that wrote them."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    if target not in ("stacked", "per_layer"):
+        raise ValueError(f"target={target!r}: expected stacked|per_layer")
+    out = {}
+    if target == "stacked":
+        pending = {}  # (pre, attr, slot) -> {layer_index: array}
+        for key, v in state.items():
+            pre, rest = _split_opt_key(key)
+            m = _match_layer_key(rest)
+            if m is None:
+                new = rest
+                for stacked_k, layer_k in _TOP_KEY_MAP.items():
+                    if rest == layer_k:
+                        new = stacked_k
+                    elif rest.startswith(layer_k + "."):
+                        new = stacked_k + rest[len(layer_k):]
+                out[pre + new] = _raw_array(v)
+                continue
+            i, attr, slot = m
+            pending.setdefault((pre, attr, slot), {})[i] = _raw_array(v)
+        for (pre, attr, slot), by_layer in pending.items():
+            L = max(by_layer) + 1
+            missing = [i for i in range(L) if i not in by_layer]
+            if missing:
+                raise ValueError(
+                    f"per-layer state is missing layers {missing} of "
+                    f"{attr}{slot} (found {sorted(by_layer)})")
+            arrs = [by_layer[i] for i in range(L)]
+            if getattr(arrs[0], "ndim", 0) == 0:
+                ref = np.asarray(arrs[0])
+                for i, a in enumerate(arrs[1:], 1):
+                    if np.asarray(a).tobytes() != ref.tobytes():
+                        raise ValueError(
+                            f"scalar slot {attr}{slot} differs between "
+                            f"layers 0 and {i} — cannot collapse into one "
+                            "stacked entry")
+                out[pre + "decoder." + attr + slot] = arrs[0]
+            else:
+                out[pre + "decoder." + attr + slot] = jnp.stack(
+                    [jnp.asarray(a) for a in arrs])
+        return out
+
+    # target == "per_layer"
+    num_layers = None
+    for key, v in state.items():
+        _, rest = _split_opt_key(key)
+        m = _match_stacked_key(rest)
+        if m is not None and m[1] == "":
+            num_layers = int(_raw_array(v).shape[0])
+            break
+    for key, v in state.items():
+        pre, rest = _split_opt_key(key)
+        m = _match_stacked_key(rest)
+        if m is None:
+            new = rest
+            for stacked_k, layer_k in _TOP_KEY_MAP.items():
+                if rest == stacked_k:
+                    new = layer_k
+                elif rest.startswith(stacked_k + "."):
+                    new = layer_k + rest[len(stacked_k):]
+            out[pre + new] = _raw_array(v)
+            continue
+        attr, slot = m
+        suffix = dict(_BLOCK_PARAM_FIELDS)[attr]
+        arr = _raw_array(v)
+        if num_layers is None:
+            raise ValueError("cannot infer num_layers: no stacked decoder "
+                             "parameter entry in the state dict")
+        for i in range(num_layers):
+            per = (arr[i] if getattr(arr, "ndim", 0) >= 1
+                   and arr.shape[0] == num_layers else arr)
+            out[f"{pre}model.layers.{i}.{suffix}{slot}"] = per
+    return out
+
+
+def restore_decoder_any_layout(manager, model, optimizer=None, step=None,
+                               strict=True):
+    """``CheckpointManager.restore_training_state`` that also accepts a
+    checkpoint written under the OTHER decoder layout: a per-layer
+    (eager GPTForCausalLM / LLaMA) checkpoint restores into a stacked
+    GPTForCausalLMPipe model bit-for-bit, and vice versa. A metadata-only
+    layout peek routes same-layout checkpoints through the exact
+    pre-existing native restore (reshard-on-load, the caller's
+    ``strict``); other-layout checkpoints go through
+    ``manager.read_state`` + :func:`convert_decoder_state_dict`.
+    Returns the step restored."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.checkpoint import (
+        MissingKeysError, _training_state_target)
+
+    # Metadata-only layout peek decides the path BEFORE loading
+    # anything: a same-layout checkpoint (including a lenient
+    # strict=False partial restore) keeps the exact native
+    # reshard-on-load path; only a genuinely other-layout checkpoint
+    # pays the convert. (Deciding by probing the native restore instead
+    # would either let a non-strict cross-layout restore "succeed"
+    # loading nothing, or reroute lenient same-layout restores through
+    # the converter and lose their resharding.)
+    want = decoder_state_layout(model.state_dict())
+    have = decoder_state_layout(manager.saved_keys(step=step))
+    if want is None or have is None or have == want:
+        try:
+            return manager.restore_training_state(model, optimizer,
+                                                  step=step, strict=strict)
+        except MissingKeysError:
+            if want is None:
+                raise
+            # mixed-layout root: the newest good step (whose layout the
+            # peek saw) failed payload validation and the native walk
+            # fell back onto an OTHER-layout older step — convert that
+            # one below. (Residual corner: under strict=False such a
+            # walk cannot raise and loads nothing from the other-layout
+            # step; mixed-layout roots should restore with strict=True.)
+    state, s = manager.read_state(step=step)
+    target, finalize = _training_state_target(model, optimizer)
+    want = decoder_state_layout(target) or "per_layer"
+    conv = convert_decoder_state_dict(state, want)
+    missing = [k for k in target if k not in conv]
+    if missing and strict:
+        raise MissingKeysError(
+            f"checkpoint step {s} (converted to {want} layout) holds no "
+            f"payload for: {sorted(missing)[:8]}"
+            + ("..." if len(missing) > 8 else ""))
+    import jax
+
+    for k, t in target.items():
+        if k not in conv:
+            continue
+        arr = jnp.asarray(conv[k])
+        if tuple(arr.shape) != tuple(t.shape):
+            raise ValueError(
+                f"{k}: converted shape {tuple(arr.shape)} != model shape "
+                f"{tuple(t.shape)}")
+        # keep the target's placement: a parameter already device_put on
+        # a mesh must not silently degrade to a replicated host array
+        t._data = jax.device_put(arr.astype(t._data.dtype),
+                                 t._data.sharding)
+    finalize()
+    return s
 
 
 # ---------------------------------------------------------------------------

@@ -62,5 +62,7 @@ def log_path_once(op: str, path: str) -> None:
 
 from .flash_attention import flash_attention, flash_attention_fwd  # noqa: E402
 from .rms_norm import rms_norm  # noqa: E402
+from .swiglu_down import swiglu_down, swiglu_down_supported  # noqa: E402
 
-__all__ = ["flash_attention", "flash_attention_fwd", "rms_norm", "use_interpret"]
+__all__ = ["flash_attention", "flash_attention_fwd", "rms_norm",
+           "swiglu_down", "swiglu_down_supported", "use_interpret"]

@@ -164,3 +164,64 @@ def test_comms_gate_passes_ok_probe_and_disabled(tmp_path):
     off = _round_with_comms(tmp_path, "BENCH_r09.json", {
         "enabled": False, "parity": {"enabled": False}})
     assert bg.main([off, "--against", off]) == 0
+
+
+# -- compile gate (ISSUE 9: scan-over-layers flat compile, docs/SCAN.md) ----
+def _round_with_compile(tmp_path, name, compile_block, value=100.0):
+    rec = {"metric": "m", "value": value, "unit": "tokens/sec/chip",
+           "compile": compile_block}
+    p = tmp_path / name
+    p.write_text(json.dumps(rec) + "\n")
+    return str(p)
+
+
+def _compile_block(total, num_layers=24, scan=True):
+    return {"trace_seconds": total * 0.1, "lower_seconds": total * 0.1,
+            "compile_seconds": total * 0.8, "hlo_program_bytes": 400000,
+            "function": "TrainStep[GPTForCausalLMPipe]",
+            "num_layers": num_layers, "scan_layers": scan}
+
+
+def test_compile_gate_fails_on_regression_same_depth(tmp_path, capsys):
+    old = _round_with_compile(tmp_path, "BENCH_r01.json",
+                              _compile_block(10.0))
+    new = _round_with_compile(tmp_path, "BENCH_r02.json",
+                              _compile_block(14.0))
+    assert bg.main([new, "--against", old]) == 1
+    assert "COMPILE" in capsys.readouterr().out
+    # a looser threshold lets the same pair pass
+    assert bg.main([new, "--against", old,
+                    "--compile-threshold", "0.5"]) == 0
+
+
+def test_compile_gate_passes_within_threshold(tmp_path):
+    old = _round_with_compile(tmp_path, "BENCH_r01.json",
+                              _compile_block(10.0))
+    new = _round_with_compile(tmp_path, "BENCH_r02.json",
+                              _compile_block(11.0))
+    assert bg.main([new, "--against", old]) == 0
+
+
+def test_compile_gate_skips_depth_or_mode_mismatch(tmp_path):
+    old = _round_with_compile(tmp_path, "BENCH_r01.json",
+                              _compile_block(10.0, num_layers=8))
+    new = _round_with_compile(tmp_path, "BENCH_r02.json",
+                              _compile_block(40.0, num_layers=48))
+    assert bg.main([new, "--against", old]) == 0  # depth changed: no gate
+    old2 = _round_with_compile(tmp_path, "BENCH_r03.json",
+                               _compile_block(10.0, scan=False))
+    new2 = _round_with_compile(tmp_path, "BENCH_r04.json",
+                               _compile_block(40.0, scan=True))
+    assert bg.main([new2, "--against", old2]) == 0  # mode changed: no gate
+
+
+def test_compile_gate_skips_missing_block_and_subsecond(tmp_path):
+    plain_old = _round(tmp_path, "BENCH_r01.json", {"m": 100.0})
+    new = _round_with_compile(tmp_path, "BENCH_r02.json",
+                              _compile_block(14.0))
+    assert bg.main([new, "--against", plain_old]) == 0
+    tiny_old = _round_with_compile(tmp_path, "BENCH_r03.json",
+                                   _compile_block(0.5))
+    tiny_new = _round_with_compile(tmp_path, "BENCH_r04.json",
+                                   _compile_block(0.9))
+    assert bg.main([tiny_new, "--against", tiny_old]) == 0

@@ -154,6 +154,40 @@ def comms_violations(rec):
     return out
 
 
+def compile_violations(rec, ref_rec, threshold=0.25):
+    """Violation strings comparing one metric's "compile" block against
+    the reference round's (docs/SCAN.md): total build wall time
+    (trace + lower + compile) must not regress more than ``threshold``
+    at the SAME depth and scan mode — the scan-over-layers flat-compile
+    guarantee, gated instead of eyeballed. Blocks are only comparable
+    when depth/mode match (a depth change legitimately changes compile
+    cost); sub-second references are noise-dominated and skipped."""
+    new_c = rec.get("compile") if isinstance(rec, dict) else None
+    old_c = ref_rec.get("compile") if isinstance(ref_rec, dict) else None
+    if not isinstance(new_c, dict) or not isinstance(old_c, dict):
+        return []
+    if new_c.get("num_layers") != old_c.get("num_layers"):
+        return []
+    if bool(new_c.get("scan_layers")) != bool(old_c.get("scan_layers")):
+        return []
+
+    def total(c):
+        return sum(float(c.get(k) or 0.0)
+                   for k in ("trace_seconds", "lower_seconds",
+                             "compile_seconds"))
+
+    old_t, new_t = total(old_c), total(new_c)
+    if old_t < 1.0:
+        return []
+    out = []
+    if new_t > old_t * (1.0 + threshold):
+        out.append(
+            f"compile time {new_t:.1f}s > {1.0 + threshold:.2f}x reference "
+            f"{old_t:.1f}s at depth {new_c.get('num_layers')} "
+            f"(scan_layers={bool(new_c.get('scan_layers'))})")
+    return out
+
+
 def compare(new_metrics, ref_metrics, threshold):
     """-> (rows, regressions). Each row: (metric, old, new, ratio|None)."""
     rows, regressions = [], []
@@ -195,6 +229,9 @@ def main(argv=None):
                     "BENCH_r*.json round)")
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="allowed fractional drop (default 0.05)")
+    ap.add_argument("--compile-threshold", type=float, default=0.25,
+                    help="allowed fractional compile-time increase at "
+                    "the same depth/scan mode (default 0.25; docs/SCAN.md)")
     ap.add_argument("--root", default=os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))),
         help="repo root for BENCH_r*.json discovery")
@@ -256,6 +293,13 @@ def main(argv=None):
             print(f"  REGRESSION {metric}: {old} -> {new} "
                   f"({(ratio - 1) * 100:+.1f}% < -{args.threshold:.0%})")
             failed = True
+        # compile gate (docs/SCAN.md): same-depth build time must not
+        # regress past --compile-threshold vs this reference round
+        for metric, rec in sorted(new_metrics.items()):
+            for v in compile_violations(rec, ref_metrics.get(metric),
+                                        args.compile_threshold):
+                print(f"  COMPILE {metric}: {v}", flush=True)
+                failed = True
     return 1 if failed else 0
 
 
