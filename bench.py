@@ -61,8 +61,10 @@ def run_model(model_kind, ckpt=None):
         if model_kind == "llama":
             # BASELINE.md config-5 variant: LLaMA-7B architecture
             # (h=4096, GQA, swiglu, rope) depth-scaled to 8 layers so
-            # params+Adam state fit one v5e chip; donated whole-step
-            # update = the single-chip degenerate of sharding_stage3.
+            # params+Adam state fit one v5e chip. This line runs REAL
+            # sharding_stage=3 (group_sharded_parallel + the ZeRO
+            # execution mode below, docs/ZERO.md) over every
+            # addressable chip — degree = device count.
             cfg = GPTConfig(vocab_size=32000, hidden_size=4096,
                             num_layers=8, num_heads=32, num_kv_heads=8,
                             intermediate_size=11008, max_seq_len=2048,
@@ -123,20 +125,60 @@ def run_model(model_kind, ckpt=None):
         for _, p in model.named_parameters():
             p._data = p._data.astype(jax.numpy.bfloat16)
 
+    # config-5 (BASELINE.md): the LLaMA-arch line runs sharding_stage=3
+    # END TO END (docs/ZERO.md) — params resident as dp shards, grads
+    # reduce-scattered, the update on 1/degree slots, scan-body
+    # just-in-time weight gathers — over every addressable chip. One
+    # chip is the degree-1 degenerate of the SAME code path (the zero
+    # plan disengages, GSPMD placements are no-ops), not a separate
+    # single-chip approximation.
+    zero_stage, zero_degree, zero_mesh = 0, 1, None
+    if model_kind == "llama":
+        from paddle_tpu.distributed import fleet as _fleet
+
+        zero_stage = 3
+        zero_degree = len(jax.devices())
+        strategy = _fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 1,
+                                   "sharding_degree": zero_degree}
+        _fleet.init(is_collective=True, strategy=strategy)
+        zero_mesh = _fleet.get_fleet_mesh()
+
     # PTPU_ADAM8=1: blockwise-int8 moments (8-bit Adam) — frees ~4GB of
     # optimizer HBM at 1.3B, buying remat headroom (r4; measured LOSING
     # on this chip, defaults off — docs/ROUND4_RESPONSE.md)
     # PTPU_ADAM_FACTORED=1: Adafactor-style factored second moment —
     # frees ~2.6GB (m2) with fp32 math, no quant round-trips (r5)
+    # The multi-chip stage-3 line uses PLAIN fp32 moments instead:
+    # factored/int8 moments compute cross-element statistics that can't
+    # run on a 1/degree shard (the zero plan would decline), and full
+    # moments divided by the shard degree beat factored's ~half saving
+    # from degree 2 up (docs/ZERO.md).
+    sharded_update = zero_stage >= 2 and zero_degree > 1
     opt = paddle.optimizer.AdamW(
         learning_rate=3e-4, parameters=model.parameters(),
-        moment_dtype=("int8" if os.environ.get("PTPU_ADAM8", "")
-                      not in ("", "0") else None),
-        factored=os.environ.get("PTPU_ADAM_FACTORED", "") not in ("", "0"))
+        moment_dtype=(None if sharded_update else
+                      ("int8" if os.environ.get("PTPU_ADAM8", "")
+                       not in ("", "0") else None)),
+        factored=(not sharded_update
+                  and os.environ.get("PTPU_ADAM_FACTORED", "")
+                  not in ("", "0")))
+    if zero_stage:
+        from paddle_tpu.distributed import group_sharded_parallel
+
+        model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
 
     def train_fn(ids, labels):
         # fused chunked head+CE: full logits never materialize (models/gpt.py)
         return model.loss(ids, labels)
+
+    def make_step():
+        if zero_mesh is not None:
+            from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+            return ShardedTrainStep(model, train_fn, opt, zero_mesh)
+        return TrainStep(model, train_fn, opt)
 
     from paddle_tpu import memory as pmem
 
@@ -160,7 +202,7 @@ def run_model(model_kind, ckpt=None):
         cfg.recompute = cand.policy != "none"
         cfg.recompute_policy = cand.policy
         cfg.head_chunk = cand.head_chunk
-        s = TrainStep(model, train_fn, opt)
+        s = make_step()
         return s, (jax.ShapeDtypeStruct((cand.batch, seq), jax.numpy.int32),
                    jax.ShapeDtypeStruct((cand.batch, seq), jax.numpy.int64))
 
@@ -194,13 +236,28 @@ def run_model(model_kind, ckpt=None):
                   # another (docs/COMMS.md)
                   "PTPU_QUANT_COLLECTIVES", "PTPU_QUANT_GRADS",
                   "PTPU_COMM_BUCKET_MB", "PTPU_QUANT_MIN_NUMEL",
-                  "PTPU_QUANT_EXCLUDE", "PTPU_TP_SEAM", "PTPU_COMM_SLAB")
+                  "PTPU_QUANT_EXCLUDE", "PTPU_TP_SEAM", "PTPU_COMM_SLAB",
+                  # zero knobs change the whole step program (manual
+                  # region layout, slot shapes, gather seams) —
+                  # docs/ZERO.md
+                  "PTPU_ZERO_MODE", "PTPU_ZERO_JIT_GATHER",
+                  "PTPU_QUANT_PARAM_GATHER")
     ) + (("int8_head", F.int8_head_enabled()),)  # gate outcome, not just env
+    # ZeRO pricing record (docs/ZERO.md): the candidate programs compile
+    # ON the sharded mesh, so their memory_analysis peak is already
+    # per-device — analytic pools stay 0 and only stage/degree ride the
+    # record + plan-cache key (a stage-3 decision never replays for a
+    # stage-0 build). The analytic pools are for planning a SHARDED
+    # config from an UNSHARDED compile (memory.zero_hbm_savings).
+    zero_info = ({"stage": zero_stage, "degree": zero_degree,
+                  "param_bytes": 0, "slot_bytes": 0, "grad_bytes": 0}
+                 if zero_stage else None)
     decision = pmem.plan_train_step(
         step_factory, candidates, require_fit=require_fit,
-        act_bytes_fn=act_bytes,
+        act_bytes_fn=act_bytes, zero=zero_info,
         opt_state_bytes=opt.slot_nbytes(
-            {n: p._data for n, p in model.named_parameters()}),
+            {n: p._data for n, p in model.named_parameters()},
+            shard_degree=zero_degree if zero_stage else 1),
         cache_extra=(model_kind, cfg.vocab_size, cfg.hidden_size,
                      cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
                      cfg.intermediate_size, seq,
@@ -215,7 +272,7 @@ def run_model(model_kind, ckpt=None):
     # not fed by the AOT path). The disk cache makes every later run of
     # the same config skip planning entirely, so the cost is first-run-
     # per-config only.
-    step = TrainStep(model, train_fn, opt)
+    step = make_step()
 
     # Crash-safe checkpointing (--ckpt-dir): per-step committed saves via
     # CheckpointManager, --resume auto restore of the newest committed
@@ -363,6 +420,16 @@ def run_model(model_kind, ckpt=None):
         telemetry.snapshot(),
         parity=_coll.parity_probe(_active_mesh()))
 
+    # "zero" block (docs/ZERO.md): the ZeRO execution state of THIS run —
+    # stage/degree always recorded; when the plan engaged, the per-step
+    # gathered-bytes / reduce-scattered-bytes accounting and param-kind
+    # counts land next to "comms"/"memory". A degree-1 run records
+    # engaged=false (the honest single-chip degenerate).
+    zplan = step.zero_plan() if hasattr(step, "zero_plan") else None
+    zero_block = (zplan.zero_summary() if zplan is not None
+                  else {"engaged": False, "stage": zero_stage,
+                        "shard_degree": zero_degree})
+
     # "compile" block (docs/SCAN.md): trace/lower/compile wall seconds +
     # serialized HLO bytes of THIS run's warmup TrainStep build, with the
     # depth and scan mode that produced them — the measurement behind the
@@ -416,6 +483,9 @@ def run_model(model_kind, ckpt=None):
         # comms traffic split + parity probe (mirrors "telemetry"/
         # "memory"; contract in docs/COMMS.md, gated by bench_gate)
         "comms": comms,
+        # ZeRO execution state: stage, shard degree, gathered/rs bytes
+        # per step (docs/ZERO.md contract)
+        "zero": zero_block,
         # warmup-build compile phases + HLO program size (docs/SCAN.md)
         "compile": compile_block,
         "resilience": (dict(step_guard.summary(),

@@ -64,6 +64,15 @@ from .fused import (  # noqa: F401
     plan_tp_seams,
     tp_seam_mode,
 )
+from .zero import (  # noqa: F401
+    ZeroParam,
+    ZeroPlan,
+    build_zero_plan,
+    jit_gather_enabled,
+    param_gather_quantized,
+    resolve_stage,
+    zero_mode_enabled,
+)
 
 __all__ = [
     "quant_collectives_enabled", "grads_quantized", "manual_grad_region",
@@ -71,7 +80,8 @@ __all__ = [
     "quantized_psum", "quantized_all_reduce_rs_ag", "packed_int32_psum",
     "partition_buckets", "reduce_grads", "GradReducePlan", "GradBucket",
     "plan_tp_seams", "TPSeamPlan", "comms_summary", "parity_probe",
-    "PARITY_THRESHOLD",
+    "PARITY_THRESHOLD", "ZeroPlan", "ZeroParam", "build_zero_plan",
+    "resolve_stage", "zero_mode_enabled", "note_zero_step",
 ]
 
 
@@ -141,7 +151,8 @@ def note_quantized_bytes(op, axis, nbytes):
 
 def note_grad_reduce(plan):
     """Tick the per-step comms accounting for one executed grad-reduce
-    plan (host side; the payload sizes are static per plan)."""
+    plan (host side; the payload sizes are static per plan). Accepts
+    either a GradReducePlan or the duck-typed ZeroPlan."""
     if not _telemetry.get_registry().enabled or plan is None:
         return
     labels3 = ("grad_reduce", plan.axis_label, str(plan.nranks))
@@ -151,6 +162,49 @@ def note_grad_reduce(plan):
     if plan.quantized_payload_bytes:
         _COLL_QBYTES.inc(plan.quantized_payload_bytes,
                          labels=("grad_reduce", plan.axis_label))
+
+
+# ZeRO traffic (docs/ZERO.md, docs/TELEMETRY.md): gathered param bytes
+# and reduce-scattered grad bytes per step, on the same static-per-plan
+# host-side basis as the grad_reduce counters above. "quantized" labels
+# whether that traffic rode the int8 wire format.
+_ZERO_GATHER = _telemetry.counter(
+    "zero3_param_gather_bytes_total",
+    "full-parameter bytes materialized by ZeRO just-in-time gathers "
+    "(stage-3 dim-shard gathers + stage-2 post-update chunk gathers)",
+    labelnames=("axis", "quantized"))
+_ZERO_RS = _telemetry.counter(
+    "zero3_grad_rs_bytes_total",
+    "gradient bytes entering a ZeRO reduce-scatter (payload basis, like "
+    "collective_bytes_total)",
+    labelnames=("axis", "quantized"))
+
+
+def note_zero_step(plan):
+    """Tick the per-step ZeRO traffic accounting for one executed step
+    under an engaged ZeroPlan (no-op for GradReducePlan/None)."""
+    from .zero import ZeroPlan
+
+    if (not _telemetry.get_registry().enabled
+            or not isinstance(plan, ZeroPlan)):
+        return
+    ax = plan.shard_axis
+    # only the stage-3 dim gathers can ride the int8 wire
+    # (PTPU_QUANT_PARAM_GATHER); the stage-2 post-update chunk gathers
+    # are always exact — label them separately or the -- zero -- report
+    # would overstate int8 traffic
+    if plan.dim_gather_bytes:
+        _ZERO_GATHER.inc(plan.dim_gather_bytes,
+                         labels=(ax, "1" if plan.gather_quantized else "0"))
+    if plan.flat_gather_bytes:
+        _ZERO_GATHER.inc(plan.flat_gather_bytes, labels=(ax, "0"))
+    rs_q = sum(p.nbytes for p in plan.params
+               if p.kind == "flat" and p.quantized)
+    rs_exact = plan.grad_rs_bytes - rs_q
+    if rs_exact:
+        _ZERO_RS.inc(rs_exact, labels=(ax, "0"))
+    if rs_q:
+        _ZERO_RS.inc(rs_q, labels=(ax, "1"))
 
 
 def build_grad_reduce_plan(named_params, mesh, *, exclude_axes=(),

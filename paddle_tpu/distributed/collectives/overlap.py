@@ -93,7 +93,12 @@ class GradBucket:
 class GradReducePlan:
     """Static description of one step's dp-grad reduce, built once at
     TrainStep build time (parallel_step._build_reduce_plan): which mesh
-    axes are manual, and how the grad tree partitions into buckets."""
+    axes are manual, and how the grad tree partitions into buckets.
+
+    Under ``sharding_stage >= 2`` on a pure-data mesh the step builds a
+    :class:`~.zero.ZeroPlan` instead — it duck-types this accounting
+    surface (calls/bytes/summary) and additionally reduce-SCATTERS each
+    bucket into the dp-sharded update's layout (docs/ZERO.md)."""
     axes: tuple           # manual mesh axis names the reduce runs over
     nranks: int
     buckets: tuple        # GradBucket, issue order

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.tensor import Parameter, Tensor
-from ..jit import TrainStep, _unwrap_tensors
+from ..jit import TrainStep, _step_update_tail, _unwrap_tensors
 from .auto_parallel import (
     ProcessMesh,
     Replicate,
@@ -65,10 +65,19 @@ class ShardedTrainStep(TrainStep):
     """
 
     def __init__(self, model, train_fn, optimizer, mesh: ProcessMesh,
-                 scaler=None, shard_opt_states=False, shard_vocab_head=None):
+                 scaler=None, shard_opt_states=False, shard_vocab_head=None,
+                 sharding_stage=None):
         super().__init__(model, train_fn, optimizer, scaler)
         self.mesh = mesh
         self.shard_opt_states = shard_opt_states
+        # ZeRO stage (docs/ZERO.md): explicit arg wins, else the
+        # group_sharded_parallel level mark on the optimizer. Stage >= 2
+        # on a pure-data mesh engages the zero execution mode at build
+        # (_ensure_zero_plan): reduce-scattered grads, dp-sharded slots
+        # and update, just-in-time param gathers.
+        self.sharding_stage = sharding_stage
+        self._zero_plan = None
+        self._zero_plan_ready = False
         # vocab-sharded LM head ("last-stage-sharded pipeline output"):
         # an axis name places the tied head's vocab dim over that tp axis
         # via model.shard_lm_head, routing the loss through the
@@ -103,6 +112,15 @@ class ShardedTrainStep(TrainStep):
         self._placed = True
 
     def _slot_sharding(self, pname, p_sharding, slot_arr, param_shape):
+        plan = self._zero_plan if self._zero_plan_ready else None
+        if plan is not None:
+            zp = plan.by_name.get(pname)
+            if (zp is not None and zp.kind == "flat"
+                    and tuple(slot_arr.shape) == (zp.padded,)):
+                # zero flat layout: the padded flat slot shards evenly
+                # over the shard axis — each rank stores 1/degree
+                return NamedSharding(self.mesh.jax_mesh,
+                                     P(plan.shard_axis))
         if tuple(slot_arr.shape) == tuple(param_shape):
             if self.shard_opt_states:
                 spec = list(p_sharding.spec) + [None] * (
@@ -168,6 +186,261 @@ class ShardedTrainStep(TrainStep):
             self._opt_state = self._init_opt_state(params)
             self._place_opt_state(params)
         return self._place_batch(raw_batch)
+
+    # -- ZeRO execution mode (distributed/collectives/zero, docs/ZERO.md) --
+    def _zero_deferred(self):
+        """{param_name: stacked-attr} for StackedDecoder ``[L, ...]``
+        slabs — the params whose stage-3 gathers defer into the scan
+        body (models/gpt.py consults ``zero.active_jit_gathers``)."""
+        out = {}
+        try:
+            from ..models.gpt import _BLOCK_PARAM_FIELDS, StackedDecoder
+        except Exception:  # pragma: no cover - models optional
+            return out
+        attrs = [a for a, _ in _BLOCK_PARAM_FIELDS]
+        for prefix, layer in self.model.named_sublayers(include_self=True):
+            if isinstance(layer, StackedDecoder):
+                for attr in attrs:
+                    out[(prefix + "." if prefix else "") + attr] = attr
+        return out
+
+    def _ensure_zero_plan(self):
+        """Resolve (once, at build) whether this step runs the ZeRO
+        execution mode. None falls through to the PR 6 reduce plan /
+        GSPMD placement-hint path — which is also what
+        ``PTPU_QUANT_COLLECTIVES=0`` (pre-PR bytes) and
+        ``PTPU_ZERO_MODE=0`` force."""
+        if self._zero_plan_ready:
+            return self._zero_plan
+        self._zero_plan_ready = True
+        self._zero_plan = None
+        from ..utils.flags import get_flags
+        from .collectives import zero as _zero
+
+        stage = _zero.resolve_stage(self.optimizer, self.sharding_stage)
+        if stage < 2:
+            return None
+        if get_flags("check_nan_inf")["check_nan_inf"]:
+            # checkify cannot instrument through the manual region
+            return None
+        entries = self.model.state_dict()
+        named = []
+        for n, t in entries.items():
+            if not isinstance(t, Parameter):
+                continue
+            if t.trainable:
+                named.append((n, t))
+                continue
+            # a FROZEN param with a data-axis Shard placement would ride
+            # the zero step as a replicated "buffer" — gathered every
+            # step and written back full, silently dropping its shard
+            # residency (and pmean'd). The GSPMD hint path handles
+            # frozen shards correctly, so decline the whole mode
+            # (partial-finetune stage-3 keeps the pre-PR program).
+            da = getattr(t, "_dist_attr", None)
+            if da is not None and any(
+                    isinstance(pl, Shard)
+                    and da.process_mesh.get_dim_size(ax) > 1
+                    and ax in ("dp", "sharding")
+                    for ax, pl in zip(da.process_mesh.dim_names,
+                                      da.placements)):
+                return None
+        self._zero_plan = _zero.build_zero_plan(
+            named, self.mesh, stage, optimizer=self.optimizer,
+            grad_clip=self.optimizer._grad_clip,
+            deferred=self._zero_deferred())
+        return self._zero_plan
+
+    def zero_plan(self):
+        """The resolved ZeroPlan (None = GSPMD / PR 6 path) — the bench
+        "zero" block embeds its zero_summary()."""
+        return self._zero_plan if self._zero_plan_ready else None
+
+    def _build(self):
+        plan = self._ensure_zero_plan()
+        if plan is None:
+            return super()._build()
+        # the zero plan owns the whole step: the PR 6 reduce plan must
+        # not also engage (one manual region), and the comms accounting
+        # rides the same seam (ZeroPlan duck-types GradReducePlan)
+        self._reduce_plan = plan
+        self._reduce_plan_ready = True
+        self._build_zero(plan)
+
+    def _build_zero(self, plan):
+        """Compile the ZeRO step: one fully-manual shard_map region over
+        the data axes containing (gather params -> forward -> loss ->
+        backward -> reduce-scatter grads -> clip/guard -> SHARDED
+        optimizer update). Mirrors TrainStep._build's step semantics
+        operation for operation — the chaos seam, regularizer, global-
+        norm clip, StepHealth bundle, and guard skip-select all behave
+        identically, just on 1/degree shards (docs/ZERO.md numerics
+        contract)."""
+        import jax as _jax
+        from jax import shard_map
+
+        from .. import framework
+        from ..jit import _wrap_arrays
+        from ..utils.flags import get_flags as _gf
+        from . import collectives
+        from .collectives import zero as _zero
+        from .. import telemetry as _telemetry
+
+        model, train_fn, opt = self.model, self.train_fn, self.optimizer
+        _telemetry.record_compile(
+            self._compile_label(),
+            ("build", bool(_gf("check_nan_inf")["check_nan_inf"]), "zero",
+             plan.stage))
+        entries = model.state_dict()
+        self._param_names = [
+            n for n, t in entries.items()
+            if isinstance(t, Parameter) and t.trainable
+        ]
+        self._buffer_names = [n for n in entries
+                              if n not in self._param_names]
+        buffer_names = tuple(self._buffer_names)
+        clip = opt._grad_clip
+        reg = opt.regularization
+        axes = plan.axes
+        total = plan.nranks
+        deferred_info = {
+            p.deferred_attr: (plan.shard_axis, p.shard_dim,
+                              plan.shard_degree, plan.gather_quantized)
+            for p in plan.params if p.deferred_attr}
+
+        def make_loss_of(buffers, key_arr, batch):
+            def loss_of(params):
+                # stage-3 just-in-time gathers: non-deferred dim shards
+                # gather here (AD of the gather IS the grad reduce-
+                # scatter); deferred slabs stay shards — the scan body
+                # gathers them per layer via the jit_gather scope
+                state = {}
+                for n, p in params.items():
+                    zp = plan.by_name[n]
+                    if zp.kind == "dim" and zp.deferred_attr is None:
+                        p = _zero.gather_shard(
+                            p, plan.shard_axis, zp.shard_dim,
+                            degree=plan.shard_degree,
+                            quantized=plan.gather_quantized)
+                    state[n] = p
+                state.update(buffers)
+                with model._swap_state(state) as mutated:
+                    with framework.no_grad(), framework.rng_key_scope(key_arr):
+                        loss_t = train_fn(*_wrap_arrays(batch))
+                new_buffers = {n: mutated[n] for n in buffer_names}
+                return loss_t._data, new_buffers
+
+            return loss_of
+
+        def per_shard(params, buffers, opt_state, lr_, guard_, key_,
+                      rng_ids, shard_ids, *batch):
+            # per-shard RNG stream + ordinals ride in as sharded iotas
+            # (lax.axis_index lowers to PartitionId, rejected here)
+            key = _jax.random.fold_in(key_, rng_ids[0])
+            ordinal = shard_ids[0]
+            loss_of = make_loss_of(buffers, key, batch)
+            with _zero.jit_gather_scope(deferred_info):
+                (loss, new_buffers), grads = _jax.value_and_grad(
+                    loss_of, has_aux=True)(params)
+            loss = _jax.lax.pmean(loss, axes)
+            new_buffers = {
+                n: (_jax.lax.pmean(v, axes)
+                    if jnp.issubdtype(v.dtype, jnp.inexact) else v)
+                for n, v in new_buffers.items()}
+            grads = {n: _zero.reduce_grad(g, plan.by_name[n], plan,
+                                          ordinal, mean=True)
+                     for n, g in grads.items()}
+            upd_params = _zero.update_view(params, plan, ordinal)
+            # the ONE step tail (chaos inject -> reg -> health -> clip
+            # -> update -> guard keep-select, jit._step_update_tail):
+            # shared with the base TrainStep so PR 5 guard semantics
+            # cannot drift between zero and non-zero steps — here it
+            # runs on the shard views, with the sumsq psum'd over the
+            # shard axis (ClipGradByNorm declined the plan at build)
+            loss, new_upd, new_buffers, new_opt_state, health = \
+                _step_update_tail(
+                    opt, clip, reg, upd_params, grads, loss, new_buffers,
+                    buffers, opt_state, lr_, guard_,
+                    gsumsq_fn=lambda g: _zero.global_grad_sumsq(g, plan))
+            new_params = _zero.params_out(new_upd, plan)
+            return loss, new_params, new_buffers, new_opt_state, health
+
+        def step(params, buffers, opt_state, lr, guard, key_arr, batch):
+            def leaf_spec(arr):
+                if (hasattr(arr, "ndim") and arr.ndim >= 1
+                        and arr.shape[0] % total == 0):
+                    return P(axes)
+                return P()
+
+            batch_specs = tuple(leaf_spec(a) for a in batch)
+            pspecs = {n: (plan.by_name[n].spec
+                          if plan.by_name[n].kind == "dim" else P())
+                      for n in params}
+            bspecs = {n: P() for n in buffers}
+            nbspecs = {n: P() for n in buffer_names}
+
+            def slot_spec(n, leaf):
+                zp = plan.by_name[n]
+                if (zp.kind == "flat"
+                        and tuple(leaf.shape) == (zp.padded,)):
+                    return P(plan.shard_axis)
+                if zp.kind == "dim" and tuple(leaf.shape) == zp.shape:
+                    return zp.spec
+                return P()
+
+            sspecs = {n: {k: slot_spec(n, v) for k, v in slots.items()}
+                      for n, slots in opt_state.items()}
+            rng_ids = jnp.arange(total, dtype=jnp.int32)
+            shard_ids = jnp.arange(plan.shard_degree, dtype=jnp.int32)
+            with collectives.manual_grad_region():
+                return shard_map(
+                    per_shard, mesh=self.mesh.jax_mesh,
+                    in_specs=(pspecs, bspecs, sspecs, P(), P(), P(),
+                              P(axes), P(plan.shard_axis)) + batch_specs,
+                    out_specs=(P(), pspecs, nbspecs, sspecs, P()),
+                    check_vma=False, axis_names=set(axes),
+                )(params, buffers, opt_state, lr, guard, key_arr,
+                  rng_ids, shard_ids, *batch)
+
+        self._execs = {}
+        self._checkified = False
+        self._compiled = jax.jit(step, donate_argnums=(0, 2))
+
+    # -- zero slot layout --------------------------------------------------
+    def _functional_state(self, params):
+        """Fresh functional slots in the layout the step runs: under an
+        engaged ZeroPlan, flat-kind params get flat ``[padded]`` slots
+        (Optimizer.functional_state shard_spec) so the dp-sharded update
+        owns a contiguous chunk per rank."""
+        plan = self._ensure_zero_plan()
+        spec = None
+        if plan is not None:
+            spec = {p.name: p.padded for p in plan.params
+                    if p.kind == "flat"}
+        return self.optimizer.functional_state(params,
+                                               shard_spec=spec or None)
+
+    def _adapt_restored_slot(self, arr, tgt, pname, pshape):
+        """Flat-layout conversions for restored slots (docs/ZERO.md
+        checkpoint contract), on top of the base rules: when the target
+        is a flat ``[padded]`` dp-sharded slot, accept a same-length
+        flat slot, a param-shaped slot (flatten + zero-pad — a non-zero
+        checkpoint restoring into a zero run), or ANOTHER degree's flat
+        slot (un-pad to numel, re-pad — the elastic-restart case where
+        the padded length changed with the shard degree)."""
+        plan = self._zero_plan if self._zero_plan_ready else None
+        zp = plan.by_name.get(pname) if plan is not None else None
+        if (zp is not None and zp.kind == "flat"
+                and tuple(tgt.shape) == (zp.padded,)):
+            if tuple(arr.shape) == (zp.padded,):
+                return arr
+            flat = arr.reshape(-1)
+            if flat.size == zp.numel or (arr.ndim == 1
+                                         and flat.size >= zp.numel):
+                flat = flat[:zp.numel]
+                return jnp.pad(flat, (0, zp.padded - zp.numel))
+            return None
+        return super()._adapt_restored_slot(arr, tgt, pname, pshape)
 
     # -- quantized/bucketed dp-grad reduce (distributed/collectives) -------
     def _ensure_reduce_plan(self):
@@ -321,7 +594,19 @@ class ShardedTrainStep(TrainStep):
         if not self._placed:
             self._place_model()
         first_state = self._opt_state is None
-        if self._compiled is None:
+        from ..utils.flags import get_flags
+
+        want_check = bool(get_flags("check_nan_inf")["check_nan_inf"])
+        if self._compiled is None or want_check != getattr(
+                self, "_checkified", False):
+            if self._compiled is not None:
+                # FLAGS_check_nan_inf flipped since the last build
+                # (mirrors TrainStep._call_impl): re-resolve the plans —
+                # checkify declines both the zero mode and the PR 6
+                # reduce plan — and rebuild with/without instrumentation
+                self._zero_plan_ready = False
+                self._reduce_plan = None
+                self._reduce_plan_ready = False
             self._build()
         entries = self.model.state_dict()
         params = {n: entries[n]._data for n in self._param_names}
@@ -337,11 +622,16 @@ class ShardedTrainStep(TrainStep):
         key_arr = framework.next_rng_key()
         # no ambient mesh context needed: every input carries an explicit
         # NamedSharding, and constraints inside the program name their mesh.
-        loss, new_params, new_buffers, self._opt_state, health = \
-            self._dispatch_compiled(
-                params, buffers, self._opt_state, lr, guard_arr, key_arr,
-                raw_batch
-            )
+        out = self._dispatch_compiled(
+            params, buffers, self._opt_state, lr, guard_arr, key_arr,
+            raw_batch
+        )
+        if self._checkified:
+            # raise BEFORE adopting any output (base-step semantics):
+            # params/buffers/opt state stay at their pre-step values
+            err, out = out
+            err.throw()
+        loss, new_params, new_buffers, self._opt_state, health = out
         self._last_health = health
         for n, arr in new_params.items():
             entries[n]._data = arr
@@ -351,9 +641,10 @@ class ShardedTrainStep(TrainStep):
         # comms accounting: one tick per executed step with the plan's
         # static payload split (exact vs int8) — the counters behind the
         # bench "comms" block (docs/COMMS.md)
-        from .collectives import note_grad_reduce
+        from .collectives import note_grad_reduce, note_zero_step
 
         note_grad_reduce(self._reduce_plan)
+        note_zero_step(self._reduce_plan)
         return Tensor(loss)
 
 
@@ -362,8 +653,17 @@ class ShardedTrainStep(TrainStep):
 # dygraph_sharding_optimizer.py:54, group_sharded_stage{2,3}.py)
 # ---------------------------------------------------------------------------
 def shard_model_parameters(model, mesh: ProcessMesh, axis="sharding"):
-    """ZeRO-3: give every parameter a Shard(0) placement over `axis`
-    (falls back to the first divisible dim, else stays replicated)."""
+    """ZeRO-3: give every parameter a Shard placement over `axis` on its
+    first divisible NON-LEADING dim — falling back to dim 0, else
+    replicated.
+
+    Non-leading dims are preferred because a multi-dim parameter's
+    leading axis is the layer axis for the stacked-decoder ``[L, ...]``
+    slabs: a Shard(0) slab cannot defer its gather into the scan body
+    (each rank would scan DIFFERENT layers), so the just-in-time gather
+    path (docs/ZERO.md) needs shard_dim >= 1 — and on flagship configs
+    ``num_layers % degree == 0`` holds exactly where the JIT gathers
+    matter most. GSPMD is indifferent to the dim choice."""
     from .auto_parallel import TensorDistAttr
 
     size = mesh.get_dim_size(axis)
@@ -380,7 +680,9 @@ def shard_model_parameters(model, mesh: ProcessMesh, axis="sharding"):
         else:
             placements = [Replicate() for _ in mesh.dim_names]
         shard_dims = {pl.dim for pl in placements if isinstance(pl, Shard)}
-        for d in range(p._data.ndim):
+        ndim = p._data.ndim
+        order = (list(range(1, ndim)) + [0]) if ndim >= 2 else range(ndim)
+        for d in order:
             if d not in shard_dims and p._data.shape[d] % size == 0:
                 placements[ax_idx] = Shard(d)
                 break
@@ -394,9 +696,33 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
 
     level: "os" (stage1) | "os_g" (stage2) | "p_g_os" (stage3).
     Returns (model, optimizer, scaler) with sharding marks applied; the
-    actual partitioning happens when ShardedTrainStep places state on the
-    mesh (stage1/2 -> shard_opt_states, stage3 -> param placements).
+    actual partitioning happens when ShardedTrainStep places state on
+    the mesh — stage1 shards optimizer slots (shard_opt_states), stage
+    2/3 engage the ZeRO execution mode (reduce-scattered grads,
+    dp-sharded update, stage-3 just-in-time param gathers) when the
+    mesh qualifies, else fall back to GSPMD placements (docs/ZERO.md).
     """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(
+            f"group_sharded_parallel level={level!r}: expected 'os' "
+            "(stage 1), 'os_g' (stage 2) or 'p_g_os' (stage 3)")
+    if offload:
+        # the kwarg used to be silently ignored — pretending CPU offload
+        # happened is worse than refusing it (a planner sized for
+        # offloaded slots would OOM the chip)
+        raise NotImplementedError(
+            "group_sharded_parallel(offload=True): CPU offload of "
+            "sharded state is not implemented on this runtime. Sharded "
+            "state stays in HBM, divided by the sharding degree "
+            "(docs/ZERO.md); pass offload=False.")
+    if kwargs:
+        import warnings
+
+        warnings.warn(
+            "group_sharded_parallel: ignoring unknown kwargs "
+            f"{sorted(kwargs)} — accepted for reference-API "
+            "compatibility, but none of them alter this runtime's "
+            "sharding behavior", stacklevel=2)
     from .auto_parallel import get_mesh
 
     mesh = get_mesh()

@@ -5,14 +5,25 @@ __all__ = ["group_sharded_parallel", "save_group_sharded_model"]
 
 
 def save_group_sharded_model(model, output, optimizer=None):
-    """Save a group-sharded model (gathers full values; parity:
-    sharding/group_sharded.py save_group_sharded_model)."""
-    import os
+    """Save a group-sharded model root (parity slot:
+    sharding/group_sharded.py save_group_sharded_model).
 
-    import paddle_tpu as paddle
+    Routed through :class:`CheckpointManager` (docs/ZERO.md checkpoint
+    contract): the old path pulled FULL values through ``state_dict()``
+    on every rank and pickled them — on a stage-3 root that all-gathers
+    every sharded param/slot onto every host, world-size times. The
+    manager's sharded writer instead saves each dp-sharded param and
+    optimizer slot as per-shard boxes with global metadata (only the
+    coordinator writes metadata + COMMIT), and restores reshard-on-load
+    across topology changes. ``tools/ckpt_inspect.py`` validates the
+    resulting root; restore with
+    ``CheckpointManager(output).restore_training_state(model, opt)``.
+    """
+    from ..checkpoint.manager import CheckpointManager
 
-    os.makedirs(output, exist_ok=True)
-    paddle.save(model.state_dict(), os.path.join(output, "model.pdparams"))
-    if optimizer is not None:
-        paddle.save(optimizer.state_dict(),
-                    os.path.join(output, "model.pdopt"))
+    manager = CheckpointManager(output)
+    try:
+        manager.save_training_state(0, model, optimizer)
+    finally:
+        manager.close()
+    return output

@@ -540,6 +540,80 @@ def _functional_clip_global_norm(grads, clip_norm, gnorm=None):
     return tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
 
 
+def _step_update_tail(opt, clip, reg, params, grads, loss, new_buffers,
+                      buffers, opt_state, lr, guard, *,
+                      gsumsq_fn=_global_grad_sumsq):
+    """The post-gradient step tail — chaos injection, regularizer,
+    StepHealth bundle, grad clip, optimizer update, guard keep-select —
+    shared by ``TrainStep._build`` and the ZeRO
+    ``ShardedTrainStep._build_zero`` so the PR 5 guard semantics live in
+    ONE place (the zero step passes param/grad SHARD views and a
+    ``gsumsq_fn`` that psums the sharded leaves; everything here is
+    elementwise or scale-broadcast, so it is layout-agnostic).
+
+    Returns ``(loss, new_params, new_buffers, new_opt_state, health)``
+    with ``new_params`` in the same layout as ``params``."""
+    # chaos anomaly seam: a zero injection selects the original bytes —
+    # the select with a false predicate is the identity, so clean runs
+    # are bit-identical with or without a hook installed
+    ginj, linj = guard[1], guard[2]
+    do_g = ginj != 0.0  # nan != 0 and inf != 0 are both True
+    grads = tree_util.tree_map(
+        lambda g: jnp.where(do_g, jnp.full_like(g, ginj.astype(g.dtype)),
+                            g),
+        grads)
+    loss = jnp.where(linj != 0.0, linj.astype(loss.dtype), loss)
+    if reg is not None:
+        grads = {
+            n: reg._apply_arr(params[n], g) for n, g in grads.items()
+        }
+    # StepHealth: ONE reduction over the flattened grad tree, shared
+    # with global-norm clipping below — no second pass, no extra HBM
+    # arrays (4 scalars ride out with the step)
+    gsumsq = gsumsq_fn(grads)
+    gnorm = jnp.sqrt(gsumsq)
+    loss32 = loss.astype(jnp.float32)
+    finite = jnp.isfinite(loss32) & jnp.isfinite(gsumsq)
+    from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+    if isinstance(clip, ClipGradByGlobalNorm):
+        grads = _functional_clip_global_norm(grads, clip.clip_norm,
+                                             gnorm=gnorm)
+    elif isinstance(clip, ClipGradByValue):
+        grads = tree_util.tree_map(
+            lambda g: jnp.clip(g, clip.min, clip.max), grads
+        )
+    elif isinstance(clip, ClipGradByNorm):
+        # (the zero plan declines ClipGradByNorm at build — per-tensor
+        # norms need the full grad tensor — so this branch only runs on
+        # full layouts)
+        def _clip_one(g):
+            n = jnp.linalg.norm(g.astype(jnp.float32).reshape(-1))
+            c = jnp.asarray(clip.clip_norm, jnp.float32)
+            return (g * jnp.minimum(c / jnp.maximum(n, c), 1.0)).astype(g.dtype)
+
+        grads = tree_util.tree_map(_clip_one, grads)
+    new_params, new_opt_state = opt.functional_update(params, grads,
+                                                      opt_state, lr)
+    # in-graph skip (StepGuard): a nonfinite or above-threshold step
+    # keeps the pre-step param/slot/buffer trees. select on a true
+    # predicate returns the update bytes unchanged, and the pre-step
+    # operands are already live inside the step, so this costs no extra
+    # HBM and composes with buffer donation.
+    ok = (guard[3] == 0.0) | (finite & (loss32 <= guard[0]))
+
+    def _keep(new, old):
+        return jnp.where(ok, new, old)
+
+    new_params = tree_util.tree_map(_keep, new_params, params)
+    new_opt_state = tree_util.tree_map(_keep, new_opt_state, opt_state)
+    new_buffers = {n: _keep(new_buffers[n], buffers[n])
+                   for n in new_buffers}
+    health = jnp.stack([finite.astype(jnp.float32), gnorm, loss32,
+                        ok.astype(jnp.float32)])
+    return loss, new_params, new_buffers, new_opt_state, health
+
+
 class TrainStep:
     """Compile (forward, loss, backward, optimizer update) into one XLA program.
 
@@ -614,61 +688,9 @@ class TrainStep:
             # hide real divergence from users who never opted in).
             (loss, new_buffers), grads = self._value_and_grads(
                 make_loss_of, params, buffers, key_arr, batch)
-            # chaos anomaly seam (resilience, testing.chaos): a zero
-            # injection selects the original bytes — the select with a
-            # false predicate is the identity, so clean runs are
-            # bit-identical with or without a hook installed
-            ginj, linj = guard[1], guard[2]
-            do_g = ginj != 0.0  # nan != 0 and inf != 0 are both True
-            grads = tree_util.tree_map(
-                lambda g: jnp.where(do_g, jnp.full_like(g, ginj.astype(g.dtype)), g),
-                grads)
-            loss = jnp.where(linj != 0.0, linj.astype(loss.dtype), loss)
-            if reg is not None:
-                grads = {
-                    n: reg._apply_arr(params[n], g) for n, g in grads.items()
-                }
-            # StepHealth: ONE reduction over the flattened grad tree,
-            # shared with global-norm clipping below — no second pass,
-            # no extra HBM arrays (4 scalars ride out with the step)
-            gsumsq = _global_grad_sumsq(grads)
-            gnorm = jnp.sqrt(gsumsq)
-            loss32 = loss.astype(jnp.float32)
-            finite = jnp.isfinite(loss32) & jnp.isfinite(gsumsq)
-            from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
-
-            if isinstance(clip, ClipGradByGlobalNorm):
-                grads = _functional_clip_global_norm(grads, clip.clip_norm,
-                                                     gnorm=gnorm)
-            elif isinstance(clip, ClipGradByValue):
-                grads = tree_util.tree_map(
-                    lambda g: jnp.clip(g, clip.min, clip.max), grads
-                )
-            elif isinstance(clip, ClipGradByNorm):
-                def _clip_one(g):
-                    n = jnp.linalg.norm(g.astype(jnp.float32).reshape(-1))
-                    c = jnp.asarray(clip.clip_norm, jnp.float32)
-                    return (g * jnp.minimum(c / jnp.maximum(n, c), 1.0)).astype(g.dtype)
-
-                grads = tree_util.tree_map(_clip_one, grads)
-            new_params, new_opt_state = opt.functional_update(params, grads, opt_state, lr)
-            # in-graph skip (StepGuard): a nonfinite or above-threshold
-            # step keeps the pre-step param/slot/buffer trees. select on
-            # a true predicate returns the update bytes unchanged, and
-            # the pre-step operands are already live inside the step, so
-            # this costs no extra HBM and composes with buffer donation.
-            ok = (guard[3] == 0.0) | (finite & (loss32 <= guard[0]))
-
-            def _keep(new, old):
-                return jnp.where(ok, new, old)
-
-            new_params = tree_util.tree_map(_keep, new_params, params)
-            new_opt_state = tree_util.tree_map(_keep, new_opt_state, opt_state)
-            new_buffers = {n: _keep(new_buffers[n], buffers[n])
-                           for n in new_buffers}
-            health = jnp.stack([finite.astype(jnp.float32), gnorm, loss32,
-                                ok.astype(jnp.float32)])
-            return loss, new_params, new_buffers, new_opt_state, health
+            return _step_update_tail(opt, clip, reg, params, grads, loss,
+                                     new_buffers, buffers, opt_state, lr,
+                                     guard)
 
         from ..utils.flags import get_flags
 
@@ -888,8 +910,7 @@ class TrainStep:
         if self._opt_state is not None:
             opt_state = tree_util.tree_map(aval, self._opt_state)
         else:
-            opt_state = jax.eval_shape(self.optimizer.functional_state,
-                                       params)
+            opt_state = jax.eval_shape(self._functional_state, params)
         lr = self.optimizer.get_lr()
         guard_aval = jax.ShapeDtypeStruct((4,), jnp.float32)
         key_arr = aval(framework.next_rng_key())
@@ -919,29 +940,60 @@ class TrainStep:
         lowered program sees the same input shardings as a real step."""
         return raw_batch
 
+    def _functional_state(self, params):
+        """Layout hook: fresh functional slots for this step. The ZeRO
+        ShardedTrainStep overrides it to create flat dp-sharded slots
+        for chunk-updated params (distributed/collectives/zero)."""
+        return self.optimizer.functional_state(params)
+
     def _init_opt_state(self, params):
         """Fresh functional slots, seeded from any eager slots already on
         the optimizer — the checkpoint-restore path: set_state_dict fills
         optimizer._slots, and a resumed compiled step must continue from
         those moments, not from zeros (reference resume semantics:
         opt.set_state_dict before the next train_batch)."""
-        state = self.optimizer.functional_state(params)
+        state = self._functional_state(params)
         entries = self.model.state_dict()
         for n in self._param_names:
             slots = self.optimizer._slots.get(id(entries[n]))
             if slots:
+                pshape = tuple(entries[n]._data.shape)
                 st = dict(state[n])
                 for k, v in slots.items():
-                    if k in st:
-                        # COPY: the compiled step donates opt state
-                        # (donate_argnums) — seeding by reference would let
-                        # the first step delete the eager slot buffers and
-                        # the checkpoint arrays they share
-                        st[k] = jnp.array(
-                            v._data if isinstance(v, Tensor) else v,
-                            copy=True)
+                    if k not in st:
+                        continue
+                    arr = jnp.asarray(v._data if isinstance(v, Tensor)
+                                      else v)
+                    adapted = self._adapt_restored_slot(arr, st[k], n,
+                                                        pshape)
+                    if adapted is None:
+                        continue  # incompatible layout: keep fresh slots
+                    # COPY: the compiled step donates opt state
+                    # (donate_argnums) — seeding by reference would let
+                    # the first step delete the eager slot buffers and
+                    # the checkpoint arrays they share
+                    st[k] = jnp.array(adapted, copy=True)
                 state[n] = st
         return state
+
+    def _adapt_restored_slot(self, arr, tgt, pname, pshape):
+        """Shape-adapt one restored eager slot ``arr`` to the functional
+        target ``tgt``, or None to keep the fresh slot. The ONE place
+        the slot-layout conversion rules live (the ZeRO
+        ShardedTrainStep overrides it for the flat dp-sharded layout,
+        docs/ZERO.md checkpoint contract). Base rules: identical shapes
+        pass through; a ZeRO flat ``[padded]`` slot un-pads losslessly
+        into a param-shaped target (the flat layout is exactly
+        flatten + zero-pad)."""
+        import numpy as _np
+
+        if tuple(arr.shape) == tuple(tgt.shape):
+            return arr
+        pnumel = int(_np.prod(pshape)) if pshape else 1
+        if (arr.ndim == 1 and arr.size >= pnumel
+                and tuple(tgt.shape) == pshape):
+            return arr[:pnumel].reshape(pshape)
+        return None
 
     def sync_optimizer_state(self):
         """Push functional opt state back into the eager optimizer slots."""

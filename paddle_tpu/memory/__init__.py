@@ -34,6 +34,7 @@ from .planner import (  # noqa: F401
     plan_train_step,
     policy_coverage,
     throughput_score,
+    zero_hbm_savings,
 )
 
 __all__ = [
@@ -42,5 +43,5 @@ __all__ = [
     "int8_checkpoint", "int8_saved_nbytes", "parse_save_names",
     "Candidate", "PlanDecision", "MemoryPlanError", "plan_train_step",
     "hbm_budget_bytes", "chip_kind", "throughput_score", "policy_coverage",
-    "estimate_stacked_activation_bytes",
+    "estimate_stacked_activation_bytes", "zero_hbm_savings",
 ]

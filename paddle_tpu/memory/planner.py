@@ -91,6 +91,9 @@ class PlanDecision:
     candidates: list = dataclasses.field(default_factory=list)
     head_chunk: int | None = None
     depth: int | None = None
+    #: ZeRO pricing record (docs/ZERO.md): {"stage", "degree", analytic
+    #: byte pools, "hbm_savings_bytes"} — None when no zero info passed
+    zero: dict | None = None
 
     def as_json(self):
         """The bench JSON ``"memory"`` block (docs/MEMORY.md contract)."""
@@ -275,10 +278,35 @@ def _cache_store(path, key, decision):
         pass  # cache is an optimization; planning already succeeded
 
 
+# -- ZeRO stage pricing (docs/ZERO.md) --------------------------------------
+def zero_hbm_savings(zero):
+    """Per-device bytes a ZeRO stage frees versus the unsharded program:
+    slot state divides by the sharding degree from stage 1, gradient
+    working set from stage 2, resident params from stage 3. ``zero`` is
+    a dict {"stage", "degree", "slot_bytes", "grad_bytes",
+    "param_bytes"} — the byte pools are the ANALYTIC sizes of the
+    UNSHARDED program the planner measured; pass 0 pools when the
+    candidate programs were already compiled on the live sharded mesh
+    (their memory_analysis peak is per-device and already divided)."""
+    if not zero:
+        return 0
+    degree = int(zero.get("degree") or 1)
+    stage = int(zero.get("stage") or 0)
+    if degree <= 1 or stage < 1:
+        return 0
+    frac = 1.0 - 1.0 / degree
+    saved = int(zero.get("slot_bytes") or 0) * frac
+    if stage >= 2:
+        saved += int(zero.get("grad_bytes") or 0) * frac
+    if stage >= 3:
+        saved += int(zero.get("param_bytes") or 0) * frac
+    return int(saved)
+
+
 # -- the planner ------------------------------------------------------------
 def plan_train_step(step_factory, candidates, *, budget_bytes=None,
                     cache_path=None, cache_extra=(), act_bytes_fn=None,
-                    opt_state_bytes=None, require_fit=True):
+                    opt_state_bytes=None, require_fit=True, zero=None):
     """Pick the best (batch, policy) that fits the HBM budget.
 
     ``step_factory(candidate) -> (TrainStep, batch_avals)`` builds a step
@@ -292,6 +320,15 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
 
     ``act_bytes_fn(candidate) -> (saved, int8)`` optionally attributes
     saved-activation bytes for telemetry/the bench JSON.
+
+    ``zero`` (docs/ZERO.md): ZeRO stage pricing — slot (stage>=1), grad
+    (stage>=2) and param (stage>=3) HBM divide by the sharding degree,
+    so a candidate whose raw single-chip peak busts the budget can
+    still be ACCEPTED at stage 3 (:func:`zero_hbm_savings` is
+    subtracted from every measured peak before the fit check, and the
+    record lands in ``PlanDecision.zero``). The cache key carries the
+    stage/degree: a decision priced at stage 3 is never replayed for a
+    stage-0 build.
 
     Decisions are cached at ``cache_path`` (default
     ``~/.cache/paddle_tpu/memory_plan.json``, env ``PTPU_PLAN_CACHE``,
@@ -326,9 +363,12 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
 
     scan_mode = ("scan" if scan_layers_enabled() else "unrolled",
                  os.environ.get("PTPU_UNROLL_LAYERS", "1"))
+    savings = zero_hbm_savings(zero)
+    zero_key = (tuple(sorted((k, int(v or 0)) for k, v in zero.items()))
+                if zero else None)
     key = hashlib.sha1(repr(
         (chip, ndev, budget, tuple(cache_extra), grid, require_fit,
-         scan_mode)
+         scan_mode, zero_key)
     ).encode()).hexdigest()[:16]
 
     cpath = _cache_path(cache_path)
@@ -360,7 +400,9 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
                               "depth": getattr(cand, "depth", None),
                               "score": score, "error": str(e)[:200]})
             continue
-        fits = mem["peak_bytes"] <= budget
+        # zero pricing: the sharded stages free (1 - 1/degree) of the
+        # slot/grad/param pools versus the measured unsharded program
+        fits = mem["peak_bytes"] - savings <= budget
         _PLAN_EVALS.inc(labels=("fit" if fits else "over_budget",))
         evaluated.append({"batch": cand.batch, "policy": cand.policy,
                           "head_chunk": getattr(cand, "head_chunk", None),
@@ -384,7 +426,9 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
         fits=bool(fits), score=float(score),
         source="planner" if require_fit else "env-override",
         chip=chip, key=key, opt_state_bytes=opt_state_bytes,
-        candidates=evaluated)
+        candidates=evaluated,
+        zero=(dict(zero, hbm_savings_bytes=int(savings))
+              if zero else None))
     if act_bytes_fn is not None:
         saved, i8 = act_bytes_fn(cand)
         decision.act_saved_bytes = int(saved)

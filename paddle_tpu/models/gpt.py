@@ -759,14 +759,23 @@ def _resolve_remat(cfg):
 
 
 def _make_block(cfg, tables=None, int8_names=frozenset(), tp_seams=None,
-                policy=None):
+                policy=None, gather=None):
     """One remat-wrapped decoder block over arrays: the scan body. With
     ``cfg.recompute`` each body is a ``jax.checkpoint`` — the remat
     policy (including int8:<anchor> saves) applies PER LAYER whether the
-    stack is scanned or unrolled."""
+    stack is scanned or unrolled.
+
+    ``gather`` (ZeRO stage 3, docs/ZERO.md): a callable mapping the
+    per-layer weight tuple of SHARDS to full weights (all-gather over
+    the sharding axis). It runs INSIDE the ``jax.checkpoint`` wrapper,
+    so the remat backward re-gathers each layer's weights instead of
+    saving L full copies — the fsdp discipline that keeps resident
+    decoder HBM at 1/degree."""
     import jax
 
     def block(x, p):
+        if gather is not None:
+            p = gather(p)
         return _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
                            cfg.rope, rope_tables=tables,
                            int8_names=int8_names, tp_seams=tp_seams)
@@ -776,7 +785,7 @@ def _make_block(cfg, tables=None, int8_names=frozenset(), tp_seams=None,
     return block
 
 
-def _scan_blocks(block, x, stacked):
+def _scan_blocks(block, x, stacked, min_unroll=1):
     """Run ``block`` as a lax.scan over a [L, ...]-stacked weight tree —
     compile time and program size flat in depth."""
     import jax
@@ -788,8 +797,12 @@ def _scan_blocks(block, x, stacked):
     # per-iteration dynamic-slice of every stacked weight (a real HBM
     # copy — profiled at >20% of device ops, r4) becomes a
     # constant-offset slice XLA can alias. Costs compile time linear
-    # in N.
-    unroll = int(os.environ.get("PTPU_UNROLL_LAYERS", "1"))
+    # in N. ``min_unroll`` floors it: the ZeRO just-in-time gather path
+    # asks for >= 2 so consecutive (gather_l, block_l) pairs share one
+    # loop body and XLA's scheduler can issue layer l+1's slab gather
+    # while layer l computes (the fsdp prefetch, docs/ZERO.md).
+    unroll = max(int(os.environ.get("PTPU_UNROLL_LAYERS", "1")),
+                 int(min_unroll))
     out, _ = jax.lax.scan(step, x, tuple(stacked), unroll=max(1, unroll))
     return out
 
@@ -967,20 +980,52 @@ class StackedDecoder(nn.Layer):
                     from paddle_tpu.distributed.auto_parallel import Shard
                     from paddle_tpu.distributed import collectives
 
+                    # DATA axes are never tp axes: ZeRO stage-3 marks
+                    # (shard_model_parameters) also land Shard(dim>0)
+                    # placements over "sharding" — treating one as a
+                    # Megatron tp placement built seam specs naming the
+                    # same mesh axis twice (duplicate-axis ValueError)
                     tp_axes = [
                         a for a, pl in zip(da.process_mesh.dim_names,
                                            da.placements)
-                        if isinstance(pl, Shard) and pl.dim > 0]
+                        if isinstance(pl, Shard) and pl.dim > 0
+                        and a not in ("dp", "sharding")]
                     if len(tp_axes) == 1:
                         tp_seams = collectives.plan_tp_seams(
                             da.process_mesh, tp_axis=tp_axes[0])
 
+            # ZeRO stage-3 just-in-time slab gathers (docs/ZERO.md): the
+            # ShardedTrainStep's manual region passes the stacked
+            # weights in as their 1/degree dim shards and opens this
+            # scope; each sharded slab gathers per layer INSIDE the
+            # remat-wrapped scan body (backward re-gathers), and AD of
+            # the gather reduce-scatters the slab grads.
+            gather = None
+            if pp <= 1 and tp_seams is None:
+                from paddle_tpu.distributed.collectives import zero as _zero
+
+                info = _zero.active_jit_gathers()
+                if info:
+                    ents = tuple(info.get(attr)
+                                 for attr, _ in _BLOCK_PARAM_FIELDS)
+                    if any(e is not None for e in ents):
+                        def gather(p, _ents=ents):
+                            # per-layer slice of a dim-d-sharded slab is
+                            # sharded at d-1
+                            return tuple(
+                                w if e is None else _zero.gather_shard(
+                                    w, e[0], e[1] - 1, degree=e[2],
+                                    quantized=e[3])
+                                for w, e in zip(p, _ents))
+
             block = _make_block(cfg, tables=tables, int8_names=int8_names,
-                                tp_seams=tp_seams, policy=policy)
+                                tp_seams=tp_seams, policy=policy,
+                                gather=gather)
 
             if pp <= 1:
                 if scan_layers_enabled():
-                    return _scan_blocks(block, x, params)
+                    return _scan_blocks(block, x, params,
+                                        min_unroll=2 if gather else 1)
                 # PTPU_SCAN_LAYERS=0 escape hatch: python-unrolled loop
                 # over constant-offset slices of the stacked weights —
                 # program size linear in depth, numerics bitwise equal

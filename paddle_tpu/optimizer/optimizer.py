@@ -185,29 +185,65 @@ class Optimizer:
             self._slots[id(p)] = slots
 
     # -- functional step (jit path) ----------------------------------------
-    def functional_state(self, named_params):
-        """Initial slot pytree for a dict of name->array."""
-        return {name: self._init_slots(arr) for name, arr in named_params.items()}
+    def functional_state(self, named_params, shard_spec=None):
+        """Initial slot pytree for a dict of name->array.
 
-    def slot_nbytes(self, named_params):
+        ``shard_spec`` (ZeRO stage>=2, distributed/collectives/zero.py):
+        ``{param_name: padded_flat_len}`` — param-shaped slots for those
+        names are created FLATTENED and zero-padded to ``padded_flat_len``
+        so the dp-sharded weight update can own a contiguous 1/degree
+        chunk per rank (the flat global array then shards evenly over the
+        data axis). Scalar slots (beta-power accumulators) are left
+        untouched; value-seeded slots (master weights) flatten their
+        seeded bytes, so the shard layout never changes slot VALUES."""
+        state = {}
+        for name, arr in named_params.items():
+            slots = self._init_slots(arr)
+            padded = (shard_spec or {}).get(name)
+            if padded:
+                pshape = tuple(arr.shape)
+
+                def _flat(leaf, _p=int(padded), _shape=pshape):
+                    if (hasattr(leaf, "shape")
+                            and tuple(leaf.shape) == _shape):
+                        flat = jnp.ravel(leaf)
+                        return jnp.pad(flat, (0, _p - flat.size))
+                    return leaf
+
+                slots = {k: _flat(v) for k, v in slots.items()}
+            state[name] = slots
+        return state
+
+    def slot_nbytes(self, named_params, shard_degree=1, shard_names=None):
         """Total bytes of this optimizer's functional slot state for the
         given name->array (or name->aval) dict — what the memory planner
         charges against the HBM budget for optimizer state. Computed via
         ``eval_shape`` over ``_init_slots``: no arrays are materialized,
         so pricing a flagship config costs nothing. Factored/int8-moment
-        variants are priced exactly (their _init_slots shapes differ)."""
+        variants are priced exactly (their _init_slots shapes differ).
+
+        ``shard_degree`` > 1 prices ZeRO-sharded slots (stage>=1,
+        docs/ZERO.md): param-SHAPED slot leaves divide by the sharding
+        degree (each rank holds 1/degree of every sharded slot);
+        ``shard_names`` restricts the division to those params (None =
+        all). Scalar slots replicate and never divide."""
         import jax
 
         total = 0
-        for arr in named_params.values():
+        for name, arr in named_params.items():
             shapes = jax.eval_shape(
                 self._init_slots,
                 jax.ShapeDtypeStruct(tuple(arr.shape), jnp.dtype(arr.dtype)))
+            divide = (int(shard_degree) > 1
+                      and (shard_names is None or name in shard_names))
             for leaf in jax.tree_util.tree_leaves(shapes):
                 n = 1
                 for d in leaf.shape:
                     n *= int(d)
-                total += n * jnp.dtype(leaf.dtype).itemsize
+                nbytes = n * jnp.dtype(leaf.dtype).itemsize
+                if divide and tuple(leaf.shape) == tuple(arr.shape):
+                    nbytes = -(-nbytes // int(shard_degree))
+                total += nbytes
         return total
 
     def functional_update(self, params, grads, state, lr):

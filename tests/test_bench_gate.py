@@ -45,6 +45,41 @@ def test_new_metric_is_not_gated(tmp_path):
     assert bg.main([new, "--against", old]) == 0
 
 
+def _round_mfu(tmp_path, name, metric, value, mfu, extra=None):
+    rec = {"metric": metric, "value": value, "unit": "tokens/sec/chip",
+           "mfu": mfu}
+    rec.update(extra or {})
+    p = tmp_path / name
+    p.write_text(json.dumps({"tail": json.dumps(rec)}))
+    return str(p)
+
+
+def test_mfu_gate_fails_on_regression(tmp_path, capsys):
+    """ISSUE 10 satellite: the stage-3 config-5 line is gated on MFU
+    alongside tokens/sec (docs/ZERO.md) — a run whose tokens/sec holds
+    but whose hardware-normalised throughput collapses must fail."""
+    old = _round_mfu(tmp_path, "BENCH_r01.json",
+                     "llama7b_arch_8L_pretrain_tokens_per_sec",
+                     100.0, 0.65)
+    new = _round_mfu(tmp_path, "BENCH_r02.json",
+                     "llama7b_arch_8L_pretrain_tokens_per_sec",
+                     100.0, 0.50)
+    assert bg.main([new, "--against", old]) == 1
+    assert "MFU" in capsys.readouterr().out
+
+
+def test_mfu_gate_passes_within_threshold_and_skips_missing(tmp_path):
+    old = _round_mfu(tmp_path, "BENCH_r01.json", "m", 100.0, 0.65,
+                     extra={"zero": {"engaged": True, "stage": 3}})
+    new = _round_mfu(tmp_path, "BENCH_r02.json", "m", 100.0, 0.64)
+    assert bg.main([new, "--against", old]) == 0
+    # a record with no mfu field is not gated
+    nom = tmp_path / "BENCH_r03.json"
+    nom.write_text(json.dumps({"tail": json.dumps(
+        {"metric": "m", "value": 100.0})}))
+    assert bg.main([str(nom), "--against", old]) == 0
+
+
 def test_discovers_latest_round_in_root(tmp_path):
     _round(tmp_path, "BENCH_r01.json", {"m": 100.0})
     _round(tmp_path, "BENCH_r02.json", {"m": 99.0})   # -1%: inside 5%

@@ -154,6 +154,27 @@ def comms_violations(rec):
     return out
 
 
+def mfu_violations(rec, ref_rec, threshold):
+    """Violation strings comparing one metric's ``mfu`` field against the
+    reference round's (docs/ZERO.md satellite: the stage-3 config-5 line
+    is gated on MFU, not only tokens/sec — a sharding regression that
+    trades tokens/sec for a quietly shrunken effective batch shows up
+    here). Gated for every metric that carries mfu on both sides."""
+    new = rec.get("mfu") if isinstance(rec, dict) else None
+    old = ref_rec.get("mfu") if isinstance(ref_rec, dict) else None
+    try:
+        new, old = float(new), float(old)
+    except (TypeError, ValueError):
+        return []
+    if old <= 0:
+        return []
+    out = []
+    if new < old * (1.0 - threshold):
+        out.append(f"mfu {new} < {1.0 - threshold:.2f}x reference {old} "
+                   f"({(new / old - 1) * 100:+.1f}%)")
+    return out
+
+
 def compile_violations(rec, ref_rec, threshold=0.25):
     """Violation strings comparing one metric's "compile" block against
     the reference round's (docs/SCAN.md): total build wall time
@@ -299,6 +320,12 @@ def main(argv=None):
             for v in compile_violations(rec, ref_metrics.get(metric),
                                         args.compile_threshold):
                 print(f"  COMPILE {metric}: {v}", flush=True)
+                failed = True
+            # mfu gate (docs/ZERO.md): hardware-normalised throughput
+            # must hold alongside raw tokens/sec
+            for v in mfu_violations(rec, ref_metrics.get(metric),
+                                    args.threshold):
+                print(f"  MFU {metric}: {v}", flush=True)
                 failed = True
     return 1 if failed else 0
 

@@ -130,9 +130,30 @@ def print_comms(snap, out=sys.stdout):
           f"({qtotal / total:.1%} int8, exact={total - qtotal})\n")
 
 
+def print_zero(snap, out=sys.stdout):
+    """ZeRO traffic section (docs/ZERO.md): gathered-param bytes and
+    reduce-scattered grad bytes by (axis, int8-vs-exact)."""
+    counters = snap.get("counters") or {}
+    rows = []
+    for name, label in (("zero3_param_gather_bytes_total", "param_gather"),
+                        ("zero3_grad_rs_bytes_total", "grad_rs")):
+        for labels, v in sorted((counters.get(name) or {}).items()):
+            d = dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+            wire = "int8" if d.get("quantized") == "1" else "exact"
+            rows.append(f"  {label}@{d.get('axis', '?')} [{wire}]: "
+                        f"bytes={int(v)}")
+    if not rows:
+        return
+    w = out.write
+    w("-- zero (sharded-state traffic) --\n")
+    for r in rows:
+        w(r + "\n")
+
+
 def print_snapshot(snap, out=sys.stdout):
     w = out.write
     print_comms(snap, out)
+    print_zero(snap, out)
     for kind in ("counters", "gauges"):
         group = snap.get(kind) or {}
         if group:
