@@ -25,6 +25,7 @@ def run_model(model_kind, ckpt=None):
 
     import paddle_tpu as paddle
     import paddle_tpu.telemetry as telemetry
+    from paddle_tpu.telemetry import trace as ptrace
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
     import paddle_tpu.nn.functional as F
@@ -34,6 +35,18 @@ def run_model(model_kind, ckpt=None):
     # a BENCH_r*.json regression explains itself (docs/TELEMETRY.md)
     telemetry.enable()
     telemetry.reset()
+
+    # --trace / PTPU_TRACE=1: span tracer ON for the whole run — jit
+    # build phases, per-step dispatch with cost_analysis attrs, plan
+    # collectives, checkpoint phases — exported as Perfetto JSON + JSONL
+    # next to the run, summarized in the JSON line's "anatomy" block
+    # (docs/TELEMETRY.md Tracing section)
+    trace_on = (bool(ckpt is not None and getattr(ckpt, "trace", False))
+                or os.environ.get("PTPU_TRACE", "") not in ("", "0"))
+    trace_dir = (getattr(ckpt, "trace_dir", None) or ".") if ckpt else "."
+    if trace_on:
+        ptrace.enable()
+        ptrace.reset()
 
     if on_tpu:
         # Tuned defaults (measured on v5e; r3 sweep + r4 sweep):
@@ -356,27 +369,33 @@ def run_model(model_kind, ckpt=None):
     t_prev = t0
     gstep = start_step + 1
     while gstep <= steps:
-        if watchdog is not None:
-            watchdog.step_started(gstep)
-        if step_guard is not None:
-            out = step_guard(gstep, ids, labels)
-            accepted, next_step = out.accepted, out.next_step
-            if accepted:
-                loss = out.loss
-        else:
-            loss = step(ids, labels)
-            accepted, next_step = True, gstep + 1
-        if watchdog is not None:
-            watchdog.step_finished()
+        # the "step" span is the anatomy root: everything recorded
+        # inside (train_step/dispatch, ckpt phases, guard fetches)
+        # decomposes it in trace.step_anatomy(). A no-op when tracing
+        # is off (shared noop singleton).
+        with ptrace.span("step", attrs={"step": gstep}, cat="step"):
+            if watchdog is not None:
+                watchdog.step_started(gstep)
+            if step_guard is not None:
+                out = step_guard(gstep, ids, labels)
+                accepted, next_step = out.accepted, out.next_step
+                if accepted:
+                    loss = out.loss
+            else:
+                loss = step(ids, labels)
+                accepted, next_step = True, gstep + 1
+            if watchdog is not None:
+                watchdog.step_finished()
+            if accepted and manager is not None \
+                    and gstep % ckpt.ckpt_every == 0:
+                manager.save_training_state(gstep, model, opt,
+                                            train_step=step,
+                                            async_save=True)
         t_now = time.perf_counter()
         bench_step.observe(t_now - t_prev)
         t_prev = t_now
         if accepted:
             n_ran += 1
-            if manager is not None and gstep % ckpt.ckpt_every == 0:
-                manager.save_training_state(gstep, model, opt,
-                                            train_step=step,
-                                            async_save=True)
         # poll preemption on EVERY iteration, not only accepted ones: a
         # SIGTERM landing mid anomaly-retry storm must still commit the
         # (pre-anomaly, still-good) live state before the ladder can
@@ -446,6 +465,59 @@ def run_model(model_kind, ckpt=None):
 
     tokens_per_sec = batch * seq * max(n_ran, 1) / dt
 
+    # "anatomy" block (docs/TELEMETRY.md Tracing): the traced run's
+    # per-phase decomposition of the timed loop, the cost-analysis
+    # device estimate vs measured wall (host gap), and where the full
+    # trace files landed. {"enabled": false} without --trace.
+    anatomy = {"enabled": False}
+    if trace_on:
+        measured = dt / max(n_ran, 1)
+        anat = ptrace.step_anatomy() or {}
+        cost = (step.last_dispatch_cost()
+                if hasattr(step, "last_dispatch_cost") else None)
+        device = None
+        if cost:
+            dev = cost["device_seconds_est"]
+            host_gap = max(0.0, measured - dev)
+            placeholder = bool(cost["peak_model_placeholder"])
+            device = {
+                "flops_per_step": cost["flops"],
+                "bytes_accessed_per_step": cost["bytes_accessed"],
+                "device_seconds_est_per_step": round(dev, 6),
+                "host_gap_seconds_per_step": round(host_gap, 6),
+                # the host-overhead bench_gate input: None (not gated)
+                # when the roofline peaks are placeholders (CPU dev)
+                "host_gap_fraction": (round(host_gap / measured, 4)
+                                      if measured > 0 and not placeholder
+                                      else None),
+                # cost-analysis MFU, alongside the measured "mfu" field:
+                # program FLOPs over measured step wall over chip peak
+                # (null on placeholder peaks — a CPU number would read
+                # as a real attribution)
+                "cost_mfu": (round(cost["flops"]
+                                   / (measured * cost["peak_flops"]), 4)
+                             if measured > 0 and not placeholder
+                             else None),
+                "peak_model_placeholder": placeholder,
+            }
+        os.makedirs(trace_dir, exist_ok=True)
+        perfetto_path = os.path.join(
+            trace_dir, f"trace_{model_kind}.perfetto.json")
+        jsonl_path = os.path.join(trace_dir, f"trace_{model_kind}.jsonl")
+        ptrace.to_perfetto(perfetto_path)
+        ptrace.dump_jsonl(jsonl_path)
+        anatomy = {
+            "enabled": True,
+            "steps_timed": max(n_ran, 1),
+            "measured_step_seconds": round(measured, 6),
+            "span_step_seconds_mean": anat.get("step_seconds_mean"),
+            "phases": anat.get("phases") or {},
+            "coverage": anat.get("coverage"),
+            "device": device,
+            "trace_files": {"perfetto": perfetto_path,
+                            "jsonl": jsonl_path},
+        }
+
     # MFU: 6 * params * tokens/sec / peak_flops
     n_params = sum(int(np.prod(p.shape)) for _, p in model.named_parameters())
     model_flops = 6.0 * n_params * tokens_per_sec
@@ -488,6 +560,12 @@ def run_model(model_kind, ckpt=None):
         "zero": zero_block,
         # warmup-build compile phases + HLO program size (docs/SCAN.md)
         "compile": compile_block,
+        # step anatomy from the span tracer (--trace / PTPU_TRACE=1):
+        # per-phase seconds, device-vs-host split from cost_analysis,
+        # cost-analysis MFU next to the measured "mfu" field, and the
+        # exported trace file paths (docs/TELEMETRY.md Tracing;
+        # tools/bench_gate.py gates host_gap_fraction)
+        "anatomy": anatomy,
         "resilience": (dict(step_guard.summary(),
                             watchdog_fires=(len(watchdog.debris_files)
                                             if watchdog is not None else 0))
@@ -514,6 +592,15 @@ def main():
                     help="retention: newest N committed steps")
     ap.add_argument("--resume", choices=("auto", "none"), default="auto",
                     help="auto = restore the newest committed step")
+    ap.add_argument("--trace", action="store_true",
+                    default=os.environ.get("PTPU_TRACE", "")
+                    not in ("", "0"),
+                    help="span tracer ON for the run: Perfetto + JSONL "
+                    "trace files and an 'anatomy' block in the JSON "
+                    "line (docs/TELEMETRY.md Tracing)")
+    ap.add_argument("--trace-dir", default=".",
+                    help="where trace_<model>.perfetto.json / .jsonl "
+                    "land (default: cwd)")
     ap.add_argument("--guard", action="store_true",
                     default=os.environ.get("PTPU_BENCH_GUARD", "")
                     not in ("", "0"),

@@ -45,6 +45,8 @@ import threading
 import time
 import weakref
 
+from ...telemetry import trace as _trace
+
 
 class CheckpointValidationError(RuntimeError):
     """A step directory failed commit/checksum validation."""
@@ -311,14 +313,24 @@ class CheckpointManager:
             # to a synchronous save (still atomic + committed).
             async_save = False
         t0 = time.perf_counter()
-        plan = _prepare_save(state_dict, path,
-                             coordinator_rank=self.coordinator_rank)
+        # ckpt:snapshot = host serialization in the caller's thread (the
+        # part that stalls training); ckpt:write_commit = disk I/O +
+        # commit, on the writer thread for async saves — the span tracer
+        # is thread-aware, so both land on the right timeline row
+        with _trace.span("ckpt:snapshot",
+                         attrs={"step": step}, cat="ckpt"):
+            plan = _prepare_save(state_dict, path,
+                                 coordinator_rank=self.coordinator_rank)
         with self._inflight_lock:
             self._inflight.add(step)
 
         def _finish():
             try:
-                self._write_and_commit(step, plan)
+                with _trace.span("ckpt:write_commit",
+                                 attrs={"step": step,
+                                        "async": bool(async_save)},
+                                 cat="ckpt"):
+                    self._write_and_commit(step, plan)
                 _metrics()["save_seconds"].observe(
                     time.perf_counter() - t0,
                     labels=("async" if async_save else "sync",))
@@ -487,7 +499,9 @@ class CheckpointManager:
 
         last_err = None
         for s in candidates:
-            problems = self.validate_step(s)
+            with _trace.span("ckpt:validate", attrs={"step": s},
+                             cat="ckpt"):
+                problems = self.validate_step(s)
             if problems:
                 _metrics()["validation_failures"].inc()
                 last_err = CheckpointValidationError(s, problems)
@@ -497,7 +511,10 @@ class CheckpointManager:
             try:
                 target = (state_dict if target_factory is None
                           else target_factory(s))
-                load_state_dict(target, self.step_dir(s), strict=strict)
+                with _trace.span("ckpt:load", attrs={"step": s},
+                                 cat="ckpt"):
+                    load_state_dict(target, self.step_dir(s),
+                                    strict=strict)
             except MissingKeysError:
                 raise  # wrong state shape, not corruption: older steps
                        # would silently resurrect stale values

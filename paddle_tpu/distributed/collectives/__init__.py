@@ -38,6 +38,8 @@ from __future__ import annotations
 import contextlib
 import os
 
+import jax.numpy as jnp
+
 from ... import telemetry as _telemetry
 from .quantized import (  # noqa: F401
     QUANT_BLOCK,
@@ -149,10 +151,67 @@ def note_quantized_bytes(op, axis, nbytes):
         _COLL_QBYTES.inc(int(nbytes), labels=(op, axis))
 
 
+def _trace_reduce_collectives(plan):
+    """One trace instant per planned grad-reduce collective for this
+    executed step, labeled op/axis/bytes/quantized from the plan's
+    static summary (docs/TELEMETRY.md Tracing) — the timeline view of
+    the same accounting the counters aggregate. ZeroPlans emit through
+    ``_trace_zero_collectives`` instead (their collectives are gathers
+    and reduce-scatters, not bucket reduces)."""
+    tr = _telemetry.trace
+    buckets = getattr(plan, "buckets", None)
+    if not tr.enabled() or not buckets:
+        return
+    for i, b in enumerate(buckets):
+        tr.instant("collective:grad_reduce",
+                   {"op": "grad_reduce", "axis": plan.axis_label,
+                    "nranks": plan.nranks, "bucket": i,
+                    "bytes": int(b.payload_bytes),
+                    "quantized": bool(b.quantized)}, cat="comms")
+
+
+def _trace_zero_collectives(plan):
+    """Trace instants for one executed ZeRO step: a param-gather and/or
+    grad reduce-scatter event per parameter, labeled kind/bytes/
+    quantized from the ZeroParam recipes (docs/ZERO.md traffic basis)."""
+    tr = _telemetry.trace
+    if not tr.enabled():
+        return
+    ax = plan.shard_axis
+    for p in plan.params:
+        if p.kind == "dim":
+            tr.instant("collective:param_gather",
+                       {"op": "all_gather", "axis": ax, "param": p.name,
+                        "bytes": int(p.nbytes),
+                        "quantized": bool(plan.gather_quantized)},
+                       cat="comms")
+            tr.instant("collective:grad_rs",
+                       {"op": "reduce_scatter", "axis": ax,
+                        "param": p.name, "bytes": int(p.nbytes),
+                        "quantized": False}, cat="comms")
+        elif p.kind == "flat":
+            tr.instant("collective:grad_rs",
+                       {"op": "reduce_scatter", "axis": ax,
+                        "param": p.name, "bytes": int(p.nbytes),
+                        "quantized": bool(p.quantized)}, cat="comms")
+            tr.instant("collective:param_gather",
+                       {"op": "all_gather", "axis": ax, "param": p.name,
+                        "bytes": int(p.padded
+                                     * jnp.dtype(p.dtype).itemsize),
+                        "quantized": False}, cat="comms")
+        else:  # replicated: the exact full psum, PR 6 semantics
+            tr.instant("collective:grad_reduce",
+                       {"op": "psum", "axis": plan.axis_label,
+                        "param": p.name, "bytes": int(p.nbytes),
+                        "quantized": False}, cat="comms")
+
+
 def note_grad_reduce(plan):
     """Tick the per-step comms accounting for one executed grad-reduce
     plan (host side; the payload sizes are static per plan). Accepts
     either a GradReducePlan or the duck-typed ZeroPlan."""
+    if plan is not None:
+        _trace_reduce_collectives(plan)
     if not _telemetry.get_registry().enabled or plan is None:
         return
     labels3 = ("grad_reduce", plan.axis_label, str(plan.nranks))
@@ -185,8 +244,10 @@ def note_zero_step(plan):
     under an engaged ZeroPlan (no-op for GradReducePlan/None)."""
     from .zero import ZeroPlan
 
-    if (not _telemetry.get_registry().enabled
-            or not isinstance(plan, ZeroPlan)):
+    if not isinstance(plan, ZeroPlan):
+        return
+    _trace_zero_collectives(plan)
+    if not _telemetry.get_registry().enabled:
         return
     ax = plan.shard_axis
     # only the stage-3 dim gathers can ride the int8 wire

@@ -577,18 +577,13 @@ class ShardedTrainStep(TrainStep):
         return (loss, new_buffers), grads
 
     # -- step --------------------------------------------------------------
-    def __call__(self, *batch):
-        # same instrumentation contract as TrainStep.__call__ (docs/
-        # TELEMETRY.md train_step_seconds/train_steps_total) — the
-        # override must not drop it for exactly the multi-chip runs
-        # where step timing matters most
-        from ..jit import _TRAIN_STEP_SECONDS, _TRAIN_STEPS
-        from .. import telemetry as _telemetry
-
-        model_label = (type(self.model).__name__,)
-        _TRAIN_STEPS.inc(labels=model_label)
-        with _telemetry.timer(_TRAIN_STEP_SECONDS, labels=model_label):
-            return self._sharded_call(*batch)
+    def _call_impl(self, *batch):
+        # the base __call__ owns the per-step instrumentation
+        # (train_step_seconds/train_steps_total + the train_step trace
+        # span, docs/TELEMETRY.md) — overriding only the impl keeps it
+        # in ONE place for exactly the multi-chip runs where step
+        # timing matters most
+        return self._sharded_call(*batch)
 
     def _sharded_call(self, *batch):
         if not self._placed:

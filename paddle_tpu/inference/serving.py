@@ -46,6 +46,7 @@ from collections import deque
 import numpy as np
 
 from .. import telemetry as _telemetry
+from ..telemetry import trace as _trace
 
 __all__ = ["PagePool", "ContinuousBatchingEngine"]
 
@@ -508,12 +509,20 @@ class ContinuousBatchingEngine:
         self._waiting.append(_Request(
             rid, [int(t) for t in prompt_ids], temperature, top_k, top_p,
             on_token))
+        # request span tree (docs/TELEMETRY.md Tracing): the async
+        # "request" span covers submit → retire; "queue" covers
+        # submit → admission (re-opened on preemption requeue)
+        _trace.async_begin("request", rid,
+                           {"prompt_tokens": len(prompt_ids)})
+        _trace.async_begin("queue", rid)
         return rid
 
     def _emit(self, req, tok):
         if req.first_token_t is None:
             req.first_token_t = time.perf_counter()
             _TTFT.observe(req.first_token_t - req.submit_t)
+            _trace.async_end("prefill", req.rid)
+            _trace.async_instant("first_token", req.rid)
         req.generated.append(tok)
         if req.on_token is not None:
             req.on_token(req.rid, tok)
@@ -569,6 +578,17 @@ class ContinuousBatchingEngine:
                 self._admit_counter += 1
                 self._slots[i] = req
                 _ADMISSIONS.inc(labels=("swap_restore",))
+                _trace.async_end("queue", req.rid)
+                _trace.async_instant("admitted", req.rid,
+                                     {"kind": "swap_restore"})
+                if req.first_token_t is None:
+                    # a mid-prefill swap victim resumes its prefill
+                    # phase here — re-open the span so the restore-to-
+                    # first-token segment stays in the TTFT anatomy
+                    _trace.async_begin(
+                        "prefill", req.rid,
+                        {"kind": "swap_restore",
+                         "resume_tokens": len(req.seq_tokens)})
                 continue  # not part of any prefill group
             # reserve only what PREFILL writes (the resume prefix); decode
             # pages are allocated as the sequence grows, with preemption
@@ -609,11 +629,19 @@ class ContinuousBatchingEngine:
             self._admit_counter += 1
             self._slots[i] = req
             _ADMISSIONS.inc(labels=("prefill",))
+            _trace.async_end("queue", req.rid)
+            _trace.async_instant("admitted", req.rid, {"kind": "prefill"})
+            if req.first_token_t is None:
+                _trace.async_begin(
+                    "prefill", req.rid,
+                    {"resume_tokens": len(req.seq_tokens)})
             group.append(req)
         if not group:
             return
         if self.prefill_chunk is None:
-            first = self._prefill_group(group)
+            with _trace.span("prefill_group",
+                             attrs={"requests": len(group)}, cat="serve"):
+                first = self._prefill_group(group)
             for req, tok in zip(group, first):
                 self._emit(req, tok)
         # chunked mode: KV fills incrementally in step()
@@ -896,6 +924,11 @@ class ContinuousBatchingEngine:
         self._waiting.appendleft(r)
         self.preemptions += 1
         _PREEMPTIONS.inc(labels=(self.preempt_policy,))
+        if r.first_token_t is None:
+            _trace.async_end("prefill", r.rid, {"preempted": True})
+        _trace.async_instant("preempt", r.rid,
+                             {"policy": self.preempt_policy})
+        _trace.async_begin("queue", r.rid, {"requeue": True})
 
     def _grow_pages(self):
         """Ensure every decoding slot owns pages for this tick's token.
@@ -940,7 +973,12 @@ class ContinuousBatchingEngine:
     def _retire(self, req: _Request):
         _REQ_LATENCY.observe(time.perf_counter() - req.submit_t)
         self._release_pages(req, register=True)
-        return req.prompt + req.generated
+        with _trace.span("detokenize", attrs={"rid": req.rid},
+                         cat="serve"):
+            out = req.prompt + req.generated
+        _trace.async_end("request", req.rid,
+                         {"generated_tokens": len(req.generated)})
+        return out
 
     def step(self):
         """Admit + one batched decode tick. Returns {rid: full_ids} for
@@ -956,9 +994,11 @@ class ContinuousBatchingEngine:
                     and r.generated[-1] == self.eos)):
                 newly[r.rid] = self._retire(r)
                 self._slots[i] = None
-        self._admit()
+        with _trace.span("admission", cat="serve"):
+            self._admit()
         if self.prefill_chunk is not None:
-            self._prefill_tick()
+            with _trace.span("prefill_tick", cat="serve"):
+                self._prefill_tick()
         self._grow_pages()
         live = [(i, r) for i, r in enumerate(self._slots)
                 if r is not None and r.generated and r.length > 0]
@@ -989,10 +1029,14 @@ class ContinuousBatchingEngine:
         # static greedy/sampling mode: one retrace per mode, and the
         # default all-greedy workload never pays the vocab sort
         do_sample = any(r.temperature > 0.0 for _, r in live)
-        nxt, self.kc, self.vc = self._decode_jit(
-            self._weights, tokens, lens, tables, list(self.kc),
-            list(self.vc), temps, top_ks, top_ps, sub, do_sample)
-        nxt = np.asarray(nxt)
+        with _trace.span("decode_tick",
+                         attrs={"live": len(live)}, cat="serve"):
+            nxt, self.kc, self.vc = self._decode_jit(
+                self._weights, tokens, lens, tables, list(self.kc),
+                list(self.vc), temps, top_ks, top_ps, sub, do_sample)
+            # the host fetch is the tick's real sync point — inside the
+            # span so decode wall time includes device work
+            nxt = np.asarray(nxt)
         for j, (i, r) in enumerate(live):
             r.length += 1
             self._emit(r, int(nxt[j]))

@@ -13,6 +13,7 @@ plus CINN, with XLA doing fusion/scheduling.
 from __future__ import annotations
 
 import functools
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +52,95 @@ _HLO_PROGRAM_BYTES = _telemetry.gauge(
 #: newest per-function phase record: {label: {"trace_seconds": ..,
 #: "lower_seconds": .., "compile_seconds": .., "hlo_program_bytes": ..}}
 _LAST_COMPILE = {}
+
+
+def _device_peaks():
+    """(peak_flops, peak_bytes_per_sec, placeholder?) for device 0 —
+    the roofline denominators behind the dispatch-span cost attrs and
+    the bench anatomy's cost-analysis MFU. bf16 peak per chip / HBM
+    bandwidth from the public chip tables; unknown kinds and CPU dev
+    runs get placeholder numbers flagged as such (the host-overhead
+    bench gate only engages on non-placeholder estimates)."""
+    try:
+        d = jax.devices()[0]
+        kind = d.device_kind.lower()
+        platform = d.platform
+    except Exception:
+        return 1e12, 100e9, True
+    if platform == "cpu":
+        return 1e12, 100e9, True
+    if "v5p" in kind:
+        return 459e12, 2765e9, False
+    if "v5e" in kind or "v5 lite" in kind or "v5" == kind:
+        return 197e12, 819e9, False
+    if "v4" in kind:
+        return 275e12, 1228e9, False
+    if "v6" in kind or "trillium" in kind:
+        return 918e12, 1640e9, False
+    return 197e12, 819e9, True
+
+
+def compiled_cost_summary(compiled):
+    """``compiled.cost_analysis()`` distilled to the anatomy contract:
+    {"flops", "bytes_accessed", "device_seconds_est" (roofline:
+    max(flops/peak_flops, bytes/peak_bw)), "peak_flops",
+    "peak_bytes_per_sec", "peak_model_placeholder"} — or None when the
+    executable exposes no cost analysis (plain jit dispatch
+    fallback)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or not ca:
+        return None
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+    if flops <= 0.0 and nbytes <= 0.0:
+        return None
+    pf, pb, placeholder = _device_peaks()
+    return {
+        "flops": flops,
+        "bytes_accessed": nbytes,
+        "device_seconds_est": max(flops / pf, nbytes / pb),
+        "peak_flops": pf,
+        "peak_bytes_per_sec": pb,
+        "peak_model_placeholder": bool(placeholder),
+    }
+
+
+def _traced_dispatch(ex, label, cost, op_args):
+    """Run one compiled dispatch, recording a ``dispatch`` span with the
+    program's cost-analysis attrs when tracing is on: flops, bytes, the
+    roofline device-seconds estimate, the per-call MFU estimate
+    (flops / wall / peak) and host_gap = wall − device estimate (async
+    dispatch can legitimately clamp it to 0). Plain call when the
+    tracer is disabled — the hot path pays one attribute check."""
+    tr = _telemetry.trace
+    if not tr.enabled():
+        return ex(*op_args)
+    t0 = _time.perf_counter()
+    out = ex(*op_args)
+    dt = _time.perf_counter() - t0
+    attrs = {"function": label}
+    if cost:
+        dev = cost["device_seconds_est"]
+        attrs.update(
+            flops=cost["flops"], bytes_accessed=cost["bytes_accessed"],
+            device_seconds_est=round(dev, 6),
+            host_gap_seconds=round(max(0.0, dt - dev), 6))
+        # per-call MFU only when the wall time plausibly COVERED the
+        # device work (dt >= roofline estimate): under async dispatch
+        # the call returns in enqueue time and flops/wall would
+        # overstate MFU by orders of magnitude — exactly on the TPU
+        # runs the attr targets. Those runs read the per-STEP cost_mfu
+        # in the bench anatomy block instead.
+        if dt >= dev > 0.0 and not cost["peak_model_placeholder"]:
+            attrs["mfu_est"] = round(
+                cost["flops"] / (dt * cost["peak_flops"]), 4)
+    tr.complete("dispatch", t0, dt, attrs, cat="jit")
+    return out
 
 
 def _serialized_hlo_bytes(lowered):
@@ -92,8 +182,6 @@ def timed_lower_compile(jitfn, label, *args, **kwargs):
     """AOT trace -> lower -> compile of a ``jax.jit`` function, feeding
     the per-phase gauges. Returns the Compiled executable (same program
     jit dispatch would build — donation and shardings preserved)."""
-    import time as _time
-
     t0 = _time.perf_counter()
     traced = None
     if hasattr(jitfn, "trace"):
@@ -117,8 +205,17 @@ def timed_lower_compile(jitfn, label, *args, **kwargs):
     t2 = _time.perf_counter()
     compiled = lowered.compile()
     t3 = _time.perf_counter()
-    _record_compile_phases(label, t1 - t0, t2 - t1, t3 - t2,
-                           _serialized_hlo_bytes(lowered))
+    hlo_bytes = _serialized_hlo_bytes(lowered)
+    _record_compile_phases(label, t1 - t0, t2 - t1, t3 - t2, hlo_bytes)
+    tr = _telemetry.trace
+    if tr.enabled():
+        # the three build phases as spans so a trace shows WHERE a cold
+        # start went (compile churn shows as repeated jit:* triplets)
+        attrs = {"function": label}
+        tr.complete("jit:trace", t0, t1 - t0, dict(attrs), cat="jit")
+        tr.complete("jit:lower", t1, t2 - t1, dict(attrs), cat="jit")
+        tr.complete("jit:compile", t2, t3 - t2,
+                    dict(attrs, hlo_program_bytes=hlo_bytes), cat="jit")
     return compiled
 
 
@@ -167,6 +264,10 @@ class StaticFunction:
             self._layer = getattr(function, "__self__", None)
             self._fn = function
         self._input_spec = input_spec
+        target = self._fn if self._fn is not None else self._layer
+        # invariant per StaticFunction: computed once, not per dispatch
+        self._dispatch_label = (getattr(target, "__qualname__", None)
+                                or type(target).__name__)
         # LRU-bounded program cache: value guards key on python scalars
         # (below), so a Layer that mutates a fresh scalar every call
         # (self.calls += 1 in forward) would otherwise grow this dict
@@ -353,41 +454,43 @@ class StaticFunction:
                                 out = layer(*wa, **wk)
                     return _unwrap_tensors(out), dict(mutated)
 
-                self._compiled[key] = [jax.jit(pure), None]
+                self._compiled[key] = [jax.jit(pure), None, None]
             else:
                 def pure_fn(key_arr, args, kwargs):
                     with framework.no_grad(), framework.rng_key_scope(key_arr):
                         out = fn(*_wrap_arrays(args), **_wrap_arrays(kwargs))
                     return _unwrap_tensors(out)
 
-                self._compiled[key] = [jax.jit(pure_fn), None]
+                self._compiled[key] = [jax.jit(pure_fn), None, None]
         return self._compiled[key]
 
     def _run_slot(self, slot, *args):
-        """Run a compiled-program slot ([jit fn, executable|None]): the
-        first call builds the executable through timed_lower_compile so
-        the compile-phase gauges (trace/lower/compile seconds +
+        """Run a compiled-program slot ([jit fn, executable|None, cost]):
+        the first call builds the executable through timed_lower_compile
+        so the compile-phase gauges (trace/lower/compile seconds +
         hlo_program_bytes, labeled by function) cover to_static programs
-        too. Graph-break tracer errors propagate to __call__'s eager
-        fallback; any other AOT surprise degrades to plain jit dispatch."""
-        jitfn, ex = slot
+        too, and caches the program's cost_analysis summary for the
+        dispatch trace span. Graph-break tracer errors propagate to
+        __call__'s eager fallback; any other AOT surprise degrades to
+        plain jit dispatch."""
+        jitfn, ex = slot[0], slot[1]
+        label = self._dispatch_label
         if ex is None:
-            target = self._fn if self._fn is not None else self._layer
-            label = (getattr(target, "__qualname__", None)
-                     or type(target).__name__)
             try:
                 ex = timed_lower_compile(jitfn, label, *args)
+                slot[2] = compiled_cost_summary(ex)
             except self._GRAPH_BREAK_ERRORS:
                 raise
             except Exception:
                 ex = jitfn
             slot[1] = ex
         try:
-            return ex(*args)
+            return _traced_dispatch(ex, label, slot[2], args)
         except (TypeError, ValueError):
             if ex is jitfn:
                 raise
             slot[1] = jitfn
+            slot[2] = None
             return jitfn(*args)
 
     _GRAPH_BREAK_ERRORS = (
@@ -576,6 +679,12 @@ def _step_update_tail(opt, clip, reg, params, grads, loss, new_buffers,
     finite = jnp.isfinite(loss32) & jnp.isfinite(gsumsq)
     from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 
+    # trace-phase anatomy: this function runs under jax tracing (once
+    # per program build), so these spans decompose the jit:trace phase
+    # of a build — they never fire per executed step
+    _tr = _telemetry.trace
+    _tr_on = _tr.enabled()
+    _t_clip = _time.perf_counter() if _tr_on else 0.0
     if isinstance(clip, ClipGradByGlobalNorm):
         grads = _functional_clip_global_norm(grads, clip.clip_norm,
                                              gnorm=gnorm)
@@ -593,8 +702,16 @@ def _step_update_tail(opt, clip, reg, params, grads, loss, new_buffers,
             return (g * jnp.minimum(c / jnp.maximum(n, c), 1.0)).astype(g.dtype)
 
         grads = tree_util.tree_map(_clip_one, grads)
+    if _tr_on:
+        _t_upd = _time.perf_counter()
+        _tr.complete("trace:grad_clip", _t_clip, _t_upd - _t_clip,
+                     cat="jit")
     new_params, new_opt_state = opt.functional_update(params, grads,
                                                       opt_state, lr)
+    if _tr_on:
+        _t_guard = _time.perf_counter()
+        _tr.complete("trace:opt_update", _t_upd, _t_guard - _t_upd,
+                     cat="jit")
     # in-graph skip (StepGuard): a nonfinite or above-threshold step
     # keeps the pre-step param/slot/buffer trees. select on a true
     # predicate returns the update bytes unchanged, and the pre-step
@@ -611,6 +728,9 @@ def _step_update_tail(opt, clip, reg, params, grads, loss, new_buffers,
                    for n in new_buffers}
     health = jnp.stack([finite.astype(jnp.float32), gnorm, loss32,
                         ok.astype(jnp.float32)])
+    if _tr_on:
+        _tr.complete("trace:guard_select", _t_guard,
+                     _time.perf_counter() - _t_guard, cat="jit")
     return loss, new_params, new_buffers, new_opt_state, health
 
 
@@ -628,6 +748,8 @@ class TrainStep:
         self.optimizer = optimizer
         self._compiled = None
         self._execs = {}  # input-signature -> AOT executable (or jit fn)
+        self._exec_costs = {}  # input-signature -> cost_analysis summary
+        self._last_cost = None  # newest executable's cost summary
         self._param_names = None
         self._buffer_names = None
         self._opt_state = None
@@ -749,11 +871,16 @@ class TrainStep:
             try:
                 ex = timed_lower_compile(self._compiled,
                                          self._compile_label(), *op_args)
+                cost = compiled_cost_summary(ex)
+                self._exec_costs[key] = cost
+                if cost is not None:
+                    self._last_cost = cost
             except Exception:
                 ex = self._compiled
             self._execs[key] = ex
         try:
-            return ex(*op_args)
+            return _traced_dispatch(ex, self._compile_label(),
+                                    self._exec_costs.get(key), op_args)
         except (TypeError, ValueError):
             # AOT argument check rejected the operands BEFORE execution
             # (an aval/layout property the signature key didn't capture):
@@ -762,6 +889,7 @@ class TrainStep:
             if ex is self._compiled:
                 raise
             self._execs[key] = self._compiled
+            self._exec_costs.pop(key, None)
             return self._compiled(*op_args)
 
     def _value_and_grads(self, make_loss_of, params, buffers, key_arr,
@@ -776,10 +904,22 @@ class TrainStep:
         loss_of = make_loss_of(buffers, key_arr, batch)
         return jax.value_and_grad(loss_of, has_aux=True)(params)
 
+    def last_dispatch_cost(self):
+        """cost_analysis summary of the newest compiled step executable
+        (compiled_cost_summary shape), or None before the first build /
+        when the program exposes no cost analysis — the bench anatomy
+        block's device-side estimate."""
+        return self._last_cost
+
     def __call__(self, *batch):
         model_label = (type(self.model).__name__,)
         _TRAIN_STEPS.inc(labels=model_label)
         with _telemetry.timer(_TRAIN_STEP_SECONDS, labels=model_label):
+            tr = _telemetry.trace
+            if tr.enabled():
+                with tr.span("train_step",
+                             attrs={"model": model_label[0]}, cat="step"):
+                    return self._call_impl(*batch)
             return self._call_impl(*batch)
 
     def _call_impl(self, *batch):

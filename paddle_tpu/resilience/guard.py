@@ -165,7 +165,12 @@ class StepGuard:
             loss = self.train_step(*batch)
         finally:
             self.train_step._guard_threshold = None
-        health = self.train_step.last_health  # the one extra device fetch
+        # the one extra device fetch — under tracing it gets its own
+        # span, because under async dispatch this is where a guarded
+        # loop actually blocks on the device
+        with _telemetry.trace.span("guard:health_fetch",
+                                   attrs={"step": step}, cat="step"):
+            health = self.train_step.last_health
         if health.ok:
             self._consecutive = 0
             # accepted progress proves the last rewind target CURED its
@@ -195,6 +200,8 @@ class StepGuard:
 
         kind = health.kind
         _ANOMALIES.inc(labels=(kind,))
+        _telemetry.trace.instant("guard:anomaly",
+                                 {"step": step, "kind": kind}, cat="step")
         self.anomalies[kind] = self.anomalies.get(kind, 0) + 1
         self._consecutive += 1
         if self._consecutive < self.max_consecutive:

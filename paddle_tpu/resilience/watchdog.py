@@ -180,6 +180,12 @@ class HangWatchdog:
             "ts": time.time(),
             "pid": os.getpid(),
             "threads": thread_stacks(),
+            # each thread's LIVE span stack (telemetry.trace): with
+            # tracing on, the debris names the exact phase the step
+            # wedged in ("train_step > dispatch") instead of leaving it
+            # to be reverse-engineered from interpreter stacks; {} when
+            # the tracer is off or nothing is open
+            "trace_spans": _telemetry.trace.live_spans(),
             "telemetry": _telemetry.snapshot(),
         }
         os.makedirs(self.debris_dir, exist_ok=True)

@@ -38,6 +38,7 @@ from .registry import (  # noqa: F401
     DEFAULT_MAX_SERIES,
 )
 from . import export as _export
+from . import trace  # noqa: F401  (span tracer: telemetry.trace.span(...))
 from .watchdog import (  # noqa: F401
     RecompileWarning,
     RecompileWatchdog,
@@ -49,11 +50,14 @@ __all__ = [
     "export_prometheus", "dump_jsonl", "load_jsonl",
     "counter", "gauge", "histogram", "timer",
     "get_registry", "recompile_watchdog", "record_compile",
-    "RecompileWarning", "MetricRegistry",
+    "RecompileWarning", "MetricRegistry", "trace",
 ]
 
 _REGISTRY = MetricRegistry()
 _WATCHDOG = RecompileWatchdog(_REGISTRY)
+# span durations mirror into trace_span_seconds{span} when BOTH the
+# tracer and the registry are enabled (docs/TELEMETRY.md Tracing)
+trace.get_tracer().bind_registry(_REGISTRY)
 
 
 def get_registry() -> MetricRegistry:

@@ -15,6 +15,14 @@ def _escape_label_value(v):
             .replace("\n", "\\n"))
 
 
+def _escape_help(text):
+    """HELP-line escaping per the exposition format: backslash and
+    newline only (no quote escaping — HELP text is not quoted). A help
+    string containing a literal newline would otherwise split into a
+    second, unparseable exposition line."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labelnames, labels, extra=()):
     pairs = [f'{k}="{_escape_label_value(v)}"'
              for k, v in zip(labelnames, labels)]
@@ -34,7 +42,7 @@ def export_prometheus(registry) -> str:
         if not series:
             continue
         if m.help:
-            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         for labels, value in sorted(series.items()):
             if m.kind == "histogram":
@@ -57,13 +65,30 @@ def export_prometheus(registry) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+#: keys dump_jsonl owns on every line — a caller tag must not be able to
+#: silently clobber them (a run tagged extra={"value": "r06"} would
+#: corrupt every counter in the file undetectably)
+_RESERVED_JSONL_KEYS = frozenset(
+    {"ts", "metric", "kind", "labels", "value", "buckets",
+     "count", "sum", "min", "max", "mean", "p50", "p95", "p99"})
+
+
 def dump_jsonl(registry, path, mode="a", extra=None) -> int:
     """Append one JSON line per live series to `path`.
 
     Line shape: {"ts", "metric", "kind", "labels": {name: value}, and
     either "value" (counter/gauge) or the histogram stats dict}. Returns
     the number of lines written. `extra` (a dict) is merged into every
-    line — callers tag runs (bench round, step number) that way."""
+    line — callers tag runs (bench round, step number) that way; a tag
+    colliding with a reserved record key raises ValueError instead of
+    silently overwriting it."""
+    if extra:
+        bad = sorted(_RESERVED_JSONL_KEYS & set(extra))
+        if bad:
+            raise ValueError(
+                f"dump_jsonl: extra keys {bad} collide with reserved "
+                "record fields — rename the tags (e.g. prefix them: "
+                f"{', '.join('tag_' + b for b in bad)})")
     ts = time.time()
     n = 0
     with open(path, mode) as f:

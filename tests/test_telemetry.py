@@ -124,6 +124,42 @@ def test_prometheus_export_format():
     assert "t_prom_seconds_count 2" in text
 
 
+def test_prometheus_help_lines_escaped():
+    """ISSUE 11 satellite: HELP text escapes backslash and newline per
+    the exposition format — a multi-line help string must not split
+    into an unparseable second exposition line."""
+    c = telemetry.counter(
+        "t_help_esc_total",
+        "first line\nsecond line with a back\\slash")
+    c.inc()
+    text = telemetry.export_prometheus()
+    assert ("# HELP t_help_esc_total first line\\nsecond line with a "
+            "back\\\\slash") in text
+    # no raw newline leaked mid-help: the help's second half must not
+    # start an exposition line of its own
+    assert not any(line.startswith("second line")
+                   for line in text.splitlines())
+
+
+def test_dump_jsonl_rejects_reserved_extra_keys(tmp_path):
+    """ISSUE 11 satellite: a caller tag must not silently clobber the
+    record's own fields (extra={"value": ...} would corrupt every
+    counter line undetectably)."""
+    c = telemetry.counter("t_extra_clash_total")
+    c.inc()
+    path = str(tmp_path / "m.jsonl")
+    with pytest.raises(ValueError, match="metric.*value"):
+        telemetry.dump_jsonl(path, extra={"value": "r06", "metric": "x"})
+    with pytest.raises(ValueError, match="p99"):
+        telemetry.dump_jsonl(path, extra={"p99": 1.0})
+    # nothing was written by the rejected calls
+    import os
+    assert not os.path.exists(path)
+    # non-colliding tags still ride every line
+    assert telemetry.dump_jsonl(path, extra={"bench_round": 6}) >= 1
+    assert all(r["bench_round"] == 6 for r in telemetry.load_jsonl(path))
+
+
 def test_jsonl_export_round_trip(tmp_path):
     c = telemetry.counter("t_jsonl_total", labelnames=("op",))
     c.inc(3, labels=("add",))

@@ -154,6 +154,31 @@ def comms_violations(rec):
     return out
 
 
+def host_overhead_violations(rec, threshold=0.25):
+    """Violation strings from one bench record's "anatomy" block: a
+    traced run whose host gap (measured step wall − cost-analysis
+    device estimate) exceeds ``threshold`` as a fraction of step time
+    is dispatch-bound, not device-bound — the step got slower for a
+    reason no kernel profile will show (docs/TELEMETRY.md Tracing).
+    Reference-free, like the comms parity gate. Runs without --trace
+    ({"enabled": false}) and runs whose roofline peaks are placeholders
+    (host_gap_fraction null, e.g. CPU dev) are not gated."""
+    anat = rec.get("anatomy") if isinstance(rec, dict) else None
+    if not isinstance(anat, dict) or not anat.get("enabled"):
+        return []
+    frac = (anat.get("device") or {}).get("host_gap_fraction")
+    if frac is None:
+        return []
+    out = []
+    if float(frac) > float(threshold):
+        gap = (anat.get("device") or {}).get("host_gap_seconds_per_step")
+        out.append(
+            f"host gap {float(frac):.1%} of step time > threshold "
+            f"{float(threshold):.0%}"
+            + (f" ({gap}s/step)" if gap is not None else ""))
+    return out
+
+
 def mfu_violations(rec, ref_rec, threshold):
     """Violation strings comparing one metric's ``mfu`` field against the
     reference round's (docs/ZERO.md satellite: the stage-3 config-5 line
@@ -253,6 +278,10 @@ def main(argv=None):
     ap.add_argument("--compile-threshold", type=float, default=0.25,
                     help="allowed fractional compile-time increase at "
                     "the same depth/scan mode (default 0.25; docs/SCAN.md)")
+    ap.add_argument("--host-threshold", type=float, default=0.25,
+                    help="allowed host-gap fraction of step time for "
+                    "traced runs carrying an 'anatomy' block (default "
+                    "0.25; docs/TELEMETRY.md Tracing)")
     ap.add_argument("--root", default=os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))),
         help="repo root for BENCH_r*.json discovery")
@@ -278,6 +307,22 @@ def main(argv=None):
                 refs = []  # the resilience gate below still applies
             else:
                 refs = [rounds[-1]]
+                # metric continuity: a gap round recorded on different
+                # hardware (e.g. a CPU-only container, BENCH_r06) lacks
+                # the tracked metrics — walking back to the NEWEST
+                # earlier round carrying each candidate metric keeps
+                # the next real round gated instead of every tracked
+                # metric reporting "NEW (not gated)" across the gap
+                covered = set(load_metrics(rounds[-1]))
+                want = set(load_metrics(candidate))
+                for r in reversed(rounds[:-1]):
+                    missing = want - covered
+                    if not missing:
+                        break
+                    have = set(load_metrics(r))
+                    if have & missing:
+                        refs.append(r)
+                        covered |= have
 
     new_metrics = load_metrics(candidate)
     if not new_metrics:
@@ -296,6 +341,11 @@ def main(argv=None):
         # candidate run alone
         for v in comms_violations(rec):
             print(f"  COMMS {metric}: {v}", flush=True)
+            failed = True
+        # host-overhead gate (reference-free): a traced round must stay
+        # device-bound at the same metric
+        for v in host_overhead_violations(rec, args.host_threshold):
+            print(f"  HOST  {metric}: {v}", flush=True)
             failed = True
     for ref_path in refs:
         ref_metrics = load_metrics(ref_path)

@@ -111,11 +111,11 @@ def _comms_rows(snap):
     return rows
 
 
-def print_comms(snap, out=sys.stdout):
+def print_comms(snap, out=None):
     rows = _comms_rows(snap)
     if not rows:
         return
-    w = out.write
+    w = (out or sys.stdout).write
     w("-- comms (exact vs int8 traffic split) --\n")
     total = sum(r.get("bytes", 0) for r in rows.values())
     qtotal = sum(r.get("q8_bytes", 0) for r in rows.values())
@@ -130,7 +130,7 @@ def print_comms(snap, out=sys.stdout):
           f"({qtotal / total:.1%} int8, exact={total - qtotal})\n")
 
 
-def print_zero(snap, out=sys.stdout):
+def print_zero(snap, out=None):
     """ZeRO traffic section (docs/ZERO.md): gathered-param bytes and
     reduce-scattered grad bytes by (axis, int8-vs-exact)."""
     counters = snap.get("counters") or {}
@@ -144,14 +144,40 @@ def print_zero(snap, out=sys.stdout):
                         f"bytes={int(v)}")
     if not rows:
         return
-    w = out.write
+    w = (out or sys.stdout).write
     w("-- zero (sharded-state traffic) --\n")
     for r in rows:
         w(r + "\n")
 
 
-def print_snapshot(snap, out=sys.stdout):
+def print_trace(snap, out=None):
+    """Span-tracer section (docs/TELEMETRY.md Tracing): the
+    ``trace_span_seconds`` histogram family mirrors every completed
+    span's wall time by name while both the tracer and the registry are
+    enabled — this is the aggregate view; the timeline lives in the
+    trace files (tools/trace_report.py)."""
+    series = (snap.get("histograms") or {}).get("trace_span_seconds") or {}
+    if not series:
+        return
+    w = (out or sys.stdout).write
+    w("-- trace (span wall seconds by name) --\n")
+
+    def _span_name(labels):
+        d = dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+        return d.get("span", labels or "?")
+
+    rows = sorted(series.items(), key=lambda kv: -float(kv[1].get("sum",
+                                                                  0.0)))
+    for labels, h in rows:
+        w(f"  {_span_name(labels)}: n={h['count']} "
+          f"total={h.get('sum', 0.0):.6f}s mean={h['mean']:.6f}s "
+          f"p99={h['p99']:.6f}\n")
+
+
+def print_snapshot(snap, out=None):
+    out = out or sys.stdout
     w = out.write
+    print_trace(snap, out)
     print_comms(snap, out)
     print_zero(snap, out)
     for kind in ("counters", "gauges"):
@@ -184,11 +210,12 @@ def _num(v):
         return 0.0
 
 
-def diff_snapshots(old, new, top=15, out=sys.stdout):
+def diff_snapshots(old, new, top=15, out=None):
     """Rank series by regression: histogram relative mean growth and
     counter relative growth. Series absent from the old snapshot rank at
     0 (flagged "new series") so they cannot crowd real regressions out
     of the top-N window."""
+    out = out or sys.stdout
     rows = []
     old_h = old.get("histograms") or {}
     for name, series in (new.get("histograms") or {}).items():
