@@ -17,6 +17,40 @@ import time
 import numpy as np
 
 
+def _serving_smoke_block():
+    """Compact fleet-serving soak for the bench JSON (--serve): replica
+    cold start (warmup compile, gated vs the previous round by
+    bench_gate's COLD gate at the same scan mode) plus a 1-vs-2 replica
+    goodput ratio and p99 TTFT vs a 10x-p50 budget (SERVE gate). The
+    heavy 1..N sweep lives in tools/serve_bench.py (docs/SERVING.md);
+    this block keeps the serving numbers tracked round over round next
+    to the training metrics."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.fleet import build_workload, soak_block
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=128,
+                      dropout=0.0)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    workload = build_workload(48, 200.0, (6, 10, 14), cfg.vocab_size,
+                              seed=1)
+    engine_kw = dict(max_slots=4, page_size=8, max_seq_len=64,
+                     max_new_tokens=8, prefill_chunk=8)
+    base = soak_block(model, replicas=1, workload=workload,
+                      engine_kw=engine_kw)
+    p50 = (base.get("ttft") or {}).get("p50")
+    block = soak_block(model, replicas=2, workload=workload,
+                       engine_kw=engine_kw, baseline=base,
+                       ttft_budget=(10.0 * p50 if p50 else None))
+    block["single"] = {"goodput_tokens_per_sec":
+                       base.get("goodput_tokens_per_sec"),
+                       "cold_start_seconds":
+                       base.get("cold_start_seconds")}
+    return block
+
+
 def run_model(model_kind, ckpt=None):
     import jax
 
@@ -518,6 +552,14 @@ def run_model(model_kind, ckpt=None):
                             "jsonl": jsonl_path},
         }
 
+    # fleet-serving smoke soak (--serve / PTPU_BENCH_SERVE=1): only on
+    # the headline (non-llama) line so the driver pays one soak per run
+    serving = {"enabled": False}
+    serve_on = (bool(ckpt is not None and getattr(ckpt, "serve", False))
+                or os.environ.get("PTPU_BENCH_SERVE", "") not in ("", "0"))
+    if serve_on and model_kind != "llama":
+        serving = _serving_smoke_block()
+
     # MFU: 6 * params * tokens/sec / peak_flops
     n_params = sum(int(np.prod(p.shape)) for _, p in model.named_parameters())
     model_flops = 6.0 * n_params * tokens_per_sec
@@ -560,6 +602,10 @@ def run_model(model_kind, ckpt=None):
         "zero": zero_block,
         # warmup-build compile phases + HLO program size (docs/SCAN.md)
         "compile": compile_block,
+        # fleet-serving smoke soak (--serve; docs/SERVING.md): replica
+        # cold start + goodput scaling + p99 TTFT vs budget, gated by
+        # bench_gate's SERVE/COLD gates
+        "serving": serving,
         # step anatomy from the span tracer (--trace / PTPU_TRACE=1):
         # per-phase seconds, device-vs-host split from cost_analysis,
         # cost-analysis MFU next to the measured "mfu" field, and the
@@ -601,6 +647,12 @@ def main():
     ap.add_argument("--trace-dir", default=".",
                     help="where trace_<model>.perfetto.json / .jsonl "
                     "land (default: cwd)")
+    ap.add_argument("--serve", action="store_true",
+                    default=os.environ.get("PTPU_BENCH_SERVE", "")
+                    not in ("", "0"),
+                    help="attach a fleet-serving smoke soak block "
+                    "(replica cold start, goodput scaling, p99 TTFT) "
+                    "to the headline JSON line (docs/SERVING.md)")
     ap.add_argument("--guard", action="store_true",
                     default=os.environ.get("PTPU_BENCH_GUARD", "")
                     not in ("", "0"),

@@ -405,12 +405,14 @@ class XpuConfig:
             setattr(self, k, v)
 
 
-from .serving import ContinuousBatchingEngine, PagePool  # noqa: E402
+from .serving import (ContinuousBatchingEngine, PagePool,  # noqa: E402
+                      int8_kv_enabled)
+from . import fleet  # noqa: E402
 
 __all__ = [
     "Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
     "DataType", "create_predictor", "get_version",
-    "ContinuousBatchingEngine", "PagePool",
+    "ContinuousBatchingEngine", "PagePool", "int8_kv_enabled", "fleet",
     "get_num_bytes_of_data_type", "get_trt_compile_version",
     "get_trt_runtime_version", "convert_to_mixed_precision",
     "PredictorPool", "XpuConfig", "_get_phi_kernel_name",

@@ -1,0 +1,31 @@
+"""paddle_tpu.inference.fleet — fleet-scale serving (docs/SERVING.md).
+
+The single-process ContinuousBatchingEngine becomes a production
+topology:
+
+- :mod:`.router` — ``FleetRouter``: N replicas behind pluggable
+  admission policies (round-robin / least-loaded on live telemetry /
+  prefix-affinity), per-replica backpressure, and requeue-on-death.
+- :mod:`.disagg` — ``DisaggregatedEngine``: prefill and decode split
+  onto separate workers with an explicit, bitwise KV handoff seam.
+- :mod:`.spec_decode` — ``DraftRunner``: draft-model speculative
+  decoding through the engine (draft K, verify in one target forward,
+  accept-prefix; greedy output bitwise-identical to plain decode).
+- :mod:`.soak` — the Poisson soak harness behind
+  ``tools/serve_bench.py`` and the bench_gate serving gates.
+
+The int8 paged-KV mode lives in the engine itself
+(``inference.serving``, ``PTPU_INT8_KV``); it composes with every
+topology here because the page payload format is invisible to routing,
+handoff, and verification.
+"""
+from .disagg import DisaggregatedEngine  # noqa: F401
+from .router import POLICIES, FleetRouter, ReplicaHandle, make_replicas  # noqa: F401
+from .soak import build_workload, fleet_soak, run_soak, soak_block  # noqa: F401
+from .spec_decode import DraftRunner  # noqa: F401
+
+__all__ = [
+    "FleetRouter", "ReplicaHandle", "POLICIES", "make_replicas",
+    "DisaggregatedEngine", "DraftRunner", "build_workload", "run_soak",
+    "fleet_soak", "soak_block",
+]

@@ -1,0 +1,329 @@
+"""Admission router: N engine replicas behind one submit() surface.
+
+Production traffic needs more than one continuous-batching engine; this
+router owns the fleet topology (docs/SERVING.md):
+
+- **Pluggable dispatch policies.** ``round_robin`` (the baseline),
+  ``least_loaded`` (scores replicas on the live queue-depth/slot/KV
+  telemetry ``ContinuousBatchingEngine.load()`` exposes — the PR 11
+  signals, read synchronously), and ``prefix_affinity`` (routes a
+  request to the replica whose prefix cache already holds the longest
+  prefix of its prompt — ``prefix_match_pages()`` — falling back to
+  least-loaded on a miss). Ties break deterministically on the lowest
+  replica index, so routing is reproducible.
+- **Backpressure.** Each replica accepts at most ``max_queue_depth``
+  waiting requests; overflow stays in the router's own pending queue
+  and is re-scored every tick (late binding: a request dispatches to
+  whichever replica is best when capacity appears, not when it arrived).
+- **Health + requeue-on-death.** A replica whose ``step()`` raises is
+  marked dead; every request it held (queued, running, or swapped) is
+  resubmitted through the policy to the survivors with the SAME request
+  id — at-least-once semantics, and greedy outputs are deterministic so
+  the replay is invisible to the caller. Generated-so-far tokens are
+  recomputed from the original prompt (the dead replica's KV is gone).
+
+Request ids are globally unique across the fleet (each replica gets a
+disjoint ``rid_base`` space and the router passes explicit rids), so
+the per-request trace trees (docs/TELEMETRY.md Tracing) — including the
+router's ``route`` span — reassemble per request, never colliding
+across replicas.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ... import telemetry as _telemetry
+from ...telemetry import trace as _trace
+
+__all__ = ["FleetRouter", "ReplicaHandle", "POLICIES"]
+
+_DISPATCH = _telemetry.counter(
+    "fleet_dispatch_total", "requests dispatched to a replica",
+    labelnames=("policy", "replica"))
+_REQUEUES = _telemetry.counter(
+    "fleet_requeues_total",
+    "requests recovered from a dead replica and resubmitted")
+_DEATHS = _telemetry.counter(
+    "fleet_replica_deaths_total", "replicas marked unhealthy")
+_PENDING = _telemetry.gauge(
+    "fleet_pending_depth", "requests held in the router (backpressure)")
+_HEALTHY = _telemetry.gauge(
+    "fleet_replicas_healthy", "replicas currently serving")
+
+#: rid spacing between replicas — disjoint id spaces for trace trees
+RID_STRIDE = 1_000_000
+
+
+def _load_score(handle):
+    """Lower is better: waiting requests weigh full, occupied slots
+    partial (they drain one token per tick), low KV headroom penalizes."""
+    load = handle.engine.load()
+    return (load["queue_depth"] + 0.5 * load["occupied_slots"]
+            + (1.0 - load["kv_free_fraction"]))
+
+
+def _policy_round_robin(router, prompt, candidates):
+    idx = candidates[router._rr_cursor % len(candidates)]
+    router._rr_cursor += 1
+    return idx
+
+
+def _policy_least_loaded(router, prompt, candidates):
+    return min(candidates,
+               key=lambda i: (_load_score(router.replicas[i]), i))
+
+
+def _policy_prefix_affinity(router, prompt, candidates):
+    """Most cached prefix pages wins; zero-hit prompts fall back to
+    least-loaded (which also breaks exact ties)."""
+    hits = {i: router.replicas[i].engine.prefix_match_pages(prompt)
+            for i in candidates}
+    best = max(hits.values())
+    if best <= 0:
+        return _policy_least_loaded(router, prompt, candidates)
+    front = [i for i in candidates if hits[i] == best]
+    return min(front, key=lambda i: (_load_score(router.replicas[i]), i))
+
+
+POLICIES = {
+    "round_robin": _policy_round_robin,
+    "least_loaded": _policy_least_loaded,
+    "prefix_affinity": _policy_prefix_affinity,
+}
+
+
+class ReplicaHandle:
+    """One replica's router-side state: health, dispatch bookkeeping,
+    and the accumulated busy-time the soak's simulated-parallel clock
+    uses (replicas run concurrently in deployment; in-process they tick
+    sequentially, so wall time is NOT the fleet critical path)."""
+
+    __slots__ = ("idx", "engine", "healthy", "dispatched", "steps",
+                 "busy_seconds", "death_reason")
+
+    def __init__(self, idx, engine):
+        self.idx = idx
+        self.engine = engine
+        self.healthy = True
+        self.dispatched = 0
+        self.steps = 0
+        self.busy_seconds = 0.0
+        self.death_reason = None
+
+
+class FleetRouter:
+    """Dispatch requests across replicas; tick the whole fleet per
+    ``step()``. ``engines`` is a list of ContinuousBatchingEngine (or
+    anything matching its fleet surface: submit/step/cancel/load/
+    prefix_match_pages/cancelled, e.g. fleet.DisaggregatedEngine)."""
+
+    def __init__(self, engines, policy="least_loaded",
+                 max_queue_depth=None):
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        if callable(policy):
+            self._policy_name = getattr(policy, "__name__", "custom")
+            self._policy = policy
+        else:
+            if policy not in POLICIES:
+                raise ValueError(
+                    f"unknown policy {policy!r}; one of {sorted(POLICIES)}")
+            self._policy_name = policy
+            self._policy = POLICIES[policy]
+        self.replicas = [ReplicaHandle(i, e) for i, e in enumerate(engines)]
+        # backpressure cap per replica: its slots plus one refill wave
+        self.max_queue_depth = (max_queue_depth
+                                if max_queue_depth is not None
+                                else 2 * max(e.max_slots for e in engines))
+        self._pending = deque()      # (rid, prompt, kwargs) awaiting dispatch
+        self._inflight = {}          # rid -> (replica idx, prompt, kwargs)
+        self._next_rid = 0
+        self._rr_cursor = 0
+        self._delivered = {}         # rid -> tokens streamed to the client
+        self.cancelled = {}          # rid -> reason (merged fleet view)
+        self.requeues = 0
+
+    # -- submit / cancel ----------------------------------------------------
+    def submit(self, prompt_ids, **kwargs) -> int:
+        """Mint a fleet-wide rid, open its ``route`` span, and dispatch
+        (or hold under backpressure — dispatch retries every step). A
+        ``deadline_seconds`` is stamped to an absolute point NOW, at
+        router submit: time spent queued under backpressure counts
+        against the deadline (the engine otherwise restarts the clock
+        at dispatch, silently extending it)."""
+        rid = self._next_rid
+        self._next_rid += 1
+        prompt = [int(t) for t in prompt_ids]
+        kwargs = dict(kwargs)
+        if kwargs.get("deadline_seconds") is not None:
+            kwargs["_deadline_at"] = (time.perf_counter()
+                                      + float(kwargs.pop("deadline_seconds")))
+        if kwargs.get("on_token") is not None:
+            # count delivered tokens so a dead-replica replay can skip
+            # the already-streamed prefix: the streaming contract stays
+            # exactly-once for greedy requests (the replayed prefix is
+            # bitwise the delivered one; sampled replays may diverge
+            # and are documented at-least-once)
+            self._delivered[rid] = 0
+            kwargs["_on_token"] = kwargs.pop("on_token")
+        _trace.async_begin("route", rid, {"policy": self._policy_name})
+        self._pending.append((rid, prompt, kwargs))
+        self._dispatch_pending()
+        return rid
+
+    def cancel(self, rid, reason="user") -> bool:
+        for i, (prid, _p, _kw) in enumerate(self._pending):
+            if prid == rid:
+                del self._pending[i]
+                self.cancelled[rid] = reason
+                # no engine ever saw this rid: only the route span is
+                # open (no "request" span to close)
+                _trace.async_end("route", rid, {"cancelled": reason})
+                return True
+        entry = self._inflight.get(rid)
+        if entry is None:
+            return False
+        handle = self.replicas[entry[0]]
+        if handle.engine.cancel(rid, reason=reason):
+            self._inflight.pop(rid, None)
+            self.cancelled[rid] = reason
+            return True
+        return False
+
+    # -- dispatch -----------------------------------------------------------
+    def _candidates(self):
+        return [h.idx for h in self.replicas
+                if h.healthy
+                and h.engine.load()["queue_depth"] < self.max_queue_depth]
+
+    def _dispatch_pending(self):
+        while self._pending:
+            cands = self._candidates()
+            if not cands:
+                return               # backpressure: hold in the router
+            rid, prompt, kwargs = self._pending[0]
+            idx = self._policy(self, prompt, cands)
+            handle = self.replicas[idx]
+            self._pending.popleft()
+            kw = dict(kwargs)
+            at = kw.pop("_deadline_at", None)
+            if at is not None:
+                # remaining budget at dispatch; <= 0 cancels on the
+                # replica's first tick (the request is already late)
+                kw["deadline_seconds"] = at - time.perf_counter()
+            cb = kw.pop("_on_token", None)
+            if cb is not None:
+                # suppress the first `skip` tokens of THIS dispatch's
+                # stream: a dead-replica replay regenerates from
+                # scratch, and the client already received that prefix
+                skip = self._delivered.get(rid, 0)
+                state = {"seen": 0}
+
+                def on_token(r, t, _cb=cb, _skip=skip, _state=state):
+                    _state["seen"] += 1
+                    if _state["seen"] > _skip:
+                        self._delivered[r] = self._delivered.get(r, 0) + 1
+                        _cb(r, t)
+
+                kw["on_token"] = on_token
+            handle.engine.submit(prompt, rid=rid, **kw)
+            handle.dispatched += 1
+            self._inflight[rid] = (idx, prompt, kwargs)
+            _DISPATCH.inc(labels=(self._policy_name, str(idx)))
+            _trace.async_end("route", rid, {"replica": idx})
+
+    # -- fleet tick ---------------------------------------------------------
+    def _on_death(self, handle, exc):
+        """Mark a replica dead and requeue everything it held. The
+        engine's internal state is untrusted after an arbitrary failure;
+        requests replay from their original prompts."""
+        handle.healthy = False
+        handle.death_reason = repr(exc)
+        _DEATHS.inc()
+        lost = [rid for rid, (idx, _p, _kw) in self._inflight.items()
+                if idx == handle.idx]
+        for rid in lost:
+            _idx, prompt, kwargs = self._inflight.pop(rid)
+            self.requeues += 1
+            _REQUEUES.inc()
+            _trace.async_instant("requeue", rid,
+                                 {"dead_replica": handle.idx})
+            _trace.async_begin("route", rid,
+                               {"policy": self._policy_name,
+                                "requeue": True})
+            self._pending.append((rid, prompt, kwargs))
+        if not any(h.healthy for h in self.replicas):
+            raise RuntimeError(
+                "FleetRouter: every replica is dead "
+                f"(last failure: {handle.death_reason})") from exc
+
+    def step(self):
+        """Dispatch pending work, tick every healthy replica, collect
+        completions/cancellations, recover from replica deaths.
+        Returns {rid: full token ids} finishing this fleet tick."""
+        self._dispatch_pending()
+        done = {}
+        for handle in self.replicas:
+            if not handle.healthy:
+                continue
+            t0 = time.perf_counter()
+            try:
+                out = handle.engine.step()
+            except Exception as exc:  # noqa: BLE001 — any failure = death
+                self._on_death(handle, exc)
+                continue
+            handle.busy_seconds += time.perf_counter() - t0
+            handle.steps += 1
+            for rid, ids in out.items():
+                self._inflight.pop(rid, None)
+                self._delivered.pop(rid, None)
+                done[rid] = ids
+            eng_cancelled = getattr(handle.engine, "cancelled", None)
+            if eng_cancelled:
+                for rid, reason in list(eng_cancelled.items()):
+                    eng_cancelled.pop(rid)
+                    self._inflight.pop(rid, None)
+                    self._delivered.pop(rid, None)
+                    self.cancelled[rid] = reason
+        self._dispatch_pending()     # freed slots admit the next wave
+        if _telemetry.get_registry().enabled:
+            _PENDING.set(len(self._pending))
+            _HEALTHY.set(sum(1 for h in self.replicas if h.healthy))
+        return done
+
+    def drained(self):
+        if self._pending or self._inflight:
+            return False
+        return all(not h.healthy or (
+            h.engine.load()["queue_depth"] == 0
+            and h.engine.load()["occupied_slots"] == 0)
+            for h in self.replicas)
+
+    def run_until_complete(self, max_ticks=100000):
+        done = {}
+        for _ in range(max_ticks):
+            done.update(self.step())
+            if self.drained():
+                return done
+        raise TimeoutError("fleet did not drain")
+
+    def load(self):
+        """Aggregate fleet load (what a front-end LB would scrape)."""
+        per = [dict(h.engine.load(), replica=h.idx, healthy=h.healthy,
+                    dispatched=h.dispatched)
+               for h in self.replicas]
+        return {"pending": len(self._pending),
+                "inflight": len(self._inflight),
+                "replicas": per}
+
+
+def make_replicas(model_factory, n, rid_stride=RID_STRIDE, **engine_kw):
+    """Build n engines with disjoint rid spaces. ``model_factory`` is
+    called once per replica (each replica owns its weights in a real
+    deployment; passing a shared model is fine for in-process tests)."""
+    from ..serving import ContinuousBatchingEngine
+
+    return [ContinuousBatchingEngine(model_factory(i),
+                                     rid_base=i * rid_stride, **engine_kw)
+            for i in range(n)]

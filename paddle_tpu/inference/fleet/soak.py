@@ -1,0 +1,258 @@
+"""Serving soak harness: Poisson arrivals, mixed prompts, N replicas.
+
+Drives synthetic traffic through an engine, a DisaggregatedEngine, or a
+FleetRouter and reduces the run to the ``"serving"`` JSON block that
+``tools/serve_bench.py`` emits and ``tools/bench_gate.py`` gates
+(docs/SERVING.md soak recipe).
+
+**Simulated-parallel clock.** In deployment each replica is its own
+mesh; in this process they tick sequentially on one host. Wall time
+would therefore show ~1x scaling no matter how good the router is, so
+the soak advances a simulated clock instead: each fleet tick costs
+``max`` over the replicas' measured step times (they would run
+concurrently) plus the router's own host time (it is serial). Goodput
+and TTFT percentiles are computed on that clock; ``wall_seconds`` is
+also reported so nothing hides. A single-replica run's simulated clock
+equals its wall clock, making ``goodput_x_single`` an honest scaling
+ratio. The block records ``"simulated_parallel": true`` whenever more
+than one replica contributed.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import numpy as np
+
+__all__ = ["build_workload", "run_soak", "percentile", "fleet_soak",
+           "soak_block"]
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile of an ascending list (None when empty)."""
+    if not sorted_vals:
+        return None
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def build_workload(n_requests, arrival_rate, prompt_lens, vocab_size,
+                   shared_prefix=0, sampled_fraction=0.0,
+                   deadline_seconds=None, seed=0):
+    """Synthetic request list [(arrival_time, prompt, kwargs)] sorted by
+    arrival: Poisson arrivals at ``arrival_rate`` req/sec (simulated
+    seconds), prompt lengths drawn from ``prompt_lens``, an optional
+    shared system prefix (the prefix-affinity workload), an optional
+    sampled-request fraction, and optional per-request deadlines."""
+    rng = np.random.default_rng(seed)
+    prefix = [int(t) for t in rng.integers(1, vocab_size, shared_prefix)]
+    t = 0.0
+    out = []
+    for _ in range(n_requests):
+        t += float(rng.exponential(1.0 / arrival_rate))
+        n = int(rng.choice(prompt_lens))
+        tail_n = max(1, n - shared_prefix)
+        prompt = prefix + [int(x) for x in
+                           rng.integers(1, vocab_size, tail_n)]
+        kw = {}
+        if sampled_fraction and rng.random() < sampled_fraction:
+            kw.update(temperature=0.7, top_k=8, top_p=0.95)
+        if deadline_seconds is not None:
+            kw["deadline_seconds"] = deadline_seconds
+        out.append((t, prompt, kw))
+    return out
+
+
+def _spec_stats(eng):
+    if getattr(eng, "spec_draft_tokens", 0):
+        return {"ticks": eng.spec_ticks,
+                "drafted": eng.spec_draft_tokens,
+                "accepted": eng.spec_accepted_tokens,
+                "acceptance_rate": round(eng.spec_acceptance_rate, 4)}
+    return None
+
+
+def _engine_stats(eng):
+    """Per-engine counters, transparent to DisaggregatedEngine."""
+    if hasattr(eng, "prefill") and hasattr(eng, "decode"):
+        p, d = eng.prefill, eng.decode
+        return {"disaggregated": True,
+                "preemptions": p.preemptions + d.preemptions,
+                "prefix_hit_pages": p.prefix_cache_hits,
+                "cancellations": p.cancellations + d.cancellations,
+                "handoffs": eng.handoffs,
+                "handoff_bytes": eng.handoff_bytes,
+                "int8_kv": d.int8_kv,
+                "spec": _spec_stats(d)}
+    return {"disaggregated": False,
+            "preemptions": eng.preemptions,
+            "prefix_hit_pages": eng.prefix_cache_hits,
+            "cancellations": eng.cancellations,
+            "handoffs": 0, "handoff_bytes": 0,
+            "int8_kv": eng.int8_kv,
+            "spec": _spec_stats(eng)}
+
+
+def run_soak(target, workload, warmup=True, max_ticks=200000):
+    """Drive ``workload`` through ``target`` (engine / disagg /
+    FleetRouter) and return the raw soak stats dict. Cold start
+    (construction is the caller's; compile is ours via ``warmup()``) is
+    measured per engine and reported as the max across replicas — in
+    deployment replicas spin up concurrently."""
+    router = hasattr(target, "replicas")
+    engines = ([h.engine for h in target.replicas] if router
+               else [target])
+    cold = []
+    if warmup:
+        for e in engines:
+            cold.append(e.warmup())
+    n_requests = len(workload)
+    pending = deque(sorted(workload, key=lambda w: w[0]))
+    arrival = {}
+    plen = {}
+    first_seen = {}
+    ttfts = []
+    sim_t = 0.0
+    done = {}
+    wall0 = time.perf_counter()
+
+    def on_token(rid, tok):
+        first_seen.setdefault(rid, None)
+
+    for _tick in range(max_ticks):
+        # admit every arrival the simulated clock has reached; when the
+        # fleet is fully idle, jump the clock to the next arrival
+        # instead of spinning empty ticks
+        n_cancelled = len(getattr(target, "cancelled", {}) or {})
+        if pending and len(done) + n_cancelled >= len(arrival):
+            sim_t = max(sim_t, pending[0][0])
+        while pending and pending[0][0] <= sim_t:
+            arr, prompt, kw = pending.popleft()
+            rid = target.submit(prompt, on_token=on_token, **kw)
+            arrival[rid] = arr
+            plen[rid] = len(prompt)
+        before_first = set(first_seen)
+        if router:
+            busy0 = [h.busy_seconds for h in target.replicas]
+            t0 = time.perf_counter()
+            out = target.step()
+            wall = time.perf_counter() - t0
+            deltas = [h.busy_seconds - b
+                      for h, b in zip(target.replicas, busy0)]
+            # replicas tick in parallel in deployment; router host work
+            # is serial on top
+            cost = (max(deltas) if deltas else 0.0) + max(
+                0.0, wall - sum(deltas))
+        else:
+            t0 = time.perf_counter()
+            out = target.step()
+            cost = time.perf_counter() - t0
+        sim_t += cost
+        for rid in set(first_seen) - before_first:
+            if rid in arrival:
+                ttfts.append(sim_t - arrival[rid])
+        done.update(out)
+        cancelled = dict(getattr(target, "cancelled", {}) or {})
+        if not pending and len(done) + len(cancelled) >= n_requests:
+            break
+    else:
+        raise TimeoutError("soak did not drain")
+    wall_seconds = time.perf_counter() - wall0
+    cancelled = dict(getattr(target, "cancelled", {}) or {})
+    # goodput counts GENERATED tokens only (completions return
+    # prompt+generated; the prompt was the caller's)
+    gen_tokens = sum(max(0, len(ids) - plen.get(rid, 0))
+                     for rid, ids in done.items())
+    ttfts.sort()
+    per_engine = [_engine_stats(e) for e in engines]
+    stats = {
+        "requests": n_requests,
+        "completed": len(done),
+        "cancelled": len(cancelled),
+        "replicas": len(engines),
+        "generated_tokens": gen_tokens,
+        "sim_seconds": round(sim_t, 6),
+        "wall_seconds": round(wall_seconds, 6),
+        "simulated_parallel": len(engines) > 1,
+        "goodput_tokens_per_sec": (round(gen_tokens / sim_t, 2)
+                                   if sim_t > 0 else None),
+        "ttft": {
+            "count": len(ttfts),
+            "p50": percentile(ttfts, 0.50),
+            "p95": percentile(ttfts, 0.95),
+            "p99": percentile(ttfts, 0.99),
+            "mean": (sum(ttfts) / len(ttfts)) if ttfts else None,
+        },
+        "cold_start_seconds": (round(max(cold), 4) if cold else None),
+        "cold_start_seconds_total": (round(sum(cold), 4) if cold
+                                     else None),
+        "engines": per_engine,
+    }
+    if router:
+        stats["router"] = {
+            "policy": target._policy_name,
+            "dispatched": [h.dispatched for h in target.replicas],
+            "deaths": sum(1 for h in target.replicas if not h.healthy),
+            "requeues": target.requeues,
+        }
+    return stats, done
+
+
+def fleet_soak(model, n_replicas, workload, *, policy="least_loaded",
+               disagg=False, draft_model=None, engine_kw=None,
+               disagg_kw=None, max_ticks=200000):
+    """Build ``n_replicas`` engines (or disaggregated pairs) over
+    ``model``, route them (FleetRouter when n>1), drive ``workload``,
+    return the soak stats. One entry point for tools/serve_bench.py and
+    ``bench.py --serve``."""
+    from ..serving import ContinuousBatchingEngine
+    from .disagg import DisaggregatedEngine
+    from .router import RID_STRIDE, FleetRouter
+
+    engine_kw = dict(engine_kw or {})
+    engines = []
+    for i in range(n_replicas):
+        if disagg:
+            engines.append(DisaggregatedEngine(
+                model, rid_base=i * RID_STRIDE, draft_model=draft_model,
+                **dict(disagg_kw or {}), **engine_kw))
+        else:
+            engines.append(ContinuousBatchingEngine(
+                model, rid_base=i * RID_STRIDE, draft_model=draft_model,
+                **engine_kw))
+    target = (engines[0] if n_replicas == 1
+              else FleetRouter(engines, policy=policy))
+    return run_soak(target, workload, max_ticks=max_ticks)
+
+
+def soak_block(model, *, replicas, workload, policy="least_loaded",
+               disagg=False, draft_model=None, engine_kw=None,
+               disagg_kw=None, baseline=None, scaling_target=None,
+               ttft_budget=None):
+    """One gateable ``"serving"`` JSON block (docs/SERVING.md contract):
+    the soak stats plus the gate fields — ``p99_ttft_seconds`` vs
+    ``p99_ttft_budget``, ``goodput_x_single`` vs ``scaling_target``
+    (both gates engage only when their bound is present), the replica
+    ``cold_start_seconds`` (gated vs the previous round at the same
+    scan mode, like the compile gate), and the scan mode itself.
+    ``baseline`` is a prior single-replica block to scale against."""
+    from ...models.gpt import scan_layers_enabled
+
+    stats, _done = fleet_soak(
+        model, replicas, workload, policy=policy, disagg=disagg,
+        draft_model=draft_model, engine_kw=engine_kw, disagg_kw=disagg_kw)
+    block = dict(stats)
+    block["enabled"] = True
+    block["policy"] = policy if replicas > 1 else None
+    block["scan_layers"] = scan_layers_enabled()
+    block["p99_ttft_seconds"] = stats["ttft"]["p99"]
+    if baseline is not None:
+        base_gp = baseline.get("goodput_tokens_per_sec")
+        if base_gp and block.get("goodput_tokens_per_sec"):
+            block["goodput_x_single"] = round(
+                block["goodput_tokens_per_sec"] / base_gp, 3)
+    if scaling_target is not None:
+        block["scaling_target"] = float(scaling_target)
+    if ttft_budget is not None:
+        block["p99_ttft_budget"] = float(ttft_budget)
+    return block

@@ -40,7 +40,9 @@ Works with the GPT/LLaMA stacked-weights families (anything exposing
 from __future__ import annotations
 
 import math
+import os
 import time
+import warnings
 from collections import deque
 
 import numpy as np
@@ -48,7 +50,7 @@ import numpy as np
 from .. import telemetry as _telemetry
 from ..telemetry import trace as _trace
 
-__all__ = ["PagePool", "ContinuousBatchingEngine"]
+__all__ = ["PagePool", "ContinuousBatchingEngine", "int8_kv_enabled"]
 
 # serving metrics (names/labels contract: docs/TELEMETRY.md). Gauges are
 # refreshed once per step(); counters tick at the event sites.
@@ -77,6 +79,225 @@ _TTFT = _telemetry.histogram(
 _REF_UNDERFLOWS = _telemetry.counter(
     "serving_page_ref_underflows_total",
     "KV page refcount decremented below zero (double-release bug)")
+_CANCELLATIONS = _telemetry.counter(
+    "serving_cancellations_total",
+    "requests cancelled before completion (docs/SERVING.md)",
+    labelnames=("reason",))
+_SPEC_TICKS = _telemetry.counter(
+    "serving_spec_ticks_total",
+    "decode ticks under a draft model: 'spec' ran draft+verify, "
+    "'fallback' took the plain single-token path (sampled rows live)",
+    labelnames=("mode",))
+_SPEC_DRAFTED = _telemetry.counter(
+    "serving_spec_draft_tokens_total",
+    "draft tokens proposed to the verifier")
+_SPEC_ACCEPTED = _telemetry.counter(
+    "serving_spec_accepted_tokens_total",
+    "draft tokens accepted by the target verify pass")
+_INT8_KV = _telemetry.gauge(
+    "serving_int8_kv_active",
+    "1 when the engine stores paged KV as blockwise int8 (+fp32 "
+    "per-row scales in the page table) — docs/SERVING.md")
+
+
+# ---------------------------------------------------------------- int8 KV
+#: relative round-trip error the int8-KV parity probe tolerates
+#: (PTPU_INT8_KV_TOL overrides). Row-absmax int8 holds ~1/254 of the
+#: row range per element; 2% is an order of magnitude of headroom, so a
+#: probe failure means the quantizer itself drifted, not noise.
+KV_QUANT_TOL = 0.02
+
+
+def _int8_kv_probe_ok():
+    """Numeric parity probe over the REAL paged-KV quantization path
+    (memory.quantize_rows_int8 / dequantize_rows_int8) on a skewed
+    tensor with outlier rows — the int8-LM-head gate discipline: the
+    probe exercises the same code every int8 cache write runs, so a
+    monkeypatched/broken quantizer fails the gate instead of serving
+    drifted KV."""
+    import jax.numpy as jnp
+
+    from ..memory import dequantize_rows_int8, quantize_rows_int8
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 64)).astype(np.float32)
+    x[0] *= 1e3        # large-magnitude row
+    x[1] *= 1e-3       # tiny row (scale epsilon path)
+    x[2, 5] = 400.0    # in-row outlier (worst case for absmax grids)
+    q, s = quantize_rows_int8(jnp.asarray(x))
+    rt = np.asarray(dequantize_rows_int8(q, s))
+    absmax = np.maximum(np.abs(x).max(-1, keepdims=True), 1e-12)
+    err = float(np.max(np.abs(rt - x) / absmax))
+    tol = float(os.environ.get("PTPU_INT8_KV_TOL", KV_QUANT_TOL))
+    return err <= tol
+
+
+def int8_kv_enabled(requested=False):
+    """Resolve the int8 paged-KV mode (docs/SERVING.md numerics
+    contract). ``PTPU_INT8_KV`` forces: ``0`` is the exact escape hatch
+    (bf16/f32 pages, bitwise the pre-int8 engine), ``1`` forces int8 on.
+    Unset: the mode engages only when the constructor ``requested`` it
+    AND the parity probe passes — a drifting quantizer defaults the
+    engine OFF (loudly) instead of serving approximate KV."""
+    env = os.environ.get("PTPU_INT8_KV", "").strip().lower()
+    if env != "":
+        return env not in ("0", "off", "false")
+    if not requested:
+        return False
+    if _int8_kv_probe_ok():
+        return True
+    warnings.warn(
+        "int8_kv requested but the paged-KV quantization parity probe "
+        "FAILED its round-trip tolerance — serving with exact "
+        f"(non-quantized) KV instead (tol {KV_QUANT_TOL}, "
+        "PTPU_INT8_KV=1 forces; docs/SERVING.md)")
+    return False
+
+
+# ------------------------------------------------------- KV cache helpers
+# A cache is ONE stacked array [L, Hkv, num_pages+1, page, D] (exact
+# mode) or a (codes int8 [L, Hkv, num_pages+1, page, D],
+# scales f32 [L, Hkv, num_pages+1, page, 1]) pair (int8 mode) — the
+# fp32 per-row scales ride NEXT TO the page payload, addressed by the
+# same page table. The helpers below are tuple-aware so every cache
+# consumer (decode, chunked prefill, swap, handoff) is written once.
+
+def _kv_map(fn, c):
+    return tuple(fn(x) for x in c) if isinstance(c, tuple) else fn(c)
+
+
+def _kv_map2(fn, a, b):
+    if isinstance(a, tuple):
+        return tuple(fn(x, y) for x, y in zip(a, b))
+    return fn(a, b)
+
+
+def _kv_index(c, li):
+    """Per-layer view of a stacked cache (basic int index, axis 0)."""
+    return _kv_map(lambda x: x[li], c)
+
+
+def _kv_stack(per_layer):
+    """Inverse of _kv_index over a list of per-layer caches."""
+    import jax.numpy as jnp
+
+    if isinstance(per_layer[0], tuple):
+        return tuple(jnp.stack([p[i] for p in per_layer])
+                     for i in range(len(per_layer[0])))
+    return jnp.stack(per_layer)
+
+
+def _kv_write(cache_l, pages, offs, vals):
+    """Scatter token rows into a PER-LAYER cache: ``pages``/``offs``
+    index arrays (any matching shape S*), ``vals`` [Hkv, *S, D] at the
+    compute dtype. int8 caches quantize each row (one fp32 scale per
+    head_dim row — the block the page table addresses) at the write."""
+    if isinstance(cache_l, tuple):
+        from ..memory import quantize_rows_int8
+
+        q, s = cache_l
+        qv, sv = quantize_rows_int8(vals)
+        return (q.at[:, pages, offs, :].set(qv),
+                s.at[:, pages, offs, :].set(sv))
+    return cache_l.at[:, pages, offs, :].set(vals.astype(cache_l.dtype))
+
+
+def _kv_write_layer(cache, li, pages, offs, vals):
+    """`_kv_write` against ONE layer of a stacked cache (the eager
+    group-prefill path, which walks layers python-side). NOTE the
+    scalar ``li`` is itself an advanced index: with the Hkv slice
+    separating it from ``pages``/``offs``, the broadcast advanced dims
+    move to the FRONT, so the update payload is [N, Hkv, D]."""
+    import jax.numpy as jnp
+
+    vals = jnp.swapaxes(vals, 0, 1)                   # [N, Hkv, D]
+    if isinstance(cache, tuple):
+        from ..memory import quantize_rows_int8
+
+        q, s = cache
+        qv, sv = quantize_rows_int8(vals)
+        return (q.at[li, :, pages, offs, :].set(qv),
+                s.at[li, :, pages, offs, :].set(sv))
+    return cache.at[li, :, pages, offs, :].set(vals.astype(cache.dtype))
+
+
+def _kv_gather_rows(cache_l, idx, dtype):
+    """Gather pages by id from a PER-LAYER cache -> values at the
+    engine's logical ``dtype``. int8 caches dequantize (codes * scales)
+    on the way out; exact caches return their storage as-is."""
+    import jax.numpy as jnp
+
+    if isinstance(cache_l, tuple):
+        q, s = cache_l
+        return (q[:, idx].astype(jnp.float32) * s[:, idx]).astype(dtype)
+    return cache_l[:, idx]
+
+
+def _kv_nbytes(c):
+    leaves = c if isinstance(c, tuple) else (c,)
+    return sum(int(np.asarray(x).nbytes if not hasattr(x, "nbytes")
+                   else x.nbytes) for x in leaves)
+
+
+_DECODE_WEIGHT_NAMES = ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg",
+                        "wu", "wd")
+
+
+def _run_layer_stack(scan_layers, layers, x, layer_fn, kc, vc):
+    """THE scan-or-unrolled walker over a [L, ...]-stacked weight tuple
+    plus cache slabs: ``layer_fn(lp, x, kc_l, vc_l) -> (x, kc_l, vc_l)``.
+    Shared by the engine's decode/prefill programs AND the spec-decode
+    DraftRunner, so the scan carry/ys shape discipline cannot drift
+    between target and draft. Scanned: compile flat in depth (the
+    replica cold-start win); unrolled (``PTPU_SCAN_LAYERS=0``): bitwise
+    identical, compile linear in depth."""
+    import jax
+
+    if scan_layers:
+        def step(carry, per):
+            lp, kc_l, vc_l = per
+            x2, kl, vl = layer_fn(lp, carry, kc_l, vc_l)
+            return x2, (kl, vl)
+
+        x, (kc, vc) = jax.lax.scan(step, x, (layers, kc, vc))
+        return x, kc, vc
+    kls, vls = [], []
+    for li in range(layers[0].shape[0]):
+        x, kl, vl = layer_fn(tuple(w[li] for w in layers), x,
+                             _kv_index(kc, li), _kv_index(vc, li))
+        kls.append(kl)
+        vls.append(vl)
+    return x, _kv_stack(kls), _kv_stack(vls)
+
+
+def _pack_weights_stacked(model):
+    """Decode weight tree: {"layers": 9x [L, ...] stacked arrays,
+    "embed", "fnorm", "head"} — shared by the engine and the spec-decode
+    DraftRunner so target and draft numerics come off one packer."""
+    import jax.numpy as jnp
+
+    core = model.model if hasattr(model, "model") else model
+    head = getattr(model, "lm_head", None)
+    L = model.config.num_layers
+    dec = getattr(model, "decoder", None)
+    if dec is not None and all(
+            getattr(getattr(dec, n, None), "_data", None) is not None
+            and getattr(dec, n)._data.shape[0] == L
+            for n in _DECODE_WEIGHT_NAMES):
+        # natively-stacked family (GPTForCausalLMPipe): reference, don't
+        # copy — a live-engine reload is free of the sliced-copy peak
+        layers = tuple(getattr(dec, n)._data for n in _DECODE_WEIGHT_NAMES)
+    else:
+        params = model._decode_params()
+        layers = tuple(
+            jnp.stack([params[li][n]._data for li in range(L)])
+            for n in _DECODE_WEIGHT_NAMES)
+    return {
+        "layers": layers,
+        "embed": core.embed_tokens.weight._data,
+        "fnorm": core.final_norm.weight._data,
+        "head": head.weight._data if head is not None else None,
+    }
 
 
 class PagePool:
@@ -104,10 +325,10 @@ class _Request:
     __slots__ = ("rid", "prompt", "generated", "length", "pages",
                  "temperature", "top_k", "top_p", "on_token",
                  "prefill_pos", "seq_tokens", "admit_seq", "swapped",
-                 "submit_t", "first_token_t")
+                 "submit_t", "first_token_t", "deadline")
 
     def __init__(self, rid, prompt, temperature=0.0, top_k=0, top_p=1.0,
-                 on_token=None):
+                 on_token=None, deadline=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.generated = []
@@ -126,6 +347,7 @@ class _Request:
         self.swapped = None      # host-side KV snapshot (swap policy)
         self.submit_t = time.perf_counter()   # latency telemetry anchors
         self.first_token_t = None
+        self.deadline = deadline  # absolute perf_counter() cancel point
 
 
 def _sample_rows(jax, jnp, logits, temps, top_ks, top_ps, key):
@@ -160,7 +382,9 @@ class ContinuousBatchingEngine:
     def __init__(self, model, max_slots=4, page_size=64, num_pages=None,
                  max_seq_len=None, max_new_tokens=32, eos_token_id=None,
                  seed=0, prefill_chunk=None, preempt_policy="recompute",
-                 enable_prefix_cache=False):
+                 enable_prefix_cache=False, int8_kv=False,
+                 draft_model=None, spec_tokens=4, prefill_only=False,
+                 rid_base=0):
         import jax
         import jax.numpy as jnp
 
@@ -186,17 +410,51 @@ class ContinuousBatchingEngine:
         self._weights = self._pack_weights(model)
         self._key = jax.random.PRNGKey(seed)
 
-        # paged caches per layer, KERNEL layout [Hkv, num_pages, page, D]
-        # (what paged_attention consumes — no per-step transposes)
+        # scan-over-layers decode (docs/SERVING.md cold start): the ONE
+        # models.gpt resolver decides — the decode/prefill programs
+        # compile as a lax.scan over the [L, ...]-stacked weights+caches
+        # (depth-flat build time, the PR 7 discipline) unless
+        # PTPU_SCAN_LAYERS=0 keeps the python-unrolled loop, the bitwise
+        # escape hatch (proven: greedy streams identical either way).
+        from ..models.gpt import scan_layers_enabled
+
+        self._scan_layers = scan_layers_enabled()
+
+        # int8 paged KV (docs/SERVING.md): pages stored as int8 codes +
+        # fp32 per-row scales riding in the page table, ~half the exact
+        # mode's KV HBM. Engages only behind the parity probe;
+        # PTPU_INT8_KV=0 is the exact escape hatch.
+        self.int8_kv = int8_kv_enabled(int8_kv)
+
+        # paged caches, stacked KERNEL layout [L, Hkv, num_pages, page, D]
+        # (per-layer slices are exactly what paged_attention consumes —
+        # no per-step transposes; the leading L axis is what the layer
+        # scan iterates)
         dt = self._weights["embed"].dtype
-        self.kc = [jnp.zeros((self.hkv, num_pages + 1, page_size, hd), dt)
-                   for _ in range(cfg.num_layers)]
-        self.vc = [jnp.zeros((self.hkv, num_pages + 1, page_size, hd), dt)
-                   for _ in range(cfg.num_layers)]
+        self._kv_dtype = dt
+        cache_shape = (cfg.num_layers, self.hkv, num_pages + 1,
+                       page_size, hd)
+        if self.int8_kv:
+            self.kc = (jnp.zeros(cache_shape, jnp.int8),
+                       jnp.zeros(cache_shape[:-1] + (1,), jnp.float32))
+            self.vc = (jnp.zeros(cache_shape, jnp.int8),
+                       jnp.zeros(cache_shape[:-1] + (1,), jnp.float32))
+        else:
+            self.kc = jnp.zeros(cache_shape, dt)
+            self.vc = jnp.zeros(cache_shape, dt)
+
+        # prefill_only: this engine is the PREFILL half of a
+        # disaggregated pair (fleet.disagg) — step() admits and prefills
+        # but never runs a decode tick; completed-prefill requests wait
+        # in their slots for extract()
+        self.prefill_only = bool(prefill_only)
 
         self._slots: list[_Request | None] = [None] * max_slots
         self._waiting: deque[_Request] = deque()
-        self._next_rid = 0
+        # rid_base: fleet routers give each replica a disjoint id space
+        # so request trace trees (docs/TELEMETRY.md Tracing) never
+        # collide across replicas
+        self._next_rid = int(rid_base)
         # weights are argument 0 — NOT closed-over jit constants — so a
         # reload on a live engine feeds the already-compiled step
         self._decode_jit = jax.jit(self._decode_step, donate_argnums=(4, 5),
@@ -276,23 +534,51 @@ class ContinuousBatchingEngine:
         self._prefill_jit = jax.jit(self._prefill_chunk_step,
                                     donate_argnums=(7, 8))
         self.prefill_chunk_steps = 0  # observability: jitted pass count
+        # -- request deadlines / cancellation (docs/SERVING.md) --
+        self.cancelled = {}           # rid -> reason, drained by callers
+        self.cancellations = 0
+        # -- draft-model speculative decoding (fleet.spec_decode) --
+        # draft K tokens per tick, verify in ONE target forward,
+        # accept-prefix; bitwise-greedy-exact vs plain decode (the
+        # verify pass runs the SAME per-position paged kernel)
+        self.spec_tokens = int(spec_tokens)
+        self._draft = None
+        if draft_model is not None:
+            if self.spec_tokens < 1:
+                raise ValueError("spec_tokens must be >= 1 with a "
+                                 f"draft model, got {spec_tokens}")
+            from .fleet.spec_decode import DraftRunner
+
+            self._draft = DraftRunner(self, draft_model)
+            self._verify_jit = jax.jit(self._spec_verify,
+                                       donate_argnums=(4, 5))
+        self.spec_ticks = 0
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        # pages each decoding slot must hold BEFORE a tick: a spec tick
+        # writes K drafts + the carry token past `length`, a plain tick
+        # writes one
+        self._lookahead = (self.spec_tokens + 1 if self._draft is not None
+                           else 1)
+        self.build_seconds = None     # set by warmup() (cold-start gate)
 
     @staticmethod
     def _pack_weights(model):
         # the decode contract: `_decode_params()` (per-layer weight dicts,
         # llama.py:66 / gpt.py GPTForCausalLMPipe) + embed/final_norm on
-        # the model or its `.model` core + optional untied `lm_head`
-        params = model._decode_params()
-        core = model.model if hasattr(model, "model") else model
-        head = getattr(model, "lm_head", None)
-        return {
-            "layers": [tuple(lp[k]._data for k in
-                             ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg",
-                              "wu", "wd")) for lp in params],
-            "embed": core.embed_tokens.weight._data,
-            "fnorm": core.final_norm.weight._data,
-            "head": head.weight._data if head is not None else None,
-        }
+        # the model or its `.model` core + optional untied `lm_head`.
+        # "layers" is a tuple of 9 LEADING-AXIS-STACKED arrays [L, ...]
+        # in _block order — the tree the layer scan iterates. Stacked
+        # models (GPTForCausalLMPipe / StackedDecoder) pack ZERO-COPY
+        # (the decoder's [L, ...] arrays are referenced as-is); per-layer
+        # models stack their slices (one transient per-layer copy during
+        # the stack, then only the stacked copy is retained).
+        return _pack_weights_stacked(model)
+
+    @staticmethod
+    def _layer_tuple(weights, li):
+        """Per-layer 9-tuple view of the stacked weight tree."""
+        return tuple(w[li] for w in weights["layers"])
 
     def reload_weights(self, model=None):
         """Re-read weights from the model (e.g. after an in-place update);
@@ -413,6 +699,18 @@ class ContinuousBatchingEngine:
         rows_j, poss_j = jnp.asarray(rows), jnp.asarray(poss)
 
         def attend(li, q, k, v):
+            if self.int8_kv:
+                # round-trip k/v through the page quantizer BEFORE both
+                # the attention math and the cache write: group prefill,
+                # chunked prefill, and decode all read the SAME
+                # quantized KV (re-quantizing a round-tripped row is
+                # exact — the absmax element always maps to code 127,
+                # so the recomputed scale is identical)
+                from ..memory import (dequantize_rows_int8,
+                                      quantize_rows_int8)
+
+                k = dequantize_rows_int8(*quantize_rows_int8(k), k.dtype)
+                v = dequantize_rows_int8(*quantize_rows_int8(v), v.dtype)
             ck = jnp.repeat(k, rep, 2) if rep > 1 else k
             cv = jnp.repeat(v, rep, 2) if rep > 1 else v
             logits = jnp.einsum("bthd,bshd->bhts",
@@ -423,17 +721,16 @@ class ContinuousBatchingEngine:
             o = jnp.einsum("bhts,bshd->bthd", probs,
                            cv.astype(jnp.float32)).astype(q.dtype)
             # scatter the group's valid k/v into the owned pages; ADJACENT
-            # advanced indices (axes 1,2) stay in place -> [Hkv, N, D]
+            # advanced indices stay in place -> [Hkv, N, D]
             kvals = jnp.swapaxes(k[rows_j, poss_j], 0, 1)
             vvals = jnp.swapaxes(v[rows_j, poss_j], 0, 1)
-            self.kc[li] = self.kc[li].at[:, tok_pages, offs, :].set(
-                kvals.astype(self.kc[li].dtype))
-            self.vc[li] = self.vc[li].at[:, tok_pages, offs, :].set(
-                vvals.astype(self.vc[li].dtype))
+            self.kc = _kv_write_layer(self.kc, li, tok_pages, offs, kvals)
+            self.vc = _kv_write_layer(self.vc, li, tok_pages, offs, vvals)
             return o
 
-        for li, lp in enumerate(w["layers"]):
-            x = self._layer_forward(li, lp, x, pos0, attend)
+        for li in range(self.cfg.num_layers):
+            x = self._layer_forward(li, self._layer_tuple(w, li), x, pos0,
+                                    attend)
         x = _rms_pure(x, w["fnorm"])
         last = x[jnp.arange(B), jnp.asarray(lens - 1)]       # [B, H]
         toks = self._head_tokens(last, reqs)
@@ -443,7 +740,70 @@ class ContinuousBatchingEngine:
             # lockstep so a later swap snapshot is classified decode-phase
             # (its restore must reserve the growth page, not the prompt)
             r.prefill_pos = int(lens[i])
+        if self._draft is not None:
+            # the draft's KV for these prompts (same pages/page table)
+            self._draft.prefill(reqs, [r.seq_tokens for r in reqs])
         return toks
+
+    def _run_layers(self, weights, x, layer_fn, kc, vc):
+        """Run ``layer_fn`` over every decoder layer through the shared
+        :func:`_run_layer_stack` walker (scan-over-layers per the
+        models.gpt resolver; ``PTPU_SCAN_LAYERS=0`` unrolls bitwise —
+        docs/SERVING.md)."""
+        return _run_layer_stack(self._scan_layers, weights["layers"], x,
+                                layer_fn, kc, vc)
+
+    def _paged_attend(self, q, kc_l, vc_l, tables, lens):
+        """Single-position paged attention over a PER-LAYER cache:
+        q [B, Hq, D] -> [B, Hq, D]. Exact caches take the Pallas paged
+        kernel; int8 caches gather the owned pages, dequantize
+        (codes * page-table scales), and run the masked reference
+        attention — the hand-written int8 Pallas decode kernel is the
+        named follow-up (docs/SERVING.md)."""
+        jax, jnp = self._jax, self._jnp
+        if not isinstance(kc_l, tuple):
+            from ..ops.pallas.decode_attention import paged_attention
+
+            return paged_attention(q, kc_l, vc_l, tables, lens)
+        b, hq, hd = q.shape
+        dt = self._kv_dtype
+        S = self.pages_per_seq * self.page
+        ck = _kv_gather_rows(kc_l, tables, dt).reshape(self.hkv, b, S, hd)
+        cv = _kv_gather_rows(vc_l, tables, dt).reshape(self.hkv, b, S, hd)
+        rep = hq // self.hkv
+        if rep > 1:
+            ck = jnp.repeat(ck, rep, 0)
+            cv = jnp.repeat(cv, rep, 0)
+        scale = 1.0 / math.sqrt(hd)
+        logits = jnp.einsum("bhd,hbsd->bhs",
+                            (q * scale).astype(jnp.float32),
+                            ck.astype(jnp.float32))
+        mask = jnp.arange(S)[None, None, :] < lens[:, None, None]
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, -1)
+        o = jnp.einsum("bhs,hbsd->bhd", probs, cv.astype(jnp.float32))
+        return o.astype(q.dtype)
+
+    def _decode_layer(self, lp, x, lens, tables, page_ids, offs,
+                      kc_l, vc_l):
+        """One decoder layer of the batched decode tick (the scan
+        body): write this token's KV row, paged-attend, MLP. Shares
+        `_layer_forward` with the prefill paths so decode numerics can
+        never drift from prefill's."""
+        jnp = self._jnp
+        new = {}
+
+        def attend(li, q, k, v):
+            kl = _kv_write(kc_l, page_ids, offs,
+                           jnp.swapaxes(k[:, 0], 0, 1))
+            vl = _kv_write(vc_l, page_ids, offs,
+                           jnp.swapaxes(v[:, 0], 0, 1))
+            new["k"], new["v"] = kl, vl
+            o = self._paged_attend(q[:, 0], kl, vl, tables, lens + 1)
+            return o[:, None]                         # [B, 1, Hq, D]
+
+        x = self._layer_forward(0, lp, x, lens, attend)
+        return x, new["k"], new["v"]
 
     def _decode_step(self, weights, tokens, lens, tables, kc, vc,
                      temps, top_ks, top_ps, key, do_sample=False):
@@ -452,28 +812,17 @@ class ContinuousBatchingEngine:
         new kc, new vc)."""
         jax, jnp = self._jax, self._jnp
         from ..models.gpt import _rms_pure
-        from ..ops.pallas.decode_attention import paged_attention
 
         b = tokens.shape[0]
         x = weights["embed"][tokens][:, None]                # [B, 1, H]
         page_ids = tables[jnp.arange(b), lens // self.page]
         offs = lens % self.page
-        for li, lp in enumerate(weights["layers"]):
-            ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
-            h = _rms_pure(x, ln1)
-            q = (h @ wq).reshape(b, 1, self.cfg.num_heads, self.hd)
-            k = (h @ wk).reshape(b, 1, self.hkv, self.hd)
-            v = (h @ wv).reshape(b, 1, self.hkv, self.hd)
-            q, k = self._rope(q, lens), self._rope(k, lens)
-            kc_l = kc[li].at[:, page_ids, offs, :].set(
-                jnp.swapaxes(k[:, 0], 0, 1).astype(kc[li].dtype))
-            vc_l = vc[li].at[:, page_ids, offs, :].set(
-                jnp.swapaxes(v[:, 0], 0, 1).astype(vc[li].dtype))
-            kc[li], vc[li] = kc_l, vc_l
-            o = paged_attention(q[:, 0], kc_l, vc_l, tables, lens + 1)
-            x = x + o.reshape(b, 1, -1).astype(x.dtype) @ wo
-            h2 = _rms_pure(x, ln2)
-            x = x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+
+        def layer_fn(lp, x, kc_l, vc_l):
+            return self._decode_layer(lp, x, lens, tables, page_ids,
+                                      offs, kc_l, vc_l)
+
+        x, kc, vc = self._run_layers(weights, x, layer_fn, kc, vc)
         x = _rms_pure(x, weights["fnorm"])[:, 0]
         lg = (x @ weights["head"] if weights["head"] is not None
               else x @ weights["embed"].T)
@@ -484,12 +833,64 @@ class ContinuousBatchingEngine:
             nxt = jnp.argmax(lg.astype(jnp.float32), -1).astype(jnp.int32)
         return nxt, kc, vc
 
+    def _spec_verify(self, weights, toks, lens, tables, kc, vc):
+        """Speculative-decoding verify: ONE target forward over the
+        C = K+1 token window [carry, d1..dK] at positions
+        lens..lens+K, returning the target's greedy token at EVERY
+        position (t1..t_{K+1}) plus the updated caches.
+
+        Bitwise-greedy-exact by construction (the acceptance contract,
+        docs/SERVING.md): projections/norms/rope/MLP are row-local ops
+        (batching over positions cannot change a row's value), and
+        attention runs the SAME per-position `_paged_attend` with the
+        same operands a plain decode tick at that position would see —
+        position i reads lens+i+1 valid rows, the earlier window rows
+        having just been written with the identical values sequential
+        ticks would have written."""
+        jnp = self._jnp
+        from ..models.gpt import _rms_pure
+
+        b, C = toks.shape
+        x = weights["embed"][toks]                           # [B, C, H]
+        pos = lens[:, None] + jnp.arange(C)[None, :]         # [B, C]
+        page_idx = jnp.clip(pos // self.page, 0, self.pages_per_seq - 1)
+        page_ids = jnp.take_along_axis(tables, page_idx, 1)
+        offs = pos % self.page
+
+        def layer_fn(lp, x, kc_l, vc_l):
+            new = {}
+
+            def attend(li, q, k, v):
+                kl = _kv_write(kc_l, page_ids, offs,
+                               jnp.transpose(k, (2, 0, 1, 3)))
+                vl = _kv_write(vc_l, page_ids, offs,
+                               jnp.transpose(v, (2, 0, 1, 3)))
+                new["k"], new["v"] = kl, vl
+                o = [self._paged_attend(q[:, i], kl, vl, tables,
+                                        lens + i + 1) for i in range(C)]
+                return jnp.stack(o, 1)                # [B, C, Hq, D]
+
+            x = self._layer_forward(0, lp, x, lens, attend)
+            return x, new["k"], new["v"]
+
+        x, kc, vc = self._run_layers(weights, x, layer_fn, kc, vc)
+        x = _rms_pure(x, weights["fnorm"])                   # [B, C, H]
+        lg = (x @ weights["head"] if weights["head"] is not None
+              else x @ weights["embed"].T)
+        t = jnp.argmax(lg.astype(jnp.float32), -1).astype(jnp.int32)
+        return t, kc, vc
+
     # -- engine surface -----------------------------------------------------
     def submit(self, prompt_ids, temperature=0.0, top_k=0, top_p=1.0,
-               on_token=None) -> int:
+               on_token=None, deadline_seconds=None, rid=None) -> int:
         """Queue a request. ``temperature=0`` decodes greedily; otherwise
         softmax sampling with optional top_k / top_p truncation.
-        ``on_token(rid, token_id)`` streams each generated token."""
+        ``on_token(rid, token_id)`` streams each generated token.
+        ``deadline_seconds`` cancels the request (queued OR running —
+        pages freed, ``serving_cancellations_total{reason="deadline"}``)
+        once that much wall time has passed since submit. ``rid`` lets a
+        fleet router assign globally-unique ids (trace trees must not
+        collide across replicas); the caller owns uniqueness."""
         if len(prompt_ids) == 0:
             raise ValueError("empty prompt: a request needs at least one "
                              "token to prefill")
@@ -499,16 +900,33 @@ class ContinuousBatchingEngine:
                 f"request needs {total} tokens (prompt "
                 f"{len(prompt_ids)} + max_new {self.max_new_tokens}) > "
                 f"max_seq_len {self.max_seq}")
-        need = (total + self.page - 1) // self.page
+        if self._draft is not None and total + self.spec_tokens > self.max_seq:
+            raise ValueError(
+                f"speculative decoding writes up to {self.spec_tokens} "
+                f"draft tokens of KV past the sequence end: request "
+                f"needs {total} + {self.spec_tokens} spec headroom > "
+                f"max_seq_len {self.max_seq}")
+        # feasibility must cover the speculative lookahead too: the
+        # grow-pages no-deadlock invariant ("a lone request always
+        # fits") prices length + K + 1 tokens under a draft model
+        spec_pad = self.spec_tokens if self._draft is not None else 0
+        need = (total + spec_pad + self.page - 1) // self.page
         if need > self.pool.num_pages:
             raise ValueError(
-                f"request needs {need} pages > pool size "
+                f"request needs {need} pages (incl. {spec_pad} tokens "
+                f"of speculative headroom) > pool size "
                 f"{self.pool.num_pages}")
-        rid = self._next_rid
-        self._next_rid += 1
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        else:
+            rid = int(rid)
+            self._next_rid = max(self._next_rid, rid + 1)
+        deadline = (time.perf_counter() + float(deadline_seconds)
+                    if deadline_seconds is not None else None)
         self._waiting.append(_Request(
             rid, [int(t) for t in prompt_ids], temperature, top_k, top_p,
-            on_token))
+            on_token, deadline=deadline))
         # request span tree (docs/TELEMETRY.md Tracing): the async
         # "request" span covers submit → retire; "queue" covers
         # submit → admission (re-opened on preemption requeue)
@@ -516,6 +934,59 @@ class ContinuousBatchingEngine:
                            {"prompt_tokens": len(prompt_ids)})
         _trace.async_begin("queue", rid)
         return rid
+
+    # -- cancellation / deadlines ------------------------------------------
+    def _cancel_req(self, req, reason, slot_idx=None):
+        """Tear a request out of the engine: release pages (completed
+        prefix pages still register into the prefix cache — their KV is
+        valid), drop any host snapshot, close its trace spans, count
+        it. The request lands in ``self.cancelled`` (rid -> reason) for
+        callers that track outcomes."""
+        if slot_idx is not None:
+            self._slots[slot_idx] = None
+            if req.first_token_t is None:
+                _trace.async_end("prefill", req.rid, {"cancelled": reason})
+        else:
+            try:
+                self._waiting.remove(req)
+            except ValueError:
+                pass
+            _trace.async_end("queue", req.rid, {"cancelled": reason})
+        if req.pages:
+            self._release_pages(req, register=True)
+        req.swapped = None
+        self.cancelled[req.rid] = reason
+        self.cancellations += 1
+        _CANCELLATIONS.inc(labels=(reason,))
+        _trace.async_end("request", req.rid, {"cancelled": reason})
+
+    def cancel(self, rid, reason="user") -> bool:
+        """Cancel a queued or running request by id. Returns True if it
+        was found live; its pages return to the pool immediately."""
+        for i, r in enumerate(self._slots):
+            if r is not None and r.rid == rid:
+                self._cancel_req(r, reason, slot_idx=i)
+                return True
+        for r in list(self._waiting):
+            if r.rid == rid:
+                self._cancel_req(r, reason)
+                return True
+        return False
+
+    def _sweep_deadlines(self):
+        """Cancel every request whose deadline has passed — queued AND
+        running (a stuck client must not hold KV pages forever). A
+        request that already FINISHED generating is not cancelled: its
+        tokens were all delivered, so the retire loop (which runs right
+        after this sweep) returns it as a completion."""
+        now = time.perf_counter()
+        for i, r in enumerate(list(self._slots)):
+            if (r is not None and r.deadline is not None
+                    and now >= r.deadline and not self._finished(r)):
+                self._cancel_req(r, "deadline", slot_idx=i)
+        for r in [r for r in self._waiting
+                  if r.deadline is not None and now >= r.deadline]:
+            self._cancel_req(r, "deadline")
 
     def _emit(self, req, tok):
         if req.first_token_t is None:
@@ -558,18 +1029,18 @@ class ContinuousBatchingEngine:
                     break  # head-of-line waits for pages
                 self._waiting.popleft()
                 req.pages = self.pool.alloc(need)
-                # stage the n-page snapshot into a fresh fixed-shape host
-                # pair (no zeroing — the padded rows scatter into the
+                # stage the n-page snapshot into fresh fixed-shape host
+                # buffers (no zeroing — the padded rows scatter into the
                 # scratch page, so their uninitialized contents are
                 # irrelevant; the padded h2d volume is the price of the
                 # compile-once scatter)
-                kh, vh = self._swap_stage(snap["k"].shape, snap["k"].dtype)
-                kh[:, :, :n] = snap["k"]
-                vh[:, :, :n] = snap["v"]
+                kh = self._swap_stage(snap["k"], n)
+                vh = self._swap_stage(snap["v"], n)
                 self.kc, self.vc = self._swap_in_jit(
-                    list(self.kc), list(self.vc),
+                    self.kc, self.vc,
                     self._padded_page_vec(req.pages[:n]),
-                    self._jnp.asarray(kh), self._jnp.asarray(vh))
+                    _kv_map(self._jnp.asarray, kh),
+                    _kv_map(self._jnp.asarray, vh))
                 req.prefill_pos = snap["prefill_pos"]
                 req.length = snap["length"]
                 req.swapped = None
@@ -577,6 +1048,16 @@ class ContinuousBatchingEngine:
                 req.admit_seq = self._admit_counter
                 self._admit_counter += 1
                 self._slots[i] = req
+                if (self._draft is not None
+                        and req.prefill_pos >= len(req.seq_tokens)):
+                    # a decode-phase snapshot (a disagg handoff, or a
+                    # swap-policy victim) carries no draft KV — rebuild
+                    # it for the restored context so acceptance doesn't
+                    # collapse (mid-prefill snapshots rebuild at the
+                    # prefill-completion hook instead)
+                    self._draft.prefill(
+                        [req],
+                        [(req.prompt + req.generated)[:req.length]])
                 _ADMISSIONS.inc(labels=("swap_restore",))
                 _trace.async_end("queue", req.rid)
                 _trace.async_instant("admitted", req.rid,
@@ -669,30 +1150,43 @@ class ContinuousBatchingEngine:
         mask = cols[None, None, :] <= row_pos[:, :, None]    # [B, c, S]
         tp = tok_pages.reshape(-1)
         of = offs.reshape(-1)
+        dt = self._kv_dtype
 
-        def attend(li, q, k, v):
-            # write the chunk's kv FIRST, then gather the prefix back
-            # (one source of truth for the attention operands)
-            kv = jnp.swapaxes(k.reshape(B * c, self.hkv, self.hd), 0, 1)
-            vv = jnp.swapaxes(v.reshape(B * c, self.hkv, self.hd), 0, 1)
-            kc[li] = kc[li].at[:, tp, of, :].set(kv.astype(kc[li].dtype))
-            vc[li] = vc[li].at[:, tp, of, :].set(vv.astype(vc[li].dtype))
-            ck = kc[li][:, hist].reshape(self.hkv, B, S, self.hd)
-            cv = vc[li][:, hist].reshape(self.hkv, B, S, self.hd)
-            if rep > 1:
-                ck = jnp.repeat(ck, rep, 0)
-                cv = jnp.repeat(cv, rep, 0)
-            logits = jnp.einsum("bchd,hbsd->bhcs",
-                                (q * scale).astype(jnp.float32),
-                                ck.astype(jnp.float32))
-            logits = jnp.where(mask[:, None], logits, -1e30)
-            probs = jax.nn.softmax(logits, -1)
-            o = jnp.einsum("bhcs,hbsd->bchd", probs,
-                           cv.astype(jnp.float32))
-            return o.astype(q.dtype)                     # [B, c, Hq, D]
+        def layer_fn(lp, x, kc_l, vc_l):
+            new = {}
 
-        for li, lp in enumerate(weights["layers"]):
-            x = self._layer_forward(li, lp, x, pos0, attend)
+            def attend(li, q, k, v):
+                # write the chunk's kv FIRST, then gather the prefix
+                # back (one source of truth for the attention operands;
+                # in int8 mode both the own-chunk and prefix reads come
+                # back dequantized — identical to what decode will see)
+                kv = jnp.swapaxes(
+                    k.reshape(B * c, self.hkv, self.hd), 0, 1)
+                vv = jnp.swapaxes(
+                    v.reshape(B * c, self.hkv, self.hd), 0, 1)
+                kl = _kv_write(kc_l, tp, of, kv)
+                vl = _kv_write(vc_l, tp, of, vv)
+                new["k"], new["v"] = kl, vl
+                ck = _kv_gather_rows(kl, hist, dt).reshape(
+                    self.hkv, B, S, self.hd)
+                cv = _kv_gather_rows(vl, hist, dt).reshape(
+                    self.hkv, B, S, self.hd)
+                if rep > 1:
+                    ck = jnp.repeat(ck, rep, 0)
+                    cv = jnp.repeat(cv, rep, 0)
+                logits = jnp.einsum("bchd,hbsd->bhcs",
+                                    (q * scale).astype(jnp.float32),
+                                    ck.astype(jnp.float32))
+                logits = jnp.where(mask[:, None], logits, -1e30)
+                probs = jax.nn.softmax(logits, -1)
+                o = jnp.einsum("bhcs,hbsd->bchd", probs,
+                               cv.astype(jnp.float32))
+                return o.astype(q.dtype)                 # [B, c, Hq, D]
+
+            x = self._layer_forward(0, lp, x, pos0, attend)
+            return x, new["k"], new["v"]
+
+        x, kc, vc = self._run_layers(weights, x, layer_fn, kc, vc)
         last_rows = jnp.clip(nvalid - 1, 0, c - 1)
         last = x[jnp.arange(B), last_rows]                   # [B, H]
         return _rms_pure(last, weights["fnorm"]), kc, vc
@@ -730,7 +1224,7 @@ class ContinuousBatchingEngine:
         last, self.kc, self.vc = self._prefill_jit(
             self._weights, jnp.asarray(ids_np), jnp.asarray(pos0),
             jnp.asarray(nvalid), jnp.asarray(tok_pages), jnp.asarray(offs),
-            jnp.asarray(hist), list(self.kc), list(self.vc))
+            jnp.asarray(hist), self.kc, self.vc)
         self.prefill_chunk_steps += 1
         completed = []
         for i, r in enumerate(reqs):
@@ -744,28 +1238,50 @@ class ContinuousBatchingEngine:
                 self.prefills_completed += 1
                 r.length = len(r.seq_tokens)
                 self._emit(r, tok)
+            if self._draft is not None:
+                done_reqs = [r for _, r in completed]
+                self._draft.prefill(done_reqs,
+                                    [r.seq_tokens for r in done_reqs])
 
     def _swap_gather(self, kc, vc, pages):
-        """Stack every layer's rows for `pages` -> [L, Hkv, P, page, D]
-        (P = pages_per_seq, trash-padded). One jitted dispatch per
-        swap-out, then a single host transfer."""
-        jnp = self._jnp
-        k = jnp.stack([c[:, pages] for c in kc])
-        v = jnp.stack([c[:, pages] for c in vc])
-        return k, v
+        """Every layer's rows for `pages` -> [L, Hkv, P, page, D]
+        (P = pages_per_seq, trash-padded; int8 caches yield a
+        (codes, scales) leaf pair). One jitted dispatch per swap-out,
+        then a single host transfer."""
+        g = lambda c: c[:, :, pages]
+        return _kv_map(g, kc), _kv_map(g, vc)
 
     def _swap_scatter(self, kc, vc, pages, k, v):
         """Scatter a host snapshot back into the caches at `pages`
         (trash-padded rows land in the scratch page — harmless by
         definition). Donates kc/vc."""
-        kc = [c.at[:, pages].set(k[li]) for li, c in enumerate(kc)]
-        vc = [c.at[:, pages].set(v[li]) for li, c in enumerate(vc)]
-        return kc, vc
+        sc = lambda c, s: c.at[:, :, pages].set(s)
+        return _kv_map2(sc, kc, k), _kv_map2(sc, vc, v)
 
     def _padded_page_vec(self, pages):
         pad = np.full(self.pages_per_seq, self._trash_page, np.int32)
         pad[: len(pages)] = pages
         return self._jnp.asarray(pad)
+
+    def _snapshot_to_host(self, r):
+        """Build ``r.swapped`` — THE host KV snapshot format the
+        swap-restore admission path consumes — shared by swap-policy
+        preemption and the disagg ``extract()`` seam so the two can
+        never drift. Sliced device-side to pages holding LIVE tokens
+        before the host copy: the retained snapshot and the d2h
+        transfer scale with written KV, not the page reservation (a
+        mid-prefill victim's untouched prompt pages and grown-but-empty
+        decode pages never leave the device; restore re-allocates the
+        full reservation from prefill_pos/length bookkeeping)."""
+        k, v = self._swap_out_jit(self.kc, self.vc,
+                                  self._padded_page_vec(r.pages))
+        written = max(r.length, r.prefill_pos)
+        n = min((written + self.page - 1) // self.page, len(r.pages))
+        cut = lambda c: np.asarray(c[:, :, :n])
+        r.swapped = {"k": _kv_map(cut, k), "v": _kv_map(cut, v),
+                     "n": n, "prefill_pos": r.prefill_pos,
+                     "length": r.length}
+        return r.swapped
 
     # -- prefix cache (content-addressed KV pages) --------------------------
     def _chain_keys(self, tokens, n_pages):
@@ -867,17 +1383,24 @@ class ContinuousBatchingEngine:
             shared.append(pg)
         return shared
 
-    def _swap_stage(self, snap_shape, dtype):
-        """FRESH host staging pair per restore at the fixed
-        [L, Hkv, P, page, D] scatter shape. A reused buffer is unsound:
-        on backends that zero-copy host arrays into the program
-        (jax CPU aliases numpy memory instead of copying at dispatch),
-        overwriting the staging pair for restore N+1 races the still
-        in-flight transfer of restore N. Fresh arrays make each restore's
-        payload immutable for the lifetime of its dispatch; allocation
-        cost is noise next to the h2d transfer itself."""
-        shape = snap_shape[:2] + (self.pages_per_seq,) + snap_shape[3:]
-        return (np.empty(shape, dtype), np.empty(shape, dtype))
+    def _swap_stage(self, snap, n):
+        """FRESH host staging buffers per restore at the fixed
+        [L, Hkv, P, page, D] scatter shape (leaf-wise over int8
+        code/scale pairs), filled with the n-page snapshot. A reused
+        buffer is unsound: on backends that zero-copy host arrays into
+        the program (jax CPU aliases numpy memory instead of copying at
+        dispatch), overwriting the staging buffer for restore N+1 races
+        the still in-flight transfer of restore N. Fresh arrays make
+        each restore's payload immutable for the lifetime of its
+        dispatch; allocation cost is noise next to the h2d transfer."""
+
+        def stage(leaf):
+            shape = leaf.shape[:2] + (self.pages_per_seq,) + leaf.shape[3:]
+            buf = np.empty(shape, leaf.dtype)
+            buf[:, :, :n] = leaf
+            return buf
+
+        return _kv_map(stage, snap)
 
     def _preempt(self, slot_idx):
         """Evict a running request and requeue it at the FRONT of the
@@ -894,20 +1417,7 @@ class ContinuousBatchingEngine:
             # page-budget limit, not physical HBM exhaustion, so the
             # transient is safe; a deployment sized to true HBM capacity
             # would gather layer-by-layer instead.
-            k, v = self._swap_out_jit(list(self.kc), list(self.vc),
-                                      self._padded_page_vec(r.pages))
-            # slice to pages holding LIVE tokens device-side before the
-            # host copy: the retained snapshot and the d2h transfer scale
-            # with written KV, not the page reservation (a mid-prefill
-            # victim's untouched prompt pages and grown-but-empty decode
-            # pages never leave the device; restore re-allocates the full
-            # reservation from prefill_pos/length bookkeeping)
-            written = max(r.length, r.prefill_pos)
-            n = min((written + self.page - 1) // self.page, len(r.pages))
-            r.swapped = {"k": np.asarray(k[:, :, :n]),
-                         "v": np.asarray(v[:, :, :n]),
-                         "n": n, "prefill_pos": r.prefill_pos,
-                         "length": r.length}
+            self._snapshot_to_host(r)
             self.swaps_out += 1
             self.pool.free(r.pages)
             r.pages = []
@@ -935,7 +1445,12 @@ class ContinuousBatchingEngine:
         On pool exhaustion, preempt the YOUNGEST running request (its
         oldest peers keep their pages and finish first — guaranteed
         progress, no deadlock: a lone request always fits by the submit()
-        feasibility check)."""
+        feasibility check). Under a draft model the reservation covers
+        the whole speculative window (K drafts + carry) instead of one
+        token; a prefill-only engine never grows (its admissions reserve
+        every page chunked prefill will write)."""
+        if self.prefill_only:
+            return
         while True:
             # oldest-first service order
             live = sorted(
@@ -944,7 +1459,8 @@ class ContinuousBatchingEngine:
                 key=lambda ir: ir[1].admit_seq)
             short = None
             for i, r in live:
-                need = (r.length + 1 + self.page - 1) // self.page
+                need = (r.length + self._lookahead
+                        + self.page - 1) // self.page
                 grow = need - len(r.pages)
                 if grow <= 0:
                     continue
@@ -970,6 +1486,15 @@ class ContinuousBatchingEngine:
             victim = max(occupied, key=lambda ir: ir[1].admit_seq)
             self._preempt(victim[0])
 
+    def _finished(self, r):
+        """True when a request has nothing left to generate: max_new
+        reached, or its newest token is eos. THE completion predicate —
+        retire, the decode-tick live filter, and the disagg handoff
+        sweep all share it."""
+        return (len(r.generated) >= self.max_new_tokens
+                or (self.eos is not None and bool(r.generated)
+                    and r.generated[-1] == self.eos))
+
     def _retire(self, req: _Request):
         _REQ_LATENCY.observe(time.perf_counter() - req.submit_t)
         self._release_pages(req, register=True)
@@ -985,13 +1510,13 @@ class ContinuousBatchingEngine:
         requests finishing THIS tick."""
         jax, jnp = self._jax, self._jnp
         newly = {}
-        # retire FIRST: a finishing slot frees pages and a slot for this
+        # deadlines sweep FIRST: an expired request must not occupy a
+        # slot (or pages) for even one more tick
+        self._sweep_deadlines()
+        # retire next: a finishing slot frees pages and a slot for this
         # very tick's admissions
         for i, r in enumerate(list(self._slots)):
-            if r is not None and (
-                    len(r.generated) >= self.max_new_tokens or (
-                    self.eos is not None and r.generated
-                    and r.generated[-1] == self.eos)):
+            if r is not None and self._finished(r):
                 newly[r.rid] = self._retire(r)
                 self._slots[i] = None
         with _trace.span("admission", cat="serve"):
@@ -1000,47 +1525,146 @@ class ContinuousBatchingEngine:
             with _trace.span("prefill_tick", cat="serve"):
                 self._prefill_tick()
         self._grow_pages()
-        live = [(i, r) for i, r in enumerate(self._slots)
-                if r is not None and r.generated and r.length > 0]
+        # a request that hit max_new/eos at prefill completion THIS
+        # tick must not decode once more before next tick's retire —
+        # the off-by-one emitted max_new+1 tokens (and a token PAST
+        # eos) whenever completion landed on the prefill path
+        live = ([] if self.prefill_only else
+                [(i, r) for i, r in enumerate(self._slots)
+                 if r is not None and r.generated and r.length > 0
+                 and not self._finished(r)])
         if _TELEMETRY_REG.enabled:
             _STEPS.inc()
             _QUEUE_DEPTH.set(len(self._waiting))
             occupied = sum(1 for s in self._slots if s is not None)
             _SLOTS_OCCUPIED.set(occupied)
             _KV_UTIL.set(1.0 - self.pool.available / self.pool.num_pages)
+            _INT8_KV.set(1.0 if self.int8_kv else 0.0)
             if live:
                 _BATCH_OCCUPANCY.observe(len(live) / self.max_slots)
         if not live:
             return newly
+        # static greedy/sampling mode: one retrace per mode, and the
+        # default all-greedy workload never pays the vocab sort
+        do_sample = any(r.temperature > 0.0 for _, r in live)
+        if self._draft is not None and not do_sample:
+            # speculative tick: draft K, verify in one target forward
+            self._spec_tick(live)
+            return newly
+        if self._draft is not None:
+            _SPEC_TICKS.inc(labels=("fallback",))
         # fixed-width batch: pad with slot 0's state (results discarded)
         pad_to = self.max_slots
         rows = [r for _, r in live] + [live[0][1]] * (pad_to - len(live))
         tokens = jnp.asarray([r.generated[-1] for r in rows], jnp.int32)
         lens = jnp.asarray([r.length for r in rows], jnp.int32)
-        table_rows = []
-        for r in rows:
-            row = list(r.pages) + [0] * (self.pages_per_seq - len(r.pages))
-            table_rows.append(row[: self.pages_per_seq])
-        tables = jnp.asarray(np.asarray(table_rows, np.int32))
+        tables = self._table_rows(rows)
         temps = jnp.asarray([r.temperature for r in rows], jnp.float32)
         top_ks = jnp.asarray([r.top_k for r in rows], jnp.int32)
         top_ps = jnp.asarray([r.top_p for r in rows], jnp.float32)
         self._key, sub = jax.random.split(self._key)
-        # static greedy/sampling mode: one retrace per mode, and the
-        # default all-greedy workload never pays the vocab sort
-        do_sample = any(r.temperature > 0.0 for _, r in live)
         with _trace.span("decode_tick",
                          attrs={"live": len(live)}, cat="serve"):
             nxt, self.kc, self.vc = self._decode_jit(
-                self._weights, tokens, lens, tables, list(self.kc),
-                list(self.vc), temps, top_ks, top_ps, sub, do_sample)
+                self._weights, tokens, lens, tables, self.kc,
+                self.vc, temps, top_ks, top_ps, sub, do_sample)
             # the host fetch is the tick's real sync point — inside the
             # span so decode wall time includes device work
             nxt = np.asarray(nxt)
+        if self._draft is not None:
+            # fallback tick under a draft: mirror the carry token into
+            # the draft's KV (proposal discarded) so the draft cache
+            # stays hole-free — without this, every sampled tick leaves
+            # a permanently stale draft row and speculative acceptance
+            # silently collapses once greedy ticks resume
+            self._draft.catch_up(tokens, lens, tables)
         for j, (i, r) in enumerate(live):
             r.length += 1
             self._emit(r, int(nxt[j]))
         return newly
+
+    def _table_rows(self, rows):
+        """Fixed-shape [B, pages_per_seq] page tables (zero-padded; the
+        kernels clamp + length-mask padded entries)."""
+        table_rows = []
+        for r in rows:
+            row = list(r.pages) + [0] * (self.pages_per_seq - len(r.pages))
+            table_rows.append(row[: self.pages_per_seq])
+        return self._jnp.asarray(np.asarray(table_rows, np.int32))
+
+    def _spec_tick(self, live):
+        """Draft-model speculative decode tick (docs/SERVING.md): the
+        draft proposes K greedy tokens per live row, the target verifies
+        all of them in ONE forward (`_spec_verify`), and the longest
+        draft prefix matching the target's own greedy tokens is emitted
+        plus the target's bonus token — 1..K+1 tokens per tick, every
+        one bitwise-identical to what plain greedy decode would emit."""
+        jnp = self._jnp
+        K = self.spec_tokens
+        pad_to = self.max_slots
+        rows = [r for _, r in live] + [live[0][1]] * (pad_to - len(live))
+        lens_np = np.asarray([r.length for r in rows], np.int32)
+        lens = jnp.asarray(lens_np)
+        tables = self._table_rows(rows)
+
+        def ctx_tok(r, i):
+            # context token i without materializing prompt+generated
+            # (O(seq) per row per tick on the hot path otherwise)
+            n = len(r.prompt)
+            return r.prompt[i] if i < n else r.generated[i - n]
+
+        # context[length] is the carry token (generated[-1]);
+        # context[length-1] re-primes the draft's previous position —
+        # always a rewrite of the same value EXCEPT after a fully-
+        # accepted window, where it fills the draft-KV hole for the
+        # token the draft proposed but never consumed
+        prev = np.asarray([ctx_tok(rows[j], int(lens_np[j]) - 1)
+                           for j in range(pad_to)], np.int32)
+        cur = np.asarray([ctx_tok(rows[j], int(lens_np[j]))
+                          for j in range(pad_to)], np.int32)
+        with _trace.span("spec_draft", attrs={"k": K}, cat="serve"):
+            d_toks = self._draft.propose(prev, cur, lens, tables, K)
+        toks = np.concatenate([cur[:, None], d_toks], 1)     # [B, K+1]
+        with _trace.span("spec_verify",
+                         attrs={"live": len(live), "k": K},
+                         cat="serve"):
+            t_out, self.kc, self.vc = self._verify_jit(
+                self._weights, jnp.asarray(toks), lens, tables,
+                self.kc, self.vc)
+            t_np = np.asarray(t_out)
+        accepted_total = 0
+        for j, (i, r) in enumerate(live):
+            drafts, targets = d_toks[j], t_np[j]
+            m = 0
+            while m < K and int(drafts[m]) == int(targets[m]):
+                m += 1
+            accepted_total += m
+            out = []
+            for t in [int(x) for x in drafts[:m]] + [int(targets[m])]:
+                out.append(t)
+                if self.eos is not None and t == self.eos:
+                    break
+                if len(r.generated) + len(out) >= self.max_new_tokens:
+                    break
+            r.length += len(out)
+            for t in out:
+                self._emit(r, t)
+        self.spec_ticks += 1
+        self.spec_draft_tokens += K * len(live)
+        self.spec_accepted_tokens += accepted_total
+        if _TELEMETRY_REG.enabled:
+            _SPEC_TICKS.inc(labels=("spec",))
+            _SPEC_DRAFTED.inc(K * len(live))
+            _SPEC_ACCEPTED.inc(accepted_total)
+        _trace.instant("spec_accept",
+                       {"accepted": accepted_total,
+                        "drafted": K * len(live)}, cat="serve")
+
+    @property
+    def spec_acceptance_rate(self):
+        """Fraction of drafted tokens the target verify accepted."""
+        return (self.spec_accepted_tokens
+                / max(1, self.spec_draft_tokens))
 
     def run_until_complete(self, max_ticks=10000):
         done = {}
@@ -1049,3 +1673,98 @@ class ContinuousBatchingEngine:
             if not self._waiting and all(s is None for s in self._slots):
                 return done
         raise TimeoutError("serving loop did not drain")
+
+    # -- fleet surface (router / disaggregated serving) ---------------------
+    def load(self):
+        """Live load signals for an admission router (docs/SERVING.md):
+        queue depth, slot occupancy, and KV headroom — the same state
+        the per-tick telemetry gauges publish, read synchronously."""
+        occupied = sum(1 for s in self._slots if s is not None)
+        return {
+            "queue_depth": len(self._waiting),
+            "occupied_slots": occupied,
+            "free_slots": self.max_slots - occupied,
+            "kv_free_fraction": self.pool.available / self.pool.num_pages,
+        }
+
+    def prefix_match_pages(self, tokens):
+        """How many full KV pages of this prompt's prefix the engine's
+        prefix cache already holds — the prefix-affinity routing signal.
+        0 when the cache is off (match is read-only: nothing is pinned)."""
+        return len(self._match_prefix([int(t) for t in tokens]))
+
+    def extract(self, slot_idx):
+        """Disaggregated-serving handoff seam (fleet.disagg): snapshot a
+        slot's KV pages + resume state to host exactly like a swap-out,
+        release the slot and its pages, and return the request. A
+        decode engine `inject()`s the request; its swap-restore
+        admission path scatters the pages back — bitwise (exact caches
+        round-trip unchanged; int8 caches move their raw codes+scales).
+        Unlike `_preempt(policy="swap")`, this works with ANY preempt
+        policy and registers completed prefix pages into this engine's
+        prefix cache (the prefill worker keeps the warm prefix)."""
+        r = self._slots[slot_idx]
+        if r is None:
+            raise ValueError(f"slot {slot_idx} is empty")
+        self._snapshot_to_host(r)
+        self._release_pages(r, register=True)
+        self._slots[slot_idx] = None
+        return r
+
+    def inject(self, req):
+        """Accept a request extracted from another engine (the decode
+        half of a disaggregated pair). Its host snapshot restores
+        through the standard swap-restore admission path. Both engines
+        must share the page geometry (page_size, pages_per_seq) and KV
+        mode; the disagg wrapper enforces this."""
+        self._next_rid = max(self._next_rid, req.rid + 1)
+        self._waiting.append(req)
+
+    def warmup(self, sample=False):
+        """Compile the engine's programs on dummy operands (cache writes
+        land in the scratch page) and record the wall time in
+        ``self.build_seconds`` — the replica cold-start number the
+        serving bench records and bench_gate gates (docs/SERVING.md).
+        Greedy programs only unless ``sample=True`` (the first sampled
+        tick otherwise pays its own compile). A ``prefill_only`` engine
+        compiles only its prefill program — the decode/verify programs
+        never run there, and charging their compile into the gated
+        cold-start number would overstate real spin-up cost."""
+        jax, jnp = self._jax, self._jnp
+        t0 = time.perf_counter()
+        b = self.max_slots
+        tokens = jnp.zeros((b,), jnp.int32)
+        lens = jnp.zeros((b,), jnp.int32)
+        tables = jnp.full((b, self.pages_per_seq), self._trash_page,
+                          jnp.int32)
+        temps = jnp.zeros((b,), jnp.float32)
+        top_ks = jnp.zeros((b,), jnp.int32)
+        top_ps = jnp.ones((b,), jnp.float32)
+        key = jax.random.PRNGKey(0)   # never touches self._key's stream
+        modes = () if self.prefill_only else (
+            (False, True) if sample else (False,))
+        for do_sample in modes:
+            nxt, self.kc, self.vc = self._decode_jit(
+                self._weights, tokens, lens, tables, self.kc, self.vc,
+                temps, top_ks, top_ps, key, do_sample)
+            np.asarray(nxt)           # block: compile + first dispatch
+        if self.prefill_chunk is not None:
+            B, c = self.max_slots, self.prefill_chunk
+            last, self.kc, self.vc = self._prefill_jit(
+                self._weights, jnp.zeros((B, c), jnp.int32),
+                jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+                jnp.full((B, c), self._trash_page, jnp.int32),
+                jnp.zeros((B, c), jnp.int32),
+                jnp.full((B, self.pages_per_seq), self._trash_page,
+                         jnp.int32),
+                self.kc, self.vc)
+            np.asarray(last)
+        if self._draft is not None and not self.prefill_only:
+            t_out, self.kc, self.vc = self._verify_jit(
+                self._weights,
+                jnp.zeros((b, self.spec_tokens + 1), jnp.int32),
+                lens, tables, self.kc, self.vc)
+            np.asarray(t_out)
+            self._draft.warmup(tables)
+        self.build_seconds = time.perf_counter() - t0
+        return self.build_seconds

@@ -19,10 +19,12 @@ from .int8_ckpt import (  # noqa: F401
     INT8_BLOCK,
     KERNEL_ANCHORS,
     dequantize_blockwise_int8,
+    dequantize_rows_int8,
     int8_checkpoint,
     int8_saved_nbytes,
     parse_save_names,
     quantize_blockwise_int8,
+    quantize_rows_int8,
 )
 from .planner import (  # noqa: F401
     Candidate,
@@ -40,6 +42,7 @@ from .planner import (  # noqa: F401
 __all__ = [
     "INT8_BLOCK", "KERNEL_ANCHORS",
     "quantize_blockwise_int8", "dequantize_blockwise_int8",
+    "quantize_rows_int8", "dequantize_rows_int8",
     "int8_checkpoint", "int8_saved_nbytes", "parse_save_names",
     "Candidate", "PlanDecision", "MemoryPlanError", "plan_train_step",
     "hbm_budget_bytes", "chip_kind", "throughput_score", "policy_coverage",

@@ -66,6 +66,30 @@ def dequantize_blockwise_int8(q, s, shape, dtype):
     return xf[:n].reshape(shape).astype(dtype)
 
 
+def quantize_rows_int8(x, eps=1e-12):
+    """Absmax int8 over the LAST axis: one fp32 scale per row.
+
+    The paged-KV grid (docs/SERVING.md): the serving engine's int8 KV
+    cache quantizes each (layer, kv-head, page-slot) row of ``head_dim``
+    elements independently, so a single-token scatter write updates one
+    block and its one scale without re-reading neighbours — the
+    :func:`quantize_blockwise_int8` recipe with block = the row the page
+    table already addresses. Returns ``(q int8 [..., D], s f32 [..., 1])``.
+    """
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(
+        jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0, eps)
+    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_rows_int8(q, s, dtype=None):
+    """Inverse of :func:`quantize_rows_int8`; ``dtype`` casts the result
+    (default: stay fp32)."""
+    x = q.astype(jnp.float32) * s
+    return x if dtype is None else x.astype(dtype)
+
+
 def int8_saved_nbytes(numel, block=INT8_BLOCK):
     """Bytes one int8-saved tensor of ``numel`` elements holds in HBM
     (int8 payload + fp32 block scales, padding included)."""

@@ -430,13 +430,20 @@ class SpanTracer:
                 spans.extend(stack)
             if not spans:
                 continue
-            # root = the span covering the most time (open end = +inf)
+            # root = the "request" span when one exists (the engine's
+            # submit→retire envelope — a fleet router's still-open
+            # "route" span would otherwise win on its infinite cover),
+            # else the span covering the most time (open end = +inf)
             def _cover(s):
                 end = s["end"] if s["end"] is not None else float("inf")
                 return end - s["start"]
 
-            spans.sort(key=_cover, reverse=True)
-            root, rest = spans[0], spans[1:]
+            named = [s for s in spans if s["name"] == "request"]
+            if named:
+                root = max(named, key=_cover)
+            else:
+                root = max(spans, key=_cover)
+            rest = [s for s in spans if s is not root]
             rest.sort(key=lambda s: s["start"])
             root["children"] = rest
             root["marks"] = marks

@@ -321,3 +321,66 @@ def test_default_refs_bridge_a_gap_round(tmp_path, capsys, monkeypatch):
     _round(tmp_path, "BENCH_r04.json", {"tracked": 101.0,
                                         "smoke_only": 5.0})
     assert bg.main(["--root", str(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------- serving gates
+def _round_with_serving(tmp_path, name, serving, extra=None):
+    rec = {"metric": "serve_goodput_tokens_per_sec_r4", "value": 100.0,
+           "unit": "tokens/sec", "serving": serving}
+    rec.update(extra or {})
+    p = tmp_path / name
+    p.write_text(json.dumps({"tail": json.dumps(rec)}))
+    return str(p)
+
+
+def test_serving_gate_fails_p99_over_budget(tmp_path, capsys):
+    """ISSUE 12 satellite: the soak embeds its p99-TTFT budget and the
+    gate fails a round whose tail latency blows it (docs/SERVING.md)."""
+    bad = _round_with_serving(tmp_path, "bad.json", {
+        "enabled": True, "requests": 10, "completed": 10, "cancelled": 0,
+        "ttft": {"p99": 0.9}, "p99_ttft_budget": 0.2})
+    assert bg.main([bad, "--against", bad]) == 1
+    assert "SERVE" in capsys.readouterr().out
+
+
+def test_serving_gate_fails_scaling_below_target(tmp_path, capsys):
+    """The acceptance bar: 4 replicas must reach the embedded scaling
+    target (3.5x single-replica goodput)."""
+    bad = _round_with_serving(tmp_path, "bad.json", {
+        "enabled": True, "requests": 10, "completed": 10, "cancelled": 0,
+        "replicas": 4, "goodput_x_single": 2.9, "scaling_target": 3.5})
+    assert bg.main([bad, "--against", bad]) == 1
+    assert "scaling" in capsys.readouterr().out
+
+
+def test_serving_gate_fails_lost_requests_and_passes_clean(tmp_path):
+    lost = _round_with_serving(tmp_path, "lost.json", {
+        "enabled": True, "requests": 10, "completed": 7, "cancelled": 1})
+    assert bg.main([lost, "--against", lost]) == 1
+    ok = _round_with_serving(tmp_path, "ok.json", {
+        "enabled": True, "requests": 10, "completed": 9, "cancelled": 1,
+        "replicas": 4, "goodput_x_single": 3.8, "scaling_target": 3.5,
+        "ttft": {"p99": 0.05}, "p99_ttft_budget": 0.2})
+    assert bg.main([ok, "--against", ok]) == 0
+    # unserved rounds ({"enabled": false}) are not gated
+    off = _round_with_serving(tmp_path, "off.json", {"enabled": False})
+    assert bg.main([off, "--against", off]) == 0
+
+
+def test_cold_start_gate_vs_reference(tmp_path, capsys):
+    """Replica cold start is gated like the compile gate: same scan
+    mode, sub-second references skipped, --compile-threshold bound."""
+    old = _round_with_serving(tmp_path, "old.json", {
+        "enabled": True, "requests": 1, "completed": 1, "cancelled": 0,
+        "cold_start_seconds": 1.5, "scan_layers": True})
+    slow = _round_with_serving(tmp_path, "slow.json", {
+        "enabled": True, "requests": 1, "completed": 1, "cancelled": 0,
+        "cold_start_seconds": 2.5, "scan_layers": True})
+    assert bg.main([slow, "--against", old]) == 1
+    assert "COLD" in capsys.readouterr().out
+    # improvement passes; a scan-mode change is not comparable
+    assert bg.main([old, "--against", slow]) == 0
+    other_mode = _round_with_serving(tmp_path, "mode.json", {
+        "enabled": True, "requests": 1, "completed": 1, "cancelled": 0,
+        "cold_start_seconds": 9.0, "scan_layers": False})
+    assert bg.main([other_mode, "--against", old]) == 0

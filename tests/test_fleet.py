@@ -1,0 +1,537 @@
+"""Fleet serving: router policies, disaggregated prefill/decode,
+speculative decoding, int8 paged KV, and the soak harness.
+
+The load-bearing guarantees (docs/SERVING.md numerics contract):
+- disaggregated output is BITWISE the single-engine output (the handoff
+  seam moves raw pages);
+- greedy speculative decoding is BITWISE plain greedy decode, and a
+  self-draft accepts 100% of its proposals (the verify pass and the
+  draft run the same math);
+- the int8 paged KV mode engages only behind the parity probe and
+  PTPU_INT8_KV=0 is the exact escape hatch;
+- routing is deterministic, prefix affinity beats round-robin on
+  grouped-prefix traffic, and a dead replica's requests replay
+  correctly elsewhere.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                          int8_kv_enabled)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny_model(seed=0, layers=2, hidden=64):
+    cfg = LlamaConfig(vocab_size=96, hidden_size=hidden,
+                      num_layers=layers, num_heads=4, num_kv_heads=2,
+                      max_seq_len=128, dropout=0.0)
+    paddle.seed(seed)
+    return LlamaForCausalLM(cfg)
+
+
+_MODEL = None
+
+
+def shared_model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = _tiny_model()
+    return _MODEL
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("max_new_tokens", 6)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _serve(target, prompts, **kw):
+    rids = [target.submit(p, **kw) for p in prompts]
+    done = target.run_until_complete()
+    return {i: done[r] for i, r in enumerate(rids)}
+
+
+def _prompts(seed=0, lens=(5, 9, 3)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 96, (n,)).tolist() for n in lens]
+
+
+def _baseline(model, prompts, **kw):
+    return _serve(_engine(model, **kw), prompts)
+
+
+# --------------------------------------------------------------- router
+class TestRouter:
+    def _replicas(self, model, n, **kw):
+        return [_engine(model, rid_base=i * 1_000_000,
+                        prefill_chunk=8, **kw) for i in range(n)]
+
+    def test_round_robin_deterministic_and_correct(self):
+        from paddle_tpu.inference.fleet import FleetRouter
+
+        model = shared_model()
+        prompts = _prompts() * 2
+        want = _baseline(model, prompts, prefill_chunk=8)
+        router = FleetRouter(self._replicas(model, 2),
+                             policy="round_robin")
+        got = _serve(router, prompts)
+        assert got == want
+        # deterministic alternation, balanced dispatch
+        assert [h.dispatched for h in router.replicas] == [3, 3]
+
+    def test_least_loaded_prefers_idle_replica(self):
+        from paddle_tpu.inference.fleet import FleetRouter
+
+        model = shared_model()
+        router = FleetRouter(self._replicas(model, 2),
+                             policy="least_loaded")
+        # preload replica 0 directly (behind the router's back)
+        for p in _prompts(1):
+            router.replicas[0].engine.submit(p)
+        rid = router.submit(_prompts(2, lens=(6,))[0])
+        assert router._inflight[rid][0] == 1   # routed to the idle one
+        router.run_until_complete()
+
+    def test_backpressure_holds_overflow_in_router(self):
+        from paddle_tpu.inference.fleet import FleetRouter
+
+        model = shared_model()
+        router = FleetRouter(self._replicas(model, 1),
+                             policy="least_loaded", max_queue_depth=1)
+        prompts = _prompts(3, lens=(5, 5, 5, 5))
+        for p in prompts:
+            router.submit(p)
+        assert len(router._pending) >= 2   # replica cap respected
+        done = router.run_until_complete()
+        assert len(done) == 4
+
+    def test_deadline_counts_router_queue_time(self):
+        """The deadline clock starts at ROUTER submit: a request whose
+        budget expires while held under backpressure is cancelled at
+        dispatch, not granted a fresh window (code-review round 2)."""
+        from paddle_tpu.inference.fleet import FleetRouter
+
+        model = shared_model()
+        router = FleetRouter(self._replicas(model, 1),
+                             policy="least_loaded", max_queue_depth=1)
+        keep = router.submit(_prompts(61, lens=(5,))[0])
+        late = router.submit(_prompts(62, lens=(5,))[0],
+                             deadline_seconds=0.0)   # expired in queue
+        assert len(router._pending) >= 1
+        done = router.run_until_complete()
+        assert keep in done
+        assert late not in done and router.cancelled[late] == "deadline"
+
+    def test_replica_death_requeues_and_completes(self):
+        from paddle_tpu.inference.fleet import FleetRouter
+
+        model = shared_model()
+        prompts = _prompts() * 2
+        want = _baseline(model, prompts, prefill_chunk=8)
+        router = FleetRouter(self._replicas(model, 2),
+                             policy="round_robin")
+        eng0 = router.replicas[0].engine
+        orig = eng0.step
+        calls = {"n": 0}
+
+        def dying_step():
+            calls["n"] += 1
+            if calls["n"] > 2:
+                raise RuntimeError("replica lost")
+            return orig()
+
+        streams = {}
+        rids = [router.submit(p, on_token=lambda r, t:
+                              streams.setdefault(r, []).append(t))
+                for p in prompts]
+        eng0.step = dying_step
+        done = router.run_until_complete()
+        got = {i: done[r] for i, r in enumerate(rids)}
+        assert got == want                 # greedy replay is invisible
+        assert not router.replicas[0].healthy
+        assert router.requeues > 0
+        # streaming stays exactly-once: a replayed request's client
+        # stream must NOT contain the delivered prefix twice
+        # (code-review round 3)
+        for i, r in enumerate(rids):
+            assert streams[r] == want[i][len(prompts[i]):], (i, streams[r])
+
+    def test_all_dead_raises(self):
+        from paddle_tpu.inference.fleet import FleetRouter
+
+        model = shared_model()
+        router = FleetRouter(self._replicas(model, 1))
+        router.submit(_prompts()[0])
+        router.replicas[0].engine.step = lambda: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        with pytest.raises(RuntimeError, match="every replica"):
+            router.run_until_complete()
+
+    def test_rejects_unknown_policy(self):
+        from paddle_tpu.inference.fleet import FleetRouter
+
+        with pytest.raises(ValueError, match="policy"):
+            FleetRouter([_engine(shared_model())], policy="random")
+
+    @pytest.mark.slow  # 4 engines with prefix caches; tier-1 time budget
+    def test_prefix_affinity_beats_round_robin(self):
+        """Grouped-prefix traffic (3 system prompts, random order): once
+        the caches are seeded, affinity routing reuses strictly more
+        cached pages than blind alternation."""
+        from paddle_tpu.inference.fleet import FleetRouter
+
+        model = shared_model()
+        groups = [list(range(1, 17)), list(range(40, 56)),
+                  list(range(60, 76))]
+
+        def drive(policy):
+            rng = np.random.default_rng(3)
+            router = FleetRouter(
+                [_engine(model, page_size=8, prefill_chunk=8,
+                         enable_prefix_cache=True,
+                         rid_base=i * 1_000_000) for i in range(2)],
+                policy=policy)
+            for base in groups:           # seed one request per group
+                router.submit(base + rng.integers(1, 96, (4,)).tolist())
+                router.run_until_complete()
+            seeded = sum(h.engine.prefix_cache_hits
+                         for h in router.replicas)
+            for _ in range(18):
+                base = groups[int(rng.integers(0, 3))]
+                router.submit(base + rng.integers(1, 96, (4,)).tolist())
+                router.step()
+            router.run_until_complete()
+            return (sum(h.engine.prefix_cache_hits
+                        for h in router.replicas) - seeded)
+
+        assert drive("prefix_affinity") > drive("round_robin")
+
+
+# --------------------------------------------- disaggregated prefill/decode
+class TestDisagg:
+    def test_bitwise_vs_single_engine(self):
+        from paddle_tpu.inference.fleet import DisaggregatedEngine
+
+        model = shared_model()
+        prompts = _prompts(5, lens=(19, 7, 26, 4))
+        want = _baseline(model, prompts, prefill_chunk=8)
+        dis = DisaggregatedEngine(model, prefill_slots=2, decode_slots=2,
+                                  page_size=16, max_seq_len=64,
+                                  max_new_tokens=6, prefill_chunk=8)
+        got = _serve(dis, prompts)
+        assert got == want
+        assert dis.handoffs == len(prompts)
+        assert dis.handoff_bytes > 0
+        # pools fully reclaimed on both halves
+        assert dis.prefill.pool.available == dis.prefill.pool.num_pages
+        assert dis.decode.pool.available == dis.decode.pool.num_pages
+
+    def test_complete_at_first_token_is_returned(self):
+        """eos on the FIRST generated token (and max_new_tokens=1):
+        nothing to decode, so the request retires on the prefill worker
+        — its completion must still come back from step() (the
+        code-review regression: prefill.step()'s returns were
+        discarded)."""
+        from paddle_tpu.inference.fleet import DisaggregatedEngine
+
+        model = shared_model()
+        prompt = _prompts(31, lens=(6,))[0]
+        base = _serve(_engine(model, prefill_chunk=8,
+                              max_new_tokens=1), [prompt])[0]
+        dis = DisaggregatedEngine(model, prefill_slots=1, decode_slots=1,
+                                  page_size=16, max_seq_len=64,
+                                  max_new_tokens=1, prefill_chunk=8)
+        got = _serve(dis, [prompt])
+        assert got[0] == base
+        assert dis.handoffs == 0          # never crossed the seam
+        # eos variant: first token == eos stops identically
+        eos = base[-1]
+        plain = _engine(model, prefill_chunk=8, max_new_tokens=6,
+                        eos_token_id=int(eos))
+        want = _serve(plain, [prompt])
+        dis2 = DisaggregatedEngine(model, prefill_slots=1, decode_slots=1,
+                                   page_size=16, max_seq_len=64,
+                                   max_new_tokens=6, prefill_chunk=8,
+                                   eos_token_id=int(eos))
+        assert _serve(dis2, [prompt]) == want
+
+    def test_submit_rejects_decode_pool_overflow(self):
+        from paddle_tpu.inference.fleet import DisaggregatedEngine
+
+        model = shared_model()
+        dis = DisaggregatedEngine(model, prefill_slots=1, decode_slots=1,
+                                  page_size=16, max_seq_len=64,
+                                  max_new_tokens=8, prefill_chunk=8,
+                                  decode_pages=1)
+        with pytest.raises(ValueError, match="decode worker pool"):
+            dis.submit(list(range(1, 30)))
+
+    def test_cancelled_dict_drains_persistently(self):
+        """`cancelled` must be poppable state (the router drains it) —
+        a per-call merged copy would grow forever under a router."""
+        from paddle_tpu.inference.fleet import DisaggregatedEngine
+
+        model = shared_model()
+        dis = DisaggregatedEngine(model, prefill_slots=1, decode_slots=1,
+                                  page_size=16, max_seq_len=64,
+                                  max_new_tokens=6, prefill_chunk=8)
+        rid = dis.submit(_prompts(33, lens=(6,))[0],
+                         deadline_seconds=0.0)
+        dis.step()
+        assert dis.cancelled.get(rid) == "deadline"
+        dis.cancelled.pop(rid)
+        assert rid not in dis.cancelled   # the pop stuck
+        assert not dis.prefill.cancelled and not dis.decode.cancelled
+
+    def test_cancel_reaches_both_halves(self):
+        from paddle_tpu.inference.fleet import DisaggregatedEngine
+
+        model = shared_model()
+        dis = DisaggregatedEngine(model, prefill_slots=1, decode_slots=1,
+                                  page_size=16, max_seq_len=64,
+                                  max_new_tokens=8, prefill_chunk=4)
+        r0 = dis.submit(list(range(1, 20)))   # long prompt: in prefill
+        r1 = dis.submit(list(range(1, 6)))
+        dis.step()
+        assert dis.cancel(r0)
+        done = dis.run_until_complete()
+        assert r1 in done and r0 not in done
+        assert r0 in dis.cancelled
+
+
+# ----------------------------------------------------- speculative decoding
+class TestSpecDecode:
+    def test_self_draft_bitwise_and_full_acceptance(self):
+        """Draft == target: every draft must be accepted (the verify
+        pass and the draft run the same math on the same KV), output
+        bitwise plain decode, and ticks collapse by ~K per tick."""
+        model = shared_model()
+        prompts = _prompts()
+        want = _baseline(model, prompts)
+        spec = _engine(model, draft_model=model, spec_tokens=3)
+        got = _serve(spec, prompts)
+        assert got == want
+        assert spec.spec_ticks > 0
+        assert spec.spec_acceptance_rate == 1.0
+
+    @pytest.mark.slow  # second model build; tier-1 time budget
+    def test_real_draft_bitwise_any_acceptance(self):
+        """An unrelated draft may be rejected every time — the OUTPUT
+        must still be bitwise plain greedy decode (the acceptance rule
+        only ever emits the target's own tokens)."""
+        model = shared_model()
+        draft = _tiny_model(seed=7, layers=1, hidden=32)
+        prompts = _prompts(9, lens=(5, 11, 3))
+        want = _baseline(model, prompts)
+        spec = _engine(model, draft_model=draft, spec_tokens=3)
+        got = _serve(spec, prompts)
+        assert got == want
+        assert spec.spec_draft_tokens > 0
+
+    @pytest.mark.slow  # tier-1 time budget
+    def test_eos_clipping_matches_plain(self):
+        model = shared_model()
+        prompt = _prompts(11, lens=(6,))[0]
+        base = _serve(_engine(model, max_new_tokens=8), [prompt])[0]
+        eos = base[len(prompt) + 2]           # stop on the 3rd token
+        plain = _engine(model, max_new_tokens=8, eos_token_id=int(eos))
+        want = _serve(plain, [prompt])
+        spec = _engine(model, max_new_tokens=8, eos_token_id=int(eos),
+                       draft_model=model, spec_tokens=3)
+        got = _serve(spec, [prompt])
+        assert got == want
+
+    @pytest.mark.slow  # mixed workload; tier-1 time budget
+    def test_fallback_ticks_keep_draft_cache_continuous(self):
+        """A sampled request forces fallback ticks mid-stream; once it
+        drains, greedy spec ticks must resume at FULL self-draft
+        acceptance — the code-review regression was permanent draft-KV
+        holes for tokens emitted during fallback."""
+        model = shared_model()
+        spec = _engine(model, max_slots=2, max_new_tokens=10,
+                       draft_model=model, spec_tokens=2)
+        greedy = _prompts(41, lens=(6,))[0]
+        sampled = _prompts(42, lens=(5,))[0]
+        # sampled gets a head start so it DRAINS while greedy is still
+        # mid-stream: the tail of greedy must then run spec ticks over
+        # draft KV written during the fallback era
+        r_s = spec.submit(sampled, temperature=0.9, top_k=8)
+        spec.step()
+        spec.step()
+        r_g = spec.submit(greedy)
+        done = spec.run_until_complete()
+        assert r_g in done and r_s in done
+        # ticks both fell back (sampled live) and speculated (after)
+        assert spec.spec_ticks > 0
+        # the greedy stream is still bitwise plain decode
+        want = _serve(_engine(model, max_new_tokens=10), [greedy])[0]
+        assert done[r_g] == want
+        # and the self-draft accepted EVERYTHING it proposed — holes in
+        # the draft cache would show up as rejections here
+        assert spec.spec_acceptance_rate == 1.0
+
+    def test_spec_headroom_rejected_at_submit(self):
+        model = shared_model()
+        spec = _engine(model, draft_model=model, spec_tokens=4,
+                       max_new_tokens=8)
+        with pytest.raises(ValueError, match="spec headroom"):
+            spec.submit(list(range(1, 54)))   # 53 + 8 + 4 > 64
+
+    def test_spec_pool_feasibility_counts_lookahead(self):
+        """The page-pool feasibility check prices the speculative
+        window too — a pool that fits the request but not its K-token
+        lookahead would deadlock _grow_pages' lone-request invariant
+        (code-review round 3)."""
+        model = shared_model()
+        spec = _engine(model, draft_model=model, spec_tokens=4,
+                       page_size=16, max_seq_len=48, max_new_tokens=8,
+                       num_pages=2)
+        # 24 + 8 = 32 tokens -> 2 pages fit; +4 spec -> 36 -> 3 pages
+        with pytest.raises(ValueError, match="speculative headroom"):
+            spec.submit(list(range(1, 25)))
+        # the same request is fine without a draft
+        plain = _engine(model, page_size=16, max_seq_len=48,
+                        max_new_tokens=8, num_pages=2)
+        plain.submit(list(range(1, 25)))
+
+    @pytest.mark.slow  # preemption + spec interaction; tier-1 time budget
+    def test_spec_with_preemption_recompute(self):
+        """A starved pool preempts mid-stream; the resumed request's
+        draft KV rebuilds at re-prefill and output stays bitwise."""
+        model = shared_model()
+        prompts = _prompts(13, lens=(10, 9, 11, 8))
+        want = _baseline(model, prompts, max_slots=4, page_size=4,
+                         max_seq_len=48, max_new_tokens=12)
+        spec = _engine(model, max_slots=4, page_size=4, max_seq_len=48,
+                       max_new_tokens=12, num_pages=17,
+                       draft_model=model, spec_tokens=2)
+        got = _serve(spec, prompts)
+        assert got == want
+        assert spec.preemptions > 0
+
+
+# ------------------------------------------------------------ int8 paged KV
+class TestInt8KV:
+    def test_gate_resolution(self, monkeypatch):
+        # not requested, no env -> off
+        monkeypatch.delenv("PTPU_INT8_KV", raising=False)
+        assert int8_kv_enabled(False) is False
+        # requested + healthy quantizer -> on
+        assert int8_kv_enabled(True) is True
+        # env forces both ways
+        monkeypatch.setenv("PTPU_INT8_KV", "0")
+        assert int8_kv_enabled(True) is False
+        monkeypatch.setenv("PTPU_INT8_KV", "1")
+        assert int8_kv_enabled(False) is True
+
+    def test_gate_defaults_off_on_drift(self, monkeypatch):
+        """The parity probe exercises the REAL quantizer: a drifting
+        implementation fails the probe and the engine serves exact KV
+        (loudly) instead."""
+        import paddle_tpu.memory as memory
+
+        monkeypatch.delenv("PTPU_INT8_KV", raising=False)
+        real = memory.quantize_rows_int8
+
+        def drifted(x, eps=1e-12):
+            q, s = real(x, eps)
+            return q, s * 1.3     # broken scales
+        monkeypatch.setattr(memory, "quantize_rows_int8", drifted)
+        with pytest.warns(UserWarning, match="parity probe"):
+            assert int8_kv_enabled(True) is False
+        eng = _engine(shared_model(), int8_kv=True)
+        assert eng.int8_kv is False
+
+    def test_int8_engine_serves_and_env_escape_is_exact(self, monkeypatch):
+        model = shared_model()
+        prompts = _prompts()
+        want = _baseline(model, prompts, prefill_chunk=8)
+        monkeypatch.delenv("PTPU_INT8_KV", raising=False)
+        eng = _engine(model, prefill_chunk=8, int8_kv=True)
+        assert eng.int8_kv is True
+        assert isinstance(eng.kc, tuple)      # codes + page-table scales
+        got = _serve(eng, prompts)
+        assert sorted(got) == sorted(want)
+        for rid in got:                       # drift-bounded, not bitwise
+            assert len(got[rid]) == len(want[rid])
+        assert eng.pool.available == eng.pool.num_pages
+        # PTPU_INT8_KV=0: the exact escape hatch is BITWISE the default
+        monkeypatch.setenv("PTPU_INT8_KV", "0")
+        exact = _engine(model, prefill_chunk=8, int8_kv=True)
+        assert exact.int8_kv is False
+        assert _serve(exact, prompts) == want
+
+    @pytest.mark.slow  # swap round-trip; tier-1 time budget
+    def test_int8_swap_roundtrip_consistent(self):
+        """Preemption-swap moves raw codes+scales through the host:
+        the restored request continues EXACTLY as an unpreempted int8
+        engine would (int8 vs int8, bitwise)."""
+        model = shared_model()
+        prompts = _prompts(3, lens=(10, 9, 11, 8))
+        kw = dict(max_slots=4, page_size=4, max_seq_len=48,
+                  max_new_tokens=12, int8_kv=True)
+        want = _serve(_engine(model, **kw), prompts)
+        tight = _engine(model, num_pages=13, preempt_policy="swap", **kw)
+        got = _serve(tight, prompts)
+        assert tight.swaps_out > 0
+        assert got == want
+
+
+# ------------------------------------------------------------- soak harness
+class TestSoak:
+    def test_build_workload_shapes(self):
+        from paddle_tpu.inference.fleet import build_workload
+
+        wl = build_workload(10, 50.0, (4, 8), 96, shared_prefix=4,
+                            deadline_seconds=9.0, seed=3)
+        assert len(wl) == 10
+        times = [t for t, _, _ in wl]
+        assert times == sorted(times) and times[0] > 0
+        for _, prompt, kw in wl:
+            assert prompt[:4] == wl[0][1][:4]      # shared prefix
+            assert kw["deadline_seconds"] == 9.0
+
+    @pytest.mark.slow  # full CLI with disagg+spec+int8; tier-1 time budget
+    def test_serve_bench_cli_full_topology(self, capsys):
+        """The module docstring's heaviest documented invocation must
+        run end to end on CPU (code-review round 2: --shared-prefix
+        past the smoke geometry crashed the first submit) and emit
+        gate-clean metric lines."""
+        import json
+
+        import tools.bench_gate as bg
+        import tools.serve_bench as sb
+
+        sb.main(["--requests", "8", "--disagg", "--spec", "--int8-kv",
+                 "--prefix-cache", "--shared-prefix", "64"])
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        assert len(lines) == 2            # r1 + r2
+        for rec in lines:
+            assert rec["serving"]["completed"] == 8
+            assert bg.serving_violations(rec) == []
+
+    @pytest.mark.slow  # full soak; tier-1 time budget
+    def test_soak_block_contract(self):
+        from paddle_tpu.inference.fleet import build_workload, soak_block
+
+        model = shared_model()
+        wl = build_workload(12, 200.0, (5, 9), 96, seed=1)
+        kw = dict(max_slots=2, page_size=8, max_seq_len=64,
+                  max_new_tokens=5, prefill_chunk=8)
+        base = soak_block(model, replicas=1, workload=wl, engine_kw=kw)
+        assert base["completed"] == 12
+        assert base["cold_start_seconds"] > 0
+        assert base["ttft"]["p99"] >= base["ttft"]["p50"]
+        block = soak_block(model, replicas=2, workload=wl, engine_kw=kw,
+                           baseline=base, ttft_budget=60.0)
+        assert block["replicas"] == 2 and block["simulated_parallel"]
+        assert block["goodput_x_single"] > 0
+        assert block["p99_ttft_budget"] == 60.0
+        import tools.bench_gate as bg
+
+        assert bg.serving_violations({"serving": block}) == []

@@ -74,20 +74,27 @@ class TestContinuousBatching:
         assert eng.pool.available == 2  # everything returned
 
     def test_eos_stops_early(self):
+        """The engine stops at the FIRST eos occurrence in the greedy
+        stream — including when eos lands on the prefill-completion
+        token (the seed's off-by-one decoded once more past eos /
+        past max_new before the retire check; ISSUE 12 fix)."""
         model = _tiny_model(2)
         rng = np.random.default_rng(2)
         prompt = rng.integers(1, 96, (4,)).tolist()
         ref = model.generate(paddle.to_tensor(
             np.asarray([prompt], np.int32)), max_new_tokens=8)
         ref_ids = np.asarray(ref.numpy())[0].tolist()
-        eos = ref_ids[len(prompt) + 2]  # the 3rd generated token
+        gen = ref_ids[len(prompt):]
+        eos = gen[2]                    # the 3rd generated token...
+        first = gen.index(eos)          # ...which may occur earlier
         eng = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
                                        max_seq_len=32, max_new_tokens=8,
                                        eos_token_id=int(eos))
         eng.submit(prompt)
         done = eng.run_until_complete()
         out = done[0]
-        assert out[-1] == eos and len(out) == len(prompt) + 3
+        assert out == prompt + gen[:first + 1]
+        assert out[-1] == eos
 
 
 def test_submit_rejects_oversized_requests():
@@ -307,6 +314,136 @@ def test_engine_rejects_bad_inputs():
                                    max_seq_len=32, max_new_tokens=4)
     with pytest.raises(ValueError, match="empty prompt"):
         eng.submit([])
+
+
+class TestDeadlinesAndCancel:
+    """ISSUE 12 satellite: a stuck client must not hold pages forever."""
+
+    def test_deadline_cancels_queued_and_running(self):
+        import paddle_tpu.telemetry as telemetry
+
+        telemetry.enable()
+        model = _tiny_model()
+        rng = np.random.default_rng(8)
+        eng = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
+                                       max_seq_len=64, max_new_tokens=8,
+                                       prefill_chunk=4)
+        # r0 fills the only slot; r1 waits queued with an expired
+        # deadline; r0's own deadline expires once it is mid-stream
+        r0 = eng.submit(rng.integers(1, 96, (6,)).tolist(),
+                        deadline_seconds=0.05)
+        r1 = eng.submit(rng.integers(1, 96, (6,)).tolist(),
+                        deadline_seconds=0.0)
+        eng.step()
+        assert eng.cancelled.get(r1) == "deadline"
+        import time as _t
+
+        _t.sleep(0.06)
+        eng.step()
+        assert eng.cancelled.get(r0) == "deadline"
+        # everything released: no slots, no pages, queue empty
+        assert all(s is None for s in eng._slots)
+        assert eng.pool.available == eng.pool.num_pages
+        assert not eng._waiting
+        snap = telemetry.snapshot()
+        series = snap["counters"].get("serving_cancellations_total", {})
+        assert any("deadline" in k for k in series), series
+
+    def test_cancel_running_request_frees_pages(self):
+        model = _tiny_model()
+        rng = np.random.default_rng(9)
+        eng = ContinuousBatchingEngine(model, max_slots=2, page_size=16,
+                                       max_seq_len=64, max_new_tokens=8)
+        keep = eng.submit(rng.integers(1, 96, (5,)).tolist())
+        drop = eng.submit(rng.integers(1, 96, (7,)).tolist())
+        eng.step()
+        assert eng.cancel(drop)
+        assert not eng.cancel(drop)            # already gone
+        done = eng.run_until_complete()
+        assert keep in done and drop not in done
+        assert eng.cancelled == {drop: "user"}
+        assert eng.pool.available == eng.pool.num_pages
+
+    def test_deadline_on_finished_request_still_completes(self):
+        """A request whose FINAL token was already delivered must
+        retire as a completion even if its deadline expires in the
+        tick gap before the retire loop runs (code-review round 2: the
+        sweep ran first and reported a fully-served request as
+        cancelled)."""
+        import time as _t
+
+        model = _tiny_model()
+        rng = np.random.default_rng(12)
+        eng = ContinuousBatchingEngine(model, max_slots=1, page_size=16,
+                                       max_seq_len=64, max_new_tokens=1,
+                                       prefill_chunk=8)
+        rid = eng.submit(rng.integers(1, 96, (5,)).tolist(),
+                         deadline_seconds=0.05)
+        eng.step()                       # prefill completes: all tokens out
+        _t.sleep(0.06)                   # deadline expires post-delivery
+        done = eng.step()
+        assert rid in done and rid not in eng.cancelled
+
+    def test_cancelled_prefix_pages_still_register(self):
+        """A cancelled request's COMPLETED prefix pages hold valid KV —
+        they register into the prefix cache and a follow-up request
+        reuses them."""
+        model = _tiny_model()
+        system = list(range(1, 13))            # 3 full pages @4
+        eng = ContinuousBatchingEngine(model, max_slots=1, page_size=4,
+                                       max_seq_len=48, max_new_tokens=6,
+                                       prefill_chunk=4,
+                                       enable_prefix_cache=True)
+        rid = eng.submit(system + [20, 21])
+        for _ in range(3):                     # part-way through prefill
+            eng.step()
+        eng.cancel(rid)
+        eng.submit(system + [30, 31])
+        eng.run_until_complete()
+        assert eng.prefix_cache_hits > 0
+
+
+class TestScanDecode:
+    """ISSUE 12 satellite: the serving forward compiles through the
+    scan-over-layers body (depth-flat replica cold start); the
+    unrolled escape hatch is bitwise."""
+
+    def test_scan_vs_unrolled_bitwise(self, monkeypatch):
+        model = _tiny_model()
+        rng = np.random.default_rng(21)
+        prompts = [rng.integers(1, 96, (n,)).tolist() for n in (5, 9)]
+
+        def serve(scan):
+            monkeypatch.setenv("PTPU_SCAN_LAYERS", scan)
+            eng = ContinuousBatchingEngine(
+                model, max_slots=2, page_size=16, max_seq_len=64,
+                max_new_tokens=6, prefill_chunk=8)
+            assert eng._scan_layers == (scan == "1")
+            for p in prompts:
+                eng.submit(p)
+            return eng.run_until_complete()
+
+        assert serve("1") == serve("0")
+
+    def test_warmup_records_build_seconds(self):
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, max_slots=2, page_size=16,
+                                       max_seq_len=64, max_new_tokens=4,
+                                       prefill_chunk=8)
+        assert eng.build_seconds is None
+        dt = eng.warmup()
+        assert dt > 0 and eng.build_seconds == dt
+        # warmup wrote only into the scratch page: a real request after
+        # warmup behaves exactly like one on a fresh engine
+        rng = np.random.default_rng(22)
+        prompt = rng.integers(1, 96, (6,)).tolist()
+        eng.submit(prompt)
+        warm = eng.run_until_complete()[0]
+        fresh = ContinuousBatchingEngine(model, max_slots=2, page_size=16,
+                                         max_seq_len=64, max_new_tokens=4,
+                                         prefill_chunk=8)
+        fresh.submit(prompt)
+        assert warm == fresh.run_until_complete()[0]
 
 
 def test_batched_prefill_single_compile_and_throughput():

@@ -179,6 +179,69 @@ def host_overhead_violations(rec, threshold=0.25):
     return out
 
 
+def serving_violations(rec):
+    """Reference-free violation strings from one record's "serving"
+    block (docs/SERVING.md; emitted by tools/serve_bench.py and
+    ``bench.py --serve``): the p99-TTFT bound and the goodput-scaling
+    target gate only when the block carries their bound — the soak run
+    embeds what it was asked to guarantee, like the comms parity block
+    embeds its threshold. A soak that lost requests (completed +
+    cancelled < submitted) also fails: silent drops are not goodput."""
+    block = rec.get("serving") if isinstance(rec, dict) else None
+    if not isinstance(block, dict) or not block.get("enabled"):
+        return []
+    out = []
+    p99 = (block.get("ttft") or {}).get("p99")
+    if p99 is None:
+        p99 = block.get("p99_ttft_seconds")
+    budget = block.get("p99_ttft_budget")
+    if p99 is not None and budget is not None and float(p99) > float(budget):
+        out.append(f"p99 TTFT {float(p99):.4f}s > budget "
+                   f"{float(budget):.4f}s")
+    x = block.get("goodput_x_single")
+    target = block.get("scaling_target")
+    if x is not None and target is not None and float(x) < float(target):
+        out.append(
+            f"goodput scaling {float(x):.2f}x single < target "
+            f"{float(target):.2f}x at {block.get('replicas')} replicas")
+    reqs = block.get("requests")
+    done = block.get("completed")
+    cancelled = block.get("cancelled") or 0
+    if reqs is not None and done is not None and (
+            int(done) + int(cancelled) < int(reqs)):
+        out.append(f"soak lost requests: {done} completed + {cancelled} "
+                   f"cancelled < {reqs} submitted")
+    return out
+
+
+def cold_start_violations(rec, ref_rec, threshold=0.25):
+    """Referenced gate on the serving block's replica cold start
+    (engine construction + program compile, ``warmup()``): must not
+    regress more than ``threshold`` vs the reference round at the SAME
+    scan-over-layers mode — the depth-flat serving compile guarantee
+    (docs/SERVING.md). Sub-second references are noise and skipped."""
+    new_b = rec.get("serving") if isinstance(rec, dict) else None
+    old_b = ref_rec.get("serving") if isinstance(ref_rec, dict) else None
+    if not isinstance(new_b, dict) or not isinstance(old_b, dict):
+        return []
+    if bool(new_b.get("scan_layers")) != bool(old_b.get("scan_layers")):
+        return []
+    try:
+        old = float(old_b.get("cold_start_seconds"))
+        new = float(new_b.get("cold_start_seconds"))
+    except (TypeError, ValueError):
+        return []
+    if old < 1.0:
+        return []
+    out = []
+    if new > old * (1.0 + threshold):
+        out.append(
+            f"replica cold start {new:.1f}s > {1.0 + threshold:.2f}x "
+            f"reference {old:.1f}s "
+            f"(scan_layers={bool(new_b.get('scan_layers'))})")
+    return out
+
+
 def mfu_violations(rec, ref_rec, threshold):
     """Violation strings comparing one metric's ``mfu`` field against the
     reference round's (docs/ZERO.md satellite: the stage-3 config-5 line
@@ -347,6 +410,11 @@ def main(argv=None):
         for v in host_overhead_violations(rec, args.host_threshold):
             print(f"  HOST  {metric}: {v}", flush=True)
             failed = True
+        # serving gate (reference-free): p99-TTFT bound + goodput
+        # scaling target + no lost requests (docs/SERVING.md)
+        for v in serving_violations(rec):
+            print(f"  SERVE {metric}: {v}", flush=True)
+            failed = True
     for ref_path in refs:
         ref_metrics = load_metrics(ref_path)
         print(f"bench_gate: {os.path.basename(candidate)} vs "
@@ -376,6 +444,12 @@ def main(argv=None):
             for v in mfu_violations(rec, ref_metrics.get(metric),
                                     args.threshold):
                 print(f"  MFU {metric}: {v}", flush=True)
+                failed = True
+            # serving cold-start gate (docs/SERVING.md): replica
+            # spin-up compile must stay depth-flat round over round
+            for v in cold_start_violations(rec, ref_metrics.get(metric),
+                                           args.compile_threshold):
+                print(f"  COLD  {metric}: {v}", flush=True)
                 failed = True
     return 1 if failed else 0
 

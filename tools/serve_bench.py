@@ -1,141 +1,175 @@
-"""Continuous-batching serving throughput on the real chip.
+"""Fleet serving soak benchmark (docs/SERVING.md soak recipe).
 
-r3 weak #9 / r4: the serving stack (batched chunked prefill + paged
-decode) had no recorded on-chip throughput. Run from /root/repo:
-    python tools/serve_bench.py [--policy recompute|swap] [--roomy]
-        [--prefix-cache] [--shared-prefix N] [--prompt-len M]
-Prints tok/s at several concurrency levels for a 1.3B-class decoder.
---policy picks the preemption strategy for the tight-pool regime;
---roomy sizes the pool at worst case (no preemption) instead;
---shared-prefix N makes every prompt share its first N tokens (a system
-prompt), the workload where --prefix-cache (automatic prefix caching)
-skips the shared prefill;
---ttft measures median time-to-first-token for single shared-prefix
-requests on a WARM engine (compile + cache seeded first) instead of
-batch throughput — the metric prefix caching targets.
+Drives Poisson-arrival synthetic traffic (mixed prompt lengths,
+optional shared system prefix / sampled fraction / deadlines) against
+1..N engine replicas behind a FleetRouter and prints ONE JSON metric
+line per replica count:
+
+    {"metric": "serve_goodput_tokens_per_sec_rN", "value": <goodput>,
+     "unit": "tokens/sec", "serving": {<gateable block>}}
+
+``tools/bench_gate.py`` consumes these lines like any bench artifact:
+reference-free gates on ``p99_ttft_seconds`` vs ``p99_ttft_budget``
+(derived from the single-replica run's p50 unless --ttft-budget pins
+it) and ``goodput_x_single`` vs ``--scaling-target`` (the acceptance
+bar: 4 replicas >= 3.5x single-replica goodput), plus a referenced
+cold-start gate at the same scan mode.
+
+Goodput and TTFT run on the soak harness's simulated-parallel clock
+(replicas tick concurrently in deployment; see
+paddle_tpu/inference/fleet/soak.py). Run from /root/repo:
+
+    python tools/serve_bench.py                      # CPU smoke, r1+r2
+    python tools/serve_bench.py --replicas 1 4 --requests 2000 \
+        --scaling-target 3.5                         # the soak gate run
+    python tools/serve_bench.py --disagg --spec --int8-kv \
+        --prefix-cache --shared-prefix 64            # full topology
 """
 from __future__ import annotations
 
+import argparse
+import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.getcwd())
 
 import numpy as np
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fleet serving soak benchmark (docs/SERVING.md)")
+    ap.add_argument("--replicas", type=int, nargs="+", default=None,
+                    help="replica counts to sweep (default: 1 2 on CPU, "
+                    "1 4 on TPU; 1 is always prepended as the baseline)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="synthetic requests per sweep point "
+                    "(default 96 CPU / 2000 TPU)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="Poisson arrival rate, req/sim-second "
+                    "(default: saturating)")
+    ap.add_argument("--policy", default="least_loaded",
+                    help="router policy: least_loaded | round_robin | "
+                    "prefix_affinity")
+    ap.add_argument("--disagg", action="store_true",
+                    help="replicas are disaggregated prefill/decode pairs")
+    ap.add_argument("--spec", action="store_true",
+                    help="attach a 1-layer draft model (speculative "
+                    "decoding) to every replica")
+    ap.add_argument("--spec-tokens", type=int, default=3)
+    ap.add_argument("--int8-kv", action="store_true",
+                    help="request the int8 paged KV mode (engages only "
+                    "behind the parity probe; PTPU_INT8_KV overrides)")
+    ap.add_argument("--prefix-cache", action="store_true")
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="tokens of shared system prompt per request")
+    ap.add_argument("--sampled-fraction", type=float, default=0.0)
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request deadline_seconds")
+    ap.add_argument("--scaling-target", type=float, default=None,
+                    help="gate: multi-replica goodput must reach this "
+                    "multiple of the single-replica run (e.g. 3.5 at 4 "
+                    "replicas)")
+    ap.add_argument("--ttft-budget", type=float, default=None,
+                    help="gate: absolute p99 TTFT bound in sim-seconds "
+                    "(default: 10x the single-replica p50)")
+    ap.add_argument("--ttft-budget-x", type=float, default=10.0,
+                    help="derived budget = this x single-replica p50")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
     import jax
 
     import paddle_tpu as paddle
-    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.inference.fleet import build_workload, soak_block
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-
-    policy = "recompute"
-    if "--policy" in sys.argv:
-        i = sys.argv.index("--policy")
-        if i + 1 >= len(sys.argv) or sys.argv[i + 1] not in (
-                "recompute", "swap"):
-            sys.exit("--policy requires a value: recompute | swap")
-        policy = sys.argv[i + 1]
-    roomy = "--roomy" in sys.argv
-    prefix_cache = "--prefix-cache" in sys.argv
-    shared_prefix = 0
-    if "--shared-prefix" in sys.argv:
-        shared_prefix = int(sys.argv[sys.argv.index("--shared-prefix") + 1])
-    prompt_len_arg = 0
-    if "--prompt-len" in sys.argv:
-        prompt_len_arg = int(sys.argv[sys.argv.index("--prompt-len") + 1])
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           num_layers=16, num_heads=16, max_seq_len=1024,
                           dropout=0.0)
-        new_tokens, prompt_len = 64, 128
+        requests = args.requests or 2000
+        prompt_lens = (64, 128, 256, 512)
+        max_new, page, slots, chunk, max_seq = 64, 64, 16, 128, 1024
+        replica_counts = args.replicas or [1, 4]
     else:
         cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
-                          num_heads=4, max_seq_len=128, dropout=0.0)
-        new_tokens, prompt_len = 8, 16
-    if prompt_len_arg:
-        prompt_len = prompt_len_arg
-        if prompt_len + new_tokens > cfg.max_seq_len:
-            cfg.max_seq_len = prompt_len + new_tokens
+                          num_heads=4, num_kv_heads=2, max_seq_len=128,
+                          dropout=0.0)
+        requests = args.requests or 96
+        prompt_lens = (6, 10, 14, 20)
+        max_new, page, slots, chunk, max_seq = 8, 8, 4, 8, 64
+        replica_counts = args.replicas or [1, 2]
+    if replica_counts[0] != 1:
+        replica_counts = [1] + list(replica_counts)
+    # a shared prefix longer than the drawn prompt length yields
+    # prefix+1 tokens — grow the sequence geometry to fit the longest
+    # possible prompt + generation (+ spec headroom) instead of
+    # crashing the first submit
+    max_prompt = max(max(prompt_lens), args.shared_prefix + 1)
+    need = max_prompt + max_new + (args.spec_tokens if args.spec else 0)
+    if need > max_seq:
+        max_seq = need
+        cfg.max_seq_len = max(cfg.max_seq_len, max_seq)
 
-    paddle.seed(0)
+    paddle.seed(args.seed)
     model = LlamaForCausalLM(cfg)
     if on_tpu:
         for _, p in model.named_parameters():
             p._data = p._data.astype(jax.numpy.bfloat16)
-    rng = np.random.default_rng(0)
+    draft = None
+    if args.spec:
+        dcfg = LlamaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size // 2,
+            num_layers=1, num_heads=max(1, cfg.num_heads // 2),
+            num_kv_heads=max(1, cfg.num_kv_heads // 2),
+            max_seq_len=cfg.max_seq_len, dropout=0.0)
+        paddle.seed(args.seed + 1)
+        draft = LlamaForCausalLM(dcfg)
+        if on_tpu:
+            for _, p in draft.named_parameters():
+                p._data = p._data.astype(jax.numpy.bfloat16)
 
-    if "--ttft" in sys.argv:
-        shared = shared_prefix or (prompt_len - prompt_len // 8)
-        sys_prompt = list(rng.integers(1, cfg.vocab_size, shared))
+    workload = build_workload(
+        requests, args.rate or (requests * 4.0), prompt_lens,
+        cfg.vocab_size, shared_prefix=args.shared_prefix,
+        sampled_fraction=(0.0 if args.spec else args.sampled_fraction),
+        deadline_seconds=args.deadline, seed=args.seed)
 
-        def tail():
-            return list(rng.integers(1, cfg.vocab_size,
-                                     prompt_len - shared))
+    engine_kw = dict(max_seq_len=max_seq, max_new_tokens=max_new,
+                     prefill_chunk=chunk, int8_kv=args.int8_kv,
+                     spec_tokens=args.spec_tokens)
+    disagg_kw = None
+    if args.disagg:
+        disagg_kw = dict(prefill_slots=max(2, slots // 2),
+                         decode_slots=slots, page_size=page,
+                         enable_prefix_cache=args.prefix_cache)
+    else:
+        engine_kw.update(max_slots=slots, page_size=page,
+                         enable_prefix_cache=args.prefix_cache)
 
-        eng = ContinuousBatchingEngine(
-            model, max_slots=4, page_size=64,
-            max_new_tokens=min(new_tokens, 8), prefill_chunk=64,
-            enable_prefix_cache=prefix_cache)
-        eng.submit(sys_prompt + tail())     # warm: compile + seed cache
-        eng.run_until_complete(max_ticks=100000)
-        samples = []
-        for _ in range(7):
-            got = []
-            eng.submit(sys_prompt + tail(),
-                       on_token=lambda r, t: got.append(
-                           time.perf_counter()))
-            t0 = time.perf_counter()
-            while not got:
-                eng.step()
-            samples.append(got[0] - t0)
-            eng.run_until_complete(max_ticks=100000)
-        med = sorted(samples)[len(samples) // 2]
-        print(f"ttft: shared {shared}/{prompt_len} tokens, "
-              f"prefix_cache={prefix_cache}: median "
-              f"{med * 1000:.0f}ms over {len(samples)} "
-              f"({[int(s * 1000) for s in samples]}ms, "
-              f"cache hits {eng.prefix_cache_hits} pages)", flush=True)
-        return
-
-    for slots in (8, 16, 32) if on_tpu else (2, 4):
-        # r5: pool sized BELOW worst-case — prompt pages for every slot
-        # plus ~half the decode growth — so incremental allocation +
-        # preemption carry the load instead of head-of-line blocking on
-        # worst-case reservations
-        per_seq_worst = -(-(prompt_len + new_tokens) // 64)
-        prompt_pages = -(-prompt_len // 64)
-        grow = per_seq_worst - prompt_pages
-        tight = max(slots * prompt_pages + (slots * grow) // 2,
-                    per_seq_worst) + 1
-        if roomy:
-            tight = slots * per_seq_worst + 2
-        eng = ContinuousBatchingEngine(
-            model, max_slots=slots, page_size=64, num_pages=tight,
-            max_new_tokens=new_tokens, prefill_chunk=64,
-            preempt_policy=policy, enable_prefix_cache=prefix_cache)
-        n_req = slots * 2
-        sys_prompt = list(rng.integers(1, cfg.vocab_size, shared_prefix))
-        for _ in range(n_req):
-            tail = list(rng.integers(1, cfg.vocab_size,
-                                     prompt_len - shared_prefix))
-            eng.submit(sys_prompt + tail)
-        t0 = time.perf_counter()
-        done = eng.run_until_complete(max_ticks=100000)
-        dt = time.perf_counter() - t0
-        gen = sum(len(v) - prompt_len for v in done.values())
-        print(f"slots={slots}: {n_req} reqs x {prompt_len}p+{new_tokens}g"
-              f" -> {gen} generated in {dt:.1f}s = {gen / dt:.1f} tok/s"
-              f" (prefill passes: {eng.prefill_chunk_steps},"
-              f" preemptions: {eng.preemptions},"
-              f" swaps: {eng.swaps_out},"
-              f" cache hits: {eng.prefix_cache_hits} pages,"
-              f" policy: {policy}, pool: {tight} pages)", flush=True)
+    baseline = None
+    for n in replica_counts:
+        budget = args.ttft_budget
+        if budget is None and baseline is not None:
+            p50 = (baseline.get("ttft") or {}).get("p50")
+            budget = args.ttft_budget_x * p50 if p50 else None
+        block = soak_block(
+            model, replicas=n, workload=workload, policy=args.policy,
+            disagg=args.disagg, draft_model=draft, engine_kw=engine_kw,
+            disagg_kw=disagg_kw, baseline=baseline,
+            scaling_target=(args.scaling_target if n > 1 else None),
+            ttft_budget=(budget if n > 1 or args.ttft_budget else None))
+        if baseline is None:
+            baseline = block
+        print(json.dumps({
+            "metric": f"serve_goodput_tokens_per_sec_r{n}",
+            "value": block.get("goodput_tokens_per_sec"),
+            "unit": "tokens/sec",
+            "serving": block,
+        }), flush=True)
 
 
 if __name__ == "__main__":

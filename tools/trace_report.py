@@ -146,6 +146,47 @@ def request_stats(events):
             for name, ds in durs.items()}, unclosed
 
 
+_SERVE_SPANS = ("admission", "prefill_group", "prefill_tick",
+                "decode_tick", "spec_draft", "spec_verify", "detokenize")
+_SERVE_ASYNC = ("request", "route", "queue", "prefill")
+
+
+def serving_stats(events):
+    """Aggregate the serving span contract (docs/TELEMETRY.md Tracing,
+    docs/SERVING.md): engine tick phases, per-request async spans
+    (route/queue/prefill/request), handoff transfers, and speculative-
+    decode acceptance from the ``spec_accept`` instants. None when the
+    trace carries no serving activity."""
+    ticks = {}
+    for e in events:
+        if e["ph"] == "X" and e["name"] in _SERVE_SPANS:
+            row = ticks.setdefault(e["name"], {"count": 0, "seconds": 0.0})
+            row["count"] += 1
+            row["seconds"] += e["dur"]
+    reqs, _unclosed = request_stats(events)
+    async_rows = {n: reqs[n] for n in _SERVE_ASYNC if n in reqs}
+    handoffs = {"count": 0, "bytes": 0}
+    spec = {"accepted": 0, "drafted": 0}
+    for e in events:
+        attrs = e.get("attrs") or {}
+        if e["ph"] == "n" and e["name"] == "handoff":
+            handoffs["count"] += 1
+            handoffs["bytes"] += int(attrs.get("bytes") or 0)
+        elif e["ph"] in ("i", "I") and e["name"] == "spec_accept":
+            spec["accepted"] += int(attrs.get("accepted") or 0)
+            spec["drafted"] += int(attrs.get("drafted") or 0)
+    if not ticks and not async_rows and not handoffs["count"]:
+        return None
+    out = {"ticks": ticks, "requests": async_rows}
+    if handoffs["count"]:
+        out["handoffs"] = handoffs
+    if spec["drafted"]:
+        spec["acceptance_rate"] = round(spec["accepted"]
+                                        / spec["drafted"], 4)
+        out["spec"] = spec
+    return out
+
+
 def print_summary(path, events, out=None):
     w = (out or sys.stdout).write
     w(f"{path}: {len(events)} events\n")
@@ -170,6 +211,23 @@ def print_summary(path, events, out=None):
               f"mean={r['mean_seconds']:.6f}s\n")
         if unclosed:
             w(f"  (unclosed spans: {unclosed})\n")
+    serve = serving_stats(events)
+    if serve:
+        w("-- serving --\n")
+        for name, row in sorted(serve["ticks"].items(),
+                                key=lambda kv: -kv[1]["seconds"]):
+            w(f"  {name}: n={row['count']} "
+              f"total={row['seconds']:.6f}s\n")
+        for name, row in sorted(serve["requests"].items()):
+            w(f"  {name} (async): n={row['count']} "
+              f"mean={row['mean_seconds']:.6f}s\n")
+        if "handoffs" in serve:
+            h = serve["handoffs"]
+            w(f"  handoffs: n={h['count']} bytes={h['bytes']}\n")
+        if "spec" in serve:
+            s = serve["spec"]
+            w(f"  spec: accepted {s['accepted']}/{s['drafted']} "
+              f"(rate {s['acceptance_rate']})\n")
 
 
 def diff(old_events, new_events, top=15, out=None):
