@@ -51,6 +51,127 @@ def _serving_smoke_block():
     return block
 
 
+def run_long_context(ckpt=None):
+    """Long-context bench line (``*_seq32k``, docs/ATTENTION.md): the
+    train step over a ``sep`` mesh with the ring-attention plan engaged
+    — 32k tokens per sequence on TPU, a reduced-length CPU smoke
+    otherwise (the honest-smoke discipline of BENCH_r06). Emits ONE
+    JSON metric line whose ``"ring"`` block carries the plan summary
+    and the ring-vs-dense parity probe ``tools/bench_gate.py`` gates
+    reference-free; tokens/sec gates against earlier rounds like every
+    metric line."""
+    import time as _time
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    import paddle_tpu as paddle
+    import paddle_tpu.telemetry as telemetry
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
+
+    telemetry.enable()
+    telemetry.reset()
+    n_dev = len(jax.devices())
+    seq_env = os.environ.get("PTPU_BENCH_LONG_SEQ")
+    if on_tpu:
+        # GPT-1.3B arch at 32k context, batch 1: flash keeps attention
+        # O(S) so the activation budget is the residual stream, not a
+        # [32k, 32k] score matrix (asserted to not exist by the tests)
+        cfg = GPTConfig(vocab_size=32000, hidden_size=2048, num_layers=24,
+                        num_heads=16, max_seq_len=32768, dropout=0.0,
+                        dtype="bfloat16", recompute=True,
+                        recompute_policy="names:attn_res,attn_lse,attn_q,"
+                        "attn_k,attn_v,resid_mid")
+        seq, steps, batch = int(seq_env or 32768), 5, 1
+        os.environ.setdefault("PTPU_PALLAS_RMS", "1")
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=512, dropout=0.0)
+        seq, steps, batch = int(seq_env or 512), 3, 2
+    # sep = the largest device count that zigzag-divides the sequence
+    sep = n_dev
+    while sep > 1 and seq % (2 * sep):
+        sep -= 1
+    mesh = None
+    if sep >= 2:
+        from paddle_tpu.distributed import fleet as _fleet
+
+        strategy = _fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": n_dev // sep,
+                                   "mp_degree": 1, "pp_degree": 1,
+                                   "sharding_degree": 1, "sep_degree": sep}
+        _fleet.init(is_collective=True, strategy=strategy)
+        mesh = _fleet.get_fleet_mesh()
+
+    with paddle.amp.auto_cast(enable=on_tpu, dtype="bfloat16", level="O2"):
+        model = GPTForCausalLMPipe(cfg)
+    if on_tpu:
+        for _, p in model.named_parameters():
+            p._data = p._data.astype(jax.numpy.bfloat16)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+
+    def train_fn(ids, labels):
+        return model.loss(ids, labels)
+
+    if mesh is not None:
+        from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+        step = ShardedTrainStep(model, train_fn, opt, mesh)
+    else:
+        step = TrainStep(model, train_fn, opt)
+
+    rng = np.random.default_rng(0)
+    dp = (n_dev // sep) if mesh is not None else 1
+    rows = max(batch, dp)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (rows, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (rows, seq)).astype(np.int64))
+    loss = step(ids, labels)                   # compile + warmup
+    _ = float(loss.numpy())
+    t0 = _time.perf_counter()
+    for _i in range(steps):
+        loss = step(ids, labels)
+    _ = float(loss.numpy())
+    dt = _time.perf_counter() - t0
+    tokens_per_sec = rows * seq * steps / dt
+
+    from paddle_tpu.distributed import collectives as _coll
+
+    plan = step.ring_plan() if hasattr(step, "ring_plan") else None
+    engaged = bool(getattr(step, "_ring_last_active", False))
+    ring_block = {
+        "enabled": plan is not None,
+        "engaged": engaged,
+        "seq": seq,
+        "parity": _coll.ring_parity_probe(mesh),
+    }
+    if plan is not None:
+        ring_block.update(plan.summary())
+
+    n_params = sum(int(np.prod(p.shape))
+                   for _, p in model.named_parameters())
+    peak = 197e12 if on_tpu else 1e12
+    mfu = 6.0 * n_params * tokens_per_sec / peak
+    print(json.dumps({
+        "metric": "gpt_long_context_tokens_per_sec_seq32k",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "seq": seq,
+        "note": (None if on_tpu and seq >= 32768 else
+                 f"reduced-length smoke (seq {seq}, {jax.default_backend()}"
+                 ") — the 32k TPU number needs a TPU round"),
+        "mfu": round(mfu, 4),
+        "vs_baseline": round(mfu, 4),
+        # ring plan + reference-free parity probe (docs/ATTENTION.md;
+        # gated by bench_gate's RING gate)
+        "ring": ring_block,
+        "telemetry": telemetry.snapshot(),
+    }), flush=True)
+
+
 def run_model(model_kind, ckpt=None):
     import jax
 
@@ -659,6 +780,13 @@ def main():
                     help="StepGuard anomaly policy + hang watchdog around "
                     "the timed loop (docs/RESILIENCE.md); decision totals "
                     "land in the JSON 'resilience' block")
+    ap.add_argument("--long-context", action="store_true",
+                    default=os.environ.get("PTPU_BENCH_LONG", "")
+                    not in ("", "0"),
+                    help="additionally emit the *_seq32k long-context "
+                    "metric line: ring attention over a sep mesh "
+                    "(32k tokens on TPU; reduced-length CPU smoke) — "
+                    "docs/ATTENTION.md")
     args = ap.parse_args()
 
     # surface which attention path ran (proof the Pallas kernel engaged)
@@ -668,12 +796,18 @@ def main():
     on_tpu = jax.default_backend() not in ("cpu",)
     kind = os.environ.get("PTPU_BENCH_MODEL")
     if kind is not None or not on_tpu:
+        if args.long_context:
+            run_long_context(ckpt=args)
+            gc.collect()
         run_model(kind or "gpt", ckpt=args)
         return
     # default driver run: BOTH tracked lines — config-5 (LLaMA-arch)
     # FIRST, the headline GPT line LAST so the parsed metric stays stable
     run_model("llama", ckpt=args)
     gc.collect()
+    if args.long_context:
+        run_long_context(ckpt=args)
+        gc.collect()
     run_model("gpt", ckpt=args)
 
 

@@ -75,6 +75,12 @@ from .zero import (  # noqa: F401
     resolve_stage,
     zero_mode_enabled,
 )
+from .ring_attention import (  # noqa: F401
+    RingAttnPlan,
+    build_ring_attn_plan,
+    ring_attn_enabled,
+    ring_parity_probe,
+)
 
 __all__ = [
     "quant_collectives_enabled", "grads_quantized", "manual_grad_region",
@@ -84,6 +90,8 @@ __all__ = [
     "plan_tp_seams", "TPSeamPlan", "comms_summary", "parity_probe",
     "PARITY_THRESHOLD", "ZeroPlan", "ZeroParam", "build_zero_plan",
     "resolve_stage", "zero_mode_enabled", "note_zero_step",
+    "RingAttnPlan", "build_ring_attn_plan", "ring_attn_enabled",
+    "ring_parity_probe", "note_ring_attn",
 ]
 
 
@@ -266,6 +274,48 @@ def note_zero_step(plan):
         _ZERO_RS.inc(rs_exact, labels=(ax, "0"))
     if rs_q:
         _ZERO_RS.inc(rs_q, labels=(ax, "1"))
+
+
+# Ring-attention KV rotation traffic (docs/ATTENTION.md,
+# docs/TELEMETRY.md): bytes of KV (fwd) and KV+grad-accumulator (bwd)
+# blocks rotated around the sep ring per executed step — the same
+# static-per-plan host-side basis as note_grad_reduce.
+_RING_KV = _telemetry.counter(
+    "ring_attn_kv_bytes_total",
+    "KV block bytes rotated around the sep ring per executed step "
+    "(phase=fwd: k+v over n-1 hops; phase=bwd: k+v over n-1 hops plus "
+    "the traveling dk/dv accumulators over n hops — the final hop "
+    "carries only the accumulators home; 4B/elem payload basis)",
+    labelnames=("axis", "phase"))
+
+
+def note_ring_attn(plan):
+    """Tick the per-step ring-attention traffic accounting for one
+    executed step under an engaged RingAttnPlan (no-op for None or a
+    plan whose trace never routed attention)."""
+    if plan is None or not plan.seq_local:
+        return
+    tr = _telemetry.trace
+    if tr.enabled():
+        hop_bytes = plan.kv_block_bytes * plan.layers
+        for hop in range(1, plan.sep_degree):
+            tr.instant("collective:ring_attn",
+                       {"op": "ppermute", "axis": plan.axis,
+                        "phase": "fwd", "hop": hop, "bytes": hop_bytes},
+                       cat="comms")
+        for hop in range(plan.sep_degree):
+            last = hop == plan.sep_degree - 1
+            tr.instant("collective:ring_attn",
+                       {"op": "ppermute", "axis": plan.axis,
+                        "phase": "bwd", "hop": hop,
+                        "bytes": hop_bytes if last else 2 * hop_bytes},
+                       cat="comms")
+    if not _telemetry.get_registry().enabled:
+        return
+    if plan.fwd_rotate_bytes:
+        _RING_KV.inc(plan.fwd_rotate_bytes, labels=(plan.axis, "fwd"))
+    if plan.bwd_rotate_bytes:
+        _RING_KV.inc(plan.bwd_rotate_bytes, labels=(plan.axis, "bwd"))
 
 
 def build_grad_reduce_plan(named_params, mesh, *, exclude_axes=(),

@@ -42,8 +42,13 @@ def _param_sharding(mesh: ProcessMesh, p) -> NamedSharding:
 
 
 def _batch_spec(mesh: ProcessMesh, arr) -> NamedSharding:
-    """Shard batch dim 0 over every data-ish axis present (dp, sharding, sep)."""
-    axes = [a for a in ("dp", "sharding", "sep") if a in mesh.dim_names and mesh.get_dim_size(a) > 1]
+    """Shard batch dim 0 over every data-ish axis present (dp, sharding,
+    sep). With an ENGAGED ring-attention plan (docs/ATTENTION.md) sep
+    stops being a batch axis — the batch routes through
+    ``_place_batch_ring`` / ``_ring_batch_sharding`` instead and never
+    reaches this function."""
+    axes = [a for a in ("dp", "sharding", "sep")
+            if a in mesh.dim_names and mesh.get_dim_size(a) > 1]
     if not axes or arr.ndim == 0:
         return NamedSharding(mesh.jax_mesh, P())
     total = int(np.prod([mesh.get_dim_size(a) for a in axes]))
@@ -97,6 +102,14 @@ class ShardedTrainStep(TrainStep):
         # keeps the pre-PR GSPMD grad psum byte-for-byte
         self._reduce_plan = None
         self._reduce_plan_ready = False
+        # ring-attention plan (collectives/ring_attention,
+        # docs/ATTENTION.md): when it engages, sep stops being a batch
+        # axis — the batch's SEQ dim shards over it (zigzag layout) and
+        # attention runs as a kv ring inside the manual region. None
+        # keeps sep a plain batch axis, byte-for-byte (PTPU_RING_ATTN=0).
+        self._ring_plan = None
+        self._ring_plan_ready = False
+        self._ring_last_active = False
 
     # -- placement ---------------------------------------------------------
     def _place_model(self):
@@ -159,6 +172,10 @@ class ShardedTrainStep(TrainStep):
                 )
 
     def _place_batch(self, raw_batch):
+        ring, ring_seq = self._ring_batch_info(raw_batch)
+        self._ring_last_active = ring is not None
+        if ring is not None:
+            return self._place_batch_ring(raw_batch, ring, ring_seq)
         placed = []
         for arr in raw_batch:
             if isinstance(arr, jax.ShapeDtypeStruct):
@@ -173,6 +190,61 @@ class ShardedTrainStep(TrainStep):
             else:
                 placed.append(arr)
         return tuple(placed)
+
+    def _place_batch_ring(self, raw_batch, plan, seq):
+        """Ring placement (docs/ATTENTION.md): seq-dim arrays are
+        zigzag-permuted (causal load balance — each rank holds chunk r
+        and chunk 2n-1-r) and shard dim 1 over ``sep``; dim 0 shards
+        over the remaining data axes only. Loss/grads are permutation-
+        invariant (per-token CE over the same token set), so nothing
+        un-permutes on the way out."""
+        from .collectives import ring_attention as _ring
+
+        plan.set_active_seq(seq)
+        perm = jnp.asarray(_ring.zigzag_perm(seq, plan.sep_degree))
+        placed = []
+        for arr in raw_batch:
+            if not hasattr(arr, "ndim") or arr.ndim == 0:
+                placed.append(arr)
+                continue
+            sh = self._ring_batch_sharding(plan, arr, seq)
+            if isinstance(arr, jax.ShapeDtypeStruct):
+                placed.append(jax.ShapeDtypeStruct(
+                    tuple(arr.shape), arr.dtype, sharding=sh))
+                continue
+            if arr.ndim >= 2 and arr.shape[1] == seq:
+                arr = jnp.take(arr, perm, axis=1)
+            placed.append(jax.device_put(arr, sh))
+        return tuple(placed)
+
+    def _ring_batch_sharding(self, plan, arr, seq):
+        data = plan.data_axes
+        total = int(np.prod([self.mesh.get_dim_size(a) for a in data])) \
+            if data else 1
+        dim0 = (tuple(data) if data and arr.shape[0] % total == 0
+                else None)
+        if arr.ndim >= 2 and arr.shape[1] == seq:
+            return NamedSharding(self.mesh.jax_mesh, P(dim0, plan.axis))
+        return NamedSharding(self.mesh.jax_mesh,
+                             P(dim0) if dim0 else P())
+
+    def _ring_batch_info(self, raw_batch):
+        """(plan, seq) when the resolved ring plan engages for this
+        batch's shapes, else (None, None). Shared by placement and the
+        in-step region so the two can never disagree: every ndim>=2
+        leaf must carry the SAME dim-1 length and it must pass the
+        plan's seq gate (zigzag divisibility + kernel tiling)."""
+        plan = self._ensure_ring_plan()
+        if plan is None:
+            return None, None
+        seqs = [int(a.shape[1]) for a in raw_batch
+                if hasattr(a, "ndim") and a.ndim >= 2]
+        if not seqs:
+            return None, None
+        seq = seqs[0]
+        if any(s != seq for s in seqs) or not plan.seq_ok(seq):
+            return None, None
+        return plan, seq
 
     def _prepare_batch(self, raw_batch):
         """memory_stats hook: mirror __call__'s placement so the lowered
@@ -497,8 +569,144 @@ class ShardedTrainStep(TrainStep):
 
     def comms_plan(self):
         """The active grad-reduce plan (None = pre-PR GSPMD path) — the
-        bench/dryrun "comms" block embeds its summary()."""
+        bench/dryrun "comms" block embeds its summary(). An engaged ring
+        plan owns its own composed reduce (axes = data + sep)."""
+        if self._ring_last_active and self._ring_plan is not None:
+            return self._ring_plan.reduce
         return self._reduce_plan if self._reduce_plan_ready else None
+
+    # -- ring attention over sep (collectives/ring_attention) --------------
+    def _ensure_ring_plan(self):
+        """Resolve (once, at build) whether this step runs context
+        parallelism as ring attention over ``sep`` (docs/ATTENTION.md).
+        Declines — keeping sep a plain batch axis and the program
+        byte-for-byte pre-PR — on: the PTPU_RING_ATTN=0 escape hatch,
+        checkify debug mode, ZeRO stage >= 2 (the zero mode owns the
+        manual region, and itself declines sep-live meshes), a vocab-
+        sharded head (its shard_map island cannot nest in ours), any
+        live axis outside {dp, sharding, sep}, and models without a
+        ring-eligible decoder stack."""
+        if self._ring_plan_ready:
+            return self._ring_plan
+        self._ring_plan_ready = True
+        self._ring_plan = None
+        from ..utils.flags import get_flags
+        from .collectives import ring_attention as _ring
+        from .collectives import zero as _zero
+
+        if ("sep" not in self.mesh.dim_names
+                or self.mesh.get_dim_size("sep") < 2):
+            return None
+        if not _ring.ring_attn_enabled():
+            return None
+        if get_flags("check_nan_inf")["check_nan_inf"]:
+            return None
+        if _zero.resolve_stage(self.optimizer, self.sharding_stage) >= 2:
+            return None
+        if (self.shard_vocab_head
+                and self.shard_vocab_head in self.mesh.dim_names
+                and self.mesh.get_dim_size(self.shard_vocab_head) > 1):
+            return None
+        entries = self.model.state_dict()
+        if not self._param_names:
+            self._param_names = [
+                n for n, t in entries.items()
+                if isinstance(t, Parameter) and t.trainable]
+        named = [(n, tuple(entries[n]._data.shape), entries[n]._data.dtype)
+                 for n in self._param_names]
+        self._ring_plan = _ring.build_ring_attn_plan(
+            named, self.mesh, self.model)
+        return self._ring_plan
+
+    def ring_plan(self):
+        """The resolved RingAttnPlan (None = sep stays a batch axis) —
+        the bench "ring" block embeds its summary()."""
+        return self._ring_plan if self._ring_plan_ready else None
+
+    def _ring_value_and_grads(self, plan, seq, make_loss_of, params,
+                              buffers, key_arr, batch):
+        """The engaged-ring differentiation seam: ONE manual shard_map
+        region over (data axes + sep). The residual stream stays
+        sep-sharded between layers — only attention communicates, as a
+        kv ring (models/gpt.py routes ``_sdpa_pure`` through
+        ``ring_attention`` while the scope is active, and rope reads
+        zigzag GLOBAL positions from the context). The fused-CE head
+        runs on the token shard (no logits or hidden gather); the loss
+        pmeans and every grad — partial over sep because each shard
+        back-propagated only its local tokens — reduces through the
+        plan's composed bucketed/quantized reduce."""
+        import jax as _jax
+        from jax import shard_map
+
+        from . import collectives
+        from .collectives import ring_attention as _ring
+
+        axes = plan.axes
+        data_axes = plan.data_axes
+        data_total = int(np.prod([self.mesh.get_dim_size(a)
+                                  for a in data_axes])) if data_axes else 1
+
+        def leaf_spec(arr):
+            if not hasattr(arr, "ndim") or arr.ndim == 0:
+                return P()
+            dim0 = (tuple(data_axes)
+                    if data_axes and arr.shape[0] % data_total == 0
+                    else None)
+            if arr.ndim >= 2 and arr.shape[1] == seq:
+                return P(dim0, plan.axis)
+            return P(dim0) if dim0 else P()
+
+        batch_specs = tuple(leaf_spec(a) for a in batch)
+        pspecs = {n: P() for n in params}
+        bspecs = {n: P() for n in buffers}
+        nbspecs = {n: P() for n in self._buffer_names}
+
+        def per_shard(params, buffers, key_arr, shard_id, sep_id, *batch):
+            # per-shard RNG: fold the GLOBAL (dp x sep) ordinal into the
+            # step key — the PR 6 dp discipline extended with the sep
+            # ordinal, so dropout-style draws stay independent across
+            # token shards too. Both ordinals ride in as sharded iotas
+            # (lax.axis_index lowers to PartitionId, rejected here).
+            key = _jax.random.fold_in(key_arr, shard_id[0])
+            ctx = _ring.RingContext(plan.axis, plan.sep_degree,
+                                    sep_id[0], plan=plan)
+            loss_of = make_loss_of(buffers, key, batch)
+            with _ring.ring_scope(ctx):
+                (loss, new_buffers), grads = _jax.value_and_grad(
+                    loss_of, has_aux=True)(params)
+            # mean of per-shard token means == the global mean when
+            # shards hold equal valid-token counts (the dp caveat,
+            # docs/COMMS.md, now also across sep token shards)
+            loss = _jax.lax.pmean(loss, axes)
+            new_buffers = {
+                n: (_jax.lax.pmean(v, axes)
+                    if jnp.issubdtype(v.dtype, jnp.inexact) else v)
+                for n, v in new_buffers.items()}
+            grads = collectives.reduce_grads(grads, plan.reduce,
+                                             mean=True)
+            return loss, new_buffers, grads
+
+        shard_ids = jnp.arange(plan.nranks, dtype=jnp.int32)
+        sep_ids = jnp.arange(plan.sep_degree, dtype=jnp.int32)
+        plan.calls_traced = 0
+        with collectives.manual_grad_region():
+            out = shard_map(
+                per_shard, mesh=self.mesh.jax_mesh,
+                in_specs=(pspecs, bspecs, P(), P(axes), P(plan.axis))
+                + batch_specs,
+                out_specs=(P(), nbspecs, pspecs),
+                check_vma=False, axis_names=set(axes),
+            )(params, buffers, key_arr, shard_ids, sep_ids, *batch)
+        if plan.calls_traced == 0:
+            raise RuntimeError(
+                "ring attention plan engaged but the model's trace never "
+                "routed attention through the ring seam "
+                "(models/gpt.py _sdpa_pure) — the step would silently "
+                "compute LOCAL-only attention. Use a flagship decoder "
+                "stack or disable with PTPU_RING_ATTN=0 "
+                "(docs/ATTENTION.md).")
+        loss, new_buffers, grads = out
+        return (loss, new_buffers), grads
 
     def _value_and_grads(self, make_loss_of, params, buffers, key_arr,
                          batch):
@@ -508,6 +716,11 @@ class ShardedTrainStep(TrainStep):
         if getattr(self, "_checkified", False):
             return super()._value_and_grads(make_loss_of, params, buffers,
                                             key_arr, batch)
+        ring, ring_seq = self._ring_batch_info(batch)
+        if ring is not None:
+            return self._ring_value_and_grads(ring, ring_seq,
+                                              make_loss_of, params,
+                                              buffers, key_arr, batch)
         plan = self._ensure_reduce_plan()
         if plan is None:
             return super()._value_and_grads(make_loss_of, params, buffers,
@@ -602,6 +815,8 @@ class ShardedTrainStep(TrainStep):
                 self._zero_plan_ready = False
                 self._reduce_plan = None
                 self._reduce_plan_ready = False
+                self._ring_plan = None
+                self._ring_plan_ready = False
             self._build()
         entries = self.model.state_dict()
         params = {n: entries[n]._data for n in self._param_names}
@@ -636,10 +851,17 @@ class ShardedTrainStep(TrainStep):
         # comms accounting: one tick per executed step with the plan's
         # static payload split (exact vs int8) — the counters behind the
         # bench "comms" block (docs/COMMS.md)
-        from .collectives import note_grad_reduce, note_zero_step
+        from .collectives import (note_grad_reduce, note_ring_attn,
+                                  note_zero_step)
 
-        note_grad_reduce(self._reduce_plan)
-        note_zero_step(self._reduce_plan)
+        if self._ring_last_active and self._ring_plan is not None:
+            # an engaged ring step owns its composed grad reduce (axes =
+            # data + sep) and additionally rotates KV around the ring
+            note_grad_reduce(self._ring_plan.reduce)
+            note_ring_attn(self._ring_plan)
+        else:
+            note_grad_reduce(self._reduce_plan)
+            note_zero_step(self._reduce_plan)
         return Tensor(loss)
 
 

@@ -154,6 +154,40 @@ def int8_kv_enabled(requested=False):
     return False
 
 
+def _int8_paged_kernel_mode():
+    """Resolve ``PTPU_PAGED_INT8_KERNEL`` — HOW an already-engaged int8
+    paged cache is read (rides ON TOP of the ``int8_kv_enabled`` parity
+    gate). Returns one of:
+
+    - ``"kernel"``: the Pallas int8-page kernel
+      (``ops/pallas/decode_attention.paged_attention_int8``);
+    - ``"interpret"``: the same kernel forced through the Pallas
+      interpreter (the CPU parity tests drive the real kernel code);
+    - ``"off"``: the HBM gather+dequant reference path.
+
+    Unset/``auto`` resolves to ``kernel`` on real TPU devices and
+    ``off`` elsewhere (off-TPU the kernel would silently run in the
+    interpreter — orders of magnitude slower). Unknown values are a
+    hard error: a mistyped knob must not masquerade as a measured
+    configuration (the ``_block_for`` discipline)."""
+    env = os.environ.get("PTPU_PAGED_INT8_KERNEL", "").strip().lower()
+    if env in ("0", "off", "false"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    if env in ("", "auto"):
+        from ..ops.pallas import on_tpu_device
+
+        return "kernel" if on_tpu_device() else "off"
+    raise ValueError(
+        f"PTPU_PAGED_INT8_KERNEL={env!r}: expected auto|interpret|0 "
+        "(docs/SERVING.md)")
+
+
+def _int8_paged_kernel_active():
+    return _int8_paged_kernel_mode() != "off"
+
+
 # ------------------------------------------------------- KV cache helpers
 # A cache is ONE stacked array [L, Hkv, num_pages+1, page, D] (exact
 # mode) or a (codes int8 [L, Hkv, num_pages+1, page, D],
@@ -756,15 +790,28 @@ class ContinuousBatchingEngine:
     def _paged_attend(self, q, kc_l, vc_l, tables, lens):
         """Single-position paged attention over a PER-LAYER cache:
         q [B, Hq, D] -> [B, Hq, D]. Exact caches take the Pallas paged
-        kernel; int8 caches gather the owned pages, dequantize
-        (codes * page-table scales), and run the masked reference
-        attention — the hand-written int8 Pallas decode kernel is the
-        named follow-up (docs/SERVING.md)."""
+        kernel; int8 caches take the int8-page Pallas kernel
+        (``paged_attention_int8``: (codes, scales) dequantized in VMEM
+        per fetched page — the PR 12 named follow-up) when the device
+        gate allows, else gather the owned pages, dequantize in HBM,
+        and run the masked reference attention (docs/SERVING.md). Both
+        int8 paths read the SAME codes*scales values; the int8 mode
+        itself engages only behind the quantizer parity gate
+        (``int8_kv_enabled``)."""
         jax, jnp = self._jax, self._jnp
         if not isinstance(kc_l, tuple):
             from ..ops.pallas.decode_attention import paged_attention
 
             return paged_attention(q, kc_l, vc_l, tables, lens)
+        mode = _int8_paged_kernel_mode()
+        if mode != "off":
+            from ..ops.pallas.decode_attention import paged_attention_int8
+
+            kc, ks = kc_l
+            vc, vs = vc_l
+            return paged_attention_int8(
+                q, kc, ks, vc, vs, tables, lens,
+                interpret=True if mode == "interpret" else None)
         b, hq, hd = q.shape
         dt = self._kv_dtype
         S = self.pages_per_seq * self.page

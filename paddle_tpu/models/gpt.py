@@ -412,6 +412,23 @@ def _rope_rotate(x, sin, cos):
     ).astype(x.dtype)
 
 
+def _rope_tables_at(p, d, base=10000.0):
+    """sin/cos tables for an ARBITRARY position vector ``p`` [T],
+    broadcast-ready for [B, T, H, D] activations: [1, T, 1, d/2] each.
+    The ONE frequency formula every table consumer shares —
+    :func:`_rope_tables` (positions 0..t-1) and the ring-attention
+    region's zigzag-global-position tables
+    (collectives/ring_attention.RingContext.rope_tables) both delegate
+    here, so an engaged ring step can never rotate by different angles
+    than the single-device program."""
+    import jax.numpy as jnp
+
+    inv = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = p.astype(jnp.float32)[:, None] * inv   # [T, d/2]
+    return (jnp.sin(freqs)[None, :, None, :],
+            jnp.cos(freqs)[None, :, None, :])
+
+
 def _rope_tables(t, d, base=10000.0):
     """sin/cos tables for positions 0..t-1, broadcast-ready for
     [B, T, H, D] activations: shape [1, T, 1, d/2] each.
@@ -422,11 +439,7 @@ def _rope_tables(t, d, base=10000.0):
     becomes a saved checkpoint input, never recomputed in backward."""
     import jax.numpy as jnp
 
-    p = jnp.arange(t, dtype=jnp.float32)
-    inv = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    freqs = p[:, None] * inv                       # [T, d/2]
-    return (jnp.sin(freqs)[None, :, None, :],
-            jnp.cos(freqs)[None, :, None, :])
+    return _rope_tables_at(jnp.arange(t, dtype=jnp.float32), d, base)
 
 
 def _rope_pure(x, base=10000.0, tables=None):
@@ -579,13 +592,24 @@ def _sdpa_pure(q, k, v, causal=True):
     `_use_pallas` holds (no silent try/except fallback: a kernel failure
     here must be loud, because the selective-remat anchors in `_block_pure`
     are chosen from the same predicate and a silent fallback would leave
-    attention with no saved residual at all)."""
+    attention with no saved residual at all).
+
+    Inside an ENGAGED ring-attention region (docs/ATTENTION.md) the
+    local tensors are one sep shard's zigzag token slice: attention
+    routes through the kv ring over ``sep`` — per-hop flash compute
+    overlapped with the ppermute rotation — instead of a local-only
+    kernel call that would silently drop cross-shard attention."""
     from paddle_tpu.nn.functional.flash_attention import (
         _constrain_heads_over_mp,
         _use_pallas,
         sdpa_arrays,
     )
 
+    from paddle_tpu.distributed.collectives import ring_attention as _ringmod
+
+    ctx = _ringmod.active_ring_context()
+    if ctx is not None:
+        return _ringmod.ring_attention(q, k, v, ctx, causal=causal)
     if _use_pallas(q.shape):
         from paddle_tpu.ops.pallas import flash_attention as _flash_kernel
 
@@ -640,7 +664,16 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     q = _col(h, wq).reshape(b, s, num_heads, hd)
     k = _col(h, wk).reshape(b, s, num_kv_heads, hd)
     v = _col(h, wv).reshape(b, s, num_kv_heads, hd)
+    # engaged ring-attention region (docs/ATTENTION.md): this block sees
+    # ONE sep shard's zigzag token slice, so rope must rotate by the
+    # GLOBAL positions of those tokens (from the region's sep ordinal),
+    # not 0..s — and hoisted local-position tables must not apply
+    from paddle_tpu.distributed.collectives import ring_attention as _ringmod
+
+    _ring_ctx = _ringmod.active_ring_context()
     if use_rope:
+        if _ring_ctx is not None:
+            rope_tables = _ring_ctx.rope_tables(s, hd)
         q = _rope_pure(q, tables=rope_tables)
         k = _rope_pure(k, tables=rope_tables)
     # remat anchors (inert under policies that don't name them): saving
@@ -655,9 +688,10 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     # re-running attention (quadratic in seq). On the pallas path the
     # custom_vjp residuals carry their own "attn_res"/"attn_lse" names —
     # tagging here too would save the same activation twice, so skip.
+    # The ring custom_vjp tags the same two names, so it skips too.
     from paddle_tpu.nn.functional.flash_attention import _use_pallas
 
-    if not _use_pallas(q.shape):
+    if _ring_ctx is None and not _use_pallas(q.shape):
         o = _save(o, "attn_out")
     if _addrms_active(tp_seams, q.shape):
         # fused residual-add + rms in one Pallas pass (named residuals

@@ -177,6 +177,130 @@ def _paged_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, ONE_F32, l)).astype(o_ref.dtype)
 
 
+def _paged_int8_kernel(tables_ref, len_ref, q_ref, kc_ref, ks_ref,
+                       vc_ref, vs_ref, o_ref, acc, m_scr, l_scr,
+                       *, scale, page, npages):
+    """Paged decode over int8 KV pages: dequantize (codes, scales)
+    INSIDE the kernel, so only ~1/4 of the exact cache's bytes cross
+    HBM->VMEM per token (int8 codes + one f32 scale per head_dim row vs
+    f32/bf16 rows) — the serving int8_kv mode's gather+dequantize-in-HBM
+    path becomes a streaming read (docs/SERVING.md)."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    length = len_ref[b]
+
+    @pl.when(j * page < length)
+    def _():
+        q = q_ref[0, 0]                # [rep, d]
+        # per-row dequant: codes [page, d] int8 * scale [page] f32 —
+        # the quantize_rows_int8 grid (block = the head_dim row the
+        # page table already addresses)
+        k = kc_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0, 0][:, None]
+        v = vc_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0, 0][:, None]
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32
+        ) * scale                      # [rep, page]
+        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * page
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, 0:1] = alpha * l_scr[:, 0:1] + jnp.sum(p, -1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0:1] = m_new
+
+    @pl.when(j == npages - 1)
+    def _():
+        l = l_scr[:, 0:1]
+        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, ONE_F32, l)).astype(o_ref.dtype)
+
+
+def paged_attention_int8(q, k_codes, k_scales, v_codes, v_scales,
+                         block_tables, lengths, *, scale=None,
+                         interpret=None):
+    """Paged-KV decode attention over int8 pages (the serving
+    ``int8_kv=True`` storage: ``memory.quantize_rows_int8`` codes
+    ``[Hkv, NumPages, PageSize, D]`` int8 + scales
+    ``[Hkv, NumPages, PageSize, 1]`` f32). Dequantization happens in
+    VMEM per fetched page — numerically identical to gathering the
+    owned pages and dequantizing in HBM (same codes * scales product),
+    without ever materializing the dequantized cache.
+    """
+    from . import use_interpret
+
+    if interpret is None:
+        interpret = use_interpret()
+    b, hq, d = q.shape
+    hkv, num_pages, page, _ = k_codes.shape
+    rep = hq // hkv
+    pages_per_seq = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    def _page_index(bi, h, j, tables, lens):
+        t = tables[bi, j]
+        return (h, jnp.clip(t, jnp.int32(0), jnp.int32(num_pages - 1)),
+                0, 0)
+
+    qg = q.reshape(b, hkv, rep, d)
+    # scales ride sublane-padded [Hkv, P, 8, page] (the lse8 pattern:
+    # Mosaic blocks need >= 8 sublanes) — a broadcast view, 32B/page-row
+    ks8 = jnp.broadcast_to(k_scales.reshape(hkv, num_pages, 1, page),
+                           (hkv, num_pages, 8, page))
+    vs8 = jnp.broadcast_to(v_scales.reshape(hkv, num_pages, 1, page),
+                           (hkv, num_pages, 8, page))
+    kern = functools.partial(_paged_int8_kernel, scale=scale, page=page,
+                             npages=pages_per_seq)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kern,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(b, hkv, pages_per_seq),
+                in_specs=[
+                    pl.BlockSpec((1, 1, rep, d),
+                                 lambda bi, h, j, T, L: (bi, h, 0, 0)),
+                    pl.BlockSpec((1, 1, page, d), _page_index),
+                    pl.BlockSpec((1, 1, 8, page), _page_index),
+                    pl.BlockSpec((1, 1, page, d), _page_index),
+                    pl.BlockSpec((1, 1, 8, page), _page_index),
+                ],
+                out_specs=pl.BlockSpec(
+                    (1, 1, rep, d), lambda bi, h, j, T, L: (bi, h, 0, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((rep, d), jnp.float32),
+                    pltpu.VMEM((rep, 128), jnp.float32),
+                    pltpu.VMEM((rep, 128), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            cost_estimate=pl.CostEstimate(
+                flops=4 * b * hq * pages_per_seq * page * d,
+                bytes_accessed=(b * hq * d * q.dtype.itemsize
+                                + 2 * b * hkv * pages_per_seq * page
+                                * (d + 4)),
+                transcendentals=b * hq * pages_per_seq * page,
+            ),
+        )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+          qg, k_codes, ks8, v_codes, vs8)
+    return out.reshape(b, hq, d)
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
                     scale=None, interpret=None):
     """Paged-KV decode attention (block_multi_head_attention slot).

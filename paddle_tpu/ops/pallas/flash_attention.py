@@ -49,9 +49,28 @@ def _block_for(s: int, env="PTPU_FA_BLOCK", default=1024):
     their sweet spot can differ)."""
     import os
 
-    pref = int(os.environ.get(env, default))
-    if pref % 128:
-        pref = default  # Mosaic tiling requires multiples of 128
+    raw = os.environ.get(env)
+    if raw is None:
+        pref = default
+    else:
+        try:
+            pref = int(raw)
+        except ValueError:
+            # a mistyped knob must not silently masquerade as a measured
+            # configuration — the sweeps record these envs verbatim
+            raise ValueError(
+                f"{env}={raw!r}: expected an integer block size in "
+                "tokens (a multiple of 128)") from None
+        if pref % 128:
+            import warnings
+
+            warnings.warn(
+                f"{env}={pref} is not a multiple of 128 — Mosaic block "
+                f"tiling requires it; IGNORING the override and using "
+                f"the default {default}. Fix the knob or the recorded "
+                "perf numbers will not measure what the env claims.",
+                RuntimeWarning, stacklevel=2)
+            pref = default
     if s <= 512:
         return s  # full-dim block (always tileable at these sizes)
     for b in (pref, 1024, 512, 256, 128):

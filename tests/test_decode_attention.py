@@ -319,3 +319,81 @@ class TestPagedAttention:
         ref = ref_decode(q, k_dense, v_dense, lengths)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestPagedAttentionInt8:
+    """int8-page variant (ISSUE 13 satellite, docs/SERVING.md): pages
+    stored as (codes, scales) dequantize INSIDE the kernel — the
+    serving ``int8_kv=True`` mode stops gathering+dequantizing in HBM."""
+
+    def _int8_setup(self, b, hkv, d, page, pps, seed=3):
+        from paddle_tpu.memory import quantize_rows_int8
+
+        num_pages = 2 * pps
+        k = _rand((hkv, num_pages, page, d), seed=seed)
+        v = _rand((hkv, num_pages, page, d), seed=seed + 1)
+        kq, ks = quantize_rows_int8(k)
+        vq, vs = quantize_rows_int8(v)
+        tables = jnp.asarray(
+            np.random.default_rng(seed).choice(
+                num_pages, (b, pps), replace=True).astype(np.int32))
+        return (kq, ks, vq, vs, tables,
+                kq.astype(jnp.float32) * ks, vq.astype(jnp.float32) * vs)
+
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)])
+    def test_bitwise_vs_dequant_then_exact_kernel(self, hq, hkv):
+        """The in-kernel dequant must be BITWISE the gather+dequant
+        path feeding the exact kernel: both compute codes * scales in
+        f32 and then the same online-softmax math."""
+        from paddle_tpu.ops.pallas.decode_attention import (
+            paged_attention_int8)
+
+        b, d, page, pps = 2, 64, 8, 4
+        kq, ks, vq, vs, tables, kd, vd = self._int8_setup(
+            b, hkv, d, page, pps)
+        q = _rand((b, hq, d), seed=11)
+        lengths = jnp.array([29, 32], jnp.int32)
+        out = paged_attention_int8(q, kq, ks, vq, vs, tables, lengths,
+                                   interpret=True)
+        ref = paged_attention(q, kd, vd, tables, lengths, interpret=True)
+        a, r = np.asarray(out), np.asarray(ref)
+        assert a.tobytes() == r.tobytes(), float(np.abs(a - r).max())
+
+    def test_serving_paged_attend_kernel_vs_gather_path(self, monkeypatch):
+        """The engine's int8 `_paged_attend` with the kernel forced
+        (PTPU_PAGED_INT8_KERNEL=interpret) matches the default HBM
+        gather+dequant reference path on the same (codes, scales)."""
+        from paddle_tpu.inference.serving import (
+            ContinuousBatchingEngine, _int8_paged_kernel_active)
+
+        assert not _int8_paged_kernel_active()  # CPU default: off
+        monkeypatch.setenv("PTPU_PAGED_INT8_KERNEL", "interpret")
+        assert _int8_paged_kernel_active()
+        monkeypatch.setenv("PTPU_PAGED_INT8_KERNEL", "0")
+        assert not _int8_paged_kernel_active()
+
+        # drive the engine method directly on a synthetic cache
+        from paddle_tpu.memory import quantize_rows_int8
+
+        class _Shim:
+            _jax, _jnp = jax, jnp
+            hkv, page, pages_per_seq = 2, 8, 4
+            _kv_dtype = jnp.float32
+            _paged_attend = ContinuousBatchingEngine._paged_attend
+
+        shim = _Shim()
+        b, hq, d = 2, 4, 64
+        num_pages = 8
+        k = _rand((shim.hkv, num_pages, shim.page, d), seed=21)
+        v = _rand((shim.hkv, num_pages, shim.page, d), seed=22)
+        kq, ks = quantize_rows_int8(k)
+        vq, vs = quantize_rows_int8(v)
+        tables = jnp.asarray(np.random.default_rng(5).choice(
+            num_pages, (b, shim.pages_per_seq)).astype(np.int32))
+        lens = jnp.array([13, 30], jnp.int32)
+        q = _rand((b, hq, d), seed=23)
+        ref = shim._paged_attend(q, (kq, ks), (vq, vs), tables, lens)
+        monkeypatch.setenv("PTPU_PAGED_INT8_KERNEL", "interpret")
+        out = shim._paged_attend(q, (kq, ks), (vq, vs), tables, lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)

@@ -1,0 +1,570 @@
+"""Ring attention over the sep axis: the RingAttnPlan (docs/ATTENTION.md).
+
+Runs on the 8-device CPU mesh (conftest). Numerics contract under test:
+
+- the shard_map ring agrees with the one-shot attention path to a few
+  ulp (the ring reassociates online-softmax accumulation over kv hops,
+  exactly as the flash kernel itself reassociates dense softmax — NOT
+  bitwise, and the docs say so);
+- the single-device :func:`ring_reference` oracle replays the identical
+  hop decomposition, pinning any remaining distributed noise to the
+  ppermute/shard_map machinery (asserted at 1e-6 — ulp-level; XLA's
+  fusion-dependent FMA contraction keeps cross-program bitwise equality
+  out of reach even for identical math, measured during development);
+- ``PTPU_RING_ATTN=0`` IS bitwise: identical trajectory to a build in
+  which the plan never existed.
+"""
+import math
+import os
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _sep_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("sep",))
+
+
+def _dense_ref(q, k, v, causal=True, scale=None):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    hq, hk = q.shape[2], k.shape[2]
+    if hq != hk:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, 2)
+        v = jnp.repeat(v, rep, 2)
+    s = jnp.einsum("bshd,bthd->bhst", q * scale, k)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool), sk - sq), s,
+                      -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhst,bthd->bshd", p, v)
+
+
+def _ring_mapped(mesh, n, causal=True, scale=None):
+    from paddle_tpu.distributed.collectives import ring_attention as R
+
+    spec = P(None, "sep", None, None)
+
+    def per_shard(qz, kz, vz, sid):
+        ctx = R.RingContext("sep", n, sid[0])
+        return R.ring_attention(qz, kz, vz, ctx, causal=causal,
+                                scale=scale)
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh, in_specs=(spec, spec, spec, P("sep")),
+        out_specs=spec, check_vma=False))
+
+
+def _run_ring(mesh, n, q, k, v, causal=True, scale=None):
+    from paddle_tpu.distributed.collectives import ring_attention as R
+
+    perm = R.zigzag_perm(q.shape[1], n)
+    inv = R.zigzag_inverse_perm(q.shape[1], n)
+    sh = NamedSharding(mesh, P(None, "sep", None, None))
+    sids = jax.device_put(jnp.arange(n, dtype=jnp.int32),
+                          NamedSharding(mesh, P("sep")))
+    mapped = _ring_mapped(mesh, n, causal=causal, scale=scale)
+    out = mapped(jax.device_put(jnp.take(q, perm, 1), sh),
+                 jax.device_put(jnp.take(k, perm, 1), sh),
+                 jax.device_put(jnp.take(v, perm, 1), sh), sids)
+    return jnp.take(out, inv, 1)
+
+
+def _qkv(b=2, s=32, hq=4, hk=2, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(b, s, hq, d), jnp.float32),
+            jnp.asarray(rng.randn(b, s, hk, d), jnp.float32),
+            jnp.asarray(rng.randn(b, s, hk, d), jnp.float32))
+
+
+# ---------------------------------------------------------------- zigzag
+
+def test_zigzag_perm_roundtrip():
+    from paddle_tpu.distributed.collectives import ring_attention as R
+
+    perm = R.zigzag_perm(32, 4)
+    inv = R.zigzag_inverse_perm(32, 4)
+    assert sorted(perm.tolist()) == list(range(32))
+    np.testing.assert_array_equal(perm[inv], np.arange(32))
+    # rank r holds chunks (r, 2n-1-r): shard 0 of the permuted seq
+    np.testing.assert_array_equal(perm[:8],
+                                  np.r_[np.arange(4), np.arange(28, 32)])
+    with pytest.raises(ValueError):
+        R.zigzag_perm(30, 4)
+
+
+def test_zigzag_positions_match_perm():
+    from paddle_tpu.distributed.collectives import ring_attention as R
+
+    n, s = 4, 32
+    perm = R.zigzag_perm(s, n)
+    s_loc = s // n
+    for r in range(n):
+        pos = np.asarray(R.zigzag_positions(r, s_loc, n))
+        np.testing.assert_array_equal(pos,
+                                      perm[r * s_loc:(r + 1) * s_loc])
+
+
+# ---------------------------------------------------------------- kernel level
+
+@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_dense(n, causal):
+    mesh = _sep_mesh(n)
+    q, k, v = _qkv()
+    out = _run_ring(mesh, n, q, k, v, causal=causal)
+    ref = _dense_ref(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_ring_matches_oracle_ulp(n):
+    """The shard_map ring vs the single-device same-decomposition
+    replay: any difference is noise from the distributed machinery —
+    asserted at ulp scale (1e-6 abs on unit-scale outputs)."""
+    from paddle_tpu.distributed.collectives import ring_attention as R
+
+    mesh = _sep_mesh(n)
+    q, k, v = _qkv(seed=3)
+    out = _run_ring(mesh, n, q, k, v, causal=True)
+    oracle = R.ring_reference(q, k, v, n, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               atol=1e-6, rtol=0)
+
+
+def test_ring_flash_kernel_hops_match_single_device_flash():
+    """PTPU_RING_KERNEL=interpret drives the REAL Pallas flash kernel
+    per hop on the CPU mesh; the merged result must match ONE
+    full-sequence flash kernel call (the single-device flash path) to a
+    few ulp, for a GQA shape."""
+    from paddle_tpu.ops.pallas.flash_attention import _fwd, from_bh, to_bh
+
+    n = 4
+    mesh = _sep_mesh(n)
+    b, s, hq, hk, d = 2, 64, 4, 2, 16
+    q, k, v = _qkv(b=b, s=s, hq=hq, hk=hk, d=d, seed=1)
+    os.environ["PTPU_RING_KERNEL"] = "interpret"
+    try:
+        out = _run_ring(mesh, n, q, k, v, causal=True)
+    finally:
+        del os.environ["PTPU_RING_KERNEL"]
+    scale = 1.0 / math.sqrt(d)
+    o_bh, _ = _fwd(to_bh(q, hq), to_bh(k, hk), to_bh(v, hk), scale,
+                   True, True, hq, hk)
+    flash = from_bh(o_bh, b, hq)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(flash),
+                               atol=2e-6, rtol=2e-6)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_ring_grads_match_dense(n):
+    """Loss AND grads through the hand-written ring custom_vjp vs the
+    dense reference — GQA across hops (dk/dv accumulate on kv heads
+    while traveling the ring)."""
+    from paddle_tpu.distributed.collectives import ring_attention as R
+
+    mesh = _sep_mesh(n)
+    q, k, v = _qkv(b=1, s=32, seed=2)
+    perm = R.zigzag_perm(32, n)
+    inv = R.zigzag_inverse_perm(32, n)
+    sids = jax.device_put(jnp.arange(n, dtype=jnp.int32),
+                          NamedSharding(mesh, P("sep")))
+    mapped = _ring_mapped(mesh, n)
+
+    def loss_ring(q_, k_, v_):
+        out = mapped(jnp.take(q_, perm, 1), jnp.take(k_, perm, 1),
+                     jnp.take(v_, perm, 1), sids)
+        return jnp.sum(jnp.take(out, inv, 1) ** 2)
+
+    def loss_dense(q_, k_, v_):
+        return jnp.sum(_dense_ref(q_, k_, v_, True) ** 2)
+
+    g = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_flash_kernel_causal_end_alignment():
+    """The flash kernel's documented sq != sk semantics: queries align
+    to the END of the key sequence (row i sees cols <= i + sk - sq) —
+    the convention the ring's per-hop calls build on."""
+    from paddle_tpu.ops.pallas.flash_attention import _fwd, from_bh, to_bh
+
+    b, sq, sk, h, d = 1, 16, 64, 2, 16
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+    scale = 1.0 / math.sqrt(d)
+    o_bh, _ = _fwd(to_bh(q, h), to_bh(k, h), to_bh(v, h), scale, True,
+                   True, h, h)
+    out = from_bh(o_bh, b, h)
+    ref = _dense_ref(q, k, v, causal=True, scale=scale)  # tril(sk - sq)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+# ---------------------------------------------------------------- step level
+
+def _flagship(seed=0, **over):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
+
+    paddle.seed(seed)
+    kw = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+              num_kv_heads=2, max_seq_len=64, dropout=0.0)
+    kw.update(over)
+    m = GPTForCausalLMPipe(GPTConfig(**kw))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    return m, opt
+
+
+def _sep_fleet(sep, dp=1):
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": sep}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_fleet_mesh()
+
+
+def _batch(rows=2, seq=32, vocab=128, seed=0):
+    import paddle_tpu as paddle
+
+    rng = np.random.default_rng(seed)
+    return (paddle.to_tensor(
+                rng.integers(0, vocab, (rows, seq)).astype(np.int32)),
+            paddle.to_tensor(
+                rng.integers(0, vocab, (rows, seq)).astype(np.int64)))
+
+
+@pytest.mark.parametrize("sep,dp", [(2, 1), (4, 2)])
+def test_ring_step_parity_vs_single_device(sep, dp):
+    """The engaged ring train step (seq sharded over sep, ring
+    attention, composed dp+sep grad reduce, fused-CE head on the token
+    shard) tracks the single-device TrainStep's loss trajectory AND
+    final parameters on the same data."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+    ids, labels = _batch(rows=max(2, dp))
+    m1, o1 = _flagship(seed=11)
+    step1 = TrainStep(m1, lambda a, b: m1.loss(a, b), o1)
+    ref = [float(step1(ids, labels).numpy()) for _ in range(3)]
+
+    mesh = _sep_fleet(sep, dp)
+    m2, o2 = _flagship(seed=11)
+    step2 = ShardedTrainStep(m2, lambda a, b: m2.loss(a, b), o2, mesh)
+    got = [float(step2(ids, labels).numpy()) for _ in range(3)]
+
+    plan = step2.ring_plan()
+    assert plan is not None and plan.sep_degree == sep
+    assert step2._ring_last_active
+    assert plan.calls_traced >= 1
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+    p1 = {n: np.asarray(p._data) for n, p in m1.named_parameters()}
+    for n, p in m2.named_parameters():
+        np.testing.assert_allclose(np.asarray(p._data), p1[n],
+                                   atol=2e-4, rtol=2e-4, err_msg=n)
+
+
+def test_ring_step_eager_frontend_engages():
+    """The eager GPTModel LayerList frontend (scan-over-layers shared
+    body) rides the same ring seam."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+    def mk(seed):
+        paddle.seed(seed)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        return m, opt
+
+    ids, labels = _batch()
+    m1, o1 = mk(7)
+    ref = [float(TrainStep(m1, lambda a, b: m1.loss(a, b), o1)(
+        ids, labels).numpy()) for _ in range(2)]
+    mesh = _sep_fleet(4, 2)
+    m2, o2 = mk(7)
+    step = ShardedTrainStep(m2, lambda a, b: m2.loss(a, b), o2, mesh)
+    got = [float(step(ids, labels).numpy()) for _ in range(2)]
+    assert step.ring_plan() is not None and step._ring_last_active
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_no_tokens_by_tokens_aval_in_ring_step():
+    """The engaged ring train-step program materializes NO
+    [tokens, tokens] score tensor at any point (the long-context
+    memory guarantee); the single-device XLA-attention program DOES —
+    the two-sided proof, test_fused_ce discipline."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+    seq = 64
+    ids, labels = _batch(rows=2, seq=seq)
+
+    def program_text(step):
+        ex = next(iter(step._execs.values()))
+        for attr in ("as_text",):
+            try:
+                return ex.as_text()
+            except Exception:
+                pass
+        pytest.skip("compiled executable exposes no text on this jax")
+
+    pat = re.compile(rf"\[(?:\d+,)*{seq},{seq}[,\]]")
+
+    mesh = _sep_fleet(4, 2)
+    m2, o2 = _flagship(seed=3, max_seq_len=seq)
+    step2 = ShardedTrainStep(m2, lambda a, b: m2.loss(a, b), o2, mesh)
+    step2(ids, labels)
+    assert step2._ring_last_active
+    ring_txt = program_text(step2)
+    assert not pat.search(ring_txt), \
+        f"[{seq}, {seq}] aval found in the ring train-step program"
+
+    m1, o1 = _flagship(seed=3, max_seq_len=seq)
+    step1 = TrainStep(m1, lambda a, b: m1.loss(a, b), o1)
+    step1(ids, labels)
+    dense_txt = program_text(step1)
+    assert pat.search(dense_txt), \
+        "oracle failure: the single-device program should materialize " \
+        f"[{seq}, {seq}] scores (did the dense path change?)"
+
+
+# ---------------------------------------------------------------- engagement
+
+def test_engagement_and_decline_matrix(monkeypatch):
+    from paddle_tpu.distributed.collectives import ring_attention as R
+    from paddle_tpu.distributed.auto_parallel import ProcessMesh
+
+    m, _ = _flagship()
+    named = [(n, tuple(p._data.shape), p._data.dtype)
+             for n, p in m.named_parameters()]
+
+    def mesh_of(shape, names):
+        return ProcessMesh(shape=shape, dim_names=names)
+
+    ok = R.build_ring_attn_plan(named, mesh_of((2, 4), ("dp", "sep")), m)
+    assert ok is not None and ok.sep_degree == 4
+    assert ok.axes == ("dp", "sep") and ok.data_axes == ("dp",)
+
+    # escape hatch
+    monkeypatch.setenv("PTPU_RING_ATTN", "0")
+    assert R.build_ring_attn_plan(
+        named, mesh_of((2, 4), ("dp", "sep")), m) is None
+    monkeypatch.delenv("PTPU_RING_ATTN")
+    # no live sep
+    assert R.build_ring_attn_plan(
+        named, mesh_of((8, 1), ("dp", "sep")), m) is None
+    # pp / ep / mp live: their kernels open their own manual regions
+    for names in (("pp", "sep"), ("ep", "sep"), ("mp", "sep")):
+        assert R.build_ring_attn_plan(
+            named, mesh_of((2, 4), names), m) is None
+    # non-eligible model (no flagship decoder stack)
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class Custom(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    assert R.build_ring_attn_plan(
+        named, mesh_of((2, 4), ("dp", "sep")), Custom()) is None
+
+
+def test_step_level_declines(monkeypatch):
+    """checkify and ZeRO stage >= 2 decline at the step, and a
+    non-zigzag-divisible sequence declines PER BATCH (the step runs the
+    legacy batch-axis program for that signature)."""
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+    mesh = _sep_fleet(4, 2)
+    # checkify
+    import paddle_tpu as paddle
+
+    m, o = _flagship(seed=1)
+    step = ShardedTrainStep(m, lambda a, b: m.loss(a, b), o, mesh)
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        assert step._ensure_ring_plan() is None
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    # zero stage >= 2 marks decline the ring (the zero mode itself
+    # declines sep meshes: both fall to the GSPMD hint program)
+    m2, o2 = _flagship(seed=1)
+    o2._group_sharded_level = "os_g"
+    step2 = ShardedTrainStep(m2, lambda a, b: m2.loss(a, b), o2, mesh)
+    assert step2._ensure_ring_plan() is None
+    # engaged plan, but a seq length that doesn't zigzag-divide
+    # (34 % (2*4) != 0) falls back per batch signature
+    m3, o3 = _flagship(seed=1)
+    step3 = ShardedTrainStep(m3, lambda a, b: m3.loss(a, b), o3, mesh)
+    ids, labels = _batch(rows=2, seq=34)
+    loss = float(step3(ids, labels).numpy())
+    assert np.isfinite(loss)
+    assert step3.ring_plan() is not None
+    assert not step3._ring_last_active
+
+
+def test_escape_hatch_bitwise(monkeypatch):
+    """PTPU_RING_ATTN=0 must reproduce — bit for bit — the program of a
+    build in which the ring plan never existed (the pre-PR step, where
+    sep is a plain batch axis)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.collectives import ring_attention as R
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+    mesh = _sep_fleet(2, 1)
+    # sep as a batch axis needs rows % sep == 0
+    ids, labels = _batch(rows=4, seq=32, seed=9)
+
+    def run(env_off):
+        if env_off:
+            monkeypatch.setenv("PTPU_RING_ATTN", "0")
+        else:
+            monkeypatch.delenv("PTPU_RING_ATTN", raising=False)
+            monkeypatch.setattr(R, "build_ring_attn_plan",
+                                lambda *a, **k: None)
+        m, o = _flagship(seed=21)
+        step = ShardedTrainStep(m, lambda a, b: m.loss(a, b), o, mesh)
+        losses = [np.asarray(step(ids, labels)._data) for _ in range(3)]
+        params = {n: np.asarray(p._data) for n, p in m.named_parameters()}
+        assert step.ring_plan() is None
+        monkeypatch.undo()
+        return losses, params
+
+    l_off, p_off = run(env_off=True)
+    l_pre, p_pre = run(env_off=False)
+    for a, b in zip(l_off, l_pre):
+        assert a.tobytes() == b.tobytes()
+    for n in p_off:
+        assert p_off[n].tobytes() == p_pre[n].tobytes(), n
+
+
+# ---------------------------------------------------------------- telemetry
+
+def test_ring_telemetry_and_report():
+    import io
+
+    import paddle_tpu.telemetry as telemetry
+    from paddle_tpu.distributed.parallel_step import ShardedTrainStep
+
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        mesh = _sep_fleet(4, 2)
+        m, o = _flagship(seed=5)
+        step = ShardedTrainStep(m, lambda a, b: m.loss(a, b), o, mesh)
+        ids, labels = _batch()
+        step(ids, labels)
+        step(ids, labels)
+        plan = step.ring_plan()
+        snap = telemetry.snapshot()
+        series = snap["counters"]["ring_attn_kv_bytes_total"]
+        by_phase = {}
+        for labels_, v in series.items():
+            d = dict(p.split("=", 1) for p in labels_.split(","))
+            by_phase[d["phase"]] = (d["axis"], int(v))
+        assert by_phase["fwd"] == ("sep", 2 * plan.fwd_rotate_bytes)
+        assert by_phase["bwd"] == ("sep", 2 * plan.bwd_rotate_bytes)
+        # grad-reduce accounting rides the composed (dp+sep) plan
+        assert any("axis=dp+sep" in lbl for lbl in
+                   snap["counters"]["collective_calls_total"])
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        import telemetry_report
+
+        buf = io.StringIO()
+        telemetry_report.print_ring(snap, out=buf)
+        text = buf.getvalue()
+        assert "-- ring" in text and "ppermute@sep [fwd]" in text
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------- knobs
+
+def test_fa_block_env_validation(monkeypatch):
+    """A mistyped PTPU_FA_BLOCK must not silently masquerade as a
+    measured configuration: non-multiple-of-128 warns loudly before
+    falling back; a non-integer is a hard error."""
+    from paddle_tpu.ops.pallas.flash_attention import _block_for
+
+    monkeypatch.setenv("PTPU_FA_BLOCK", "512")
+    assert _block_for(2048) == 512
+    monkeypatch.setenv("PTPU_FA_BLOCK", "300")
+    with pytest.warns(RuntimeWarning, match="not a multiple of 128"):
+        assert _block_for(2048) == 1024
+    monkeypatch.setenv("PTPU_FA_BLOCK", "fast")
+    with pytest.raises(ValueError, match="PTPU_FA_BLOCK='fast'"):
+        _block_for(2048)
+
+
+def test_ring_kernel_mode_validation(monkeypatch):
+    from paddle_tpu.distributed.collectives import ring_attention as R
+
+    monkeypatch.setenv("PTPU_RING_KERNEL", "gpu")
+    with pytest.raises(ValueError, match="PTPU_RING_KERNEL"):
+        R.ring_kernel_mode()
+
+
+# ---------------------------------------------------------------- probe
+
+def test_ring_parity_probe():
+    mesh = _sep_fleet(4, 2)
+    from paddle_tpu.distributed import collectives
+
+    probe = collectives.ring_parity_probe(mesh)
+    assert probe["enabled"] and probe["ok"]
+    assert probe["max_rel_err"] <= probe["threshold"]
+
+
+def test_bench_gate_ring_violations():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import bench_gate
+
+    clean = {"ring": {"enabled": True, "engaged": True,
+                      "parity": {"enabled": True, "max_rel_err": 1e-7,
+                                 "threshold": 1e-3, "ok": True}}}
+    assert bench_gate.ring_violations(clean) == []
+    drifted = {"ring": {"enabled": True, "engaged": True,
+                        "parity": {"enabled": True, "max_rel_err": 5e-3,
+                                   "threshold": 1e-3, "ok": False}}}
+    assert any("drift" in v for v in bench_gate.ring_violations(drifted))
+    fellback = {"ring": {"enabled": True, "engaged": False,
+                         "parity": {"enabled": False}}}
+    assert any("never engaged" in v
+               for v in bench_gate.ring_violations(fellback))
+    assert bench_gate.ring_violations({"ring": {"enabled": False}}) == []
+    assert bench_gate.ring_violations({}) == []

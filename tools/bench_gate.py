@@ -154,6 +154,34 @@ def comms_violations(rec):
     return out
 
 
+def ring_violations(rec):
+    """Violation strings from one bench record's "ring" block
+    (docs/ATTENTION.md; the ``*_seq32k`` long-context lines): a run
+    whose ring-vs-dense parity probe drifted past its embedded
+    threshold must not land silently — a hop mask or merge regression
+    is a numerics bug, not noise. Reference-free, like the comms parity
+    gate; a sep-mesh run whose plan unexpectedly declined (enabled but
+    never engaged) also fails — the line would silently measure the
+    batch-axis fallback instead of the ring."""
+    block = rec.get("ring") if isinstance(rec, dict) else None
+    if not isinstance(block, dict) or not block.get("enabled"):
+        return []
+    out = []
+    parity = block.get("parity")
+    if isinstance(parity, dict) and parity.get("enabled"):
+        err = parity.get("max_rel_err")
+        thr = parity.get("threshold")
+        if err is not None and thr is not None and float(err) > float(thr):
+            out.append(f"ring-attention parity drift {float(err):.2e} > "
+                       f"threshold {float(thr):.2e}")
+        elif parity.get("ok") is False:
+            out.append("ring-attention parity probe reported ok=false")
+    if block.get("engaged") is False:
+        out.append("ring plan built but never engaged — the long-context "
+                   "line measured the batch-axis fallback")
+    return out
+
+
 def host_overhead_violations(rec, threshold=0.25):
     """Violation strings from one bench record's "anatomy" block: a
     traced run whose host gap (measured step wall − cost-analysis
@@ -404,6 +432,11 @@ def main(argv=None):
         # candidate run alone
         for v in comms_violations(rec):
             print(f"  COMMS {metric}: {v}", flush=True)
+            failed = True
+        # ring gate (docs/ATTENTION.md): the *_seq32k long-context
+        # lines embed a ring-vs-dense parity probe — reference-free
+        for v in ring_violations(rec):
+            print(f"  RING  {metric}: {v}", flush=True)
             failed = True
         # host-overhead gate (reference-free): a traced round must stay
         # device-bound at the same metric

@@ -150,6 +150,22 @@ def print_zero(snap, out=None):
         w(r + "\n")
 
 
+def print_ring(snap, out=None):
+    """Ring-attention traffic section (docs/ATTENTION.md): KV block
+    bytes rotated around the sep ring per phase (fwd = k+v hops, bwd =
+    k+v plus the traveling dk/dv accumulators)."""
+    counters = snap.get("counters") or {}
+    series = counters.get("ring_attn_kv_bytes_total") or {}
+    if not series:
+        return
+    w = (out or sys.stdout).write
+    w("-- ring (sep kv rotation traffic) --\n")
+    for labels, v in sorted(series.items()):
+        d = dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+        w(f"  ppermute@{d.get('axis', '?')} [{d.get('phase', '?')}]: "
+          f"bytes={int(v)}\n")
+
+
 def print_trace(snap, out=None):
     """Span-tracer section (docs/TELEMETRY.md Tracing): the
     ``trace_span_seconds`` histogram family mirrors every completed
@@ -180,6 +196,7 @@ def print_snapshot(snap, out=None):
     print_trace(snap, out)
     print_comms(snap, out)
     print_zero(snap, out)
+    print_ring(snap, out)
     for kind in ("counters", "gauges"):
         group = snap.get(kind) or {}
         if group:
