@@ -604,6 +604,55 @@ def run_model(model_kind, ckpt=None):
                   else {"engaged": False, "stage": zero_stage,
                         "shard_degree": zero_degree})
 
+    # "pipe" block (docs/PIPELINE.md): pipeline-schedule state + bubble
+    # accounting. Engagement comes from the composed plan
+    # (collectives/compose); the bubble fractions are priced from
+    # MEASURED per-phase stage costs on this host (pipeline.bubble_report
+    # — wall-clocking the ring on a core-shared CPU mesh measures
+    # contention, not idleness, docs/ZB_WALLCLOCK.md). Without a live pp
+    # axis the reference pp=2 x n_micro=4 shape keeps the schedule
+    # arithmetic tracked round over round; bench_gate's PIPE gate fails
+    # a bubble fraction over the 1F1B budget or a pp-live mesh whose
+    # composition never engaged.
+    from paddle_tpu.distributed import pipeline as _pl
+
+    cplan = (step.composed_plan()
+             if hasattr(step, "composed_plan") else None)
+    pp_engaged = bool(cplan is not None and cplan.pp_axis)
+    _mesh_b = _active_mesh()
+    pp_live = bool(_mesh_b is not None and "pp" in _mesh_b.dim_names
+                   and _mesh_b.get_dim_size("pp") > 1)
+    from paddle_tpu.distributed.collectives import compose as _compose_b
+
+    # an escape-hatch knob explicitly disabling composition is an
+    # intended A/B baseline, not a silent decline — recorded so the
+    # PIPE gate only fails the "enabled-but-never-engaged" case.
+    # composed_enabled() folds the PTPU_QUANT_COLLECTIVES master knob
+    disabled_by_knob = bool(
+        not _compose_b.composed_enabled()
+        or _compose_b.pipeline_schedule_disabled())
+    # the structured why-not for a pp-live mesh without a schedule: a
+    # pp-replicated decoder (no stage placements) engages composition
+    # without a pipeline row; otherwise the composed plan's own decline
+    # reason carries the story. The PIPE gate passes the documented
+    # config-shape declines and fails everything silent.
+    decline_reason = None
+    if pp_live and not pp_engaged:
+        if cplan is not None:
+            decline_reason = "no_stage_placements"
+        else:
+            _v = _compose_b.last_verdicts().get("composed")
+            decline_reason = _v[1] if _v else None
+    pipe_block = dict(
+        _pl.bubble_report(
+            cplan.pp if pp_engaged else 2,
+            cplan.n_micro if pp_engaged else 4,
+            schedule=(cplan.pp_schedule if pp_engaged
+                      else getattr(cfg, "pp_schedule", "1f1b") or "1f1b")),
+        engaged=pp_engaged, pp_axis_live=pp_live,
+        disabled_by_knob=disabled_by_knob,
+        decline_reason=decline_reason)
+
     # "compile" block (docs/SCAN.md): trace/lower/compile wall seconds +
     # serialized HLO bytes of THIS run's warmup TrainStep build, with the
     # depth and scan mode that produced them — the measurement behind the
@@ -721,6 +770,9 @@ def run_model(model_kind, ckpt=None):
         # ZeRO execution state: stage, shard degree, gathered/rs bytes
         # per step (docs/ZERO.md contract)
         "zero": zero_block,
+        # pipeline schedule + measured-cost bubble accounting
+        # (docs/PIPELINE.md; bench_gate PIPE gate)
+        "pipe": pipe_block,
         # warmup-build compile phases + HLO program size (docs/SCAN.md)
         "compile": compile_block,
         # fleet-serving smoke soak (--serve; docs/SERVING.md): replica

@@ -397,6 +397,19 @@ def shard_activation(x, placements=None, mesh=None, spec=None):
     arr = x._data if is_tensor else x
     use_mesh = mesh.jax_mesh
     abstract = jax.sharding.get_abstract_mesh()
+    if abstract.empty:
+        # legacy jax reports a permanently-empty abstract mesh, so the
+        # manual-axis strip below can never engage — but a plain
+        # constraint traced inside a manual shard_map region makes this
+        # XLA's partitioner hard-abort (Check failed: IsManualSubgroup,
+        # the pre-existing example-02 crash). The explicitly-tracked
+        # region flag (collectives.manual_grad_region) is the authority
+        # there: skip the hint entirely — per-shard code already holds
+        # exactly its slice, and auto axes lose only a placement HINT.
+        from . import collectives as _coll
+
+        if _coll.in_manual_grad_region():
+            return x
     manual = (set() if abstract.empty else {
         n for n, t in zip(abstract.axis_names, abstract.axis_types)
         if t == jax.sharding.AxisType.Manual})

@@ -249,9 +249,13 @@ _ZERO_RS = _telemetry.counter(
 
 def note_zero_step(plan):
     """Tick the per-step ZeRO traffic accounting for one executed step
-    under an engaged ZeroPlan (no-op for GradReducePlan/None)."""
+    under an engaged ZeroPlan (no-op for GradReducePlan/None). A
+    ComposedPlan (collectives/compose) carries its inner zero plan on
+    ``.zero`` — the composed step's zero traffic rides the same basis."""
     from .zero import ZeroPlan
 
+    if plan is not None and not isinstance(plan, ZeroPlan):
+        plan = getattr(plan, "zero", None)
     if not isinstance(plan, ZeroPlan):
         return
     _trace_zero_collectives(plan)
@@ -319,8 +323,11 @@ def note_ring_attn(plan):
 
 
 def build_grad_reduce_plan(named_params, mesh, *, exclude_axes=(),
-                           quantized=None, bucket_bytes=None):
-    """Build the dp-grad reduce plan for a ShardedTrainStep, or None.
+                           quantized=None, bucket_bytes=None,
+                           reason_out=None):
+    """Build the dp-grad reduce plan for a ShardedTrainStep, or None
+    (``reason_out``, when given, receives the structured decline
+    :class:`~.compose.Reason`).
 
     ``named_params``: [(name, shape, dtype)] in reduce (state-dict)
     order. Engages only when it is provably safe AND worthwhile on this
@@ -336,22 +343,25 @@ def build_grad_reduce_plan(named_params, mesh, *, exclude_axes=(),
     - at least one gradient actually quantizes (tiny models keep the
       exact pre-PR program byte-for-byte — nothing to win there).
     """
+    from .compose import Reason
+    from .compose import note_decline as _note
+
     if not quant_collectives_enabled():
-        return None
+        return _note(reason_out, Reason.MASTER_OFF)
     if quantized is None:
         quantized = grads_quantized()
     live = {a: mesh.get_dim_size(a) for a in mesh.dim_names
             if mesh.get_dim_size(a) > 1}
     if not live or not set(live) <= {"dp", "sharding", "mp"}:
-        return None
+        return _note(reason_out, Reason.MESH_AXES)
     axes = tuple(a for a in ("dp", "sharding")
                  if a in live and a not in exclude_axes)
     if not axes:
-        return None
+        return _note(reason_out, Reason.NO_DATA_AXIS)
     buckets = partition_buckets(named_params, bucket_bytes=bucket_bytes,
                                 quantized=quantized)
     if not any(b.quantized for b in buckets):
-        return None
+        return _note(reason_out, Reason.NO_QUANTIZABLE_GRAD)
     nranks = 1
     for a in axes:
         nranks *= live[a]

@@ -708,7 +708,7 @@ def _ring_layers(model):
     return layers
 
 
-def build_ring_attn_plan(named_params, mesh, model):
+def build_ring_attn_plan(named_params, mesh, model, reason_out=None):
     """Build the step's ring plan, or None (decline). The decline matrix
     (docs/ATTENTION.md — declined configs keep the pre-PR program
     byte-for-byte):
@@ -726,18 +726,25 @@ def build_ring_attn_plan(named_params, mesh, model):
     Non-divisible sequence lengths decline PER BATCH SIGNATURE via
     :meth:`RingAttnPlan.seq_ok` — the plan itself stays built.
     """
+    from .compose import Reason
+    from .compose import note_decline as _note
+
     if not ring_attn_enabled():
-        return None
+        from . import quant_collectives_enabled
+
+        return _note(reason_out,
+                     Reason.MASTER_OFF if not quant_collectives_enabled()
+                     else Reason.RING_OFF)
     live = {a: mesh.get_dim_size(a) for a in mesh.dim_names
             if mesh.get_dim_size(a) > 1}
     n = live.get("sep", 1)
     if n < 2:
-        return None
+        return _note(reason_out, Reason.NO_SEP)
     if not set(live) <= {"dp", "sharding", "sep"}:
-        return None
+        return _note(reason_out, Reason.MESH_AXES)
     layers = _ring_layers(model)
     if not layers:
-        return None
+        return _note(reason_out, Reason.MODEL_INELIGIBLE)
     data_axes = tuple(a for a in ("dp", "sharding") if a in live)
     axes = data_axes + ("sep",)
     nranks = 1

@@ -273,8 +273,10 @@ def resolve_stage(optimizer, explicit=None):
 
 
 def build_zero_plan(named_entries, mesh, stage, *, optimizer=None,
-                    grad_clip=None, deferred=None):
-    """Resolve the ZeRO execution plan for a ShardedTrainStep, or None.
+                    grad_clip=None, deferred=None, reason_out=None):
+    """Resolve the ZeRO execution plan for a ShardedTrainStep, or None
+    (``reason_out``, when given, receives the structured
+    :class:`~.compose.Reason` for a decline).
 
     ``named_entries``: ``[(name, tensor)]`` for the trainable params in
     state-dict order. Engages only when provably safe on this runtime:
@@ -291,24 +293,34 @@ def build_zero_plan(named_entries, mesh, stage, *, optimizer=None,
     - param placements are consistent with the stage (stage-2 marks
       with data-axis param shards fall back to GSPMD).
     """
-    if stage < 2 or not zero_mode_enabled():
-        return None
+    from .compose import Reason
+    from .compose import note_decline as _note
+
+    if stage < 2:
+        return _note(reason_out, Reason.STAGE_LT_2)
+    if not zero_mode_enabled():
+        from . import quant_collectives_enabled
+
+        return _note(reason_out,
+                     Reason.MASTER_OFF if not quant_collectives_enabled()
+                     else Reason.ZERO_MODE_OFF)
     live = {a: mesh.get_dim_size(a) for a in mesh.dim_names
             if mesh.get_dim_size(a) > 1}
     if not live or not set(live) <= {"dp", "sharding"}:
-        return None
+        return _note(reason_out, Reason.MESH_AXES)
     shard_axis = "sharding" if "sharding" in live else "dp"
     degree = live[shard_axis]
     if degree <= 1:
-        return None
+        return _note(reason_out, Reason.NO_DATA_AXIS)
     if optimizer is not None and (
             getattr(optimizer, "_factored", False)
             or getattr(optimizer, "_moment_dtype", None)):
-        return None
+        return _note(reason_out, Reason.OPTIMIZER_STATS)
     from ...nn.clip import ClipGradByNorm
 
     if isinstance(grad_clip, ClipGradByNorm):
-        return None  # per-tensor norms need the full grad tensor
+        # per-tensor norms need the full grad tensor
+        return _note(reason_out, Reason.CLIP_BY_NORM)
     from . import grads_quantized
     from ..auto_parallel import Shard, placements_to_spec
 
@@ -336,12 +348,14 @@ def build_zero_plan(named_entries, mesh, stage, *, optimizer=None,
                 if ax_name == shard_axis:
                     sdim = pl.dim
                 elif da.process_mesh.get_dim_size(ax_name) > 1:
-                    return None  # sharded over an axis this plan can't own
+                    # sharded over an axis this plan can't own
+                    return _note(reason_out, Reason.MESH_AXES)
             if sdim is not None:
                 spec = placements_to_spec(da.process_mesh, da.placements)
         if sdim is not None:
             if stage < 3:
-                return None  # stage-2 marks + stage-3 placements: GSPMD
+                # stage-2 marks + stage-3 placements: GSPMD
+                return _note(reason_out, Reason.ZERO3_PLACEMENT)
             attr = deferred.get(name)
             params.append(ZeroParam(
                 name, "dim", shape, dtype, numel, shard_dim=sdim,
@@ -357,7 +371,7 @@ def build_zero_plan(named_entries, mesh, stage, *, optimizer=None,
         else:
             params.append(ZeroParam(name, "replicated", shape, dtype, numel))
     if not any(p.kind in ("dim", "flat") for p in params):
-        return None
+        return _note(reason_out, Reason.NO_SHARDABLE_STATE)
     return ZeroPlan(stage=stage,
                     axes=tuple(a for a in ("dp", "sharding") if a in live),
                     shard_axis=shard_axis, shard_degree=degree,

@@ -367,11 +367,13 @@ class PipelineLayer(Layer):
 
         mid_mb, out_mb = mid_aval, out_aval   # probe returns mb-sized
 
-        def body(packed, shared, x_mb, lab_mb):
+        def body(ids, packed, shared, x_mb, lab_mb):
             # shared params consumed by several branches: pcast-varying so
             # the switch transpose psums their cotangents home
             shared = [jax.lax.pcast(a, "pp", to="varying") for a in shared]
-            idx = jax.lax.axis_index("pp")
+            # stage ordinal via sharded iota: lax.axis_index lowers to the
+            # PartitionId op this container's XLA rejects (pipeline.py)
+            idx = ids[0]
 
             def make_branch(s):
                 def branch(packed_local, shared_ops, x_in, state):
@@ -419,17 +421,54 @@ class PipelineLayer(Layer):
 
             return pipeline_schedule_hetero(
                 stage_fn2, x_mb, pp, mid_mb, out_mb,
-                out_consume=out_consume)
+                out_consume=out_consume, stage_id=idx)
 
         lab_arr = (labels._data if loss_fn is not None
                    else jnp.zeros((x.shape[0],), jnp.int32))
-        out = jax.shard_map(
-            body, mesh=mesh.jax_mesh,
-            in_specs=({dt: P("pp") for dt in dtypes}, P(), P(), P()),
-            out_specs=P(),
-            axis_names={"pp"},
-        )(pack(flat_all), shared_flat, microbatch(x._data, n_micro),
-          microbatch(lab_arr, n_micro))
+        # one jitted ring per program signature: a fresh jax.jit over a
+        # fresh closure would re-trace and re-compile on every call
+        key = (mesh.jax_mesh, pp, n_micro, loss_fn,
+               tuple((n, state[n]._data.shape, str(state[n]._data.dtype))
+                     for n in names),
+               x._data.shape, str(x._data.dtype),
+               lab_arr.shape, str(lab_arr.dtype))
+        cache = self.__dict__.setdefault("_ring_jit_cache", {})
+        jitted = cache.get(key)
+        if jitted is not None:
+            cache[key] = cache.pop(key)   # refresh recency: LRU, not FIFO
+        else:
+            # EVERY live mesh axis joins as MANUAL (replicated specs over
+            # the non-pp axes): an auto axis propagating into the region
+            # is the IsManualSubgroup partitioner hard-abort on this XLA
+            # (the same fix as the grad-reduce region — the ring math is
+            # replicated over dp/mp, so per-shard code is unchanged)
+            sharded = jax.shard_map(
+                body, mesh=mesh.jax_mesh,
+                in_specs=(P("pp"), {dt: P("pp") for dt in dtypes}, P(),
+                          P(), P()),
+                out_specs=P(),
+                axis_names=set(mesh.jax_mesh.axis_names),
+            )
+            # the legacy shard_map has no eager path for regions with auto
+            # (non-manual) mesh axes — a fleet mesh always carries its
+            # other (possibly size-1) axes, so the ring must run under jit
+            # bounded LRU: a fresh-closure loss_fn per call (identity
+            # key misses, same cost as the pre-cache behavior) must not
+            # grow the cache or evict the hot entries — hits refresh
+            # recency above, so next(iter) is the least-recently used
+            if len(cache) >= 8:
+                cache.pop(next(iter(cache)))
+            jitted = cache[key] = jax.jit(sharded)
+        from .. import collectives as _coll
+
+        # partial-manual region (pp manual, other fleet axes auto): any
+        # shard_activation hint traced inside it is the IsManualSubgroup
+        # hard-abort on legacy jax — the region flag makes them skip
+        with _coll.manual_grad_region():
+            out = jitted(
+                jnp.arange(pp, dtype=jnp.int32), pack(flat_all),
+                shared_flat, microbatch(x._data, n_micro),
+                microbatch(lab_arr, n_micro))
         if loss_fn is not None:
             return Tensor(out)                  # [n_micro] losses
         return Tensor(unmicrobatch(out))

@@ -110,6 +110,14 @@ class ShardedTrainStep(TrainStep):
         self._ring_plan = None
         self._ring_plan_ready = False
         self._ring_last_active = False
+        # composed hybrid plan (collectives/compose, docs/COMMS.md
+        # lattice): when mp and/or pp are live and the model carries a
+        # composable flagship decoder, ONE fully-manual region over
+        # every live axis composes tp seams + bucketed/quantized grad
+        # reduce + ZeRO + the explicit pipeline schedule. None keeps the
+        # pre-PR GSPMD program byte-for-byte.
+        self._composed_plan = None
+        self._composed_plan_ready = False
 
     # -- placement ---------------------------------------------------------
     def _place_model(self):
@@ -287,14 +295,21 @@ class ShardedTrainStep(TrainStep):
         self._zero_plan_ready = True
         self._zero_plan = None
         from ..utils.flags import get_flags
+        from .collectives import compose as _compose
         from .collectives import zero as _zero
+
+        Reason = _compose.Reason
+
+        def _decline(reason):
+            _compose.note_plan_engagement("zero", reason)
+            return None
 
         stage = _zero.resolve_stage(self.optimizer, self.sharding_stage)
         if stage < 2:
-            return None
+            return _decline(Reason.STAGE_LT_2)
         if get_flags("check_nan_inf")["check_nan_inf"]:
             # checkify cannot instrument through the manual region
-            return None
+            return _decline(Reason.CHECKIFY)
         entries = self.model.state_dict()
         named = []
         for n, t in entries.items():
@@ -316,11 +331,15 @@ class ShardedTrainStep(TrainStep):
                     and ax in ("dp", "sharding")
                     for ax, pl in zip(da.process_mesh.dim_names,
                                       da.placements)):
-                return None
+                return _decline(Reason.FROZEN_SHARD)
+        reasons = []
         self._zero_plan = _zero.build_zero_plan(
             named, self.mesh, stage, optimizer=self.optimizer,
             grad_clip=self.optimizer._grad_clip,
-            deferred=self._zero_deferred())
+            deferred=self._zero_deferred(), reason_out=reasons)
+        _compose.note_plan_engagement(
+            "zero", Reason.ENGAGED if self._zero_plan is not None
+            else (reasons[0] if reasons else Reason.UNSPECIFIED))
         return self._zero_plan
 
     def zero_plan(self):
@@ -329,6 +348,16 @@ class ShardedTrainStep(TrainStep):
         return self._zero_plan if self._zero_plan_ready else None
 
     def _build(self):
+        cplan = self._ensure_composed_plan()
+        if cplan is not None:
+            # the composed plan owns the whole step: comms accounting
+            # rides its GradReducePlan duck-type, and its inner zero
+            # plan (possibly None) drives the slot-layout hooks
+            self._reduce_plan = cplan
+            self._reduce_plan_ready = True
+            self._zero_plan = cplan.zero
+            self._zero_plan_ready = True
+            return self._build_composed(cplan)
         plan = self._ensure_zero_plan()
         if plan is None:
             return super()._build()
@@ -338,6 +367,201 @@ class ShardedTrainStep(TrainStep):
         self._reduce_plan = plan
         self._reduce_plan_ready = True
         self._build_zero(plan)
+
+    # -- composed hybrid mode (distributed/collectives/compose) ------------
+    def _ensure_composed_plan(self):
+        """Resolve (once, at build) whether this step runs the composed
+        hybrid mode — see collectives/compose.py's lattice. None falls
+        through to the zero / reduce / ring plans (pure-data meshes) or
+        the pre-PR GSPMD program (declined hybrids)."""
+        if self._composed_plan_ready:
+            return self._composed_plan
+        self._composed_plan_ready = True
+        self._composed_plan = None
+        from .collectives import compose as _compose
+
+        plan, reason = _compose.build_composed_plan(
+            self.model, self.optimizer, self.mesh,
+            sharding_stage=self.sharding_stage,
+            shard_vocab_head=self.shard_vocab_head,
+            grad_clip=self.optimizer._grad_clip)
+        _compose.note_plan_engagement("composed", reason)
+        self._composed_plan = plan
+        return plan
+
+    def composed_plan(self):
+        """The resolved ComposedPlan (None = per-plan/GSPMD path) — the
+        bench "comms" block embeds its summary()."""
+        return self._composed_plan if self._composed_plan_ready else None
+
+    def _build_composed(self, plan):
+        """Compile the composed step: ONE fully-manual shard_map region
+        over every live axis containing (gather/stage-slice params ->
+        forward with in-region tp seams and the inline pipeline ring ->
+        loss -> backward -> bucketed/quantized + zero grad reduce ->
+        clip/guard -> sharded update). Mirrors _build_zero's step
+        semantics operation for operation (docs/COMMS.md lattice,
+        docs/PIPELINE.md schedule contract)."""
+        import jax as _jax
+        from jax import shard_map
+
+        from .. import framework
+        from ..jit import _wrap_arrays
+        from ..utils.flags import get_flags as _gf
+        from . import collectives
+        from .collectives import compose as _compose
+        from .collectives import zero as _zero
+        from .. import telemetry as _telemetry
+
+        model, train_fn, opt = self.model, self.train_fn, self.optimizer
+        _telemetry.record_compile(
+            self._compile_label(),
+            ("build", bool(_gf("check_nan_inf")["check_nan_inf"]),
+             "composed", plan.tp, plan.pp,
+             plan.zero.stage if plan.zero else 0))
+        entries = model.state_dict()
+        self._param_names = [
+            n for n, t in entries.items()
+            if isinstance(t, Parameter) and t.trainable
+        ]
+        self._buffer_names = [n for n in entries
+                              if n not in self._param_names]
+        buffer_names = tuple(self._buffer_names)
+        clip = opt._grad_clip
+        reg = opt.regularization
+        axes = plan.axes
+        data_axes = plan.data_axes
+        data_total = int(np.prod([self.mesh.get_dim_size(a)
+                                  for a in data_axes])) if data_axes else 1
+        zplan = plan.zero
+        deferred_info = {}
+        if zplan is not None:
+            deferred_info = {
+                p.deferred_attr: (zplan.shard_axis, p.shard_dim,
+                                  zplan.shard_degree,
+                                  zplan.gather_quantized)
+                for p in zplan.params if p.deferred_attr}
+
+        def make_loss_of(buffers, key_arr, batch):
+            def loss_of(params):
+                state = {}
+                for n, p in params.items():
+                    zp = zplan.by_name.get(n) if zplan is not None else None
+                    if (zp is not None and zp.kind == "dim"
+                            and zp.deferred_attr is None):
+                        p = _zero.gather_shard(
+                            p, zplan.shard_axis, zp.shard_dim,
+                            degree=zplan.shard_degree,
+                            quantized=zplan.gather_quantized)
+                    state[n] = p
+                state.update(buffers)
+                with model._swap_state(state) as mutated:
+                    with framework.no_grad(), framework.rng_key_scope(key_arr):
+                        loss_t = train_fn(*_wrap_arrays(batch))
+                new_buffers = {n: mutated[n] for n in buffer_names}
+                return loss_t._data, new_buffers
+
+            return loss_of
+
+        def per_shard(params, buffers, opt_state, lr_, guard_, key_,
+                      rng_ids, z_ids, tp_ids, pp_ids, *batch):
+            # ordinals ride in as sharded iotas (lax.axis_index lowers
+            # to PartitionId, rejected here); the RNG stream folds the
+            # DATA ordinal only — mp/pp ranks replicate the same draws
+            key = _jax.random.fold_in(key_, rng_ids[0])
+            ctx = _compose.ComposedContext(
+                plan, tp_ordinal=tp_ids[0], stage_ordinal=pp_ids[0])
+            loss_of = make_loss_of(buffers, key, batch)
+            with _compose.composed_scope(ctx), \
+                    _zero.jit_gather_scope(deferred_info):
+                (loss, new_buffers), grads = _jax.value_and_grad(
+                    loss_of, has_aux=True)(params)
+            if plan.tp_seams and (ctx.seams is None
+                                  or ctx.seams.calls == 0):
+                raise RuntimeError(
+                    "composed plan engaged tp seams but the model's "
+                    "trace never routed a matmul through them "
+                    "(models/gpt.py _block_pure) — the step would "
+                    "compute on weight SHARDS as if they were full. "
+                    "Use a flagship decoder stack or disable with "
+                    "PTPU_COMPOSED=0 (docs/COMMS.md).")
+            if data_axes:
+                loss = _jax.lax.pmean(loss, data_axes)
+                new_buffers = {
+                    n: (_jax.lax.pmean(v, data_axes)
+                        if jnp.issubdtype(v.dtype, jnp.inexact) else v)
+                    for n, v in new_buffers.items()}
+            zero_ord = z_ids[0]
+            grads = _compose.reduce_grads(grads, plan, zero_ord)
+            upd_params = _compose.update_view(params, plan, zero_ord)
+            loss, new_upd, new_buffers, new_opt_state, health = \
+                _step_update_tail(
+                    opt, clip, reg, upd_params, grads, loss, new_buffers,
+                    buffers, opt_state, lr_, guard_,
+                    gsumsq_fn=lambda g: _compose.global_grad_sumsq(
+                        g, plan))
+            new_params = _compose.params_out(new_upd, plan)
+            return loss, new_params, new_buffers, new_opt_state, health
+
+        def step(params, buffers, opt_state, lr, guard, key_arr, batch):
+            def leaf_spec(arr):
+                if (data_axes and hasattr(arr, "ndim") and arr.ndim >= 1
+                        and arr.shape[0] % data_total == 0):
+                    return P(data_axes)
+                return P()
+
+            batch_specs = tuple(leaf_spec(a) for a in batch)
+            pspecs = {n: plan.param_specs.get(n, P()) for n in params}
+            bspecs = {n: P() for n in buffers}
+            nbspecs = {n: P() for n in buffer_names}
+
+            def slot_spec(n, leaf):
+                zp = zplan.by_name.get(n) if zplan is not None else None
+                if (zp is not None and zp.kind == "flat"
+                        and tuple(leaf.shape) == (zp.padded,)):
+                    return P(zplan.shard_axis)
+                # param-shaped slots follow the param's storage spec
+                # (pipeline/TP-sharded optimizer state for free)
+                if tuple(leaf.shape) == tuple(entries[n]._data.shape):
+                    return plan.param_specs.get(n, P())
+                return P()
+
+            sspecs = {n: {k: slot_spec(n, v) for k, v in slots.items()}
+                      for n, slots in opt_state.items()}
+            rng_ids = jnp.arange(max(data_total, 1), dtype=jnp.int32)
+            rng_spec = P(data_axes) if data_axes else P()
+            if zplan is not None:
+                z_ids = jnp.arange(zplan.shard_degree, dtype=jnp.int32)
+                z_spec = P(zplan.shard_axis)
+            else:
+                z_ids = jnp.zeros((1,), jnp.int32)
+                z_spec = P()
+            if plan.tp_axis:
+                tp_ids = jnp.arange(plan.tp, dtype=jnp.int32)
+                tp_spec = P(plan.tp_axis)
+            else:
+                tp_ids = jnp.zeros((1,), jnp.int32)
+                tp_spec = P()
+            if plan.pp_axis:
+                pp_ids = jnp.arange(plan.pp, dtype=jnp.int32)
+                pp_spec = P(plan.pp_axis)
+            else:
+                pp_ids = jnp.zeros((1,), jnp.int32)
+                pp_spec = P()
+            with collectives.manual_grad_region():
+                return shard_map(
+                    per_shard, mesh=self.mesh.jax_mesh,
+                    in_specs=(pspecs, bspecs, sspecs, P(), P(), P(),
+                              rng_spec, z_spec, tp_spec, pp_spec)
+                    + batch_specs,
+                    out_specs=(P(), pspecs, nbspecs, sspecs, P()),
+                    check_vma=False, axis_names=set(axes),
+                )(params, buffers, opt_state, lr, guard, key_arr,
+                  rng_ids, z_ids, tp_ids, pp_ids, *batch)
+
+        self._execs = {}
+        self._checkified = False
+        self._compiled = jax.jit(step, donate_argnums=(0, 2))
 
     def _build_zero(self, plan):
         """Compile the ZeRO step: one fully-manual shard_map region over
@@ -531,20 +755,27 @@ class ShardedTrainStep(TrainStep):
         self._reduce_plan = None
         from ..utils.flags import get_flags
         from . import collectives
+        from .collectives import compose as _compose
+
+        Reason = _compose.Reason
+
+        def _decline(reason):
+            _compose.note_plan_engagement("grad_reduce", reason)
+            return None
 
         if not collectives.quant_collectives_enabled():
-            return None
+            return _decline(Reason.MASTER_OFF)
         if get_flags("check_nan_inf")["check_nan_inf"]:
-            return None
+            return _decline(Reason.CHECKIFY)
         mp_live = ("mp" in self.mesh.dim_names
                    and self.mesh.get_dim_size("mp") > 1)
         if self.shard_vocab_head and mp_live:
             # the vocab-sharded CE opens its own mp shard_map island
-            return None
+            return _decline(Reason.VOCAB_SHARDED_HEAD)
         if collectives.tp_seam_mode() == "fused" and mp_live:
             # explicit seam forcing: the seam islands win the one manual
             # region this XLA allows (docs/COMMS.md precedence)
-            return None
+            return _decline(Reason.SEAM_FORCED)
         entries = self.model.state_dict()
         taken = set()
         for n in self._param_names:
@@ -560,11 +791,15 @@ class ShardedTrainStep(TrainStep):
             # manual subgroups is exactly the lowering this XLA rejects
             # (docs/COMMS.md runtime limits) — those placements stay
             # with GSPMD end to end, on every data axis
-            return None
+            return _decline(Reason.ZERO3_PLACEMENT)
         named = [(n, tuple(entries[n]._data.shape),
                   entries[n]._data.dtype) for n in self._param_names]
+        reasons = []
         self._reduce_plan = collectives.build_grad_reduce_plan(
-            named, self.mesh)
+            named, self.mesh, reason_out=reasons)
+        _compose.note_plan_engagement(
+            "grad_reduce", Reason.ENGAGED if self._reduce_plan is not None
+            else (reasons[0] if reasons else Reason.UNSPECIFIED))
         return self._reduce_plan
 
     def comms_plan(self):
@@ -591,22 +826,33 @@ class ShardedTrainStep(TrainStep):
         self._ring_plan_ready = True
         self._ring_plan = None
         from ..utils.flags import get_flags
+        from .collectives import compose as _compose
         from .collectives import ring_attention as _ring
         from .collectives import zero as _zero
 
+        Reason = _compose.Reason
+
+        def _decline(reason):
+            _compose.note_plan_engagement("ring_attn", reason)
+            return None
+
         if ("sep" not in self.mesh.dim_names
                 or self.mesh.get_dim_size("sep") < 2):
-            return None
+            return None  # not a sep mesh at all: nothing to resolve
         if not _ring.ring_attn_enabled():
-            return None
+            from . import collectives
+
+            return _decline(Reason.MASTER_OFF
+                            if not collectives.quant_collectives_enabled()
+                            else Reason.RING_OFF)
         if get_flags("check_nan_inf")["check_nan_inf"]:
-            return None
+            return _decline(Reason.CHECKIFY)
         if _zero.resolve_stage(self.optimizer, self.sharding_stage) >= 2:
-            return None
+            return _decline(Reason.ZERO_REQUESTED)
         if (self.shard_vocab_head
                 and self.shard_vocab_head in self.mesh.dim_names
                 and self.mesh.get_dim_size(self.shard_vocab_head) > 1):
-            return None
+            return _decline(Reason.VOCAB_SHARDED_HEAD)
         entries = self.model.state_dict()
         if not self._param_names:
             self._param_names = [
@@ -614,8 +860,12 @@ class ShardedTrainStep(TrainStep):
                 if isinstance(t, Parameter) and t.trainable]
         named = [(n, tuple(entries[n]._data.shape), entries[n]._data.dtype)
                  for n in self._param_names]
+        reasons = []
         self._ring_plan = _ring.build_ring_attn_plan(
-            named, self.mesh, self.model)
+            named, self.mesh, self.model, reason_out=reasons)
+        _compose.note_plan_engagement(
+            "ring_attn", Reason.ENGAGED if self._ring_plan is not None
+            else (reasons[0] if reasons else Reason.UNSPECIFIED))
         return self._ring_plan
 
     def ring_plan(self):
@@ -780,12 +1030,24 @@ class ShardedTrainStep(TrainStep):
             return loss, new_buffers, grads
 
         shard_ids = jnp.arange(total, dtype=jnp.int32)
+        # a live-but-placement-free mp axis joins the region as a MANUAL
+        # axis (params enter replicated; every mp rank runs the same
+        # per-shard math redundantly, exactly what GSPMD computed for
+        # it). Leaving it AUTO lets sharding propagation reach
+        # instructions inside the manual region, which this XLA's
+        # partitioner hard-aborts on (IsManualSubgroup CHECK — the
+        # pre-existing example-02 crash class). The reduce axes
+        # (plan.axes) are unchanged: no mp collective is ever emitted.
+        region_axes = set(axes)
+        if ("mp" in self.mesh.dim_names
+                and self.mesh.get_dim_size("mp") > 1):
+            region_axes.add("mp")
         with collectives.manual_grad_region():
             loss, new_buffers, grads = shard_map(
                 per_shard, mesh=self.mesh.jax_mesh,
                 in_specs=(pspecs, bspecs, P(), P(axes)) + batch_specs,
                 out_specs=(P(), nbspecs, pspecs),
-                check_vma=False, axis_names=set(axes),
+                check_vma=False, axis_names=region_axes,
             )(params, buffers, key_arr, shard_ids, *batch)
         return (loss, new_buffers), grads
 
@@ -810,13 +1072,15 @@ class ShardedTrainStep(TrainStep):
             if self._compiled is not None:
                 # FLAGS_check_nan_inf flipped since the last build
                 # (mirrors TrainStep._call_impl): re-resolve the plans —
-                # checkify declines both the zero mode and the PR 6
+                # checkify declines the composed/zero modes and the PR 6
                 # reduce plan — and rebuild with/without instrumentation
                 self._zero_plan_ready = False
                 self._reduce_plan = None
                 self._reduce_plan_ready = False
                 self._ring_plan = None
                 self._ring_plan_ready = False
+                self._composed_plan = None
+                self._composed_plan_ready = False
             self._build()
         entries = self.model.state_dict()
         params = {n: entries[n]._data for n in self._param_names}

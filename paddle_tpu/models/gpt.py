@@ -661,9 +661,18 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     b, s, hdim = x.shape
     hd = hdim // num_heads
     h = _rms_pure(x, ln1)
-    q = _col(h, wq).reshape(b, s, num_heads, hd)
-    k = _col(h, wk).reshape(b, s, num_kv_heads, hd)
-    v = _col(h, wv).reshape(b, s, num_kv_heads, hd)
+    # head counts and the attention seq length derive from the SEAM
+    # output, not the config: inside a composed manual region
+    # (collectives/compose) the block runs per shard — `_col` gathers
+    # the seq-sharded stream (sq = s * tp) and its mp-sharded weight
+    # yields the LOCAL head slice (num_heads/tp), while the plain and
+    # island-seam paths see sq == s and the full head count. `-1` in the
+    # reshape covers both without branching.
+    q = _col(h, wq)
+    sq = q.shape[1]
+    q = q.reshape(b, sq, -1, hd)
+    k = _col(h, wk).reshape(b, sq, -1, hd)
+    v = _col(h, wv).reshape(b, sq, -1, hd)
     # engaged ring-attention region (docs/ATTENTION.md): this block sees
     # ONE sep shard's zigzag token slice, so rope must rotate by the
     # GLOBAL positions of those tokens (from the region's sep ordinal),
@@ -674,6 +683,11 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     if use_rope:
         if _ring_ctx is not None:
             rope_tables = _ring_ctx.rope_tables(s, hd)
+        elif sq != s:
+            # composed-seam path: the gathered attention stream covers
+            # the FULL sequence; hoisted local-position tables (built
+            # for the seq shard) must not apply
+            rope_tables = None
         q = _rope_pure(q, tables=rope_tables)
         k = _rope_pure(k, tables=rope_tables)
     # remat anchors (inert under policies that don't name them): saving
@@ -682,7 +696,7 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     q = _save(q, "attn_q")
     k = _save(k, "attn_k")
     v = _save(v, "attn_v")
-    o = _sdpa_pure(q, k, v, causal=True).reshape(b, s, num_heads * hd)
+    o = _sdpa_pure(q, k, v, causal=True).reshape(b, sq, -1)
     # selective-remat anchor for the XLA-fallback path: with
     # recompute_policy="attn" the backward reuses this tensor instead of
     # re-running attention (quadratic in seq). On the pallas path the
@@ -762,6 +776,28 @@ _BLOCK_PARAM_FIELDS = (
     ("wu", "mlp.up_proj.weight"),
     ("wd", "mlp.down_proj.weight"),
 )
+
+
+def _zero_jit_gather():
+    """JIT slab-gather closure over _BLOCK_PARAM_FIELDS, or None when no
+    dim-sharded slab is deferred (docs/ZERO.md stage-3) — shared by the
+    pure-data zero path and the composed region."""
+    from paddle_tpu.distributed.collectives import zero as _zero
+
+    info = _zero.active_jit_gathers()
+    if not info:
+        return None
+    ents = tuple(info.get(attr) for attr, _ in _BLOCK_PARAM_FIELDS)
+    if not any(e is not None for e in ents):
+        return None
+
+    def gather(p, _ents=ents):
+        # per-layer slice of a dim-d-sharded slab is sharded at d-1
+        return tuple(
+            w if e is None else _zero.gather_shard(
+                w, e[0], e[1] - 1, degree=e[2], quantized=e[3])
+            for w, e in zip(p, _ents))
+    return gather
 
 
 def _resolve_remat(cfg):
@@ -978,6 +1014,46 @@ class StackedDecoder(nn.Layer):
             p._dist_attr = TensorDistAttr(mesh, placements)
         return self
 
+    def _run_composed(self, ctx, x, params):
+        """Composed-region decoder body (collectives/compose,
+        docs/COMMS.md lattice): runs PER SHARD inside the step's ONE
+        fully-manual region. The residual stream is sequence-sharded
+        over mp between the in-region seams (seq_split/seq_unsplit are
+        the hand-written transpose pair), ZeRO slab gathers defer into
+        the scan body exactly as in the pure-data zero mode, and a live
+        pipeline axis runs the explicit inline 1F1B/zero-bubble
+        schedule (distributed/pipeline.py) over this shard's stage
+        slab with the stage ordinal from the region's sharded iota."""
+        cfg = self.config
+        plan = ctx.plan
+        policy, int8_names = (_resolve_remat(cfg) if cfg.recompute
+                              else (None, frozenset()))
+        gather = _zero_jit_gather()
+
+        seams = ctx.seams
+        # no hoisted rope tables here: the seq-sharded stream's local
+        # positions are not the attention stream's (the seam gather
+        # restores the full sequence; _block_pure rotates inline)
+        block = _make_block(cfg, tables=None, int8_names=int8_names,
+                            tp_seams=seams, policy=policy, gather=gather)
+        ctx.decoder_calls += 1
+        if seams is not None:
+            x = seams.seq_split(x)
+        if plan.pp_axis:
+            x = ctx.pipeline_apply(block, x, params,
+                                   gather=gather is not None)
+        elif scan_layers_enabled():
+            x = _scan_blocks(block, x, params,
+                             min_unroll=2 if gather else 1)
+        else:
+            L = int(params[0].shape[0])
+            x = _unrolled_blocks(
+                block, x,
+                (tuple(w[i] for w in params) for i in range(L)))
+        if seams is not None:
+            x = seams.seq_unsplit(x)
+        return x
+
     def forward(self, x):
         import jax
         from paddle_tpu.core.dispatch import apply_op
@@ -987,6 +1063,13 @@ class StackedDecoder(nn.Layer):
 
         def _run(x, *params):
             import os
+
+            from paddle_tpu.distributed.collectives import (
+                compose as _compose)
+
+            _ctx = _compose.active_composed_context()
+            if _ctx is not None:
+                return self._run_composed(_ctx, x, params)
 
             # PTPU_ROPE_HOIST=1 precomputes sin/cos tables once per step
             # outside the scan. Measured SLOWER on v5e (0.5007 vs 0.5072 MFU
@@ -1034,23 +1117,8 @@ class StackedDecoder(nn.Layer):
             # scope; each sharded slab gathers per layer INSIDE the
             # remat-wrapped scan body (backward re-gathers), and AD of
             # the gather reduce-scatters the slab grads.
-            gather = None
-            if pp <= 1 and tp_seams is None:
-                from paddle_tpu.distributed.collectives import zero as _zero
-
-                info = _zero.active_jit_gathers()
-                if info:
-                    ents = tuple(info.get(attr)
-                                 for attr, _ in _BLOCK_PARAM_FIELDS)
-                    if any(e is not None for e in ents):
-                        def gather(p, _ents=ents):
-                            # per-layer slice of a dim-d-sharded slab is
-                            # sharded at d-1
-                            return tuple(
-                                w if e is None else _zero.gather_shard(
-                                    w, e[0], e[1] - 1, degree=e[2],
-                                    quantized=e[3])
-                                for w, e in zip(p, _ents))
+            gather = (_zero_jit_gather()
+                      if pp <= 1 and tp_seams is None else None)
 
             block = _make_block(cfg, tables=tables, int8_names=int8_names,
                                 tp_seams=tp_seams, policy=policy,
@@ -1070,6 +1138,26 @@ class StackedDecoder(nn.Layer):
 
             def step(x, p):
                 return block(x, p), None
+
+            # a hybrid pipeline mesh outside the composed path would
+            # open a PARTIAL-manual shard_map over 'pp' — this
+            # container's XLA hard-ABORTS the partitioner on
+            # CollectivePermute with manual subgroups (docs/COMMS.md
+            # runtime limits), killing the whole process instead of
+            # raising. Refuse loudly first; the composed hybrid step
+            # (collectives/compose) is the supported lowering here.
+            live_others = [a for a in mesh.dim_names
+                           if a != "pp" and mesh.get_dim_size(a) > 1]
+            if live_others and jax.default_backend() == "cpu":
+                raise RuntimeError(
+                    "pipeline parallelism with other live mesh axes "
+                    f"({'/'.join(live_others)}) cannot lower as a "
+                    "partial-manual shard_map on this XLA build — use "
+                    "the composed hybrid step (ShardedTrainStep over "
+                    "the full mesh, docs/COMMS.md lattice) or a "
+                    "pp-only mesh. If composition was declined, the "
+                    "plan_engagement telemetry names the reason "
+                    "(tools/telemetry_report.py -- plans --).")
 
             from paddle_tpu.distributed.pipeline import (
                 microbatch, spmd_pipeline, spmd_pipeline_interleaved,

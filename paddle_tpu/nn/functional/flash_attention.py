@@ -65,6 +65,14 @@ def _constrain_heads_over_mp(q, k, v):
     from ...distributed.fleet import active_mesh
     from ...distributed.spmd_rules import constraints_enabled
 
+    from ...distributed import collectives as _coll
+
+    if _coll.in_manual_grad_region():
+        # inside the composed/quantized manual region (docs/COMMS.md)
+        # every live axis is already manual — a with_sharding_constraint
+        # naming 'mp' there is illegal, and the per-shard trace already
+        # holds exactly its head slice
+        return q, k, v
     mesh = active_mesh()
     mp_size = (
         mesh.get_dim_size("mp")

@@ -1,0 +1,449 @@
+"""Composed hybrid-mesh lattice (ISSUE 14, collectives/compose.py,
+docs/COMMS.md): which mechanisms engage together on which meshes, that
+every declined combo keeps the pre-compose program, and that the
+composed dp×mp(×pp) programs track the single-device trajectory.
+
+Runs on the 8-device CPU mesh (conftest).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import telemetry
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.collectives import compose
+from paddle_tpu.distributed.parallel_step import (ShardedTrainStep,
+                                                  group_sharded_parallel)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
+
+Reason = compose.Reason
+
+
+def _hexes(vals):
+    return [float(np.float32(v)).hex() for v in vals]
+
+
+def _env(overrides):
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        old = {k: os.environ.get(k) for k in overrides}
+        for k, v in overrides.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            yield
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    return ctx()
+
+
+_IDS = np.random.RandomState(3).randint(0, 64, (8, 16))
+_LABS = np.random.RandomState(4).randint(0, 64, (8, 16))
+
+
+def _build(dp=1, mp=1, pp=1, sharding=1, *, placements=None, stage=None,
+           schedule="1f1b", seed=11, shard_vocab_head=None, num_layers=4):
+    """(model, step) on the given mesh. ``placements``: None | "tp" |
+    "pp" (apply_pipeline_placements, tp_axis=mp when live)."""
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                        "pp_degree": pp, "sharding_degree": sharding}
+    fleet.init(is_collective=True, strategy=s)
+    mesh = fleet.get_fleet_mesh()
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=num_layers,
+                    num_heads=2, max_seq_len=16, dropout=0.0,
+                    pp_schedule=schedule)
+    m = GPTForCausalLMPipe(cfg)
+    if placements == "tp":
+        m.decoder.apply_tp_placements(mesh, tp_axis="mp")
+    elif placements == "pp":
+        m.decoder.apply_pipeline_placements(
+            tp_axis="mp" if mp > 1 else None)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    if stage:
+        m, opt, _ = group_sharded_parallel(m, opt, stage)
+    step = ShardedTrainStep(m, lambda a, b: m.loss(a, b), opt, mesh,
+                            shard_vocab_head=shard_vocab_head)
+    return m, step
+
+
+def _run(step, n=3):
+    ids = paddle.to_tensor(_IDS.astype(np.int32))
+    labs = paddle.to_tensor(_LABS.astype(np.int64))
+    return [float(step(ids, labs).numpy()) for _ in range(n)]
+
+
+def _ref(n=3, seed=11, num_layers=4):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=num_layers,
+                    num_heads=2, max_seq_len=16, dropout=0.0)
+    m = GPTForCausalLMPipe(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    return _run(TrainStep(m, lambda a, b: m.loss(a, b), opt), n)
+
+
+# ---------------------------------------------------------------------------
+# Engagement matrix: exactly which features engage together
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "mesh_kw,expect",
+    [
+        # pure-data mesh: the composed plan yields to the per-plan path
+        (dict(dp=8), dict(composed=None)),
+        # dp x mp with TP placements: seams + quantized buckets, no zero
+        (dict(dp=4, mp=2, placements="tp"),
+         dict(composed=True, tp_seams=True, pp=None, zero=0)),
+        # dp x mp WITHOUT placements: nothing to compose — pre-PR plans
+        (dict(dp=4, mp=2), dict(composed=None)),
+        # dp x mp x pp with stage+TP placements: seams + pipeline
+        (dict(dp=2, mp=2, pp=2, placements="pp"),
+         dict(composed=True, tp_seams=True, pp="1f1b", zero=0)),
+        # + zero stage 2: sharded update joins the same region
+        (dict(dp=2, mp=2, pp=2, placements="pp", stage="os_g"),
+         dict(composed=True, tp_seams=True, pp="1f1b", zero=2)),
+        # 3-axis dp x sharding x mp + stage 3: JIT gathers + seams
+        (dict(dp=2, sharding=2, mp=2, placements="tp", stage="p_g_os"),
+         dict(composed=True, tp_seams=True, pp=None, zero=3)),
+        # zero-bubble schedule by config
+        (dict(dp=2, mp=2, pp=2, placements="pp", schedule="zb"),
+         dict(composed=True, tp_seams=True, pp="zb", zero=0)),
+    ])
+def test_engagement_matrix(mesh_kw, expect):
+    try:
+        m, step = _build(**mesh_kw)
+        step(paddle.to_tensor(_IDS.astype(np.int32)),
+             paddle.to_tensor(_LABS.astype(np.int64)))
+        plan = step.composed_plan()
+        if expect["composed"] is None:
+            assert plan is None
+            return
+        assert plan is not None
+        cs = plan.composed_summary()
+        assert cs["tp_seams"] == expect["tp_seams"]
+        assert cs["pp_schedule"] == expect["pp"]
+        assert cs["zero_stage"] == expect["zero"]
+    finally:
+        fleet._reset_for_tests()
+
+
+@pytest.mark.parametrize(
+    "knobs,reason",
+    [
+        ({"PTPU_QUANT_COLLECTIVES": "0"}, Reason.MASTER_OFF),
+        ({"PTPU_COMPOSED": "0"}, Reason.COMPOSED_OFF),
+        ({"PTPU_TP_SEAM": "fused"}, Reason.SEAM_FORCED),
+    ])
+def test_decline_reasons_structured(knobs, reason):
+    """Escape-hatch knobs decline with their structured reason and the
+    lattice records them via plan_engagement (enum + human string)."""
+    try:
+        with _env(knobs):
+            m, step = _build(dp=4, mp=2, placements="tp")
+            plan, got = compose.build_composed_plan(
+                m, step.optimizer, step.mesh, sharding_stage=None,
+                shard_vocab_head=None, grad_clip=None)
+            assert plan is None and got is reason
+            assert reason in compose.REASON_TEXT  # human string exists
+    finally:
+        fleet._reset_for_tests()
+
+
+def test_interleave_and_pipeline_off_decline():
+    try:
+        # vpp storage layout is not composable: structured decline
+        m, step = _build(dp=2, mp=2, pp=2, placements="pp")
+        cfg = m.decoder.config
+        cfg.pp_interleave = 2
+        plan, got = compose.build_composed_plan(
+            m, step.optimizer, step.mesh, sharding_stage=None,
+            shard_vocab_head=None, grad_clip=None)
+        assert plan is None and got is Reason.INTERLEAVE
+        cfg.pp_interleave = 1
+        with _env({"PTPU_PIPELINE_SCHEDULE": "0"}):
+            plan, got = compose.build_composed_plan(
+                m, step.optimizer, step.mesh, sharding_stage=None,
+                shard_vocab_head=None, grad_clip=None)
+            assert plan is None and got is Reason.PIPELINE_OFF
+    finally:
+        fleet._reset_for_tests()
+
+
+def test_vocab_sharded_head_declines():
+    try:
+        m, step = _build(dp=4, mp=2, placements="tp",
+                         shard_vocab_head="mp")
+        plan, got = compose.build_composed_plan(
+            m, step.optimizer, step.mesh, sharding_stage=None,
+            shard_vocab_head="mp", grad_clip=None)
+        assert plan is None and got is Reason.VOCAB_SHARDED_HEAD
+    finally:
+        fleet._reset_for_tests()
+
+
+def test_checkify_declines_composed():
+    from paddle_tpu.utils.flags import set_flags
+
+    try:
+        set_flags({"FLAGS_check_nan_inf": True})
+        m, step = _build(dp=4, mp=2, placements="tp")
+        plan, got = compose.build_composed_plan(
+            m, step.optimizer, step.mesh, sharding_stage=None,
+            shard_vocab_head=None, grad_clip=None)
+        assert plan is None and got is Reason.CHECKIFY
+    finally:
+        set_flags({"FLAGS_check_nan_inf": False})
+        fleet._reset_for_tests()
+
+
+def test_plan_engagement_telemetry_and_report():
+    """Every resolved plan logs ONE plan_engagement{plan,verdict,reason}
+    event, and the report's -- plans -- section renders them."""
+    import io
+
+    from tools.telemetry_report import print_plans
+
+    try:
+        telemetry.enable()
+        telemetry.reset()
+        m, step = _build(dp=4, mp=2, placements="tp")
+        _run(step, 1)
+        snap = telemetry.snapshot()
+        series = snap["counters"].get("plan_engagement_total") or {}
+        assert any("plan=composed" in k and "verdict=engaged" in k
+                   for k in series), series
+        verdicts = compose.last_verdicts()
+        assert verdicts["composed"][0] == "engaged"
+        buf = io.StringIO()
+        print_plans(snap, out=buf)
+        assert "-- plans" in buf.getvalue()
+        assert "composed: engaged" in buf.getvalue()
+    finally:
+        telemetry.disable()
+        fleet._reset_for_tests()
+
+
+def test_declined_hybrid_logs_reason():
+    """A silently-declined hybrid config is VISIBLE: the decline lands
+    in plan_engagement with its structured reason."""
+    try:
+        telemetry.enable()
+        telemetry.reset()
+        with _env({"PTPU_COMPOSED": "0"}):
+            m, step = _build(dp=4, mp=2, placements="tp")
+            _run(step, 1)
+        snap = telemetry.snapshot()
+        series = snap["counters"].get("plan_engagement_total") or {}
+        assert any("plan=composed" in k and "verdict=declined" in k
+                   and "reason=composed_knob_off" in k
+                   for k in series), series
+    finally:
+        telemetry.disable()
+        fleet._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Declined combos keep the pre-compose program byte-for-byte
+# ---------------------------------------------------------------------------
+def test_declined_combo_program_untouched():
+    """With the escape hatch set, the step trajectory is float32-hex
+    IDENTICAL to a build where the composed resolver never existed
+    (monkeypatched to decline) — the decline leaves the program bytes
+    alone."""
+    try:
+        with _env({"PTPU_COMPOSED": "0"}):
+            m, step = _build(dp=4, mp=2, placements="tp")
+            off = _run(step)
+            assert step.composed_plan() is None
+        fleet._reset_for_tests()
+        orig = compose.build_composed_plan
+        compose.build_composed_plan = (
+            lambda *a, **k: (None, Reason.COMPOSED_OFF))
+        try:
+            m, step = _build(dp=4, mp=2, placements="tp")
+            bypassed = _run(step)
+        finally:
+            compose.build_composed_plan = orig
+        assert _hexes(off) == _hexes(bypassed)
+    finally:
+        fleet._reset_for_tests()
+
+
+@pytest.mark.slow  # tier-1 time budget: the COMPOSED=0 variant above
+def test_master_escape_hatch_bitwise():  # covers the decline-untouched claim
+    """PTPU_QUANT_COLLECTIVES=0 keeps the whole hybrid stack on the
+    pre-PR GSPMD program: hex-identical to the compose-bypassed +
+    master-off build."""
+    try:
+        with _env({"PTPU_QUANT_COLLECTIVES": "0"}):
+            m, step = _build(dp=4, mp=2, placements="tp")
+            off = _run(step)
+            assert step.composed_plan() is None
+            assert step.comms_plan() is None
+        fleet._reset_for_tests()
+        with _env({"PTPU_QUANT_COLLECTIVES": "0"}):
+            orig = compose.build_composed_plan
+            compose.build_composed_plan = (
+                lambda *a, **k: (None, Reason.MASTER_OFF))
+            try:
+                m, step = _build(dp=4, mp=2, placements="tp")
+                bypassed = _run(step)
+            finally:
+                compose.build_composed_plan = orig
+        assert _hexes(off) == _hexes(bypassed)
+    finally:
+        fleet._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Numerics: the composed programs track the single-device trajectory
+# ---------------------------------------------------------------------------
+def test_composed_dp_mp_parity():
+    """Composed dp2×mp2 (seams + exact buckets — the tiny model has no
+    quantizable grads) vs single device: the seam decomposition
+    reassociates matmul accumulation, so parity is tight-tolerance, not
+    bitwise (the bitwise contract is the escape hatch)."""
+    try:
+        ref = _ref()
+        m, step = _build(dp=4, mp=2, placements="tp")
+        hyb = _run(step)
+        plan = step.composed_plan()
+        assert plan is not None and plan.tp_seams
+        assert max(abs(a - b) for a, b in zip(ref, hyb)) < 1e-4, (ref,
+                                                                  hyb)
+    finally:
+        fleet._reset_for_tests()
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "zb"])
+def test_composed_pipeline_parity(schedule):
+    try:
+        ref = _ref()
+        m, step = _build(dp=2, mp=2, pp=2, placements="pp",
+                         schedule=schedule)
+        hyb = _run(step)
+        plan = step.composed_plan()
+        assert plan is not None and plan.pp_schedule == schedule
+        assert max(abs(a - b) for a, b in zip(ref, hyb)) < 1e-4, (ref,
+                                                                  hyb)
+    finally:
+        fleet._reset_for_tests()
+
+
+def test_composed_zero3_parity_and_layout():
+    """3-axis dp×sharding×mp stage-3: JIT slab gathers + seams + the
+    dp-sharded update in ONE region; loss tracks single-device and the
+    inner zero plan reports deferred slabs."""
+    try:
+        ref = _ref()
+        m, step = _build(dp=2, sharding=2, mp=2, placements="tp",
+                         stage="p_g_os")
+        hyb = _run(step)
+        plan = step.composed_plan()
+        assert plan is not None and plan.zero is not None
+        assert plan.zero.stage == 3
+        assert any(p.deferred_attr for p in plan.zero.params)
+        assert max(abs(a - b) for a, b in zip(ref, hyb)) < 1e-4, (ref,
+                                                                  hyb)
+        # zero accounting rides the composed plan (bench "zero" block)
+        z = step.zero_plan().zero_summary()
+        assert z["engaged"] and z["stage"] == 3
+    finally:
+        fleet._reset_for_tests()
+
+
+@pytest.mark.slow  # tier-1 time budget; numerics covered by the
+def test_composed_vs_island_seams_track():  # single-device parity tests
+    """Composed seams vs the PR 6 island seams (PTPU_TP_SEAM=fused
+    forces the islands and declines composition): same decomposition,
+    different program structure — trajectories must track tightly."""
+    try:
+        m, step = _build(dp=4, mp=2, placements="tp")
+        composed = _run(step)
+        assert step.composed_plan() is not None
+        fleet._reset_for_tests()
+        with _env({"PTPU_TP_SEAM": "fused"}):
+            m, step = _build(dp=4, mp=2, placements="tp")
+            islands = _run(step)
+            assert step.composed_plan() is None
+        assert max(abs(a - b)
+                   for a, b in zip(composed, islands)) < 1e-4, (
+            composed, islands)
+    finally:
+        fleet._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Pipeline bubble accounting + gate
+# ---------------------------------------------------------------------------
+def test_bubble_accounting():
+    from paddle_tpu.distributed.pipeline import (bubble_fraction_model,
+                                                 bubble_report)
+
+    # the 1F1B model fraction IS the textbook budget
+    assert abs(bubble_fraction_model(4, 4) - 3 / 7) < 1e-9
+    rep = bubble_report(2, 4, schedule="zb", iters=2)
+    assert rep["bubble_fraction_1f1b"] <= rep["bubble_budget_1f1b"] + 1e-9
+    assert rep["bubble_fraction_zb"] < rep["bubble_fraction_1f1b"]
+    assert rep["zb_beats_1f1b"]
+
+
+def test_pipe_gate():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from bench_gate import pipe_violations
+
+    ok = {"pipe": {"bubble_fraction": 0.2, "bubble_budget_1f1b": 0.2,
+                   "schedule": "1f1b", "pp": 2, "n_micro": 4,
+                   "engaged": True, "pp_axis_live": True}}
+    assert pipe_violations(ok) == []
+    over = {"pipe": dict(ok["pipe"], bubble_fraction=0.5)}
+    assert any("over the 1F1B budget" in v for v in pipe_violations(over))
+    silent = {"pipe": dict(ok["pipe"], engaged=False)}
+    assert any("never engaged" in v for v in pipe_violations(silent))
+    # documented config-shape fallbacks and the escape hatch pass; a
+    # reason outside the documented set still fails
+    for reason in ("no_stage_placements", "interleave_not_composed",
+                   "layers_indivisible_by_pp"):
+        shaped = {"pipe": dict(ok["pipe"], engaged=False,
+                               decline_reason=reason)}
+        assert pipe_violations(shaped) == [], reason
+    knob = {"pipe": dict(ok["pipe"], engaged=False,
+                         disabled_by_knob=True)}
+    assert pipe_violations(knob) == []
+    odd = {"pipe": dict(ok["pipe"], engaged=False,
+                        decline_reason="checkify_debug")}
+    assert any("never engaged" in v for v in pipe_violations(odd))
+    zb_bad = {"pipe": dict(ok["pipe"], schedule="zb",
+                           zb_beats_1f1b=False)}
+    assert any("does not beat" in v for v in pipe_violations(zb_bad))
+    assert pipe_violations({}) == []
+
+
+def test_seq_indivisible_raises_clearly():
+    """A sequence that does not divide by tp cannot seq-shard — the
+    composed seams raise with guidance instead of computing garbage."""
+    try:
+        m, step = _build(dp=4, mp=2, placements="tp")
+        ids = paddle.to_tensor(_IDS[:, :15].astype(np.int32))
+        labs = paddle.to_tensor(_LABS[:, :15].astype(np.int64))
+        with pytest.raises(Exception, match="does not divide"):
+            step(ids, labs)
+    finally:
+        fleet._reset_for_tests()

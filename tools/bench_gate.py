@@ -182,6 +182,61 @@ def ring_violations(rec):
     return out
 
 
+#: decline reasons that describe the CONFIG's shape, not a silent
+#: downgrade — documented fallbacks (docs/PIPELINE.md) a user may run on
+#: purpose, so the never-engaged check reports them in the JSON but does
+#: not hard-fail the round. Everything else (checkify, frozen shards,
+#: optimizer stats, missing reason...) still fails: the line claims a
+#: pipeline it silently is not measuring.
+PIPE_CONFIG_DECLINES = frozenset({
+    "no_stage_placements",          # pp axis live, decoder not staged
+    "interleave_not_composed",      # VPP layout (Reason.INTERLEAVE)
+    "layers_indivisible_by_pp",     # Reason.LAYERS_INDIVISIBLE
+})
+
+
+def pipe_violations(rec):
+    """Reference-free violation strings from one record's "pipe" block
+    (docs/PIPELINE.md): the engaged schedule's measured-cost bubble
+    fraction must stay within the plain-1F1B budget
+    (pp−1)/(n_micro+pp−1) — a fraction past it means the schedule
+    arithmetic or the per-phase cost split regressed, not noise (5%
+    relative + 0.02 absolute headroom for timing jitter). A pp-live
+    mesh whose composition never engaged also fails — the line would
+    silently measure the GSPMD fallback while claiming a pipeline —
+    unless the recorded decline reason is one of the documented
+    config-shape fallbacks (:data:`PIPE_CONFIG_DECLINES`) or an
+    explicit escape-hatch knob."""
+    block = rec.get("pipe") if isinstance(rec, dict) else None
+    if not isinstance(block, dict) or "bubble_fraction" not in block:
+        return []
+    out = []
+    frac = block.get("bubble_fraction")
+    budget = block.get("bubble_budget_1f1b")
+    if frac is not None and budget is not None:
+        if float(frac) > float(budget) * 1.05 + 0.02:
+            out.append(
+                f"pipeline bubble fraction {float(frac):.3f} over the "
+                f"1F1B budget {float(budget):.3f} "
+                f"(schedule={block.get('schedule')}, "
+                f"pp={block.get('pp')}, n_micro={block.get('n_micro')})")
+    if (block.get("pp_axis_live") and not block.get("engaged")
+            and not block.get("disabled_by_knob")
+            and block.get("decline_reason") not in PIPE_CONFIG_DECLINES):
+        # an explicit escape-hatch knob (disabled_by_knob) is an
+        # intended A/B baseline, and a config-shape decline is a
+        # documented fallback — only a silent decline fails
+        out.append("pp axis live but the composed pipeline never "
+                   "engaged — the line measured the GSPMD fallback "
+                   f"(decline_reason={block.get('decline_reason')!r}; "
+                   "see the plan_engagement telemetry)")
+    if (block.get("schedule") == "zb"
+            and block.get("zb_beats_1f1b") is False):
+        out.append("zero-bubble schedule engaged but its measured-cost "
+                   "bubble fraction does not beat plain 1F1B")
+    return out
+
+
 def host_overhead_violations(rec, threshold=0.25):
     """Violation strings from one bench record's "anatomy" block: a
     traced run whose host gap (measured step wall − cost-analysis
@@ -447,6 +502,11 @@ def main(argv=None):
         # scaling target + no lost requests (docs/SERVING.md)
         for v in serving_violations(rec):
             print(f"  SERVE {metric}: {v}", flush=True)
+            failed = True
+        # pipeline gate (docs/PIPELINE.md): measured-cost bubble over
+        # budget, or a pp-live mesh whose composition never engaged
+        for v in pipe_violations(rec):
+            print(f"  PIPE  {metric}: {v}", flush=True)
             failed = True
     for ref_path in refs:
         ref_metrics = load_metrics(ref_path)

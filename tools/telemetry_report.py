@@ -166,6 +166,23 @@ def print_ring(snap, out=None):
           f"bytes={int(v)}\n")
 
 
+def print_plans(snap, out=None):
+    """Plan-engagement section (docs/COMMS.md lattice): one row per
+    (plan, verdict, reason) resolution at step build — a hybrid config
+    whose quantized/zero/ring machinery silently declined shows up here
+    with its structured reason instead of just running slower."""
+    counters = snap.get("counters") or {}
+    series = counters.get("plan_engagement_total") or {}
+    if not series:
+        return
+    w = (out or sys.stdout).write
+    w("-- plans (engagement verdicts at step build) --\n")
+    for labels, v in sorted(series.items()):
+        d = dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+        w(f"  {d.get('plan', '?')}: {d.get('verdict', '?')} "
+          f"[{d.get('reason', '?')}] x{int(v)}\n")
+
+
 def print_trace(snap, out=None):
     """Span-tracer section (docs/TELEMETRY.md Tracing): the
     ``trace_span_seconds`` histogram family mirrors every completed
@@ -194,6 +211,7 @@ def print_snapshot(snap, out=None):
     out = out or sys.stdout
     w = out.write
     print_trace(snap, out)
+    print_plans(snap, out)
     print_comms(snap, out)
     print_zero(snap, out)
     print_ring(snap, out)
