@@ -529,6 +529,13 @@ class ComposedPlan:
     tp_partial: tuple = ()              # names needing an extra mp psum
     param_specs: dict = dataclasses.field(default_factory=dict)
     sumsq_axes: dict = dataclasses.field(default_factory=dict)
+    # stage-1 (shard_opt_states) slot sharding kept THROUGH the region:
+    # name -> (dim, degree) for params whose param-shaped optimizer
+    # slots stay stored as 1/degree shards over "sharding" — gathered
+    # exactly (all_gather) just before the update, sliced back to the
+    # shard right after (the stage-3 JIT-gather discipline applied to
+    # slots; resident HBM keeps the stage-1 memory win)
+    slot_shards: dict = dataclasses.field(default_factory=dict)
     quant_block: int = QUANT_BLOCK
 
     # -- GradReducePlan-compatible accounting ---------------------------
@@ -583,6 +590,7 @@ class ComposedPlan:
             "n_micro": self.n_micro,
             "zero_stage": (self.zero.stage if self.zero is not None
                            else 0),
+            "stage1_slot_shards": len(self.slot_shards),
             "buckets": len(self.buckets),
             "tp_partial": list(self.tp_partial),
         }
@@ -660,8 +668,37 @@ def _find_decoder(model):
     return hits[0] if len(hits) == 1 else (None, None)
 
 
+def stage1_slot_dim(shape, size):
+    """The dim a stage-1 (``shard_opt_states``) param-shaped optimizer
+    slot shards over: the FIRST dim divisible by the sharding degree —
+    ONE resolver shared by ``ShardedTrainStep._slot_sharding`` (storage
+    placement) and the composed plan (region in/out specs), so the two
+    can never disagree about the layout. None = not shardable."""
+    for d, n in enumerate(shape):
+        if n and n % size == 0:
+            return d
+    return None
+
+
+def stage1_slot_spec(param_spec, dim):
+    """``param_spec`` with the "sharding" axis appended at ``dim`` —
+    the storage PartitionSpec of a stage-1 sharded slot whose param is
+    stored with ``param_spec`` (mp/pp slabs keep their placements)."""
+    from jax.sharding import PartitionSpec as P
+
+    entries = list(param_spec) + [None] * (dim + 1 - len(param_spec))
+    cur = entries[dim]
+    if cur is None:
+        entries[dim] = "sharding"
+    else:
+        cur = tuple(cur) if isinstance(cur, tuple) else (cur,)
+        entries[dim] = cur + ("sharding",)
+    return P(*entries)
+
+
 def build_composed_plan(model, optimizer, mesh, *, sharding_stage=None,
-                        shard_vocab_head=None, grad_clip=None):
+                        shard_vocab_head=None, grad_clip=None,
+                        shard_opt_states=False):
     """Resolve the composed hybrid plan, or ``(None, Reason)``.
 
     Returns ``(ComposedPlan | None, Reason)`` — the reason is
@@ -876,6 +913,35 @@ def build_composed_plan(model, optimizer, mesh, *, sharding_stage=None,
             shard_degree=degree, nranks=nranks,
             params=tuple(zero_params),
             gather_quantized=_zero.param_gather_quantized())
+
+    # -- stage-1 slot sharding (ROADMAP item 2 follow-up (c)) -----------
+    # shard_opt_states keeps its dp-sharded slot layout THROUGH the
+    # composed region: the region's slot in/out specs carry the
+    # storage's "sharding" extension, the update gathers the shard
+    # exactly and slices the result back (stage1_gather_slots /
+    # stage1_slice_slots) — resident slot HBM stays 1/degree instead of
+    # resharding to replicated at the region boundary. Stage >= 2 slots
+    # are owned by the inner ZeroPlan and skip this walk.
+    slot_shards = {}
+    if shard_opt_states and not zero_wanted and live.get("sharding", 1) > 1:
+        ssize = live["sharding"]
+        for name, t in named:
+            if not t.trainable or name not in param_specs:
+                continue
+            shape = tuple(int(d) for d in t._data.shape)
+            d = stage1_slot_dim(shape, ssize)
+            if d is None:
+                continue
+            # the region view divides dims by their mp/pp placements
+            # too: only engage when the LOCAL dim still divides evenly
+            # (otherwise the slot keeps today's replicated region ride)
+            lshape = _local_shape(shape, param_specs[name], sizes)
+            if lshape[d] % ssize:
+                continue
+            slot_shards[name] = (d, ssize)
+        note_plan_engagement(
+            "zero_stage1",
+            Reason.ENGAGED if slot_shards else Reason.NO_SHARDABLE_STATE)
     reduce_main = None
     main_named = [e for e in bucket_named if e[0] not in tp_partial]
     if data_axes and main_named:
@@ -888,7 +954,7 @@ def build_composed_plan(model, optimizer, mesh, *, sharding_stage=None,
         pp_axis=pp_axis, pp=pp, pp_schedule=pp_schedule, n_micro=n_micro,
         zero=zplan, reduce_main=reduce_main,
         tp_partial=tuple(tp_partial), param_specs=param_specs,
-        sumsq_axes=sumsq_axes), Reason.ENGAGED
+        sumsq_axes=sumsq_axes, slot_shards=slot_shards), Reason.ENGAGED
 
 
 # ---------------------------------------------------------------------------
@@ -931,6 +997,52 @@ def update_view(params, plan, zero_ordinal):
     if plan.zero is not None:
         sub = {p.name: params[p.name] for p in plan.zero.params}
         out.update(_zero.update_view(sub, plan.zero, zero_ordinal))
+    return out
+
+
+def stage1_gather_slots(opt_state, params, plan):
+    """Stage-1 sharded slots -> their full (per-mp/pp-slab) update view:
+    one exact tiled all_gather over "sharding" per slot leaf, issued
+    just before the update — resident storage stays 1/degree, the
+    update math is bit-identical to the replicated layout's."""
+    if not plan.slot_shards:
+        return opt_state
+    out = {}
+    for n, slots in opt_state.items():
+        sd = plan.slot_shards.get(n)
+        p = params.get(n)
+        if sd is None or p is None:
+            out[n] = slots
+            continue
+        d, deg = sd
+        exp = list(p.shape)
+        exp[d] //= deg
+        exp = tuple(exp)
+        out[n] = {k: (_zero.gather_shard(v, "sharding", d)
+                      if tuple(v.shape) == exp else v)
+                  for k, v in slots.items()}
+    return out
+
+
+def stage1_slice_slots(new_opt_state, params, plan, ordinal):
+    """Updated full slots back to this rank's stage-1 storage shard
+    (the gather's exact inverse: a dynamic slice at the shard dim)."""
+    if not plan.slot_shards:
+        return new_opt_state
+    out = {}
+    for n, slots in new_opt_state.items():
+        sd = plan.slot_shards.get(n)
+        p = params.get(n)
+        if sd is None or p is None:
+            out[n] = slots
+            continue
+        d, deg = sd
+        pshape = tuple(p.shape)
+        chunk = pshape[d] // deg
+        out[n] = {k: (jax.lax.dynamic_slice_in_dim(
+                          v, ordinal * chunk, chunk, axis=d)
+                      if tuple(v.shape) == pshape else v)
+                  for k, v in slots.items()}
     return out
 
 

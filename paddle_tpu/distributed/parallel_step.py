@@ -154,17 +154,21 @@ class ShardedTrainStep(TrainStep):
                     and "sharding" not in taken
                     and len(param_shape) > 0
                 ):
+                    # the ONE shared dim resolver (compose.stage1_slot_dim)
+                    # so the composed region's slot specs match this
+                    # storage layout exactly (docs/ZERO.md stage 1)
+                    from .collectives.compose import stage1_slot_dim
+
                     size = self.mesh.get_dim_size("sharding")
-                    for d in range(len(param_shape)):
-                        if param_shape[d] % size == 0:
-                            cur = spec[d]
-                            spec[d] = (
-                                ("sharding",) if cur is None
-                                else (tuple(cur) if isinstance(cur, tuple) else (cur,)) + ("sharding",)
-                            )
-                            if not isinstance(spec[d], tuple) or len(spec[d]) == 1:
-                                spec[d] = spec[d][0] if isinstance(spec[d], tuple) else spec[d]
-                            break
+                    d = stage1_slot_dim(param_shape, size)
+                    if d is not None:
+                        cur = spec[d]
+                        spec[d] = (
+                            ("sharding",) if cur is None
+                            else (tuple(cur) if isinstance(cur, tuple) else (cur,)) + ("sharding",)
+                        )
+                        if not isinstance(spec[d], tuple) or len(spec[d]) == 1:
+                            spec[d] = spec[d][0] if isinstance(spec[d], tuple) else spec[d]
                 return NamedSharding(self.mesh.jax_mesh, P(*spec))
             return p_sharding
         return NamedSharding(self.mesh.jax_mesh, P())
@@ -384,7 +388,8 @@ class ShardedTrainStep(TrainStep):
             self.model, self.optimizer, self.mesh,
             sharding_stage=self.sharding_stage,
             shard_vocab_head=self.shard_vocab_head,
-            grad_clip=self.optimizer._grad_clip)
+            grad_clip=self.optimizer._grad_clip,
+            shard_opt_states=self.shard_opt_states)
         _compose.note_plan_engagement("composed", reason)
         self._composed_plan = plan
         return plan
@@ -464,7 +469,7 @@ class ShardedTrainStep(TrainStep):
             return loss_of
 
         def per_shard(params, buffers, opt_state, lr_, guard_, key_,
-                      rng_ids, z_ids, tp_ids, pp_ids, *batch):
+                      rng_ids, z_ids, s1_ids, tp_ids, pp_ids, *batch):
             # ordinals ride in as sharded iotas (lax.axis_index lowers
             # to PartitionId, rejected here); the RNG stream folds the
             # DATA ordinal only — mp/pp ranks replicate the same draws
@@ -494,12 +499,21 @@ class ShardedTrainStep(TrainStep):
             zero_ord = z_ids[0]
             grads = _compose.reduce_grads(grads, plan, zero_ord)
             upd_params = _compose.update_view(params, plan, zero_ord)
+            # stage-1 slot sharding (shard_opt_states): gather the
+            # 1/degree slot shards to their full update view exactly;
+            # the update runs the replicated math bit-for-bit and the
+            # result slices back to the shard below — resident slot
+            # storage never leaves its dp-sharded layout
+            opt_state = _compose.stage1_gather_slots(opt_state, params,
+                                                     plan)
             loss, new_upd, new_buffers, new_opt_state, health = \
                 _step_update_tail(
                     opt, clip, reg, upd_params, grads, loss, new_buffers,
                     buffers, opt_state, lr_, guard_,
                     gsumsq_fn=lambda g: _compose.global_grad_sumsq(
                         g, plan))
+            new_opt_state = _compose.stage1_slice_slots(
+                new_opt_state, params, plan, s1_ids[0])
             new_params = _compose.params_out(new_upd, plan)
             return loss, new_params, new_buffers, new_opt_state, health
 
@@ -521,9 +535,16 @@ class ShardedTrainStep(TrainStep):
                         and tuple(leaf.shape) == (zp.padded,)):
                     return P(zplan.shard_axis)
                 # param-shaped slots follow the param's storage spec
-                # (pipeline/TP-sharded optimizer state for free)
+                # (pipeline/TP-sharded optimizer state for free); a
+                # stage-1 (shard_opt_states) slot additionally carries
+                # its "sharding" extension — the dp-sharded layout rides
+                # THROUGH the region instead of resharding to replicated
                 if tuple(leaf.shape) == tuple(entries[n]._data.shape):
-                    return plan.param_specs.get(n, P())
+                    base = plan.param_specs.get(n, P())
+                    sd = plan.slot_shards.get(n)
+                    if sd is not None:
+                        return _compose.stage1_slot_spec(base, sd[0])
+                    return base
                 return P()
 
             sspecs = {n: {k: slot_spec(n, v) for k, v in slots.items()}
@@ -536,6 +557,13 @@ class ShardedTrainStep(TrainStep):
             else:
                 z_ids = jnp.zeros((1,), jnp.int32)
                 z_spec = P()
+            if plan.slot_shards:
+                s1_deg = next(iter(plan.slot_shards.values()))[1]
+                s1_ids = jnp.arange(s1_deg, dtype=jnp.int32)
+                s1_spec = P("sharding")
+            else:
+                s1_ids = jnp.zeros((1,), jnp.int32)
+                s1_spec = P()
             if plan.tp_axis:
                 tp_ids = jnp.arange(plan.tp, dtype=jnp.int32)
                 tp_spec = P(plan.tp_axis)
@@ -552,12 +580,12 @@ class ShardedTrainStep(TrainStep):
                 return shard_map(
                     per_shard, mesh=self.mesh.jax_mesh,
                     in_specs=(pspecs, bspecs, sspecs, P(), P(), P(),
-                              rng_spec, z_spec, tp_spec, pp_spec)
+                              rng_spec, z_spec, s1_spec, tp_spec, pp_spec)
                     + batch_specs,
                     out_specs=(P(), pspecs, nbspecs, sspecs, P()),
                     check_vma=False, axis_names=set(axes),
                 )(params, buffers, opt_state, lr, guard, key_arr,
-                  rng_ids, z_ids, tp_ids, pp_ids, *batch)
+                  rng_ids, z_ids, s1_ids, tp_ids, pp_ids, *batch)
 
         self._execs = {}
         self._checkified = False

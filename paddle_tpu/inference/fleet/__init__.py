@@ -20,12 +20,17 @@ topology here because the page payload format is invisible to routing,
 handoff, and verification.
 """
 from .disagg import DisaggregatedEngine  # noqa: F401
+from .overload import (Overloaded, OverloadConfig, TransientReplicaError,  # noqa: F401
+                       classify_step_exception, overload_enabled)
 from .router import POLICIES, FleetRouter, ReplicaHandle, make_replicas  # noqa: F401
-from .soak import build_workload, fleet_soak, run_soak, soak_block  # noqa: F401
+from .soak import (build_workload, fleet_soak, overload_block, run_soak,  # noqa: F401
+                   soak_block)
 from .spec_decode import DraftRunner  # noqa: F401
 
 __all__ = [
     "FleetRouter", "ReplicaHandle", "POLICIES", "make_replicas",
     "DisaggregatedEngine", "DraftRunner", "build_workload", "run_soak",
-    "fleet_soak", "soak_block",
+    "fleet_soak", "soak_block", "overload_block", "Overloaded",
+    "OverloadConfig", "TransientReplicaError", "classify_step_exception",
+    "overload_enabled",
 ]

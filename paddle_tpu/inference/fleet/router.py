@@ -15,12 +15,30 @@ router owns the fleet topology (docs/SERVING.md):
   waiting requests; overflow stays in the router's own pending queue
   and is re-scored every tick (late binding: a request dispatches to
   whichever replica is best when capacity appears, not when it arrived).
-- **Health + requeue-on-death.** A replica whose ``step()`` raises is
-  marked dead; every request it held (queued, running, or swapped) is
-  resubmitted through the policy to the survivors with the SAME request
-  id — at-least-once semantics, and greedy outputs are deterministic so
-  the replay is invisible to the caller. Generated-so-far tokens are
-  recomputed from the original prompt (the dead replica's KV is gone).
+- **Health + circuit breakers + requeue (docs/SERVING.md "Overload &
+  degradation").** A replica whose ``step()`` raises a *fatal* fault is
+  marked dead after ``max_consecutive_fatal`` in a row (default 1 — the
+  pre-overload behavior); every request it held (queued, running, or
+  swapped) is resubmitted through the policy to the survivors with the
+  SAME request id — at-least-once semantics, and greedy outputs are
+  deterministic so the replay is invisible to the caller (the streamed
+  prefix is suppressed, so the client stream stays exactly-once).
+  *Transient* faults (``overload.classify_step_exception``) instead
+  tick a per-replica circuit breaker: past the error-rate threshold the
+  breaker OPENS (the replica's work requeues through the same replay
+  machinery, dispatch routes around it), backs off exponentially with
+  deterministic jitter, half-opens for a single probe request, and
+  closes after consecutive clean steps — a flaky replica loses traffic
+  for a backoff, not forever.
+- **Admission control / shedding / brownout.** With an
+  ``overload.OverloadConfig`` carrying an SLO or watermarks, ``submit``
+  rejects with a structured ``Overloaded(retry_after)`` terminal
+  outcome when the predicted TTFT breaks the SLO (or the queue-depth /
+  rate-limit watermark trips), each ``step()`` sheds queued
+  deadline-infeasible / lowest-priority requests past the shed
+  watermark (``router.shed`` maps rid -> reason), and the brownout
+  ladder reversibly degrades the engines under sustained pressure.
+  ``PTPU_OVERLOAD=0`` keeps every pre-overload code path bitwise.
 
 Request ids are globally unique across the fleet (each replica gets a
 disjoint ``rid_base`` space and the router passes explicit rids), so
@@ -35,6 +53,7 @@ from collections import deque
 
 from ... import telemetry as _telemetry
 from ...telemetry import trace as _trace
+from . import overload as _overload
 
 __all__ = ["FleetRouter", "ReplicaHandle", "POLICIES"]
 
@@ -119,7 +138,7 @@ class FleetRouter:
     prefix_match_pages/cancelled, e.g. fleet.DisaggregatedEngine)."""
 
     def __init__(self, engines, policy="least_loaded",
-                 max_queue_depth=None):
+                 max_queue_depth=None, overload=None):
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
         if callable(policy):
@@ -136,47 +155,76 @@ class FleetRouter:
         self.max_queue_depth = (max_queue_depth
                                 if max_queue_depth is not None
                                 else 2 * max(e.max_slots for e in engines))
-        self._pending = deque()      # (rid, prompt, kwargs) awaiting dispatch
-        self._inflight = {}          # rid -> (replica idx, prompt, kwargs)
+        # pending/inflight entries: (rid, prompt, kwargs, priority)
+        self._pending = deque()      # awaiting dispatch (backpressure)
+        self._inflight = {}          # rid -> (replica idx, prompt, kw, pri)
         self._next_rid = 0
         self._rr_cursor = 0
         self._delivered = {}         # rid -> tokens streamed to the client
         self.cancelled = {}          # rid -> reason (merged fleet view)
+        self.shed = {}               # rid -> reason (overload shedding)
         self.requeues = 0
+        self.served = 0              # completions returned by step()
+        # overload machinery (fleet.overload, docs/SERVING.md "Overload
+        # & degradation"): None (PTPU_OVERLOAD=0 or overload=False)
+        # keeps every pre-overload code path — any step() exception is
+        # permanent death, no admission control, no shedding/brownout
+        cfg = _overload.resolve_config(overload)
+        self._ov = (_overload.OverloadController(cfg, len(engines))
+                    if cfg is not None else None)
 
     # -- submit / cancel ----------------------------------------------------
-    def submit(self, prompt_ids, **kwargs) -> int:
+    def submit(self, prompt_ids, priority="interactive", **kwargs) -> int:
         """Mint a fleet-wide rid, open its ``route`` span, and dispatch
         (or hold under backpressure — dispatch retries every step). A
         ``deadline_seconds`` is stamped to an absolute point NOW, at
         router submit: time spent queued under backpressure counts
         against the deadline (the engine otherwise restarts the clock
-        at dispatch, silently extending it)."""
+        at dispatch, silently extending it).
+
+        With overload control active, admission runs FIRST: the request
+        may be rejected with a structured :class:`.overload.Overloaded`
+        (carrying ``retry_after``) instead of queueing — nothing is
+        minted for a rejected request. ``priority`` ("interactive" |
+        "batch") orders dispatch and shedding; without overload control
+        it is accepted and ignored (plain FIFO)."""
+        if self._ov is not None:
+            self._ov.admit(self, priority)     # may raise Overloaded
         rid = self._next_rid
         self._next_rid += 1
         prompt = [int(t) for t in prompt_ids]
         kwargs = dict(kwargs)
+        clock = (self._ov.clock if self._ov is not None
+                 else time.perf_counter)
         if kwargs.get("deadline_seconds") is not None:
-            kwargs["_deadline_at"] = (time.perf_counter()
+            kwargs["_deadline_at"] = (clock()
                                       + float(kwargs.pop("deadline_seconds")))
-        if kwargs.get("on_token") is not None:
-            # count delivered tokens so a dead-replica replay can skip
-            # the already-streamed prefix: the streaming contract stays
-            # exactly-once for greedy requests (the replayed prefix is
-            # bitwise the delivered one; sampled replays may diverge
-            # and are documented at-least-once)
+        if kwargs.get("on_token") is not None or self._ov is not None:
+            # count delivered tokens so a dead-replica (or breaker)
+            # replay can skip the already-streamed prefix: the streaming
+            # contract stays exactly-once for greedy requests (the
+            # replayed prefix is bitwise the delivered one; sampled
+            # replays may diverge and are documented at-least-once).
+            # Overload control always installs the wrapper — the first
+            # delivered token is the TTFT observation the admission
+            # predictor learns from.
             self._delivered[rid] = 0
-            kwargs["_on_token"] = kwargs.pop("on_token")
+            kwargs["_on_token"] = kwargs.pop("on_token", None)
+        if self._ov is not None:
+            self._ov.predictor.note_submit(rid)
         _trace.async_begin("route", rid, {"policy": self._policy_name})
-        self._pending.append((rid, prompt, kwargs))
+        self._pending.append((rid, prompt, kwargs, priority))
         self._dispatch_pending()
         return rid
 
     def cancel(self, rid, reason="user") -> bool:
-        for i, (prid, _p, _kw) in enumerate(self._pending):
-            if prid == rid:
+        for i, entry in enumerate(self._pending):
+            if entry[0] == rid:
                 del self._pending[i]
                 self.cancelled[rid] = reason
+                self._delivered.pop(rid, None)
+                if self._ov is not None:
+                    self._ov.predictor.forget(rid)
                 # no engine ever saw this rid: only the route span is
                 # open (no "request" span to close)
                 _trace.async_end("route", rid, {"cancelled": reason})
@@ -188,52 +236,118 @@ class FleetRouter:
         if handle.engine.cancel(rid, reason=reason):
             self._inflight.pop(rid, None)
             self.cancelled[rid] = reason
+            self._delivered.pop(rid, None)
+            if self._ov is not None:
+                self._ov.predictor.forget(rid)
             return True
         return False
 
     # -- dispatch -----------------------------------------------------------
+    def _replica_inflight(self, idx):
+        return sum(1 for entry in self._inflight.values()
+                   if entry[0] == idx)
+
     def _candidates(self):
-        return [h.idx for h in self.replicas
-                if h.healthy
-                and h.engine.load()["queue_depth"] < self.max_queue_depth]
+        # per-replica inflight counts matter only to half-open probe
+        # gating; one O(inflight) pass, and only when a breaker is
+        # actually out of the closed state
+        counts = None
+        if self._ov is not None and any(
+                br.state != "closed" for br in self._ov.breakers):
+            counts = {}
+            for entry in self._inflight.values():
+                counts[entry[0]] = counts.get(entry[0], 0) + 1
+        cands = []
+        for h in self.replicas:
+            if not h.healthy:
+                continue
+            if h.engine.load()["queue_depth"] >= self.max_queue_depth:
+                continue
+            if self._ov is not None:
+                # route around open breakers; a half-open replica takes
+                # exactly one probe request at a time
+                br = self._ov.breakers[h.idx]
+                if not br.routable(0 if counts is None
+                                   else counts.get(h.idx, 0)):
+                    continue
+            cands.append(h.idx)
+        return cands
+
+    def _next_pending(self):
+        """Index of the next entry to dispatch: plain FIFO without
+        overload control; priority-aware FIFO (interactive before
+        batch, arrival order within a class) with it."""
+        if self._ov is None or len(self._pending) <= 1:
+            return 0
+        for i, entry in enumerate(self._pending):
+            if (entry[3] if len(entry) > 3 else "interactive") \
+                    == "interactive":
+                return i
+        return 0
 
     def _dispatch_pending(self):
         while self._pending:
             cands = self._candidates()
             if not cands:
                 return               # backpressure: hold in the router
-            rid, prompt, kwargs = self._pending[0]
+            pick = self._next_pending()
+            rid, prompt, kwargs, priority = self._pending[pick]
             idx = self._policy(self, prompt, cands)
             handle = self.replicas[idx]
-            self._pending.popleft()
+            del self._pending[pick]
             kw = dict(kwargs)
             at = kw.pop("_deadline_at", None)
             if at is not None:
                 # remaining budget at dispatch; <= 0 cancels on the
                 # replica's first tick (the request is already late)
-                kw["deadline_seconds"] = at - time.perf_counter()
+                now = (self._ov.clock() if self._ov is not None
+                       else time.perf_counter())
+                kw["deadline_seconds"] = at - now
             cb = kw.pop("_on_token", None)
-            if cb is not None:
+            if cb is not None or rid in self._delivered:
                 # suppress the first `skip` tokens of THIS dispatch's
-                # stream: a dead-replica replay regenerates from
-                # scratch, and the client already received that prefix
+                # stream: a dead-replica (or breaker-open) replay
+                # regenerates from scratch, and the client already
+                # received that prefix. The wrapper also feeds the
+                # admission predictor its TTFT observations.
                 skip = self._delivered.get(rid, 0)
                 state = {"seen": 0}
 
                 def on_token(r, t, _cb=cb, _skip=skip, _state=state):
                     _state["seen"] += 1
                     if _state["seen"] > _skip:
-                        self._delivered[r] = self._delivered.get(r, 0) + 1
-                        _cb(r, t)
+                        n = self._delivered.get(r, 0) + 1
+                        self._delivered[r] = n
+                        if n == 1 and self._ov is not None:
+                            self._ov.predictor.note_first_token(r)
+                        if _cb is not None:
+                            _cb(r, t)
 
                 kw["on_token"] = on_token
             handle.engine.submit(prompt, rid=rid, **kw)
             handle.dispatched += 1
-            self._inflight[rid] = (idx, prompt, kwargs)
+            self._inflight[rid] = (idx, prompt, kwargs, priority)
             _DISPATCH.inc(labels=(self._policy_name, str(idx)))
             _trace.async_end("route", rid, {"replica": idx})
 
     # -- fleet tick ---------------------------------------------------------
+    def _requeue_all(self, handle, instant, attrs):
+        """Pull every inflight request off ``handle`` and hold it in the
+        router for re-dispatch with the SAME rid — the exactly-once
+        replay machinery (the streamed prefix is suppressed at the next
+        dispatch). Shared by permanent death and breaker-open."""
+        lost = [rid for rid, entry in self._inflight.items()
+                if entry[0] == handle.idx]
+        for rid in lost:
+            _idx, prompt, kwargs, priority = self._inflight.pop(rid)
+            self.requeues += 1
+            _REQUEUES.inc()
+            _trace.async_instant(instant, rid, attrs)
+            _trace.async_begin("route", rid,
+                               {"policy": self._policy_name,
+                                "requeue": True})
+            self._pending.append((rid, prompt, kwargs, priority))
+
     def _on_death(self, handle, exc):
         """Mark a replica dead and requeue everything it held. The
         engine's internal state is untrusted after an arbitrary failure;
@@ -241,38 +355,136 @@ class FleetRouter:
         handle.healthy = False
         handle.death_reason = repr(exc)
         _DEATHS.inc()
-        lost = [rid for rid, (idx, _p, _kw) in self._inflight.items()
-                if idx == handle.idx]
-        for rid in lost:
-            _idx, prompt, kwargs = self._inflight.pop(rid)
-            self.requeues += 1
-            _REQUEUES.inc()
-            _trace.async_instant("requeue", rid,
-                                 {"dead_replica": handle.idx})
-            _trace.async_begin("route", rid,
-                               {"policy": self._policy_name,
-                                "requeue": True})
-            self._pending.append((rid, prompt, kwargs))
+        self._requeue_all(handle, "requeue", {"dead_replica": handle.idx})
         if not any(h.healthy for h in self.replicas):
             raise RuntimeError(
                 "FleetRouter: every replica is dead "
                 f"(last failure: {handle.death_reason})") from exc
 
+    def _on_breaker_open(self, handle):
+        """The breaker opened: tear the replica's requests out of the
+        (still-alive) engine — a later half-open tick must never
+        double-serve a rid the survivors already replayed — and requeue
+        them through the exactly-once replay machinery. A request the
+        engine had ALREADY terminally cancelled inside the failing tick
+        (e.g. its deadline expired before the fault) keeps that outcome
+        instead of replaying: honoring it here also clears the
+        engine-side record, so a later half-open drain can never
+        double-terminate a rid the survivors are serving."""
+        eng_cancelled = getattr(handle.engine, "cancelled", None)
+        if eng_cancelled is None:     # NOT `or {}`: an EMPTY dict is
+            eng_cancelled = {}        # falsy, and pops must reach the
+                                      # engine's real dict
+        wedged = None
+        for rid, entry in list(self._inflight.items()):
+            if entry[0] != handle.idx:
+                continue
+            try:
+                cancelled_now = handle.engine.cancel(
+                    rid, reason="breaker_requeue")
+            except Exception as exc:  # noqa: BLE001
+                # cancel() itself failing means the engine's HOST state
+                # is untrusted: the rid still requeues, but the replica
+                # must die (below) — a half-open probe on an engine
+                # still holding this rid could double-serve it
+                wedged = exc
+                cancelled_now = False
+            prior = eng_cancelled.pop(rid, None)
+            _idx, prompt, kwargs, priority = self._inflight.pop(rid)
+            if not cancelled_now and prior is not None:
+                # the engine already reached a terminal cancel for this
+                # rid in the failing tick — that outcome stands
+                self.cancelled[rid] = prior
+                self._delivered.pop(rid, None)
+                self._ov.predictor.forget(rid)
+                _trace.async_end("route", rid, {"cancelled": prior})
+                continue
+            self.requeues += 1
+            _REQUEUES.inc()
+            _trace.async_instant("breaker_requeue", rid,
+                                 {"replica": handle.idx})
+            _trace.async_begin("route", rid,
+                               {"policy": self._policy_name,
+                                "requeue": True})
+            self._pending.append((rid, prompt, kwargs, priority))
+        if wedged is not None:
+            # every request is already safely requeued; the engine that
+            # cannot even cancel is out of the fleet for good
+            handle.healthy = False
+            handle.death_reason = repr(wedged)
+            _DEATHS.inc()
+            if not any(h.healthy for h in self.replicas):
+                raise RuntimeError(
+                    "FleetRouter: every replica is dead "
+                    f"(last failure: {handle.death_reason})") from wedged
+
+    def _on_step_error(self, handle, exc):
+        """Classify a step() fault through the replica's breaker:
+        transient faults tolerate/open (requeue + backoff), fatal faults
+        keep the permanent-death path after ``max_consecutive_fatal``
+        in a row."""
+        kind = _overload.classify_step_exception(exc)
+        action = self._ov.breakers[handle.idx].record_failure(kind)
+        if action == "die":
+            self._on_death(handle, exc)
+        elif action == "open":
+            self._on_breaker_open(handle)
+        # "tolerate": the requests stay on the replica; next tick retries
+
+    def _overload_tick(self):
+        """Once per fleet tick: advance breakers, shed past the
+        watermarks, and update the brownout ladder."""
+        ov = self._ov
+        for br in ov.breakers:
+            br.poll()
+        for entry, reason in ov.shed_targets(self):
+            rid = entry[0]
+            try:
+                self._pending.remove(entry)
+            except ValueError:
+                continue             # already gone (raced a cancel)
+            self.shed[rid] = reason
+            _overload.note_shed(reason)
+            ov.predictor.forget(rid)
+            self._delivered.pop(rid, None)
+            _trace.async_end("route", rid, {"shed": reason})
+        engines = [h.engine for h in self.replicas if h.healthy]
+        ov.brownout.update(ov.pressure(self), engines)
+
     def step(self):
         """Dispatch pending work, tick every healthy replica, collect
-        completions/cancellations, recover from replica deaths.
-        Returns {rid: full token ids} finishing this fleet tick."""
+        completions/cancellations, recover from replica faults (breaker
+        or death). Returns {rid: full token ids} finishing this tick."""
+        if self._ov is not None:
+            self._overload_tick()
         self._dispatch_pending()
         done = {}
         for handle in self.replicas:
             if not handle.healthy:
                 continue
+            had_work = False
+            if self._ov is not None:
+                # open breaker: in backoff — the replica neither ticks
+                # nor receives traffic until its half-open probe window
+                br = self._ov.breakers[handle.idx]
+                if br.poll() == "open":
+                    continue
+                if br.state == "half_open":
+                    # a close needs REAL probe ticks (requests inflight),
+                    # not idle no-op steps
+                    had_work = self._replica_inflight(handle.idx) > 0
             t0 = time.perf_counter()
             try:
                 out = handle.engine.step()
-            except Exception as exc:  # noqa: BLE001 — any failure = death
-                self._on_death(handle, exc)
+            except Exception as exc:  # noqa: BLE001
+                if self._ov is None:   # pre-overload: any failure = death
+                    self._on_death(handle, exc)
+                else:
+                    self._on_step_error(handle, exc)
                 continue
+            if self._ov is not None:
+                self._ov.breakers[handle.idx].record_success(
+                    probe_work=had_work)
             handle.busy_seconds += time.perf_counter() - t0
             handle.steps += 1
             for rid, ids in out.items():
@@ -286,6 +498,9 @@ class FleetRouter:
                     self._inflight.pop(rid, None)
                     self._delivered.pop(rid, None)
                     self.cancelled[rid] = reason
+                    if self._ov is not None:
+                        self._ov.predictor.forget(rid)
+        self.served += len(done)
         self._dispatch_pending()     # freed slots admit the next wave
         if _telemetry.get_registry().enabled:
             _PENDING.set(len(self._pending))
@@ -313,9 +528,32 @@ class FleetRouter:
         per = [dict(h.engine.load(), replica=h.idx, healthy=h.healthy,
                     dispatched=h.dispatched)
                for h in self.replicas]
-        return {"pending": len(self._pending),
-                "inflight": len(self._inflight),
-                "replicas": per}
+        out = {"pending": len(self._pending),
+               "inflight": len(self._inflight),
+               "replicas": per}
+        if self._ov is not None:
+            out["overload"] = self._ov.summary()
+        return out
+
+    @property
+    def overload(self):
+        """The live OverloadController (None when PTPU_OVERLOAD=0 /
+        overload=False keeps the pre-overload router)."""
+        return self._ov
+
+    def outcomes(self):
+        """Terminal-outcome accounting over this router's lifetime:
+        every submitted-and-admitted request ends in exactly one of
+        served / cancelled / shed (rejected requests never minted a
+        rid; the admission controller counts them separately)."""
+        out = {"served": self.served,
+               "cancelled": len(self.cancelled),
+               "shed": len(self.shed),
+               "pending": len(self._pending),
+               "inflight": len(self._inflight)}
+        if self._ov is not None:
+            out["rejected"] = sum(self._ov.rejects.values())
+        return out
 
 
 def make_replicas(model_factory, n, rid_stride=RID_STRIDE, **engine_kw):

@@ -24,8 +24,10 @@ from collections import deque
 
 import numpy as np
 
+from .overload import Overloaded
+
 __all__ = ["build_workload", "run_soak", "percentile", "fleet_soak",
-           "soak_block"]
+           "soak_block", "overload_block", "overload_workload"]
 
 
 def percentile(sorted_vals, q):
@@ -38,12 +40,14 @@ def percentile(sorted_vals, q):
 
 def build_workload(n_requests, arrival_rate, prompt_lens, vocab_size,
                    shared_prefix=0, sampled_fraction=0.0,
-                   deadline_seconds=None, seed=0):
+                   deadline_seconds=None, batch_fraction=0.0, seed=0):
     """Synthetic request list [(arrival_time, prompt, kwargs)] sorted by
     arrival: Poisson arrivals at ``arrival_rate`` req/sec (simulated
     seconds), prompt lengths drawn from ``prompt_lens``, an optional
     shared system prefix (the prefix-affinity workload), an optional
-    sampled-request fraction, and optional per-request deadlines."""
+    sampled-request fraction, optional per-request deadlines, and an
+    optional ``batch``-priority fraction (the overload scenario's mixed
+    traffic — batch requests hit every watermark first)."""
     rng = np.random.default_rng(seed)
     prefix = [int(t) for t in rng.integers(1, vocab_size, shared_prefix)]
     t = 0.0
@@ -59,8 +63,22 @@ def build_workload(n_requests, arrival_rate, prompt_lens, vocab_size,
             kw.update(temperature=0.7, top_k=8, top_p=0.95)
         if deadline_seconds is not None:
             kw["deadline_seconds"] = deadline_seconds
+        if batch_fraction and rng.random() < batch_fraction:
+            kw["priority"] = "batch"
         out.append((t, prompt, kw))
     return out
+
+
+def overload_workload(capacity_req_per_sec, n_requests, prompt_lens,
+                      vocab_size, *, rate_x_capacity=2.0,
+                      batch_fraction=0.4, seed=0, **kw):
+    """The overload scenario's arrival schedule: sustained Poisson
+    arrivals at ``rate_x_capacity`` times the fleet's measured service
+    capacity (req/sim-second), with a mixed interactive/batch split —
+    the traffic shape admission control and shedding exist for."""
+    return build_workload(
+        n_requests, rate_x_capacity * capacity_req_per_sec, prompt_lens,
+        vocab_size, batch_fraction=batch_fraction, seed=seed, **kw)
 
 
 def _spec_stats(eng):
@@ -93,15 +111,32 @@ def _engine_stats(eng):
             "spec": _spec_stats(eng)}
 
 
-def run_soak(target, workload, warmup=True, max_ticks=200000):
+def run_soak(target, workload, warmup=True, max_ticks=200000,
+             rebase_overload_clock=True):
     """Drive ``workload`` through ``target`` (engine / disagg /
     FleetRouter) and return the raw soak stats dict. Cold start
     (construction is the caller's; compile is ours via ``warmup()``) is
     measured per engine and reported as the max across replicas — in
-    deployment replicas spin up concurrently."""
+    deployment replicas spin up concurrently.
+
+    Every submitted request reaches exactly one terminal outcome:
+    served (``completed``), ``cancelled``, ``shed`` (overload load
+    shedding), or ``rejected`` (a structured ``Overloaded`` raised at
+    admission — nothing was queued). ``outcomes_conserved`` asserts the
+    conservation; a ``False`` there means a request was lost or hung.
+
+    When the target is a FleetRouter with overload control, its
+    controller is rebased onto THIS soak's simulated-parallel clock
+    (``rebase_overload_clock=False`` keeps wall time): admission
+    prediction, breaker backoff, and brownout hysteresis then measure
+    fleet time, and the run is reproducible."""
     router = hasattr(target, "replicas")
     engines = ([h.engine for h in target.replicas] if router
                else [target])
+    sim = [0.0]
+    ov = getattr(target, "overload", None) if router else None
+    if ov is not None and rebase_overload_clock:
+        ov.set_clock(lambda: sim[0])
     cold = []
     if warmup:
         for e in engines:
@@ -112,23 +147,38 @@ def run_soak(target, workload, warmup=True, max_ticks=200000):
     plen = {}
     first_seen = {}
     ttfts = []
-    sim_t = 0.0
     done = {}
+    rejected = {}                 # reason -> count (Overloaded raises)
+    retry_afters = []
     wall0 = time.perf_counter()
 
     def on_token(rid, tok):
         first_seen.setdefault(rid, None)
 
+    def n_terminal():
+        return (len(done)
+                + len(getattr(target, "cancelled", {}) or {})
+                + len(getattr(target, "shed", {}) or {}))
+
     for _tick in range(max_ticks):
         # admit every arrival the simulated clock has reached; when the
         # fleet is fully idle, jump the clock to the next arrival
         # instead of spinning empty ticks
-        n_cancelled = len(getattr(target, "cancelled", {}) or {})
-        if pending and len(done) + n_cancelled >= len(arrival):
-            sim_t = max(sim_t, pending[0][0])
-        while pending and pending[0][0] <= sim_t:
+        if pending and n_terminal() >= len(arrival):
+            sim[0] = max(sim[0], pending[0][0])
+        while pending and pending[0][0] <= sim[0]:
             arr, prompt, kw = pending.popleft()
-            rid = target.submit(prompt, on_token=on_token, **kw)
+            if not router:
+                # priority classes are a router concept; a bare engine's
+                # submit() surface doesn't take one
+                kw = {k: v for k, v in kw.items() if k != "priority"}
+            try:
+                rid = target.submit(prompt, on_token=on_token, **kw)
+            except Overloaded as o:
+                # structured terminal outcome: rejected at admission
+                rejected[o.reason] = rejected.get(o.reason, 0) + 1
+                retry_afters.append(o.retry_after)
+                continue
             arrival[rid] = arr
             plen[rid] = len(prompt)
         before_first = set(first_seen)
@@ -147,28 +197,55 @@ def run_soak(target, workload, warmup=True, max_ticks=200000):
             t0 = time.perf_counter()
             out = target.step()
             cost = time.perf_counter() - t0
-        sim_t += cost
+        sim[0] += cost
         for rid in set(first_seen) - before_first:
             if rid in arrival:
-                ttfts.append(sim_t - arrival[rid])
+                ttfts.append(sim[0] - arrival[rid])
         done.update(out)
-        cancelled = dict(getattr(target, "cancelled", {}) or {})
-        if not pending and len(done) + len(cancelled) >= n_requests:
+        if not pending and n_terminal() >= len(arrival):
             break
     else:
         raise TimeoutError("soak did not drain")
+    if ov is not None and ov.brownout.level > 0:
+        # post-drain cool-down: the pressure is gone — give the brownout
+        # ladder its hysteresis ticks to step fully back up, so
+        # "restored on recovery" is an observable property of the run
+        # (bounded: each level needs brownout_down_ticks calm ticks)
+        limit = ((ov.cfg.brownout_down_ticks + 1)
+                 * (ov.cfg.brownout_levels + 1) * 4)
+        for _ in range(limit):
+            if ov.brownout.level == 0:
+                break
+            t0 = time.perf_counter()
+            target.step()
+            sim[0] += time.perf_counter() - t0
+    sim_t = sim[0]
     wall_seconds = time.perf_counter() - wall0
     cancelled = dict(getattr(target, "cancelled", {}) or {})
+    shed = dict(getattr(target, "shed", {}) or {})
+    n_rejected = sum(rejected.values())
     # goodput counts GENERATED tokens only (completions return
     # prompt+generated; the prompt was the caller's)
     gen_tokens = sum(max(0, len(ids) - plen.get(rid, 0))
                      for rid, ids in done.items())
     ttfts.sort()
     per_engine = [_engine_stats(e) for e in engines]
+    shed_reasons = {}
+    for reason in shed.values():
+        shed_reasons[reason] = shed_reasons.get(reason, 0) + 1
     stats = {
         "requests": n_requests,
         "completed": len(done),
         "cancelled": len(cancelled),
+        "shed": len(shed),
+        "rejected": n_rejected,
+        "shed_reasons": shed_reasons,
+        "reject_reasons": dict(rejected),
+        "retry_after_mean": (round(sum(retry_afters)
+                                   / len(retry_afters), 6)
+                             if retry_afters else None),
+        "outcomes_conserved": (len(done) + len(cancelled) + len(shed)
+                               + n_rejected == n_requests),
         "replicas": len(engines),
         "generated_tokens": gen_tokens,
         "sim_seconds": round(sim_t, 6),
@@ -195,16 +272,23 @@ def run_soak(target, workload, warmup=True, max_ticks=200000):
             "deaths": sum(1 for h in target.replicas if not h.healthy),
             "requeues": target.requeues,
         }
+        if ov is not None:
+            stats["overload"] = ov.summary()
     return stats, done
 
 
 def fleet_soak(model, n_replicas, workload, *, policy="least_loaded",
                disagg=False, draft_model=None, engine_kw=None,
-               disagg_kw=None, max_ticks=200000):
+               disagg_kw=None, max_ticks=200000, overload=None,
+               chaos_wrap=None):
     """Build ``n_replicas`` engines (or disaggregated pairs) over
     ``model``, route them (FleetRouter when n>1), drive ``workload``,
     return the soak stats. One entry point for tools/serve_bench.py and
-    ``bench.py --serve``."""
+    ``bench.py --serve``. ``overload`` passes an
+    :class:`.overload.OverloadConfig` to the router; ``chaos_wrap`` is
+    an optional ``{replica_idx: fn}`` map wrapping chosen engines in a
+    fault injector (``paddle_tpu.testing.chaos.ChaosReplica``) before
+    routing — the overload scenario's flapping replica."""
     from ..serving import ContinuousBatchingEngine
     from .disagg import DisaggregatedEngine
     from .router import RID_STRIDE, FleetRouter
@@ -220,9 +304,76 @@ def fleet_soak(model, n_replicas, workload, *, policy="least_loaded",
             engines.append(ContinuousBatchingEngine(
                 model, rid_base=i * RID_STRIDE, draft_model=draft_model,
                 **engine_kw))
-    target = (engines[0] if n_replicas == 1
-              else FleetRouter(engines, policy=policy))
+    for idx, fn in (chaos_wrap or {}).items():
+        engines[idx] = fn(engines[idx])
+    target = (engines[0] if n_replicas == 1 and overload is None
+              and not chaos_wrap
+              else FleetRouter(engines, policy=policy, overload=overload))
     return run_soak(target, workload, max_ticks=max_ticks)
+
+
+def overload_block(model, *, replicas, workload, overload_cfg,
+                   policy="least_loaded", engine_kw=None,
+                   chaos_wrap=None, ttft_budget=None,
+                   shed_ceiling=0.5, flap_bound=8,
+                   rate_x_capacity=None, max_ticks=400000):
+    """The gateable ``"overload"`` JSON block (docs/SERVING.md
+    "Overload & degradation"; ``tools/bench_gate.py`` OVERLOAD gate):
+    drive an overload-scenario workload (typically 2x measured capacity,
+    mixed priorities, optionally one chaos-flapping replica) through a
+    FleetRouter with the given :class:`.overload.OverloadConfig` and
+    reduce the run to its embedded-budget gate fields —
+
+    - ``conserved``: every submitted request reached exactly one
+      terminal outcome (served | cancelled | shed | rejected); zero
+      lost/hung requests is the hard floor;
+    - ``p99_ttft_seconds`` of ADMITTED requests vs ``p99_ttft_budget``;
+    - ``shed_fraction`` ((shed + rejected) / submitted) vs
+      ``shed_ceiling`` — refusing a bounded slice of 2x traffic is the
+      design, refusing most of it is a regression;
+    - ``breaker_opens`` vs ``breaker_flap_bound`` — a flapping replica
+      must cost a bounded number of breaker flaps, not one per fault;
+    - ``brownout.restored`` — the ladder must step fully back up after
+      the pressure clears (the run cools down post-drain until it does).
+    """
+    stats, _done = fleet_soak(
+        model, replicas, workload, policy=policy, engine_kw=engine_kw,
+        overload=overload_cfg, chaos_wrap=chaos_wrap,
+        max_ticks=max_ticks)
+    ov = stats.get("overload") or {}
+    brown = dict(ov.get("brownout") or {})
+    submitted = stats["requests"]
+    refused = stats["shed"] + stats["rejected"]
+    block = {
+        "enabled": True,
+        "replicas": replicas,
+        "policy": policy,
+        "submitted": submitted,
+        "served": stats["completed"],
+        "cancelled": stats["cancelled"],
+        "shed": stats["shed"],
+        "rejected": stats["rejected"],
+        "shed_reasons": stats["shed_reasons"],
+        "reject_reasons": stats["reject_reasons"],
+        "conserved": bool(stats["outcomes_conserved"]),
+        "goodput_tokens_per_sec": stats["goodput_tokens_per_sec"],
+        "sim_seconds": stats["sim_seconds"],
+        "ttft": stats["ttft"],
+        "p99_ttft_seconds": stats["ttft"]["p99"],
+        "shed_fraction": (round(refused / submitted, 4)
+                          if submitted else 0.0),
+        "shed_ceiling": float(shed_ceiling),
+        "breaker_opens": int(ov.get("breaker_opens") or 0),
+        "breaker_flap_bound": int(flap_bound),
+        "breakers": ov.get("breakers"),
+        "brownout": brown,
+        "retry_after_mean": stats["retry_after_mean"],
+    }
+    if ttft_budget is not None:
+        block["p99_ttft_budget"] = float(ttft_budget)
+    if rate_x_capacity is not None:
+        block["rate_x_capacity"] = float(rate_x_capacity)
+    return block
 
 
 def soak_block(model, *, replicas, workload, policy="least_loaded",

@@ -595,6 +595,15 @@ class ContinuousBatchingEngine:
         self._lookahead = (self.spec_tokens + 1 if self._draft is not None
                            else 1)
         self.build_seconds = None     # set by warmup() (cold-start gate)
+        # -- brownout degradation knobs (fleet.overload, docs/SERVING.md
+        # "Overload & degradation") — reversible service caps the fleet
+        # brownout ladder sets under sustained pressure and restores on
+        # recovery. All-default = full service, behavior unchanged.
+        self.max_new_cap = None       # L1: cap on tokens to generate
+        self.spec_paused = False      # L2: skip speculative ticks
+                                      #     (greedy-output-invariant)
+        self.prefill_chunk_cap = None  # L3: per-tick prefill token
+                                       #     budget (output-invariant)
 
     @staticmethod
     def _pack_weights(model):
@@ -1252,6 +1261,11 @@ class ContinuousBatchingEngine:
         if not reqs:
             return
         B, c = self.max_slots, self.prefill_chunk
+        # brownout L3: a live chunk cap shrinks the per-tick prefill
+        # token budget WITHOUT recompiling — the jitted pass keeps its
+        # [B, c] shapes and simply sees fewer valid tokens per row
+        c_eff = (c if self.prefill_chunk_cap is None
+                 else max(1, min(c, self.prefill_chunk_cap)))
         ids_np = np.zeros((B, c), np.int32)
         pos0 = np.zeros(B, np.int32)
         nvalid = np.zeros(B, np.int32)
@@ -1260,7 +1274,7 @@ class ContinuousBatchingEngine:
         hist = np.zeros((B, self.pages_per_seq), np.int32)
         for i, r in enumerate(reqs):
             pos = r.prefill_pos
-            n = min(c, len(r.seq_tokens) - pos)
+            n = min(c_eff, len(r.seq_tokens) - pos)
             ids_np[i, :n] = r.seq_tokens[pos:pos + n]
             pos0[i], nvalid[i] = pos, n
             pages = np.asarray(r.pages, np.int64)
@@ -1537,8 +1551,13 @@ class ContinuousBatchingEngine:
         """True when a request has nothing left to generate: max_new
         reached, or its newest token is eos. THE completion predicate —
         retire, the decode-tick live filter, and the disagg handoff
-        sweep all share it."""
-        return (len(r.generated) >= self.max_new_tokens
+        sweep all share it. A live brownout L1 cap (``max_new_cap``)
+        lowers the limit for every request still generating; restoring
+        the cap to None restores the full budget."""
+        limit = self.max_new_tokens
+        if self.max_new_cap is not None:
+            limit = min(limit, self.max_new_cap)
+        return (len(r.generated) >= limit
                 or (self.eos is not None and bool(r.generated)
                     and r.generated[-1] == self.eos))
 
@@ -1594,7 +1613,8 @@ class ContinuousBatchingEngine:
         # static greedy/sampling mode: one retrace per mode, and the
         # default all-greedy workload never pays the vocab sort
         do_sample = any(r.temperature > 0.0 for _, r in live)
-        if self._draft is not None and not do_sample:
+        if (self._draft is not None and not do_sample
+                and not self.spec_paused):
             # speculative tick: draft K, verify in one target forward
             self._spec_tick(live)
             return newly

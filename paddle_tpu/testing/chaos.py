@@ -21,6 +21,11 @@ Four fault families, matching how real training jobs die
   chosen step invocation compute NaN/Inf grads (or a poisoned loss)
   INSIDE the compiled train step — the one-bad-batch /
   flaky-interconnect fault `resilience.StepGuard` exists to survive.
+- **Fleet faults**: `ChaosReplica` wraps one serving replica's fleet
+  surface with deterministic tick-counted fault injection — step
+  latency (straggler), intermittent transient exceptions, a flapping
+  replica — the seam the FleetRouter circuit breakers are proven
+  against (docs/SERVING.md "Overload & degradation").
 
 Every injector routes through a seam its subsystem exposes
 (`distributed.checkpoint._WRITE_FAULT_HOOK` for writes,
@@ -154,6 +159,90 @@ def die_during_write(match=None, exit_code=57):
 
     with _install_hook(hook):
         yield
+
+
+# ---------------------------------------------------------------------------
+# fleet fault seams (docs/SERVING.md "Overload & degradation")
+# ---------------------------------------------------------------------------
+class ChaosReplica:
+    """Wrap one engine's fleet surface with deterministic, tick-counted
+    fault injection — the seam ``FleetRouter``'s circuit breakers are
+    proven against (breaker open/half-open/close transitions,
+    exactly-once streaming across shed/retry/replay). Everything except
+    ``step()`` delegates to the wrapped engine; injected faults fire
+    BEFORE the wrapped step executes, so a faulted tick is effect-free
+    (the shape of a transient runtime error: the work did not happen).
+
+    Fault families (composable, all keyed on the 1-based step ordinal so
+    runs are reproducible with no wall-clock dependence):
+
+    - ``latency``: seconds of injected ``step()`` latency — a straggler
+      replica (slows the fleet tick; never fails).
+    - ``fail_ticks``: explicit step ordinals that raise.
+    - ``transient_every=k``: every k-th step raises — an intermittently
+      flaky replica (drives breaker open -> half-open -> close).
+    - ``flap=(up, down)``: ``up`` healthy steps then ``down`` failing
+      steps, cycling forever — the flapping replica the overload soak
+      scenario runs (breaker flap count must stay bounded).
+    - ``exc_factory``: exception builder taking the step ordinal
+      (default :class:`~paddle_tpu.inference.fleet.overload.
+      TransientReplicaError`; pass e.g. ``RuntimeError`` to inject
+      FATAL-classified faults and exercise ``max_consecutive_fatal``).
+    """
+
+    _OWN = frozenset({"_engine", "latency", "fail_ticks",
+                      "transient_every", "flap", "_exc", "steps",
+                      "faults"})
+
+    def __init__(self, engine, *, latency=0.0, fail_ticks=(),
+                 transient_every=None, flap=None, exc_factory=None):
+        object.__setattr__(self, "_engine", engine)
+        self.latency = float(latency)
+        self.fail_ticks = frozenset(int(t) for t in fail_ticks)
+        self.transient_every = transient_every
+        self.flap = tuple(flap) if flap else None
+        self._exc = exc_factory
+        self.steps = 0
+        self.faults = 0
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_engine"), name)
+
+    def __setattr__(self, name, value):
+        # brownout/controller writes (max_new_cap, spec_paused, ...)
+        # must land on the ENGINE — only this wrapper's own fields stay
+        # local, so the seam is invisible to every fleet consumer
+        if name in self._OWN:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._engine, name, value)
+
+    def _should_fail(self):
+        t = self.steps
+        if t in self.fail_ticks:
+            return True
+        if self.transient_every and t % int(self.transient_every) == 0:
+            return True
+        if self.flap:
+            up, down = self.flap
+            return (t - 1) % (up + down) >= up
+        return False
+
+    def step(self):
+        self.steps += 1
+        if self.latency:
+            time.sleep(self.latency)
+        if self._should_fail():
+            self.faults += 1
+            if self._exc is not None:
+                raise self._exc(
+                    f"chaos: injected fault at replica step {self.steps}")
+            from ..inference.fleet.overload import TransientReplicaError
+
+            raise TransientReplicaError(
+                f"chaos: injected transient fault at replica step "
+                f"{self.steps}")
+        return self._engine.step()
 
 
 # ---------------------------------------------------------------------------

@@ -384,3 +384,87 @@ def test_cold_start_gate_vs_reference(tmp_path, capsys):
         "enabled": True, "requests": 1, "completed": 1, "cancelled": 0,
         "cold_start_seconds": 9.0, "scan_layers": False})
     assert bg.main([other_mode, "--against", old]) == 0
+
+
+# --------------------------------------------------------- overload gate
+def _overload_block(**overrides):
+    """A gate-clean overload block (docs/SERVING.md 'Overload &
+    degradation'); overrides poke individual violations."""
+    block = {
+        "enabled": True, "replicas": 2, "submitted": 100, "served": 70,
+        "cancelled": 5, "shed": 15, "rejected": 10, "conserved": True,
+        "ttft": {"p99": 0.4}, "p99_ttft_seconds": 0.4,
+        "p99_ttft_budget": 1.0, "shed_fraction": 0.25,
+        "shed_ceiling": 0.5, "breaker_opens": 3,
+        "breaker_flap_bound": 8,
+        "brownout": {"level": 0, "max_level": 2, "restored": True},
+    }
+    block.update(overrides)
+    return block
+
+
+def _round_with_overload(tmp_path, name, block):
+    rec = {"metric": "serve_overload_goodput_r2", "value": 100.0,
+           "unit": "tokens/sec", "overload": block}
+    p = tmp_path / name
+    p.write_text(json.dumps({"tail": json.dumps(rec)}))
+    return str(p)
+
+
+def test_overload_gate_passes_clean_and_skips_disabled(tmp_path):
+    ok = _round_with_overload(tmp_path, "ok.json", _overload_block())
+    assert bg.main([ok, "--against", ok]) == 0
+    off = _round_with_overload(tmp_path, "off.json", {"enabled": False})
+    assert bg.main([off, "--against", off]) == 0
+
+
+def test_overload_gate_fails_lost_requests(tmp_path, capsys):
+    """Zero lost/hung requests at 2x capacity is the hard floor: a
+    broken outcome conservation fails reference-free."""
+    bad = _round_with_overload(tmp_path, "bad.json", _overload_block(
+        served=65, conserved=False))
+    assert bg.main([bad, "--against", bad]) == 1
+    out = capsys.readouterr().out
+    assert "OVERLOAD" in out and "conservation" in out
+
+
+def test_overload_gate_fails_admitted_p99_over_budget(tmp_path, capsys):
+    bad = _round_with_overload(tmp_path, "bad.json", _overload_block(
+        p99_ttft_seconds=1.7))
+    assert bg.main([bad, "--against", bad]) == 1
+    assert "p99 TTFT" in capsys.readouterr().out
+
+
+def test_overload_gate_fails_shed_over_ceiling(tmp_path, capsys):
+    bad = _round_with_overload(tmp_path, "bad.json", _overload_block(
+        shed_fraction=0.8))
+    assert bg.main([bad, "--against", bad]) == 1
+    assert "ceiling" in capsys.readouterr().out
+
+
+def test_overload_gate_fails_breaker_flaps_over_bound(tmp_path, capsys):
+    bad = _round_with_overload(tmp_path, "bad.json", _overload_block(
+        breaker_opens=20))
+    assert bg.main([bad, "--against", bad]) == 1
+    assert "flap" in capsys.readouterr().out
+
+
+def test_overload_gate_fails_unrestored_brownout(tmp_path, capsys):
+    bad = _round_with_overload(tmp_path, "bad.json", _overload_block(
+        brownout={"level": 2, "max_level": 3, "restored": False}))
+    assert bg.main([bad, "--against", bad]) == 1
+    assert "brownout" in capsys.readouterr().out
+
+
+def test_serving_gate_counts_shed_and_rejected_as_outcomes(tmp_path):
+    """A soak that shed/rejected under overload control did NOT lose
+    those requests — the SERVE lost-request arithmetic must count every
+    terminal outcome (docs/SERVING.md)."""
+    ok = _round_with_serving(tmp_path, "ok.json", {
+        "enabled": True, "requests": 10, "completed": 6, "cancelled": 1,
+        "shed": 2, "rejected": 1})
+    assert bg.main([ok, "--against", ok]) == 0
+    lost = _round_with_serving(tmp_path, "lost.json", {
+        "enabled": True, "requests": 10, "completed": 6, "cancelled": 1,
+        "shed": 2, "rejected": 0})
+    assert bg.main([lost, "--against", lost]) == 1

@@ -54,7 +54,8 @@ _LABS = np.random.RandomState(4).randint(0, 64, (8, 16))
 
 
 def _build(dp=1, mp=1, pp=1, sharding=1, *, placements=None, stage=None,
-           schedule="1f1b", seed=11, shard_vocab_head=None, num_layers=4):
+           schedule="1f1b", seed=11, shard_vocab_head=None, num_layers=4,
+           shard_opt_states=False):
     """(model, step) on the given mesh. ``placements``: None | "tp" |
     "pp" (apply_pipeline_placements, tp_axis=mp when live)."""
     s = fleet.DistributedStrategy()
@@ -77,7 +78,8 @@ def _build(dp=1, mp=1, pp=1, sharding=1, *, placements=None, stage=None,
     if stage:
         m, opt, _ = group_sharded_parallel(m, opt, stage)
     step = ShardedTrainStep(m, lambda a, b: m.loss(a, b), opt, mesh,
-                            shard_vocab_head=shard_vocab_head)
+                            shard_vocab_head=shard_vocab_head,
+                            shard_opt_states=shard_opt_states)
     return m, step
 
 
@@ -447,3 +449,85 @@ def test_seq_indivisible_raises_clearly():
             step(ids, labs)
     finally:
         fleet._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Stage-1 (shard_opt_states) slot sharding through the composed region
+# (ROADMAP item 2 follow-up (c), docs/ZERO.md)
+# ---------------------------------------------------------------------------
+class TestStage1SlotSharding:
+    def _slot_leaves(self, step):
+        for name, slots in step._opt_state.items():
+            for k, v in slots.items():
+                yield name, k, v
+
+    def _sharded_count(self, step):
+        n = 0
+        for _n, _k, v in self._slot_leaves(step):
+            spec = getattr(v.sharding, "spec", None) or ()
+            axes = set()
+            for e in spec:
+                if e:
+                    axes.update(e if isinstance(e, tuple) else (e,))
+            if "sharding" in axes:
+                n += 1
+        return n
+
+    def test_composed_keeps_dp_sharded_slots_bitwise(self):
+        """shard_opt_states on a composed dp x sharding x mp mesh: the
+        slot layout stays dp-sharded THROUGH the region (gather-exact
+        update + slice-out), losses AND slot values bitwise the
+        replicated layout's, and the zero_stage1 plan engagement is
+        recorded."""
+        try:
+            m0, s0 = _build(dp=2, sharding=2, mp=2, placements="tp")
+            base = _run(s0)
+            assert compose.last_verdicts().get("composed", (None,))[0] \
+                == "engaged"
+            assert self._sharded_count(s0) == 0
+
+            m1, s1 = _build(dp=2, sharding=2, mp=2, placements="tp",
+                            shard_opt_states=True)
+            got = _run(s1)
+            assert _hexes(got) == _hexes(base)
+            verdict = compose.last_verdicts().get("zero_stage1")
+            assert verdict == ("engaged", "engaged")
+            plan = s1.composed_plan()
+            assert plan is not None and len(plan.slot_shards) > 0
+            assert plan.composed_summary()["stage1_slot_shards"] \
+                == len(plan.slot_shards)
+            # resident slots keep the 1/degree storage AFTER real steps
+            # — the memory win the region used to reshard away
+            assert self._sharded_count(s1) > 0
+            # slot VALUES are bitwise the replicated layout's
+            for (n0, k0, v0), (n1, k1, v1) in zip(
+                    sorted(self._slot_leaves(s0)),
+                    sorted(self._slot_leaves(s1))):
+                assert (n0, k0) == (n1, k1)
+                assert v0.shape == v1.shape
+                assert np.array_equal(np.asarray(v0), np.asarray(v1)), \
+                    (n0, k0)
+        finally:
+            fleet._reset_for_tests()
+
+    def test_storage_and_region_share_the_dim_resolver(self):
+        """compose.stage1_slot_dim IS the storage dim choice: the
+        region spec for every slot_shards entry extends the param spec
+        at exactly that dim."""
+        try:
+            _m, step = _build(dp=2, sharding=2, mp=2, placements="tp",
+                              shard_opt_states=True)
+            _run(step, n=1)
+            plan = step.composed_plan()
+            entries = step.model.state_dict()
+            for name, (d, deg) in plan.slot_shards.items():
+                shape = tuple(int(x) for x in entries[name]._data.shape)
+                assert compose.stage1_slot_dim(shape, 2) == d
+                assert deg == 2
+                spec = compose.stage1_slot_spec(plan.param_specs[name],
+                                                d)
+                ext = spec[d]
+                axes = ext if isinstance(ext, tuple) else (ext,)
+                assert "sharding" in axes
+        finally:
+            fleet._reset_for_tests()

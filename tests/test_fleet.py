@@ -515,6 +515,32 @@ class TestSoak:
             assert rec["serving"]["completed"] == 8
             assert bg.serving_violations(rec) == []
 
+    @pytest.mark.slow  # full CLI overload scenario; tier-1 time budget
+    def test_serve_bench_cli_overload_scenario(self, capsys):
+        """The ISSUE 15 acceptance invocation: --overload drives 2x
+        measured capacity with mixed priorities and a chaos-flapping
+        replica; every request reaches a terminal outcome and the
+        OVERLOAD gate is green (docs/SERVING.md)."""
+        import json
+
+        import tools.bench_gate as bg
+        import tools.serve_bench as sb
+
+        sb.main(["--requests", "24", "--overload",
+                 "--overload-requests", "96"])
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        over = [r for r in lines if "overload" in r]
+        assert len(over) == 1
+        block = over[0]["overload"]
+        assert block["conserved"] is True
+        assert (block["served"] + block["cancelled"] + block["shed"]
+                + block["rejected"]) == block["submitted"] == 96
+        assert block["brownout"]["restored"] is True
+        assert block["chaos"]["faults"] > 0
+        assert bg.overload_violations(over[0]) == []
+
     @pytest.mark.slow  # full soak; tier-1 time budget
     def test_soak_block_contract(self):
         from paddle_tpu.inference.fleet import build_workload, soak_block

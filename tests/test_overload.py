@@ -1,0 +1,712 @@
+"""Overload-safe fleet serving (ISSUE 15, inference/fleet/overload.py,
+docs/SERVING.md "Overload & degradation").
+
+The load-bearing guarantees:
+- admission rejects with a structured Overloaded(retry_after) terminal
+  outcome (SLO prediction, depth watermarks, token bucket, priorities);
+- shedding removes queued requests with counted reasons and outcome
+  conservation holds (served + cancelled + shed + rejected == submitted)
+  over thousands of requests under 2x-capacity chaos;
+- per-replica circuit breakers: transient faults open -> half_open ->
+  close instead of killing the replica; fatal faults keep the old
+  permanent-death path after max_consecutive_fatal; streaming stays
+  exactly-once across breaker requeue/replay (greedy bitwise);
+- the brownout ladder steps down under sustained pressure, every level
+  restores, and greedy outputs after recovery are bitwise those of an
+  unpressured run;
+- PTPU_OVERLOAD=0 reproduces the pre-overload router behavior.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.fleet import overload as ov
+from paddle_tpu.inference.fleet.overload import (Overloaded,
+                                                 OverloadConfig,
+                                                 TransientReplicaError)
+from paddle_tpu.inference.fleet.router import FleetRouter
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing.chaos import ChaosReplica
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class StubEngine:
+    """Deterministic in-memory fleet surface: admits up to max_slots,
+    generates one synthetic token per live request per step. Cheap
+    enough for conservation proofs over thousands of requests."""
+
+    def __init__(self, max_slots=4, max_new_tokens=4, rid_base=0):
+        self.max_slots = max_slots
+        self.max_new_tokens = max_new_tokens
+        self.rid_base = rid_base
+        self.cancelled = {}
+        self._queue = []              # [rid, prompt, on_token]
+        self._running = {}            # rid -> [prompt, generated, cb]
+        # brownout surface
+        self.max_new_cap = None
+        self.spec_paused = False
+        self.prefill_chunk = 8
+        self.prefill_chunk_cap = None
+
+    def submit(self, prompt, rid=None, on_token=None, **kw):
+        self._queue.append([rid, list(prompt), on_token])
+        return rid
+
+    def cancel(self, rid, reason="user"):
+        for i, (qrid, _p, _cb) in enumerate(self._queue):
+            if qrid == rid:
+                del self._queue[i]
+                self.cancelled[rid] = reason
+                return True
+        if rid in self._running:
+            del self._running[rid]
+            self.cancelled[rid] = reason
+            return True
+        return False
+
+    def load(self):
+        occ = len(self._running)
+        return {"queue_depth": len(self._queue), "occupied_slots": occ,
+                "free_slots": self.max_slots - occ,
+                "kv_free_fraction": 1.0 - occ / self.max_slots}
+
+    def prefix_match_pages(self, tokens):
+        return 0
+
+    def warmup(self):
+        return 0.0
+
+    def step(self):
+        while self._queue and len(self._running) < self.max_slots:
+            rid, prompt, cb = self._queue.pop(0)
+            self._running[rid] = [prompt, [], cb]
+        limit = self.max_new_tokens
+        if self.max_new_cap is not None:
+            limit = min(limit, self.max_new_cap)
+        done = {}
+        for rid in list(self._running):
+            prompt, gen, cb = self._running[rid]
+            tok = 100 + len(gen)
+            gen.append(tok)
+            if cb is not None:
+                cb(rid, tok)
+            if len(gen) >= limit:
+                done[rid] = prompt + gen
+                del self._running[rid]
+        return done
+
+
+def _stub_router(n=2, cfg=None, chaos=None, **router_kw):
+    engines = [StubEngine(rid_base=i * 1000) for i in range(n)]
+    for idx, fn in (chaos or {}).items():
+        engines[idx] = fn(engines[idx])
+    return FleetRouter(engines, policy="round_robin",
+                       overload=cfg or OverloadConfig(), **router_kw)
+
+
+# ------------------------------------------------------------- taxonomy
+class TestTaxonomy:
+    def test_classification(self):
+        assert ov.classify_step_exception(TransientReplicaError("x")) \
+            == "transient"
+        assert ov.classify_step_exception(TimeoutError()) == "transient"
+        assert ov.classify_step_exception(OSError(5, "io")) == "transient"
+        assert ov.classify_step_exception(
+            RuntimeError("RESOURCE_EXHAUSTED: hbm")) == "transient"
+        assert ov.classify_step_exception(RuntimeError("boom")) == "fatal"
+        assert ov.classify_step_exception(ValueError("bad")) == "fatal"
+
+    def test_env_hatch_spellings(self, monkeypatch):
+        for off in ("0", "off", "false", "False"):
+            monkeypatch.setenv("PTPU_OVERLOAD", off)
+            assert not ov.overload_enabled()
+            assert ov.resolve_config(OverloadConfig()) is None
+        monkeypatch.delenv("PTPU_OVERLOAD")
+        assert ov.overload_enabled()
+        assert ov.resolve_config(False) is None
+        assert isinstance(ov.resolve_config(None), OverloadConfig)
+
+
+# ------------------------------------------------------------ admission
+class TestAdmission:
+    def test_ttft_slo_rejects_with_retry_after(self):
+        clock = FakeClock()
+        cfg = OverloadConfig(clock=clock, ttft_slo=1.0)
+        router = _stub_router(n=1, cfg=cfg)
+        # cold fleet never rejects on a guess
+        rid = router.submit([1, 2, 3])
+        clock.advance(3.0)            # observed TTFT will be ~3s
+        router.step()                 # first token observed
+        assert router.overload.predictor.base() is not None
+        # base 3s > slo 1s -> the very next submit is over SLO
+        with pytest.raises(Overloaded) as ei:
+            for _ in range(50):
+                router.submit([4, 5])
+        assert ei.value.reason == "ttft_slo"
+        assert ei.value.retry_after > 0
+        assert ei.value.predicted_ttft > 1.0
+        assert router.overload.rejects.get("ttft_slo", 0) >= 1
+
+    def test_depth_watermark_and_batch_priority(self):
+        cfg = OverloadConfig(admit_depth=4)   # batch watermark = 2
+        router = _stub_router(n=1, cfg=cfg, max_queue_depth=1)
+        engine = router.replicas[0].engine
+        # one dispatch fills the replica's queue cap; the rest pend
+        for _ in range(3):
+            router.submit([1])
+        assert len(router._pending) == 2
+        with pytest.raises(Overloaded) as ei:
+            router.submit([2], priority="batch")
+        assert ei.value.reason == "queue_depth"
+        assert ei.value.priority == "batch"
+        router.submit([3])            # interactive still admitted (< 4)
+        router.submit([3])
+        with pytest.raises(Overloaded):
+            router.submit([3])        # now over the interactive mark
+        assert engine.load()["queue_depth"] == 1
+        # everything admitted still completes
+        done = router.run_until_complete()
+        out = router.outcomes()
+        assert out["served"] == len(done) == 5   # 7 submits, 2 rejected
+        assert out["rejected"] == 2
+
+    def test_batch_watermark_stands_alone(self):
+        """admit_depth_batch works without admit_depth: batch traffic
+        is bounded while interactive stays unlimited."""
+        cfg = OverloadConfig(admit_depth_batch=1)
+        router = _stub_router(n=1, cfg=cfg, max_queue_depth=1)
+        for _ in range(3):
+            router.submit([1])        # interactive: no depth limit
+        with pytest.raises(Overloaded) as ei:
+            router.submit([2], priority="batch")
+        assert ei.value.reason == "queue_depth"
+        router.run_until_complete()
+
+    def test_token_bucket(self):
+        clock = FakeClock()
+        cfg = OverloadConfig(clock=clock, rate_limit=(1.0, 2))
+        router = _stub_router(n=1, cfg=cfg)
+        router.submit([1])
+        router.submit([2])            # burst of 2 spent
+        with pytest.raises(Overloaded) as ei:
+            router.submit([3])
+        assert ei.value.reason == "rate_limit"
+        assert ei.value.retry_after == pytest.approx(1.0, abs=0.05)
+        clock.advance(1.0)            # one token refilled
+        router.submit([3])
+        router.run_until_complete()
+
+    def test_priority_validation(self):
+        router = _stub_router(n=1)
+        with pytest.raises(ValueError, match="priority"):
+            router.submit([1], priority="vip")
+
+
+# ------------------------------------------------------------- shedding
+class TestShedding:
+    def test_depth_shed_prefers_batch_then_infeasible_deadlines(self):
+        clock = FakeClock()
+        cfg = OverloadConfig(clock=clock, shed_depth=2, shed_low=1)
+        router = _stub_router(n=1, cfg=cfg, max_queue_depth=1)
+        router.submit([0])            # dispatched to the replica
+        keep = router.submit([1])     # pending[0], interactive
+        b1 = router.submit([2], priority="batch")
+        b2 = router.submit([3], priority="batch")
+        late = router.submit([4], deadline_seconds=0.5)
+        clock.advance(1.0)            # late's deadline is now infeasible
+        router.step()
+        # infeasible deadline shed first, then batch from the back
+        assert router.shed[late] == "deadline_infeasible"
+        assert router.shed[b2] == "queue_depth"
+        assert router.shed[b1] == "queue_depth"
+        assert keep not in router.shed
+        done = router.run_until_complete()
+        out = router.outcomes()
+        assert out["served"] == len(done)
+        assert out["served"] + out["shed"] + out["cancelled"] == 5
+        assert out["pending"] == out["inflight"] == 0
+
+    def test_no_shedding_without_watermarks(self):
+        clock = FakeClock()
+        router = _stub_router(n=1, cfg=OverloadConfig(clock=clock),
+                              max_queue_depth=1)
+        router.submit([0])
+        late = router.submit([1], deadline_seconds=0.01)
+        clock.advance(1.0)
+        router.step()
+        assert router.shed == {}      # defaults are behavior-neutral
+        assert late not in router.shed
+
+
+# ------------------------------------------------------- circuit breaker
+class TestBreaker:
+    def _cfg(self, clock, **kw):
+        kw.setdefault("breaker_threshold", 2)
+        kw.setdefault("breaker_window", 8)
+        kw.setdefault("breaker_backoff", 1.0)
+        kw.setdefault("breaker_close_after", 2)
+        return OverloadConfig(clock=clock, **kw)
+
+    def test_transient_open_half_open_close(self):
+        clock = FakeClock()
+        cfg = self._cfg(clock)
+        router = _stub_router(
+            n=2, cfg=cfg,
+            chaos={0: lambda e: ChaosReplica(e, fail_ticks=(1, 2))})
+        rids = [router.submit([i]) for i in range(6)]
+        br = router.overload.breakers[0]
+        router.step()                 # replica0 fault #1 (tolerated)
+        assert br.state == "closed"
+        router.step()                 # fault #2 -> threshold -> OPEN
+        assert br.state == "open"
+        assert router.replicas[0].healthy     # NOT dead — the fix
+        assert router.requeues > 0            # its work replayed
+        done = router.run_until_complete()    # survivors drain it
+        assert set(done) == set(rids)
+        # backoff expiry -> half_open; IDLE ticks must not close it —
+        # a close needs real probe requests
+        clock.advance(1.5)
+        router.step()
+        assert br.state == "half_open"
+        router.step()
+        assert br.state == "half_open"        # no-op steps don't probe
+        probes = [router.submit([20]), router.submit([21])]
+        done2 = {}
+        for _ in range(10):
+            done2.update(router.step())
+            if br.state == "closed":
+                break
+        assert br.state == "closed"
+        assert br.opens == 1
+        assert [s for _, s in br.transitions] == ["open", "half_open",
+                                                  "closed"]
+        done2.update(router.run_until_complete())
+        assert set(probes) <= set(done2)
+
+    def test_half_open_probe_fails_reopens_with_doubled_backoff(self):
+        clock = FakeClock()
+        cfg = self._cfg(clock)
+        router = _stub_router(
+            n=2, cfg=cfg,
+            chaos={0: lambda e: ChaosReplica(e, fail_ticks=(1, 2, 3))})
+        for i in range(4):
+            router.submit([i])
+        router.step()
+        router.step()                 # open (backoff 1.0, next 2.0)
+        br = router.overload.breakers[0]
+        assert br.state == "open"
+        clock.advance(1.2)
+        router.step()                 # half_open; probe tick fails (#3)
+        assert br.state == "open"
+        assert br.opens == 2
+        t_reopen = br.reopen_at - clock()
+        assert t_reopen > 1.5         # doubled backoff (2.0 + jitter)
+        router.run_until_complete()
+
+    def test_fatal_keeps_old_death_path_by_default(self):
+        router = _stub_router(
+            n=2,
+            chaos={0: lambda e: ChaosReplica(e, fail_ticks=(1,),
+                                             exc_factory=RuntimeError)})
+        for i in range(4):
+            router.submit([i])
+        router.step()
+        assert not router.replicas[0].healthy   # max_consecutive_fatal=1
+        router.run_until_complete()
+
+    def test_max_consecutive_fatal_escape_tolerates_flaky_fatals(self):
+        clock = FakeClock()
+        cfg = self._cfg(clock, max_consecutive_fatal=3,
+                        breaker_threshold=3)
+        router = _stub_router(
+            n=2, cfg=cfg,
+            chaos={0: lambda e: ChaosReplica(e, fail_ticks=(1,),
+                                             exc_factory=RuntimeError)})
+        for i in range(4):
+            router.submit([i])
+        done = router.run_until_complete()
+        assert len(done) == 4
+        assert router.replicas[0].healthy       # one fatal tolerated
+
+    def test_wedged_cancel_on_open_is_permanent_death(self):
+        """An engine whose cancel() ALSO raises at breaker-open has
+        untrusted host state: its work still requeues exactly-once, but
+        the replica dies — a half-open probe on an engine still holding
+        a replayed rid could double-serve it."""
+        clock = FakeClock()
+        router = _stub_router(n=2, cfg=self._cfg(clock),
+                              chaos={0: lambda e: ChaosReplica(
+                                  e, transient_every=1)})
+        def bad_cancel(rid, reason="user"):
+            raise RuntimeError("cancel path wedged too")
+        router.replicas[0].engine._engine.cancel = bad_cancel
+        rids = [router.submit([i]) for i in range(6)]
+        done = {}
+        for _ in range(3):
+            done.update(router.step())
+        assert not router.replicas[0].healthy
+        done.update(router.run_until_complete())
+        assert set(done) == set(rids)         # exactly-once, no loss
+        out = router.outcomes()
+        assert out["served"] == 6 and out["cancelled"] == 0
+
+    def test_open_replica_receives_no_dispatch(self):
+        clock = FakeClock()
+        cfg = self._cfg(clock)
+        router = _stub_router(
+            n=2, cfg=cfg,
+            chaos={0: lambda e: ChaosReplica(e, transient_every=1)})
+        for i in range(8):
+            router.submit([i])
+        router.step()
+        router.step()                 # breaker 0 opens
+        assert router.overload.breakers[0].state == "open"
+        d0 = router.replicas[0].dispatched
+        done = {}
+        for _ in range(5):
+            done.update(router.step())  # backoff never expires (fake clock)
+        assert router.replicas[0].dispatched == d0
+        done.update(router.run_until_complete())
+        assert len(done) == 8
+
+
+# ------------------------------------------------- conservation (stub)
+class TestConservation:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_thousands_of_requests_conserved_under_chaos(self, seed):
+        """Exactly-one-terminal-outcome over thousands of requests at
+        2x capacity with a flapping replica, admission + shedding on."""
+        rng = np.random.default_rng(seed)
+        clock = FakeClock()
+        cfg = OverloadConfig(
+            clock=clock, ttft_slo=50.0, admit_depth=64, shed_depth=32,
+            shed_low=8, breaker_threshold=2, breaker_backoff=0.5,
+            brownout_up_ticks=2, brownout_down_ticks=3)
+        router = _stub_router(
+            n=3, cfg=cfg,
+            chaos={1: lambda e: ChaosReplica(e, flap=(7, 2))})
+        total = 3000
+        submitted = rejected = 0
+        i = 0
+        while submitted + rejected < total or not router.drained():
+            # bursty arrivals: ~2x what 3 stubs x 4 slots drain per tick
+            n_wave = int(rng.integers(16, 33))
+            while n_wave and submitted + rejected < total:
+                n_wave -= 1
+                i += 1
+                pri = "batch" if rng.random() < 0.4 else "interactive"
+                kw = {}
+                if rng.random() < 0.2:
+                    kw["deadline_seconds"] = float(rng.uniform(0.5, 50))
+                try:
+                    router.submit([i], priority=pri, **kw)
+                    submitted += 1
+                except Overloaded:
+                    rejected += 1
+            router.step()
+            clock.advance(0.1)
+        out = router.outcomes()
+        assert out["rejected"] == rejected
+        assert out["served"] + out["cancelled"] + out["shed"] == submitted
+        assert out["pending"] == out["inflight"] == 0
+        assert out["shed"] > 0 or out["rejected"] > 0  # overload was real
+        assert all(h.healthy for h in router.replicas)
+
+
+# -------------------------------------------------------- brownout unit
+class TestBrownout:
+    def test_ladder_hysteresis_and_restore(self):
+        cfg = OverloadConfig(brownout_up_ticks=2, brownout_down_ticks=3)
+        ctl = ov.BrownoutController(cfg)
+        e = StubEngine()
+        eng = [e]
+        for _ in range(2):
+            ctl.update(2.0, eng)      # sustained pressure
+        assert ctl.level == 1 and e.max_new_cap is not None
+        assert not e.spec_paused
+        for _ in range(2):
+            ctl.update(2.0, eng)
+        assert ctl.level == 2 and e.spec_paused
+        for _ in range(2):
+            ctl.update(2.0, eng)
+        assert ctl.level == 3 and e.prefill_chunk_cap is not None
+        ctl.update(2.0, eng)
+        assert ctl.level == 3         # capped at brownout_levels
+        # a blip above low resets the calm counter
+        ctl.update(0.0, eng)
+        ctl.update(0.0, eng)
+        ctl.update(0.9, eng)
+        assert ctl.level == 3
+        level_seen = []
+        for _ in range(12):
+            ctl.update(0.0, eng)
+            level_seen.append(ctl.level)
+        assert ctl.level == 0
+        assert e.max_new_cap is None and not e.spec_paused \
+            and e.prefill_chunk_cap is None     # fully restored
+        assert ctl.summary()["restored"] is True
+        assert ctl.max_level == 3
+
+    def test_spec_pause_and_chunk_cap_are_output_invariant(self):
+        model = _tiny_model()
+        prompts = _prompts(5)
+        want = _serve_plain(model, prompts)
+        # L2: draft attached but paused -> bitwise the plain stream
+        eng = _engine(model, draft_model=model, spec_tokens=2,
+                      max_seq_len=64)
+        eng.spec_paused = True
+        assert _serve(eng, prompts) == want
+        assert eng.spec_ticks == 0    # the draft never ran
+        # L3: chunk cap -> bitwise (prefill split is output-invariant)
+        eng = _engine(model, prefill_chunk=8)
+        eng.prefill_chunk_cap = 2
+        assert _serve(eng, prompts) == want
+
+
+# ------------------------------------------------- real-engine guarantees
+def _tiny_model(seed=0):
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=128,
+                      dropout=0.0)
+    paddle.seed(seed)
+    return LlamaForCausalLM(cfg)
+
+
+_MODEL = None
+
+
+def shared_model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = _tiny_model()
+    return _MODEL
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("max_new_tokens", 6)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _prompts(seed=0, lens=(5, 9, 3, 7, 4, 6)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 96, (n,)).tolist() for n in lens]
+
+
+def _serve(target, prompts, **kw):
+    rids = [target.submit(p, **kw) for p in prompts]
+    done = target.run_until_complete()
+    return {i: done[r] for i, r in enumerate(rids)}
+
+
+def _serve_plain(model, prompts):
+    return _serve(_engine(model), prompts)
+
+
+class TestRealEngine:
+    def test_exactly_once_streaming_across_breaker_replay(self):
+        """A transient fault burst opens the breaker mid-stream; the
+        requeued requests replay on the survivor and the replica later
+        heals — outputs bitwise the no-fault run, client streams
+        exactly-once, fleet capacity NOT shrunk."""
+        model = shared_model()
+        prompts = _prompts() * 2
+        want = {i: v for i, v in enumerate(
+            _serve(_engine(model, prefill_chunk=8), prompts).values())}
+        cfg = OverloadConfig(breaker_threshold=2, breaker_backoff=0.005,
+                             breaker_close_after=1)
+        engines = [_engine(model, rid_base=i * 1_000_000,
+                           prefill_chunk=8) for i in range(2)]
+        router = FleetRouter(
+            [ChaosReplica(engines[0], fail_ticks=(2, 3)), engines[1]],
+            policy="round_robin", overload=cfg)
+        streams = {}
+        rids = [router.submit(p, on_token=lambda r, t:
+                              streams.setdefault(r, []).append(t))
+                for p in prompts]
+        done = router.run_until_complete()
+        got = {i: done[r] for i, r in enumerate(rids)}
+        assert got == want                     # greedy replay invisible
+        assert router.replicas[0].healthy      # breaker, not death
+        assert router.requeues > 0
+        assert router.overload.breakers[0].opens >= 1
+        assert router.overload.breakers[0].state == "closed"
+        for i, r in enumerate(rids):
+            assert streams[r] == want[i][len(prompts[i]):], (i,
+                                                             streams[r])
+
+    def test_brownout_recovery_bitwise(self):
+        """Requests served after the ladder restores are bitwise those
+        of a never-pressured run (the ISSUE acceptance criterion)."""
+        model = shared_model()
+        pressured = _prompts(21)
+        after = _prompts(22)
+        # plain engines: L2's spec pause is proven output-invariant in
+        # TestBrownout (a second draft compile here buys no coverage)
+        want_after = _serve(
+            FleetRouter([_engine(model, prefill_chunk=8)],
+                        overload=OverloadConfig()), after)
+        router = FleetRouter([_engine(model, prefill_chunk=8)],
+                             overload=OverloadConfig())
+        ctl = router.overload.brownout
+        ctl.level = 3
+        ctl.apply([h.engine for h in router.replicas])
+        degraded = _serve(router, pressured)
+        eng = router.replicas[0].engine
+        assert eng.max_new_cap is not None     # L1 cap visibly engaged
+        assert all(len(v) <= len(p) + eng.max_new_cap
+                   for v, p in zip(degraded.values(), pressured))
+        ctl.level = 0
+        ctl.apply([h.engine for h in router.replicas])
+        assert eng.max_new_cap is None and not eng.spec_paused \
+            and eng.prefill_chunk_cap is None
+        got_after = _serve(router, after)
+        assert got_after == want_after         # bitwise recovery
+
+    def test_ptpu_overload_0_reproduces_old_router(self, monkeypatch):
+        """The escape hatch keeps the pre-overload behavior: identical
+        outputs/dispatch on polite load, and a TRANSIENT fault is
+        permanent death again."""
+        model = shared_model()
+        prompts = _prompts(31)
+
+        def drive():
+            engines = [_engine(model, rid_base=i * 1_000_000,
+                               prefill_chunk=8) for i in range(2)]
+            router = FleetRouter(engines, policy="round_robin")
+            out = _serve(router, prompts)
+            return out, [h.dispatched for h in router.replicas]
+
+        out_on, disp_on = drive()
+        monkeypatch.setenv("PTPU_OVERLOAD", "0")
+        out_off, disp_off = drive()
+        assert out_on == out_off and disp_on == disp_off
+        # hatch on: transient fault = the old permanent death
+        router = FleetRouter(
+            [ChaosReplica(_engine(model, prefill_chunk=8),
+                          transient_every=1),
+             _engine(model, rid_base=1_000_000, prefill_chunk=8)],
+            policy="round_robin")
+        assert router.overload is None
+        router.submit(prompts[0])
+        router.run_until_complete()
+        assert not router.replicas[0].healthy
+
+    def test_overload_block_gate_clean(self):
+        """End-to-end overload soak block on real engines: 2x pressure,
+        one flapping replica, conservation + budgets gate-clean."""
+        import tools.bench_gate as bench_gate
+
+        from paddle_tpu.inference.fleet.soak import (build_workload,
+                                                     overload_block)
+
+        model = shared_model()
+        wl = build_workload(60, 400.0, (4, 6, 8), 96,
+                            batch_fraction=0.4, seed=5)
+        cfg = OverloadConfig(
+            ttft_slo=5.0, admit_depth=48, shed_depth=24, shed_low=6,
+            breaker_threshold=2, breaker_backoff=0.01,
+            brownout_up_ticks=2, brownout_down_ticks=3)
+        holder = []
+
+        def wrap(e):
+            holder.append(ChaosReplica(e, flap=(10, 2)))
+            return holder[-1]
+
+        block = overload_block(
+            model, replicas=2, workload=wl, overload_cfg=cfg,
+            engine_kw=dict(max_slots=2, page_size=16, max_seq_len=64,
+                           max_new_tokens=6, prefill_chunk=8),
+            chaos_wrap={0: wrap}, ttft_budget=10.0, shed_ceiling=0.9)
+        bursts = holder[0].steps // 12 + 1
+        block["breaker_flap_bound"] = 2 * bursts + 2
+        assert block["conserved"] is True
+        assert (block["served"] + block["cancelled"] + block["shed"]
+                + block["rejected"]) == block["submitted"]
+        assert block["brownout"]["restored"] is True
+        assert bench_gate.overload_violations({"overload": block}) == []
+
+
+# ------------------------------------------------------- report section
+def test_telemetry_report_overload_section():
+    """tools/telemetry_report.py prints the -- overload -- section from
+    a bare snapshot (no paddle_tpu import needed in the tool)."""
+    import io
+
+    from tools.telemetry_report import print_overload
+
+    snap = {
+        "counters": {
+            "serving_admission_rejects_total": {
+                "priority=batch,reason=queue_depth": 4},
+            "serving_shed_total": {"reason=deadline_infeasible": 2},
+            "serving_breaker_transitions_total": {
+                "replica=0,to=open": 3, "replica=0,to=closed": 3},
+            "serving_brownout_transitions_total": {"direction=down": 2},
+        },
+        "gauges": {
+            "serving_breaker_state": {"replica=0": 0.0},
+            "serving_brownout_level": {"": 1.0},
+        },
+    }
+    buf = io.StringIO()
+    print_overload(snap, out=buf)
+    out = buf.getvalue()
+    assert "-- overload" in out
+    assert "reject[queue_depth] (batch): 4" in out
+    assert "shed[deadline_infeasible]: 2" in out
+    assert "breaker r0 -> open: x3" in out
+    assert "breaker r0 state: closed" in out
+    assert "brownout level: 1" in out
+    # empty snapshots print nothing
+    buf2 = io.StringIO()
+    print_overload({}, out=buf2)
+    assert buf2.getvalue() == ""
+
+
+def test_overload_telemetry_series_recorded():
+    """With the registry enabled, the overload path ticks its counters:
+    rejects, sheds, breaker transitions, brownout level."""
+    from paddle_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    was = reg.enabled
+    reg.enabled = True
+    try:
+        clock = FakeClock()
+        cfg = OverloadConfig(clock=clock, admit_depth=1, shed_depth=1,
+                             shed_low=0, breaker_threshold=1,
+                             brownout_up_ticks=1, brownout_down_ticks=1)
+        router = _stub_router(
+            n=2, cfg=cfg, max_queue_depth=1,
+            chaos={0: lambda e: ChaosReplica(e, fail_ticks=(1,))})
+        for i in range(4):
+            try:
+                router.submit([i])
+            except Overloaded:
+                pass
+        router.step()
+        done = router.run_until_complete()
+        snap = telemetry.snapshot()
+        counters = snap.get("counters", {})
+        assert counters.get("serving_admission_rejects_total")
+        assert counters.get("serving_breaker_transitions_total")
+        out = router.outcomes()
+        assert (out["served"] + out["shed"] + out["cancelled"]
+                + out["rejected"]) == 4
+        assert len(done) == out["served"]
+    finally:
+        reg.enabled = was

@@ -290,10 +290,68 @@ def serving_violations(rec):
     reqs = block.get("requests")
     done = block.get("completed")
     cancelled = block.get("cancelled") or 0
+    shed = block.get("shed") or 0
+    rejected = block.get("rejected") or 0
     if reqs is not None and done is not None and (
-            int(done) + int(cancelled) < int(reqs)):
+            int(done) + int(cancelled) + int(shed) + int(rejected)
+            < int(reqs)):
         out.append(f"soak lost requests: {done} completed + {cancelled} "
-                   f"cancelled < {reqs} submitted")
+                   f"cancelled + {shed} shed + {rejected} rejected "
+                   f"< {reqs} submitted")
+    return out
+
+
+def overload_violations(rec):
+    """Reference-free violation strings from one record's "overload"
+    block (docs/SERVING.md "Overload & degradation"; emitted by
+    ``tools/serve_bench.py --overload``). The block embeds every budget
+    it was asked to guarantee, like the serving/comms blocks:
+
+    - ``conserved`` false = a submitted request reached no terminal
+      outcome (served | cancelled | shed | rejected) — a lost or hung
+      request, the hard floor;
+    - p99 TTFT of ADMITTED requests over ``p99_ttft_budget`` — admission
+      control exists precisely so admitted requests keep their SLO
+      under 2x-capacity pressure;
+    - ``shed_fraction`` over ``shed_ceiling`` — refusing a bounded
+      slice of overload traffic is the design, refusing most of it is a
+      regression;
+    - ``breaker_opens`` over ``breaker_flap_bound`` — a flapping
+      replica must cost a bounded number of breaker flaps;
+    - a brownout ladder that did not restore (``restored`` false) —
+      degradation must be reversible once pressure clears."""
+    block = rec.get("overload") if isinstance(rec, dict) else None
+    if not isinstance(block, dict) or not block.get("enabled"):
+        return []
+    out = []
+    if block.get("conserved") is False:
+        n = (int(block.get("submitted") or 0)
+             - int(block.get("served") or 0)
+             - int(block.get("cancelled") or 0)
+             - int(block.get("shed") or 0)
+             - int(block.get("rejected") or 0))
+        out.append(f"outcome conservation broken: {n} of "
+                   f"{block.get('submitted')} requests reached no "
+                   "terminal outcome (lost or hung)")
+    p99 = block.get("p99_ttft_seconds")
+    budget = block.get("p99_ttft_budget")
+    if p99 is not None and budget is not None and float(p99) > float(budget):
+        out.append(f"admitted p99 TTFT {float(p99):.4f}s > budget "
+                   f"{float(budget):.4f}s under overload")
+    frac = block.get("shed_fraction")
+    ceil = block.get("shed_ceiling")
+    if frac is not None and ceil is not None and float(frac) > float(ceil):
+        out.append(f"shed+rejected fraction {float(frac):.2f} > ceiling "
+                   f"{float(ceil):.2f}")
+    opens = block.get("breaker_opens")
+    bound = block.get("breaker_flap_bound")
+    if opens is not None and bound is not None and int(opens) > int(bound):
+        out.append(f"breaker flap count {int(opens)} > bound "
+                   f"{int(bound)}")
+    brown = block.get("brownout") or {}
+    if brown and brown.get("restored") is False:
+        out.append(f"brownout ladder not restored after the run "
+                   f"(level still {brown.get('level')})")
     return out
 
 
@@ -502,6 +560,12 @@ def main(argv=None):
         # scaling target + no lost requests (docs/SERVING.md)
         for v in serving_violations(rec):
             print(f"  SERVE {metric}: {v}", flush=True)
+            failed = True
+        # overload gate (reference-free): outcome conservation at 2x
+        # capacity, admitted-p99 budget, shed ceiling, breaker flap
+        # bound, brownout restoration (docs/SERVING.md)
+        for v in overload_violations(rec):
+            print(f"  OVERLOAD {metric}: {v}", flush=True)
             failed = True
         # pipeline gate (docs/PIPELINE.md): measured-cost bubble over
         # budget, or a pp-live mesh whose composition never engaged

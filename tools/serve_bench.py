@@ -24,6 +24,10 @@ paddle_tpu/inference/fleet/soak.py). Run from /root/repo:
         --scaling-target 3.5                         # the soak gate run
     python tools/serve_bench.py --disagg --spec --int8-kv \
         --prefix-cache --shared-prefix 64            # full topology
+    python tools/serve_bench.py --overload           # 2x-capacity
+        # overload scenario: mixed priorities, one chaos-flapping
+        # replica, admission/shedding/breakers/brownout on — emits the
+        # OVERLOAD-gated "overload" block (docs/SERVING.md)
 """
 from __future__ import annotations
 
@@ -76,6 +80,19 @@ def main(argv=None):
                     "(default: 10x the single-replica p50)")
     ap.add_argument("--ttft-budget-x", type=float, default=10.0,
                     help="derived budget = this x single-replica p50")
+    ap.add_argument("--overload", action="store_true",
+                    help="after the sweep, run the overload scenario: "
+                    "sustained arrivals at --overload-x the measured "
+                    "fleet capacity, mixed interactive/batch "
+                    "priorities, one chaos-flapping replica, overload "
+                    "control on — emits the gateable 'overload' block "
+                    "(docs/SERVING.md 'Overload & degradation')")
+    ap.add_argument("--overload-x", type=float, default=2.0,
+                    help="overload arrival rate as a multiple of the "
+                    "measured capacity (default 2.0)")
+    ap.add_argument("--overload-requests", type=int, default=None,
+                    help="requests in the overload scenario (default: "
+                    "same as --requests)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -169,6 +186,64 @@ def main(argv=None):
             "value": block.get("goodput_tokens_per_sec"),
             "unit": "tokens/sec",
             "serving": block,
+        }), flush=True)
+
+    if args.overload:
+        from paddle_tpu.inference.fleet import OverloadConfig
+        from paddle_tpu.inference.fleet.soak import (overload_block,
+                                                     overload_workload)
+        from paddle_tpu.testing.chaos import ChaosReplica
+
+        n = max(replica_counts)
+        # measured capacity: what ONE replica actually served per
+        # simulated second in the baseline sweep run
+        base_rate = (baseline["completed"]
+                     / max(baseline["sim_seconds"], 1e-9))
+        p50 = (baseline.get("ttft") or {}).get("p50") or 0.1
+        slo = args.ttft_budget or args.ttft_budget_x * p50
+        n_over = args.overload_requests or requests
+        wl = overload_workload(
+            base_rate * n, n_over, prompt_lens, cfg.vocab_size,
+            rate_x_capacity=args.overload_x, batch_fraction=0.4,
+            seed=args.seed + 7)
+        depth = 2 * n * slots
+        ov_cfg = OverloadConfig(
+            ttft_slo=slo, admit_depth=2 * depth, shed_depth=depth,
+            breaker_backoff=0.02, breaker_threshold=2,
+            breaker_close_after=2, brownout_up_ticks=3,
+            brownout_down_ticks=6)
+        flap = (12, 3)
+        holder = []
+
+        def wrap(e):
+            holder.append(ChaosReplica(e, flap=flap))
+            return holder[-1]
+
+        # the overload scenario always runs plain engines (the breaker /
+        # brownout mechanics are topology-independent); a --disagg sweep
+        # kept slots/page in disagg_kw, so re-add them here
+        ov_engine_kw = dict(engine_kw)
+        ov_engine_kw.setdefault("max_slots", slots)
+        ov_engine_kw.setdefault("page_size", page)
+        block = overload_block(
+            model, replicas=n, workload=wl, overload_cfg=ov_cfg,
+            policy=args.policy, engine_kw=ov_engine_kw,
+            chaos_wrap={0: wrap}, ttft_budget=2.0 * slo,
+            shed_ceiling=0.9, rate_x_capacity=args.overload_x)
+        # bound the breaker flap count by the fault bursts the chaos
+        # schedule actually fired: at most two opens per down-phase
+        # (threshold-crossing + one failed half-open probe inside the
+        # same burst), never one per fault
+        chaos = holder[0]
+        bursts = chaos.steps // (flap[0] + flap[1]) + 1
+        block["breaker_flap_bound"] = 2 * bursts + 2
+        block["chaos"] = {"flap": list(flap), "steps": chaos.steps,
+                          "faults": chaos.faults}
+        print(json.dumps({
+            "metric": f"serve_overload_goodput_r{n}",
+            "value": block.get("goodput_tokens_per_sec"),
+            "unit": "tokens/sec",
+            "overload": block,
         }), flush=True)
 
 

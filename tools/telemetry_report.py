@@ -183,6 +183,52 @@ def print_plans(snap, out=None):
           f"[{d.get('reason', '?')}] x{int(v)}\n")
 
 
+def print_overload(snap, out=None):
+    """Overload section (docs/SERVING.md "Overload & degradation"):
+    admission rejects by reason/priority, shed counts by reason, breaker
+    states/transitions per replica, and the brownout ladder level."""
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    rows = []
+
+    def _d(labels):
+        return dict(p.split("=", 1) for p in labels.split(",")
+                    if "=" in p)
+
+    for labels, v in sorted((counters.get(
+            "serving_admission_rejects_total") or {}).items()):
+        d = _d(labels)
+        rows.append(f"  reject[{d.get('reason', '?')}] "
+                    f"({d.get('priority', '?')}): {int(v)}")
+    for labels, v in sorted((counters.get("serving_shed_total")
+                             or {}).items()):
+        rows.append(f"  shed[{_d(labels).get('reason', '?')}]: {int(v)}")
+    for labels, v in sorted((counters.get(
+            "serving_breaker_transitions_total") or {}).items()):
+        d = _d(labels)
+        rows.append(f"  breaker r{d.get('replica', '?')} -> "
+                    f"{d.get('to', '?')}: x{int(v)}")
+    state_names = {0: "closed", 1: "half_open", 2: "open"}
+    for labels, v in sorted((gauges.get("serving_breaker_state")
+                             or {}).items()):
+        d = _d(labels)
+        rows.append(f"  breaker r{d.get('replica', '?')} state: "
+                    f"{state_names.get(int(float(v)), v)}")
+    for labels, v in sorted((counters.get(
+            "serving_brownout_transitions_total") or {}).items()):
+        rows.append(f"  brownout step {_d(labels).get('direction', '?')}:"
+                    f" x{int(v)}")
+    lvl = (gauges.get("serving_brownout_level") or {}).get("")
+    if lvl is not None:
+        rows.append(f"  brownout level: {int(float(lvl))}")
+    if not rows:
+        return
+    w = (out or sys.stdout).write
+    w("-- overload (admission / shedding / breakers / brownout) --\n")
+    for r in rows:
+        w(r + "\n")
+
+
 def print_trace(snap, out=None):
     """Span-tracer section (docs/TELEMETRY.md Tracing): the
     ``trace_span_seconds`` histogram family mirrors every completed
@@ -215,6 +261,7 @@ def print_snapshot(snap, out=None):
     print_comms(snap, out)
     print_zero(snap, out)
     print_ring(snap, out)
+    print_overload(snap, out)
     for kind in ("counters", "gauges"):
         group = snap.get(kind) or {}
         if group:
