@@ -203,6 +203,23 @@ def run_model(model_kind, ckpt=None):
         ptrace.enable()
         ptrace.reset()
 
+    # --record / PTPU_RECORD=1: background time-series recorder for the
+    # whole run — registry samples every --record-interval seconds into
+    # a JSONL timeline next to the bench output, summarized in the JSON
+    # line's "timeline" block and readable by tools/telemetry_report.py
+    # --timeline (docs/TELEMETRY.md "Time series, SLOs...")
+    record_on = (bool(ckpt is not None and getattr(ckpt, "record", False))
+                 or os.environ.get("PTPU_RECORD", "") not in ("", "0"))
+    record_interval = float(
+        (getattr(ckpt, "record_interval", None) if ckpt else None)
+        or os.environ.get("PTPU_RECORD_INTERVAL", "") or 0.5)
+    ts_recorder = None
+    if record_on:
+        os.makedirs(trace_dir, exist_ok=True)
+        ts_recorder = telemetry.recorder(jsonl_path=os.path.join(
+            trace_dir, f"timeline_{model_kind}.jsonl"))
+        ts_recorder.start(record_interval)
+
     if on_tpu:
         # Tuned defaults (measured on v5e; r3 sweep + r4 sweep):
         # - Pallas rms kernel with saved rstd residual (+3.1% MFU, r3)
@@ -747,6 +764,18 @@ def run_model(model_kind, ckpt=None):
                   else "gpt3_1.3b_pretrain_tokens_per_sec")
     else:
         metric = "gpt_pretrain_tokens_per_sec"
+
+    timeline_block = {"enabled": False}
+    if ts_recorder is not None:
+        ts_recorder.sample()        # the final totals land in the file
+        ts_recorder.close()
+        timeline_block = {
+            "enabled": True,
+            "path": ts_recorder.jsonl_path,
+            "samples": ts_recorder.seq,
+            "dropped": ts_recorder.dropped,
+            "interval_seconds": record_interval,
+        }
     print(json.dumps({
         "metric": metric,
         "value": round(tokens_per_sec, 1),
@@ -779,6 +808,11 @@ def run_model(model_kind, ckpt=None):
         # cold start + goodput scaling + p99 TTFT vs budget, gated by
         # bench_gate's SERVE/COLD gates
         "serving": serving,
+        # background time-series recording (--record; docs/TELEMETRY.md
+        # "Time series, SLOs..."): cadence samples of the registry in a
+        # JSONL timeline next to the bench output, inspected by
+        # tools/telemetry_report.py --timeline
+        "timeline": timeline_block,
         # step anatomy from the span tracer (--trace / PTPU_TRACE=1):
         # per-phase seconds, device-vs-host split from cost_analysis,
         # cost-analysis MFU next to the measured "mfu" field, and the
@@ -832,6 +866,17 @@ def main():
                     help="StepGuard anomaly policy + hang watchdog around "
                     "the timed loop (docs/RESILIENCE.md); decision totals "
                     "land in the JSON 'resilience' block")
+    ap.add_argument("--record", action="store_true",
+                    default=os.environ.get("PTPU_RECORD", "")
+                    not in ("", "0"),
+                    help="record a background time-series timeline "
+                    "(registry samples every --record-interval seconds) "
+                    "into timeline_<model>.jsonl next to the bench "
+                    "output; adds the 'timeline' block to the JSON line "
+                    "(docs/TELEMETRY.md)")
+    ap.add_argument("--record-interval", type=float, default=None,
+                    help="seconds between --record samples "
+                    "(default 0.5, or PTPU_RECORD_INTERVAL)")
     ap.add_argument("--long-context", action="store_true",
                     default=os.environ.get("PTPU_BENCH_LONG", "")
                     not in ("", "0"),

@@ -680,6 +680,7 @@ class PreemptionGuard:
         self._old = {}
         self._last_check = None
         self._max_step_seconds = 0.0
+        self._flight_dumped = False
 
     # -- signal plumbing -----------------------------------------------------
     def _handler(self, signum, frame):
@@ -731,10 +732,25 @@ class PreemptionGuard:
                                          now - self._last_check)
         self._last_check = now
         if self._preempted:
+            self._note_flight("signal")
             return True
         if self._deadline is not None:
-            return now + self._max_step_seconds + self.margin >= self._deadline
+            if now + self._max_step_seconds + self.margin >= self._deadline:
+                self._note_flight("deadline")
+                return True
         return False
+
+    def _note_flight(self, why):
+        """One forensics bundle per preemption, from the POLL site —
+        the signal handler itself must never touch the filesystem."""
+        if self._flight_dumped:
+            return
+        self._flight_dumped = True
+        from ...telemetry import flight as _flight
+        _flight.maybe_dump("preemption", {
+            "why": why, "signum": self._signum,
+            "max_step_seconds": round(self._max_step_seconds, 3),
+            "margin": self.margin})
 
     def checkpoint_and_stop(self, step, state_dict) -> bool:
         """If stopping: drain pending async saves, write `state_dict` as a

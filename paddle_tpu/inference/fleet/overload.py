@@ -52,6 +52,7 @@ import time
 from collections import deque
 
 from ... import telemetry as _telemetry
+from ...telemetry import flight as _flight
 
 __all__ = [
     "Overloaded", "TransientReplicaError", "OverloadConfig",
@@ -463,6 +464,7 @@ class BrownoutController:
 
     def update(self, pressure, engines):
         changed = False
+        direction = None
         if pressure >= self.cfg.brownout_high:
             self._above += 1
             self._below = 0
@@ -473,6 +475,7 @@ class BrownoutController:
                 self.steps_down += 1
                 self._above = 0
                 changed = True
+                direction = "down"
                 if _telemetry.get_registry().enabled:
                     _BROWNOUT_TRANSITIONS.inc(labels=("down",))
         elif pressure <= self.cfg.brownout_low:
@@ -484,6 +487,7 @@ class BrownoutController:
                 self.steps_up += 1
                 self._below = 0
                 changed = True
+                direction = "up"
                 if _telemetry.get_registry().enabled:
                     _BROWNOUT_TRANSITIONS.inc(labels=("up",))
         else:
@@ -491,6 +495,16 @@ class BrownoutController:
             self._below = 0
         if changed:
             self.apply(engines)
+            _flight.note_event("brownout_step", {
+                "direction": direction, "level": self.level,
+                "pressure": round(float(pressure), 4)})
+            if direction == "down":
+                # stepping DOWN a level is load-shedding in anger: dump
+                # a forensics bundle (flight's per-reason rate limit
+                # keeps an oscillating ladder from spraying files)
+                _flight.maybe_dump("brownout_step", {
+                    "level": self.level,
+                    "pressure": round(float(pressure), 4)})
         return self.level
 
     def summary(self):

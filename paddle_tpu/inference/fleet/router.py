@@ -52,6 +52,7 @@ import time
 from collections import deque
 
 from ... import telemetry as _telemetry
+from ...telemetry import flight as _flight
 from ...telemetry import trace as _trace
 from . import overload as _overload
 
@@ -355,6 +356,9 @@ class FleetRouter:
         handle.healthy = False
         handle.death_reason = repr(exc)
         _DEATHS.inc()
+        _flight.maybe_dump("replica_death", {
+            "replica": handle.idx, "exc": repr(exc),
+            "healthy_replicas": sum(h.healthy for h in self.replicas)})
         self._requeue_all(handle, "requeue", {"dead_replica": handle.idx})
         if not any(h.healthy for h in self.replicas):
             raise RuntimeError(
@@ -371,6 +375,7 @@ class FleetRouter:
         instead of replaying: honoring it here also clears the
         engine-side record, so a later half-open drain can never
         double-terminate a rid the survivors are serving."""
+        _flight.maybe_dump("breaker_open", {"replica": handle.idx})
         eng_cancelled = getattr(handle.engine, "cancelled", None)
         if eng_cancelled is None:     # NOT `or {}`: an EMPTY dict is
             eng_cancelled = {}        # falsy, and pops must reach the
@@ -413,6 +418,11 @@ class FleetRouter:
             handle.healthy = False
             handle.death_reason = repr(wedged)
             _DEATHS.inc()
+            _flight.maybe_dump("replica_death", {
+                "replica": handle.idx, "exc": repr(wedged),
+                "why": "cancel() failed during breaker requeue",
+                "healthy_replicas": sum(h.healthy
+                                        for h in self.replicas)})
             if not any(h.healthy for h in self.replicas):
                 raise RuntimeError(
                     "FleetRouter: every replica is dead "

@@ -24,10 +24,21 @@ from collections import deque
 
 import numpy as np
 
+from ... import telemetry as _telemetry
+from ...telemetry import flight as _flight
 from .overload import Overloaded
 
 __all__ = ["build_workload", "run_soak", "percentile", "fleet_soak",
-           "soak_block", "overload_block", "overload_workload"]
+           "soak_block", "overload_block", "overload_workload",
+           "default_objectives"]
+
+#: a TTFT observed more than this many fleet ticks ago ages out of the
+#: per-tick ``values:ttft_p50/p99_recent`` signals — the SLO engine's
+#: burn windows then drain and a fired TTFT alert can CLEAR once the
+#: overload passes (docs/TELEMETRY.md)
+TTFT_RECENT_TICKS = 50
+
+_BREAKER_CODES = {"closed": 0, "half_open": 1, "open": 2}
 
 
 def percentile(sorted_vals, q):
@@ -112,7 +123,8 @@ def _engine_stats(eng):
 
 
 def run_soak(target, workload, warmup=True, max_ticks=200000,
-             rebase_overload_clock=True):
+             rebase_overload_clock=True, recorder=None, slo=None,
+             timeline_path=None):
     """Drive ``workload`` through ``target`` (engine / disagg /
     FleetRouter) and return the raw soak stats dict. Cold start
     (construction is the caller's; compile is ours via ``warmup()``) is
@@ -129,7 +141,19 @@ def run_soak(target, workload, warmup=True, max_ticks=200000,
     controller is rebased onto THIS soak's simulated-parallel clock
     (``rebase_overload_clock=False`` keeps wall time): admission
     prediction, breaker backoff, and brownout hysteresis then measure
-    fleet time, and the run is reproducible."""
+    fleet time, and the run is reproducible.
+
+    **Telemetry.** ``recorder`` (a
+    :class:`~paddle_tpu.telemetry.TimeSeriesRecorder`) — or
+    ``timeline_path``/``slo``, which create one — records one timeline
+    sample per fleet tick on the simulated clock: queue depth, inflight,
+    brownout level, per-replica breaker states, recent-TTFT percentiles,
+    running goodput, and cumulative outcome counters. ``slo`` is a list
+    of :class:`~paddle_tpu.telemetry.SloObjective` (or a prebuilt
+    engine) evaluated live after every sample; its fire/clear events
+    land in ``stats["slo"]`` and the flight recorder's forensics window.
+    The run ends with a ``soak_end`` flight bundle when a flight
+    recorder is installed."""
     router = hasattr(target, "replicas")
     engines = ([h.engine for h in target.replicas] if router
                else [target])
@@ -137,6 +161,20 @@ def run_soak(target, workload, warmup=True, max_ticks=200000,
     ov = getattr(target, "overload", None) if router else None
     if ov is not None and rebase_overload_clock:
         ov.set_clock(lambda: sim[0])
+    own_recorder = False
+    if recorder is None and (timeline_path is not None
+                             or slo is not None):
+        recorder = _telemetry.recorder(jsonl_path=timeline_path)
+        own_recorder = True
+    if recorder is not None:
+        recorder.set_clock(lambda: sim[0])
+    slo_engine = None
+    if slo is not None:
+        slo_engine = (slo if hasattr(slo, "evaluate")
+                      else _telemetry.SloEngine(
+                          recorder, slo,
+                          registry=_telemetry.get_registry(),
+                          flight=_flight.get()))
     cold = []
     if warmup:
         for e in engines:
@@ -159,6 +197,44 @@ def run_soak(target, workload, warmup=True, max_ticks=200000,
         return (len(done)
                 + len(getattr(target, "cancelled", {}) or {})
                 + len(getattr(target, "shed", {}) or {}))
+
+    tick_no = [0]
+    gen_running = [0]
+    ttft_recent = deque()         # (tick, ttft) — aged out by tick
+
+    def take_sample():
+        """One timeline sample on the sim clock (per fleet tick)."""
+        while ttft_recent and \
+                ttft_recent[0][0] < tick_no[0] - TTFT_RECENT_TICKS:
+            ttft_recent.popleft()
+        values = {}
+        recent = sorted(t for _, t in ttft_recent)
+        if recent:
+            values["ttft_p50_recent"] = percentile(recent, 0.50)
+            values["ttft_p99_recent"] = percentile(recent, 0.99)
+        values["goodput_tokens_per_sec"] = (
+            round(gen_running[0] / sim[0], 3) if sim[0] > 0 else 0.0)
+        if router:
+            values["queue_depth"] = len(target._pending)
+            values["inflight"] = len(target._inflight)
+            values["healthy_replicas"] = sum(
+                1 for h in target.replicas if h.healthy)
+        if ov is not None:
+            values["brownout_level"] = ov.brownout.level
+            # per-replica rollup: breaker state as a plottable code
+            for i, br in enumerate(ov.breakers):
+                values[f"breaker_state_r{i}"] = _BREAKER_CODES.get(
+                    br.state, -1)
+        counters = {
+            "soak_completed_total": len(done),
+            "soak_shed_total": len(getattr(target, "shed", {}) or {}),
+            "soak_rejected_total": sum(rejected.values()),
+            "soak_generated_tokens_total": gen_running[0],
+        }
+        recorder.sample(values=values, counters=counters,
+                        tags={"tick": tick_no[0]})
+        if slo_engine is not None:
+            slo_engine.evaluate()
 
     for _tick in range(max_ticks):
         # admit every arrival the simulated clock has reached; when the
@@ -198,27 +274,52 @@ def run_soak(target, workload, warmup=True, max_ticks=200000,
             out = target.step()
             cost = time.perf_counter() - t0
         sim[0] += cost
+        tick_no[0] = _tick
         for rid in set(first_seen) - before_first:
             if rid in arrival:
-                ttfts.append(sim[0] - arrival[rid])
+                ttft = sim[0] - arrival[rid]
+                ttfts.append(ttft)
+                ttft_recent.append((_tick, ttft))
+        gen_running[0] += sum(max(0, len(ids) - plen.get(rid, 0))
+                              for rid, ids in out.items())
         done.update(out)
+        if recorder is not None:
+            take_sample()
         if not pending and n_terminal() >= len(arrival):
             break
     else:
         raise TimeoutError("soak did not drain")
-    if ov is not None and ov.brownout.level > 0:
+
+    def cooling():
+        if ov is not None and ov.brownout.level > 0:
+            return True
+        return bool(slo_engine is not None and slo_engine.active)
+
+    if cooling():
         # post-drain cool-down: the pressure is gone — give the brownout
         # ladder its hysteresis ticks to step fully back up, so
         # "restored on recovery" is an observable property of the run
-        # (bounded: each level needs brownout_down_ticks calm ticks)
-        limit = ((ov.cfg.brownout_down_ticks + 1)
-                 * (ov.cfg.brownout_levels + 1) * 4)
+        # (bounded: each level needs brownout_down_ticks calm ticks),
+        # and give the SLO engine's burn windows their ticks to drain so
+        # a fired alert CLEARS on recovery (recent TTFTs age out after
+        # TTFT_RECENT_TICKS, then the windows empty and burn drops to 0)
+        limit = 16
+        if ov is not None:
+            limit = max(limit, (ov.cfg.brownout_down_ticks + 1)
+                        * (ov.cfg.brownout_levels + 1) * 4)
+        if slo_engine is not None:
+            limit = max(limit, TTFT_RECENT_TICKS + 8 + 4 * max(
+                (o.fast_samples for o in slo_engine.objectives),
+                default=8))
         for _ in range(limit):
-            if ov.brownout.level == 0:
+            if not cooling():
                 break
             t0 = time.perf_counter()
             target.step()
             sim[0] += time.perf_counter() - t0
+            tick_no[0] += 1
+            if recorder is not None:
+                take_sample()
     sim_t = sim[0]
     wall_seconds = time.perf_counter() - wall0
     cancelled = dict(getattr(target, "cancelled", {}) or {})
@@ -274,13 +375,28 @@ def run_soak(target, workload, warmup=True, max_ticks=200000,
         }
         if ov is not None:
             stats["overload"] = ov.summary()
+    if recorder is not None:
+        stats["timeline"] = {
+            "samples": recorder.seq,
+            "dropped": recorder.dropped,
+            "path": recorder.jsonl_path,
+        }
+    if slo_engine is not None:
+        stats["slo"] = slo_engine.summary()
+    _flight.maybe_dump("soak_end", {
+        "requests": n_requests, "completed": len(done),
+        "shed": len(shed), "rejected": n_rejected,
+        "sim_seconds": round(sim_t, 6)})
+    if own_recorder:
+        recorder.close()
     return stats, done
 
 
 def fleet_soak(model, n_replicas, workload, *, policy="least_loaded",
                disagg=False, draft_model=None, engine_kw=None,
                disagg_kw=None, max_ticks=200000, overload=None,
-               chaos_wrap=None):
+               chaos_wrap=None, recorder=None, slo=None,
+               timeline_path=None):
     """Build ``n_replicas`` engines (or disaggregated pairs) over
     ``model``, route them (FleetRouter when n>1), drive ``workload``,
     return the soak stats. One entry point for tools/serve_bench.py and
@@ -309,14 +425,40 @@ def fleet_soak(model, n_replicas, workload, *, policy="least_loaded",
     target = (engines[0] if n_replicas == 1 and overload is None
               and not chaos_wrap
               else FleetRouter(engines, policy=policy, overload=overload))
-    return run_soak(target, workload, max_ticks=max_ticks)
+    return run_soak(target, workload, max_ticks=max_ticks,
+                    recorder=recorder, slo=slo,
+                    timeline_path=timeline_path)
+
+
+def default_objectives(ttft_budget=None, goodput_floor=None,
+                       shed_rate_ceiling=None):
+    """The stock soak objectives (docs/TELEMETRY.md declaration
+    syntax), built from the same budgets the bench gates use."""
+    out = []
+    if ttft_budget is not None:
+        out.append(_telemetry.SloObjective(
+            "ttft_p99", "values:ttft_p99_recent", float(ttft_budget),
+            op="le", description="p99 TTFT over the recent-tick window "
+            "stays within the serving budget"))
+    if goodput_floor is not None:
+        out.append(_telemetry.SloObjective(
+            "goodput_floor", "values:goodput_tokens_per_sec",
+            float(goodput_floor), op="ge",
+            description="running goodput stays above the floor"))
+    if shed_rate_ceiling is not None:
+        out.append(_telemetry.SloObjective(
+            "shed_rate", "counters:soak_shed_total:rate",
+            float(shed_rate_ceiling), op="le",
+            description="shed per-second rate stays under the ceiling"))
+    return out
 
 
 def overload_block(model, *, replicas, workload, overload_cfg,
                    policy="least_loaded", engine_kw=None,
                    chaos_wrap=None, ttft_budget=None,
                    shed_ceiling=0.5, flap_bound=8,
-                   rate_x_capacity=None, max_ticks=400000):
+                   rate_x_capacity=None, max_ticks=400000,
+                   timeline_path=None, slo=None):
     """The gateable ``"overload"`` JSON block (docs/SERVING.md
     "Overload & degradation"; ``tools/bench_gate.py`` OVERLOAD gate):
     drive an overload-scenario workload (typically 2x measured capacity,
@@ -335,11 +477,19 @@ def overload_block(model, *, replicas, workload, overload_cfg,
       must cost a bounded number of breaker flaps, not one per fault;
     - ``brownout.restored`` — the ladder must step fully back up after
       the pressure clears (the run cools down post-drain until it does).
+
+    When ``timeline_path``/``slo`` (or ``ttft_budget``) is given the
+    soak records a per-tick timeline and runs the SLO engine live; the
+    block then embeds ``"timeline"`` and ``"slo"`` sub-blocks. Alerts
+    here are EXPECTED (the scenario runs past capacity by design) — the
+    bench_gate SLO gate applies to clean ``"serving"`` blocks only.
     """
+    if slo is None and ttft_budget is not None and timeline_path:
+        slo = default_objectives(ttft_budget=ttft_budget)
     stats, _done = fleet_soak(
         model, replicas, workload, policy=policy, engine_kw=engine_kw,
         overload=overload_cfg, chaos_wrap=chaos_wrap,
-        max_ticks=max_ticks)
+        max_ticks=max_ticks, slo=slo, timeline_path=timeline_path)
     ov = stats.get("overload") or {}
     brown = dict(ov.get("brownout") or {})
     submitted = stats["requests"]
@@ -369,6 +519,9 @@ def overload_block(model, *, replicas, workload, overload_cfg,
         "brownout": brown,
         "retry_after_mean": stats["retry_after_mean"],
     }
+    for extra in ("timeline", "slo"):
+        if extra in stats:
+            block[extra] = stats[extra]
     if ttft_budget is not None:
         block["p99_ttft_budget"] = float(ttft_budget)
     if rate_x_capacity is not None:
@@ -379,19 +532,28 @@ def overload_block(model, *, replicas, workload, overload_cfg,
 def soak_block(model, *, replicas, workload, policy="least_loaded",
                disagg=False, draft_model=None, engine_kw=None,
                disagg_kw=None, baseline=None, scaling_target=None,
-               ttft_budget=None):
+               ttft_budget=None, timeline_path=None, slo=None):
     """One gateable ``"serving"`` JSON block (docs/SERVING.md contract):
     the soak stats plus the gate fields — ``p99_ttft_seconds`` vs
     ``p99_ttft_budget``, ``goodput_x_single`` vs ``scaling_target``
     (both gates engage only when their bound is present), the replica
     ``cold_start_seconds`` (gated vs the previous round at the same
     scan mode, like the compile gate), and the scan mode itself.
-    ``baseline`` is a prior single-replica block to scale against."""
+    ``baseline`` is a prior single-replica block to scale against.
+
+    With ``timeline_path`` (or explicit ``slo`` objectives) the soak
+    records a per-tick timeline; a ``ttft_budget`` then also declares
+    the stock TTFT SLO and the engine runs live, so the block's embedded
+    ``"slo"`` sub-block is gateable: a CLEAN soak that still fires a
+    fast-burn alert fails the round (tools/bench_gate.py SLO gate)."""
     from ...models.gpt import scan_layers_enabled
 
+    if slo is None and ttft_budget is not None and timeline_path:
+        slo = default_objectives(ttft_budget=ttft_budget)
     stats, _done = fleet_soak(
         model, replicas, workload, policy=policy, disagg=disagg,
-        draft_model=draft_model, engine_kw=engine_kw, disagg_kw=disagg_kw)
+        draft_model=draft_model, engine_kw=engine_kw, disagg_kw=disagg_kw,
+        slo=slo, timeline_path=timeline_path)
     block = dict(stats)
     block["enabled"] = True
     block["policy"] = policy if replicas > 1 else None

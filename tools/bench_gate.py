@@ -301,6 +301,36 @@ def serving_violations(rec):
     return out
 
 
+def slo_violations(rec):
+    """Reference-free SLO gate over a CLEAN soak's embedded ``"slo"``
+    block (the "serving" block only — an "overload" block runs past
+    capacity by design and its alerts are the scenario working): a
+    clean soak whose live SLO engine fired any fast-burn alert fails
+    the round, same discipline as the guard/OVERLOAD gates. An alert
+    still ACTIVE at the end of the run (it never cleared during the
+    cool-down) fails at any severity — the condition outlived its
+    cause."""
+    block = rec.get("serving") if isinstance(rec, dict) else None
+    if not isinstance(block, dict) or not block.get("enabled"):
+        return []
+    slo = block.get("slo")
+    if not isinstance(slo, dict) or not slo.get("enabled"):
+        return []
+    out = []
+    fast = int(slo.get("fast_burn_alerts") or 0)
+    if fast > 0:
+        names = sorted({e.get("objective") for e in slo.get("events") or []
+                        if e.get("severity") == "fast_burn"
+                        and e.get("event") == "fire"})
+        out.append(f"{fast} fast-burn SLO alert(s) fired during a clean "
+                   f"soak ({', '.join(n for n in names if n) or '?'})")
+    active = slo.get("active") or []
+    if active:
+        out.append("SLO alert(s) still active at soak end: "
+                   + ", ".join(str(a) for a in active))
+    return out
+
+
 def overload_violations(rec):
     """Reference-free violation strings from one record's "overload"
     block (docs/SERVING.md "Overload & degradation"; emitted by
@@ -560,6 +590,12 @@ def main(argv=None):
         # scaling target + no lost requests (docs/SERVING.md)
         for v in serving_violations(rec):
             print(f"  SERVE {metric}: {v}", flush=True)
+            failed = True
+        # SLO gate (reference-free): a clean soak's embedded slo block
+        # reporting any fast-burn alert fails the round
+        # (docs/TELEMETRY.md "Time series, SLOs...")
+        for v in slo_violations(rec):
+            print(f"  SLO   {metric}: {v}", flush=True)
             failed = True
         # overload gate (reference-free): outcome conservation at 2x
         # capacity, admitted-p99 budget, shed ceiling, breaker flap

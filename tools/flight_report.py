@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Inspect flight-recorder forensics bundles and timeline JSONL files.
+
+Usage:
+    python tools/flight_report.py BUNDLE.json [...]        # validate + summarize
+    python tools/flight_report.py --diff OLD.json NEW.json # window deltas
+    python tools/flight_report.py --timeline TIMELINE.jsonl
+
+Validates every bundle against the ``ptpu-flight-1`` contract
+(paddle_tpu/telemetry/flight.py) and **exits 1 on any malformed file** —
+the CI hook: a crash path that writes unreadable forensics is itself a
+bug. HangWatchdog debris files are flight bundles too and validate the
+same way.
+
+Standalone by design: this tool loads ``telemetry/flight.py`` and
+``telemetry/timeseries.py`` directly by file path (they are pure-stdlib
+and import nothing from the package), so validating a bundle in CI never
+pays the paddle_tpu/jax import. ``tools/telemetry_report.py --timeline``
+reuses :func:`load_timeseries` for the same reason.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_TELEMETRY_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "paddle_tpu", "telemetry")
+
+
+def _load_by_path(name, filename):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_TELEMETRY_DIR, filename))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_flight():
+    """The flight module, loaded by path (no package import)."""
+    return _load_by_path("_ptpu_flight", "flight.py")
+
+
+def load_timeseries():
+    """The timeseries module (shared timeline JSONL reader)."""
+    return _load_by_path("_ptpu_timeseries", "timeseries.py")
+
+
+# ---------------------------------------------------------------------------
+# Bundle summaries
+# ---------------------------------------------------------------------------
+def _fmt_ts(ts):
+    try:
+        import datetime
+        return datetime.datetime.fromtimestamp(ts).strftime(
+            "%Y-%m-%d %H:%M:%S")
+    except (OverflowError, OSError, ValueError):
+        return str(ts)
+
+
+def summarize(bundle, path=""):
+    """Human summary lines for one validated bundle."""
+    lines = [f"flight bundle {path or '<dict>'}"]
+    lines.append(f"  reason      {bundle['reason']}"
+                 f"   pid {bundle['pid']}   seq {bundle.get('seq')}"
+                 f"   at {_fmt_ts(bundle['ts'])}")
+    ctx = bundle.get("context") or {}
+    if ctx:
+        kv = ", ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+        lines.append(f"  context     {kv}")
+    samples = bundle.get("samples") or []
+    lines.append(f"  samples     {len(samples)}"
+                 + (f"   ts {samples[0]['ts']:.3f}"
+                    f" .. {samples[-1]['ts']:.3f}" if samples else ""))
+    alerts = bundle.get("alerts") or []
+    lines.append(f"  alerts      {len(alerts)}")
+    for a in alerts[-8:]:
+        lines.append(f"    {a.get('event', '?'):5s} {a.get('objective')}"
+                     f" [{a.get('severity')}] burn="
+                     f"{a.get('burn_rate')} value={a.get('value')}")
+    events = bundle.get("events") or []
+    kinds = {}
+    for e in events:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+    lines.append("  events      " + (", ".join(
+        f"{k} x{n}" for k, n in sorted(kinds.items())) or "0"))
+    threads = bundle.get("threads") or {}
+    lines.append(f"  threads     {len(threads)}: "
+                 + ", ".join(sorted(t.split(':')[0] for t in threads)))
+    live = bundle.get("live_spans") or bundle.get("trace_spans") or {}
+    for tname, stack in sorted(live.items()):
+        if stack:
+            names = [s.get("name", "?") if isinstance(s, dict) else str(s)
+                     for s in stack]
+            lines.append(f"  open spans  {tname}: {' > '.join(names)}")
+    # legacy hang fields (debris files)
+    if "elapsed_seconds" in bundle:
+        lines.append(f"  hang        step {bundle.get('step')}: "
+                     f"{bundle.get('elapsed_seconds')}s elapsed vs "
+                     f"limit {bundle.get('limit_seconds')}s "
+                     f"(p50 {bundle.get('p50_step_seconds')})")
+    return lines
+
+
+def _window_stats(bundle):
+    return {"samples": len(bundle.get("samples") or []),
+            "alerts": len(bundle.get("alerts") or []),
+            "events": len(bundle.get("events") or []),
+            "trace_events": len(bundle.get("trace_events") or [])}
+
+
+def diff(old, new):
+    """Window-size and alert deltas between two bundles."""
+    lines = [f"flight diff: {old['reason']} (seq {old.get('seq')})"
+             f" -> {new['reason']} (seq {new.get('seq')}),"
+             f" dt {new['ts'] - old['ts']:.3f}s"]
+    so, sn = _window_stats(old), _window_stats(new)
+    for k in sorted(so):
+        lines.append(f"  {k:14s} {so[k]:6d} -> {sn[k]:6d}"
+                     f"  ({sn[k] - so[k]:+d})")
+
+    def _alert_keys(b):
+        return {(a.get("objective"), a.get("severity"), a.get("event"))
+                for a in b.get("alerts") or []}
+    fresh = _alert_keys(new) - _alert_keys(old)
+    for key in sorted(fresh, key=str):
+        lines.append(f"  new alert     {key[2]} {key[0]} [{key[1]}]")
+    return lines
+
+
+def summarize_timeline(path, ts_mod):
+    samples = ts_mod.read_timeline(path)
+    lines = [f"timeline {path}: {len(samples)} samples"]
+    if samples:
+        lines.append(f"  ts {samples[0]['ts']:.3f}"
+                     f" .. {samples[-1]['ts']:.3f}")
+        keys = ts_mod.timeline_keys(samples)
+        lines.append(f"  signals ({len(keys)}):")
+        for k in keys:
+            lines.append(f"    {k}")
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="flight bundle JSON files (or a timeline "
+                    "JSONL with --timeline)")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff exactly two bundles")
+    ap.add_argument("--timeline", action="store_true",
+                    help="treat paths as timeline JSONL files")
+    ap.add_argument("--quiet", action="store_true",
+                    help="validate only, print problems only")
+    args = ap.parse_args(argv)
+
+    if args.timeline:
+        ts_mod = load_timeseries()
+        status = 0
+        for p in args.paths:
+            try:
+                for line in summarize_timeline(p, ts_mod):
+                    print(line)
+            except (OSError, ValueError) as e:
+                print(f"MALFORMED {p}: {e}", file=sys.stderr)
+                status = 1
+        return status
+
+    fl = load_flight()
+    bundles = []
+    status = 0
+    for p in args.paths:
+        try:
+            bundles.append((p, fl.load_bundle(p)))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"MALFORMED {p}: {e}", file=sys.stderr)
+            status = 1
+    if status:
+        return status
+    if args.diff:
+        if len(bundles) != 2:
+            print("--diff needs exactly two bundles", file=sys.stderr)
+            return 2
+        for line in diff(bundles[0][1], bundles[1][1]):
+            print(line)
+        return 0
+    for p, b in bundles:
+        if args.quiet:
+            print(f"OK {p}")
+        else:
+            for line in summarize(b, p):
+                print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

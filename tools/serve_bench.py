@@ -94,6 +94,12 @@ def main(argv=None):
                     help="requests in the overload scenario (default: "
                     "same as --requests)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeline-dir", default=None,
+                    help="record a per-tick timeline JSONL per soak "
+                    "into this directory (serve_rN.jsonl / "
+                    "serve_overload_rN.jsonl) and run the SLO engine "
+                    "live — the blocks then embed 'timeline' and 'slo' "
+                    "sub-blocks (docs/TELEMETRY.md)")
     args = ap.parse_args(argv)
 
     import jax
@@ -173,12 +179,16 @@ def main(argv=None):
         if budget is None and baseline is not None:
             p50 = (baseline.get("ttft") or {}).get("p50")
             budget = args.ttft_budget_x * p50 if p50 else None
+        timeline = (os.path.join(args.timeline_dir,
+                                 f"serve_r{n}.jsonl")
+                    if args.timeline_dir else None)
         block = soak_block(
             model, replicas=n, workload=workload, policy=args.policy,
             disagg=args.disagg, draft_model=draft, engine_kw=engine_kw,
             disagg_kw=disagg_kw, baseline=baseline,
             scaling_target=(args.scaling_target if n > 1 else None),
-            ttft_budget=(budget if n > 1 or args.ttft_budget else None))
+            ttft_budget=(budget if n > 1 or args.ttft_budget else None),
+            timeline_path=timeline)
         if baseline is None:
             baseline = block
         print(json.dumps({
@@ -229,7 +239,10 @@ def main(argv=None):
             model, replicas=n, workload=wl, overload_cfg=ov_cfg,
             policy=args.policy, engine_kw=ov_engine_kw,
             chaos_wrap={0: wrap}, ttft_budget=2.0 * slo,
-            shed_ceiling=0.9, rate_x_capacity=args.overload_x)
+            shed_ceiling=0.9, rate_x_capacity=args.overload_x,
+            timeline_path=(os.path.join(
+                args.timeline_dir, f"serve_overload_r{n}.jsonl")
+                if args.timeline_dir else None))
         # bound the breaker flap count by the fault bursts the chaos
         # schedule actually fired: at most two opens per down-phase
         # (threshold-crossing + one failed half-open probe inside the

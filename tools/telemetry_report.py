@@ -332,15 +332,81 @@ def diff_snapshots(old, new, top=15, out=None):
     return rows
 
 
+def _timeseries_mod():
+    """The shared timeline JSONL reader, via tools/flight_report.py's
+    by-path loader (no paddle_tpu/jax import — same discipline as the
+    rest of this tool)."""
+    try:
+        from tools import flight_report
+    except ImportError:
+        import flight_report
+    return flight_report.load_timeseries()
+
+
+def print_timeline(path, top=15):
+    """Per-metric delta/rate table between consecutive timeline samples:
+    for every counter, the total delta across the file and the mean/max
+    per-second rate; for every values/gauges signal, min/mean/max/last.
+    """
+    ts_mod = _timeseries_mod()
+    samples = ts_mod.read_timeline(path)
+    print(f"timeline {path}: {len(samples)} samples"
+          + (f", ts {samples[0]['ts']:.3f} .. {samples[-1]['ts']:.3f}"
+             if samples else ""))
+    if not samples:
+        return
+    counter_keys = ts_mod.timeline_keys(samples, group="counters")
+    rows = []
+    for k in counter_keys:
+        deltas = ts_mod.series_from(samples, f"counters:{k}:delta")
+        rates = ts_mod.series_from(samples, f"counters:{k}:rate")
+        if not deltas:
+            continue
+        total = sum(v for _, v in deltas)
+        rvals = [v for _, v in rates]
+        rows.append((k, total, sum(rvals) / len(rvals) if rvals else 0.0,
+                     max(rvals) if rvals else 0.0))
+    rows.sort(key=lambda r: -abs(r[1]))
+    if rows:
+        print(f"\n  {'counter':44s} {'delta':>12s} {'rate/s mean':>12s}"
+              f" {'rate/s max':>12s}")
+        for k, total, mean_r, max_r in rows[:top]:
+            print(f"  {k[:44]:44s} {total:12.6g} {mean_r:12.6g}"
+                  f" {max_r:12.6g}")
+    for group in ("values", "gauges"):
+        keys = ts_mod.timeline_keys(samples, group=group)
+        rows = []
+        for k in keys:
+            vals = [v for _, v in ts_mod.series_from(samples,
+                                                     f"{group}:{k}")]
+            if vals:
+                rows.append((k, min(vals), sum(vals) / len(vals),
+                             max(vals), vals[-1]))
+        if rows:
+            print(f"\n  {group + ':':44s} {'min':>10s} {'mean':>10s}"
+                  f" {'max':>10s} {'last':>10s}")
+            for k, lo, mean, hi, last in rows[:top * 2]:
+                print(f"  {k[:44]:44s} {lo:10.4g} {mean:10.4g}"
+                      f" {hi:10.4g} {last:10.4g}")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("snapshot", help="telemetry snapshot or bench JSON")
+    ap.add_argument("snapshot", help="telemetry snapshot or bench JSON "
+                    "(a timeline JSONL with --timeline)")
     ap.add_argument("other", nargs="?",
                     help="second snapshot: diff mode (old=first, new=second)")
     ap.add_argument("--top", type=int, default=15,
                     help="diff mode: how many regressed metrics to show")
+    ap.add_argument("--timeline", action="store_true",
+                    help="the input is a timeline JSONL (recorded by "
+                    "TimeSeriesRecorder / a soak / bench.py --record): "
+                    "print per-metric delta/rate columns between "
+                    "consecutive samples")
     args = ap.parse_args(argv)
-    if args.other is None:
+    if args.timeline:
+        print_timeline(args.snapshot, top=args.top)
+    elif args.other is None:
         print_snapshot(load_snapshot(args.snapshot))
     else:
         diff_snapshots(load_snapshot(args.snapshot),
