@@ -184,6 +184,7 @@ def run_model(model_kind, ckpt=None):
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLMPipe
     import paddle_tpu.nn.functional as F
+    from paddle_tpu import quant as _pquant
 
     # full-run telemetry: op dispatch, collectives, compile events, and
     # step timing all land in the snapshot attached to the bench JSON, so
@@ -367,25 +368,47 @@ def run_model(model_kind, ckpt=None):
 
     from paddle_tpu import memory as pmem
 
+    # quant-compute axis (docs/QUANT.md): every grid candidate also
+    # REQUESTS the scaled fp8/int8 GEMM mode (`quant:all` entries appended
+    # to its names: policy). The request creates the amax buffer and rides
+    # the plan-cache key; trace-time ENGAGEMENT still resolves behind the
+    # parity gate / CPU default-off / PTPU_QUANT_COMPUTE, so a red gate
+    # prices and runs the same wide programs with a passthrough buffer.
+    # PTPU_BENCH_QUANT=0 drops the request (no buffer — the structural
+    # escape hatch, hex-identical to the pre-quant programs).
+    env_bquant = os.environ.get("PTPU_BENCH_QUANT", "").strip().lower()
+    quant_grid = (None,) if env_bquant in ("0", "off") else ("all",)
+
+    def _quant_policy(policy, q):
+        # the request rides the names: policy (models/gpt.py
+        # _resolve_remat strips + resolves it); other policies can't
+        # carry quant entries
+        return (f"{policy},quant:{q}"
+                if q and str(policy).startswith("names:") else policy)
+
     if env_batch and env_remat:
         # reproduce path: only pin the head chunk when the sweep pinned it
-        # too — otherwise keep the kernel default the recorded round used
+        # too — otherwise keep the kernel default the recorded round used.
+        # The explicit policy is taken verbatim (carry your own quant:
+        # entries to reproduce a quantized round).
         candidates = [pmem.Candidate(
             int(env_batch), env_remat,
             head_chunk=int(env_hchunk) if env_hchunk else None)]
         require_fit = False  # trust the sweep; still price + record it
     else:
         candidates = [
-            pmem.Candidate(b, p, head_chunk=hc)
+            pmem.Candidate(b, p, head_chunk=hc, quant=q)
             for b in ((int(env_batch),) if env_batch else batch_grid)
             for p in ((env_remat,) if env_remat else policy_grid)
             for hc in hchunk_grid
+            for q in quant_grid
         ]
         require_fit = True
 
     def step_factory(cand):
-        cfg.recompute = cand.policy != "none"
-        cfg.recompute_policy = cand.policy
+        pol = _quant_policy(cand.policy, getattr(cand, "quant", None))
+        cfg.recompute = pol != "none"
+        cfg.recompute_policy = pol
         cfg.head_chunk = cand.head_chunk
         s = make_step()
         return s, (jax.ShapeDtypeStruct((cand.batch, seq), jax.numpy.int32),
@@ -426,8 +449,16 @@ def run_model(model_kind, ckpt=None):
                   # region layout, slot shapes, gather seams) —
                   # docs/ZERO.md
                   "PTPU_ZERO_MODE", "PTPU_ZERO_JIT_GATHER",
-                  "PTPU_QUANT_PARAM_GATHER")
-    ) + (("int8_head", F.int8_head_enabled()),)  # gate outcome, not just env
+                  "PTPU_QUANT_PARAM_GATHER",
+                  # quant-compute knobs: a plan priced with wide GEMMs
+                  # must not replay across a PTPU_QUANT_COMPUTE flip
+                  # (planner.py also keys on quant.cache_key_knobs() —
+                  # belt + suspenders, docs/QUANT.md)
+                  "PTPU_QUANT_COMPUTE", "PTPU_QUANT_DTYPE",
+                  "PTPU_QUANT_AMAX_HIST", "PTPU_QUANT_GATE_TOL",
+                  "PTPU_INT8_WEIGHTS", "PTPU_BENCH_QUANT")
+    ) + (("int8_head", F.int8_head_enabled()),  # gate outcome, not just env
+         ("quant_gate", _pquant.quant_gate()))
     # ZeRO pricing record (docs/ZERO.md): the candidate programs compile
     # ON the sharded mesh, so their memory_analysis peak is already
     # per-device — analytic pools stay 0 and only stage/degree ride the
@@ -449,7 +480,8 @@ def run_model(model_kind, ckpt=None):
                      "bf16" if on_tpu else "f32", mem_envs))
     batch = decision.batch
     cfg.recompute = decision.policy != "none"
-    cfg.recompute_policy = decision.policy
+    cfg.recompute_policy = _quant_policy(decision.policy,
+                                         getattr(decision, "quant", None))
     cfg.head_chunk = decision.head_chunk
 
     # NOTE: on a plan-cache miss the winning program compiles twice (once
@@ -610,6 +642,31 @@ def run_model(model_kind, ckpt=None):
     comms = _coll.comms_summary(
         telemetry.snapshot(),
         parity=_coll.parity_probe(_active_mesh()))
+
+    # "quant" block (docs/QUANT.md): the scaled fp8/int8 GEMM state of
+    # THIS run — the request (candidate quant axis -> policy quant:
+    # entries), the trace-time engagement verdict (compose's quant_gemm
+    # plan row: engaged, or the structured decline reason), the numeric
+    # parity-gate report, and an embedded reference-free loss-drift A/B
+    # (exact vs scaled training on a fixed tiny problem, quant.gemm
+    # loss_drift_probe) that tools/bench_gate.py's QUANT gate checks
+    # against the 0.5% budget — no baseline file needed, like the comms
+    # parity probe above.
+    from paddle_tpu.distributed.collectives import compose as _compose_q
+
+    _qv = _compose_q.last_verdicts().get("quant_gemm")
+    _q_requested = bool(getattr(decision, "quant", None))
+    quant_block = {
+        "requested": _q_requested,
+        "dtype": _pquant.quant_dtype(),
+        "engaged": bool(_qv and _qv[0] == "engaged"),
+        "verdict": _qv[0] if _qv else None,
+        "reason": _qv[1] if _qv else None,
+        "gate": _pquant.quant_gate_report(),
+        "loss_drift_rel": round(float(_pquant.loss_drift_probe()), 6),
+        "loss_drift_budget": 0.005,
+        "amax_hist_len": _pquant.amax_hist_len(),
+    }
 
     # "zero" block (docs/ZERO.md): the ZeRO execution state of THIS run —
     # stage/degree always recorded; when the plan engaged, the per-step
@@ -796,6 +853,10 @@ def run_model(model_kind, ckpt=None):
         # comms traffic split + parity probe (mirrors "telemetry"/
         # "memory"; contract in docs/COMMS.md, gated by bench_gate)
         "comms": comms,
+        # low-precision compute state: request/engagement/decline, the
+        # parity-gate report, and the embedded loss-drift A/B vs the
+        # 0.5% budget (docs/QUANT.md; bench_gate QUANT gate)
+        "quant": quant_block,
         # ZeRO execution state: stage, shard degree, gathered/rs bytes
         # per step (docs/ZERO.md contract)
         "zero": zero_block,

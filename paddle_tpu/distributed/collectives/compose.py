@@ -103,6 +103,11 @@ class Reason(str, enum.Enum):
     PIPELINE_OFF = "pipeline_schedule_off"
     INTERLEAVE = "interleave_not_composed"
     LAYERS_INDIVISIBLE = "layers_indivisible_by_pp"
+    QUANT_GATE = "quant_parity_gate"
+    QUANT_SEAM = "tp_seam_owns_gemm"
+    QUANT_FUSED_FFN = "fused_kernel_owns_gemm"
+    QUANT_PIPELINE = "pipeline_stage_fn"
+    QUANT_COMPOSED = "composed_region"
 
 
 #: human strings for the enum (the "enum + human string" contract)
@@ -146,6 +151,15 @@ REASON_TEXT = {
     Reason.INTERLEAVE: "interleaved (VPP) storage layout is not "
                        "composable yet",
     Reason.LAYERS_INDIVISIBLE: "num_layers not divisible by pp",
+    Reason.QUANT_GATE: "numeric parity probe failed (or CPU default-off) — "
+                       "scaled GEMMs stay wide",
+    Reason.QUANT_SEAM: "engaged tp seams own the row/col matmul layouts "
+                       "(PR 6/7 precedence)",
+    Reason.QUANT_FUSED_FFN: "a fused FFN kernel (swiglu_down / _ffn_i8) "
+                            "owns these GEMMs",
+    Reason.QUANT_PIPELINE: "pipeline stage_fn does not thread amax state",
+    Reason.QUANT_COMPOSED: "composed manual region does not thread amax "
+                           "state",
 }
 
 

@@ -82,12 +82,26 @@ def param_gather_quantized():
     the PR 6 int8 all-gather (codes + f32 scales on the wire, ~1B/elem).
     Default OFF — unlike gradient traffic, int8 params perturb the
     forward, so the exact gather is the default and the bitwise-parity
-    contract. Master switch (``PTPU_QUANT_COLLECTIVES``) also gates."""
+    contract. Master switch (``PTPU_QUANT_COLLECTIVES``) also gates.
+
+    Stacking rule (docs/QUANT.md): with the knob UNSET and quantized
+    compute force-engaged (``PTPU_QUANT_COMPUTE`` truthy), the int8
+    gathers ride along — the forward already runs narrow scaled GEMMs,
+    so int8 param perturbation is inside the mode's numerics contract
+    and stage-3 traffic halves for free. An explicit ``0``/``off``
+    always wins."""
     from . import quant_collectives_enabled
 
-    return (quant_collectives_enabled()
-            and os.environ.get("PTPU_QUANT_PARAM_GATHER", "")
-            not in ("", "0", "off"))
+    if not quant_collectives_enabled():
+        return False
+    env = os.environ.get("PTPU_QUANT_PARAM_GATHER", "")
+    if env not in ("", "0", "off"):
+        return True
+    if env in ("0", "off"):
+        return False
+    from ...quant import quant_compute_forced
+
+    return quant_compute_forced()
 
 
 def flat_padded_len(numel, degree, *, quantized, block=QUANT_BLOCK):

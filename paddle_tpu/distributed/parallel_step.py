@@ -1154,6 +1154,13 @@ class ShardedTrainStep(TrainStep):
         else:
             note_grad_reduce(self._reduce_plan)
             note_zero_step(self._reduce_plan)
+        # quant-compute flops accounting (docs/QUANT.md): per-step tick at
+        # the rate the last engaged trace recorded (global batch tokens)
+        from ..quant import note_step_tokens
+
+        shape = getattr(raw_batch[0], "shape", ()) if raw_batch else ()
+        note_step_tokens(int(shape[0]) * int(shape[1])
+                         if len(shape) >= 2 else 0)
         return Tensor(loss)
 
 

@@ -7,53 +7,24 @@ MXU run the narrow matmul (fp8 ops double the MXU rate on fp8-capable
 TPUs; on older chips XLA upcasts, keeping the path portable). The
 backward runs in the ORIGINAL dtype (bf16/fp32) through a custom_vjp —
 the standard fp8-training recipe (forward narrow, gradients wide).
+
+The numerics live in :mod:`paddle_tpu.quant.gemm` — one shared quantizer
+implementation (the int8-head discipline): this module keeps only the
+paddle-flavoured ``apply_op`` entry points, the scale-clamp epsilon is the
+repo-wide ``memory.SCALE_EPS``, and the per-call inline absmax is the
+shared delayed-scaling core run with an empty history (it bootstraps from
+the current step's amax, which *is* the inline recipe).
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
 from ....core.dispatch import apply_op
-
-E4M3_MAX = 448.0
-
-
-def _quantize(a):
-    """Per-tensor absmax scaling into e4m3. Returns (q, scale)."""
-    amax = jnp.max(jnp.abs(a.astype(jnp.float32)))
-    scale = jnp.maximum(amax / E4M3_MAX, 1e-12)
-    q = (a.astype(jnp.float32) / scale).astype(jnp.float8_e4m3fn)
-    return q, scale
+from ....quant.gemm import E4M3_MAX, inline_scaled_gemm  # noqa: F401
 
 
-@jax.custom_vjp
 def _fp8_matmul(x, w):
-    qx, sx = _quantize(x)
-    qw, sw = _quantize(w)
-    out = jnp.matmul(qx, qw, preferred_element_type=jnp.float32)
-    return (out * (sx * sw)).astype(x.dtype)
-
-
-def _fp8_fwd(x, w):
-    return _fp8_matmul(x, w), (x, w)
-
-
-def _fp8_bwd(res, g):
-    x, w = res
-    # wide backward: dgrad/wgrad precision limits fp8 training far more
-    # than the forward does
-    gw = g.astype(jnp.float32)
-    dx = jnp.matmul(gw, jnp.swapaxes(w.astype(jnp.float32), -1, -2))
-    xw = x.astype(jnp.float32)
-    x2 = xw.reshape(-1, xw.shape[-1])
-    g2 = gw.reshape(-1, gw.shape[-1])
-    dw = jnp.matmul(x2.T, g2)
-    return dx.astype(x.dtype), dw.astype(w.dtype)
-
-
-_fp8_matmul.defvjp(_fp8_fwd, _fp8_bwd)
+    return inline_scaled_gemm(x, w, dtype="fp8")
 
 
 def fp8_gemm(x, y, transpose_x=False, transpose_y=False, name=None):

@@ -112,6 +112,8 @@ def _engine_stats(eng):
                 "handoffs": eng.handoffs,
                 "handoff_bytes": eng.handoff_bytes,
                 "int8_kv": d.int8_kv,
+                "int8_weights": d.int8_weights,
+                "weight_bytes": dict(d.weight_bytes),
                 "spec": _spec_stats(d)}
     return {"disaggregated": False,
             "preemptions": eng.preemptions,
@@ -119,6 +121,8 @@ def _engine_stats(eng):
             "cancellations": eng.cancellations,
             "handoffs": 0, "handoff_bytes": 0,
             "int8_kv": eng.int8_kv,
+            "int8_weights": eng.int8_weights,
+            "weight_bytes": dict(eng.weight_bytes),
             "spec": _spec_stats(eng)}
 
 

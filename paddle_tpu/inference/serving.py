@@ -98,6 +98,11 @@ _INT8_KV = _telemetry.gauge(
     "serving_int8_kv_active",
     "1 when the engine stores paged KV as blockwise int8 (+fp32 "
     "per-row scales in the page table) — docs/SERVING.md")
+_WEIGHT_BYTES = _telemetry.gauge(
+    "serving_weight_bytes",
+    "resident packed decode-weight bytes per storage dtype "
+    "(docs/QUANT.md: int8-packed replicas report the reduced footprint)",
+    labelnames=("dtype",))
 
 
 # ---------------------------------------------------------------- int8 KV
@@ -275,6 +280,52 @@ def _kv_nbytes(c):
 
 _DECODE_WEIGHT_NAMES = ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg",
                         "wu", "wd")
+#: the 7 projection slabs eligible for int8-resident packing (norms stay
+#: exact: they are cheap, and their dynamic range is the worst int8 fit)
+_QUANT_WEIGHT_NAMES = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+
+
+def _wmat(x, w):
+    """``x @ W`` for one packed decode weight: exact slabs multiply
+    directly; int8-resident ``(codes, scales)`` pairs take the
+    dequant-free int8 x int8 -> int32 GEMM (quant.int8_weight_matmul) —
+    the weights are never expanded back to wide dtype."""
+    if isinstance(w, tuple):
+        from ..quant import int8_weight_matmul
+
+        return int8_weight_matmul(x, *w)
+    return x @ w
+
+
+def _layer_slice(w, li):
+    """Per-layer view of one stacked weight entry (tuple-aware: an
+    int8-packed entry slices codes and scales together)."""
+    if isinstance(w, tuple):
+        return (w[0][li], w[1][li])
+    return w[li]
+
+
+def _weight_nbytes(weights):
+    """Resident bytes of the packed decode tree, keyed by storage dtype —
+    the ``serving_weight_bytes{dtype}`` footprint. int8-packed layers
+    split between their int8 codes and f32 scale rows."""
+    out = {}
+
+    def add(a):
+        if a is None:
+            return
+        if isinstance(a, tuple):
+            for x in a:
+                add(x)
+            return
+        key = str(a.dtype)
+        out[key] = out.get(key, 0) + int(a.nbytes)
+
+    for w in weights["layers"]:
+        add(w)
+    for n in ("embed", "fnorm", "head"):
+        add(weights[n])
+    return out
 
 
 def _run_layer_stack(scan_layers, layers, x, layer_fn, kc, vc):
@@ -297,7 +348,7 @@ def _run_layer_stack(scan_layers, layers, x, layer_fn, kc, vc):
         return x, kc, vc
     kls, vls = [], []
     for li in range(layers[0].shape[0]):
-        x, kl, vl = layer_fn(tuple(w[li] for w in layers), x,
+        x, kl, vl = layer_fn(tuple(_layer_slice(w, li) for w in layers), x,
                              _kv_index(kc, li), _kv_index(vc, li))
         kls.append(kl)
         vls.append(vl)
@@ -417,8 +468,8 @@ class ContinuousBatchingEngine:
                  max_seq_len=None, max_new_tokens=32, eos_token_id=None,
                  seed=0, prefill_chunk=None, preempt_policy="recompute",
                  enable_prefix_cache=False, int8_kv=False,
-                 draft_model=None, spec_tokens=4, prefill_only=False,
-                 rid_base=0):
+                 int8_weights=False, draft_model=None, spec_tokens=4,
+                 prefill_only=False, rid_base=0):
         import jax
         import jax.numpy as jnp
 
@@ -439,6 +490,17 @@ class ContinuousBatchingEngine:
 
         hd = cfg.hidden_size // cfg.num_heads
         self.hd, self.hkv = hd, cfg.num_kv_heads
+
+        # int8 resident weights (docs/QUANT.md): the 7 projection slabs
+        # pack as per-output-column int8 codes + f32 scales and every
+        # decode/prefill GEMM runs int8 x int8 -> int32 without ever
+        # dequantizing the weights (~4x less weight HBM per replica vs
+        # f32). Engages only behind the round-trip probe;
+        # PTPU_INT8_WEIGHTS=0 is the exact escape hatch. Resolved BEFORE
+        # the pack below, which reads the flag.
+        from ..quant import int8_weights_enabled
+
+        self.int8_weights = int8_weights_enabled(int8_weights)
 
         self._model = model
         self._weights = self._pack_weights(model)
@@ -605,8 +667,7 @@ class ContinuousBatchingEngine:
         self.prefill_chunk_cap = None  # L3: per-tick prefill token
                                        #     budget (output-invariant)
 
-    @staticmethod
-    def _pack_weights(model):
+    def _pack_weights(self, model):
         # the decode contract: `_decode_params()` (per-layer weight dicts,
         # llama.py:66 / gpt.py GPTForCausalLMPipe) + embed/final_norm on
         # the model or its `.model` core + optional untied `lm_head`.
@@ -616,12 +677,31 @@ class ContinuousBatchingEngine:
         # (the decoder's [L, ...] arrays are referenced as-is); per-layer
         # models stack their slices (one transient per-layer copy during
         # the stack, then only the stacked copy is retained).
-        return _pack_weights_stacked(model)
+        #
+        # int8_weights: the 7 projection slabs are re-packed as
+        # (codes int8 [L, h, n], scales f32 [L, 1, n]) tuples — embed,
+        # norms and head stay exact (embed also fixes the engine's KV
+        # dtype). The stacked zero-copy reference is given up for ~4x
+        # less resident bytes; per-dtype footprint lands in
+        # self.weight_bytes and serving_weight_bytes{dtype}.
+        w = _pack_weights_stacked(model)
+        if self.int8_weights:
+            from ..quant import quantize_weight_cols_int8
+
+            w["layers"] = tuple(
+                quantize_weight_cols_int8(arr)
+                if name in _QUANT_WEIGHT_NAMES else arr
+                for name, arr in zip(_DECODE_WEIGHT_NAMES, w["layers"]))
+        self.weight_bytes = _weight_nbytes(w)
+        for dt, nb in self.weight_bytes.items():
+            _WEIGHT_BYTES.set(float(nb), labels=(dt,))
+        return w
 
     @staticmethod
     def _layer_tuple(weights, li):
-        """Per-layer 9-tuple view of the stacked weight tree."""
-        return tuple(w[li] for w in weights["layers"])
+        """Per-layer 9-tuple view of the stacked weight tree
+        (int8-packed entries slice to per-layer (codes, scales))."""
+        return tuple(_layer_slice(w, li) for w in weights["layers"])
 
     def reload_weights(self, model=None):
         """Re-read weights from the model (e.g. after an in-place update);
@@ -677,14 +757,14 @@ class ContinuousBatchingEngine:
         ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
         B, S = x.shape[:2]
         h = _rms_pure(x, ln1)
-        q = (h @ wq).reshape(B, S, self.cfg.num_heads, self.hd)
-        k = (h @ wk).reshape(B, S, self.hkv, self.hd)
-        v = (h @ wv).reshape(B, S, self.hkv, self.hd)
+        q = _wmat(h, wq).reshape(B, S, self.cfg.num_heads, self.hd)
+        k = _wmat(h, wk).reshape(B, S, self.hkv, self.hd)
+        v = _wmat(h, wv).reshape(B, S, self.hkv, self.hd)
         q, k = self._rope(q, pos0), self._rope(k, pos0)
         o = attend(li, q, k, v)                       # [B, S, Hq, D]
-        x = x + o.reshape(B, S, -1) @ wo
+        x = x + _wmat(o.reshape(B, S, -1), wo)
         h2 = _rms_pure(x, ln2)
-        return x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+        return x + _wmat(jax.nn.silu(_wmat(h2, wg)) * _wmat(h2, wu), wd)
 
     def _head_tokens(self, last, reqs):
         """final-norm'd last hidden rows [B, H] -> first token per req."""
@@ -1752,6 +1832,11 @@ class ContinuousBatchingEngine:
             "occupied_slots": occupied,
             "free_slots": self.max_slots - occupied,
             "kv_free_fraction": self.pool.available / self.pool.num_pages,
+            # per-replica resident decode-weight footprint by storage
+            # dtype (docs/QUANT.md): int8-packed replicas report the
+            # reduced bytes a placement router can pack against
+            "int8_weights": self.int8_weights,
+            "weight_bytes": dict(self.weight_bytes),
         }
 
     def prefix_match_pages(self, tokens):

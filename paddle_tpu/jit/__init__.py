@@ -961,6 +961,13 @@ class TrainStep:
         if self.optimizer._lr_scheduler is not None:
             pass  # stepped by the caller per paddle convention
         self.optimizer._step_count += 1
+        # quant-compute flops accounting (docs/QUANT.md): one counter tick
+        # per executed step, rate recorded by the last engaged trace
+        from ..quant import note_step_tokens
+
+        shape = getattr(raw_batch[0], "shape", ()) if raw_batch else ()
+        note_step_tokens(int(shape[0]) * int(shape[1])
+                         if len(shape) >= 2 else 0)
         return Tensor(loss)
 
     def _guard_operand(self):

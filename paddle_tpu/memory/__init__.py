@@ -18,6 +18,7 @@ See docs/MEMORY.md for the policy syntax, knobs, and JSON contract.
 from .int8_ckpt import (  # noqa: F401
     INT8_BLOCK,
     KERNEL_ANCHORS,
+    SCALE_EPS,
     dequantize_blockwise_int8,
     dequantize_rows_int8,
     int8_checkpoint,
@@ -40,7 +41,7 @@ from .planner import (  # noqa: F401
 )
 
 __all__ = [
-    "INT8_BLOCK", "KERNEL_ANCHORS",
+    "INT8_BLOCK", "KERNEL_ANCHORS", "SCALE_EPS",
     "quantize_blockwise_int8", "dequantize_blockwise_int8",
     "quantize_rows_int8", "dequantize_rows_int8",
     "int8_checkpoint", "int8_saved_nbytes", "parse_save_names",

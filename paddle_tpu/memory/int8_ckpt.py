@@ -41,6 +41,11 @@ from jax.ad_checkpoint import checkpoint_name
 #: moment blocks, optimizer/__init__.py _Q8_BLOCK)
 INT8_BLOCK = 256
 
+#: absmax scale floor shared by every quantizer in the repo (blockwise int8
+#: saves here, the serving KV rows, incubate fp8, and paddle_tpu/quant) — an
+#: all-zero tensor divides by this instead of 0 and round-trips to exact 0.
+SCALE_EPS = 1e-12
+
 
 def quantize_blockwise_int8(x, block=INT8_BLOCK):
     """Blockwise absmax int8: flatten, pad to a block multiple, one fp32
@@ -52,7 +57,7 @@ def quantize_blockwise_int8(x, block=INT8_BLOCK):
         xf = jnp.concatenate([xf, jnp.zeros((pad,), jnp.float32)])
     xb = xf.reshape(-1, block)
     s = jnp.maximum(jnp.max(jnp.abs(xb), axis=-1, keepdims=True) / 127.0,
-                    1e-12)
+                    SCALE_EPS)
     q = jnp.clip(jnp.round(xb / s), -127, 127).astype(jnp.int8)
     return q, s
 
@@ -66,7 +71,7 @@ def dequantize_blockwise_int8(q, s, shape, dtype):
     return xf[:n].reshape(shape).astype(dtype)
 
 
-def quantize_rows_int8(x, eps=1e-12):
+def quantize_rows_int8(x, eps=SCALE_EPS):
     """Absmax int8 over the LAST axis: one fp32 scale per row.
 
     The paged-KV grid (docs/SERVING.md): the serving engine's int8 KV

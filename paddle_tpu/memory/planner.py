@@ -72,6 +72,12 @@ class Candidate:
     score: float | None = None
     head_chunk: int | None = None
     depth: int | None = None
+    #: quant-compute site spec ("all"/"attn"/"ffn"/comma-joined sites,
+    #: None = wide GEMMs): the step_factory appends the matching
+    #: ``quant:`` entries to the candidate's names: policy, making
+    #: narrow-vs-wide compute a planner axis like batch x remat
+    #: (docs/QUANT.md)
+    quant: str | None = None
 
 
 @dataclasses.dataclass
@@ -91,6 +97,9 @@ class PlanDecision:
     candidates: list = dataclasses.field(default_factory=list)
     head_chunk: int | None = None
     depth: int | None = None
+    #: winning candidate's quant-compute site spec (Candidate.quant) —
+    #: the caller re-applies it to the policy it builds with
+    quant: str | None = None
     #: ZeRO pricing record (docs/ZERO.md): {"stage", "degree", analytic
     #: byte pools, "hbm_savings_bytes"} — None when no zero info passed
     zero: dict | None = None
@@ -350,7 +359,7 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
                                              getattr(c, "head_chunk", None))),
         reverse=True)
     grid = [(c.batch, c.policy, getattr(c, "head_chunk", None),
-             getattr(c, "depth", None))
+             getattr(c, "depth", None), getattr(c, "quant", None))
             for c in order]
     # the key must carry the scan/unroll mode: a decision priced under
     # the depth-flat scanned program replayed for an unrolled build (or
@@ -366,9 +375,14 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
     savings = zero_hbm_savings(zero)
     zero_key = (tuple(sorted((k, int(v or 0)) for k, v in zero.items()))
                 if zero else None)
+    # every quant-compute knob rides in the key: a cached decision priced
+    # with wide GEMMs must not replay across a PTPU_QUANT_COMPUTE flip
+    # (the same staleness class as scan_mode above — docs/QUANT.md)
+    from ..quant import cache_key_knobs as _quant_knobs
+
     key = hashlib.sha1(repr(
         (chip, ndev, budget, tuple(cache_extra), grid, require_fit,
-         scan_mode, zero_key)
+         scan_mode, zero_key, _quant_knobs())
     ).encode()).hexdigest()[:16]
 
     cpath = _cache_path(cache_path)
@@ -398,6 +412,7 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
             evaluated.append({"batch": cand.batch, "policy": cand.policy,
                               "head_chunk": getattr(cand, "head_chunk", None),
                               "depth": getattr(cand, "depth", None),
+                              "quant": getattr(cand, "quant", None),
                               "score": score, "error": str(e)[:200]})
             continue
         # zero pricing: the sharded stages free (1 - 1/degree) of the
@@ -407,6 +422,7 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
         evaluated.append({"batch": cand.batch, "policy": cand.policy,
                           "head_chunk": getattr(cand, "head_chunk", None),
                           "depth": getattr(cand, "depth", None),
+                          "quant": getattr(cand, "quant", None),
                           "score": score, "peak_bytes": mem["peak_bytes"],
                           "fits": fits})
         if fits or not require_fit:
@@ -422,6 +438,7 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
         batch=cand.batch, policy=cand.policy,
         head_chunk=getattr(cand, "head_chunk", None),
         depth=getattr(cand, "depth", None),
+        quant=getattr(cand, "quant", None),
         peak_bytes=int(mem["peak_bytes"]), budget_bytes=int(budget),
         fits=bool(fits), score=float(score),
         source="planner" if require_fit else "env-override",

@@ -238,6 +238,12 @@ class GPTModel(nn.Layer):
         self.layers = nn.LayerList([factory() for _ in range(config.num_layers)])
         norm_cls = nn.RMSNorm if config.norm_type == "rmsnorm" else nn.LayerNorm
         self.final_norm = norm_cls(config.hidden_size)
+        # quant-compute amax state (docs/QUANT.md) — only threaded on the
+        # shared-scan path (_run_stacked); the per-layer module loop
+        # never quantizes (its matmuls live inside nn.Linear)
+        amax0 = _quant_buffer_state(config)
+        if amax0 is not None:
+            self.register_buffer("quant_amax", amax0)
 
     def forward(self, input_ids, attn_mask=None):
         x = self.embed_tokens(input_ids)
@@ -324,23 +330,59 @@ class GPTModel(nn.Layer):
                    "mlp.down_proj.weight": l.mlp.down_proj.weight}
             flat.extend(obj[suffix] for _, suffix in _BLOCK_PARAM_FIELDS)
 
+        quant_buf = self._buffers.get("quant_amax")
+
         def _run(x, *params):
+            amax = None
+            if quant_buf is not None:
+                amax = params[-1]
+                params = params[:-1]
             tables = (_rope_tables(x.shape[1],
                                    cfg.hidden_size // cfg.num_heads)
                       if cfg.rope and os.environ.get("PTPU_ROPE_HOIST")
                       else None)
             policy, int8_names = (_resolve_remat(cfg) if cfg.recompute
                                   else (None, frozenset()))
+            q_sites, q_dtype = _resolve_quant(cfg)
+            if q_sites and amax is None:
+                from paddle_tpu import quant as _quant
+
+                amax = jnp.zeros((L, len(_quant.GEMM_SITES), 2,
+                                  _quant.amax_hist_len()), jnp.float32)
             block = _make_block(cfg, tables=tables, int8_names=int8_names,
-                                policy=policy)
+                                policy=policy, quant_sites=q_sites,
+                                quant_dtype=q_dtype)
             n = len(_BLOCK_PARAM_FIELDS)
             per_layer = [params[i * n:(i + 1) * n] for i in range(L)]
+
+            def _out(res, new_amax=None):
+                if quant_buf is None:
+                    return res
+                return res, (amax if new_amax is None else new_amax)
+
             if scan_layers_enabled():
                 stacked = tuple(jnp.stack([lp[k] for lp in per_layer])
                                 for k in range(n))
-                return _scan_blocks(block, x, stacked)
-            return _unrolled_blocks(block, x, per_layer)
+                if q_sites:
+                    out, new_amax = _scan_blocks(block, x, stacked,
+                                                 amax=amax)
+                    return _out(out, new_amax)
+                return _out(_scan_blocks(block, x, stacked))
+            if q_sites:
+                out, new_amax = _unrolled_blocks(block, x, per_layer,
+                                                 amax=amax)
+                return _out(out, new_amax)
+            return _out(_unrolled_blocks(block, x, per_layer))
 
+        if quant_buf is not None:
+            out = apply_op(_run, x, *flat, quant_buf,
+                           _op_name="gpt_layer_stack")
+            from paddle_tpu.core.tensor import Tensor
+
+            out, new_amax = out
+            quant_buf._data = (new_amax._data
+                               if isinstance(new_amax, Tensor) else new_amax)
+            return out
         return apply_op(_run, x, *flat, _op_name="gpt_layer_stack")
 
 
@@ -619,7 +661,8 @@ def _sdpa_pure(q, k, v, causal=True):
 
 
 def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
-                rope_tables=None, int8_names=frozenset(), tp_seams=None):
+                rope_tables=None, int8_names=frozenset(), tp_seams=None,
+                quant=None):
     """One decoder block on arrays. p = (ln1, wq, wk, wv, wo, ln2, wg, wu, wd).
 
     ``int8_names``: anchors whose save point is routed through
@@ -634,7 +677,14 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     stream between seams stays SEQUENCE-SHARDED over the tp axis) and
     the q/k/v/gate/up projections become all-gather+matmul
     (docs/COMMS.md). None (the default, and always under pp or inside
-    the quantized dp-grad region) keeps the GSPMD-emitted seams."""
+    the quantized dp-grad region) keeps the GSPMD-emitted seams.
+
+    ``quant``: a ``paddle_tpu.quant.GemmQuantCtx`` holding this layer's
+    delayed-scaling amax state — engaged GEMM sites run the scaled
+    fp8/int8 forward (backward stays wide/exact, docs/QUANT.md) and the
+    caller collects the updated amax histories via ``quant.collect()``.
+    Mutually exclusive with ``tp_seams`` (the seams own their matmul
+    layouts — the engagement resolver declines quant first)."""
     import jax
     import jax.numpy as jnp
 
@@ -647,14 +697,18 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
             return int8_checkpoint(t, name)
         return checkpoint_name(t, name)
 
-    def _col(xx, w):        # column-parallel seam (x may be seq-sharded)
+    def _col(xx, w, site):  # column-parallel seam (x may be seq-sharded)
         if tp_seams is not None:
             return tp_seams.all_gather_matmul(xx, w)
+        if quant is not None:
+            return quant.gemm(xx, w, site)
         return xx @ w
 
-    def _row(xx, w):        # row-parallel seam (output seq-sharded)
+    def _row(xx, w, site):  # row-parallel seam (output seq-sharded)
         if tp_seams is not None:
             return tp_seams.matmul_reduce_scatter(xx, w)
+        if quant is not None:
+            return quant.gemm(xx, w, site)
         return xx @ w
 
     ln1, wq, wk, wv, wo, ln2, wg, wu, wd = p
@@ -668,11 +722,11 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
     # yields the LOCAL head slice (num_heads/tp), while the plain and
     # island-seam paths see sq == s and the full head count. `-1` in the
     # reshape covers both without branching.
-    q = _col(h, wq)
+    q = _col(h, wq, "wq")
     sq = q.shape[1]
     q = q.reshape(b, sq, -1, hd)
-    k = _col(h, wk).reshape(b, sq, -1, hd)
-    v = _col(h, wv).reshape(b, sq, -1, hd)
+    k = _col(h, wk, "wk").reshape(b, sq, -1, hd)
+    v = _col(h, wv, "wv").reshape(b, sq, -1, hd)
     # engaged ring-attention region (docs/ATTENTION.md): this block sees
     # ONE sep shard's zigzag token slice, so rope must rotate by the
     # GLOBAL positions of those tokens (from the region's sep ordinal),
@@ -715,13 +769,14 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
         # between the layouts and forfeits the seam win (docs/COMMS.md)
         from ..ops.pallas.add_rms_norm import add_rms_norm
 
-        x, h2 = add_rms_norm(o @ wo, x, ln2)
+        wo_out = (quant.gemm(o, wo, "wo") if quant is not None else o @ wo)
+        x, h2 = add_rms_norm(wo_out, x, ln2)
     else:
         # anchors: resid_mid skips the o-proj re-run; ln2_out feeds the
         # gate/up recompute without re-running rms2. On the fused-seam
         # path _row returns the attn output SEQ-SHARDED, so the
         # residual add and rms below run on 1/tp of the rows
-        x = _save(x + _row(o, wo), "resid_mid")
+        x = _save(x + _row(o, wo, "wo"), "resid_mid")
         h2 = _save(_rms_pure(x, ln2), "ln2_out")
     if os.environ.get("PTPU_INT8_FFN") and tp_seams is None:
         # (seam precedence as above: _ffn_i8's plain matmuls would break
@@ -736,8 +791,8 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
         return x + _ffn_i8(h2, wg, wu, wd)
     # per-projection anchors: saving gate/up outputs individually lets a
     # policy trade ~67MB/layer (b4) for skipping that matmul's re-run
-    gate = _save(_col(h2, wg), "ffn_gate")
-    up = _save(_col(h2, wu), "ffn_up")
+    gate = _save(_col(h2, wg, "wg"), "ffn_gate")
+    up = _save(_col(h2, wu, "wu"), "ffn_up")
     if _fused_ffn_active(tp_seams):
         from ..ops.pallas.swiglu_down import swiglu_down, swiglu_down_supported
 
@@ -751,7 +806,7 @@ def _block_pure(p, x, num_heads, num_kv_heads, use_rope=True,
             # the silu*mul replay is elementwise; docs/SCAN.md).
             return x + swiglu_down(gate, up, wd)
     ffn = _save(jax.nn.silu(gate) * up, "ffn_out")
-    return x + _row(ffn, wd)
+    return x + _row(ffn, wd, "wd")
 
 
 # ---------------------------------------------------------------------------
@@ -813,9 +868,14 @@ def _resolve_remat(cfg):
         # (the available anchors are tagged in _block_pure). An
         # int8:<anchor> entry saves that anchor as blockwise int8 + fp32
         # scales (memory.int8_checkpoint) at ~half the bf16 bytes.
+        # quant:<site> entries belong to the quantized-compute resolver
+        # (paddle_tpu.quant, docs/QUANT.md) — stripped before the save
+        # names parse, they name GEMM sites rather than remat anchors.
         from paddle_tpu.memory import parse_save_names
+        from paddle_tpu.quant import split_quant_entries
 
-        save_names, int8_names = parse_save_names(pol[len("names:"):])
+        spec, _ = split_quant_entries(pol[len("names:"):])
+        save_names, int8_names = parse_save_names(spec)
         policy = jax.checkpoint_policies.save_only_these_names(*save_names)
     elif pol == "dots":
         policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
@@ -828,8 +888,83 @@ def _resolve_remat(cfg):
     return policy, int8_names
 
 
+def _resolve_quant(cfg, *, tp_seams=None, composed=False, pipelined=False,
+                   path="train"):
+    """Trace-time quantized-compute engagement for the shared scan body
+    (docs/QUANT.md): ``(engaged sites, narrow dtype)``, with every
+    resolution recorded as a structured ``quant_gemm`` plan verdict.
+
+    Precedence mirrors the PR 6/7 rules: engaged tp seams own the
+    row/col matmul layouts; the pipeline stage_fn and composed manual
+    region don't thread amax state; a fused FFN kernel (``_ffn_i8`` /
+    ``swiglu_down``) owns its GEMMs, dropping just those sites; and with
+    ``PTPU_QUANT_COMPUTE`` unset the int8-head-style parity gate (CPU
+    default-off) must pass."""
+    from paddle_tpu import quant as _quant
+    from paddle_tpu.distributed.collectives import compose as _compose
+
+    sites = _quant.requested_quant_sites(cfg)
+    if not sites:
+        return frozenset(), None
+    note = _compose.note_plan_engagement
+
+    def _decline(reason):
+        note("quant_gemm", reason)
+        _quant.note_gemm_mode(path, frozenset(), None)
+        return frozenset(), None
+
+    if composed:
+        return _decline(_compose.Reason.QUANT_COMPOSED)
+    if pipelined:
+        return _decline(_compose.Reason.QUANT_PIPELINE)
+    if tp_seams is not None:
+        return _decline(_compose.Reason.QUANT_SEAM)
+    if not _quant.quant_compute_enabled(requested=True):
+        return _decline(_compose.Reason.QUANT_GATE)
+    if os.environ.get("PTPU_INT8_FFN"):
+        owned = sites & {"wg", "wu", "wd"}
+        if owned:
+            note("quant_gemm", _compose.Reason.QUANT_FUSED_FFN)
+            sites = sites - owned
+    elif _fused_ffn_active(tp_seams) and "wd" in sites:
+        # the swiglu_down megakernel consumes wd (and declines
+        # pre-quantized operands — its VMEM stream is bf16-shaped);
+        # gate/up stay quantizable, they feed the kernel post-GEMM
+        note("quant_gemm", _compose.Reason.QUANT_FUSED_FFN)
+        sites = sites - {"wd"}
+    if not sites:
+        return frozenset(), None
+    dtype = _quant.quant_dtype()
+    note("quant_gemm", _compose.Reason.ENGAGED)
+    h = cfg.hidden_size
+    kv = cfg.num_kv_heads * (h // cfg.num_heads)
+    m = cfg.intermediate_size
+    dims = {"wq": h * h, "wk": h * kv, "wv": h * kv, "wo": h * h,
+            "wg": h * m, "wu": h * m, "wd": m * h}
+    flops_per_token = 2 * sum(dims[s] for s in sites) * cfg.num_layers
+    _quant.note_gemm_mode(path, sites, dtype, flops_per_token)
+    return frozenset(sites), dtype
+
+
+def _quant_buffer_state(config):
+    """The fresh stacked delayed-scaling buffer for ``config``, or None
+    when quant-compute is not requested (buffer presence tracks the
+    REQUEST — policy ``quant:`` entries or the env force — not the
+    parity gate, so a gate flake can't change checkpoint layout)."""
+    from paddle_tpu import quant as _quant
+
+    if not _quant.requested_quant_sites(config):
+        return None
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    return Tensor(jnp.asarray(_quant.init_amax_state(config.num_layers)))
+
+
 def _make_block(cfg, tables=None, int8_names=frozenset(), tp_seams=None,
-                policy=None, gather=None):
+                policy=None, gather=None, quant_sites=frozenset(),
+                quant_dtype=None):
     """One remat-wrapped decoder block over arrays: the scan body. With
     ``cfg.recompute`` each body is a ``jax.checkpoint`` — the remat
     policy (including int8:<anchor> saves) applies PER LAYER whether the
@@ -840,28 +975,47 @@ def _make_block(cfg, tables=None, int8_names=frozenset(), tp_seams=None,
     the sharding axis). It runs INSIDE the ``jax.checkpoint`` wrapper,
     so the remat backward re-gathers each layer's weights instead of
     saving L full copies — the fsdp discipline that keeps resident
-    decoder HBM at 1/degree."""
+    decoder HBM at 1/degree.
+
+    ``quant_sites`` (docs/QUANT.md): engaged scaled-GEMM sites. The body
+    then takes ``p = (weights, amax_layer)`` and returns
+    ``(x, new_amax_layer)`` — delayed-scaling state is an explicit
+    input/output because ``jax.checkpoint`` demands a pure body (the
+    scan threads it through the stacked ``[L, ...]`` amax buffer)."""
     import jax
 
     def block(x, p):
+        qctx = None
+        if quant_sites:
+            from paddle_tpu.quant import GemmQuantCtx
+
+            p, amax_l = p
+            qctx = GemmQuantCtx(quant_sites, amax_l, quant_dtype)
         if gather is not None:
             p = gather(p)
-        return _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
-                           cfg.rope, rope_tables=tables,
-                           int8_names=int8_names, tp_seams=tp_seams)
+        out = _block_pure(p, x, cfg.num_heads, cfg.num_kv_heads,
+                          cfg.rope, rope_tables=tables,
+                          int8_names=int8_names, tp_seams=tp_seams,
+                          quant=qctx)
+        if qctx is not None:
+            return out, qctx.collect()
+        return out
 
     if cfg.recompute:
         block = jax.checkpoint(block, policy=policy)
     return block
 
 
-def _scan_blocks(block, x, stacked, min_unroll=1):
+def _scan_blocks(block, x, stacked, min_unroll=1, amax=None):
     """Run ``block`` as a lax.scan over a [L, ...]-stacked weight tree —
-    compile time and program size flat in depth."""
-    import jax
+    compile time and program size flat in depth.
 
-    def step(x, p):
-        return block(x, p), None
+    With ``amax`` (the stacked ``[L, sites, 2, H]`` delayed-scaling
+    buffer, docs/QUANT.md) the scan carries it as a second xs leaf and
+    collects each layer's updated histories as ys — returns
+    ``(out, new_amax)``; the block must be quant-shaped
+    (``_make_block(quant_sites=...)``)."""
+    import jax
 
     # PTPU_UNROLL_LAYERS=N statically unrolls the scan N-wide: the
     # per-iteration dynamic-slice of every stacked weight (a real HBM
@@ -873,14 +1027,36 @@ def _scan_blocks(block, x, stacked, min_unroll=1):
     # while layer l computes (the fsdp prefetch, docs/ZERO.md).
     unroll = max(int(os.environ.get("PTPU_UNROLL_LAYERS", "1")),
                  int(min_unroll))
+
+    if amax is not None:
+        def qstep(x, p):
+            out, new_amax_l = block(x, p)
+            return out, new_amax_l
+
+        return jax.lax.scan(qstep, x, (tuple(stacked), amax),
+                            unroll=max(1, unroll))
+
+    def step(x, p):
+        return block(x, p), None
+
     out, _ = jax.lax.scan(step, x, tuple(stacked), unroll=max(1, unroll))
     return out
 
 
-def _unrolled_blocks(block, x, layer_params):
+def _unrolled_blocks(block, x, layer_params, amax=None):
     """The ``PTPU_SCAN_LAYERS=0`` escape hatch: a python loop over
     per-layer weight tuples — program size linear in depth, float32-hex
-    identical to the scanned path (tests/test_scan_layers.py proves it)."""
+    identical to the scanned path (tests/test_scan_layers.py proves it).
+    With ``amax`` it mirrors the quant-shaped scan: returns
+    ``(out, new_amax)`` with the per-layer histories restacked."""
+    if amax is not None:
+        import jax.numpy as jnp
+
+        new_rows = []
+        for i, p in enumerate(layer_params):
+            x, new_amax_l = block(x, (tuple(p), amax[i]))
+            new_rows.append(new_amax_l)
+        return x, jnp.stack(new_rows)
     for p in layer_params:
         x = block(x, tuple(p))
     return x
@@ -924,6 +1100,15 @@ class StackedDecoder(nn.Layer):
         self.wg = w(L, h, m)
         self.wu = w(L, h, m)
         self.wd = w(L, m, h)
+        # delayed-scaling amax state [L, sites, 2, H] (docs/QUANT.md):
+        # registered only when quant-compute is REQUESTED, so unrequested
+        # builds are structurally identical to pre-quant programs (the
+        # PTPU_QUANT_COMPUTE=0 hex-identity contract). As a persistable
+        # buffer it rides TrainStep/ShardedTrainStep threading, StepGuard
+        # skip/rollback, and CheckpointManager like the RNG-key chain.
+        amax0 = _quant_buffer_state(config)
+        if amax0 is not None:
+            self.register_buffer("quant_amax", amax0)
 
     def _mesh_pp(self):
         from paddle_tpu.distributed.fleet import active_mesh
@@ -1060,6 +1245,7 @@ class StackedDecoder(nn.Layer):
 
         cfg = self.config
         mesh, pp = self._mesh_pp()
+        quant_buf = self._buffers.get("quant_amax")
 
         def _run(x, *params):
             import os
@@ -1067,9 +1253,23 @@ class StackedDecoder(nn.Layer):
             from paddle_tpu.distributed.collectives import (
                 compose as _compose)
 
+            # quant-compute amax state rides as the last operand when the
+            # buffer exists; declined paths pass it through unchanged so
+            # the output structure stays (x, amax) either way
+            amax = None
+            if quant_buf is not None:
+                amax = params[-1]
+                params = params[:-1]
+
+            def _out(res, new_amax=None):
+                if quant_buf is None:
+                    return res
+                return res, (amax if new_amax is None else new_amax)
+
             _ctx = _compose.active_composed_context()
             if _ctx is not None:
-                return self._run_composed(_ctx, x, params)
+                _resolve_quant(cfg, composed=True)
+                return _out(self._run_composed(_ctx, x, params))
 
             # PTPU_ROPE_HOIST=1 precomputes sin/cos tables once per step
             # outside the scan. Measured SLOWER on v5e (0.5007 vs 0.5072 MFU
@@ -1120,21 +1320,47 @@ class StackedDecoder(nn.Layer):
             gather = (_zero_jit_gather()
                       if pp <= 1 and tp_seams is None else None)
 
+            # quantized-compute engagement (docs/QUANT.md): resolved per
+            # trace against the live path — engaged tp seams and the
+            # pipeline stage_fn decline with a structured reason
+            q_sites, q_dtype = _resolve_quant(cfg, tp_seams=tp_seams,
+                                              pipelined=pp > 1)
+            if q_sites and amax is None:
+                # env-forced quant on a model built without the buffer:
+                # run stateless (all-zero histories bootstrap from the
+                # current step's amax — the inline-scaling recipe)
+                import jax.numpy as jnp
+
+                from paddle_tpu import quant as _quant
+
+                amax = jnp.zeros(
+                    (cfg.num_layers, len(_quant.GEMM_SITES), 2,
+                     _quant.amax_hist_len()), jnp.float32)
+
             block = _make_block(cfg, tables=tables, int8_names=int8_names,
                                 tp_seams=tp_seams, policy=policy,
-                                gather=gather)
+                                gather=gather, quant_sites=q_sites,
+                                quant_dtype=q_dtype)
 
             if pp <= 1:
                 if scan_layers_enabled():
-                    return _scan_blocks(block, x, params,
-                                        min_unroll=2 if gather else 1)
+                    if q_sites:
+                        out, new_amax = _scan_blocks(
+                            block, x, params,
+                            min_unroll=2 if gather else 1, amax=amax)
+                        return _out(out, new_amax)
+                    return _out(_scan_blocks(
+                        block, x, params, min_unroll=2 if gather else 1))
                 # PTPU_SCAN_LAYERS=0 escape hatch: python-unrolled loop
                 # over constant-offset slices of the stacked weights —
                 # program size linear in depth, numerics bitwise equal
                 L = int(params[0].shape[0])
-                return _unrolled_blocks(
-                    block, x,
-                    (tuple(w[i] for w in params) for i in range(L)))
+                per_layer = (tuple(w[i] for w in params) for i in range(L))
+                if q_sites:
+                    out, new_amax = _unrolled_blocks(block, x, per_layer,
+                                                     amax=amax)
+                    return _out(out, new_amax)
+                return _out(_unrolled_blocks(block, x, per_layer))
 
             def step(x, p):
                 return block(x, p), None
@@ -1191,12 +1417,21 @@ class StackedDecoder(nn.Layer):
                     stage_fn, mesh.jax_mesh, pp,
                     params_spec=P("pp"), remat=cfg.recompute,
                 )
-            return unmicrobatch(pipe(tuple(params), microbatch(x, n_micro)))
+            return _out(unmicrobatch(pipe(tuple(params),
+                                          microbatch(x, n_micro))))
 
-        return apply_op(
-            _run, x, self.ln1, self.wq, self.wk, self.wv, self.wo,
-            self.ln2, self.wg, self.wu, self.wd, _op_name="stacked_decoder",
-        )
+        operands = [x, self.ln1, self.wq, self.wk, self.wv, self.wo,
+                    self.ln2, self.wg, self.wu, self.wd]
+        if quant_buf is not None:
+            operands.append(quant_buf)
+        out = apply_op(_run, *operands, _op_name="stacked_decoder")
+        if quant_buf is not None:
+            from paddle_tpu.core.tensor import Tensor
+
+            out, new_amax = out
+            quant_buf._data = (new_amax._data
+                               if isinstance(new_amax, Tensor) else new_amax)
+        return out
 
 
 class GPTForCausalLMPipe(nn.Layer):

@@ -78,13 +78,24 @@ class LlamaForCausalLM(GPTForCausalLM):
         ]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=0, seed=0):
+                 top_k=0, seed=0, int8_weights=False):
         """Greedy (temperature=0) or sampled decode with a kv cache.
 
         input_ids: [B, S] Tensor/array. Returns [B, S + max_new_tokens].
+
+        ``int8_weights=True`` requests int8-resident decode weights
+        (docs/QUANT.md): the 7 projection slabs quantize once per call
+        (per-output-column codes + f32 scales, quant.gemm) and every
+        decode GEMM runs int8 x int8 -> int32 without dequantizing the
+        weights — the same mode the serving engine packs per replica.
+        Engages only behind the round-trip probe; ``PTPU_INT8_WEIGHTS``
+        forces either way (``0`` is the exact escape hatch).
         """
         import jax
         import jax.numpy as jnp
+
+        from ..quant import (int8_weight_matmul, int8_weights_enabled,
+                             quantize_weight_cols_int8)
 
         cfg = self.config
         ids = input_ids._data if hasattr(input_ids, "_data") else jnp.asarray(
@@ -94,12 +105,22 @@ class LlamaForCausalLM(GPTForCausalLM):
         hd = cfg.hidden_size // cfg.num_heads
         n_layers = cfg.num_layers
 
+        use_int8_w = int8_weights_enabled(int8_weights)
+        proj = {"wq", "wk", "wv", "wo", "wg", "wu", "wd"}
         params = self._decode_params()
         flat_params = []
         for lp in params:
-            flat_params.extend(
-                lp[k]._data for k in
-                ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd"))
+            for k in ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu",
+                      "wd"):
+                w = lp[k]._data
+                flat_params.append(
+                    quantize_weight_cols_int8(w)
+                    if use_int8_w and k in proj else w)
+
+        def _mm(x, w):
+            # exact slab -> plain GEMM; (codes, scales) -> int8 GEMM
+            return (int8_weight_matmul(x, *w) if isinstance(w, tuple)
+                    else x @ w)
         embed = self.model.embed_tokens.weight._data
         fnorm = self.model.final_norm.weight._data
         head = (self.lm_head.weight._data if self.lm_head is not None
@@ -125,9 +146,9 @@ class LlamaForCausalLM(GPTForCausalLM):
             ln1, wq, wk, wv, wo, ln2, wg, wu, wd = lp
             bsz, t, hdim = x.shape
             h = _rms_pure(x, ln1)
-            q = (h @ wq).reshape(bsz, t, cfg.num_heads, hd)
-            k = (h @ wk).reshape(bsz, t, cfg.num_kv_heads, hd)
-            v = (h @ wv).reshape(bsz, t, cfg.num_kv_heads, hd)
+            q = _mm(h, wq).reshape(bsz, t, cfg.num_heads, hd)
+            k = _mm(h, wk).reshape(bsz, t, cfg.num_kv_heads, hd)
+            v = _mm(h, wv).reshape(bsz, t, cfg.num_kv_heads, hd)
             q, k = rope_at(q, pos), rope_at(k, pos)
             zero = jnp.int32(0)
             kcache = jax.lax.dynamic_update_slice(
@@ -155,9 +176,9 @@ class LlamaForCausalLM(GPTForCausalLM):
             o = jnp.einsum("bhts,bshd->bthd", probs,
                            cv.astype(jnp.float32)).astype(x.dtype)
             o = o.reshape(bsz, t, cfg.num_heads * hd)
-            x = x + o @ wo
+            x = x + _mm(o, wo)
             h2 = _rms_pure(x, ln2)
-            x = x + (jax.nn.silu(h2 @ wg) * (h2 @ wu)) @ wd
+            x = x + _mm(jax.nn.silu(_mm(h2, wg)) * _mm(h2, wu), wd)
             return x, kcache, vcache
 
         def forward_step(token_ids, caches, pos):

@@ -1,0 +1,51 @@
+"""paddle_tpu.quant: scaled fp8/int8 GEMMs for training and serving.
+
+One shared quantized-compute core (see :mod:`.gemm` for the numerics
+contract): delayed-scaling scaled GEMMs engaged per-layer through the
+``names:`` recompute-policy syntax (``quant:<site>`` entries), the
+int8-head-style parity gate, and the serving engine's int8 resident
+weights. ``incubate.nn.functional.fp8`` delegates here (PR 4 discipline —
+one quantizer implementation).
+"""
+from .gemm import (  # noqa: F401
+    E4M3_MAX,
+    GEMM_SITES,
+    INT8_MAX,
+    QUANT_KNOBS,
+    SITE_ALIASES,
+    GemmQuantCtx,
+    amax_hist_len,
+    cache_key_knobs,
+    dtype_max,
+    engaged_quant_sites,
+    fp8_dot_supported,
+    init_amax_state,
+    inline_scaled_gemm,
+    int8_weight_matmul,
+    int8_weights_enabled,
+    loss_drift_probe,
+    note_gemm_mode,
+    note_step_tokens,
+    quant_compute_enabled,
+    quant_compute_forced,
+    quant_dtype,
+    quant_gate,
+    quant_gate_report,
+    quant_sites_from_policy,
+    quantize_weight_cols_int8,
+    requested_quant_sites,
+    scaled_gemm,
+    split_quant_entries,
+)
+
+__all__ = [
+    "E4M3_MAX", "INT8_MAX", "GEMM_SITES", "SITE_ALIASES", "QUANT_KNOBS",
+    "GemmQuantCtx", "scaled_gemm", "inline_scaled_gemm", "amax_hist_len",
+    "init_amax_state", "split_quant_entries", "quant_sites_from_policy",
+    "requested_quant_sites", "engaged_quant_sites", "quant_compute_enabled",
+    "quant_compute_forced", "quant_dtype", "dtype_max", "fp8_dot_supported",
+    "quant_gate", "quant_gate_report", "cache_key_knobs",
+    "quantize_weight_cols_int8", "int8_weight_matmul",
+    "int8_weights_enabled", "loss_drift_probe", "note_gemm_mode",
+    "note_step_tokens",
+]

@@ -237,6 +237,58 @@ def pipe_violations(rec):
     return out
 
 
+#: quant decline reasons that describe a DOCUMENTED fallback
+#: (docs/QUANT.md): the parity gate / CPU default-off (loud, warned), or
+#: a precedence rule ceding the GEMM to an owner kernel/region. A
+#: requested run declining for any other (or no) recorded reason fails —
+#: the line would silently measure wide GEMMs while claiming quant.
+QUANT_CONFIG_DECLINES = frozenset({
+    "quant_parity_gate",        # gate red / CPU default-off
+    "tp_seam_owns_gemm",        # fused tp seams own the projections
+    "fused_kernel_owns_gemm",   # swiglu_down megakernel owns wd
+    "pipeline_stage_fn",        # pipeline stage fns: no amax threading
+    "composed_region",          # manual composed region owns the math
+})
+
+
+def quant_violations(rec):
+    """Reference-free violation strings from one record's "quant" block
+    (docs/QUANT.md): the numeric parity-gate report must be green (a red
+    gate that still ENGAGED means someone forced past drifted numerics),
+    the embedded exact-vs-scaled loss-drift A/B must stay inside its
+    0.5% budget, and a requested mode that never engaged must carry a
+    documented decline reason — the int8-head gate discipline applied to
+    the scaled-GEMM compute mode."""
+    block = rec.get("quant") if isinstance(rec, dict) else None
+    if not isinstance(block, dict):
+        return []
+    out = []
+    gate = block.get("gate")
+    if isinstance(gate, dict) and gate.get("ok") is False:
+        out.append(
+            "quant parity gate red (loss_rel_err="
+            f"{gate.get('loss_rel_err')}, tol={gate.get('tol')}, "
+            f"grad_rel_err={gate.get('grad_rel_err')}, "
+            f"grad_tol={gate.get('grad_tol')})"
+            + (" yet the run ENGAGED scaled GEMMs — forced past a "
+               "failing probe" if block.get("engaged") else ""))
+    drift = block.get("loss_drift_rel")
+    budget = block.get("loss_drift_budget")
+    if drift is not None and budget is not None \
+            and float(drift) > float(budget):
+        out.append(
+            f"quant loss drift {float(drift):.4f} > budget "
+            f"{float(budget):.4f} vs the embedded exact A/B "
+            "(quant.loss_drift_probe)")
+    if (block.get("requested") and not block.get("engaged")
+            and block.get("reason") not in QUANT_CONFIG_DECLINES):
+        out.append(
+            "quant compute requested but never engaged "
+            f"(decline_reason={block.get('reason')!r}; see the "
+            "plan_engagement telemetry)")
+    return out
+
+
 def host_overhead_violations(rec, threshold=0.25):
     """Violation strings from one bench record's "anatomy" block: a
     traced run whose host gap (measured step wall − cost-analysis
@@ -580,6 +632,11 @@ def main(argv=None):
         # lines embed a ring-vs-dense parity probe — reference-free
         for v in ring_violations(rec):
             print(f"  RING  {metric}: {v}", flush=True)
+            failed = True
+        # quant gate (docs/QUANT.md): parity-gate report + embedded
+        # loss-drift A/B + no silent request-without-engagement
+        for v in quant_violations(rec):
+            print(f"  QUANT {metric}: {v}", flush=True)
             failed = True
         # host-overhead gate (reference-free): a traced round must stay
         # device-bound at the same metric

@@ -183,6 +183,37 @@ def print_plans(snap, out=None):
           f"[{d.get('reason', '?')}] x{int(v)}\n")
 
 
+def print_quant(snap, out=None):
+    """Low-precision compute section (docs/QUANT.md): the per-site GEMM
+    dtype mode (0=wide, 1=int8, 2=fp8) recorded at trace time, the
+    cumulative narrow-GEMM forward FLOPs by dtype, and the serving
+    resident-weight footprint by storage dtype."""
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    mode = gauges.get("gemm_dtype_mode") or {}
+    flops = counters.get("quant_gemm_flops_total") or {}
+    wbytes = gauges.get("serving_weight_bytes") or {}
+    if not (mode or flops or wbytes):
+        return
+    w = (out or sys.stdout).write
+    w("-- quant (scaled-GEMM compute) --\n")
+    names = {0.0: "wide", 1.0: "int8", 2.0: "fp8"}
+
+    def _d(labels):
+        return dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+
+    for labels, v in sorted(mode.items()):
+        d = _d(labels)
+        w(f"  gemm[{d.get('site', '?')}]@{d.get('path', '?')}: "
+          f"{names.get(float(v), v)}\n")
+    for labels, v in sorted(flops.items()):
+        d = _d(labels)
+        w(f"  narrow_flops[{d.get('dtype', '?')}]: {int(v)}\n")
+    for labels, v in sorted(wbytes.items()):
+        d = _d(labels)
+        w(f"  serving_weight_bytes[{d.get('dtype', '?')}]: {int(v)}\n")
+
+
 def print_overload(snap, out=None):
     """Overload section (docs/SERVING.md "Overload & degradation"):
     admission rejects by reason/priority, shed counts by reason, breaker
@@ -261,6 +292,7 @@ def print_snapshot(snap, out=None):
     print_comms(snap, out)
     print_zero(snap, out)
     print_ring(snap, out)
+    print_quant(snap, out)
     print_overload(snap, out)
     for kind in ("counters", "gauges"):
         group = snap.get(kind) or {}
