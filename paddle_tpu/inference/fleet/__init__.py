@@ -13,24 +13,47 @@ topology:
   accept-prefix; greedy output bitwise-identical to plain decode).
 - :mod:`.soak` — the Poisson soak harness behind
   ``tools/serve_bench.py`` and the bench_gate serving gates.
+- :mod:`.wire` / :mod:`.transport` — the length-prefixed msgpack frame
+  format and the RPC transport (loopback + socket) that turn replicas
+  into real OS processes, with retries, idempotent call ids, and a
+  chaos seam (``testing.chaos.ChaosTransport``).
+- :mod:`.cluster` — ``FleetSupervisor``: child-process lifecycle over
+  the router — heartbeat leases, SIGKILL + exactly-once replay +
+  respawn, SLO-driven autoscaling, and zero-loss rolling weight
+  upgrades over the KV-migration wire (``PTPU_FLEET_PROC=0`` falls
+  back to in-process loopback children, bitwise).
 
 The int8 paged-KV mode lives in the engine itself
 (``inference.serving``, ``PTPU_INT8_KV``); it composes with every
 topology here because the page payload format is invisible to routing,
 handoff, and verification.
 """
+from .cluster import (AutoscaleConfig, Autoscaler, FleetSupervisor,  # noqa: F401
+                      build_model_from_spec, fleet_proc_enabled,
+                      make_model_spec)
 from .disagg import DisaggregatedEngine  # noqa: F401
-from .overload import (Overloaded, OverloadConfig, TransientReplicaError,  # noqa: F401
-                       classify_step_exception, overload_enabled)
+from .overload import (Overloaded, OverloadConfig, RemoteReplicaError,  # noqa: F401
+                       TransientReplicaError, classify_step_exception,
+                       outcome_from_wire, outcome_to_wire,
+                       overload_enabled)
 from .router import POLICIES, FleetRouter, ReplicaHandle, make_replicas  # noqa: F401
 from .soak import (build_workload, fleet_soak, overload_block, run_soak,  # noqa: F401
-                   soak_block)
+                   soak_block, upgrade_block)
 from .spec_decode import DraftRunner  # noqa: F401
+from .transport import (LoopbackTransport, RemoteEngine, ReplicaServer,  # noqa: F401
+                        SocketTransport, Transport, TransportError,
+                        TransportSevered, TransportTimeout)
 
 __all__ = [
     "FleetRouter", "ReplicaHandle", "POLICIES", "make_replicas",
     "DisaggregatedEngine", "DraftRunner", "build_workload", "run_soak",
-    "fleet_soak", "soak_block", "overload_block", "Overloaded",
-    "OverloadConfig", "TransientReplicaError", "classify_step_exception",
-    "overload_enabled",
+    "fleet_soak", "soak_block", "overload_block", "upgrade_block",
+    "Overloaded",
+    "OverloadConfig", "TransientReplicaError", "RemoteReplicaError",
+    "classify_step_exception", "overload_enabled", "outcome_to_wire",
+    "outcome_from_wire", "Transport", "LoopbackTransport",
+    "SocketTransport", "RemoteEngine", "ReplicaServer", "TransportError",
+    "TransportTimeout", "TransportSevered", "FleetSupervisor",
+    "Autoscaler", "AutoscaleConfig", "make_model_spec",
+    "build_model_from_spec", "fleet_proc_enabled",
 ]

@@ -148,22 +148,94 @@ class TransientReplicaError(RuntimeError):
 TRANSIENT_TYPES = (TransientReplicaError, OSError)
 
 #: substrings marking a transient runtime fault (XLA/jax runtime errors
-#: surface as RuntimeError with gRPC-style status markers)
+#: surface as RuntimeError with gRPC-style status markers; the fleet
+#: transport's taxonomy — timeouts, severed links, heartbeat-lease
+#: expiry — rides the same list for faults that arrive as re-hydrated
+#: remote exceptions instead of live OSError subclasses)
 TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED",
-                     "UNAVAILABLE", "ABORTED", "preempt")
+                     "UNAVAILABLE", "ABORTED", "preempt",
+                     "severed", "heartbeat lease")
 
 
 def classify_step_exception(exc):
     """``"transient"`` (retry through the breaker) or ``"fatal"``
     (the old mark-dead path after ``max_consecutive_fatal``). Unknown
     exceptions are FATAL: an arbitrary failure leaves the engine state
-    untrusted, and the pre-overload semantics stay the default."""
+    untrusted, and the pre-overload semantics stay the default.
+
+    The transport taxonomy lands here for free: TransportError and its
+    subclasses (timeout, severed link) are ``ConnectionError`` /
+    ``OSError`` descendants, so a dead or flapping replica process is
+    transient — the breaker backs off and the requests replay
+    exactly-once instead of the replica being marked dead on the first
+    dropped frame."""
     if isinstance(exc, TRANSIENT_TYPES):
         return "transient"
     msg = str(exc)
     if any(m in msg for m in TRANSIENT_MARKERS):
         return "transient"
     return "fatal"
+
+
+# ---------------------------------------------------------------------------
+# Structured outcomes on the wire
+# ---------------------------------------------------------------------------
+class RemoteReplicaError(RuntimeError):
+    """An exception type the wire registry doesn't know, re-hydrated
+    from a child process.  The original type name and message are
+    preserved (``remote_type``), so marker-based classification still
+    sees whatever the child saw."""
+
+    def __init__(self, remote_type, message):
+        self.remote_type = remote_type
+        super().__init__(f"{remote_type}: {message}")
+
+
+#: builtins allowed to re-hydrate by name from a child-process reply.
+_WIRE_BUILTINS = {
+    c.__name__: c for c in (
+        ValueError, TypeError, KeyError, IndexError, RuntimeError,
+        NotImplementedError, MemoryError, TimeoutError, OSError,
+        ConnectionError, StopIteration,
+    )
+}
+
+
+def outcome_to_wire(exc):
+    """Serialize a structured terminal outcome (or any exception) for
+    the RPC boundary.  ``Overloaded`` keeps its full structure — a
+    child-process admission reject must reach the caller with
+    ``retry_after`` / ``reason`` / ``predicted_ttft`` intact, not as a
+    flattened string."""
+    d = {"kind": type(exc).__name__, "message": str(exc)}
+    if isinstance(exc, Overloaded):
+        d.update(reason=exc.reason, retry_after=exc.retry_after,
+                 predicted_ttft=exc.predicted_ttft, priority=exc.priority)
+    elif isinstance(exc, RemoteReplicaError):
+        d["kind"] = exc.remote_type          # don't double-wrap on relay
+    return d
+
+
+def outcome_from_wire(d):
+    """Re-hydrate :func:`outcome_to_wire`.  Unknown types come back as
+    :class:`RemoteReplicaError` carrying the original name + message
+    (classification by marker still works; nothing is silently eaten)."""
+    kind = d.get("kind", "RemoteReplicaError")
+    msg = d.get("message", "")
+    if kind == "Overloaded":
+        return Overloaded(d.get("reason", "remote"),
+                          d.get("retry_after", 0.0),
+                          predicted_ttft=d.get("predicted_ttft"),
+                          priority=d.get("priority", "interactive"))
+    if kind == "TransientReplicaError":
+        return TransientReplicaError(msg)
+    cls = _WIRE_BUILTINS.get(kind)
+    if cls is not None:
+        try:
+            return cls(msg)
+        except Exception:       # exotic ctor signature -> generic wrap
+            pass
+    return RemoteReplicaError(kind, msg)
 
 
 # ---------------------------------------------------------------------------
@@ -544,6 +616,13 @@ class OverloadController:
 
     def set_clock(self, fn):
         self._clock_fn = fn
+
+    def add_breaker(self):
+        """Grow the breaker list for a replica added live (supervisor
+        respawn / autoscale-up).  Returns the new breaker's index."""
+        idx = len(self.breakers)
+        self.breakers.append(CircuitBreaker(self.cfg, idx, self.clock))
+        return idx
 
     # -- admission ------------------------------------------------------
     def _reject(self, reason, retry_after, predicted, priority):

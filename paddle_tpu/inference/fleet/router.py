@@ -120,7 +120,7 @@ class ReplicaHandle:
     sequentially, so wall time is NOT the fleet critical path)."""
 
     __slots__ = ("idx", "engine", "healthy", "dispatched", "steps",
-                 "busy_seconds", "death_reason")
+                 "busy_seconds", "death_reason", "draining", "retired")
 
     def __init__(self, idx, engine):
         self.idx = idx
@@ -130,6 +130,12 @@ class ReplicaHandle:
         self.steps = 0
         self.busy_seconds = 0.0
         self.death_reason = None
+        # draining: no NEW dispatches (rolling upgrade / scale-down);
+        # inflight work still ticks.  retired: out of the fleet for good
+        # (scale-down completed) — distinct from dead so it doesn't
+        # count as a failure in stats or trip the all-dead check.
+        self.draining = False
+        self.retired = False
 
 
 class FleetRouter:
@@ -260,7 +266,7 @@ class FleetRouter:
                 counts[entry[0]] = counts.get(entry[0], 0) + 1
         cands = []
         for h in self.replicas:
-            if not h.healthy:
+            if not h.healthy or h.draining or h.retired:
                 continue
             if h.engine.load()["queue_depth"] >= self.max_queue_depth:
                 continue
@@ -325,7 +331,19 @@ class FleetRouter:
                             _cb(r, t)
 
                 kw["on_token"] = on_token
-            handle.engine.submit(prompt, rid=rid, **kw)
+            try:
+                handle.engine.submit(prompt, rid=rid, **kw)
+            except Exception as exc:   # noqa: BLE001
+                if _overload.classify_step_exception(exc) != "transient":
+                    raise
+                # the replica died between health checks (a SIGKILLed
+                # child whose lease hasn't expired yet): declare it dead
+                # now — its inflight work requeues — and put THIS
+                # request back at the head for a surviving candidate
+                self._pending.appendleft((rid, prompt, kwargs, priority))
+                self.kill_replica(handle.idx, exc, raise_if_empty=False,
+                                  context={"during": "dispatch"})
+                continue
             handle.dispatched += 1
             self._inflight[rid] = (idx, prompt, kwargs, priority)
             _DISPATCH.inc(labels=(self._policy_name, str(idx)))
@@ -353,17 +371,52 @@ class FleetRouter:
         """Mark a replica dead and requeue everything it held. The
         engine's internal state is untrusted after an arbitrary failure;
         requests replay from their original prompts."""
+        self.kill_replica(handle.idx, exc)
+
+    def kill_replica(self, idx, exc, *, raise_if_empty=True, context=None):
+        """Declare replica ``idx`` dead — from inside (a step() fault)
+        or from outside (a supervisor's heartbeat-lease expiry or child
+        exit detection, which passes ``context`` with the exit code and
+        heartbeat age for the ``replica_death`` flight bundle).  Every
+        request the replica held requeues with its original rid through
+        the exactly-once replay machinery.  ``raise_if_empty=False``
+        hands the no-survivors case to the caller: a supervisor
+        RESPAWNS instead of dying."""
+        handle = self.replicas[idx]
+        if not handle.healthy:
+            return
         handle.healthy = False
         handle.death_reason = repr(exc)
         _DEATHS.inc()
-        _flight.maybe_dump("replica_death", {
-            "replica": handle.idx, "exc": repr(exc),
-            "healthy_replicas": sum(h.healthy for h in self.replicas)})
+        ctx = {"replica": handle.idx, "exc": repr(exc),
+               "healthy_replicas": sum(h.healthy for h in self.replicas)}
+        if context:
+            ctx.update(context)
+        _flight.maybe_dump("replica_death", ctx)
         self._requeue_all(handle, "requeue", {"dead_replica": handle.idx})
-        if not any(h.healthy for h in self.replicas):
+        if raise_if_empty and not any(
+                h.healthy and not h.retired for h in self.replicas):
             raise RuntimeError(
                 "FleetRouter: every replica is dead "
                 f"(last failure: {handle.death_reason})") from exc
+
+    def add_replica(self, engine):
+        """Grow the fleet live (supervisor respawn / autoscale-up): a
+        fresh handle — and a fresh breaker when overload control is on —
+        routable from the next dispatch.  Returns the new index."""
+        idx = len(self.replicas)
+        self.replicas.append(ReplicaHandle(idx, engine))
+        if self._ov is not None:
+            self._ov.add_breaker()
+        return idx
+
+    def reassign(self, rid, new_idx):
+        """Point an inflight rid at a new replica (KV migration moved
+        the live request).  The delivered-token suppression state stays:
+        the stream continues on the peer, exactly once."""
+        entry = self._inflight.get(rid)
+        if entry is not None:
+            self._inflight[rid] = (new_idx,) + entry[1:]
 
     def _on_breaker_open(self, handle):
         """The breaker opened: tear the replica's requests out of the
@@ -470,7 +523,7 @@ class FleetRouter:
         self._dispatch_pending()
         done = {}
         for handle in self.replicas:
-            if not handle.healthy:
+            if not handle.healthy or handle.retired:
                 continue
             had_work = False
             if self._ov is not None:
@@ -520,7 +573,7 @@ class FleetRouter:
     def drained(self):
         if self._pending or self._inflight:
             return False
-        return all(not h.healthy or (
+        return all(not h.healthy or h.retired or (
             h.engine.load()["queue_depth"] == 0
             and h.engine.load()["occupied_slots"] == 0)
             for h in self.replicas)

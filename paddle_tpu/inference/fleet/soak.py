@@ -103,6 +103,10 @@ def _spec_stats(eng):
 
 def _engine_stats(eng):
     """Per-engine counters, transparent to DisaggregatedEngine."""
+    if hasattr(eng, "engine_stats"):
+        # a RemoteEngine proxy: the counters live in the replica
+        # process — one stats RPC computes this dict server-side
+        return eng.engine_stats()
     if hasattr(eng, "prefill") and hasattr(eng, "decode"):
         p, d = eng.prefill, eng.decode
         return {"disaggregated": True,
@@ -128,7 +132,7 @@ def _engine_stats(eng):
 
 def run_soak(target, workload, warmup=True, max_ticks=200000,
              rebase_overload_clock=True, recorder=None, slo=None,
-             timeline_path=None):
+             timeline_path=None, on_tick=None, token_cb=None):
     """Drive ``workload`` through ``target`` (engine / disagg /
     FleetRouter) and return the raw soak stats dict. Cold start
     (construction is the caller's; compile is ours via ``warmup()``) is
@@ -157,7 +161,15 @@ def run_soak(target, workload, warmup=True, max_ticks=200000,
     engine) evaluated live after every sample; its fire/clear events
     land in ``stats["slo"]`` and the flight recorder's forensics window.
     The run ends with a ``soak_end`` flight bundle when a flight
-    recorder is installed."""
+    recorder is installed.
+
+    ``on_tick(tick_index)`` fires after every fleet tick — the seam the
+    multi-process chaos scenarios use to SIGKILL a replica or start a
+    rolling upgrade mid-soak.  ``token_cb(rid, tok)`` observes every
+    streamed token (duplicate-delivery accounting for the UPGRADE
+    gate).  A target exposing ``attach_slo`` (the FleetSupervisor)
+    receives the live SLO engine so its autoscaler can read burn
+    rates."""
     router = hasattr(target, "replicas")
     engines = ([h.engine for h in target.replicas] if router
                else [target])
@@ -179,6 +191,8 @@ def run_soak(target, workload, warmup=True, max_ticks=200000,
                           recorder, slo,
                           registry=_telemetry.get_registry(),
                           flight=_flight.get()))
+    if slo_engine is not None and hasattr(target, "attach_slo"):
+        target.attach_slo(slo_engine)
     cold = []
     if warmup:
         for e in engines:
@@ -196,6 +210,8 @@ def run_soak(target, workload, warmup=True, max_ticks=200000,
 
     def on_token(rid, tok):
         first_seen.setdefault(rid, None)
+        if token_cb is not None:
+            token_cb(rid, tok)
 
     def n_terminal():
         return (len(done)
@@ -289,6 +305,8 @@ def run_soak(target, workload, warmup=True, max_ticks=200000,
         done.update(out)
         if recorder is not None:
             take_sample()
+        if on_tick is not None:
+            on_tick(_tick)
         if not pending and n_terminal() >= len(arrival):
             break
     else:
@@ -572,4 +590,178 @@ def soak_block(model, *, replicas, workload, policy="least_loaded",
         block["scaling_target"] = float(scaling_target)
     if ttft_budget is not None:
         block["p99_ttft_budget"] = float(ttft_budget)
+    return block
+
+
+def upgrade_block(supervisor, workload, *, version=1, upgrade_tick=4,
+                  kill_tick=None, kill_replica=0,
+                  window_goodput_floor=None, window_ttft_budget=None,
+                  max_ticks=400000):
+    """The gateable ``"upgrade"`` JSON block (docs/SERVING.md "Process
+    topology"; ``tools/bench_gate.py`` UPGRADE gate): drive ``workload``
+    through a running :class:`.cluster.FleetSupervisor`, SIGKILL one
+    replica mid-soak (``kill_tick``), start a rolling weight upgrade to
+    ``version`` at ``upgrade_tick``, and reduce the run to its
+    reference-free gate fields.
+
+    The gate is reference-free because the invariants are absolute, not
+    relative to a prior round:
+
+    - ``conserved`` / ``lost_requests``: every submitted request reaches
+      exactly one terminal outcome across kills, migrations, and
+      reloads — zero lost requests is the whole point of the rollout
+      machinery;
+    - ``duplicate_stream_tokens`` / ``lost_stream_tokens``: every
+      generated token is delivered to its stream callback exactly once,
+      counted independently of the router's own suppression (the
+      ``token_cb`` seam tallies raw deliveries; the engines report raw
+      generation);
+    - ``upgrade.complete`` and the upgraded-replica roster: the rollout
+      must actually finish while serving;
+    - the upgrade *window* (start tick -> finish tick) is cut out of the
+      per-tick timeline: its goodput as a fraction of the whole-run
+      goodput vs ``window_goodput_floor``, and the worst recent-p99
+      TTFT inside the window vs ``window_ttft_budget``.  Both window
+      gates engage only when their budget is embedded (passed here) —
+      goodput counts COMPLETED requests' tokens, which is lumpy at
+      small scale, so the floor is an explicit opt-in for runs big
+      enough to make it meaningful; ``peak_outstanding`` lets the gate
+      skip windows that were legitimately idle.
+    """
+    recorder = _telemetry.recorder()
+    delivered = {}
+    up_state = {"started": None, "finished": None, "peak_outstanding": 0}
+
+    def token_cb(rid, tok):
+        delivered[rid] = delivered.get(rid, 0) + 1
+
+    def on_tick(tick):
+        if kill_tick is not None and tick == kill_tick:
+            child = supervisor.children.get(kill_replica)
+            if child is not None:
+                child.kill()
+        if tick == upgrade_tick and up_state["started"] is None:
+            supervisor.start_rolling_upgrade(version)
+            up_state["started"] = tick
+        if (up_state["started"] is not None
+                and up_state["finished"] is None):
+            # load actually present during the window: an idle-fleet
+            # upgrade legitimately generates nothing, a stalled one
+            # starves real work — the gate needs to tell them apart
+            up_state["peak_outstanding"] = max(
+                up_state["peak_outstanding"],
+                len(supervisor._pending) + len(supervisor._inflight))
+            if supervisor._upgrade is None:
+                up_state["finished"] = tick
+
+    stats, done = run_soak(supervisor, workload, max_ticks=max_ticks,
+                           recorder=recorder, on_tick=on_tick,
+                           token_cb=token_cb)
+    # the soak can drain before the staged rollout (one stage per tick)
+    # finishes — keep ticking the idle fleet until the upgrade lands, so
+    # "complete" measures the machinery, not the workload length
+    for _ in range(1000):
+        if up_state["started"] is None or supervisor._upgrade is None:
+            break
+        supervisor.step()
+    recorder.close()
+    summary = supervisor.summary()
+    upgrades = summary.get("upgrades") or []
+    up = dict(upgrades[-1]) if upgrades else None
+    complete = bool(up is not None and up.get("finished_tick") is not None
+                    and up_state["started"] is not None)
+
+    # token exactly-once accounting: deliveries counted at the callback
+    # seam vs tokens the engines actually generated for COMPLETED
+    # requests (cancelled streams legitimately deliver a partial prefix)
+    delivered_total = sum(n for rid, n in delivered.items()
+                          if rid in done)
+    generated = stats["generated_tokens"]
+    duplicates = max(0, delivered_total - generated)
+    lost_tokens = max(0, generated - delivered_total)
+
+    # cut the upgrade window out of the timeline
+    window = {}
+    samples = recorder.window()
+    if up_state["started"] is not None:
+        end_tick = (up_state["finished"]
+                    if up_state["finished"] is not None else 10 ** 9)
+        in_win = [s for s in samples
+                  if up_state["started"] <= s.get("tags", {}).get(
+                      "tick", -1) <= end_tick]
+        if len(in_win) >= 2:
+            t0, t1 = in_win[0]["ts"], in_win[-1]["ts"]
+            g0 = in_win[0]["counters"].get(
+                "soak_generated_tokens_total", 0)
+            g1 = in_win[-1]["counters"].get(
+                "soak_generated_tokens_total", 0)
+            win_goodput = ((g1 - g0) / (t1 - t0)) if t1 > t0 else None
+            overall = stats["goodput_tokens_per_sec"]
+            ttfts = [s["values"]["ttft_p99_recent"] for s in in_win
+                     if "ttft_p99_recent" in s.get("values", {})]
+            window = {
+                "start_tick": up_state["started"],
+                "end_tick": up_state["finished"],
+                "ticks": len(in_win),
+                "peak_outstanding": up_state["peak_outstanding"],
+                "generated_tokens": int(g1 - g0),
+                "sim_seconds": round(t1 - t0, 6),
+                "goodput_tokens_per_sec": (round(win_goodput, 2)
+                                           if win_goodput is not None
+                                           else None),
+                "goodput_fraction": (round(win_goodput / overall, 4)
+                                     if win_goodput is not None
+                                     and overall else None),
+                "p99_ttft_seconds": (round(max(ttfts), 6) if ttfts
+                                     else None),
+            }
+            if window_goodput_floor is not None:
+                window["goodput_floor_fraction"] = float(
+                    window_goodput_floor)
+            if window_ttft_budget is not None:
+                window["p99_ttft_budget"] = float(window_ttft_budget)
+
+    submitted = stats["requests"]
+    terminal = (stats["completed"] + stats["cancelled"] + stats["shed"]
+                + stats["rejected"])
+    block = {
+        "enabled": True,
+        "backend": "proc" if supervisor.proc else "inproc",
+        "replicas": stats["replicas"],
+        "policy": supervisor._policy_name,
+        "submitted": submitted,
+        "served": stats["completed"],
+        "cancelled": stats["cancelled"],
+        "shed": stats["shed"],
+        "rejected": stats["rejected"],
+        "conserved": bool(stats["outcomes_conserved"]),
+        "lost_requests": max(0, submitted - terminal),
+        "generated_tokens": generated,
+        "delivered_stream_tokens": delivered_total,
+        "duplicate_stream_tokens": duplicates,
+        "lost_stream_tokens": lost_tokens,
+        "goodput_tokens_per_sec": stats["goodput_tokens_per_sec"],
+        "sim_seconds": stats["sim_seconds"],
+        "wall_seconds": stats["wall_seconds"],
+        "ttft": stats["ttft"],
+        "upgrade": {
+            "version": version,
+            "requested_tick": upgrade_tick,
+            "started_tick": up_state["started"],
+            "finished_tick": up_state["finished"],
+            "complete": complete,
+            "upgraded_replicas": (up or {}).get("upgraded", []),
+            "migrated_requests": (up or {}).get("migrated", 0),
+            "migration_bytes": (up or {}).get("migrate_bytes", 0),
+        },
+        "kill": ({
+            "tick": kill_tick,
+            "replica": kill_replica,
+            "respawns": summary["respawns"],
+            "lease_deaths": summary["lease_deaths"],
+        } if kill_tick is not None else None),
+        "supervisor": summary,
+    }
+    if window:
+        block["window"] = window
     return block

@@ -1,0 +1,767 @@
+"""Pluggable RPC transport: the fleet's replicas as REAL processes.
+
+One replica link = one :class:`Transport` (client half, owned by a
+:class:`RemoteEngine` proxy inside the parent) talking to one
+:class:`ReplicaServer` (server half, wrapping a live
+``ContinuousBatchingEngine`` — in a child process over the socket
+transport, or in-process behind the loopback for tests and the
+``PTPU_FLEET_PROC=0`` escape hatch).  Frames are the length-prefixed
+msgpack format from :mod:`.wire`.
+
+Failure semantics, end to end:
+
+- every call gets a fresh monotone id; retries RE-SEND the same id with
+  exponential backoff + deterministic jitter.  The server keeps a
+  bounded cache of id -> encoded reply, so a duplicated or re-sent
+  frame replays the cached reply instead of re-executing — submits and
+  steps stay exactly-once under drop/duplicate/corrupt chaos.
+- transport faults raise :class:`TransportError` (a ``ConnectionError``
+  subclass) / :class:`TransportTimeout` / :class:`TransportSevered`, so
+  ``classify_step_exception`` sees them as TRANSIENT and the router's
+  breakers back off + replay instead of killing the replica.
+- a corrupt frame in either direction raises :class:`.wire.FrameError`
+  loudly at the decode site and is retried by the caller; garbage never
+  reaches an engine.
+
+Streaming: ``on_token`` callbacks cannot cross a process boundary, so
+the server buffers ``(rid, token)`` events and every ``step`` /
+``stream`` reply drains them; :class:`RemoteEngine` replays the events
+into the client-side callbacks, preserving the router's ``_delivered``
+exactly-once suppression machinery unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict, deque
+
+from ... import telemetry as _telemetry
+from . import wire
+from .overload import outcome_from_wire, outcome_to_wire
+
+_CALLS = _telemetry.counter(
+    "transport_calls_total", "fleet RPC calls by method and outcome",
+    labelnames=("method", "outcome"))
+_RETRIES = _telemetry.counter(
+    "transport_retries_total", "fleet RPC attempts beyond the first")
+_BYTES = _telemetry.counter(
+    "transport_bytes_total", "fleet RPC frame bytes by direction",
+    labelnames=("direction",))
+
+
+class TransportError(ConnectionError):
+    """Base transport fault (ConnectionError => transient taxonomy)."""
+
+
+class TransportTimeout(TransportError):
+    """The per-call deadline elapsed without a matching reply."""
+
+
+class TransportSevered(TransportError):
+    """The link is gone: peer dead, socket closed, or chaos-severed."""
+
+
+class SimulatedCrash(BaseException):
+    """Raised by the test-only ``crash`` RPC; deliberately NOT an
+    Exception so the server dispatch cannot swallow it — it unwinds to
+    the worker's top level and exercises the unhandled-crash flight
+    path for real."""
+
+
+#: per-method call timeouts (seconds).  warmup/reload compile real
+#: programs; steps decode real tokens; everything else is bookkeeping.
+DEFAULT_TIMEOUTS = {
+    "hello": 120.0,
+    "warmup": 600.0,
+    "reload_weights": 600.0,
+    "step": 300.0,
+    "drain": 300.0,
+    "extract": 120.0,
+    "inject": 120.0,
+}
+DEFAULT_TIMEOUT = 60.0
+
+
+class _Call:
+    __slots__ = ("id", "method", "frame", "needs_send")
+
+    def __init__(self, call_id, method, frame, needs_send):
+        self.id = call_id
+        self.method = method
+        self.frame = frame
+        self.needs_send = needs_send
+
+
+class Transport:
+    """Client half of one replica link.
+
+    Subclasses implement ``_send(frame_bytes)`` and
+    ``_recv_bytes(timeout) -> bytes`` (one complete frame).  The retry /
+    timeout / jitter machinery lives here so every transport shares the
+    exact same failure semantics.  ``begin()``/``finish()`` split a call
+    so a supervisor can issue ``step`` to the whole fleet concurrently
+    and collect replies afterwards (real wall-clock parallelism)."""
+
+    def __init__(self, *, timeout=DEFAULT_TIMEOUT, timeouts=None,
+                 max_retries=3, backoff=0.05, backoff_max=2.0,
+                 jitter=0.25, seed=0, codec=None,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.timeout = float(timeout)
+        self.timeouts = dict(DEFAULT_TIMEOUTS)
+        if timeouts:
+            self.timeouts.update(timeouts)
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+        self.codec = codec
+        self.clock = clock
+        self.sleep = sleep
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self.retries = 0
+        self.calls = 0
+        self.backoffs = []            # realized backoff schedule (tests)
+        self.last_ok_time = clock()   # heartbeat-lease anchor
+        self.last_load = None         # server-attached load snapshot
+
+    # -- subclass surface ---------------------------------------------------
+    def _send(self, frame):
+        raise NotImplementedError
+
+    def _recv_bytes(self, timeout):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+    # -- call machinery -----------------------------------------------------
+    def _backoff_for(self, attempt):
+        """attempt >= 1.  Deterministic jitter: a hash mix of the link
+        seed and the call ordinal, NOT random — reproducible runs, but
+        distinct links (and distinct calls) still decorrelate."""
+        base = min(self.backoff * (2.0 ** (attempt - 1)), self.backoff_max)
+        mix = ((self.seed * 2654435761 + self.calls * 40503 + attempt)
+               & 0xFFFFFFFF)
+        frac = (mix % 997) / 996.0
+        delay = base * (1.0 + self.jitter * frac)
+        self.backoffs.append(delay)
+        return delay
+
+    def begin(self, method, args=None):
+        """Send a call without waiting for the reply."""
+        with self._lock:
+            call_id = self._next_id
+            self._next_id += 1
+        self.calls += 1
+        frame = wire.encode_frame(
+            {"id": call_id, "m": method, "a": args or {}}, self.codec)
+        needs_send = False
+        try:
+            self._send(frame)
+        except OSError:
+            needs_send = True      # finish() retries the send
+        return _Call(call_id, method, frame, needs_send)
+
+    def finish(self, call, timeout=None):
+        """Wait for (and if needed re-drive) a begun call's reply."""
+        if timeout is None:
+            timeout = self.timeouts.get(call.method, self.timeout)
+        last_exc = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.retries += 1
+                _RETRIES.inc()
+                self.sleep(self._backoff_for(attempt))
+                call.needs_send = True
+            if call.needs_send:
+                try:
+                    self._send(call.frame)
+                    call.needs_send = False
+                except OSError as exc:
+                    last_exc = exc
+                    continue
+            try:
+                reply = self._recv_reply(call.id, timeout)
+            except (wire.FrameError, OSError) as exc:
+                last_exc = exc
+                continue
+            _CALLS.inc(labels=(call.method, "ok"))
+            return self._unwrap(reply)
+        _CALLS.inc(labels=(call.method, "error"))
+        if isinstance(last_exc, TransportError):
+            raise last_exc
+        if isinstance(last_exc, (TimeoutError, socket.timeout)):
+            raise TransportTimeout(
+                f"rpc {call.method!r}: no reply within {timeout}s "
+                f"after {self.max_retries + 1} attempts") from last_exc
+        raise TransportSevered(
+            f"rpc {call.method!r}: link failed after "
+            f"{self.max_retries + 1} attempts ({last_exc!r})") from last_exc
+
+    def call(self, method, args=None, timeout=None):
+        return self.finish(self.begin(method, args), timeout)
+
+    def _recv_reply(self, call_id, timeout):
+        """Read frames until the one matching ``call_id``.  Stale or
+        duplicated replies (chaos duplication, an earlier abandoned
+        attempt's late reply) are dropped by id — ids are never
+        reused, so a mismatch is always safe to discard."""
+        deadline = self.clock() + timeout
+        while True:
+            remaining = deadline - self.clock()
+            if remaining <= 0:
+                raise TransportTimeout(
+                    f"rpc id {call_id}: reply timeout after {timeout}s")
+            msg = wire.decode_frame(self._recv_bytes(remaining))
+            if isinstance(msg, dict) and msg.get("id") == call_id:
+                return msg
+
+    def _unwrap(self, reply):
+        self.last_ok_time = self.clock()
+        if reply.get("load") is not None:
+            self.last_load = reply["load"]
+        err = reply.get("err")
+        if err is not None:
+            raise outcome_from_wire(err)
+        return reply.get("ok")
+
+
+# ---------------------------------------------------------------------------
+# Loopback (in-process) transport
+# ---------------------------------------------------------------------------
+class LoopbackTransport(Transport):
+    """In-process transport over a real byte-level frame boundary: the
+    request is ENCODED, handed to the server as bytes, and the reply
+    decoded — so codec, idempotency, and chaos corruption behave
+    exactly as over a socket, minus the kernel."""
+
+    def __init__(self, server, **kw):
+        super().__init__(**kw)
+        self.server = server
+        self._rx = deque()
+
+    def _send(self, frame):
+        if self.server.dead:
+            raise TransportSevered("loopback: peer is dead")
+        _BYTES.inc(len(frame), labels=("tx",))
+        reply = self.server.handle_frame(bytes(frame))
+        if reply is not None:
+            _BYTES.inc(len(reply), labels=("rx",))
+            self._rx.append(reply)
+
+    def _recv_bytes(self, timeout):
+        if not self._rx:
+            raise TransportTimeout("loopback: no reply buffered")
+        return self._rx.popleft()
+
+
+# ---------------------------------------------------------------------------
+# Socket transport
+# ---------------------------------------------------------------------------
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransportSevered("socket: peer closed the connection")
+        buf += chunk
+    return bytes(buf)
+
+
+class SocketTransport(Transport):
+    """Length-prefixed frames over TCP (loopback interface by default).
+    Connects lazily and reconnects after any fault, so a respawned
+    worker on the same port is picked up by the normal retry path."""
+
+    def __init__(self, host, port, *, connect_timeout=10.0, **kw):
+        super().__init__(**kw)
+        self.host = host
+        self.port = int(port)
+        self.connect_timeout = float(connect_timeout)
+        self._sock = None
+
+    def _ensure_conn(self):
+        if self._sock is None:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self.connect_timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+        return self._sock
+
+    def _drop_conn(self):
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _send(self, frame):
+        try:
+            sock = self._ensure_conn()
+            sock.sendall(frame)
+            _BYTES.inc(len(frame), labels=("tx",))
+        except OSError:
+            self._drop_conn()
+            raise
+
+    def _recv_bytes(self, timeout):
+        try:
+            sock = self._ensure_conn()
+            sock.settimeout(max(timeout, 0.001))
+            header = _recv_exact(sock, wire.HEADER_SIZE)
+            _, length, _ = wire.parse_header(header)
+            payload = _recv_exact(sock, length)
+        except socket.timeout as exc:
+            raise TransportTimeout("socket: reply timeout") from exc
+        except wire.FrameError:
+            # unsynced stream — drop the connection so the next attempt
+            # starts on a clean frame boundary
+            self._drop_conn()
+            raise
+        except OSError:
+            self._drop_conn()
+            raise
+        _BYTES.inc(len(header) + len(payload), labels=("rx",))
+        return header + payload
+
+    def close(self):
+        self._drop_conn()
+
+
+# ---------------------------------------------------------------------------
+# Server half
+# ---------------------------------------------------------------------------
+class ReplicaServer:
+    """RPC dispatcher over one live engine.  ``handle_frame(bytes) ->
+    bytes`` is transport-agnostic: the loopback calls it directly, the
+    socket loop feeds it.  Replies carry the engine's ``load()``
+    snapshot so the client's routing view is refreshed by every call
+    with zero extra round trips."""
+
+    IDEMPOTENCY_WINDOW = 128
+
+    def __init__(self, engine, *, replica_id=0, model_factory=None,
+                 scrape_port=None, codec=None):
+        self.engine = engine
+        self.replica_id = replica_id
+        self.model_factory = model_factory
+        self.scrape_port = scrape_port
+        self.codec = codec
+        self.dead = False
+        self.shutting_down = False
+        self.weights_version = 0
+        self._done = OrderedDict()     # call id -> encoded reply bytes
+        self._events = []              # buffered (rid, token) stream
+        self.handled = 0
+        self.duplicates = 0
+
+    # engine token streaming lands in the buffer; step/stream drain it
+    def _event_cb(self, rid, tok):
+        self._events.append((int(rid), int(tok)))
+
+    def handle_frame(self, data):
+        try:
+            msg = wire.decode_frame(data)
+        except wire.FrameError as exc:
+            # can't know the call id of a corrupt request — answer with
+            # an unaddressed error frame; the client drops it and
+            # re-sends on its own timeout
+            return wire.encode_frame(
+                {"id": None, "err": outcome_to_wire(exc)}, self.codec)
+        call_id = msg.get("id")
+        cached = self._done.get(call_id)
+        if cached is not None:
+            # duplicate / re-sent frame: replay, do NOT re-execute
+            self.duplicates += 1
+            self._done.move_to_end(call_id)
+            return cached
+        self.handled += 1
+        try:
+            result = self._dispatch(msg.get("m"), msg.get("a") or {})
+            reply = {"id": call_id, "ok": result}
+        except SimulatedCrash:
+            raise
+        except Exception as exc:
+            reply = {"id": call_id, "err": outcome_to_wire(exc)}
+        try:
+            reply["load"] = self.engine.load()
+        except Exception:
+            reply["load"] = None
+        out = wire.encode_frame(reply, self.codec)
+        if call_id is not None:
+            self._done[call_id] = out
+            while len(self._done) > self.IDEMPOTENCY_WINDOW:
+                self._done.popitem(last=False)
+        return out
+
+    # -- dispatch -----------------------------------------------------------
+    def _dispatch(self, method, a):
+        handler = getattr(self, "_rpc_" + str(method), None)
+        if handler is None:
+            raise ValueError(f"rpc: unknown method {method!r}")
+        return handler(a)
+
+    def _rpc_hello(self, a):
+        eng = self.engine
+        return {
+            "replica_id": self.replica_id,
+            "pid": os.getpid(),
+            "max_slots": eng.max_slots,
+            "max_new_tokens": eng.max_new_tokens,
+            "page": eng.page,
+            "pages_per_seq": eng.pages_per_seq,
+            "int8_kv": bool(getattr(eng, "int8_kv", False)),
+            "scrape_port": self.scrape_port,
+            "weights_version": self.weights_version,
+        }
+
+    def _rpc_ping(self, a):
+        return {"ok": True, "replica_id": self.replica_id,
+                "pid": os.getpid()}
+
+    def _rpc_submit(self, a):
+        rid = self.engine.submit(
+            a["prompt"],
+            temperature=a.get("temperature", 0.0),
+            top_k=a.get("top_k", 0),
+            top_p=a.get("top_p", 1.0),
+            on_token=self._event_cb,
+            deadline_seconds=a.get("deadline_seconds"),
+            rid=a.get("rid"))
+        return int(rid)
+
+    def _drain_events(self):
+        ev, self._events = self._events, []
+        return ev
+
+    def _drain_cancelled(self):
+        c = {int(r): str(reason)
+             for r, reason in self.engine.cancelled.items()}
+        self.engine.cancelled.clear()
+        return c
+
+    def _rpc_step(self, a):
+        done = self.engine.step()
+        return {"done": {int(r): [int(t) for t in ids]
+                         for r, ids in done.items()},
+                "events": self._drain_events(),
+                "cancelled": self._drain_cancelled()}
+
+    def _rpc_stream(self, a):
+        # drain buffered token events without stepping
+        return {"events": self._drain_events(),
+                "cancelled": self._drain_cancelled()}
+
+    def _rpc_cancel(self, a):
+        ok = bool(self.engine.cancel(a["rid"],
+                                     reason=a.get("reason", "client")))
+        return {"ok": ok, "cancelled": self._drain_cancelled()}
+
+    def _rpc_load(self, a):
+        return self.engine.load()
+
+    def _rpc_prefix_match_pages(self, a):
+        return int(self.engine.prefix_match_pages(a["tokens"]))
+
+    def _rpc_extract(self, a):
+        req = self.engine.extract(a["slot"])
+        return wire.request_to_wire(req)
+
+    def _rpc_inject(self, a):
+        req = wire.request_from_wire(a["req"])
+        req.on_token = self._event_cb
+        self.engine.inject(req)
+        return int(req.rid)
+
+    def _rpc_drain(self, a):
+        """Serialize EVERYTHING queued or running and empty the engine:
+        the KV-migration point of a rolling upgrade.  Occupied slots go
+        through ``extract()`` (host KV snapshot rides along); waiting
+        requests ship as-is."""
+        eng = self.engine
+        running = []
+        for i, r in enumerate(eng._slots):
+            if r is not None:
+                running.append(wire.request_to_wire(eng.extract(i)))
+        waiting = []
+        while eng._waiting:
+            waiting.append(wire.request_to_wire(eng._waiting.popleft()))
+        return {"running": running, "waiting": waiting}
+
+    def _rpc_reload_weights(self, a):
+        version = a.get("version")
+        model = None
+        if self.model_factory is not None:
+            model = self.model_factory(version=version)
+        self.engine.reload_weights(model)
+        if version is not None:
+            self.weights_version = version
+        return {"weights_version": self.weights_version}
+
+    def _rpc_warmup(self, a):
+        self.engine.warmup(sample=a.get("sample", False))
+        return {"build_seconds": self.engine.build_seconds}
+
+    def _rpc_stats(self, a):
+        from .soak import _engine_stats
+        return _engine_stats(self.engine)
+
+    def _rpc_shutdown(self, a):
+        self.shutting_down = True
+        return {"ok": True}
+
+    def _rpc_crash(self, a):
+        raise SimulatedCrash("chaos: crash requested over RPC")
+
+
+# ---------------------------------------------------------------------------
+# Socket serve loop (runs in the worker process)
+# ---------------------------------------------------------------------------
+class SocketServerLoop:
+    """Accept one parent connection at a time and pump frames through a
+    :class:`ReplicaServer` until it flags shutdown.  A fresh connection
+    after a drop (parent restarted its transport) is business as usual."""
+
+    def __init__(self, server, *, host="127.0.0.1", port=0):
+        self.server = server
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(4)
+        self.host, self.port = self._listener.getsockname()[:2]
+
+    def serve_forever(self, accept_timeout=1.0):
+        self._listener.settimeout(accept_timeout)
+        while not self.server.shutting_down:
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                self._pump(conn)
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        self._listener.close()
+
+    def _pump(self, conn):
+        conn.settimeout(0.5)
+        while not self.server.shutting_down:
+            try:
+                header = _recv_exact(conn, wire.HEADER_SIZE)
+            except socket.timeout:
+                continue
+            except TransportSevered:
+                return                     # parent dropped; re-accept
+            try:
+                _, length, _ = wire.parse_header(header)
+                conn.settimeout(10.0)
+                payload = _recv_exact(conn, length)
+            except wire.FrameError:
+                return                     # unsynced stream; re-accept
+            except (socket.timeout, TransportSevered):
+                return
+            finally:
+                conn.settimeout(0.5)
+            reply = self.server.handle_frame(header + payload)
+            if reply is not None:
+                try:
+                    conn.sendall(reply)
+                except OSError:
+                    return
+
+
+# ---------------------------------------------------------------------------
+# Client proxy
+# ---------------------------------------------------------------------------
+class RemoteEngine:
+    """Duck-types the engine surface the fleet consumes (submit / step /
+    cancel / load / prefix_match_pages / cancelled / extract / inject /
+    reload_weights / warmup), so it drops into a ``ReplicaHandle``
+    unchanged.  Token events from step replies are replayed into
+    client-side callbacks; ``load()`` is served from the snapshot the
+    server attaches to every reply (zero extra round trips on the
+    routing hot path)."""
+
+    def __init__(self, transport, *, hello=True):
+        self.transport = transport
+        self.cancelled = {}           # client-side mirror, router drains
+        self._cbs = {}                # rid -> client on_token callback
+        self._load = None
+        self._pending_step = None
+        self.pid = None
+        self.scrape_port = None
+        self.replica_id = None
+        self.weights_version = 0
+        if hello:
+            info = transport.call("hello")
+            self.max_slots = info["max_slots"]
+            self.max_new_tokens = info["max_new_tokens"]
+            self.page = info["page"]
+            self.pages_per_seq = info["pages_per_seq"]
+            self.int8_kv = info["int8_kv"]
+            self.pid = info["pid"]
+            self.scrape_port = info.get("scrape_port")
+            self.replica_id = info.get("replica_id")
+            self.weights_version = info.get("weights_version", 0)
+            self._refresh_load()
+
+    # -- bookkeeping --------------------------------------------------------
+    def _refresh_load(self):
+        if self.transport.last_load is not None:
+            self._load = self.transport.last_load
+
+    def _absorb(self, reply):
+        """Fold a step/stream/cancel reply's events + cancels into the
+        client-side stream state, exactly once per reply."""
+        for rid, tok in reply.get("events") or []:
+            cb = self._cbs.get(rid)
+            if cb is not None:
+                cb(rid, tok)
+        for rid, reason in (reply.get("cancelled") or {}).items():
+            rid = int(rid)
+            self.cancelled[rid] = reason
+            self._cbs.pop(rid, None)
+        self._refresh_load()
+
+    # -- engine surface -----------------------------------------------------
+    def submit(self, prompt_ids, temperature=0.0, top_k=0, top_p=1.0,
+               on_token=None, deadline_seconds=None, rid=None):
+        out = self.transport.call("submit", {
+            "prompt": [int(t) for t in prompt_ids],
+            "temperature": float(temperature),
+            "top_k": int(top_k), "top_p": float(top_p),
+            "deadline_seconds": deadline_seconds,
+            "rid": rid,
+        })
+        out = int(out)
+        if on_token is not None:
+            self._cbs[out] = on_token
+        self._refresh_load()
+        return out
+
+    def prestep(self):
+        """Issue the step RPC without collecting it — the supervisor
+        calls this for every routable replica before the router's
+        sequential collection pass, so child processes decode
+        CONCURRENTLY on real wall clock."""
+        if self._pending_step is None:
+            self._pending_step = self.transport.begin("step", {})
+
+    def step(self):
+        call, self._pending_step = self._pending_step, None
+        try:
+            if call is not None:
+                reply = self.transport.finish(call)
+            else:
+                reply = self.transport.call("step", {})
+        except BaseException:
+            self._pending_step = None
+            raise
+        self._absorb(reply)
+        done = {int(r): list(ids)
+                for r, ids in (reply.get("done") or {}).items()}
+        for rid in done:
+            self._cbs.pop(rid, None)
+        return done
+
+    def run_until_complete(self, max_ticks=10000):
+        """Drive the remote engine until it drains (parity with the
+        in-process engine surface; tests and small tools use it)."""
+        done = {}
+        for _ in range(max_ticks):
+            done.update(self.step())
+            load = self.load()
+            if not load.get("queue_depth") and \
+                    not load.get("occupied_slots"):
+                return done
+        raise TimeoutError("remote serving loop did not drain")
+
+    def cancel(self, rid, reason="client"):
+        reply = self.transport.call("cancel", {"rid": int(rid),
+                                               "reason": reason})
+        self._absorb(reply)
+        self._cbs.pop(int(rid), None)
+        return bool(reply["ok"])
+
+    def load(self):
+        if self._load is None:
+            self._load = self.transport.call("load", {})
+        return self._load
+
+    def prefix_match_pages(self, tokens):
+        return self.transport.call("prefix_match_pages",
+                                   {"tokens": [int(t) for t in tokens]})
+
+    def stream(self):
+        self._absorb(self.transport.call("stream", {}))
+
+    # -- migration / upgrade seam -------------------------------------------
+    def extract_wire(self, slot):
+        return self.transport.call("extract", {"slot": int(slot)})
+
+    def inject_wire(self, req_wire):
+        return int(self.transport.call("inject", {"req": req_wire}))
+
+    def drain_requests(self):
+        return self.transport.call("drain", {})
+
+    def release_stream(self, rid):
+        """Detach and return the client callback for ``rid`` (the
+        stream is moving to a peer replica)."""
+        return self._cbs.pop(int(rid), None)
+
+    def adopt_stream(self, rid, cb):
+        if cb is not None:
+            self._cbs[int(rid)] = cb
+
+    def reload_weights(self, model=None, version=None):
+        if model is not None:
+            raise ValueError(
+                "RemoteEngine.reload_weights ships a version tag, not a "
+                "live model — the worker rebuilds from its model spec")
+        out = self.transport.call("reload_weights", {"version": version})
+        self.weights_version = out["weights_version"]
+        self._load = None
+        return out
+
+    def warmup(self, sample=False):
+        out = self.transport.call("warmup", {"sample": sample})
+        # match the engine surface: warmup() returns build_seconds
+        self.build_seconds = out["build_seconds"]
+        return self.build_seconds
+
+    def engine_stats(self):
+        try:
+            return self.transport.call("stats", {})
+        except (TransportError, wire.FrameError, OSError):
+            # a dead replica's counters died with it; report the link
+            # state instead of failing the whole soak's accounting
+            return {"disaggregated": False, "unreachable": True,
+                    "preemptions": 0, "prefix_hit_pages": 0,
+                    "cancellations": 0, "handoffs": 0,
+                    "handoff_bytes": 0, "int8_kv": False,
+                    "int8_weights": False, "weight_bytes": {},
+                    "spec": None}
+
+    def ping(self, timeout=None):
+        return self.transport.call("ping", {}, timeout=timeout)
+
+    def shutdown(self):
+        try:
+            return self.transport.call("shutdown", {})
+        except (TransportError, wire.FrameError, OSError):
+            return None
+
+    def close(self):
+        self.transport.close()

@@ -39,13 +39,16 @@ class ScrapeServer:
     """One registry (+ optional recorder) behind an HTTP endpoint."""
 
     def __init__(self, registry, recorder=None, *, port=0,
-                 host="127.0.0.1"):
+                 host="127.0.0.1", replica_id=None):
         self.registry = registry
         self.recorder = recorder
         self._host = host
         self._want_port = int(port)
         self._httpd = None
         self._thread = None
+        #: fleet replica id, if this endpoint serves a child process
+        #: (shows up in /healthz and the scrape_endpoint gauge label)
+        self.replica_id = replica_id
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -76,6 +79,19 @@ class ScrapeServer:
             target=self._httpd.serve_forever, daemon=True,
             name="ptpu-scrape")
         self._thread.start()
+        # Register the bound (possibly auto-picked) port on the registry
+        # so a supervisor scraping the parent can discover child
+        # endpoints: `port=0` is resolved by the kernel, and the only
+        # in-band channel back out is a metric.
+        try:
+            label = (str(self.replica_id) if self.replica_id is not None
+                     else "main")
+            self.registry.gauge(
+                "scrape_endpoint",
+                "bound port of a /metrics scrape endpoint, by replica",
+                ("replica",)).set(float(self.port), (label,))
+        except Exception:       # a scrape endpoint must never kill boot
+            pass
         return self
 
     def stop(self):
@@ -126,6 +142,9 @@ class ScrapeServer:
                     "ok": True,
                     "enabled": bool(getattr(self.registry, "enabled",
                                             False)),
+                    "replica_id": self.replica_id,
+                    "pid": os.getpid(),
+                    "port": self.port,
                     "routes": ["/metrics", "/timeline", "/flight",
                                "/healthz"]})
             else:

@@ -26,6 +26,11 @@ Four fault families, matching how real training jobs die
   latency (straggler), intermittent transient exceptions, a flapping
   replica — the seam the FleetRouter circuit breakers are proven
   against (docs/SERVING.md "Overload & degradation").
+- **Wire faults**: `ChaosTransport` wraps one fleet transport link
+  with deterministic send-ordinal-keyed frame faults — drop, delay,
+  duplicate, corrupt (byte flip past the header), and sever-for-N-calls
+  — the seam the RPC retry/idempotency machinery is proven against
+  (docs/SERVING.md "Process topology").
 
 Every injector routes through a seam its subsystem exposes
 (`distributed.checkpoint._WRITE_FAULT_HOOK` for writes,
@@ -376,3 +381,111 @@ def subprocess_env(extra=None):
     if extra:
         env.update(extra)
     return env
+
+
+class ChaosTransport:
+    """Deterministic frame-level fault injection on ONE fleet link.
+
+    Wraps a live :class:`~paddle_tpu.inference.fleet.transport.Transport`
+    and interposes on its byte-level `_send` / `_recv_bytes` seam, so the
+    retry / idempotency / CRC machinery above it is exercised for real —
+    nothing here monkeypatches transport internals, and the call-level
+    semantics (ids, backoff, timeouts) are the wrapped transport's own.
+
+    Faults key on the 1-based SEND ordinal (every `_send` attempt,
+    including retries, increments it), so a schedule like
+    ``drop_sends={1}`` is reproducible run to run:
+
+    - ``drop_sends``: the frame silently vanishes (client times out and
+      re-sends the same call id; the server's idempotency cache keeps it
+      exactly-once).
+    - ``corrupt_sends``: one payload byte is flipped (server's CRC check
+      rejects it loudly; never half-parsed).
+    - ``duplicate_sends``: the frame is delivered twice (server replays
+      the cached reply; the duplicate must not re-execute).
+    - ``delay_sends`` + ``delay``: injected latency before delivery.
+    - ``sever_for(n)``: the next ``n`` send attempts raise
+      `TransportSevered` (a dead link that heals — the breaker's
+      backoff-and-replay case).
+    - ``corrupt_recvs``: flips a byte in a REPLY frame instead.
+    """
+
+    def __init__(self, inner, *, drop_sends=(), corrupt_sends=(),
+                 duplicate_sends=(), delay_sends=(), delay=0.0,
+                 corrupt_recvs=(), sleep=time.sleep):
+        self._inner = inner
+        self.drop_sends = set(drop_sends)
+        self.corrupt_sends = set(corrupt_sends)
+        self.duplicate_sends = set(duplicate_sends)
+        self.delay_sends = set(delay_sends)
+        self.delay = float(delay)
+        self.corrupt_recvs = set(corrupt_recvs)
+        self._sleep = sleep
+        self.sends = 0
+        self.recvs = 0
+        self.dropped = 0
+        self.corrupted = 0
+        self.duplicated = 0
+        self.severed_calls = 0
+        self._sever_left = 0
+        # the retry/call machinery runs on the wrapped transport with
+        # OUR byte seam spliced in
+        inner._send = self._send_faulted(inner.__class__._send, inner)
+        inner._recv_bytes = self._recv_faulted(
+            inner.__class__._recv_bytes, inner)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    # -- fault schedule ------------------------------------------------------
+    def sever_for(self, n):
+        """Sever the link for the next ``n`` send attempts."""
+        self._sever_left = int(n)
+
+    @staticmethod
+    def _flip_byte(frame):
+        """Flip one PAYLOAD byte (past the header) so the CRC check —
+        not the length prefix — is what catches it."""
+        from paddle_tpu.inference.fleet import wire as _wire
+
+        buf = bytearray(frame)
+        pos = _wire.HEADER_SIZE if len(buf) > _wire.HEADER_SIZE else 0
+        buf[pos] ^= 0xFF
+        return bytes(buf)
+
+    def _send_faulted(self, real_send, inner):
+        from paddle_tpu.inference.fleet.transport import TransportSevered
+
+        def _send(frame):
+            self.sends += 1
+            n = self.sends
+            if self._sever_left > 0:
+                self._sever_left -= 1
+                self.severed_calls += 1
+                raise TransportSevered(
+                    f"chaos: link severed ({self._sever_left} left)")
+            if n in self.drop_sends:
+                self.dropped += 1
+                return                      # the frame never arrives
+            if n in self.delay_sends and self.delay > 0:
+                self._sleep(self.delay)
+            if n in self.corrupt_sends:
+                self.corrupted += 1
+                frame = self._flip_byte(frame)
+            real_send(inner, frame)
+            if n in self.duplicate_sends:
+                self.duplicated += 1
+                real_send(inner, frame)
+
+        return _send
+
+    def _recv_faulted(self, real_recv, inner):
+        def _recv_bytes(timeout):
+            data = real_recv(inner, timeout)
+            self.recvs += 1
+            if self.recvs in self.corrupt_recvs:
+                self.corrupted += 1
+                data = self._flip_byte(data)
+            return data
+
+        return _recv_bytes

@@ -1,0 +1,187 @@
+"""Real multi-process fleet (slow): fork/exec workers, socket
+transport, chaos link faults, SIGKILL mid-soak, rolling weight upgrade,
+and per-child crash forensics.
+
+Everything here spawns actual OS processes (``python -m
+paddle_tpu.inference.fleet.worker``), so the module is slow-marked; the
+same machinery runs fast in-process in tests/test_transport_cluster.py and
+tests/test_transport.py.  The acceptance scenario (ISSUE 18 /
+docs/SERVING.md "Process topology"): a >=4-replica process fleet with a
+chaos-injected link and one SIGKILL'd replica conserves outcomes and
+completes a rolling weight upgrade with zero lost requests, asserted by
+the bench_gate UPGRADE gate — and the proc backend's outputs are
+BITWISE the in-process backend's.
+"""
+import glob
+import json
+import os
+import signal
+import sys
+
+import pytest
+
+from paddle_tpu.inference.fleet import (FleetSupervisor, build_workload,
+                                        make_model_spec, run_soak,
+                                        upgrade_block)
+from paddle_tpu.inference.fleet.transport import (TransportError,
+                                                  TransportSevered,
+                                                  TransportTimeout)
+from paddle_tpu.inference.fleet import wire
+from paddle_tpu.telemetry import flight as _flight
+from paddle_tpu.testing.chaos import ChaosTransport
+
+pytestmark = pytest.mark.slow
+
+CONFIG_KW = dict(vocab_size=64, hidden_size=32, num_layers=1,
+                 num_heads=2, num_kv_heads=2, max_seq_len=64)
+ENGINE_KW = dict(max_slots=2, page_size=8, max_new_tokens=4,
+                 max_seq_len=48, seed=0)
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def _spec(**kw):
+    return make_model_spec(dict(CONFIG_KW), seed=0,
+                           engine_kw=dict(ENGINE_KW), **kw)
+
+
+def _wl(n, seed=1):
+    return build_workload(n, 50.0, (4, 6), 64, seed=seed)
+
+
+def _gate():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    return bench_gate
+
+
+class TestAcceptanceScenario:
+    def test_four_procs_chaos_kill_upgrade_gated(self):
+        """The acceptance run: 4 replica PROCESSES, chaos transport on
+        one link, replica 0 SIGKILL'd at tick 2, rolling upgrade from
+        tick 5 — conserved outcomes, exactly-once streams, completed
+        upgrade, all through the UPGRADE gate; outputs bitwise the
+        in-process control."""
+        chaos = {1: lambda t: ChaosTransport(
+            t, drop_sends={5}, duplicate_sends={9}, corrupt_sends={13})}
+        sup = FleetSupervisor(
+            _spec(), 4, proc=True, lease_seconds=120.0, chaos=chaos,
+            transport_kw=dict(timeouts={"step": 10.0, "submit": 10.0},
+                              backoff=0.01))
+        if not sup.proc:
+            sup.close()
+            pytest.skip("PTPU_FLEET_PROC=0 in this environment")
+        try:
+            assert all(c.pid > 0 for c in sup.children.values())
+            blk = upgrade_block(sup, _wl(24), version=1, upgrade_tick=5,
+                                kill_tick=2, kill_replica=0)
+        finally:
+            sup.close()
+        assert blk["backend"] == "proc"
+        assert _gate().upgrade_violations({"upgrade": blk}) == []
+        assert blk["conserved"] and blk["served"] == 24
+        assert blk["duplicate_stream_tokens"] == 0
+        assert blk["lost_stream_tokens"] == 0
+        assert blk["upgrade"]["complete"]
+        assert blk["kill"]["respawns"] >= 1
+
+    def test_proc_backend_bitwise_vs_inproc(self):
+        """A clean (no-fault) soak through real processes produces
+        BITWISE the outputs of the in-process loopback backend: the
+        spec rebuilds identical weights from the same seed, and greedy
+        decode is batch-invariant."""
+        sup = FleetSupervisor(_spec(), 2, proc=True, lease_seconds=120.0)
+        if not sup.proc:
+            sup.close()
+            pytest.skip("PTPU_FLEET_PROC=0 in this environment")
+        try:
+            stats_p, done_p = run_soak(sup, _wl(10))
+        finally:
+            sup.close()
+        ctrl = FleetSupervisor(_spec(), 2, proc=False,
+                               lease_seconds=120.0)
+        try:
+            stats_i, done_i = run_soak(ctrl, _wl(10))
+        finally:
+            ctrl.close()
+        assert stats_p["outcomes_conserved"]
+        assert stats_i["outcomes_conserved"]
+        assert done_p == done_i
+
+
+class TestServeBenchProcs:
+    def test_serve_bench_procs_wrapper(self, capsys):
+        """tools/serve_bench.py --procs N end to end with a tiny
+        config, UPGRADE-gated."""
+        sys.path.insert(0, _TOOLS)
+        try:
+            import serve_bench
+            serve_bench.main(["--procs", "2", "--requests", "12",
+                              "--kill-tick", "2", "--upgrade-tick", "4"])
+        finally:
+            sys.path.pop(0)
+        lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        assert lines, "serve_bench --procs emitted no metric line"
+        rec = json.loads(lines[-1])
+        assert rec["metric"].startswith("serve_upgrade_procs_r2")
+        assert _gate().upgrade_violations(rec) == []
+        assert rec["upgrade"]["conserved"]
+
+
+class TestChildCrashForensics:
+    def test_unhandled_crash_dumps_bundle(self, tmp_path):
+        """An unhandled exception in a replica process dumps a
+        ptpu-flight-1 ``replica_crash`` bundle before exiting non-zero;
+        tools/flight_report.py validates it."""
+        sup = FleetSupervisor(_spec(flight_dir=str(tmp_path)), 1,
+                              proc=True, lease_seconds=120.0,
+                              respawn=False)
+        if not sup.proc:
+            sup.close()
+            pytest.skip("PTPU_FLEET_PROC=0 in this environment")
+        try:
+            child = sup.children[0]
+            with pytest.raises((TransportError, TransportTimeout,
+                                TransportSevered, OSError,
+                                wire.FrameError)):
+                child.transport.call("crash", {}, timeout=5.0)
+            assert child.wait(timeout=30.0) == 1   # loud non-zero exit
+        finally:
+            sup.close()
+        bundles = glob.glob(str(tmp_path / "flight_replica_crash_*"))
+        assert bundles, "child dumped no replica_crash bundle"
+        b = _flight.load_bundle(bundles[0])
+        assert _flight.validate_bundle(b) == []
+        assert b["reason"] == "replica_crash"
+        assert "SimulatedCrash" in b["context"]["exc"]
+        assert b["context"]["traceback"]
+        sys.path.insert(0, _TOOLS)
+        try:
+            import flight_report
+            assert flight_report.main(["--quiet"] + bundles) == 0
+        finally:
+            sys.path.pop(0)
+
+    def test_sigterm_dumps_bundle(self, tmp_path):
+        """SIGTERM dumps a ``replica_sigterm`` bundle and exits 0."""
+        sup = FleetSupervisor(_spec(flight_dir=str(tmp_path)), 1,
+                              proc=True, lease_seconds=120.0,
+                              respawn=False)
+        if not sup.proc:
+            sup.close()
+            pytest.skip("PTPU_FLEET_PROC=0 in this environment")
+        try:
+            child = sup.children[0]
+            child.proc.send_signal(signal.SIGTERM)
+            assert child.wait(timeout=30.0) == 0   # clean shutdown
+        finally:
+            sup.close()
+        bundles = glob.glob(str(tmp_path / "flight_replica_sigterm_*"))
+        assert bundles, "child dumped no replica_sigterm bundle"
+        b = _flight.load_bundle(bundles[0])
+        assert _flight.validate_bundle(b) == []
+        assert b["context"]["signal"] == int(signal.SIGTERM)

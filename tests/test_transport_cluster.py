@@ -1,0 +1,245 @@
+"""FleetSupervisor over in-process loopback children: lifecycle,
+heartbeat-lease deaths, exactly-once replay, rolling upgrades, and the
+autoscaler policy.
+
+These tests run the REAL supervisor machinery (transport RPCs, drain /
+extract / inject migration, reload_weights) against LocalChild replicas
+— every code path of the multi-process fleet except fork/exec, which
+``tests/test_fleet_procs.py`` covers slow-marked.  The load-bearing
+guarantees (docs/SERVING.md "Process topology"):
+
+- every submitted request reaches exactly one terminal outcome through
+  SIGKILL + respawn and through a rolling weight upgrade;
+- a run with a mid-soak kill and a rolling upgrade produces BITWISE the
+  outputs of an undisturbed control run (greedy decode is
+  batch-invariant, streams replay exactly-once, and
+  ``version_seed_stride=0`` keeps reloaded weights identical);
+- ``PTPU_FLEET_PROC=0`` forces the in-process backend, bitwise;
+- a dead replica's ``replica_death`` flight bundle records the child's
+  exit code and last heartbeat age, and validates as ``ptpu-flight-1``.
+"""
+import glob
+import os
+import sys
+
+import pytest
+
+from paddle_tpu.inference.fleet import (AutoscaleConfig, Autoscaler,
+                                        FleetSupervisor, build_workload,
+                                        fleet_proc_enabled,
+                                        make_model_spec, run_soak,
+                                        upgrade_block)
+from paddle_tpu.telemetry import flight as _flight
+
+CONFIG_KW = dict(vocab_size=64, hidden_size=32, num_layers=1,
+                 num_heads=2, num_kv_heads=2, max_seq_len=64)
+ENGINE_KW = dict(max_slots=2, page_size=8, max_new_tokens=4,
+                 max_seq_len=48, seed=0)
+
+
+def _spec(**kw):
+    return make_model_spec(dict(CONFIG_KW), seed=0,
+                           engine_kw=dict(ENGINE_KW), **kw)
+
+
+def _sup(n=2, **kw):
+    kw.setdefault("proc", False)
+    kw.setdefault("lease_seconds", 120.0)
+    return FleetSupervisor(_spec(), n, **kw)
+
+
+def _wl(n=12, seed=1):
+    return build_workload(n, 50.0, (4, 6), 64, seed=seed)
+
+
+class TestSupervisorBasics:
+    def test_soak_conserves_and_balances(self):
+        sup = _sup(2)
+        try:
+            stats, done = run_soak(sup, _wl(12))
+            assert stats["outcomes_conserved"]
+            assert stats["completed"] == 12
+            dispatched = stats["router"]["dispatched"]
+            assert all(d > 0 for d in dispatched)
+            assert sup.summary()["proc_backend"] is False
+        finally:
+            sup.close()
+
+    def test_env_hatch_forces_inproc_bitwise(self, monkeypatch):
+        monkeypatch.setenv("PTPU_FLEET_PROC", "0")
+        assert fleet_proc_enabled() is False
+        sup_a = FleetSupervisor(_spec(), 2, proc=True,
+                                lease_seconds=120.0)
+        try:
+            assert sup_a.proc is False          # the hatch won
+            _, done_a = run_soak(sup_a, _wl(10))
+        finally:
+            sup_a.close()
+        sup_b = _sup(2)
+        try:
+            _, done_b = run_soak(sup_b, _wl(10))
+        finally:
+            sup_b.close()
+        assert done_a == done_b                  # bitwise
+
+    def test_classify_heartbeat_lost(self):
+        from paddle_tpu.inference.fleet.cluster import HeartbeatLost
+        from paddle_tpu.inference.fleet.overload import \
+            classify_step_exception
+        exc = HeartbeatLost("heartbeat lease expired (31.0s > 30.0s)")
+        assert classify_step_exception(exc) == "transient"
+
+
+class TestKillRespawnForensics:
+    def test_kill_replays_and_respawns(self, tmp_path):
+        _flight.install(str(tmp_path))
+        try:
+            sup = _sup(2)
+            try:
+                stats, done = run_soak(
+                    sup, _wl(12),
+                    on_tick=lambda t: (t == 1 and
+                                       sup.children[0].kill()))
+                assert stats["outcomes_conserved"]
+                assert stats["completed"] == 12
+                s = sup.summary()
+                assert s["lease_deaths"] == 1
+                assert s["respawns"] == 1
+            finally:
+                sup.close()
+        finally:
+            _flight.uninstall()
+        bundles = glob.glob(str(tmp_path / "flight_replica_death_*"))
+        assert bundles, "no replica_death bundle dumped"
+        b = _flight.load_bundle(bundles[0])   # raises if malformed
+        assert _flight.validate_bundle(b) == []
+        ctx = b["context"]
+        assert ctx["supervisor"] is True
+        assert ctx["exit_code"] is not None   # SIGKILLed child
+        assert "heartbeat_age" in ctx
+        assert ctx["pid"] is not None
+
+    def test_flight_report_validates_death_bundle(self, tmp_path):
+        _flight.install(str(tmp_path))
+        try:
+            sup = _sup(2)
+            try:
+                run_soak(sup, _wl(8),
+                         on_tick=lambda t: (t == 1 and
+                                            sup.children[1].kill()))
+            finally:
+                sup.close()
+        finally:
+            _flight.uninstall()
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        "..", "tools"))
+        try:
+            import flight_report
+            bundles = glob.glob(str(tmp_path / "flight_replica_death_*"))
+            assert flight_report.main(["--quiet"] + bundles) == 0
+        finally:
+            sys.path.pop(0)
+
+
+class TestRollingUpgrade:
+    def test_zero_loss_bitwise_vs_control(self):
+        sup = _sup(3)
+        try:
+            stats, done = run_soak(
+                sup, _wl(18),
+                on_tick=lambda t: (t == 2 and
+                                   sup.start_rolling_upgrade(1) and None))
+            assert stats["outcomes_conserved"]
+            # the soak may drain before the staged rollout finishes —
+            # tick the idle fleet until the upgrade lands
+            for _ in range(200):
+                if sup._upgrade is None:
+                    break
+                sup.step()
+            s = sup.summary()
+            assert s["upgrades"], "upgrade never completed"
+            assert s["upgrades"][-1]["finished_tick"] is not None
+        finally:
+            sup.close()
+        control = _sup(3)
+        try:
+            _, want = run_soak(control, _wl(18))
+        finally:
+            control.close()
+        assert done == want                      # zero loss, bitwise
+
+    def test_upgrade_block_gate_fields(self):
+        sup = _sup(2)
+        try:
+            blk = upgrade_block(sup, _wl(12), version=1, upgrade_tick=3,
+                                kill_tick=1, kill_replica=0)
+        finally:
+            sup.close()
+        assert blk["conserved"] and blk["lost_requests"] == 0
+        assert blk["duplicate_stream_tokens"] == 0
+        assert blk["lost_stream_tokens"] == 0
+        assert blk["upgrade"]["complete"]
+        assert blk["kill"]["respawns"] >= 1
+        assert blk["backend"] == "inproc"
+        # the gate accepts it
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        "..", "tools"))
+        try:
+            import bench_gate
+            assert bench_gate.upgrade_violations({"upgrade": blk}) == []
+            broken = dict(blk, lost_requests=1,
+                          duplicate_stream_tokens=2, conserved=False)
+            out = bench_gate.upgrade_violations({"upgrade": broken})
+            assert len(out) >= 3
+        finally:
+            sys.path.pop(0)
+
+
+class TestAutoscaler:
+    def test_up_on_brownout_and_burn(self):
+        a = Autoscaler(AutoscaleConfig(cooldown_ticks=4))
+        d, why = a.decide(1, 2, brownout_level=1)
+        assert d == "up" and "brownout" in why
+        # cooldown holds the next action
+        assert a.decide(2, 3, brownout_level=2)[0] is None
+        d, why = a.decide(10, 3, decision_input={
+            "ttft_p99": {"fast_burn": 2.5}})
+        assert d == "up" and "fast_burn" in why
+
+    def test_down_needs_sustained_idle(self):
+        cfg = AutoscaleConfig(idle_ticks_down=3, cooldown_ticks=0)
+        a = Autoscaler(cfg)
+        assert a.decide(1, 2, idle=True)[0] is None
+        assert a.decide(2, 2, idle=True)[0] is None
+        d, why = a.decide(3, 2, idle=True)
+        assert d == "down" and "idle" in why
+        # a busy tick resets the idle streak
+        a2 = Autoscaler(cfg)
+        a2.decide(1, 2, idle=True)
+        a2.decide(2, 2, idle=False)
+        assert a2.decide(3, 2, idle=True)[0] is None
+
+    def test_bounds_respected(self):
+        a = Autoscaler(AutoscaleConfig(min_replicas=1, max_replicas=2,
+                                       idle_ticks_down=1,
+                                       cooldown_ticks=0))
+        assert a.decide(1, 2, brownout_level=3)[0] is None   # at max
+        assert a.decide(2, 1, idle=True)[0] is None          # at min
+
+    def test_supervisor_scales_down_when_idle(self):
+        sup = _sup(3, autoscale=AutoscaleConfig(
+            min_replicas=1, idle_ticks_down=2, cooldown_ticks=0))
+        try:
+            stats, _ = run_soak(sup, _wl(6))
+            # drive idle ticks past the threshold
+            for _ in range(12):
+                sup.step()
+            retired = [h.idx for h in sup.router.replicas if h.retired]
+            assert retired, "sustained idle never drained a replica"
+            live = [h for h in sup.router.replicas
+                    if h.healthy and not h.retired]
+            assert len(live) >= 1
+            assert any(d == "down" for _, d, _ in
+                       sup.autoscaler.decisions)
+        finally:
+            sup.close()

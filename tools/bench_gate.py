@@ -437,6 +437,68 @@ def overload_violations(rec):
     return out
 
 
+def upgrade_violations(rec):
+    """Reference-free violation strings from one record's "upgrade"
+    block (docs/SERVING.md "Process topology"; emitted by
+    ``tools/serve_bench.py --procs N``): the multi-process fleet soak
+    with a SIGKILLed replica, chaos-injected link faults, and a rolling
+    weight upgrade mid-traffic. The invariants are absolute:
+
+    - ``conserved`` false / ``lost_requests`` > 0 — a request lost or
+      hung across kills, migrations, and reloads is the hard floor;
+    - ``duplicate_stream_tokens`` / ``lost_stream_tokens`` > 0 — every
+      generated token must reach its stream callback exactly once,
+      counted at an independent seam from the router's suppression;
+    - an upgrade that never completed (``upgrade.complete`` false) —
+      the rollout must finish while the fleet keeps serving;
+    - inside the upgrade *window* (both gates engage only when their
+      budget is embedded in the block): goodput fraction under
+      ``goodput_floor_fraction`` while work was actually outstanding,
+      or worst recent-p99 TTFT over ``p99_ttft_budget``."""
+    block = rec.get("upgrade") if isinstance(rec, dict) else None
+    if not isinstance(block, dict) or not block.get("enabled"):
+        return []
+    out = []
+    if block.get("conserved") is False:
+        out.append(f"outcome conservation broken across the fleet "
+                   f"scenario ({block.get('submitted')} submitted, "
+                   f"{block.get('served')} served)")
+    lost = int(block.get("lost_requests") or 0)
+    if lost > 0:
+        out.append(f"{lost} request(s) lost (no terminal outcome) "
+                   "through kill/migration/upgrade")
+    dup = int(block.get("duplicate_stream_tokens") or 0)
+    if dup > 0:
+        out.append(f"{dup} stream token(s) delivered more than once "
+                   "(exactly-once replay broken)")
+    missing = int(block.get("lost_stream_tokens") or 0)
+    if missing > 0:
+        out.append(f"{missing} generated token(s) never delivered to "
+                   "their stream callback")
+    up = block.get("upgrade") or {}
+    if up and not up.get("complete"):
+        out.append(f"rolling upgrade to version {up.get('version')} "
+                   f"did not complete (stalled after "
+                   f"{up.get('upgraded_replicas')})")
+    win = block.get("window") or {}
+    frac = win.get("goodput_fraction")
+    floor = win.get("goodput_floor_fraction")
+    if (frac is not None and floor is not None
+            and int(win.get("peak_outstanding") or 0) > 0
+            and float(frac) < float(floor)):
+        out.append(f"goodput inside the upgrade window fell to "
+                   f"{float(frac):.3f}x of the whole run "
+                   f"(< floor {float(floor):.3f}x) with "
+                   f"{win.get('peak_outstanding')} requests outstanding")
+    p99 = win.get("p99_ttft_seconds")
+    budget = win.get("p99_ttft_budget")
+    if p99 is not None and budget is not None \
+            and float(p99) > float(budget):
+        out.append(f"p99 TTFT {float(p99):.4f}s inside the upgrade "
+                   f"window > budget {float(budget):.4f}s")
+    return out
+
+
 def cold_start_violations(rec, ref_rec, threshold=0.25):
     """Referenced gate on the serving block's replica cold start
     (engine construction + program compile, ``warmup()``): must not
@@ -659,6 +721,12 @@ def main(argv=None):
         # bound, brownout restoration (docs/SERVING.md)
         for v in overload_violations(rec):
             print(f"  OVERLOAD {metric}: {v}", flush=True)
+            failed = True
+        # upgrade gate (reference-free): zero lost / duplicated requests
+        # and tokens through SIGKILL + chaos + rolling weight upgrade,
+        # plus embedded window budgets (docs/SERVING.md)
+        for v in upgrade_violations(rec):
+            print(f"  UPGRADE {metric}: {v}", flush=True)
             failed = True
         # pipeline gate (docs/PIPELINE.md): measured-cost bubble over
         # budget, or a pp-live mesh whose composition never engaged

@@ -93,6 +93,30 @@ def main(argv=None):
     ap.add_argument("--overload-requests", type=int, default=None,
                     help="requests in the overload scenario (default: "
                     "same as --requests)")
+    ap.add_argument("--procs", type=int, default=None,
+                    help="run the multi-process fleet scenario instead "
+                    "of the in-process sweep: N replicas as real OS "
+                    "processes behind the socket transport "
+                    "(FleetSupervisor), one replica SIGKILLed "
+                    "mid-soak, a chaos-injected link, and a rolling "
+                    "weight upgrade — emits the gateable 'upgrade' "
+                    "block (docs/SERVING.md 'Process topology'). "
+                    "PTPU_FLEET_PROC=0 falls back to in-process "
+                    "loopback children, bitwise")
+    ap.add_argument("--kill-tick", type=int, default=3,
+                    help="soak tick at which one replica is SIGKILLed "
+                    "(--procs scenario; negative disables the kill)")
+    ap.add_argument("--upgrade-tick", type=int, default=6,
+                    help="soak tick at which the rolling weight "
+                    "upgrade starts (--procs scenario)")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="skip the ChaosTransport link faults in the "
+                    "--procs scenario")
+    ap.add_argument("--window-goodput-floor", type=float, default=None,
+                    help="gate: goodput inside the upgrade window must "
+                    "stay above this fraction of whole-run goodput "
+                    "(opt-in — completion-based goodput is lumpy at "
+                    "smoke scale)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeline-dir", default=None,
                     help="record a per-tick timeline JSONL per soak "
@@ -172,6 +196,67 @@ def main(argv=None):
     else:
         engine_kw.update(max_slots=slots, page_size=page,
                          enable_prefix_cache=args.prefix_cache)
+
+    if args.procs:
+        from paddle_tpu.inference.fleet import (FleetSupervisor,
+                                                fleet_proc_enabled,
+                                                make_model_spec,
+                                                upgrade_block)
+        from paddle_tpu.testing.chaos import ChaosTransport
+
+        n = args.procs
+        proc = fleet_proc_enabled()
+        if not proc:
+            sys.stderr.write("# serve_bench: PTPU_FLEET_PROC=0 — "
+                             "in-process loopback children (bitwise "
+                             "fallback)\n")
+        # the multi-process scenario always runs plain engines (the
+        # transport/supervisor mechanics are topology-independent)
+        pe_kw = dict(engine_kw)
+        pe_kw.setdefault("max_slots", slots)
+        pe_kw.setdefault("page_size", page)
+        pe_kw["seed"] = args.seed
+        spec = make_model_spec(
+            dict(vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+                 num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+                 num_kv_heads=cfg.num_kv_heads,
+                 max_seq_len=cfg.max_seq_len, dropout=0.0),
+            seed=args.seed, engine_kw=pe_kw)
+        chaos = None
+        if not args.no_chaos and n > 1:
+            # deterministic small fault schedule on replica 1's link:
+            # one dropped request (timeout + idempotent re-send), one
+            # duplicated frame (served from the reply cache), one
+            # corrupted frame (CRC reject, re-send)
+            chaos = {1: lambda t: ChaosTransport(
+                t, drop_sends={5}, duplicate_sends={9},
+                corrupt_sends={13})}
+        sup = FleetSupervisor(
+            spec, n, proc=proc, policy=args.policy, chaos=chaos,
+            lease_seconds=120.0,
+            transport_kw=dict(timeouts={"step": 10.0, "submit": 10.0},
+                              backoff=0.01))
+        try:
+            block = upgrade_block(
+                sup, workload, version=1,
+                upgrade_tick=args.upgrade_tick,
+                kill_tick=(args.kill_tick if args.kill_tick >= 0
+                           and n > 1 else None),
+                kill_replica=0,
+                window_goodput_floor=args.window_goodput_floor,
+                window_ttft_budget=args.ttft_budget)
+        finally:
+            sup.close()
+        block["chaos"] = (None if chaos is None else
+                          {"link": 1, "drop_sends": [5],
+                           "duplicate_sends": [9], "corrupt_sends": [13]})
+        print(json.dumps({
+            "metric": f"serve_upgrade_procs_r{n}",
+            "value": block.get("goodput_tokens_per_sec"),
+            "unit": "tokens/sec",
+            "upgrade": block,
+        }), flush=True)
+        return
 
     baseline = None
     for n in replica_counts:
