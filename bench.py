@@ -293,6 +293,14 @@ def run_model(model_kind, ckpt=None):
     env_batch = os.environ.get("PTPU_BENCH_BATCH")
     env_remat = os.environ.get("PTPU_BENCH_REMAT")
     env_hchunk = os.environ.get("PTPU_BENCH_HEAD_CHUNK")
+    # --autotune / PTPU_AUTOTUNE=1 (docs/AUTOTUNE.md): route this line
+    # through the layout autotuner — the mesh/schedule lattice is
+    # searched lowering-only and the headline runs the winning layout's
+    # built ShardedTrainStep instead of the hand-picked config
+    autotune_on = (bool(ckpt is not None and getattr(ckpt, "autotune",
+                                                     False))
+                   or os.environ.get("PTPU_AUTOTUNE", "")
+                   not in ("", "0"))
     # fused-CE head chunk: a third plan dimension. Bigger chunks = fewer
     # serialized LSE scan steps; the resident [tokens, chunk] fp32 block
     # is what memory_analysis prices against batch/remat headroom.
@@ -456,7 +464,14 @@ def run_model(model_kind, ckpt=None):
                   # belt + suspenders, docs/QUANT.md)
                   "PTPU_QUANT_COMPUTE", "PTPU_QUANT_DTYPE",
                   "PTPU_QUANT_AMAX_HIST", "PTPU_QUANT_GATE_TOL",
-                  "PTPU_INT8_WEIGHTS", "PTPU_BENCH_QUANT")
+                  "PTPU_INT8_WEIGHTS", "PTPU_BENCH_QUANT",
+                  # layout knobs (docs/AUTOTUNE.md): an autotuned
+                  # decision priced under one engagement regime must
+                  # not replay across a knob flip — nor may a
+                  # hand-picked plan replay into an --autotune run
+                  "PTPU_AUTOTUNE", "PTPU_PIPELINE_SCHEDULE",
+                  "PTPU_RING_ATTN", "PTPU_SHARDED_HEAD", "PTPU_COMPOSED",
+                  "PTPU_LINK_GBPS", "PTPU_LAYOUT_CACHE")
     ) + (("int8_head", F.int8_head_enabled()),  # gate outcome, not just env
          ("quant_gate", _pquant.quant_gate()))
     # ZeRO pricing record (docs/ZERO.md): the candidate programs compile
@@ -468,28 +483,92 @@ def run_model(model_kind, ckpt=None):
     zero_info = ({"stage": zero_stage, "degree": zero_degree,
                   "param_bytes": 0, "slot_bytes": 0, "grad_bytes": 0}
                  if zero_stage else None)
-    decision = pmem.plan_train_step(
-        step_factory, candidates, require_fit=require_fit,
-        act_bytes_fn=act_bytes, zero=zero_info,
-        opt_state_bytes=opt.slot_nbytes(
-            {n: p._data for n, p in model.named_parameters()},
-            shard_degree=zero_degree if zero_stage else 1),
-        cache_extra=(model_kind, cfg.vocab_size, cfg.hidden_size,
-                     cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
-                     cfg.intermediate_size, seq,
-                     "bf16" if on_tpu else "f32", mem_envs))
-    batch = decision.batch
-    cfg.recompute = decision.policy != "none"
-    cfg.recompute_policy = _quant_policy(decision.policy,
-                                         getattr(decision, "quant", None))
-    cfg.head_chunk = decision.head_chunk
+    cache_extra = (model_kind, cfg.vocab_size, cfg.hidden_size,
+                   cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+                   cfg.intermediate_size, seq,
+                   "bf16" if on_tpu else "f32", mem_envs)
+    layout_block = {"enabled": False}
+    if autotune_on:
+        # the layout autotuner (docs/AUTOTUNE.md) owns mesh + model +
+        # step: it searches every (dp, sharding, mp, pp, sep) x zero x
+        # schedule point the compose lattice accepts (pruning the rest
+        # with structured Reasons, lowering-only pricing for survivors)
+        # and hands back the BUILT ShardedTrainStep for the winner. The
+        # hand-picked config rides along as the baseline — it is scored
+        # through the same cost model, may legitimately win, and is
+        # what the bench_gate LAYOUT gate compares against. batch in a
+        # LayoutCandidate is rows PER DATA SHARD (global = batch x
+        # dp*sharding*sep).
+        import copy as _copy
 
-    # NOTE: on a plan-cache miss the winning program compiles twice (once
-    # AOT in the planner, once here at warmup — jit's dispatch cache is
-    # not fed by the AOT path). The disk cache makes every later run of
-    # the same config skip planning entirely, so the cost is first-run-
-    # per-config only.
-    step = make_step()
+        ndev = len(jax.devices())
+        factory = pmem.flagship_gpt_factory(
+            lambda: _copy.deepcopy(cfg), amp_bf16=on_tpu,
+            optimizer_factory=lambda m: paddle.optimizer.AdamW(
+                learning_rate=3e-4, parameters=m.parameters()))
+        layouts = pmem.enumerate_layouts(
+            ndev,
+            batches=((int(env_batch),) if env_batch else batch_grid),
+            policies=((env_remat,) if env_remat else policy_grid),
+            head_chunks=hchunk_grid, quants=quant_grid)
+        if model_kind == "llama":
+            # the hand-picked config-5 layout: stage-3 over every chip
+            base_layout = pmem.LayoutCandidate(
+                sharding=ndev, zero_stage=3, batch=batch_grid[0],
+                policy=policy_grid[0], head_chunk=hchunk_grid[0],
+                quant=quant_grid[0])
+        else:
+            base_layout = pmem.LayoutCandidate(
+                dp=ndev, batch=batch_grid[0], policy=policy_grid[0],
+                head_chunk=hchunk_grid[0], quant=quant_grid[0])
+        step, layout_decision = pmem.autotune_train_step(
+            factory, seq_len=seq, layouts=layouts, baseline=base_layout,
+            require_fit=require_fit, cache_extra=cache_extra)
+        layout_block = layout_decision.as_json()
+        # the winner's PlanDecision-shaped record keeps the "memory"
+        # block (and everything downstream of `decision`) unchanged
+        decision = pmem.PlanDecision(**layout_decision.memory)
+        model, opt = step.model, step.optimizer
+        batch = decision.batch
+        cfg.recompute = decision.policy != "none"
+        cfg.recompute_policy = _quant_policy(
+            decision.policy, getattr(decision, "quant", None))
+        cfg.head_chunk = decision.head_chunk
+    else:
+        from paddle_tpu.nn.functional.fused_cross_entropy import (
+            resolve_vocab_chunk)
+
+        def _program_key(c):
+            # head_chunk reaches the traced program only through the
+            # RESOLVED CE vocab chunk — candidates whose chunks clamp
+            # to the same effective value share one lowering (the
+            # planner memoizes on this key, docs/MEMORY.md)
+            return (c.batch,
+                    _quant_policy(c.policy, getattr(c, "quant", None)),
+                    resolve_vocab_chunk(cfg.vocab_size, c.head_chunk),
+                    getattr(c, "depth", None))
+
+        decision = pmem.plan_train_step(
+            step_factory, candidates, require_fit=require_fit,
+            act_bytes_fn=act_bytes, zero=zero_info,
+            opt_state_bytes=opt.slot_nbytes(
+                {n: p._data for n, p in model.named_parameters()},
+                shard_degree=zero_degree if zero_stage else 1),
+            program_key_fn=_program_key,
+            cache_extra=cache_extra)
+        batch = decision.batch
+        cfg.recompute = decision.policy != "none"
+        cfg.recompute_policy = _quant_policy(decision.policy,
+                                             getattr(decision, "quant",
+                                                     None))
+        cfg.head_chunk = decision.head_chunk
+
+        # NOTE: on a plan-cache miss the winning program compiles twice
+        # (once AOT in the planner, once here at warmup — jit's dispatch
+        # cache is not fed by the AOT path). The disk cache makes every
+        # later run of the same config skip planning entirely, so the
+        # cost is first-run-per-config only.
+        step = make_step()
 
     # Crash-safe checkpointing (--ckpt-dir): per-step committed saves via
     # CheckpointManager, --resume auto restore of the newest committed
@@ -847,6 +926,13 @@ def run_model(model_kind, ckpt=None):
         # "telemetry" key explains its time (tools/hbm_report.py diffs
         # two rounds' blocks; contract in docs/MEMORY.md)
         "memory": decision.as_json(),
+        # layout autotuner outcome (--autotune / PTPU_AUTOTUNE=1,
+        # docs/AUTOTUNE.md): winner + top-3 scored candidates, pruned
+        # counts by compose Reason, search seconds — bench_gate's
+        # LAYOUT gate fails a winner whose predicted score loses to
+        # the hand-picked baseline or a silent fallback.
+        # {"enabled": false} without the flag.
+        "layout": layout_block,
         # guard decision totals (docs/RESILIENCE.md): a CLEAN bench run
         # must report zero anomalies and zero rollbacks — bench_gate
         # exits 1 otherwise. {"enabled": false} when --guard is off.
@@ -938,6 +1024,13 @@ def main():
     ap.add_argument("--record-interval", type=float, default=None,
                     help="seconds between --record samples "
                     "(default 0.5, or PTPU_RECORD_INTERVAL)")
+    ap.add_argument("--autotune", action="store_true",
+                    default=os.environ.get("PTPU_AUTOTUNE", "")
+                    not in ("", "0"),
+                    help="route the headline lines through the layout "
+                    "autotuner (mesh/schedule search over the compose "
+                    "lattice, docs/AUTOTUNE.md); adds the 'layout' "
+                    "block to the JSON line")
     ap.add_argument("--long-context", action="store_true",
                     default=os.environ.get("PTPU_BENCH_LONG", "")
                     not in ("", "0"),

@@ -231,6 +231,32 @@ COMPAT_LATTICE = {
 }
 
 
+def lattice_owner(live_axes, *, stage=0):
+    """The :data:`COMPAT_LATTICE` row that OWNS a mesh whose live axes
+    are ``live_axes`` (any iterable of axis names), or ``None`` when no
+    row accepts the set — the declarative pre-build validity check the
+    layout autotuner (memory/autotune.py) consults before paying a
+    model build or a trace. Precedence mirrors the build walk:
+    composed owns any mp/pp-live mesh, ring owns sep-live pure-data
+    meshes (stage < 2 — stage >= 2 with sep live falls off every row,
+    exactly the ``owner_when`` annotations), else zero (stage >= 2) /
+    grad_reduce. An EMPTY set returns "grad_reduce"/"zero": a degree-1
+    mesh is the degenerate pure-data case every plan handles."""
+    live = frozenset(live_axes)
+    if not live:
+        return "zero" if int(stage or 0) >= 2 else "grad_reduce"
+    if "mp" in live or "pp" in live:
+        return ("composed"
+                if live in COMPAT_LATTICE["composed"]["axes"] else None)
+    if "sep" in live:
+        if int(stage or 0) >= 2:
+            return None  # zero declines sep, ring declines stage >= 2
+        return ("ring_attn"
+                if live in COMPAT_LATTICE["ring_attn"]["axes"] else None)
+    row = "zero" if int(stage or 0) >= 2 else "grad_reduce"
+    return row if live in COMPAT_LATTICE[row]["axes"] else None
+
+
 def composed_enabled():
     """``PTPU_COMPOSED`` (default on) on top of the PR 6 master switch —
     ``PTPU_QUANT_COLLECTIVES=0`` must keep every program pre-PR."""

@@ -1082,6 +1082,26 @@ class TrainStep:
                               + ma.temp_size_in_bytes),
         }
 
+    def aot_report(self, *batch):
+        """One AOT compile, both pricing surfaces: ``(memory, cost)``
+        where ``memory`` is the :meth:`memory_stats` dict and ``cost``
+        the :func:`compiled_cost_summary` roofline record (or None when
+        the executable exposes no cost analysis). The layout autotuner
+        (memory/autotune.py) scores every candidate from this — calling
+        memory_stats and a separate cost pass would pay the
+        lower+compile twice per candidate."""
+        compiled = self.aot_compile(*batch)
+        ma = compiled.memory_analysis()
+        mem = {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "peak_bytes": int(ma.argument_size_in_bytes
+                              + ma.temp_size_in_bytes),
+        }
+        return mem, compiled_cost_summary(compiled)
+
     def _prepare_batch(self, raw_batch):
         """Hook: sharded subclasses place batch arrays on the mesh so the
         lowered program sees the same input shardings as a real step."""

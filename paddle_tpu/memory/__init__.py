@@ -13,8 +13,28 @@ RUNNING candidates; here XLA's buffer assignment prices them unexecuted):
    the HBM budget, caches decisions per (config, chip), and records the
    outcome in telemetry gauges + the bench JSON ``"memory"`` block.
 
+Plus the layer above both (ISSUE 19): the **layout autotuner**
+(:mod:`.autotune`) extends the planner grid with the parallelism axes —
+mesh degrees over the compose lattice, ZeRO stage, pipeline schedule x
+microbatches, comm buckets — prunes non-composable layouts via the
+structured ``compose.Reason`` before any trace, scores survivors
+lowering-only (roofline + link model + analytic pipeline bubbles), and
+returns the built ``ShardedTrainStep`` for the winner
+(``autotune_train_step``; docs/AUTOTUNE.md).
+
 See docs/MEMORY.md for the policy syntax, knobs, and JSON contract.
 """
+from .autotune import (  # noqa: F401
+    LAYOUT_ENV_KNOBS,
+    LayoutCandidate,
+    LayoutDecision,
+    LayoutSearchError,
+    autotune_train_step,
+    enumerate_layouts,
+    flagship_gpt_factory,
+    link_bytes_per_sec,
+    plan_wire_bytes,
+)
 from .int8_ckpt import (  # noqa: F401
     INT8_BLOCK,
     KERNEL_ANCHORS,
@@ -32,6 +52,7 @@ from .planner import (  # noqa: F401
     MemoryPlanError,
     PlanDecision,
     chip_kind,
+    default_program_key,
     estimate_stacked_activation_bytes,
     hbm_budget_bytes,
     plan_train_step,
@@ -48,4 +69,8 @@ __all__ = [
     "Candidate", "PlanDecision", "MemoryPlanError", "plan_train_step",
     "hbm_budget_bytes", "chip_kind", "throughput_score", "policy_coverage",
     "estimate_stacked_activation_bytes", "zero_hbm_savings",
+    "default_program_key",
+    "LayoutCandidate", "LayoutDecision", "LayoutSearchError",
+    "LAYOUT_ENV_KNOBS", "autotune_train_step", "enumerate_layouts",
+    "flagship_gpt_factory", "link_bytes_per_sec", "plan_wire_bytes",
 ]

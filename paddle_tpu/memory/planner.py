@@ -48,7 +48,10 @@ _ACT_INT8 = _telemetry.gauge(
 _PLAN_EVALS = _telemetry.counter(
     "memory_plan_lowerings_total",
     "candidate TrainStep programs lowered+compiled by the planner",
-    labelnames=("outcome",))  # fit | over_budget | error | cache_hit
+    # fit | over_budget | error | cache_hit | memoized ("memoized" =
+    # a build SAVED because an earlier candidate already lowered the
+    # same traced program; fit+over_budget+error = actual lowerings)
+    labelnames=("outcome",))
 
 
 class MemoryPlanError(RuntimeError):
@@ -313,9 +316,21 @@ def zero_hbm_savings(zero):
 
 
 # -- the planner ------------------------------------------------------------
+def default_program_key(cand):
+    """The candidate axes that change the traced program, conservatively:
+    every grid axis. Callers that KNOW two candidates lower to the same
+    program pass a coarser ``program_key_fn`` — e.g. bench.py resolves
+    the EFFECTIVE CE head chunk (fused_cross_entropy.resolve_vocab_chunk
+    clamps to the vocab), so head_chunk values that clamp to the same
+    chunk share one lowering instead of re-compiling per spelling."""
+    return (cand.batch, cand.policy, getattr(cand, "head_chunk", None),
+            getattr(cand, "depth", None), getattr(cand, "quant", None))
+
+
 def plan_train_step(step_factory, candidates, *, budget_bytes=None,
                     cache_path=None, cache_extra=(), act_bytes_fn=None,
-                    opt_state_bytes=None, require_fit=True, zero=None):
+                    opt_state_bytes=None, require_fit=True, zero=None,
+                    program_key_fn=None):
     """Pick the best (batch, policy) that fits the HBM budget.
 
     ``step_factory(candidate) -> (TrainStep, batch_avals)`` builds a step
@@ -329,6 +344,13 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
 
     ``act_bytes_fn(candidate) -> (saved, int8)`` optionally attributes
     saved-activation bytes for telemetry/the bench JSON.
+
+    ``program_key_fn(candidate)`` names the axes that actually change
+    the TRACED program (default :func:`default_program_key` — every grid
+    axis). When two candidates map to the same key, the second reuses
+    the first's measured memory instead of re-lowering — the saved
+    build is counted as ``memory_plan_lowerings_total{outcome=
+    "memoized"}`` and the evaluated record carries ``"memoized": true``.
 
     ``zero`` (docs/ZERO.md): ZeRO stage pricing — slot (stage>=1), grad
     (stage>=2) and param (stage>=3) HBM divide by the sharding degree,
@@ -397,34 +419,50 @@ def plan_train_step(step_factory, candidates, *, budget_bytes=None,
 
     evaluated = []
     chosen = None
+    key_fn = program_key_fn or default_program_key
+    lowered = {}  # program key -> measured memory (the memoization seam)
     for cand in order:
         score = (cand.score if cand.score is not None
                  else throughput_score(cand.batch, cand.policy,
                                        getattr(cand, "head_chunk", None)))
-        step, batch_avals = step_factory(cand)
-        # label this step's build as a planning compile so the recompile
-        # watchdog's per-function counts stay meaningful (jit._build)
-        step._planning = True
-        try:
-            mem = step.memory_stats(*batch_avals)
-        except Exception as e:  # lowering/compile failure = not plannable
-            _PLAN_EVALS.inc(labels=("error",))
-            evaluated.append({"batch": cand.batch, "policy": cand.policy,
-                              "head_chunk": getattr(cand, "head_chunk", None),
-                              "depth": getattr(cand, "depth", None),
-                              "quant": getattr(cand, "quant", None),
-                              "score": score, "error": str(e)[:200]})
-            continue
+        pkey = key_fn(cand)
+        memoized = pkey in lowered
+        if memoized:
+            # an earlier candidate already lowered this exact traced
+            # program (e.g. head_chunk spellings clamping to the same
+            # effective CE chunk) — reuse its measured bytes, count the
+            # saved build
+            mem = lowered[pkey]
+            _PLAN_EVALS.inc(labels=("memoized",))
+        else:
+            step, batch_avals = step_factory(cand)
+            # label this step's build as a planning compile so the
+            # recompile watchdog's per-function counts stay meaningful
+            # (jit._build)
+            step._planning = True
+            try:
+                mem = step.memory_stats(*batch_avals)
+            except Exception as e:  # lowering/compile failure = not plannable
+                _PLAN_EVALS.inc(labels=("error",))
+                evaluated.append(
+                    {"batch": cand.batch, "policy": cand.policy,
+                     "head_chunk": getattr(cand, "head_chunk", None),
+                     "depth": getattr(cand, "depth", None),
+                     "quant": getattr(cand, "quant", None),
+                     "score": score, "error": str(e)[:200]})
+                continue
+            lowered[pkey] = mem
         # zero pricing: the sharded stages free (1 - 1/degree) of the
         # slot/grad/param pools versus the measured unsharded program
         fits = mem["peak_bytes"] - savings <= budget
-        _PLAN_EVALS.inc(labels=("fit" if fits else "over_budget",))
+        if not memoized:
+            _PLAN_EVALS.inc(labels=("fit" if fits else "over_budget",))
         evaluated.append({"batch": cand.batch, "policy": cand.policy,
                           "head_chunk": getattr(cand, "head_chunk", None),
                           "depth": getattr(cand, "depth", None),
                           "quant": getattr(cand, "quant", None),
                           "score": score, "peak_bytes": mem["peak_bytes"],
-                          "fits": fits})
+                          "fits": fits, "memoized": memoized})
         if fits or not require_fit:
             chosen = (cand, mem, score, fits)
             break

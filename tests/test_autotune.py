@@ -1,0 +1,295 @@
+"""memory/autotune — the mesh/schedule layout autotuner (ISSUE 19).
+
+CPU-only: the virtual 8-device mesh from conftest stands in for the
+chips; every candidate is priced lowering-only through XLA-CPU's buffer
+assignment, exactly the bench --autotune path at test scale."""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.telemetry as telemetry
+from paddle_tpu import memory as pmem
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.collectives import compose
+from paddle_tpu.memory import autotune as at
+from paddle_tpu.models.gpt import GPTConfig
+
+SEQ = 32
+
+
+def _cfg_factory():
+    return GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=2, max_seq_len=SEQ, dropout=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _fleet_reset():
+    yield
+    fleet._reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+
+
+def _counter(snap, name):
+    """{labels-dict-as-frozenset: value} for one counter family."""
+    out = {}
+    for labels, v in (snap["counters"].get(name) or {}).items():
+        d = dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+        out[frozenset(d.items())] = v
+    return out
+
+
+def _verdict_totals(snap):
+    by = {}
+    for key, v in _counter(snap, "autotune_candidates_total").items():
+        verdict = dict(key)["verdict"]
+        by[verdict] = by.get(verdict, 0) + v
+    return by
+
+
+class TestEnumerate:
+    def test_eight_device_space_shape(self):
+        """The default 8-device space: every (dp, sharding, mp, pp, sep)
+        factorization under the 2-caps — 20 shells, >= 12 of them
+        lattice-valid (the acceptance floor), the off-lattice sep-hybrid
+        shells generated too (the pruning pass records them with their
+        Reason instead of hiding them)."""
+        layouts = pmem.enumerate_layouts(8)
+        assert len(layouts) == 20
+        assert all(l.device_count == 8 for l in layouts)
+        valid = [l for l in layouts
+                 if l.hybrid
+                 or compose.lattice_owner(l.live_axes(),
+                                          stage=l.zero_stage)]
+        # hybrid shells resolve against build_composed_plan at search
+        # time; the 5 sep-under-mp/pp shells are the only oracle prunes
+        on_lattice = [l for l in layouts
+                      if not (l.hybrid and "sep" in l.live_axes())]
+        assert len(on_lattice) == 15
+        assert len(valid) >= 12
+        # deterministic: the decision must reproduce bitwise
+        assert [l.label() for l in pmem.enumerate_layouts(8)] \
+            == [l.label() for l in layouts]
+
+    def test_pipeline_batches_divide_microbatches(self):
+        layouts = pmem.enumerate_layouts(8, batches=(1, 3),
+                                         microbatches=(2, 4))
+        for l in layouts:
+            if l.pp > 1:
+                assert l.batch % l.n_micro == 0, l.label()
+
+    def test_zero_stage_defaults(self):
+        assert at.default_zero_stage(1, 8, 1, 1, 1) == 3   # pure sharding
+        assert at.default_zero_stage(8, 1, 1, 1, 1) == 0   # pure dp
+        assert at.default_zero_stage(2, 1, 2, 2, 1) == 2   # hybrid + data
+        assert at.default_zero_stage(1, 1, 2, 2, 1) == 0   # no data axis
+        assert at.default_zero_stage(4, 1, 1, 1, 2) == 0   # sep live
+
+    def test_off_lattice_pure_data_layout_rejected(self):
+        # sep-live + stage>=2 is on NO lattice row — enumerate never
+        # produces it, and a hand-built one must fail loudly, not float
+        # through the search as an unpriceable candidate
+        bad = pmem.LayoutCandidate(dp=4, sep=2, zero_stage=2)
+        with pytest.raises(ValueError):
+            pmem.autotune_train_step(
+                lambda layout, mesh: None, seq_len=SEQ, layouts=[bad],
+                cache_path="", device_count=8)
+
+
+class TestSearch:
+    def test_pruned_reason_matches_forced_compose_and_counters(self):
+        """(a) every pruned candidate's recorded Reason is exactly what
+        build_composed_plan returns when forced on that layout's mesh;
+        (b) only composable candidates are lowered, nothing executes —
+        both read from the autotune_candidates_total counters."""
+        factory = pmem.flagship_gpt_factory(_cfg_factory)
+        off = pmem.LayoutCandidate(dp=2, mp=2, sep=2, zero_stage=2)
+        ok = pmem.LayoutCandidate(sharding=8, zero_stage=3, batch=1)
+        step, decision = pmem.autotune_train_step(
+            factory, seq_len=SEQ, layouts=[off, ok],
+            budget_bytes=1e12, cache_path="")
+        assert decision.label == ok.label()
+        assert decision.pruned_total == 1
+        rec = decision.pruned[0]
+        assert rec["label"] == off.label()
+        # force the oracle: same mesh + factory model, compose called
+        # directly — the recorded Reason must be ITS verdict
+        probe = at._build_candidate(off, factory)
+        _, reason = compose.build_composed_plan(
+            probe.model, probe.optimizer, probe.mesh,
+            sharding_stage=probe.sharding_stage,
+            shard_vocab_head=probe.shard_vocab_head,
+            grad_clip=probe.optimizer._grad_clip,
+            shard_opt_states=probe.shard_opt_states)
+        assert rec["reason"] == reason.value == "unsupported_mesh_axes"
+        snap = telemetry.snapshot()
+        totals = _verdict_totals(snap)
+        assert totals.get("lowered") == 1      # only the composable one
+        assert totals.get("pruned") == 1
+        assert "error" not in totals
+        # the search executed NOTHING: no TrainStep invocation ticked
+        assert not _counter(snap, "train_steps_total")
+
+    def test_cache_roundtrip_and_knob_separation(self, tmp_path,
+                                                 monkeypatch):
+        """(c) the LayoutDecision disk-cache round-trips bitwise, and an
+        engagement-affecting knob flip (or another device count) misses
+        the cache instead of replaying a stale layout."""
+        cpath = str(tmp_path / "layout.json")
+        factory = pmem.flagship_gpt_factory(_cfg_factory)
+        layouts = [pmem.LayoutCandidate(sharding=8, zero_stage=3)]
+        _, d1 = pmem.autotune_train_step(
+            factory, seq_len=SEQ, layouts=layouts, budget_bytes=1e12,
+            cache_path=cpath)
+        assert d1.source == "search"
+        step2, d2 = pmem.autotune_train_step(
+            factory, seq_len=SEQ, layouts=layouts, budget_bytes=1e12,
+            cache_path=cpath)
+        assert d2.source == "cache" and d2.key == d1.key
+        assert d2.fingerprint() == d1.fingerprint()
+        # the cache hit still hands back a BUILT step for the winner
+        assert step2.zero_plan() is not None
+        # knob flip -> new key -> fresh search, not a stale replay
+        monkeypatch.setenv("PTPU_LINK_GBPS", "50")
+        _, d3 = pmem.autotune_train_step(
+            factory, seq_len=SEQ, layouts=layouts, budget_bytes=1e12,
+            cache_path=cpath)
+        assert d3.source == "search" and d3.key != d1.key
+        # device_count separates the key even with identical knobs
+        assert at._layout_key("cpu", 8, 1, (), layouts, None, True) \
+            != at._layout_key("cpu", 16, 1, (), layouts, None, True)
+
+    def test_winner_fits_budget_and_reproduces_bitwise(self):
+        """(d) the CPU-mesh winner's predicted peak is inside the HBM
+        budget and the whole decision reproduces bitwise across two
+        cache-disabled searches."""
+        factory = pmem.flagship_gpt_factory(_cfg_factory)
+        layouts = [pmem.LayoutCandidate(sharding=8, zero_stage=3)]
+
+        def run():
+            return pmem.autotune_train_step(
+                factory, seq_len=SEQ, layouts=layouts,
+                budget_bytes=1e12, cache_path="")[1]
+
+        d1, d2 = run(), run()
+        assert d1.fits and d1.peak_bytes <= d1.budget_bytes
+        assert d1.fingerprint() == d2.fingerprint()
+        assert json.loads(json.dumps(d1.as_json()))["label"] == d1.label
+
+    def test_no_fit_falls_back_to_baseline_with_reason(self):
+        """An impossible budget prunes every searched candidate; the
+        hand-picked baseline comes back as the structured fallback —
+        never silently (the bench_gate LAYOUT gate contract)."""
+        factory = pmem.flagship_gpt_factory(_cfg_factory)
+        layouts = [pmem.LayoutCandidate(sharding=8, zero_stage=3)]
+        base = pmem.LayoutCandidate(dp=8)
+        _, d = pmem.autotune_train_step(
+            factory, seq_len=SEQ, layouts=layouts, baseline=base,
+            budget_bytes=1, cache_path="")
+        assert d.source == "fallback"
+        assert d.fallback_reason == "no_candidate_fit"
+        assert d.label == base.label() and not d.fits
+        # and with no baseline at all the search raises, not guesses
+        with pytest.raises(pmem.LayoutSearchError):
+            pmem.autotune_train_step(
+                factory, seq_len=SEQ, layouts=layouts,
+                budget_bytes=1, cache_path="")
+
+    @pytest.mark.slow  # full 20-shell lattice search: ~15 AOT compiles
+    def test_full_lattice_search_acceptance(self):
+        """The ISSUE 19 acceptance line: >= 12 lattice-valid candidates
+        searched lowering-only on the 8-device mesh (counters), nothing
+        executed during the search, the winner's predicted peak fits,
+        and its measured step actually runs."""
+        factory = pmem.flagship_gpt_factory(_cfg_factory)
+        layouts = pmem.enumerate_layouts(8)
+        baseline = pmem.LayoutCandidate(sharding=8, zero_stage=3)
+        step, decision = pmem.autotune_train_step(
+            factory, seq_len=SEQ, layouts=layouts, baseline=baseline,
+            budget_bytes=1e12, cache_path="")
+        snap = telemetry.snapshot()
+        totals = _verdict_totals(snap)
+        assert totals.get("lowered", 0) >= 12
+        assert not _counter(snap, "train_steps_total")  # lowering-only
+        assert decision.fits
+        assert decision.pruned_by_reason == {"unsupported_mesh_axes": 5}
+        # the searched winner never loses to the hand baseline
+        base_rec = decision.baseline
+        assert base_rec["fits"]
+        assert decision.predicted_score \
+            >= base_rec["predicted_tokens_per_sec"]
+        assert snap["gauges"]["autotune_search_seconds"][""] > 0
+        # the measured step runs: one real optimizer step on the winner
+        winner = pmem.LayoutCandidate(**decision.layout)
+        rows = winner.batch * winner.data_parallel
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, 128, (rows, SEQ)).astype(np.int32))
+        lab = paddle.to_tensor(
+            rng.integers(0, 128, (rows, SEQ)).astype(np.int64))
+        loss = float(step(ids, lab).numpy())
+        assert np.isfinite(loss)
+
+
+class TestPlannerMemoize:
+    def test_same_program_key_lowers_once(self):
+        """ISSUE 19 satellite: candidates differing only on axes that do
+        NOT change the traced program share one lowering — counted as
+        the `memoized` outcome in memory_plan_lowerings_total."""
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.models.gpt import GPTForCausalLMPipe
+
+        paddle.seed(11)
+        cfg = _cfg_factory()
+        model = GPTForCausalLMPipe(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        built = []
+
+        def factory(cand):
+            built.append(cand)
+            cfg.recompute = cand.policy != "none"
+            cfg.recompute_policy = cand.policy
+            step = TrainStep(model, lambda i, l: model.loss(i, l), opt)
+            return step, (jax.ShapeDtypeStruct((cand.batch, SEQ),
+                                               jnp.int32),
+                          jax.ShapeDtypeStruct((cand.batch, SEQ),
+                                               jnp.int64))
+
+        # the over-budget batch is tried first (higher score) under two
+        # head_chunk spellings that clamp to the same vocab-128 CE chunk
+        # -> ONE program: the second spelling must reuse the first's
+        # measured bytes instead of paying another lower+compile, and
+        # the fitting batch still wins
+        cands = [pmem.Candidate(2048, "none", head_chunk=128),
+                 pmem.Candidate(2048, "none", head_chunk=512),
+                 pmem.Candidate(2, "none", head_chunk=128)]
+        d = pmem.plan_train_step(
+            factory, cands, budget_bytes=64e6, cache_path="",
+            program_key_fn=lambda c: (c.batch, c.policy,
+                                      min(c.head_chunk, 128)))
+        assert d.batch == 2 and d.fits
+        assert [c.batch for c in built] == [2048, 2]  # one saved build
+        snap = telemetry.snapshot()
+        evals = _counter(snap, "memory_plan_lowerings_total")
+        assert evals.get(frozenset([("outcome", "memoized")])) == 1
+        assert [c.get("memoized") for c in d.candidates].count(True) == 1
+
+    def test_default_program_key_keeps_distinct_programs_distinct(self):
+        a = pmem.Candidate(2, "none", head_chunk=64)
+        b = pmem.Candidate(2, "none", head_chunk=128)
+        assert pmem.default_program_key(a) != pmem.default_program_key(b)
+        assert pmem.default_program_key(a) \
+            == pmem.default_program_key(pmem.Candidate(2, "none",
+                                                       head_chunk=64))

@@ -468,3 +468,75 @@ def test_serving_gate_counts_shed_and_rejected_as_outcomes(tmp_path):
         "enabled": True, "requests": 10, "completed": 6, "cancelled": 1,
         "shed": 2, "rejected": 0})
     assert bg.main([lost, "--against", lost]) == 1
+
+
+def _layout_block(**over):
+    """A healthy autotuned "layout" block (docs/AUTOTUNE.md shapes)."""
+    block = {
+        "label": "sharding8/z3/b2/r-names", "predicted_score": 1200.0,
+        "predicted_step_seconds": 0.01, "peak_bytes": 100, "fits": True,
+        "budget_bytes": 1000, "source": "search", "chip": "cpu",
+        "device_count": 8, "key": "k", "searched": 15, "pruned_total": 5,
+        "pruned_by_reason": {"unsupported_mesh_axes": 5},
+        "search_seconds": 1.0, "fallback_reason": None,
+        "baseline": {"label": "dp8/z0/b2/r-names", "fits": True,
+                     "predicted_tokens_per_sec": 1000.0},
+    }
+    block.update(over)
+    return block
+
+
+def _round_with_layout(tmp_path, name, layout):
+    rec = {"metric": "gpt_pretrain_tokens_per_sec", "value": 100.0,
+           "unit": "tokens/sec/chip", "mfu": 0.5, "layout": layout}
+    p = tmp_path / name
+    p.write_text(json.dumps({"tail": json.dumps(rec)}))
+    return str(p)
+
+
+def test_layout_gate_passes_winner_and_disabled_blocks(tmp_path):
+    """ISSUE 19 satellite: a winner that beats (or IS) the hand-picked
+    baseline passes, as do non-autotuned rounds ({"enabled": false} or
+    no block at all) — the gate only speaks when a search ran."""
+    ok = _round_with_layout(tmp_path, "ok.json", _layout_block())
+    assert bg.main([ok, "--against", ok]) == 0
+    tie = _round_with_layout(tmp_path, "tie.json", _layout_block(
+        predicted_score=1000.0))
+    assert bg.main([tie, "--against", tie]) == 0
+    off = _round_with_layout(tmp_path, "off.json", {"enabled": False})
+    assert bg.main([off, "--against", off]) == 0
+
+
+def test_layout_gate_fails_winner_losing_to_baseline(tmp_path, capsys):
+    """An autotuned layout whose PREDICTED score loses to the hand-picked
+    config's predicted score at equal chips is a misranked search — the
+    baseline went through the same cost model, so the winner can only
+    lose by construction error (docs/AUTOTUNE.md gate recipe)."""
+    bad = _round_with_layout(tmp_path, "bad.json", _layout_block(
+        predicted_score=900.0))
+    assert bg.main([bad, "--against", bad]) == 1
+    assert "LAYOUT" in capsys.readouterr().out
+
+
+def test_layout_gate_fails_silent_fallback(tmp_path, capsys):
+    """source="fallback" without a structured fallback_reason measures
+    the hand config while claiming a search — only a reasoned fallback
+    (e.g. no_candidate_fit) is a legitimate outcome."""
+    silent = _round_with_layout(tmp_path, "silent.json", _layout_block(
+        source="fallback", fallback_reason=None))
+    assert bg.main([silent, "--against", silent]) == 1
+    assert "fallback_reason" in capsys.readouterr().out
+    reasoned = _round_with_layout(tmp_path, "reasoned.json", _layout_block(
+        source="fallback", fallback_reason="no_candidate_fit"))
+    assert bg.main([reasoned, "--against", reasoned]) == 0
+
+
+def test_layout_gate_skips_unfit_baseline(tmp_path):
+    """A baseline that itself does not fit the HBM budget cannot anchor
+    the predicted-score comparison — the searched winner was the only
+    runnable choice."""
+    ok = _round_with_layout(tmp_path, "unfit.json", _layout_block(
+        predicted_score=900.0,
+        baseline={"label": "dp8/z0/b2/r-names", "fits": False,
+                  "predicted_tokens_per_sec": 1000.0}))
+    assert bg.main([ok, "--against", ok]) == 0

@@ -237,6 +237,43 @@ def pipe_violations(rec):
     return out
 
 
+def layout_violations(rec):
+    """Reference-free violation strings from one record's "layout" block
+    (docs/AUTOTUNE.md): an --autotune round must not ship a layout whose
+    PREDICTED score loses to the hand-picked baseline's predicted score
+    at equal chips — the baseline is searched through the same cost
+    model, so by construction the winner can only lose to it when the
+    search silently misranked or fell back. A fallback (no searched
+    candidate fit) is a legitimate outcome ONLY when it carries its
+    structured reason; a silent one would measure the hand config while
+    claiming a search."""
+    block = rec.get("layout") if isinstance(rec, dict) else None
+    if not isinstance(block, dict) or not block.get("label"):
+        return []  # {"enabled": false} or absent: not an autotuned line
+    out = []
+    base = block.get("baseline")
+    score = block.get("predicted_score")
+    if (isinstance(base, dict) and base.get("fits")
+            and score is not None
+            and base.get("predicted_tokens_per_sec") is not None
+            and float(score)
+            < float(base["predicted_tokens_per_sec"]) * (1 - 1e-9)):
+        out.append(
+            f"autotuned layout {block.get('label')!r} predicted "
+            f"{float(score):.1f} tokens/sec loses to the hand-picked "
+            f"baseline {base.get('label')!r} at "
+            f"{float(base['predicted_tokens_per_sec']):.1f} on the same "
+            f"{block.get('device_count')} chips — the searched winner "
+            "must beat (or be) every scored candidate")
+    if block.get("source") == "fallback" and not block.get(
+            "fallback_reason"):
+        out.append(
+            "layout search fell back to the hand-picked config without "
+            "a structured fallback_reason — silent fallbacks would "
+            "measure the baseline while claiming a search")
+    return out
+
+
 #: quant decline reasons that describe a DOCUMENTED fallback
 #: (docs/QUANT.md): the parity gate / CPU default-off (loud, warned), or
 #: a precedence rule ceding the GEMM to an owner kernel/region. A
@@ -732,6 +769,12 @@ def main(argv=None):
         # budget, or a pp-live mesh whose composition never engaged
         for v in pipe_violations(rec):
             print(f"  PIPE  {metric}: {v}", flush=True)
+            failed = True
+        # layout gate (docs/AUTOTUNE.md): the autotuned winner's
+        # predicted score must not lose to the hand-picked baseline,
+        # and a fallback must carry its structured reason
+        for v in layout_violations(rec):
+            print(f"  LAYOUT {metric}: {v}", flush=True)
             failed = True
     for ref_path in refs:
         ref_metrics = load_metrics(ref_path)

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Pretty-print a bench "layout" block, or diff two rounds' blocks.
+
+Usage:
+    python tools/layout_report.py RUN.json
+    python tools/layout_report.py OLD.json NEW.json
+
+The sibling of tools/hbm_report.py for the parallelism-layout dimension:
+accepts a raw autotune decision dict (``paddle_tpu.memory.LayoutDecision
+.as_json()``), a bench JSON line carrying it under ``"layout"``, or a
+BENCH_r*.json round record ({"n", "cmd", "tail", "parsed"}). Diff mode
+explains "why did this round's layout change" — winning mesh/schedule,
+predicted throughput, and the search-space deltas — from recorded data
+instead of a re-search. A present-but-malformed block exits 1: a bench
+that claims to have autotuned must carry a readable decision.
+Contract: docs/AUTOTUNE.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_AXES = ("dp", "sharding", "mp", "pp", "sep")
+
+
+def _is_layout(d):
+    return (isinstance(d, dict) and "label" in d
+            and "predicted_score" in d and "layout" in d)
+
+
+def _is_disabled(d):
+    return isinstance(d, dict) and d.get("enabled") is False
+
+
+def _scan_lines(text):
+    """LAST JSON-object line carrying a layout block (bench stdout prints
+    log lines and, on TPU, TWO metric lines — the headline one is last)."""
+    best = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and ("layout" in d or _is_layout(d)):
+            best = d
+    return best
+
+
+def _extract(data):
+    if not isinstance(data, dict):
+        return None
+    if _is_layout(data) or _is_disabled(data):
+        return data
+    blk = data.get("layout")
+    if _is_layout(blk) or _is_disabled(blk):
+        return blk
+    if isinstance(blk, dict):
+        raise ValueError(
+            "malformed layout block: expected an autotune decision "
+            f"(label/predicted_score/layout) or {{'enabled': false}}, "
+            f"got keys {sorted(blk.keys())}")
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict):
+        got = _extract(parsed)
+        if got is not None:
+            return got
+    tail = data.get("tail")
+    if isinstance(tail, str):
+        return _extract(_scan_lines(tail))
+    return None
+
+
+def load_layout(path):
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        data = _scan_lines(text)
+        if data is None:
+            raise ValueError(f"{path}: no JSON object found")
+    blk = _extract(data)
+    if blk is None:
+        raise ValueError(
+            f"{path}: no layout block found (expected an autotune decision "
+            "dict, a bench JSON line with a 'layout' key, or a "
+            "BENCH_r*.json round record — rounds before the autotuner "
+            "don't carry one)")
+    return blk
+
+
+def _fmt_bytes(v):
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(v) < 1024 or unit == "GB":
+            return (f"{v:.2f}{unit}" if unit != "B" else f"{int(v)}B")
+        v /= 1024
+    return f"{v:.2f}GB"
+
+
+def _fmt_rate(v):
+    return "-" if v is None else f"{float(v):,.0f} tok/s"
+
+
+def _mesh(layout):
+    live = [f"{a}{layout[a]}" for a in _AXES if int(layout.get(a, 1)) > 1]
+    return "x".join(live) or "single"
+
+
+def print_layout(blk, out=None):
+    # resolve stdout at call time (a def-time default would pin whatever
+    # stream was active at first import — e.g. a pytest capture buffer)
+    w = (out or sys.stdout).write
+    if _is_disabled(blk):
+        w("layout: autotune disabled for this round\n")
+        return
+    lay = blk["layout"]
+    w(f"winner: {blk.get('label')} source={blk.get('source')} "
+      f"chip={blk.get('chip')} devices={blk.get('device_count')}\n")
+    w(f"  mesh: {_mesh(lay)} zero_stage={lay.get('zero_stage')} "
+      f"schedule={lay.get('pp_schedule')}"
+      f"@{lay.get('pp_microbatches') or lay.get('pp')}\n")
+    w(f"  predicted: {_fmt_rate(blk.get('predicted_score'))} "
+      f"({blk.get('predicted_step_seconds', 0):.6f}s/step)\n")
+    pk, bd = blk.get("peak_bytes"), blk.get("budget_bytes")
+    w(f"  peak: {_fmt_bytes(pk)} of {_fmt_bytes(bd)} "
+      f"fits={blk.get('fits')}\n")
+    link = blk.get("link") or {}
+    if link:
+        tag = " (placeholder)" if link.get("placeholder") else ""
+        w(f"  link: {_fmt_bytes(link.get('bytes_per_sec'))}/s{tag}\n")
+    w(f"  search: {blk.get('searched')} lowered, "
+      f"{blk.get('pruned_total')} pruned, "
+      f"{blk.get('search_seconds', 0):.1f}s key={blk.get('key')}\n")
+    if blk.get("fallback_reason"):
+        w(f"  FALLBACK: {blk['fallback_reason']}\n")
+    for reason, n in sorted((blk.get("pruned_by_reason") or {}).items()):
+        w(f"    pruned[{reason}]: {n}\n")
+    base = blk.get("baseline")
+    if base:
+        w(f"  baseline: {base.get('label')} "
+          f"{_fmt_rate(base.get('predicted_tokens_per_sec'))} "
+          f"fits={base.get('fits')}\n")
+    cands = blk.get("candidates") or []
+    if cands:
+        w(f"-- top candidates ({len(cands)}) --\n")
+        for c in cands:
+            tag = "fits" if c.get("fits") else "over budget"
+            star = "*" if c.get("is_baseline") else " "
+            w(f" {star}{c.get('label')}: "
+              f"{_fmt_rate(c.get('predicted_tokens_per_sec'))} "
+              f"idle={c.get('idle_fraction', 0):.2f} "
+              f"wire={_fmt_bytes(c.get('wire_bytes_per_step'))} [{tag}]\n")
+    errors = blk.get("errors") or []
+    if errors:
+        w(f"-- lowering errors ({len(errors)}) --\n")
+        for e in errors:
+            w(f"  {e.get('label')}: {e.get('error')}\n")
+
+
+def diff_layout(old, new, out=None):
+    w = (out or sys.stdout).write
+    if _is_disabled(old) or _is_disabled(new):
+        w(f"autotune enabled: {not _is_disabled(old)} -> "
+          f"{not _is_disabled(new)}\n")
+        if _is_disabled(old) and not _is_disabled(new):
+            print_layout(new, out)
+        return []
+    changed = []
+    for k in ("label", "source", "chip", "device_count", "fits",
+              "fallback_reason", "key"):
+        if old.get(k) != new.get(k):
+            changed.append(f"  {k}: {old.get(k)} -> {new.get(k)}")
+    for a in (*_AXES, "zero_stage", "pp_schedule", "pp_microbatches",
+              "bucket_mb", "batch", "head_chunk", "quant"):
+        ov, nv = old["layout"].get(a), new["layout"].get(a)
+        if ov != nv:
+            changed.append(f"  layout.{a}: {ov} -> {nv}")
+    w("layout changes (new vs old):\n")
+    w(("\n".join(changed) + "\n") if changed
+      else "  (same winner/source)\n")
+    w("prediction deltas:\n")
+    any_delta = False
+    for k in ("predicted_score", "predicted_step_seconds", "peak_bytes",
+              "searched", "pruned_total", "search_seconds"):
+        ov, nv = old.get(k), new.get(k)
+        if ov is None and nv is None or ov == nv:
+            continue
+        any_delta = True
+        delta = (nv or 0) - (ov or 0)
+        rel = f" ({delta / ov:+.1%})" if ov else ""
+        fmt = _fmt_bytes if k == "peak_bytes" else (
+            lambda v: "-" if v is None else f"{float(v):,.2f}")
+        w(f"  {k}: {fmt(ov)} -> {fmt(nv)}{rel}\n")
+    if not any_delta:
+        w("  (no prediction changes)\n")
+    return changed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run", help="bench JSON / layout decision block")
+    ap.add_argument("other", nargs="?",
+                    help="second run: diff mode (old=first, new=second)")
+    args = ap.parse_args(argv)
+    try:
+        if args.other is None:
+            print_layout(load_layout(args.run))
+        else:
+            diff_layout(load_layout(args.run), load_layout(args.other))
+    except ValueError as e:
+        print(f"layout_report: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

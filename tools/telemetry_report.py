@@ -260,6 +260,28 @@ def print_overload(snap, out=None):
         w(r + "\n")
 
 
+def print_layout(snap, out=None):
+    """Layout-autotuner section (docs/AUTOTUNE.md): one row per
+    (verdict, reason) over the candidate lattice — ``pruned`` rows never
+    paid a lowering (the compose probe declined their mesh shell),
+    ``lowered`` rows were AOT-compiled and priced, ``error`` rows failed
+    to lower — plus the wall seconds the search spent."""
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    series = counters.get("autotune_candidates_total") or {}
+    secs = (gauges.get("autotune_search_seconds") or {}).get("")
+    if not series and secs is None:
+        return
+    w = (out or sys.stdout).write
+    w("-- layout (autotune candidate verdicts) --\n")
+    for labels, v in sorted(series.items()):
+        d = dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+        w(f"  {d.get('verdict', '?')} [{d.get('reason', '?')}]: "
+          f"x{int(v)}\n")
+    if secs is not None:
+        w(f"  search_seconds: {float(secs):.3f}\n")
+
+
 def print_trace(snap, out=None):
     """Span-tracer section (docs/TELEMETRY.md Tracing): the
     ``trace_span_seconds`` histogram family mirrors every completed
@@ -288,6 +310,7 @@ def print_snapshot(snap, out=None):
     out = out or sys.stdout
     w = out.write
     print_trace(snap, out)
+    print_layout(snap, out)
     print_plans(snap, out)
     print_comms(snap, out)
     print_zero(snap, out)
