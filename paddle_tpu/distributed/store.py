@@ -122,11 +122,15 @@ class TCPStore:
     """
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
-                 world_size=1, timeout=30.0):
+                 world_size=1, timeout=30.0, clock=None):
         self._server = None
         self._native_handle = None
         self.host = host
         self.timeout = timeout
+        # connect/wait deadlines are measured on a monotonic clock: a
+        # wall-clock step (NTP) must not hang or instantly expire a
+        # rendezvous wait. `clock` is injectable for tests.
+        self._clock = clock if clock is not None else time.monotonic
         if is_master:
             from ..core import native
 
@@ -157,9 +161,9 @@ class TCPStore:
         return self._native_handle is not None
 
     def _connect(self):
-        deadline = time.time() + self.timeout
+        deadline = self._clock() + self.timeout
         last = None
-        while time.time() < deadline:
+        while self._clock() < deadline:
             try:
                 s = socket.create_connection((self.host, self.port),
                                              timeout=self.timeout)
@@ -207,13 +211,14 @@ class TCPStore:
         import math
 
         t = timeout if timeout is not None else self.timeout
-        deadline = None if (t is None or not math.isfinite(t)) else time.time() + t
+        deadline = (None if (t is None or not math.isfinite(t))
+                    else self._clock() + t)
         interval = 0.02
         while True:
             val = self._req(_CMD_GET, key)
             if val is not None:
                 return val
-            if deadline is not None and time.time() >= deadline:
+            if deadline is not None and self._clock() >= deadline:
                 raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
             time.sleep(interval)
             interval = min(interval * 1.5, 0.5)

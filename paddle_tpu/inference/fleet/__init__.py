@@ -22,6 +22,12 @@ topology:
   respawn, SLO-driven autoscaling, and zero-loss rolling weight
   upgrades over the KV-migration wire (``PTPU_FLEET_PROC=0`` falls
   back to in-process loopback children, bitwise).
+- :mod:`.hosts` — cross-host topology (``PTPU_FLEET_HOSTS``): per-host
+  agents rendezvous through the distributed TCPStore, the supervisor
+  places replicas across hosts and fences each (re)lease with a
+  monotone epoch, network partitions sever whole hosts (fence + replay,
+  then quarantine-and-adopt on heal), and overload shedding upgrades to
+  live cross-host migration when a peer has headroom.
 
 The int8 paged-KV mode lives in the engine itself
 (``inference.serving``, ``PTPU_INT8_KV``); it composes with every
@@ -32,13 +38,16 @@ from .cluster import (AutoscaleConfig, Autoscaler, FleetSupervisor,  # noqa: F40
                       build_model_from_spec, fleet_proc_enabled,
                       make_model_spec)
 from .disagg import DisaggregatedEngine  # noqa: F401
+from .hosts import (AgentClient, HostAgent, HostDirectory, HostHandle,  # noqa: F401
+                    HostLost, fleet_hosts_enabled, spawn_local_agent,
+                    spawn_proc_agent)
 from .overload import (Overloaded, OverloadConfig, RemoteReplicaError,  # noqa: F401
                        TransientReplicaError, classify_step_exception,
                        outcome_from_wire, outcome_to_wire,
                        overload_enabled)
 from .router import POLICIES, FleetRouter, ReplicaHandle, make_replicas  # noqa: F401
-from .soak import (build_workload, fleet_soak, overload_block, run_soak,  # noqa: F401
-                   soak_block, upgrade_block)
+from .soak import (build_workload, fleet_soak, overload_block,  # noqa: F401
+                   partition_block, run_soak, soak_block, upgrade_block)
 from .spec_decode import DraftRunner  # noqa: F401
 from .transport import (LoopbackTransport, RemoteEngine, ReplicaServer,  # noqa: F401
                         SocketTransport, Transport, TransportError,
@@ -48,7 +57,7 @@ __all__ = [
     "FleetRouter", "ReplicaHandle", "POLICIES", "make_replicas",
     "DisaggregatedEngine", "DraftRunner", "build_workload", "run_soak",
     "fleet_soak", "soak_block", "overload_block", "upgrade_block",
-    "Overloaded",
+    "partition_block", "Overloaded",
     "OverloadConfig", "TransientReplicaError", "RemoteReplicaError",
     "classify_step_exception", "overload_enabled", "outcome_to_wire",
     "outcome_from_wire", "Transport", "LoopbackTransport",
@@ -56,4 +65,7 @@ __all__ = [
     "TransportTimeout", "TransportSevered", "FleetSupervisor",
     "Autoscaler", "AutoscaleConfig", "make_model_spec",
     "build_model_from_spec", "fleet_proc_enabled",
+    "HostAgent", "AgentClient", "HostDirectory", "HostHandle",
+    "HostLost", "fleet_hosts_enabled", "spawn_local_agent",
+    "spawn_proc_agent",
 ]

@@ -41,6 +41,7 @@ import tempfile
 import time
 
 from ... import telemetry as _telemetry
+from ...distributed.store import TCPStore
 from ...telemetry import flight as _flight
 from .overload import _OFF_SPELLINGS
 from .router import RID_STRIDE, FleetRouter
@@ -60,7 +61,8 @@ _RESPAWNS = _telemetry.counter(
     "fleet_respawns_total", "replica child processes respawned")
 _MIGRATIONS = _telemetry.counter(
     "fleet_migrations_total",
-    "live requests migrated between replicas (KV rode the wire)")
+    "live requests migrated between replicas (KV rode the wire)",
+    labelnames=("reason",))
 _MIGRATION_BYTES = _telemetry.counter(
     "fleet_migration_bytes_total",
     "serialized request/KV bytes shipped during migrations")
@@ -71,6 +73,13 @@ _AUTOSCALE = _telemetry.counter(
     "fleet_autoscale_total", "autoscaler actions", labelnames=("direction",))
 _PROCS = _telemetry.gauge(
     "fleet_replica_procs", "live replica child processes")
+_PREFIX_WARM = _telemetry.counter(
+    "fleet_prefix_warm_pages_total",
+    "prefix-cache pages shipped to a drain destination before retiring "
+    "the source")
+_LEASE_EPOCH = _telemetry.gauge(
+    "fleet_lease_epoch", "current lease fencing epoch per replica",
+    labelnames=("replica",))
 
 
 def fleet_proc_enabled():
@@ -326,7 +335,8 @@ class FleetSupervisor:
                  max_queue_depth=None, lease_seconds=30.0,
                  heartbeat_every=2.0, workdir=None, transport_kw=None,
                  chaos=None, autoscale=None, max_respawns=8,
-                 respawn=True, warmup_new=True):
+                 respawn=True, warmup_new=True, hosts=None, store=None,
+                 host_lease_seconds=2.0, push=None):
         self.spec = dict(spec)
         # PTPU_FLEET_PROC=0 forces the in-process loopback children
         # everywhere, no code change — the bitwise escape hatch
@@ -353,6 +363,42 @@ class FleetSupervisor:
         self._upgrade = None
         self.upgrades = []            # completed upgrade summaries
         self._slo_engine = None
+        # cross-host topology (fleet.hosts): agents, host leases, fenced
+        # epochs.  hosts=None (or PTPU_FLEET_HOSTS=0) keeps the PR 18
+        # single-host spawn path bitwise.
+        self.n_target = int(n_replicas)
+        self.host_lease_seconds = float(host_lease_seconds)
+        self.host_handles = {}        # host_id -> hosts.HostHandle
+        self.store = store
+        self._own_store = False
+        self._hosts_mod = None
+        self.directory = None
+        self._epoch_counter = 0
+        self._want_respawn = 0        # respawns deferred: no live host
+        self.host_severs = 0
+        self.host_heals = 0
+        self.adopted_workers = 0
+        self.rescued = 0
+        self.rebalanced = 0
+        self.prefix_warm_pages = 0
+        n_hosts = int(hosts) if hosts else 0
+        if n_hosts:
+            from . import hosts as _hosts_mod
+
+            if not _hosts_mod.fleet_hosts_enabled():
+                n_hosts = 0           # single-host escape hatch
+            else:
+                self._hosts_mod = _hosts_mod
+                self._init_hosts(n_hosts)
+        # push token streaming: default-on across hosts (that is where
+        # TTFT is quantized by the supervisor tick), PTPU_PUSH_STREAM
+        # overrides either way
+        raw = os.environ.get("PTPU_PUSH_STREAM", "").strip().lower()
+        if raw:
+            self._push = raw not in _OFF_SPELLINGS
+        else:
+            self._push = bool(self.host_handles) if push is None \
+                else bool(push)
         engines = []
         spawned = []
         for _ in range(n_replicas):
@@ -363,14 +409,81 @@ class FleetSupervisor:
                                   max_queue_depth=max_queue_depth,
                                   overload=overload)
         for idx, child in enumerate(spawned):
-            self.children[idx] = child
+            self._register_child(idx, child)
+        if self.host_handles:
+            self.router.shed_rescue = self._rescue_shed
         _PROCS.set(float(len(self.children)))
 
+    def _init_hosts(self, n_hosts):
+        """Start ``n_hosts`` agents, then DISCOVER them back through the
+        store (the rendezvous contract: the supervisor reads records the
+        agents wrote, it is never configured with addresses)."""
+        mod = self._hosts_mod
+        if self.store is None:
+            self.store = TCPStore(is_master=True)
+            self._own_store = True
+        self.directory = mod.HostDirectory(self.store)
+        for i in range(n_hosts):
+            host_id = f"host{i}"
+            if self.proc:
+                handle = mod.spawn_proc_agent(
+                    self.spec, host_id, self.directory, store=self.store,
+                    workdir=self.workdir,
+                    transport_kw=self.transport_kw)
+            else:
+                handle = mod.spawn_local_agent(
+                    self.spec, host_id, self.directory,
+                    transport_kw=self.transport_kw)
+            self.host_handles[host_id] = handle
+        # rendezvous: every agent's record must be readable back
+        self.directory.wait_hosts(n_hosts)
+        self._set_host_gauge()
+
+    def _set_host_gauge(self):
+        if not self._telemetry_on():
+            return
+        alive = sum(1 for h in self.host_handles.values()
+                    if h.state == "alive")
+        self._hosts_mod._HOSTS.set(float(alive), labels=("alive",))
+        self._hosts_mod._HOSTS.set(
+            float(len(self.host_handles) - alive), labels=("severed",))
+
+    @staticmethod
+    def _telemetry_on():
+        return _telemetry.get_registry().enabled
+
     # -- spawning -----------------------------------------------------------
+    def _next_epoch(self):
+        """Monotone fencing token: every (re)lease of a replica gets a
+        strictly higher epoch, stamped into every frame its transport
+        sends.  A frame from an older lease is rejected server-side
+        (StaleLease) and a reply made under an older lease is dropped
+        client-side — split-brain safety by construction."""
+        self._epoch_counter += 1
+        return self._epoch_counter
+
+    def _pick_host(self):
+        """Placement: fewest placed replicas among live hosts (spread
+        across failure domains), ordinal-tie-broken for determinism.
+        None when every host is severed."""
+        alive = [h for h in self.host_handles.values()
+                 if h.state == "alive"]
+        if not alive:
+            return None
+        return min(alive,
+                   key=lambda h: (len(h.replicas) + h.pending, h.ordinal))
+
     def _spawn(self):
         ordinal = self._next_ordinal
         self._next_ordinal += 1
-        if self.proc:
+        if self.host_handles:
+            host = self._pick_host()
+            if host is None:
+                raise TransportError("no live host to place a replica on")
+            child = self._hosts_mod.spawn_on_host(
+                host, self.spec, ordinal, transport_kw=self.transport_kw)
+            host.pending += 1
+        elif self.proc:
             child = ProcChild(self.spec, ordinal, workdir=self.workdir,
                               transport_kw=self.transport_kw)
         else:
@@ -379,15 +492,45 @@ class FleetSupervisor:
         wrap = self._chaos.get(ordinal)
         if wrap is not None:
             child.transport = wrap(child.transport)
+        if self.host_handles:
+            # fence the lease BEFORE first contact: the hello frame
+            # already carries the new epoch
+            child.transport.epoch = self._next_epoch()
         engine = RemoteEngine(child.transport)
+        if self._push:
+            engine.enable_push()
         return child, engine
 
+    def _register_child(self, idx, child):
+        """Router-index bookkeeping shared by initial spawn, respawn,
+        and heal adoption: child table, host membership, epoch gauge."""
+        self.children[idx] = child
+        host_id = getattr(child, "host_id", None)
+        if host_id is not None:
+            self.router.replicas[idx].host = host_id
+            host = self.host_handles.get(host_id)
+            if host is not None:
+                host.replicas.add(idx)
+                host.pending = max(0, host.pending - 1)
+        if self._telemetry_on():
+            _LEASE_EPOCH.set(
+                float(getattr(child.transport, "epoch", 0) or 0),
+                labels=(str(idx),))
+
     def _spawn_replacement(self):
-        child, engine = self._spawn()
+        try:
+            child, engine = self._spawn()
+        except TransportError:
+            if not self.host_handles:
+                raise
+            # every host is severed (or the picked one died mid-spawn):
+            # defer — _host_tick respawns as soon as a host is live
+            self._want_respawn += 1
+            return None
         if self.warmup_new:
             engine.warmup()
         idx = self.router.add_replica(engine)
-        self.children[idx] = child
+        self._register_child(idx, child)
         self.respawns += 1
         _RESPAWNS.inc()
         _PROCS.set(float(self._live_children()))
@@ -483,9 +626,13 @@ class FleetSupervisor:
     # -- the fleet tick -----------------------------------------------------
     def step(self):
         self.tick += 1
+        if self.host_handles:
+            self._host_tick()
         self._lease_tick()
         self._upgrade_tick()
         self._autoscale_tick()
+        if self.host_handles:
+            self._rebalance_tick()
         self._prestep()
         return self.router.step()
 
@@ -563,8 +710,251 @@ class FleetSupervisor:
                      "pid": child.pid,
                      "supervisor": True})
         _PROCS.set(float(self._live_children()))
+        host = self.host_handles.get(
+            getattr(self.children.get(idx), "host_id", None))
+        if host is not None:
+            host.replicas.discard(idx)
         if self.respawn and self.respawns < self.max_respawns:
             self._spawn_replacement()
+
+    # -- host leases (cross-host topology) ----------------------------------
+    def sever_host(self, host_id):
+        """Chaos seam: partition ``host_id`` away from the supervisor
+        (links drop, heartbeats stop reaching the store).  Detection and
+        fencing still run through :meth:`_host_tick` — nothing here
+        touches fleet state directly."""
+        self.host_handles[host_id].sever()
+
+    def heal_host(self, host_id):
+        self.host_handles[host_id].heal()
+
+    def _host_tick(self):
+        """Host-lease check: a host is live while its heartbeat counter
+        ADVANCES (monotone store counter, never a wall-clock timestamp)
+        or its agent answers a direct ping.  Both silent past
+        ``host_lease_seconds`` => severed: fence + replay every replica
+        it held, fleet-wide, in one tick.  A severed host whose beats
+        resume AND whose agent answers again is healed: its surviving
+        workers are re-leased at a higher epoch (they self-quarantine on
+        first contact) and adopted back or retired."""
+        now = time.monotonic()
+        for host in self.host_handles.values():
+            advanced = False
+            try:
+                beats = self.directory.beats(host.ordinal)
+                if beats > host.last_beats:
+                    host.last_beats = beats
+                    advanced = True
+            except Exception:         # noqa: BLE001
+                pass                  # store unreachable from HERE
+            if not advanced:
+                # stalled counter: confirm over the direct agent link
+                try:
+                    host.client.ping(timeout=1.0)
+                    advanced = True
+                except Exception:     # noqa: BLE001
+                    pass
+            if advanced:
+                host.last_advance = now
+                if host.state == "severed":
+                    self._host_healed(host)
+            elif host.state == "alive" \
+                    and now - host.last_advance >= self.host_lease_seconds:
+                self._host_severed(host)
+        while self._want_respawn > 0 and self.respawn \
+                and self.respawns < self.max_respawns \
+                and self._pick_host() is not None:
+            self._want_respawn -= 1
+            self._spawn_replacement()
+        self._set_host_gauge()
+
+    def _host_severed(self, host):
+        """One lost host, one tick: every replica on it is fenced to a
+        dead lease (its epoch can never be stamped again) and declared
+        dead through the router, so all its requests replay elsewhere
+        through the existing exactly-once machinery."""
+        host.state = "severed"
+        self.host_severs += 1
+        self._hosts_mod._SEVERED.inc()
+        _flight.maybe_dump("host_severed", {
+            "host": host.host_id, "ordinal": host.ordinal,
+            "replicas": sorted(host.replicas)})
+        for idx in sorted(host.replicas):
+            handle = self.router.replicas[idx]
+            if not handle.healthy or handle.retired:
+                continue
+            self._reaped.add(idx)
+            child = self.children.get(idx)
+            if child is not None:
+                child.kill()          # best-effort; the epoch fences it
+            self.router.kill_replica(
+                idx, self._hosts_mod.HostLost(
+                    f"host {host.host_id} severed"),
+                raise_if_empty=False,
+                context={"host": host.host_id, "supervisor": True})
+            if self.respawn and self.respawns < self.max_respawns:
+                self._spawn_replacement()
+        host.replicas.clear()
+        _PROCS.set(float(self._live_children()))
+
+    def _host_healed(self, host):
+        """The partition healed.  Surviving workers are stranded at
+        their old (dead) epoch: re-contacting them with a freshly minted
+        higher epoch quarantines them first (all old-lease work is
+        cancelled server-side, never surfaced), then they rejoin the
+        fleet if it is below target size — otherwise they are retired
+        via the agent."""
+        host.state = "alive"
+        self.host_heals += 1
+        self._hosts_mod._HEALED.inc()
+        try:
+            survivors = host.client.list_workers()["workers"]
+        except Exception:             # noqa: BLE001
+            host.state = "severed"    # not actually reachable yet
+            return
+        _flight.maybe_dump("host_healed", {
+            "host": host.host_id, "survivors": sorted(survivors)})
+        for wid in sorted(survivors, key=int):
+            winfo = survivors[wid]
+            if not winfo.get("alive", True):
+                continue
+            n_live = sum(1 for h in self.router.replicas
+                         if h.healthy and not h.retired)
+            if n_live >= self.n_target:
+                try:
+                    host.client.kill_worker(int(wid))
+                except Exception:     # noqa: BLE001
+                    pass
+                continue
+            try:
+                idx = self._adopt_worker(host, int(wid), winfo)
+            except Exception:         # noqa: BLE001
+                try:
+                    host.client.kill_worker(int(wid))
+                except Exception:     # noqa: BLE001
+                    pass
+                continue
+            self.adopted_workers += 1
+            self._hosts_mod._ADOPTED.inc()
+            _flight.maybe_dump("worker_adopted", {
+                "host": host.host_id, "worker": int(wid),
+                "replica": idx})
+
+    def _adopt_worker(self, host, wid, winfo):
+        """Open a fresh partition-gated link to a healed host's
+        surviving worker at a freshly minted epoch (the hello frame
+        quarantines it) and add it to the fleet."""
+        from ...testing.chaos import PartitionedLink
+
+        mod = self._hosts_mod
+        if host.agent is not None:
+            raw = host.agent.worker_transport(wid, seed=wid,
+                                              **self.transport_kw)
+        else:
+            raw = SocketTransport(host.record.get("address", "127.0.0.1"),
+                                  winfo["port"], seed=wid,
+                                  **self.transport_kw)
+        link = PartitionedLink(raw)
+        host.links.append(link)
+        link.epoch = self._next_epoch()
+        engine = RemoteEngine(link)   # hello at the new epoch: quarantine
+        if self._push:
+            engine.enable_push()
+        if self.warmup_new:
+            engine.warmup()
+        idx = self.router.add_replica(engine)
+        child = mod.HostedChild(host, wid, winfo, link)
+        self._register_child(idx, child)
+        _PROCS.set(float(self._live_children()))
+        return idx
+
+    # -- shedding-becomes-migration + queue rebalance -----------------------
+    def _rescue_shed(self, entry, reason):
+        """Installed as ``router.shed_rescue`` on cross-host fleets:
+        before the overload ladder sheds a queued request, look for a
+        replica with REAL headroom (under half its queue cap, on a live
+        host) — overflow-priced, so a rescue can never itself create the
+        overload it is escaping.  True => the request was dispatched
+        there instead of shed."""
+        best, best_key = None, None
+        for h in self.router.replicas:
+            if not self._routable(h) or h.draining:
+                continue
+            if h.host is not None \
+                    and self.host_handles.get(h.host) is not None \
+                    and self.host_handles[h.host].state != "alive":
+                continue
+            load = h.engine.load()
+            if 2 * load["queue_depth"] >= self.router.max_queue_depth:
+                continue              # headroom, not merely room
+            key = (load["queue_depth"] + 0.5 * load["occupied_slots"]
+                   + (1.0 - load["kv_free_fraction"]), h.idx)
+            if best_key is None or key < best_key:
+                best, best_key = h, key
+        if best is None:
+            return False
+        if not self.router.dispatch_to(entry, best.idx):
+            return False
+        _MIGRATIONS.inc(labels=("shed_rescue",))
+        return True
+
+    def _rebalance_tick(self):
+        """Steal-based queue rebalance across hosts: when one replica is
+        at its queue cap while a replica on ANOTHER host has meaningful
+        headroom, live-migrate queued/swapped requests (KV snapshot over
+        the wire) instead of letting backpressure push the ladder toward
+        shedding.  One donor->recipient batch per tick, deterministic."""
+        donor, recipient = None, None
+        depths = {}
+        for h in self.router.replicas:
+            if not self._routable(h) or h.draining:
+                continue
+            depths[h.idx] = h.engine.load()["queue_depth"]
+        if not depths:
+            return
+        d_idx = max(depths, key=lambda i: (depths[i], -i))
+        if depths[d_idx] < self.router.max_queue_depth:
+            return                    # nobody saturated: nothing to do
+        donor = self.router.replicas[d_idx]
+        for h in self.router.replicas:
+            if h.idx == d_idx or h.idx not in depths:
+                continue
+            if h.host is not None and h.host == donor.host:
+                continue              # rebalance is ACROSS hosts
+            if depths[h.idx] + 2 > depths[d_idx]:
+                continue
+            if recipient is None \
+                    or depths[h.idx] < depths[recipient.idx]:
+                recipient = h
+        if recipient is None:
+            return
+        n = max(1, (depths[d_idx] - depths[recipient.idx]) // 2)
+        try:
+            stolen = donor.engine.steal_requests(n)
+        except Exception:             # noqa: BLE001
+            return
+        for req in stolen:
+            rid = int(req["rid"])
+            try:
+                recipient.engine.inject_wire(req)
+            except Exception:         # noqa: BLE001
+                # the request is out of the donor but not into the
+                # recipient: requeue through the router (replay path)
+                entry = self.router._inflight.pop(rid, None)
+                if entry is not None:
+                    self.router.requeues += 1
+                    self.router._pending.append(
+                        (rid, entry[1], entry[2], entry[3]))
+                continue
+            self.router.reassign(rid, recipient.idx)
+            recipient.engine.adopt_stream(
+                rid, donor.engine.release_stream(rid))
+            nbytes = _wire_size(req)
+            self.rebalanced += 1
+            self.migrated_requests += 1
+            self.migration_bytes += nbytes
+            _MIGRATIONS.inc(labels=("rebalance",))
+            _MIGRATION_BYTES.inc(nbytes)
 
     # -- autoscaling --------------------------------------------------------
     def _autoscale_tick(self):
@@ -611,6 +1001,10 @@ class FleetSupervisor:
             if (load["queue_depth"] == 0 and load["occupied_slots"] == 0
                     and self.router._replica_inflight(handle.idx) == 0):
                 child = self.children.get(handle.idx)
+                peers = [h for h in self.router.replicas
+                         if h is not handle and h.healthy
+                         and not h.retired and not h.draining]
+                self._warm_prefix(handle, peers)
                 handle.retired = True
                 handle.draining = False
                 if child is not None:
@@ -716,11 +1110,12 @@ class FleetSupervisor:
         never loses work, it just degrades to replay."""
         data = handle.engine.drain_requests()
         reqs = list(data["running"]) + list(data["waiting"])
-        if not reqs:
-            return
         peers = [h for h in self.router.replicas
                  if h is not handle and h.healthy
                  and not h.retired and not h.draining]
+        self._warm_prefix(handle, peers)
+        if not reqs:
+            return
         if not peers:
             # single-replica fleet: hold the requests in the router and
             # let them re-dispatch (to this replica, post-upgrade)
@@ -745,8 +1140,34 @@ class FleetSupervisor:
             self.migration_bytes += nbytes
             up["migrated"] += 1
             up["migrate_bytes"] += nbytes
-            _MIGRATIONS.inc()
+            _MIGRATIONS.inc(labels=("upgrade",))
             _MIGRATION_BYTES.inc(nbytes)
+
+    def _warm_prefix(self, handle, peers):
+        """Prefix-cache-preserving drain: before ``handle`` goes away,
+        copy its prefix-page registry to the least-loaded live peer so
+        the fleet's cache hit-rate survives the drain.  Best-effort —
+        a cold or cacheless replica simply exports nothing."""
+        if not peers:
+            return 0
+        if not (self.host_handles
+                or self.spec.get("engine_kw", {}).get(
+                    "enable_prefix_cache")):
+            return 0
+        try:
+            entries = handle.engine.export_prefix()
+            if not entries:
+                return 0
+            peer = min(peers, key=lambda h:
+                       (h.engine.load()["queue_depth"]
+                        + h.engine.load()["occupied_slots"], h.idx))
+            warmed = peer.engine.import_prefix(entries)
+        except Exception:       # noqa: BLE001 — warming never blocks a drain
+            return 0
+        if warmed:
+            self.prefix_warm_pages += warmed
+            _PREFIX_WARM.inc(warmed)
+        return warmed
 
     # -- shutdown -----------------------------------------------------------
     def close(self):
@@ -766,6 +1187,40 @@ class FleetSupervisor:
         for handle in self.router.replicas:
             try:
                 handle.engine.close()
+            except Exception:
+                pass
+        for host in self.host_handles.values():
+            if host.client is not None:
+                try:
+                    host.client.shutdown()
+                except Exception:
+                    pass
+                try:
+                    host.client.close()
+                except Exception:
+                    pass
+            if host.proc_agent is not None:
+                try:
+                    host.proc_agent.terminate()
+                    if host.proc_agent.wait(timeout=5.0) is None:
+                        host.proc_agent.kill()
+                        host.proc_agent.wait(timeout=5.0)
+                    host.proc_agent.close_logs()
+                except Exception:
+                    pass
+            if host.agent is not None:
+                try:
+                    host.agent.close()
+                except Exception:
+                    pass
+            for pid in list(host.worker_pids):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+        if self._own_store and self.store is not None:
+            try:
+                self.store.close()
             except Exception:
                 pass
         _PROCS.set(0.0)
@@ -791,6 +1246,16 @@ class FleetSupervisor:
                 for u in self.upgrades],
             "autoscale": (list(self.autoscaler.decisions)
                           if self.autoscaler else []),
+            "hosts": {hid: h.state
+                      for hid, h in self.host_handles.items()},
+            "host_severs": self.host_severs,
+            "host_heals": self.host_heals,
+            "adopted_workers": self.adopted_workers,
+            "rescued": self.router.rescued,
+            "rebalanced": self.rebalanced,
+            "prefix_warm_pages": self.prefix_warm_pages,
+            "lease_epoch": self._epoch_counter,
+            "push": self._push,
         }
 
 

@@ -90,8 +90,7 @@ def _policy_round_robin(router, prompt, candidates):
 
 
 def _policy_least_loaded(router, prompt, candidates):
-    return min(candidates,
-               key=lambda i: (_load_score(router.replicas[i]), i))
+    return min(candidates, key=lambda i: (router._score(i), i))
 
 
 def _policy_prefix_affinity(router, prompt, candidates):
@@ -103,7 +102,7 @@ def _policy_prefix_affinity(router, prompt, candidates):
     if best <= 0:
         return _policy_least_loaded(router, prompt, candidates)
     front = [i for i in candidates if hits[i] == best]
-    return min(front, key=lambda i: (_load_score(router.replicas[i]), i))
+    return min(front, key=lambda i: (router._score(i), i))
 
 
 POLICIES = {
@@ -120,12 +119,16 @@ class ReplicaHandle:
     sequentially, so wall time is NOT the fleet critical path)."""
 
     __slots__ = ("idx", "engine", "healthy", "dispatched", "steps",
-                 "busy_seconds", "death_reason", "draining", "retired")
+                 "busy_seconds", "death_reason", "draining", "retired",
+                 "host")
 
     def __init__(self, idx, engine):
         self.idx = idx
         self.engine = engine
         self.healthy = True
+        # failure-domain id (fleet.hosts host_id); None on a single-host
+        # fleet, which keeps every scoring path bitwise pre-hosts
+        self.host = None
         self.dispatched = 0
         self.steps = 0
         self.busy_seconds = 0.0
@@ -172,6 +175,12 @@ class FleetRouter:
         self.shed = {}               # rid -> reason (overload shedding)
         self.requeues = 0
         self.served = 0              # completions returned by step()
+        # cross-host placement (fleet.hosts): host-pressure weight in
+        # the policy score, and the shedding-becomes-migration hook the
+        # supervisor installs — both inert on a single-host fleet
+        self.host_spread = 0.25
+        self.shed_rescue = None      # (entry, reason) -> bool (rescued)
+        self.rescued = 0
         # overload machinery (fleet.overload, docs/SERVING.md "Overload
         # & degradation"): None (PTPU_OVERLOAD=0 or overload=False)
         # keeps every pre-overload code path — any step() exception is
@@ -250,6 +259,31 @@ class FleetRouter:
         return False
 
     # -- dispatch -----------------------------------------------------------
+    def _score(self, i):
+        """Policy score for replica ``i``: the load score plus — only
+        when the fleet spans hosts — a host-pressure term that spreads
+        traffic across failure domains (two equally-loaded replicas
+        tie-break to the quieter host).  With no host mapping the term
+        vanishes and scoring is bitwise the pre-hosts behavior."""
+        h = self.replicas[i]
+        score = _load_score(h)
+        if h.host is not None:
+            score += self.host_spread * self._host_pressure(h.host)
+        return score
+
+    def _host_pressure(self, host):
+        """Mean in-flight load (waiting + running) per replica on
+        ``host``, normalized by its replica count so a big host is not
+        penalized for being big."""
+        total, n = 0.0, 0
+        for h in self.replicas:
+            if h.host != host or not h.healthy or h.retired:
+                continue
+            load = h.engine.load()
+            total += load["queue_depth"] + load["occupied_slots"]
+            n += 1
+        return total / n if n else 0.0
+
     def _replica_inflight(self, idx):
         return sum(1 for entry in self._inflight.values()
                    if entry[0] == idx)
@@ -292,6 +326,66 @@ class FleetRouter:
                 return i
         return 0
 
+    def _prepared_kwargs(self, rid, kwargs):
+        """Turn a pending entry's stored kwargs into submit kwargs:
+        stamp the remaining deadline budget and install the
+        delivered-token suppression wrapper (shared by the policy
+        dispatch path and the shed-rescue targeted dispatch)."""
+        kw = dict(kwargs)
+        at = kw.pop("_deadline_at", None)
+        if at is not None:
+            # remaining budget at dispatch; <= 0 cancels on the
+            # replica's first tick (the request is already late)
+            now = (self._ov.clock() if self._ov is not None
+                   else time.perf_counter())
+            kw["deadline_seconds"] = at - now
+        cb = kw.pop("_on_token", None)
+        if cb is not None or rid in self._delivered:
+            # suppress the first `skip` tokens of THIS dispatch's
+            # stream: a dead-replica (or breaker-open) replay
+            # regenerates from scratch, and the client already
+            # received that prefix. The wrapper also feeds the
+            # admission predictor its TTFT observations.
+            skip = self._delivered.get(rid, 0)
+            state = {"seen": 0}
+
+            def on_token(r, t, _cb=cb, _skip=skip, _state=state):
+                _state["seen"] += 1
+                if _state["seen"] > _skip:
+                    n = self._delivered.get(r, 0) + 1
+                    self._delivered[r] = n
+                    if n == 1 and self._ov is not None:
+                        self._ov.predictor.note_first_token(r)
+                    if _cb is not None:
+                        _cb(r, t)
+
+            kw["on_token"] = on_token
+        return kw
+
+    def dispatch_to(self, entry, idx):
+        """Dispatch one specific pending entry to one specific replica —
+        the shedding-becomes-migration path (a supervisor found real
+        headroom on another host for a would-be shed victim).  Returns
+        True if the entry left the pending queue for ``idx``; False
+        leaves it exactly where it was (the shed proceeds)."""
+        try:
+            pos = self._pending.index(entry)
+        except ValueError:
+            return False
+        rid, prompt, kwargs, priority = entry
+        handle = self.replicas[idx]
+        try:
+            handle.engine.submit(prompt, rid=rid,
+                                 **self._prepared_kwargs(rid, kwargs))
+        except Exception:              # noqa: BLE001
+            return False               # best-effort; victim sheds
+        del self._pending[pos]
+        handle.dispatched += 1
+        self._inflight[rid] = (idx, prompt, kwargs, priority)
+        _DISPATCH.inc(labels=("shed_rescue", str(idx)))
+        _trace.async_end("route", rid, {"replica": idx, "rescued": True})
+        return True
+
     def _dispatch_pending(self):
         while self._pending:
             cands = self._candidates()
@@ -302,35 +396,7 @@ class FleetRouter:
             idx = self._policy(self, prompt, cands)
             handle = self.replicas[idx]
             del self._pending[pick]
-            kw = dict(kwargs)
-            at = kw.pop("_deadline_at", None)
-            if at is not None:
-                # remaining budget at dispatch; <= 0 cancels on the
-                # replica's first tick (the request is already late)
-                now = (self._ov.clock() if self._ov is not None
-                       else time.perf_counter())
-                kw["deadline_seconds"] = at - now
-            cb = kw.pop("_on_token", None)
-            if cb is not None or rid in self._delivered:
-                # suppress the first `skip` tokens of THIS dispatch's
-                # stream: a dead-replica (or breaker-open) replay
-                # regenerates from scratch, and the client already
-                # received that prefix. The wrapper also feeds the
-                # admission predictor its TTFT observations.
-                skip = self._delivered.get(rid, 0)
-                state = {"seen": 0}
-
-                def on_token(r, t, _cb=cb, _skip=skip, _state=state):
-                    _state["seen"] += 1
-                    if _state["seen"] > _skip:
-                        n = self._delivered.get(r, 0) + 1
-                        self._delivered[r] = n
-                        if n == 1 and self._ov is not None:
-                            self._ov.predictor.note_first_token(r)
-                        if _cb is not None:
-                            _cb(r, t)
-
-                kw["on_token"] = on_token
+            kw = self._prepared_kwargs(rid, kwargs)
             try:
                 handle.engine.submit(prompt, rid=rid, **kw)
             except Exception as exc:   # noqa: BLE001
@@ -502,6 +568,14 @@ class FleetRouter:
             br.poll()
         for entry, reason in ov.shed_targets(self):
             rid = entry[0]
+            if self.shed_rescue is not None:
+                try:
+                    rescued = self.shed_rescue(entry, reason)
+                except Exception:     # noqa: BLE001
+                    rescued = False   # rescue is best-effort: shed
+                if rescued:
+                    self.rescued += 1
+                    continue          # migrated to headroom, not shed
             try:
                 self._pending.remove(entry)
             except ValueError:

@@ -30,7 +30,7 @@ from .overload import Overloaded
 
 __all__ = ["build_workload", "run_soak", "percentile", "fleet_soak",
            "soak_block", "overload_block", "overload_workload",
-           "default_objectives"]
+           "default_objectives", "upgrade_block", "partition_block"]
 
 #: a TTFT observed more than this many fleet ticks ago ages out of the
 #: per-tick ``values:ttft_p50/p99_recent`` signals — the SLO engine's
@@ -764,4 +764,167 @@ def upgrade_block(supervisor, workload, *, version=1, upgrade_tick=4,
     }
     if window:
         block["window"] = window
+    return block
+
+
+def partition_block(supervisor, workload, *, host=None, sever_tick=4,
+                    heal_tick=None, kill_agent=False,
+                    upgrade_version=None, upgrade_tick=None,
+                    max_ticks=400000, settle_ticks=2000):
+    """The gateable ``"partition"`` JSON block (docs/SERVING.md
+    "Cross-host topology"; ``tools/bench_gate.py`` PARTITION gate):
+    drive ``workload`` through a hosts-mode
+    :class:`.cluster.FleetSupervisor`, partition one whole host away
+    mid-soak (``sever_tick``), optionally SIGKILL its agent
+    (``kill_agent``), heal the partition (``heal_tick``, or after the
+    soak drains), optionally overlap a rolling upgrade, and reduce the
+    run to reference-free gate fields.
+
+    The invariants are absolute:
+
+    - ``conserved`` / ``lost_requests``: every admitted request reaches
+      exactly one terminal outcome even though a whole host's replicas
+      were fenced and their work replayed;
+    - ``duplicate_stream_tokens``: the fencing epochs mean no rid is
+      ever served by two replicas — a stale lease's late tokens are
+      dropped at both ends, so the callback seam must see **zero**
+      duplicate deliveries (and zero losses) across the partition;
+    - ``fleet_live_at_drain``: the fleet is back at target size with
+      every replica healthy once the run settles — replay + respawn
+      actually reconverged;
+    - ``partition.healed``: with a surviving agent the severed host
+      returns to ``alive`` (its stranded workers are quarantined via
+      the epoch bump, then adopted or retired); with ``kill_agent``
+      the host legitimately stays severed and this field is not gated.
+    """
+    recorder = _telemetry.recorder()
+    delivered = {}
+    state = {"severed": None, "healed": None, "up_started": None}
+    if host is None:
+        host = next(iter(supervisor.host_handles), None)
+    if host is None:
+        raise ValueError("partition_block needs a hosts-mode supervisor "
+                         "(FleetSupervisor(..., hosts=N))")
+
+    def token_cb(rid, tok):
+        delivered[rid] = delivered.get(rid, 0) + 1
+
+    def on_tick(tick):
+        if tick == sever_tick and state["severed"] is None:
+            supervisor.sever_host(host)
+            if kill_agent:
+                supervisor.host_handles[host].kill_agent()
+            state["severed"] = tick
+        if (heal_tick is not None and tick >= heal_tick
+                and state["severed"] is not None
+                and state["healed"] is None and not kill_agent):
+            supervisor.heal_host(host)
+            state["healed"] = tick
+        if (upgrade_tick is not None and tick == upgrade_tick
+                and state["up_started"] is None):
+            supervisor.start_rolling_upgrade(upgrade_version or 1)
+            state["up_started"] = tick
+
+    stats, done = run_soak(supervisor, workload, max_ticks=max_ticks,
+                           recorder=recorder, on_tick=on_tick,
+                           token_cb=token_cb)
+    # post-soak: heal a partition the soak outlived, finish any staged
+    # rollout, and let the fleet settle back to target size — the gate
+    # measures the recovery machinery, not the workload length
+    if (state["severed"] is not None and state["healed"] is None
+            and not kill_agent):
+        supervisor.heal_host(host)
+        state["healed"] = "post_drain"
+    for _ in range(settle_ticks):
+        live = sum(1 for h in supervisor.router.replicas
+                   if h.healthy and not h.retired)
+        up_done = (state["up_started"] is None
+                   or supervisor._upgrade is None)
+        host_ok = (kill_agent or state["severed"] is None
+                   or supervisor.host_handles[host].state == "alive")
+        if live >= supervisor.n_target and up_done and host_ok:
+            break
+        supervisor.step()
+        time.sleep(0.001)
+    recorder.close()
+    summary = supervisor.summary()
+
+    live = sum(1 for h in supervisor.router.replicas
+               if h.healthy and not h.retired)
+    # fencing evidence from both ends of every link that still answers
+    fenced_replies = sum(
+        getattr(h.engine, "fenced_replies", 0) or 0
+        for h in supervisor.router.replicas)
+    server_fenced = quarantines = 0
+    for h in supervisor.router.replicas:
+        if not (h.healthy and not h.retired):
+            continue
+        try:
+            st = h.engine.lease()
+        except Exception:
+            continue
+        server_fenced += int(st.get("fenced", 0) or 0)
+        quarantines += int(st.get("quarantines", 0) or 0)
+
+    delivered_total = sum(n for rid, n in delivered.items()
+                          if rid in done)
+    generated = stats["generated_tokens"]
+    submitted = stats["requests"]
+    terminal = (stats["completed"] + stats["cancelled"] + stats["shed"]
+                + stats["rejected"])
+    healed = (state["severed"] is None
+              or supervisor.host_handles[host].state == "alive")
+    block = {
+        "enabled": True,
+        "backend": "proc" if supervisor.proc else "inproc",
+        "replicas": stats["replicas"],
+        "hosts": summary["hosts"],
+        "policy": supervisor._policy_name,
+        "submitted": submitted,
+        "served": stats["completed"],
+        "cancelled": stats["cancelled"],
+        "shed": stats["shed"],
+        "rejected": stats["rejected"],
+        "conserved": bool(stats["outcomes_conserved"]),
+        "lost_requests": max(0, submitted - terminal),
+        "generated_tokens": generated,
+        "delivered_stream_tokens": delivered_total,
+        "duplicate_stream_tokens": max(0, delivered_total - generated),
+        "lost_stream_tokens": max(0, generated - delivered_total),
+        "goodput_tokens_per_sec": stats["goodput_tokens_per_sec"],
+        "sim_seconds": stats["sim_seconds"],
+        "wall_seconds": stats["wall_seconds"],
+        "ttft": stats["ttft"],
+        "fleet_live_at_drain": bool(live >= supervisor.n_target),
+        "partition": {
+            "host": host,
+            "sever_tick": state["severed"],
+            "heal_tick": state["healed"],
+            "agent_killed": bool(kill_agent),
+            "healed": bool(healed),
+            "host_severs": summary["host_severs"],
+            "host_heals": summary["host_heals"],
+            "adopted_workers": summary["adopted_workers"],
+            "fenced_replies": fenced_replies,
+            "server_fenced_calls": server_fenced,
+            "quarantines": quarantines,
+            "lease_epoch": summary["lease_epoch"],
+        },
+        "migration": {
+            "rescued": summary["rescued"],
+            "rebalanced": summary["rebalanced"],
+            "migrated_requests": summary["migrated_requests"],
+            "migration_bytes": summary["migration_bytes"],
+            "prefix_warm_pages": summary["prefix_warm_pages"],
+        },
+        "upgrade": ({
+            "version": upgrade_version or 1,
+            "requested_tick": upgrade_tick,
+            "started_tick": state["up_started"],
+            "complete": bool(state["up_started"] is not None
+                             and supervisor._upgrade is None),
+        } if upgrade_tick is not None else None),
+        "respawns": summary["respawns"],
+        "supervisor": summary,
+    }
     return block

@@ -24,10 +24,26 @@ Failure semantics, end to end:
   reaches an engine.
 
 Streaming: ``on_token`` callbacks cannot cross a process boundary, so
-the server buffers ``(rid, token)`` events and every ``step`` /
-``stream`` reply drains them; :class:`RemoteEngine` replays the events
-into the client-side callbacks, preserving the router's ``_delivered``
-exactly-once suppression machinery unchanged.
+the server assigns every token a per-rid sequence number and (a) pushes
+it immediately to an attached push sink — a second persistent
+connection in socket mode, a client-side buffer in loopback mode — and
+(b) retains it in a per-rid event log that the pull path (``step`` /
+``stream`` replies) drains and can replay from any sequence number.
+:class:`RemoteEngine` delivers events exactly once by sequence number:
+duplicates (a frame that arrived on both channels, a reconnect replay)
+are dropped, gaps are detected and resynced through the pull path, so
+delivery survives reconnects without the router's ``_delivered``
+machinery ever seeing a duplicate.
+
+Fencing: the supervisor stamps a monotonically increasing lease epoch
+into every RPC frame.  A server that sees a HIGHER epoch knows its old
+lease was revoked (the supervisor declared it dead and replayed its
+work elsewhere): it self-quarantines — cancels all live requests,
+drops buffered events and cached replies — before adopting the new
+epoch, so a partitioned-then-healed replica can never double-serve a
+rid.  A frame with a LOWER epoch is a stale caller (a late frame from
+before the partition): it is rejected with :class:`StaleLease` and
+never executes.  Split-brain safety is by construction, not timing.
 """
 
 from __future__ import annotations
@@ -51,6 +67,25 @@ _RETRIES = _telemetry.counter(
 _BYTES = _telemetry.counter(
     "transport_bytes_total", "fleet RPC frame bytes by direction",
     labelnames=("direction",))
+_FENCED = _telemetry.counter(
+    "transport_fenced_calls_total",
+    "RPC frames rejected because their lease epoch was stale")
+_QUARANTINES = _telemetry.counter(
+    "transport_quarantines_total",
+    "replica self-quarantines on seeing a newer lease epoch")
+_PUSH_FRAMES = _telemetry.counter(
+    "transport_stream_push_frames_total",
+    "server-pushed token stream frames")
+_STREAM_DUP = _telemetry.counter(
+    "transport_stream_duplicates_total",
+    "stream events dropped as duplicates by sequence number")
+_STREAM_RESYNC = _telemetry.counter(
+    "transport_stream_resyncs_total",
+    "pull-path resyncs after a stream sequence gap")
+_IDEM_EVICT = _telemetry.counter(
+    "transport_idempotency_evictions_total",
+    "idempotency-cache entries evicted past the window",
+    labelnames=("cause",))
 
 
 class TransportError(ConnectionError):
@@ -63,6 +98,19 @@ class TransportTimeout(TransportError):
 
 class TransportSevered(TransportError):
     """The link is gone: peer dead, socket closed, or chaos-severed."""
+
+
+class StaleLease(RuntimeError):
+    """The caller's lease epoch is older than the replica's: the frame
+    was fenced off without executing.  Crosses the wire as a
+    ``RemoteReplicaError`` whose ``remote_type`` is ``"StaleLease"``
+    (see :func:`is_stale_lease`)."""
+
+
+def is_stale_lease(exc):
+    """True if ``exc`` is a fencing reject, local or rehydrated."""
+    return (isinstance(exc, StaleLease)
+            or getattr(exc, "remote_type", None) == "StaleLease")
 
 
 class SimulatedCrash(BaseException):
@@ -82,6 +130,9 @@ DEFAULT_TIMEOUTS = {
     "drain": 300.0,
     "extract": 120.0,
     "inject": 120.0,
+    "steal": 120.0,
+    "export_prefix": 120.0,
+    "import_prefix": 120.0,
 }
 DEFAULT_TIMEOUT = 60.0
 
@@ -129,6 +180,9 @@ class Transport:
         self.backoffs = []            # realized backoff schedule (tests)
         self.last_ok_time = clock()   # heartbeat-lease anchor
         self.last_load = None         # server-attached load snapshot
+        self.epoch = 0                # lease fencing token, stamped on
+                                      # every frame; supervisor-owned
+        self.last_ep = None           # epoch the last reply was made at
 
     # -- subclass surface ---------------------------------------------------
     def _send(self, frame):
@@ -139,6 +193,11 @@ class Transport:
 
     def close(self):
         pass
+
+    def open_push(self, on_msg):
+        """Open the server->client push stream channel; returns a handle
+        or None when the transport cannot push (base class default)."""
+        return None
 
     # -- call machinery -----------------------------------------------------
     def _backoff_for(self, attempt):
@@ -160,7 +219,8 @@ class Transport:
             self._next_id += 1
         self.calls += 1
         frame = wire.encode_frame(
-            {"id": call_id, "m": method, "a": args or {}}, self.codec)
+            {"id": call_id, "m": method, "a": args or {},
+             "ep": self.epoch}, self.codec)
         needs_send = False
         try:
             self._send(frame)
@@ -224,6 +284,8 @@ class Transport:
 
     def _unwrap(self, reply):
         self.last_ok_time = self.clock()
+        if reply.get("ep") is not None:
+            self.last_ep = int(reply["ep"])
         if reply.get("load") is not None:
             self.last_load = reply["load"]
         err = reply.get("err")
@@ -259,6 +321,16 @@ class LoopbackTransport(Transport):
         if not self._rx:
             raise TransportTimeout("loopback: no reply buffered")
         return self._rx.popleft()
+
+    def open_push(self, on_msg):
+        """Attach the push channel: server-side token events are decoded
+        and handed to ``on_msg(msg)`` synchronously (the in-process
+        analogue of the socket transport's persistent push connection)."""
+        def sink(frame):
+            on_msg(wire.decode_frame(frame))
+
+        self.server.push_sink = sink
+        return sink
 
 
 # ---------------------------------------------------------------------------
@@ -331,6 +403,41 @@ class SocketTransport(Transport):
         _BYTES.inc(len(header) + len(payload), labels=("rx",))
         return header + payload
 
+    def open_push(self, on_msg):
+        """Second persistent connection: subscribe, then a daemon reader
+        thread hands every pushed frame to ``on_msg(msg)``.  Best
+        effort — if the channel dies the reader exits and the pull
+        path's sequence-number resync recovers anything missed."""
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sub = wire.encode_frame(
+            {"id": 0, "m": "stream_subscribe", "a": {},
+             "ep": self.epoch}, self.codec)
+        sock.sendall(sub)
+
+        def reader():
+            try:
+                while True:
+                    header = _recv_exact(sock, wire.HEADER_SIZE)
+                    _, length, _ = wire.parse_header(header)
+                    payload = _recv_exact(sock, length)
+                    msg = wire.decode_frame(header + payload)
+                    if isinstance(msg, dict) and "push" in msg:
+                        on_msg(msg)
+            except (OSError, wire.FrameError, TransportError):
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=reader, daemon=True,
+                             name="ptpu-push-reader")
+        t.start()
+        return sock
+
     def close(self):
         self._drop_conn()
 
@@ -346,9 +453,14 @@ class ReplicaServer:
     with zero extra round trips."""
 
     IDEMPOTENCY_WINDOW = 128
+    #: cached extract/drain replies carry full KV snapshots — a retry
+    #: storm must not pin unbounded host memory, so the window is also
+    #: bounded by retained payload bytes (oldest evicted first)
+    IDEMPOTENCY_BYTES = 32 << 20
 
     def __init__(self, engine, *, replica_id=0, model_factory=None,
-                 scrape_port=None, codec=None):
+                 scrape_port=None, codec=None, idempotency_window=None,
+                 idempotency_bytes=None):
         self.engine = engine
         self.replica_id = replica_id
         self.model_factory = model_factory
@@ -357,14 +469,89 @@ class ReplicaServer:
         self.dead = False
         self.shutting_down = False
         self.weights_version = 0
+        self.idempotency_window = int(
+            idempotency_window if idempotency_window is not None
+            else self.IDEMPOTENCY_WINDOW)
+        self.idempotency_bytes = int(
+            idempotency_bytes if idempotency_bytes is not None
+            else self.IDEMPOTENCY_BYTES)
         self._done = OrderedDict()     # call id -> encoded reply bytes
-        self._events = []              # buffered (rid, token) stream
+        self._done_bytes = 0
+        self.idem_evictions = {"count": 0, "bytes": 0}
+        self._events = []              # pending (rid, seq, token) pull drain
+        self._seq = {}                 # rid -> last assigned seq
+        self._event_log = {}           # rid -> [(seq, token)] replay log
+        self.push_sink = None          # callable(frame_bytes) or None
+        self._push_lock = threading.Lock()
+        self.lease_epoch = 0           # fencing token (supervisor-owned)
+        self.fenced = 0                # frames rejected as stale
+        self.quarantines = 0
+        self.quarantined_rids = []     # rids cancelled by quarantines
         self.handled = 0
         self.duplicates = 0
 
-    # engine token streaming lands in the buffer; step/stream drain it
+    # -- token streaming ----------------------------------------------------
+    # every token gets a per-rid sequence number, lands in the pull
+    # buffer + replay log, and is pushed immediately when a sink is
+    # attached (the persistent push connection / loopback buffer)
     def _event_cb(self, rid, tok):
-        self._events.append((int(rid), int(tok)))
+        rid, tok = int(rid), int(tok)
+        seq = self._seq.get(rid, 0) + 1
+        self._seq[rid] = seq
+        self._events.append((rid, seq, tok))
+        self._event_log.setdefault(rid, []).append((seq, tok))
+        sink = self.push_sink
+        if sink is not None:
+            frame = wire.encode_frame(
+                {"push": [(rid, seq, tok)], "ep": self.lease_epoch},
+                self.codec)
+            try:
+                with self._push_lock:
+                    sink(frame)
+                _PUSH_FRAMES.inc()
+            except OSError:
+                # push channel is best-effort: the pull path replays
+                # from the event log, sequence numbers dedup overlap
+                self.push_sink = None
+
+    def _retire_stream(self, rid):
+        rid = int(rid)
+        self._seq.pop(rid, None)
+        self._event_log.pop(rid, None)
+
+    def _reset_stream(self, rid):
+        rid = int(rid)
+        self._seq[rid] = 0
+        self._event_log[rid] = []
+
+    def _quarantine(self, new_epoch):
+        """The supervisor re-leased at a higher epoch: everything this
+        replica was doing under the old lease has been replayed
+        elsewhere.  Cancel it all, drop buffered events, cached replies
+        and stream state, THEN adopt the new epoch — by construction no
+        old-lease work can ever surface under the new one."""
+        eng = self.engine
+        live = [r.rid for r in eng._slots if r is not None]
+        live += [r.rid for r in list(eng._waiting)]
+        # a freshly spawned replica adopting its first lease has nothing
+        # to drop — that is plain epoch adoption, not a quarantine
+        had_state = bool(live or self._events or self._event_log
+                         or self._done)
+        for rid in live:
+            eng.cancel(rid, reason="fenced")
+        # the supervisor already replayed these rids on peers — the
+        # engine-side cancels are bookkeeping, not terminal outcomes
+        eng.cancelled.clear()
+        self.quarantined_rids.extend(int(r) for r in live)
+        self._events = []
+        self._seq.clear()
+        self._event_log.clear()
+        self._done.clear()
+        self._done_bytes = 0
+        if had_state:
+            self.quarantines += 1
+            _QUARANTINES.inc()
+        self.lease_epoch = int(new_epoch)
 
     def handle_frame(self, data):
         try:
@@ -376,6 +563,21 @@ class ReplicaServer:
             return wire.encode_frame(
                 {"id": None, "err": outcome_to_wire(exc)}, self.codec)
         call_id = msg.get("id")
+        ep = msg.get("ep")
+        if ep is not None:
+            ep = int(ep)
+            if ep > self.lease_epoch:
+                self._quarantine(ep)
+            elif ep < self.lease_epoch:
+                # stale caller: fence the frame off BEFORE the
+                # idempotency cache — it must never execute or replay
+                self.fenced += 1
+                _FENCED.inc()
+                return wire.encode_frame(
+                    {"id": call_id, "ep": self.lease_epoch,
+                     "err": outcome_to_wire(StaleLease(
+                         f"frame epoch {ep} < lease epoch "
+                         f"{self.lease_epoch}"))}, self.codec)
         cached = self._done.get(call_id)
         if cached is not None:
             # duplicate / re-sent frame: replay, do NOT re-execute
@@ -390,6 +592,7 @@ class ReplicaServer:
             raise
         except Exception as exc:
             reply = {"id": call_id, "err": outcome_to_wire(exc)}
+        reply["ep"] = self.lease_epoch
         try:
             reply["load"] = self.engine.load()
         except Exception:
@@ -397,8 +600,18 @@ class ReplicaServer:
         out = wire.encode_frame(reply, self.codec)
         if call_id is not None:
             self._done[call_id] = out
-            while len(self._done) > self.IDEMPOTENCY_WINDOW:
-                self._done.popitem(last=False)
+            self._done_bytes += len(out)
+            while len(self._done) > self.idempotency_window:
+                _, old = self._done.popitem(last=False)
+                self._done_bytes -= len(old)
+                self.idem_evictions["count"] += 1
+                _IDEM_EVICT.inc(labels=("count",))
+            while self._done_bytes > self.idempotency_bytes \
+                    and len(self._done) > 1:
+                _, old = self._done.popitem(last=False)
+                self._done_bytes -= len(old)
+                self.idem_evictions["bytes"] += 1
+                _IDEM_EVICT.inc(labels=("bytes",))
         return out
 
     # -- dispatch -----------------------------------------------------------
@@ -424,7 +637,17 @@ class ReplicaServer:
 
     def _rpc_ping(self, a):
         return {"ok": True, "replica_id": self.replica_id,
-                "pid": os.getpid()}
+                "pid": os.getpid(), "epoch": self.lease_epoch}
+
+    def _rpc_lease(self, a):
+        """Explicit lease grant/renewal probe.  The epoch itself rides
+        the frame header (adoption/fencing happened in
+        ``handle_frame`` before we got here); this just reports back."""
+        return {"epoch": self.lease_epoch,
+                "quarantines": self.quarantines,
+                "quarantined_rids": [int(r)
+                                     for r in self.quarantined_rids],
+                "fenced": self.fenced}
 
     def _rpc_submit(self, a):
         rid = self.engine.submit(
@@ -435,28 +658,43 @@ class ReplicaServer:
             on_token=self._event_cb,
             deadline_seconds=a.get("deadline_seconds"),
             rid=a.get("rid"))
+        # a (re)submitted rid starts a fresh stream: seq from 1
+        self._reset_stream(rid)
         return int(rid)
 
-    def _drain_events(self):
+    def _drain_events(self, resync=None):
         ev, self._events = self._events, []
+        if resync:
+            # client detected a sequence gap: replay the event log past
+            # its last delivered seq (overlap is deduped client-side)
+            for rid, last in resync.items():
+                rid, last = int(rid), int(last)
+                for seq, tok in self._event_log.get(rid, []):
+                    if seq > last:
+                        ev.append((rid, seq, tok))
         return ev
 
     def _drain_cancelled(self):
         c = {int(r): str(reason)
              for r, reason in self.engine.cancelled.items()}
         self.engine.cancelled.clear()
+        for rid in c:
+            self._retire_stream(rid)
         return c
 
     def _rpc_step(self, a):
         done = self.engine.step()
-        return {"done": {int(r): [int(t) for t in ids]
-                         for r, ids in done.items()},
-                "events": self._drain_events(),
-                "cancelled": self._drain_cancelled()}
+        out = {"done": {int(r): [int(t) for t in ids]
+                        for r, ids in done.items()},
+               "events": self._drain_events(a.get("resync")),
+               "cancelled": self._drain_cancelled()}
+        for rid in out["done"]:
+            self._retire_stream(rid)
+        return out
 
     def _rpc_stream(self, a):
         # drain buffered token events without stepping
-        return {"events": self._drain_events(),
+        return {"events": self._drain_events(a.get("resync")),
                 "cancelled": self._drain_cancelled()}
 
     def _rpc_cancel(self, a):
@@ -472,12 +710,16 @@ class ReplicaServer:
 
     def _rpc_extract(self, a):
         req = self.engine.extract(a["slot"])
+        self._retire_stream(req.rid)
         return wire.request_to_wire(req)
 
     def _rpc_inject(self, a):
         req = wire.request_from_wire(a["req"])
         req.on_token = self._event_cb
         self.engine.inject(req)
+        # the stream continues here: post-inject tokens restart at seq 1
+        # against a fresh client-side counter (adopt_stream resets it)
+        self._reset_stream(req.rid)
         return int(req.rid)
 
     def _rpc_drain(self, a):
@@ -493,7 +735,35 @@ class ReplicaServer:
         waiting = []
         while eng._waiting:
             waiting.append(wire.request_to_wire(eng._waiting.popleft()))
+        for w in running + waiting:
+            self._retire_stream(w["rid"])
         return {"running": running, "waiting": waiting}
+
+    def _rpc_steal(self, a):
+        """Pop up to ``n`` WAITING requests off the back of the queue —
+        the ones that would wait longest (and be shed first) — for live
+        migration to a replica with headroom.  Swapped host-KV
+        snapshots ride along; running slots are untouched."""
+        eng = self.engine
+        n = int(a.get("n", 1))
+        out = []
+        while eng._waiting and len(out) < n:
+            req = eng._waiting.pop()       # back of the queue
+            out.append(wire.request_to_wire(req))
+            self._retire_stream(req.rid)
+        out.reverse()                      # preserve relative order
+        return {"stolen": out}
+
+    def _rpc_export_prefix(self, a):
+        """Ship the warmest prefix-cache pages (chain key + KV page
+        snapshot) so a drain destination starts warm."""
+        entries = self.engine.export_prefix_pages(
+            max_pages=a.get("max_pages"))
+        return {"entries": entries}
+
+    def _rpc_import_prefix(self, a):
+        n = self.engine.import_prefix_pages(a.get("entries") or [])
+        return {"imported": int(n)}
 
     def _rpc_reload_weights(self, a):
         version = a.get("version")
@@ -513,6 +783,11 @@ class ReplicaServer:
         from .soak import _engine_stats
         return _engine_stats(self.engine)
 
+    def _rpc_stream_subscribe(self, a):
+        # the serve loop attached the connection as push_sink before
+        # dispatching this ack; loopback attaches the sink directly
+        return {"ok": True, "epoch": self.lease_epoch}
+
     def _rpc_shutdown(self, a):
         self.shutting_down = True
         return {"ok": True}
@@ -525,9 +800,14 @@ class ReplicaServer:
 # Socket serve loop (runs in the worker process)
 # ---------------------------------------------------------------------------
 class SocketServerLoop:
-    """Accept one parent connection at a time and pump frames through a
-    :class:`ReplicaServer` until it flags shutdown.  A fresh connection
-    after a drop (parent restarted its transport) is business as usual."""
+    """Accept parent connections and pump frames through a
+    :class:`ReplicaServer` until it flags shutdown.  The RPC connection
+    is pumped on the accept thread (one request/reply at a time, as
+    before); a connection whose first frame is ``stream_subscribe``
+    becomes the persistent PUSH channel and is pumped on its own
+    daemon thread, so token frames flow while an RPC is in flight.  A
+    fresh connection after a drop (parent restarted its transport) is
+    business as usual."""
 
     def __init__(self, server, *, host="127.0.0.1", port=0):
         self.server = server
@@ -536,6 +816,13 @@ class SocketServerLoop:
         self._listener.bind((host, int(port)))
         self._listener.listen(4)
         self.host, self.port = self._listener.getsockname()[:2]
+        # one dispatch at a time: the push-channel pump thread and the
+        # RPC pump share the (not thread-safe) ReplicaServer
+        self._dispatch_lock = threading.Lock()
+
+    def _handle(self, frame):
+        with self._dispatch_lock:
+            return self.server.handle_frame(frame)
 
     def serve_forever(self, accept_timeout=1.0):
         self._listener.settimeout(accept_timeout)
@@ -545,6 +832,31 @@ class SocketServerLoop:
             except socket.timeout:
                 continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            first = self._read_frame(conn)
+            if first is None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            if self._is_subscribe(first):
+                # push channel: attach the sink, ack, pump on a thread
+                self.server.push_sink = conn.sendall
+                reply = self._handle(first)
+                try:
+                    conn.sendall(reply)
+                except OSError:
+                    continue
+                threading.Thread(
+                    target=self._pump, args=(conn,), daemon=True,
+                    name="ptpu-push-conn").start()
+                continue
+            reply = self._handle(first)
+            if reply is not None:
+                try:
+                    conn.sendall(reply)
+                except OSError:
+                    pass
             try:
                 self._pump(conn)
             finally:
@@ -554,6 +866,25 @@ class SocketServerLoop:
                     pass
         self._listener.close()
 
+    def _is_subscribe(self, frame):
+        try:
+            msg = wire.decode_frame(frame)
+        except wire.FrameError:
+            return False
+        return isinstance(msg, dict) and msg.get("m") == "stream_subscribe"
+
+    def _read_frame(self, conn, first_timeout=5.0):
+        """Read one complete frame (or None on drop/corruption)."""
+        conn.settimeout(first_timeout)
+        try:
+            header = _recv_exact(conn, wire.HEADER_SIZE)
+            _, length, _ = wire.parse_header(header)
+            payload = _recv_exact(conn, length)
+        except (socket.timeout, TransportSevered, wire.FrameError,
+                OSError):
+            return None
+        return header + payload
+
     def _pump(self, conn):
         conn.settimeout(0.5)
         while not self.server.shutting_down:
@@ -561,7 +892,7 @@ class SocketServerLoop:
                 header = _recv_exact(conn, wire.HEADER_SIZE)
             except socket.timeout:
                 continue
-            except TransportSevered:
+            except (TransportSevered, OSError):
                 return                     # parent dropped; re-accept
             try:
                 _, length, _ = wire.parse_header(header)
@@ -569,11 +900,14 @@ class SocketServerLoop:
                 payload = _recv_exact(conn, length)
             except wire.FrameError:
                 return                     # unsynced stream; re-accept
-            except (socket.timeout, TransportSevered):
+            except (socket.timeout, TransportSevered, OSError):
                 return
             finally:
-                conn.settimeout(0.5)
-            reply = self.server.handle_frame(header + payload)
+                try:
+                    conn.settimeout(0.5)
+                except OSError:
+                    return
+            reply = self._handle(header + payload)
             if reply is not None:
                 try:
                     conn.sendall(reply)
@@ -603,6 +937,17 @@ class RemoteEngine:
         self.scrape_port = None
         self.replica_id = None
         self.weights_version = 0
+        # exactly-once stream delivery by sequence number
+        self._seq = {}                # rid -> last delivered seq
+        self._ahead = {}              # rid -> {seq: tok} out-of-order hold
+        self._need_resync = set()     # rids with a detected gap
+        self._push_q = deque()        # pushed frames awaiting pump
+        self._push_handle = None
+        self.stream_dups = 0          # dropped by seq (benign overlap)
+        self.stream_gaps = 0
+        self.stream_resyncs = 0
+        self.push_delivered = 0       # tokens delivered off push frames
+        self.fenced_replies = 0       # old-epoch replies dropped whole
         if hello:
             info = transport.call("hello")
             self.max_slots = info["max_slots"]
@@ -621,18 +966,110 @@ class RemoteEngine:
         if self.transport.last_load is not None:
             self._load = self.transport.last_load
 
+    def _drop_stream_state(self, rid):
+        rid = int(rid)
+        self._cbs.pop(rid, None)
+        self._seq.pop(rid, None)
+        self._ahead.pop(rid, None)
+        self._need_resync.discard(rid)
+
+    def _deliver(self, rid, seq, tok, *, pushed=False):
+        """Exactly-once, in-order delivery: seq must be last+1.  Lower
+        is a duplicate (both channels / reconnect replay) and dropped;
+        higher is held and flagged for a pull-path resync."""
+        rid, seq = int(rid), int(seq)
+        last = self._seq.get(rid)
+        if last is None:
+            return                    # no live stream for this rid here
+        if seq <= last:
+            self.stream_dups += 1
+            _STREAM_DUP.inc()
+            return
+        if seq > last + 1:
+            self._ahead.setdefault(rid, {})[seq] = tok
+            if rid not in self._need_resync:
+                self._need_resync.add(rid)
+                self.stream_gaps += 1
+            return
+        cb = self._cbs.get(rid)
+        if cb is not None:
+            cb(rid, tok)
+        if pushed:
+            self.push_delivered += 1
+        self._seq[rid] = seq
+        ahead = self._ahead.get(rid)
+        while ahead:
+            nxt = self._seq[rid] + 1
+            if nxt not in ahead:
+                break
+            t = ahead.pop(nxt)
+            if cb is not None:
+                cb(rid, t)
+            if pushed:
+                self.push_delivered += 1
+            self._seq[rid] = nxt
+        if not ahead:
+            self._ahead.pop(rid, None)
+            self._need_resync.discard(rid)
+
+    def _link_fenced(self):
+        """True when the LAST reply on this link was generated under an
+        older lease epoch than the link now holds — a late arrival from
+        before a partition; its contents must not surface."""
+        ep = self.transport.last_ep
+        if ep is not None and ep < self.transport.epoch:
+            self.fenced_replies += 1
+            return True
+        return False
+
     def _absorb(self, reply):
         """Fold a step/stream/cancel reply's events + cancels into the
         client-side stream state, exactly once per reply."""
-        for rid, tok in reply.get("events") or []:
-            cb = self._cbs.get(rid)
-            if cb is not None:
-                cb(rid, tok)
+        if self._link_fenced():
+            return
+        for rid, seq, tok in reply.get("events") or []:
+            self._deliver(rid, seq, tok)
         for rid, reason in (reply.get("cancelled") or {}).items():
             rid = int(rid)
             self.cancelled[rid] = reason
-            self._cbs.pop(rid, None)
+            self._drop_stream_state(rid)
         self._refresh_load()
+
+    # -- push channel -------------------------------------------------------
+    def enable_push(self):
+        """Open the persistent push channel (second connection over a
+        socket transport, a synchronous buffer over loopback).  Pushed
+        frames queue until :meth:`pump_push` drains them on the caller's
+        thread, so callbacks never fire concurrently."""
+        if self._push_handle is None:
+            self._push_handle = self.transport.open_push(
+                self._push_q.append)
+        return self._push_handle is not None
+
+    def pump_push(self):
+        """Deliver queued push frames into client callbacks.  Safe to
+        call at any cadence — a front-end polling between supervisor
+        ticks gets tokens the moment the server emits them instead of
+        quantized to the tick.  Returns frames drained."""
+        n = 0
+        while self._push_q:
+            msg = self._push_q.popleft()
+            n += 1
+            ep = msg.get("ep")
+            if ep is not None and int(ep) < self.transport.epoch:
+                self.fenced_replies += 1
+                continue
+            for rid, seq, tok in msg.get("push") or []:
+                self._deliver(rid, seq, tok, pushed=True)
+        return n
+
+    def _resync_args(self):
+        if not self._need_resync:
+            return {}
+        self.stream_resyncs += len(self._need_resync)
+        _STREAM_RESYNC.inc(len(self._need_resync))
+        return {"resync": {int(r): int(self._seq.get(r, 0))
+                           for r in self._need_resync}}
 
     # -- engine surface -----------------------------------------------------
     def submit(self, prompt_ids, temperature=0.0, top_k=0, top_p=1.0,
@@ -647,6 +1084,10 @@ class RemoteEngine:
         out = int(out)
         if on_token is not None:
             self._cbs[out] = on_token
+        # fresh stream: server restarts this rid's seq from 1
+        self._seq[out] = 0
+        self._ahead.pop(out, None)
+        self._need_resync.discard(out)
         self._refresh_load()
         return out
 
@@ -656,7 +1097,8 @@ class RemoteEngine:
         sequential collection pass, so child processes decode
         CONCURRENTLY on real wall clock."""
         if self._pending_step is None:
-            self._pending_step = self.transport.begin("step", {})
+            self._pending_step = self.transport.begin(
+                "step", self._resync_args())
 
     def step(self):
         call, self._pending_step = self._pending_step, None
@@ -664,15 +1106,19 @@ class RemoteEngine:
             if call is not None:
                 reply = self.transport.finish(call)
             else:
-                reply = self.transport.call("step", {})
+                reply = self.transport.call("step", self._resync_args())
         except BaseException:
             self._pending_step = None
             raise
+        self.pump_push()
+        if self._link_fenced():
+            # late reply from before the lease was re-issued: fenced
+            return {}
         self._absorb(reply)
         done = {int(r): list(ids)
                 for r, ids in (reply.get("done") or {}).items()}
         for rid in done:
-            self._cbs.pop(rid, None)
+            self._drop_stream_state(rid)
         return done
 
     def run_until_complete(self, max_ticks=10000):
@@ -691,7 +1137,7 @@ class RemoteEngine:
         reply = self.transport.call("cancel", {"rid": int(rid),
                                                "reason": reason})
         self._absorb(reply)
-        self._cbs.pop(int(rid), None)
+        self._drop_stream_state(rid)
         return bool(reply["ok"])
 
     def load(self):
@@ -704,7 +1150,16 @@ class RemoteEngine:
                                    {"tokens": [int(t) for t in tokens]})
 
     def stream(self):
-        self._absorb(self.transport.call("stream", {}))
+        self.pump_push()
+        self._absorb(self.transport.call("stream", self._resync_args()))
+
+    def lease(self, epoch=None, timeout=None):
+        """Grant/renew the lease at ``epoch`` (bumps the link's fencing
+        token) and return the server's view — quarantine counters and
+        the rids it cancelled when an older lease was revoked."""
+        if epoch is not None:
+            self.transport.epoch = int(epoch)
+        return self.transport.call("lease", {}, timeout=timeout)
 
     # -- migration / upgrade seam -------------------------------------------
     def extract_wire(self, slot):
@@ -716,14 +1171,33 @@ class RemoteEngine:
     def drain_requests(self):
         return self.transport.call("drain", {})
 
+    def steal_requests(self, n):
+        """Pop up to ``n`` waiting requests (KV snapshots ride along)
+        off the replica's queue for live migration to a peer."""
+        return self.transport.call("steal", {"n": int(n)})["stolen"]
+
+    def export_prefix(self, max_pages=None):
+        return self.transport.call(
+            "export_prefix", {"max_pages": max_pages})["entries"]
+
+    def import_prefix(self, entries):
+        return int(self.transport.call(
+            "import_prefix", {"entries": entries})["imported"])
+
     def release_stream(self, rid):
         """Detach and return the client callback for ``rid`` (the
         stream is moving to a peer replica)."""
+        self._seq.pop(int(rid), None)
+        self._ahead.pop(int(rid), None)
+        self._need_resync.discard(int(rid))
         return self._cbs.pop(int(rid), None)
 
     def adopt_stream(self, rid, cb):
         if cb is not None:
             self._cbs[int(rid)] = cb
+            # the migrated stream restarts at seq 1 on this replica
+            self._seq[int(rid)] = 0
+            self._ahead.pop(int(rid), None)
 
     def reload_weights(self, model=None, version=None):
         if model is not None:
@@ -764,4 +1238,10 @@ class RemoteEngine:
             return None
 
     def close(self):
+        h, self._push_handle = self._push_handle, None
+        if h is not None and hasattr(h, "close"):
+            try:
+                h.close()
+            except OSError:
+                pass
         self.transport.close()

@@ -95,8 +95,12 @@ def main(argv=None):
                               replica_id=replica_id).start()
         scrape_port = scrape.port
 
+    from .router import RID_STRIDE
+
     model = build_model_from_spec(spec)
-    engine = ContinuousBatchingEngine(model, **spec.get("engine_kw", {}))
+    engine = ContinuousBatchingEngine(
+        model, rid_base=replica_id * RID_STRIDE,
+        **spec.get("engine_kw", {}))
 
     def model_factory(version=None):
         return build_model_from_spec(spec, version=version)

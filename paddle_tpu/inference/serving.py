@@ -605,6 +605,8 @@ class ContinuousBatchingEngine:
         self.prefix_cache_hits = 0    # pages reused instead of prefilled
         self.prefix_cache_evictions = 0
         self.prefix_tokens_skipped = 0
+        self.prefix_pages_exported = 0  # shipped to a drain destination
+        self.prefix_pages_imported = 0  # warmed from a draining peer
         self._cache_admit_floor = 0   # requests admitted before a
                                       # reload_weights hold stale KV and
                                       # must not register pages
@@ -1871,6 +1873,60 @@ class ContinuousBatchingEngine:
         mode; the disagg wrapper enforces this."""
         self._next_rid = max(self._next_rid, req.rid + 1)
         self._waiting.append(req)
+
+    def export_prefix_pages(self, max_pages=None):
+        """Serialize prefix-cache entries — (chain key, one-page KV
+        snapshot) pairs, in cache insertion order so every chain ships
+        head-first — for a drain destination to warm its cache from
+        before this engine retires. ``max_pages`` caps the payload; a
+        chain cut mid-way imports as a valid shorter prefix (a shipped
+        tail whose head was cut is unreachable by ``_match_prefix`` and
+        simply evicts under pressure)."""
+        if not self.enable_prefix_cache:
+            return []
+        keys = list(self._prefix_cache)
+        if max_pages is not None:
+            keys = keys[: int(max_pages)]
+        entries = []
+        for start in range(0, len(keys), self.pages_per_seq):
+            chunk = keys[start: start + self.pages_per_seq]
+            pages = [self._prefix_cache[k] for k in chunk]
+            k, v = self._swap_out_jit(self.kc, self.vc,
+                                      self._padded_page_vec(pages))
+            for i, key in enumerate(chunk):
+                cut = lambda c, i=i: np.asarray(c[:, :, i: i + 1])
+                entries.append({"key": bytes(key),
+                                "k": _kv_map(cut, k),
+                                "v": _kv_map(cut, v)})
+        self.prefix_pages_exported += len(entries)
+        return entries
+
+    def import_prefix_pages(self, entries):
+        """Install exported prefix pages: allocate a page, scatter the
+        snapshot into the caches, register key -> page at refcount 0 —
+        free-but-cached, evictable under pressure like any cached page.
+        Known keys are skipped; import never evicts anything (free-pool
+        pages only: warming must not cannibalize live or warmer state).
+        Returns the number of pages imported."""
+        if not self.enable_prefix_cache:
+            return 0
+        n = 0
+        for e in entries:
+            key = bytes(e["key"])
+            if key in self._prefix_cache:
+                continue
+            if self.pool.available == 0:
+                break
+            pg = self.pool.alloc(1)[0]
+            pages = self._jnp.asarray(np.asarray([pg], np.int32))
+            self.kc, self.vc = self._swap_scatter(
+                self.kc, self.vc, pages, e["k"], e["v"])
+            self._prefix_cache[key] = pg
+            self._cached_pages.add(pg)
+            self._page_ref[pg] = 0
+            n += 1
+        self.prefix_pages_imported += n
+        return n
 
     def warmup(self, sample=False):
         """Compile the engine's programs on dummy operands (cache writes

@@ -30,7 +30,9 @@ Four fault families, matching how real training jobs die
   with deterministic send-ordinal-keyed frame faults — drop, delay,
   duplicate, corrupt (byte flip past the header), and sever-for-N-calls
   — the seam the RPC retry/idempotency machinery is proven against
-  (docs/SERVING.md "Process topology").
+  (docs/SERVING.md "Process topology"). `PartitionedLink` holds one
+  link severed as a STATE (sever/heal), the network-partition seam the
+  cross-host fencing machinery (fleet.hosts) is proven against.
 
 Every injector routes through a seam its subsystem exposes
 (`distributed.checkpoint._WRITE_FAULT_HOOK` for writes,
@@ -489,3 +491,70 @@ class ChaosTransport:
             return data
 
         return _recv_bytes
+
+
+class PartitionedLink:
+    """Network-partition seam for one supervisor->host fleet link.
+
+    Unlike :meth:`ChaosTransport.sever_for` (a count of failed send
+    attempts), a partition is a STATE: while :meth:`sever` holds, every
+    send raises `TransportSevered` immediately and every push frame the
+    server emits is swallowed before the client sees it — nothing
+    crosses in either direction until :meth:`heal`.  The supervisor's
+    host-lease machinery (fleet.hosts) is proven against this seam: a
+    severed host's replicas are fenced to a higher lease epoch and
+    replayed elsewhere, and a healed host's survivors self-quarantine
+    on first contact instead of double-serving.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.severed = False
+        self.blocked_sends = 0
+        self.blocked_push = 0
+        # capture the BOUND send (which may already be chaos-spliced) so
+        # partition composes with ChaosTransport fault schedules
+        inner._send = self._send_gated(inner._send)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def epoch(self):
+        """Lease fencing token — delegated so the supervisor's epoch
+        stamp lands on the real transport, not the wrapper."""
+        return self._inner.epoch
+
+    @epoch.setter
+    def epoch(self, value):
+        self._inner.epoch = value
+
+    def sever(self):
+        self.severed = True
+
+    def heal(self):
+        self.severed = False
+
+    def open_push(self, on_msg):
+        """Push frames ride the same (conceptual) network: while the
+        partition holds they are dropped client-side, exactly as a real
+        severed connection would lose them — the pull path's event-log
+        resync is what recovers the stream."""
+        def gated(msg):
+            if self.severed:
+                self.blocked_push += 1
+                return
+            on_msg(msg)
+
+        return self._inner.open_push(gated)
+
+    def _send_gated(self, real_send):
+        from paddle_tpu.inference.fleet.transport import TransportSevered
+
+        def _send(frame):
+            if self.severed:
+                self.blocked_sends += 1
+                raise TransportSevered("chaos: network partition")
+            return real_send(frame)
+
+        return _send

@@ -154,3 +154,40 @@ def test_launcher_elastic_scale_out(tmp_path):
     for ln in done:
         assert "world=3" in ln
         assert int(ln.split("start_step=")[1]) >= 4  # resumed, not restarted
+
+
+class TestStoreClock:
+    def test_wait_deadline_runs_on_injected_monotonic_clock(self):
+        """Regression: wait() deadlines are measured on the store's own
+        monotonic clock, never wall time — an NTP step must not hang or
+        instantly expire a rendezvous wait.  With an injected clock that
+        jumps 10 "seconds" per probe, a 25s timeout expires after ~3
+        polls of real sleep (<1s wall), proving the deadline math reads
+        the injected clock and not time.time()/time.monotonic()."""
+        import time as _time
+
+        port = _free_port()
+        master = TCPStore(host="127.0.0.1", port=port, is_master=True,
+                          world_size=1)
+        ticks = {"n": 0}
+
+        def fake_clock():
+            ticks["n"] += 1
+            return ticks["n"] * 10.0
+
+        client = TCPStore(host="127.0.0.1", port=port, is_master=False,
+                          world_size=1, clock=fake_clock)
+        try:
+            start = _time.monotonic()
+            with pytest.raises(TimeoutError, match="missing/key"):
+                client.wait("missing/key", timeout=25.0)
+            # real wall time stays tiny: the 25s budget was consumed by
+            # the fake clock, not by sleeping
+            assert _time.monotonic() - start < 5.0
+            assert ticks["n"] >= 2  # deadline set + at least one check
+            # an existing key is still returned immediately
+            master.set("present", b"v")
+            assert client.wait("present", timeout=25.0) == b"v"
+        finally:
+            client.close()
+            master.close()

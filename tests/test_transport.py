@@ -316,3 +316,46 @@ class TestSnapshotWireContract:
         buf[wire.HEADER_SIZE + 5] ^= 0x40
         with pytest.raises(wire.FrameError):
             wire.decode_frame(bytes(buf))
+
+
+class TestIdempotencyBounds:
+    def test_byte_bound_evicts_oldest_first(self):
+        """The reply cache is bounded by retained payload BYTES, not
+        only entry count: a burst of fat replies (extract/drain carry
+        KV snapshots) must not pin unbounded memory.  Oldest entries
+        go first; a re-sent evicted call re-executes (which is safe —
+        idempotency only matters inside the retry window)."""
+        eng = _engine(_tiny_model())
+        # a ping reply frame is ~170 bytes; a 512-byte bound holds only
+        # the three most recent replies
+        server = ReplicaServer(eng, idempotency_window=64,
+                               idempotency_bytes=512)
+        for i in range(5):
+            server.handle_frame(wire.encode_frame(
+                {"id": 1000 + i, "m": "ping", "a": {}}))
+        assert server.idem_evictions["bytes"] >= 1
+        assert server._done_bytes <= 512
+        # the oldest call ids were evicted, the newest survives
+        assert 1000 not in server._done
+        assert 1004 in server._done
+        # a duplicate of a SURVIVING entry still replays from cache
+        before = server.handled
+        server.handle_frame(wire.encode_frame(
+            {"id": 1004, "m": "ping", "a": {}}))
+        assert server.handled == before
+        assert server.duplicates == 1
+        # an EVICTED call id re-executes rather than replaying
+        server.handle_frame(wire.encode_frame(
+            {"id": 1000, "m": "ping", "a": {}}))
+        assert server.handled == before + 1
+        assert server.duplicates == 1
+
+    def test_count_window_still_applies(self):
+        eng = _engine(_tiny_model())
+        server = ReplicaServer(eng, idempotency_window=4)
+        for i in range(7):
+            server.handle_frame(wire.encode_frame(
+                {"id": i, "m": "ping", "a": {}}))
+        assert len(server._done) == 4
+        assert server.idem_evictions["count"] == 3
+        assert set(server._done) == {3, 4, 5, 6}

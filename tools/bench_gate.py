@@ -536,6 +536,61 @@ def upgrade_violations(rec):
     return out
 
 
+def partition_violations(rec):
+    """Reference-free violation strings from one record's "partition"
+    block (docs/SERVING.md "Cross-host topology"; emitted by
+    ``tools/serve_bench.py --hosts N``): the cross-host fleet soak with
+    a whole host partitioned away mid-traffic, its replicas fenced and
+    their work replayed, then the partition healed. The invariants are
+    absolute:
+
+    - ``conserved`` false / ``lost_requests`` > 0 — a severed host must
+      not lose or hang a single request;
+    - ``duplicate_stream_tokens`` > 0 — the fencing epochs guarantee no
+      rid is ever served by two replicas, so the independent callback
+      seam must count zero duplicate deliveries (a duplicate here means
+      a stale lease's tokens leaked past the fence);
+    - ``lost_stream_tokens`` > 0 — exactly-once is not at-most-once;
+    - ``fleet_live_at_drain`` false — replay + respawn must reconverge
+      the fleet to target size;
+    - ``partition.healed`` false with a surviving agent — a healed
+      network must return the host to service (with ``agent_killed``
+      the host legitimately stays severed and is not gated);
+    - an overlapped rolling upgrade that never completed."""
+    block = rec.get("partition") if isinstance(rec, dict) else None
+    if not isinstance(block, dict) or not block.get("enabled"):
+        return []
+    out = []
+    if block.get("conserved") is False:
+        out.append(f"outcome conservation broken across the host "
+                   f"partition ({block.get('submitted')} submitted, "
+                   f"{block.get('served')} served)")
+    lost = int(block.get("lost_requests") or 0)
+    if lost > 0:
+        out.append(f"{lost} request(s) lost (no terminal outcome) "
+                   "through the host partition")
+    dup = int(block.get("duplicate_stream_tokens") or 0)
+    if dup > 0:
+        out.append(f"{dup} stream token(s) delivered more than once "
+                   "(a stale lease leaked past the fencing epoch)")
+    missing = int(block.get("lost_stream_tokens") or 0)
+    if missing > 0:
+        out.append(f"{missing} generated token(s) never delivered to "
+                   "their stream callback")
+    if block.get("fleet_live_at_drain") is False:
+        out.append("fleet below target size after the run settled "
+                   "(replay/respawn did not reconverge)")
+    part = block.get("partition") or {}
+    if part.get("healed") is False and not part.get("agent_killed"):
+        out.append(f"host {part.get('host')} never returned to service "
+                   "after the partition healed")
+    up = block.get("upgrade") or {}
+    if up and not up.get("complete"):
+        out.append(f"rolling upgrade to version {up.get('version')} "
+                   "overlapping the partition did not complete")
+    return out
+
+
 def cold_start_violations(rec, ref_rec, threshold=0.25):
     """Referenced gate on the serving block's replica cold start
     (engine construction + program compile, ``warmup()``): must not
@@ -764,6 +819,12 @@ def main(argv=None):
         # plus embedded window budgets (docs/SERVING.md)
         for v in upgrade_violations(rec):
             print(f"  UPGRADE {metric}: {v}", flush=True)
+            failed = True
+        # partition gate (reference-free): zero lost / duplicated
+        # requests and tokens through a whole-host partition — fencing
+        # epochs, fleet-wide replay, heal + adoption (docs/SERVING.md)
+        for v in partition_violations(rec):
+            print(f"  PARTITION {metric}: {v}", flush=True)
             failed = True
         # pipeline gate (docs/PIPELINE.md): measured-cost bubble over
         # budget, or a pp-live mesh whose composition never engaged

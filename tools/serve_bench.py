@@ -103,6 +103,26 @@ def main(argv=None):
                     "block (docs/SERVING.md 'Process topology'). "
                     "PTPU_FLEET_PROC=0 falls back to in-process "
                     "loopback children, bitwise")
+    ap.add_argument("--hosts", type=int, default=None,
+                    help="run the cross-host fleet scenario instead of "
+                    "the in-process sweep: replicas spread across N "
+                    "host agents discovered through the rendezvous "
+                    "store, one whole host partitioned away mid-soak "
+                    "(fenced leases + fleet-wide replay), then healed "
+                    "— emits the gateable 'partition' block "
+                    "(docs/SERVING.md 'Cross-host topology'). "
+                    "PTPU_FLEET_HOSTS=0 collapses to the single-host "
+                    "topology, bitwise")
+    ap.add_argument("--sever-tick", type=int, default=4,
+                    help="soak tick at which the host partition starts "
+                    "(--hosts scenario)")
+    ap.add_argument("--heal-tick", type=int, default=None,
+                    help="soak tick at which the partition heals "
+                    "(--hosts scenario; default: after the soak drains)")
+    ap.add_argument("--kill-agent", action="store_true",
+                    help="also SIGKILL the severed host's agent "
+                    "(--hosts scenario; the host stays lost and the "
+                    "fleet must reconverge on the survivors)")
     ap.add_argument("--kill-tick", type=int, default=3,
                     help="soak tick at which one replica is SIGKILLed "
                     "(--procs scenario; negative disables the kill)")
@@ -196,6 +216,54 @@ def main(argv=None):
     else:
         engine_kw.update(max_slots=slots, page_size=page,
                          enable_prefix_cache=args.prefix_cache)
+
+    if args.hosts:
+        from paddle_tpu.inference.fleet import (FleetSupervisor,
+                                                fleet_hosts_enabled,
+                                                fleet_proc_enabled,
+                                                make_model_spec,
+                                                partition_block)
+
+        n_hosts = args.hosts
+        if not fleet_hosts_enabled():
+            sys.stderr.write("# serve_bench: PTPU_FLEET_HOSTS=0 — "
+                             "cross-host scenario collapses to the "
+                             "single-host topology; skipping\n")
+            return
+        n = max(max(replica_counts), n_hosts)
+        he_kw = dict(engine_kw)
+        he_kw.setdefault("max_slots", slots)
+        he_kw.setdefault("page_size", page)
+        he_kw["seed"] = args.seed
+        spec = make_model_spec(
+            dict(vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+                 num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+                 num_kv_heads=cfg.num_kv_heads,
+                 max_seq_len=cfg.max_seq_len, dropout=0.0),
+            seed=args.seed, engine_kw=he_kw)
+        proc = fleet_proc_enabled()
+        sup = FleetSupervisor(
+            spec, n, proc=proc, policy=args.policy, hosts=n_hosts,
+            lease_seconds=120.0, host_lease_seconds=1.0,
+            transport_kw=dict(timeouts={"step": 10.0, "submit": 10.0},
+                              backoff=0.01))
+        try:
+            block = partition_block(
+                sup, workload, host="host0",
+                sever_tick=args.sever_tick, heal_tick=args.heal_tick,
+                kill_agent=args.kill_agent,
+                upgrade_version=(1 if args.upgrade_tick >= 0 else None),
+                upgrade_tick=(args.upgrade_tick
+                              if args.upgrade_tick >= 0 else None))
+        finally:
+            sup.close()
+        print(json.dumps({
+            "metric": f"serve_crosshost_goodput_h{n_hosts}_r{n}",
+            "value": block.get("goodput_tokens_per_sec"),
+            "unit": "tokens/sec",
+            "partition": block,
+        }), flush=True)
+        return
 
     if args.procs:
         from paddle_tpu.inference.fleet import (FleetSupervisor,
